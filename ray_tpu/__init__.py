@@ -1,0 +1,196 @@
+"""ray_tpu: a TPU-native distributed ML framework.
+
+Capability surface of Ray (tasks, actors, distributed object store,
+placement groups, Train/Tune/Data/Serve/RLlib-equivalents) re-designed for
+TPU hardware: the tensor plane is XLA collectives over ICI (jax.sharding +
+shard_map + pallas), not NCCL; the scheduler treats TPU chips and slice
+topology as first-class resources.
+
+Public core API mirrors the reference (``python/ray/__init__.py``):
+``init``, ``shutdown``, ``remote``, ``get``, ``put``, ``wait``, ``kill``,
+``cancel``, ``get_actor``, ``nodes``, ``cluster_resources``,
+``available_resources``, plus ``ObjectRef`` / ``ActorHandle`` types.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ._private import worker as _worker_mod
+from ._private.ids import ActorID, NodeID, ObjectID, TaskID
+from ._private.remote import ActorClass, ActorHandle, ActorMethod, RemoteFunction, remote
+from ._private.serialization import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ._private.worker import ObjectRef
+
+__version__ = "0.1.0"
+
+_head_node = None
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default",
+         num_initial_workers: int = 2,
+         probe_tpu: bool = True,
+         ignore_reinit_error: bool = False,
+         object_store_memory: Optional[int] = None,
+         log_to_driver: bool = True):
+    """Start (or connect to) a ray_tpu cluster.
+
+    With no ``address``, spawns a head process (GCS + node agent + worker
+    pool) for this host — the analog of ``ray.init()`` head-node bootstrap
+    (reference: ``python/ray/_private/worker.py:1262``).
+    """
+    global _head_node, _initialized
+    if _initialized:
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu.init() called twice; use "
+                           "ignore_reinit_error=True to allow this.")
+    if address is None:
+        from ._private.node import HeadNode
+
+        res = dict(resources or {})
+        if object_store_memory is not None:
+            res["object_store_memory"] = float(object_store_memory)
+        _head_node = HeadNode(num_cpus=num_cpus, num_tpus=num_tpus,
+                              resources=res or None,
+                              num_initial_workers=num_initial_workers,
+                              probe_tpu=probe_tpu)
+        address = _head_node.address
+    w = _worker_mod.Worker(role="driver")
+    w.namespace = namespace
+    w.connect(address)
+    _worker_mod.set_global_worker(w)
+    _initialized = True
+    atexit.register(shutdown)
+    return address
+
+
+def shutdown():
+    """Disconnect and, if this driver started the cluster, tear it down."""
+    global _head_node, _initialized
+    if not _initialized:
+        return
+    w = _worker_mod._global_worker
+    if w is not None:
+        if _head_node is not None:
+            try:
+                w.request_gcs({"t": "shutdown"}, timeout=5)
+            except Exception:
+                pass
+        w.disconnect()
+    _worker_mod.set_global_worker(None)
+    if _head_node is not None:
+        _head_node.stop()
+        _head_node = None
+    _initialized = False
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    w = _worker_mod.global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or a list, got {type(refs)}")
+    return w.get(list(refs), timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return _worker_mod.global_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return _worker_mod.global_worker().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _worker_mod.global_worker().kill_actor(actor._id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    _worker_mod.global_worker().cancel_task(ref.task_id(), force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (reference: ``ray.get_actor``)."""
+    w = _worker_mod.global_worker()
+    aid = w.get_actor_id_by_name(name, namespace or w.namespace)
+    # Method names are unknown without the class; permissive handle resolves
+    # any non-underscore attribute as a method.
+    return _AnyMethodActorHandle(aid, [], 0)
+
+
+class _AnyMethodActorHandle(ActorHandle):
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+
+def nodes() -> List[dict]:
+    info = _worker_mod.global_worker().cluster_info()
+    return [
+        {"NodeID": n["node_id"].hex(), "Alive": n["alive"],
+         "NodeManagerHostname": n["hostname"], "Resources": n["total"],
+         "Available": n["avail"], "Workers": n["workers"]}
+        for n in info["nodes"]
+    ]
+
+
+def cluster_resources() -> Dict[str, float]:
+    info = _worker_mod.global_worker().cluster_info()
+    out: Dict[str, float] = {}
+    for n in info["nodes"]:
+        if n["alive"]:
+            for k, v in n["total"].items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    info = _worker_mod.global_worker().cluster_info()
+    out: Dict[str, float] = {}
+    for n in info["nodes"]:
+        if n["alive"]:
+            for k, v in n["avail"].items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorHandle", "ActorClass",
+    "RemoteFunction", "TaskError", "ActorDiedError", "WorkerCrashedError",
+    "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
+]

@@ -1,0 +1,1037 @@
+"""Global control service: the cluster's single control-plane authority.
+
+TPU-native re-design of the reference's GCS + raylet split
+(``src/ray/gcs/gcs_server/gcs_server.cc``, ``src/ray/raylet/node_manager.h``).
+The reference distributes scheduling across per-node raylets with worker
+leases because its clusters are thousands of CPU nodes; a TPU cluster is a
+small number of *hosts* (one per 4-8 chips) each fronting enormous compute,
+so a centralized asyncio control plane comfortably covers the control-plane
+rates that matter (§6 of SURVEY.md) while being radically simpler. The
+sched­uler still implements the reference's policy surface: hybrid
+pack-then-spread (``raylet/scheduling/policy/hybrid_scheduling_policy.h:50``),
+SPREAD, node-affinity, and placement-group bundle placement with
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD (``policy/bundle_scheduling_policy.cc``).
+
+Components in this process (each a manager class, mirroring the reference's
+``gcs_server.h:128-161`` Init* list):
+  * NodeDirectory    — node membership + resource accounting
+  * WorkerDirectory  — worker registration, pools, liveness
+  * TaskManager      — queueing, scheduling, retries, lineage for recon
+  * ObjectDirectory  — object table, inline store, waiters, LRU eviction
+  * ActorDirectory   — actor lifecycle state machine, named actors, restarts
+  * PlacementGroups  — bundle reservation across nodes
+  * KV               — namespaced key-value store (functions, metadata)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import protocol
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .object_store import make_store
+
+logger = logging.getLogger(__name__)
+
+# Worker states
+W_STARTING = "starting"
+W_IDLE = "idle"
+W_BUSY = "busy"
+W_ACTOR = "actor"
+W_DEAD = "dead"
+
+# Actor states (reference: gcs_actor_manager.h:89 state machine)
+A_PENDING = "pending"
+A_ALIVE = "alive"
+A_RESTARTING = "restarting"
+A_DEAD = "dead"
+
+
+def _res_fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+
+def _res_sub(avail: Dict[str, float], req: Dict[str, float]):
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _res_add(avail: Dict[str, float], req: Dict[str, float]):
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+class NodeInfo:
+    def __init__(self, node_id: NodeID, resources: Dict[str, float], hostname: str,
+                 agent_conn: Optional[protocol.Connection]):
+        self.node_id = node_id
+        self.total = dict(resources)
+        self.avail = dict(resources)
+        self.hostname = hostname
+        self.agent_conn = agent_conn
+        self.alive = True
+        self.idle_workers: deque = deque()  # WorkerID
+        self.workers: Set[WorkerID] = set()
+        self.spawning = 0
+
+    def utilization(self) -> float:
+        cpu_t = self.total.get("CPU", 0.0)
+        if cpu_t <= 0:
+            return 0.0
+        return 1.0 - self.avail.get("CPU", 0.0) / cpu_t
+
+
+class WorkerInfo:
+    def __init__(self, worker_id: WorkerID, node_id: NodeID,
+                 conn: protocol.Connection, addr: str, pid: int):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.conn = conn
+        self.addr = addr
+        self.pid = pid
+        self.state = W_IDLE
+        self.current_task: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.acquired: Dict[str, float] = {}
+
+
+class TaskRecord:
+    __slots__ = ("task_id", "msg", "owner", "retries_left", "state", "worker_id",
+                 "cancelled", "resources", "pg", "bundle", "strategy", "returns")
+
+    def __init__(self, task_id: TaskID, msg: dict, owner: "ClientConn"):
+        self.task_id = task_id
+        self.msg = msg
+        self.owner = owner
+        opts = msg.get("opts") or {}
+        self.retries_left = opts.get("retries", 3)
+        self.resources = opts.get("res") or {"CPU": 1.0}
+        self.pg = opts.get("pg")
+        self.bundle = opts.get("bix")
+        self.strategy = opts.get("sched") or "DEFAULT"
+        self.state = "pending"
+        self.worker_id: Optional[WorkerID] = None
+        self.cancelled = False
+        self.returns: List[ObjectID] = [
+            ObjectID.for_task_return(task_id, i + 1)
+            for i in range(msg.get("nret", 1))
+        ]
+
+
+class ObjectEntry:
+    __slots__ = ("object_id", "nbytes", "ready", "inline", "on_shm", "refcount",
+                 "waiters", "producing_task", "spilled")
+
+    def __init__(self, object_id: ObjectID):
+        self.object_id = object_id
+        self.nbytes = 0
+        self.ready = False
+        self.inline: Optional[bytes] = None
+        self.on_shm = False
+        self.refcount = 0
+        self.waiters: List[Tuple[protocol.Connection, dict]] = []
+        self.producing_task: Optional[dict] = None  # retained spec for recon
+        self.spilled: Optional[str] = None
+
+
+class ActorRecord:
+    def __init__(self, actor_id: ActorID, msg: dict, owner: "ClientConn"):
+        self.actor_id = actor_id
+        self.msg = msg
+        self.owner = owner
+        opts = msg.get("opts") or {}
+        self.name: Optional[str] = opts.get("name")
+        self.namespace: str = opts.get("namespace") or "default"
+        self.detached: bool = opts.get("lifetime") == "detached"
+        self.resources: Dict[str, float] = opts.get("res") or {"CPU": 1.0}
+        self.max_restarts: int = opts.get("max_restarts", 0)
+        self.restarts_used = 0
+        self.pg = opts.get("pg")
+        self.bundle = opts.get("bix")
+        self.state = A_PENDING
+        self.worker_id: Optional[WorkerID] = None
+        self.addr: Optional[str] = None
+        self.node_id: Optional[NodeID] = None
+        self.addr_waiters: List[Tuple[protocol.Connection, dict]] = []
+        self.death_cause: Optional[str] = None
+
+
+class PGRecord:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str, name: str, owner: "ClientConn"):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.owner = owner
+        self.state = "pending"
+        self.placement: List[Optional[NodeID]] = [None] * len(bundles)
+        # Per-bundle available resources once reserved.
+        self.bundle_avail: List[Dict[str, float]] = [dict(b) for b in bundles]
+        self.ready_waiters: List[Tuple[protocol.Connection, dict]] = []
+
+
+class ClientConn:
+    """A registered client: driver, worker, or node agent."""
+
+    def __init__(self, conn: protocol.Connection):
+        self.conn = conn
+        self.role = "unknown"
+        self.worker_id: Optional[WorkerID] = None
+        self.node_id: Optional[NodeID] = None
+
+
+class GcsServer:
+    def __init__(self, session_name: str, session_dir: str,
+                 store_capacity: int = 0):
+        self.session_name = session_name
+        self.session_dir = session_dir
+        self.store_capacity = store_capacity
+        self.store = make_store(session_name, store_capacity)
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.workers: Dict[WorkerID, WorkerInfo] = {}
+        self.tasks: Dict[TaskID, TaskRecord] = {}
+        self.pending: deque = deque()  # TaskID
+        self.objects: Dict[ObjectID, ObjectEntry] = {}
+        self.zero_ref_lru: "OrderedDict[ObjectID, int]" = OrderedDict()
+        self.shm_bytes = 0
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.pgs: Dict[PlacementGroupID, PGRecord] = {}
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.clients: List[ClientConn] = []
+        self.drivers: List[ClientConn] = []
+        self._spread_rr = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._shutdown_event = asyncio.Event()
+        self._sched_wakeup = asyncio.Event()
+        self._owned_objects: Dict[int, Set[ObjectID]] = {}  # id(client) -> oids
+
+    # ------------------------------------------------------------------ serve
+
+    async def start(self, address: str):
+        self._server = await protocol.serve(address, self._on_client)
+        asyncio.get_running_loop().create_task(self._scheduler_loop())
+        logger.info("GCS listening on %s", address)
+
+    async def wait_shutdown(self):
+        await self._shutdown_event.wait()
+
+    async def _on_client(self, reader, writer):
+        client = ClientConn(None)  # placeholder until hello
+        conn = protocol.Connection(
+            reader, writer,
+            handler=lambda msg: self._dispatch(client, msg),
+            on_close=lambda: self._on_disconnect(client),
+        )
+        client.conn = conn
+        self.clients.append(client)
+        conn.start()
+
+    async def _dispatch(self, client: ClientConn, msg: dict):
+        t = msg.get("t")
+        handler = getattr(self, f"_h_{t}", None)
+        if handler is None:
+            logger.warning("unknown message type %r", t)
+            return
+        try:
+            await handler(client, msg)
+        except Exception:
+            logger.exception("error handling %r", t)
+            if msg.get("i") is not None and not client.conn.closed:
+                client.conn.reply(msg, {"ok": False, "err": "internal error"})
+
+    # ------------------------------------------------------- registration
+
+    async def _h_hello(self, client: ClientConn, msg: dict):
+        role = msg["role"]
+        client.role = role
+        if role == "agent":
+            node_id = NodeID(msg["node_id"])
+            client.node_id = node_id
+            self.nodes[node_id] = NodeInfo(
+                node_id, msg["resources"], msg.get("hostname", ""), client.conn)
+            logger.info("node %s joined: %s", node_id.hex()[:8], msg["resources"])
+            self._wake_scheduler()
+        elif role == "worker":
+            worker_id = WorkerID(msg["worker_id"])
+            node_id = NodeID(msg["node_id"])
+            client.worker_id = worker_id
+            client.node_id = node_id
+            info = WorkerInfo(worker_id, node_id, client.conn,
+                              msg.get("addr", ""), msg.get("pid", 0))
+            self.workers[worker_id] = info
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node.workers.add(worker_id)
+                node.spawning = max(0, node.spawning - 1)
+                node.idle_workers.append(worker_id)
+            self._wake_scheduler()
+        elif role == "driver":
+            worker_id = WorkerID(msg["worker_id"])
+            client.worker_id = worker_id
+            self.drivers.append(client)
+        client.conn.reply(msg, {
+            "ok": True,
+            "session": self.session_name,
+            "session_dir": self.session_dir,
+        })
+
+    async def _h_update_resources(self, client: ClientConn, msg: dict):
+        """Node agent reports discovered resources (e.g. TPU probe finished)."""
+        node = self.nodes.get(NodeID(msg["node_id"]))
+        if node is None:
+            return
+        for k, v in msg["resources"].items():
+            old_total = node.total.get(k, 0.0)
+            node.total[k] = v
+            node.avail[k] = node.avail.get(k, 0.0) + (v - old_total)
+        self._wake_scheduler()
+
+    def _on_disconnect(self, client: ClientConn):
+        if client in self.clients:
+            self.clients.remove(client)
+        if client.role == "worker" and client.worker_id is not None:
+            asyncio.get_running_loop().create_task(
+                self._on_worker_death(client.worker_id))
+        elif client.role == "driver":
+            if client in self.drivers:
+                self.drivers.remove(client)
+            self._on_driver_exit(client)
+        elif client.role == "agent" and client.node_id is not None:
+            self._on_node_death(client.node_id)
+
+    # ------------------------------------------------------------- KV store
+
+    async def _h_kv_put(self, client, msg):
+        self.kv[(msg.get("ns", ""), msg["k"])] = msg["v"]
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+
+    async def _h_kv_get(self, client, msg):
+        v = self.kv.get((msg.get("ns", ""), msg["k"]))
+        client.conn.reply(msg, {"ok": v is not None, "v": v})
+
+    async def _h_kv_del(self, client, msg):
+        self.kv.pop((msg.get("ns", ""), msg["k"]), None)
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+
+    async def _h_kv_keys(self, client, msg):
+        ns = msg.get("ns", "")
+        prefix = msg.get("prefix", "")
+        keys = [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+        client.conn.reply(msg, {"ok": True, "keys": keys})
+
+    # ------------------------------------------------------------- objects
+
+    def _obj(self, object_id: ObjectID) -> ObjectEntry:
+        entry = self.objects.get(object_id)
+        if entry is None:
+            entry = ObjectEntry(object_id)
+            self.objects[object_id] = entry
+        return entry
+
+    def _mark_ready(self, entry: ObjectEntry, nbytes: int,
+                    inline: Optional[bytes], on_shm: bool):
+        entry.nbytes = nbytes
+        entry.inline = inline
+        entry.on_shm = on_shm
+        entry.ready = True
+        if on_shm:
+            self.shm_bytes += nbytes
+        for conn, req in entry.waiters:
+            if not conn.closed:
+                conn.reply(req, self._obj_reply(entry))
+        entry.waiters.clear()
+        if entry.refcount <= 0:
+            self._lru_touch(entry)
+        self._maybe_evict()
+
+    def _obj_reply(self, entry: ObjectEntry) -> dict:
+        if entry.inline is not None:
+            return {"ok": True, "where": "inline", "data": entry.inline,
+                    "nbytes": entry.nbytes}
+        return {"ok": True, "where": "shm", "nbytes": entry.nbytes}
+
+    async def _h_obj_put(self, client, msg):
+        oid = ObjectID(msg["oid"])
+        entry = self._obj(oid)
+        if entry.ready:  # duplicate registration
+            if msg.get("i") is not None:
+                client.conn.reply(msg, {"ok": True})
+            return
+        entry.refcount += 1  # the owner's initial reference
+        self._owned_objects.setdefault(id(client), set()).add(oid)
+        self._mark_ready(entry, msg["nbytes"], msg.get("data"),
+                         msg.get("shm", False))
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+
+    async def _h_obj_wait(self, client, msg):
+        oid = ObjectID(msg["oid"])
+        entry = self._obj(oid)
+        if entry.ready:
+            client.conn.reply(msg, self._obj_reply(entry))
+        elif entry.spilled is not None or self._try_reconstruct(entry):
+            entry.waiters.append((client.conn, msg))
+        else:
+            entry.waiters.append((client.conn, msg))
+
+    async def _h_obj_contains(self, client, msg):
+        oid = ObjectID(msg["oid"])
+        entry = self.objects.get(oid)
+        client.conn.reply(msg, {"ok": True,
+                                "ready": bool(entry and entry.ready)})
+
+    async def _h_ref(self, client, msg):
+        for oid_bytes, delta in msg["d"]:
+            oid = ObjectID(oid_bytes)
+            entry = self.objects.get(oid)
+            if entry is None:
+                continue
+            entry.refcount += delta
+            if entry.refcount <= 0 and entry.ready:
+                self._lru_touch(entry)
+            elif entry.refcount > 0:
+                self.zero_ref_lru.pop(oid, None)
+
+    def _lru_touch(self, entry: ObjectEntry):
+        self.zero_ref_lru.pop(entry.object_id, None)
+        self.zero_ref_lru[entry.object_id] = entry.nbytes
+
+    def _maybe_evict(self):
+        """LRU-evict zero-ref shm objects when over capacity.
+
+        Mirrors plasma's LRU eviction (``plasma/eviction_policy.h:105``): we
+        never delete a referenced object; zero-ref objects are kept warm until
+        the store passes capacity.
+        """
+        if self.store_capacity <= 0:
+            return
+        while self.shm_bytes > self.store_capacity and self.zero_ref_lru:
+            oid, nbytes = self.zero_ref_lru.popitem(last=False)
+            entry = self.objects.get(oid)
+            if entry is None or not entry.ready:
+                continue
+            if entry.on_shm:
+                self.store.delete(oid)
+                self.shm_bytes -= nbytes
+            del self.objects[oid]
+
+    def _try_reconstruct(self, entry: ObjectEntry) -> bool:
+        """Lineage reconstruction: resubmit the producing task.
+
+        Reference: ``core_worker/object_recovery_manager.h:41`` — the owner
+        resubmits the task that created a lost object.
+        """
+        spec = entry.producing_task
+        if spec is None:
+            return False
+        tid = entry.object_id.task_id()
+        if tid in self.tasks and self.tasks[tid].state in ("pending", "running"):
+            return True  # already being recomputed
+        record = TaskRecord(tid, spec["msg"], spec["owner"])
+        self.tasks[tid] = record
+        self.pending.append(tid)
+        self._wake_scheduler()
+        return True
+
+    # --------------------------------------------------------------- tasks
+
+    async def _h_submit(self, client, msg):
+        tid = TaskID(msg["tid"])
+        record = TaskRecord(tid, msg, client)
+        self.tasks[tid] = record
+        for oid in record.returns:
+            entry = self._obj(oid)
+            entry.refcount += 1
+            self._owned_objects.setdefault(id(client), set()).add(oid)
+            if record.retries_left > 0:
+                entry.producing_task = {"msg": msg, "owner": client}
+        self.pending.append(tid)
+        self._wake_scheduler()
+
+    async def _h_task_cancel(self, client, msg):
+        tid = TaskID(msg["tid"])
+        record = self.tasks.get(tid)
+        if record is None:
+            return
+        record.cancelled = True
+        if record.state == "running" and record.worker_id is not None:
+            w = self.workers.get(record.worker_id)
+            if w is not None and not w.conn.closed:
+                w.conn.send({"t": "cancel", "tid": msg["tid"],
+                             "force": msg.get("force", False)})
+
+    def _wake_scheduler(self):
+        self._sched_wakeup.set()
+
+    async def _scheduler_loop(self):
+        while True:
+            await self._sched_wakeup.wait()
+            self._sched_wakeup.clear()
+            self._schedule()
+
+    def _feasible_nodes(self, res: Dict[str, float]) -> List[NodeInfo]:
+        return [n for n in self.nodes.values()
+                if n.alive and _res_fits(n.avail, res)]
+
+    def _pick_node(self, record) -> Optional[NodeInfo]:
+        """Hybrid policy: pack onto low-utilization nodes first, spill to
+        spread past the 50% threshold (hybrid_scheduling_policy.h:50)."""
+        if record.pg is not None:
+            pg = self.pgs.get(PlacementGroupID(record.pg))
+            if pg is None or pg.state != "ready":
+                return None
+            bix = record.bundle if record.bundle is not None else 0
+            node_id = pg.placement[bix]
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return None
+            if not _res_fits(pg.bundle_avail[bix], record.resources):
+                return None
+            return node
+        strategy = record.strategy
+        feasible = self._feasible_nodes(record.resources)
+        if not feasible:
+            return None
+        if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
+            target = NodeID(strategy["node_id"])
+            for n in feasible:
+                if n.node_id == target:
+                    return n
+            return None if not strategy.get("soft") else feasible[0]
+        if strategy == "SPREAD":
+            self._spread_rr += 1
+            chosen = feasible[self._spread_rr % len(feasible)]
+            logger.debug("SPREAD pick rr=%d of %d -> %s", self._spread_rr,
+                         len(feasible), chosen.node_id.hex()[:8])
+            return chosen
+        # hybrid: first feasible node under 50% utilization in stable order,
+        # else the least-utilized feasible node.
+        feasible.sort(key=lambda n: n.node_id.binary())
+        for n in feasible:
+            if n.utilization() < 0.5:
+                return n
+        return min(feasible, key=lambda n: n.utilization())
+
+    def _acquire(self, node: NodeInfo, record) -> Dict[str, float]:
+        res = record.resources
+        if record.pg is not None:
+            pg = self.pgs[PlacementGroupID(record.pg)]
+            bix = record.bundle if record.bundle is not None else 0
+            _res_sub(pg.bundle_avail[bix], res)
+        else:
+            _res_sub(node.avail, res)
+        return dict(res)
+
+    def _release(self, worker: WorkerInfo, record):
+        if not worker.acquired:
+            return
+        node = self.nodes.get(worker.node_id)
+        if record is not None and record.pg is not None:
+            pg = self.pgs.get(PlacementGroupID(record.pg))
+            if pg is not None:
+                bix = record.bundle if record.bundle is not None else 0
+                _res_add(pg.bundle_avail[bix], worker.acquired)
+        elif node is not None:
+            _res_add(node.avail, worker.acquired)
+        worker.acquired = {}
+
+    def _schedule(self):
+        deficit: Dict[NodeID, int] = {}
+        made_progress = True
+        while made_progress and self.pending:
+            made_progress = False
+            requeue = []
+            while self.pending:
+                tid = self.pending.popleft()
+                record = self.tasks.get(tid)
+                if record is None or record.cancelled:
+                    if record is not None:
+                        self._finish_cancelled(record)
+                    continue
+                node = self._pick_node(record)
+                if node is None:
+                    requeue.append(tid)
+                    continue
+                worker = self._grab_idle_worker(node)
+                if worker is None:
+                    deficit[node.node_id] = deficit.get(node.node_id, 0) + 1
+                    requeue.append(tid)
+                    continue
+                worker.state = W_BUSY
+                worker.current_task = tid
+                worker.acquired = self._acquire(node, record)
+                record.state = "running"
+                record.worker_id = worker.worker_id
+                fwd = dict(record.msg)
+                fwd["t"] = "exec"
+                fwd.pop("i", None)
+                worker.conn.send(fwd)
+                made_progress = True
+            self.pending.extend(requeue)
+        for node_id, d in deficit.items():
+            node = self.nodes.get(node_id)
+            if node is not None:
+                self._request_worker(node, demand=d)
+
+    def _grab_idle_worker(self, node: NodeInfo) -> Optional[WorkerInfo]:
+        while node.idle_workers:
+            wid = node.idle_workers.popleft()
+            w = self.workers.get(wid)
+            if w is not None and w.state == W_IDLE and not w.conn.closed:
+                return w
+        return None
+
+    def _request_worker(self, node: NodeInfo, demand: int = 1):
+        """Ask the node agent to spawn workers to cover ``demand`` waiting
+        consumers.
+
+        Pool-size policy (reference: ``raylet/worker_pool.h:174`` prestart +
+        on-demand growth): actor workers are dedicated and don't count
+        against the pool cap; the cap bounds task workers at CPU total plus
+        headroom. ``node.spawning`` tracks in-flight spawns so repeated
+        scheduling passes never stampede the host with interpreter startups.
+        """
+        actor_workers = sum(
+            1 for wid in node.workers
+            if (w := self.workers.get(wid)) is not None and w.state == W_ACTOR)
+        cap = max(int(node.total.get("CPU", 1)), 1) + 2 + actor_workers
+        if node.agent_conn is None or node.agent_conn.closed:
+            return
+        while (node.spawning < min(demand, 4)
+               and len(node.workers) + node.spawning < cap):
+            node.spawning += 1
+            node.agent_conn.send({"t": "spawn_worker"})
+
+    async def _h_task_done(self, client, msg):
+        tid = TaskID(msg["tid"])
+        record = self.tasks.get(tid)
+        worker = self.workers.get(client.worker_id) if client.worker_id else None
+        if worker is not None:
+            self._release(worker, record)
+            worker.current_task = None
+            if worker.state == W_BUSY:
+                worker.state = W_IDLE
+                node = self.nodes.get(worker.node_id)
+                if node is not None:
+                    node.idle_workers.append(worker.worker_id)
+        if record is None:
+            self._wake_scheduler()
+            return
+        record.state = "done"
+        for r in msg["results"]:
+            entry = self._obj(ObjectID(r["oid"]))
+            self._mark_ready(entry, r["nbytes"], r.get("data"),
+                             r.get("shm", False))
+        if record.owner.conn is not None and not record.owner.conn.closed:
+            record.owner.conn.send({"t": "task_done", "tid": msg["tid"],
+                                    "results": msg["results"]})
+        self._wake_scheduler()
+
+    def _finish_cancelled(self, record: TaskRecord):
+        from . import serialization
+
+        record.state = "done"
+        err = serialization.serialize(
+            serialization.TaskCancelledError(record.task_id.hex())).to_bytes()
+        results = [{"oid": oid.binary(), "nbytes": len(err), "data": err}
+                   for oid in record.returns]
+        for r in results:
+            self._mark_ready(self._obj(ObjectID(r["oid"])), r["nbytes"],
+                             r["data"], False)
+        if not record.owner.conn.closed:
+            record.owner.conn.send({"t": "task_done",
+                                    "tid": record.task_id.binary(),
+                                    "results": results})
+
+    async def _on_worker_death(self, worker_id: WorkerID):
+        worker = self.workers.pop(worker_id, None)
+        if worker is None:
+            return
+        node = self.nodes.get(worker.node_id)
+        if node is not None:
+            node.workers.discard(worker_id)
+            try:
+                node.idle_workers.remove(worker_id)
+            except ValueError:
+                pass
+        # Actor death
+        if worker.actor_id is not None:
+            await self._on_actor_worker_death(worker.actor_id, worker)
+            return
+        # Task retry (reference: TaskManager retries, task_manager.h:210)
+        tid = worker.current_task
+        if tid is None:
+            return
+        record = self.tasks.get(tid)
+        if record is None:
+            return
+        self._release(worker, record)
+        if record.cancelled:
+            self._finish_cancelled(record)
+        elif record.retries_left > 0:
+            record.retries_left -= 1
+            record.state = "pending"
+            record.worker_id = None
+            logger.info("retrying task %s (%d retries left)",
+                        tid.hex()[:8], record.retries_left)
+            self.pending.append(tid)
+        else:
+            from . import serialization
+
+            err = serialization.serialize(serialization.WorkerCrashedError(
+                f"worker {worker_id.hex()[:8]} died while executing task"
+            )).to_bytes()
+            results = [{"oid": oid.binary(), "nbytes": len(err), "data": err}
+                       for oid in record.returns]
+            for r in results:
+                self._mark_ready(self._obj(ObjectID(r["oid"])), r["nbytes"],
+                                 r["data"], False)
+            record.state = "done"
+            if not record.owner.conn.closed:
+                record.owner.conn.send({"t": "task_done", "tid": tid.binary(),
+                                        "results": results})
+        self._wake_scheduler()
+
+    def _on_node_death(self, node_id: NodeID):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        node.alive = False
+        for wid in list(node.workers):
+            asyncio.get_running_loop().create_task(self._on_worker_death(wid))
+
+    def _on_driver_exit(self, client: ClientConn):
+        """Non-detached actors owned by an exiting driver are killed; its
+        objects are dereferenced."""
+        for actor in list(self.actors.values()):
+            if actor.owner is client and not actor.detached:
+                asyncio.get_running_loop().create_task(
+                    self._kill_actor(actor, no_restart=True,
+                                     cause="owner driver exited"))
+        for oid in self._owned_objects.pop(id(client), set()):
+            entry = self.objects.get(oid)
+            if entry is not None:
+                entry.refcount -= 1
+                if entry.refcount <= 0 and entry.ready:
+                    self._lru_touch(entry)
+
+    # --------------------------------------------------------------- actors
+
+    async def _h_actor_create(self, client, msg):
+        aid = ActorID(msg["aid"])
+        record = ActorRecord(aid, msg, client)
+        if record.name is not None:
+            key = (record.namespace, record.name)
+            if key in self.named_actors:
+                client.conn.reply(msg, {
+                    "ok": False,
+                    "err": f"actor name {record.name!r} already taken"})
+                return
+            self.named_actors[key] = aid
+        self.actors[aid] = record
+        client.conn.reply(msg, {"ok": True})
+        self._try_place_actor(record)
+
+    def _try_place_actor(self, record: ActorRecord):
+        fake_task = type("T", (), {})()
+        fake_task.pg = record.pg
+        fake_task.bundle = record.bundle
+        fake_task.resources = record.resources
+        fake_task.strategy = (record.msg.get("opts") or {}).get("sched") or "DEFAULT"
+        node = self._pick_node(fake_task)
+        if node is None:
+            asyncio.get_running_loop().call_later(
+                0.05, self._retry_place_actor, record)
+            return
+        worker = self._grab_idle_worker(node)
+        if worker is None:
+            self._request_worker(node)
+            asyncio.get_running_loop().call_later(
+                0.05, self._retry_place_actor, record)
+            return
+        worker.state = W_ACTOR
+        worker.actor_id = record.actor_id
+        worker.acquired = self._acquire(node, record)
+        record.worker_id = worker.worker_id
+        record.node_id = node.node_id
+        fwd = dict(record.msg)
+        fwd["t"] = "actor_init"
+        fwd.pop("i", None)
+        worker.conn.send(fwd)
+
+    def _retry_place_actor(self, record: ActorRecord):
+        if record.state in (A_PENDING, A_RESTARTING):
+            self._try_place_actor(record)
+
+    async def _h_actor_ready(self, client, msg):
+        aid = ActorID(msg["aid"])
+        record = self.actors.get(aid)
+        if record is None:
+            return
+        worker = self.workers.get(record.worker_id)
+        record.state = A_ALIVE
+        record.addr = worker.addr if worker else ""
+        for conn, req in record.addr_waiters:
+            if not conn.closed:
+                conn.reply(req, {"ok": True, "state": A_ALIVE,
+                                 "addr": record.addr})
+        record.addr_waiters.clear()
+
+    async def _h_actor_init_err(self, client, msg):
+        aid = ActorID(msg["aid"])
+        record = self.actors.get(aid)
+        if record is None:
+            return
+        record.state = A_DEAD
+        record.death_cause = "creation task failed"
+        record.msg_error = msg.get("err")
+        for conn, req in record.addr_waiters:
+            if not conn.closed:
+                conn.reply(req, {"ok": False, "state": A_DEAD,
+                                 "err": msg.get("err")})
+        record.addr_waiters.clear()
+        # free the worker back to the pool
+        worker = self.workers.get(record.worker_id)
+        if worker is not None:
+            self._release(worker, record)
+            worker.actor_id = None
+            worker.state = W_IDLE
+            node = self.nodes.get(worker.node_id)
+            if node is not None:
+                node.idle_workers.append(worker.worker_id)
+
+    async def _h_actor_get(self, client, msg):
+        """Resolve actor id -> direct-call address (waits while pending)."""
+        aid = ActorID(msg["aid"])
+        record = self.actors.get(aid)
+        if record is None:
+            client.conn.reply(msg, {"ok": False, "state": A_DEAD,
+                                    "err": "no such actor"})
+            return
+        if record.state == A_ALIVE:
+            client.conn.reply(msg, {"ok": True, "state": A_ALIVE,
+                                    "addr": record.addr})
+        elif record.state == A_DEAD:
+            client.conn.reply(msg, {"ok": False, "state": A_DEAD,
+                                    "err": record.death_cause or "actor died"})
+        else:
+            record.addr_waiters.append((client.conn, msg))
+
+    async def _h_actor_by_name(self, client, msg):
+        key = (msg.get("namespace") or "default", msg["name"])
+        aid = self.named_actors.get(key)
+        if aid is None:
+            client.conn.reply(msg, {"ok": False,
+                                    "err": f"no actor named {msg['name']!r}"})
+        else:
+            client.conn.reply(msg, {"ok": True, "aid": aid.binary()})
+
+    async def _h_actor_kill(self, client, msg):
+        record = self.actors.get(ActorID(msg["aid"]))
+        if record is None:
+            return
+        await self._kill_actor(record, msg.get("no_restart", True),
+                               cause="killed via ray.kill")
+
+    async def _kill_actor(self, record: ActorRecord, no_restart: bool,
+                          cause: str):
+        if no_restart:
+            record.max_restarts = record.restarts_used
+        worker = self.workers.get(record.worker_id) if record.worker_id else None
+        if worker is not None and not worker.conn.closed:
+            worker.conn.send({"t": "exit"})
+        else:
+            record.state = A_DEAD
+            record.death_cause = cause
+            self._cleanup_dead_actor(record)
+
+    async def _on_actor_worker_death(self, actor_id: ActorID,
+                                     worker: WorkerInfo):
+        record = self.actors.get(actor_id)
+        if record is None:
+            return
+        self._release(worker, record)
+        if (record.restarts_used < record.max_restarts
+                or record.max_restarts < 0):
+            record.restarts_used += 1
+            record.state = A_RESTARTING
+            record.worker_id = None
+            record.addr = None
+            logger.info("restarting actor %s (attempt %d)",
+                        actor_id.hex()[:8], record.restarts_used)
+            self._try_place_actor(record)
+        else:
+            record.state = A_DEAD
+            record.death_cause = "actor worker died"
+            self._cleanup_dead_actor(record)
+
+    def _cleanup_dead_actor(self, record: ActorRecord):
+        for conn, req in record.addr_waiters:
+            if not conn.closed:
+                conn.reply(req, {"ok": False, "state": A_DEAD,
+                                 "err": record.death_cause})
+        record.addr_waiters.clear()
+        if record.name is not None:
+            self.named_actors.pop((record.namespace, record.name), None)
+        # Notify all drivers so pending direct calls can fail fast.
+        for d in self.drivers:
+            if not d.conn.closed:
+                d.conn.send({"t": "actor_dead",
+                             "aid": record.actor_id.binary(),
+                             "cause": record.death_cause or "actor died"})
+
+    async def _h_actor_list(self, client, msg):
+        out = []
+        for a in self.actors.values():
+            out.append({"aid": a.actor_id.binary(), "state": a.state,
+                        "name": a.name or "", "namespace": a.namespace,
+                        "node": a.node_id.binary() if a.node_id else b"",
+                        "restarts": a.restarts_used})
+        client.conn.reply(msg, {"ok": True, "actors": out})
+
+    # ------------------------------------------------------ placement groups
+
+    async def _h_pg_create(self, client, msg):
+        pg_id = PlacementGroupID(msg["pgid"])
+        record = PGRecord(pg_id, msg["bundles"], msg["strategy"],
+                          msg.get("name", ""), client)
+        self.pgs[pg_id] = record
+        placed = self._place_bundles(record)
+        if placed:
+            record.state = "ready"
+            client.conn.reply(msg, {"ok": True, "ready": True})
+        else:
+            record.ready_waiters.append((client.conn, msg))
+            asyncio.get_running_loop().call_later(0.05, self._retry_pg, record)
+
+    def _retry_pg(self, record: PGRecord):
+        if record.state != "pending":
+            return
+        if self._place_bundles(record):
+            record.state = "ready"
+            for conn, req in record.ready_waiters:
+                if not conn.closed:
+                    conn.reply(req, {"ok": True, "ready": True})
+            record.ready_waiters.clear()
+            self._wake_scheduler()
+        else:
+            asyncio.get_running_loop().call_later(0.1, self._retry_pg, record)
+
+    def _place_bundles(self, record: PGRecord) -> bool:
+        """Reserve every bundle or nothing (all-or-nothing like the
+        reference's 2PC prepare/commit, node_manager.h:507-512 — centralized
+        here so a plain transactional update suffices)."""
+        strategy = record.strategy
+        nodes = [n for n in self.nodes.values() if n.alive]
+        nodes.sort(key=lambda n: n.node_id.binary())
+        staged: Dict[NodeID, Dict[str, float]] = {
+            n.node_id: dict(n.avail) for n in nodes}
+        placement: List[Optional[NodeID]] = []
+        if strategy in ("STRICT_PACK",):
+            for n in nodes:
+                avail = dict(staged[n.node_id])
+                if all(self._stage(avail, b) for b in record.bundles):
+                    placement = [n.node_id] * len(record.bundles)
+                    break
+            else:
+                return False
+        elif strategy in ("STRICT_SPREAD",):
+            if len(nodes) < len(record.bundles):
+                return False
+            used: Set[NodeID] = set()
+            for b in record.bundles:
+                for n in nodes:
+                    if n.node_id in used:
+                        continue
+                    if self._stage(staged[n.node_id], b):
+                        placement.append(n.node_id)
+                        used.add(n.node_id)
+                        break
+                else:
+                    return False
+        else:  # PACK / SPREAD: best-effort
+            order = nodes if strategy == "PACK" else nodes[::-1]
+            for idx, b in enumerate(record.bundles):
+                rotated = order[idx % len(order):] + order[:idx % len(order)] \
+                    if strategy == "SPREAD" else order
+                for n in rotated:
+                    if self._stage(staged[n.node_id], b):
+                        placement.append(n.node_id)
+                        break
+                else:
+                    return False
+        # Commit
+        for node_id, bundle in zip(placement, record.bundles):
+            _res_sub(self.nodes[node_id].avail, bundle)
+        record.placement = placement
+        return True
+
+    @staticmethod
+    def _stage(avail: Dict[str, float], bundle: Dict[str, float]) -> bool:
+        if _res_fits(avail, bundle):
+            _res_sub(avail, bundle)
+            return True
+        return False
+
+    async def _h_pg_remove(self, client, msg):
+        pg_id = PlacementGroupID(msg["pgid"])
+        record = self.pgs.pop(pg_id, None)
+        if record is not None and record.state == "ready":
+            for node_id, bundle, avail in zip(
+                    record.placement, record.bundles, record.bundle_avail):
+                node = self.nodes.get(node_id)
+                if node is not None:
+                    # Return only unconsumed capacity; consumed capacity is
+                    # returned by the releasing tasks as they finish.
+                    _res_add(node.avail, bundle)
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+        self._wake_scheduler()
+
+    async def _h_pg_list(self, client, msg):
+        out = [{"pgid": p.pg_id.binary(), "state": p.state, "name": p.name,
+                "strategy": p.strategy, "bundles": p.bundles}
+               for p in self.pgs.values()]
+        client.conn.reply(msg, {"ok": True, "pgs": out})
+
+    # ----------------------------------------------------------- inspection
+
+    async def _h_cluster_info(self, client, msg):
+        nodes = [{"node_id": n.node_id.binary(), "alive": n.alive,
+                  "hostname": n.hostname, "total": n.total, "avail": n.avail,
+                  "workers": len(n.workers)}
+                 for n in self.nodes.values()]
+        client.conn.reply(msg, {"ok": True, "nodes": nodes})
+
+    async def _h_task_list(self, client, msg):
+        out = [{"tid": t.task_id.binary(), "state": t.state,
+                "name": (t.msg.get("opts") or {}).get("name", "")}
+               for t in self.tasks.values()]
+        client.conn.reply(msg, {"ok": True, "tasks": out})
+
+    async def _h_shutdown(self, client, msg):
+        logger.info("shutdown requested")
+        for w in self.workers.values():
+            if not w.conn.closed:
+                try:
+                    w.conn.send({"t": "exit"})
+                except ConnectionError:
+                    pass
+        for n in self.nodes.values():
+            if n.agent_conn is not None and not n.agent_conn.closed:
+                try:
+                    n.agent_conn.send({"t": "exit"})
+                except ConnectionError:
+                    pass
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+        await asyncio.sleep(0.05)
+        self._shutdown_event.set()

@@ -1,0 +1,342 @@
+"""Node lifecycle: session directories, the head process, and node agents.
+
+Analog of the reference's ``Node`` process supervisor
+(``python/ray/_private/node.py:37``) and the raylet's worker pool + agent
+manager (``raylet/worker_pool.h:174``, ``raylet/agent_manager.h:45``). A
+"node" here is a TPU host: the agent registers the host's resources
+(CPU / memory / TPU chips and slice topology) with the GCS and spawns worker
+processes on demand.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from . import protocol
+from .ids import NodeID
+
+DEFAULT_STORE_CAPACITY = 2 * 1024**3
+
+
+def default_session_root() -> str:
+    return os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+
+
+def new_session_dir() -> str:
+    root = default_session_root()
+    name = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
+    path = os.path.join(root, name)
+    os.makedirs(path, exist_ok=True)
+    latest = os.path.join(root, "session_latest")
+    try:
+        if os.path.islink(latest):
+            os.unlink(latest)
+        os.symlink(path, latest)
+    except OSError:
+        pass
+    return path
+
+
+def detect_node_resources(num_cpus: Optional[int] = None,
+                          num_tpus: Optional[int] = None,
+                          resources: Optional[Dict[str, float]] = None
+                          ) -> Dict[str, float]:
+    """Detect this host's schedulable resources.
+
+    TPU detection mirrors the reference's ``TPUAcceleratorManager``
+    (``python/ray/_private/accelerators/tpu.py:71``): chip count from the
+    environment / libtpu, plus a ``TPU-<accel>-head`` marker resource on pod
+    hosts so multi-host slices can gang-schedule (one "head" per slice).
+    """
+    out: Dict[str, float] = {}
+    out["CPU"] = float(num_cpus if num_cpus is not None
+                       else max(os.cpu_count() or 1, 1))
+    mem = 0
+    try:
+        import psutil
+
+        mem = psutil.virtual_memory().available
+    except Exception:
+        pass
+    out["memory"] = float(mem or 1 << 30)
+    out["object_store_memory"] = float(DEFAULT_STORE_CAPACITY)
+    if num_tpus is not None:
+        if num_tpus > 0:
+            out["TPU"] = float(num_tpus)
+    else:
+        env_chips = os.environ.get("RAY_TPU_CHIPS")
+        if env_chips:
+            out["TPU"] = float(env_chips)
+        # else: async probe later (agent sends update_resources)
+    if resources:
+        out.update(resources)
+    return out
+
+
+_TPU_PROBE = """
+import os
+os.environ.pop("JAX_PLATFORMS", None)
+try:
+    import jax
+    print(len(jax.devices("tpu")))
+except Exception:
+    print(0)
+"""
+
+_WORKER_BOOTSTRAP = (
+    "import sys, os\n"
+    "sys.path[:0] = os.environ['RAY_TPU_SYS_PATH'].split(os.pathsep)\n"
+    "from ray_tpu._private.worker_main import main\n"
+    "main()\n"
+)
+
+
+def worker_sys_path() -> str:
+    """The parent's import path, for ``python -S`` worker bootstrap."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = [pkg_root] + [p for p in sys.path if p]
+    seen, out = set(), []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return os.pathsep.join(out)
+
+
+class NodeAgent:
+    """Per-node agent: registers the node, spawns/reaps workers."""
+
+    def __init__(self, gcs_address: str, session_dir: str,
+                 resources: Dict[str, float],
+                 node_id: Optional[NodeID] = None,
+                 num_initial_workers: int = 2,
+                 env_overrides: Optional[Dict[str, str]] = None,
+                 probe_tpu: bool = False):
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.node_id = node_id or NodeID.from_random()
+        self.resources = resources
+        self.num_initial_workers = num_initial_workers
+        self.env_overrides = env_overrides or {}
+        self.probe_tpu = probe_tpu
+        self.conn: Optional[protocol.Connection] = None
+        self.procs: List[subprocess.Popen] = []
+        self.stopped = asyncio.Event()
+
+    async def start(self):
+        reader, writer = await protocol.connect(self.gcs_address)
+        self.conn = protocol.Connection(
+            reader, writer, handler=self._on_msg,
+            on_close=lambda: self.stopped.set())
+        self.conn.start()
+        await self.conn.request({
+            "t": "hello", "role": "agent",
+            "node_id": self.node_id.binary(),
+            "resources": self.resources,
+            "hostname": os.uname().nodename,
+        }, timeout=30)
+        for _ in range(self.num_initial_workers):
+            self.spawn_worker()
+        if self.probe_tpu and "TPU" not in self.resources:
+            asyncio.get_running_loop().create_task(self._probe_tpu())
+        asyncio.get_running_loop().create_task(self._reap_loop())
+
+    async def _probe_tpu(self):
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-c", _TPU_PROBE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL)
+            out, _ = await asyncio.wait_for(proc.communicate(), timeout=120)
+            n = int(out.strip() or 0)
+        except Exception:
+            n = 0
+        if n > 0 and self.conn and not self.conn.closed:
+            self.conn.send({"t": "update_resources",
+                            "node_id": self.node_id.binary(),
+                            "resources": {"TPU": float(n)}})
+
+    def spawn_worker(self):
+        env = dict(os.environ)
+        env.update(self.env_overrides)
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_SYS_PATH"] = worker_sys_path()
+        # ``-S`` skips site processing (~2s in large venvs); the bootstrap
+        # restores the parent's sys.path so imports resolve identically.
+        proc = subprocess.Popen(
+            [sys.executable, "-S", "-c", _WORKER_BOOTSTRAP,
+             "--gcs", self.gcs_address,
+             "--node-id", self.node_id.hex(),
+             "--session-dir", self.session_dir],
+            env=env,
+            stdout=open(os.path.join(
+                self.session_dir, f"worker-{len(self.procs)}.out"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+        self.procs.append(proc)
+
+    async def _on_msg(self, msg: dict):
+        t = msg.get("t")
+        if t == "spawn_worker":
+            self.spawn_worker()
+        elif t == "exit":
+            self.stopped.set()
+
+    async def _reap_loop(self):
+        while not self.stopped.is_set():
+            for p in self.procs:
+                p.poll()
+            await asyncio.sleep(0.5)
+
+    async def run_until_stopped(self):
+        await self.stopped.wait()
+        self.shutdown_workers()
+
+    def shutdown_workers(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 3
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(max(0.0, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+async def head_amain(args):
+    from .gcs import GcsServer
+
+    resources = json.loads(args.resources)
+    session_name = os.path.basename(args.session_dir)
+    gcs = GcsServer(session_name, args.session_dir,
+                    store_capacity=int(resources.get(
+                        "object_store_memory", DEFAULT_STORE_CAPACITY)))
+    address = "unix:" + os.path.join(args.session_dir, "gcs.sock")
+    if args.port:
+        address = f"0.0.0.0:{args.port}"
+    await gcs.start(address)
+    agent = NodeAgent(
+        "unix:" + os.path.join(args.session_dir, "gcs.sock"),
+        args.session_dir, resources,
+        num_initial_workers=args.num_initial_workers,
+        probe_tpu=not args.no_probe_tpu)
+    await agent.start()
+    # Signal readiness to the parent driver.
+    ready = os.path.join(args.session_dir, "gcs.ready")
+    with open(ready, "w") as f:
+        f.write(address)
+    try:
+        await gcs.wait_shutdown()
+    finally:
+        agent.stopped.set()
+        agent.shutdown_workers()
+
+
+def head_main():
+    import argparse
+    import logging
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", required=True)
+    parser.add_argument("--num-initial-workers", type=int, default=2)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--no-probe-tpu", action="store_true")
+    args = parser.parse_args()
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+    asyncio.run(head_amain(args))
+
+
+async def agent_amain(args):
+    resources = json.loads(args.resources)
+    agent = NodeAgent(args.gcs, args.session_dir, resources,
+                      num_initial_workers=args.num_initial_workers,
+                      env_overrides=json.loads(args.env or "{}"))
+    await agent.start()
+    await agent.run_until_stopped()
+
+
+def agent_main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", required=True)
+    parser.add_argument("--num-initial-workers", type=int, default=1)
+    parser.add_argument("--env", default="{}")
+    args = parser.parse_args()
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+    asyncio.run(agent_amain(args))
+
+
+class HeadNode:
+    """Driver-side handle that spawns and supervises the head process."""
+
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 num_initial_workers: int = 2, probe_tpu: bool = True):
+        self.session_dir = new_session_dir()
+        self.resources = detect_node_resources(num_cpus, num_tpus, resources)
+        self.address = "unix:" + os.path.join(self.session_dir, "gcs.sock")
+        cmd = [sys.executable, "-m", "ray_tpu._private.head_entry",
+               "--session-dir", self.session_dir,
+               "--resources", json.dumps(self.resources),
+               "--num-initial-workers", str(num_initial_workers)]
+        if not probe_tpu:
+            cmd.append("--no-probe-tpu")
+        self.proc = subprocess.Popen(
+            cmd,
+            start_new_session=True,
+            stdout=open(os.path.join(self.session_dir, "gcs.out"), "ab"),
+            stderr=subprocess.STDOUT)
+        ready = os.path.join(self.session_dir, "gcs.ready")
+        deadline = time.time() + 30
+        while not os.path.exists(ready):
+            if self.proc.poll() is not None:
+                out = open(os.path.join(self.session_dir, "gcs.out")).read()
+                raise RuntimeError(f"head process failed to start:\n{out}")
+            if time.time() > deadline:
+                raise TimeoutError("timed out waiting for the head process")
+            time.sleep(0.01)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            try:
+                os.killpg(self.proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                self.proc.wait(5)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(self.proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        # Best-effort cleanup of leaked shm segments for this session.
+        session = os.path.basename(self.session_dir)
+        shm_dir = "/dev/shm"
+        try:
+            for name in os.listdir(shm_dir):
+                if session[-8:] in name and name.startswith("rtpu"):
+                    try:
+                        os.unlink(os.path.join(shm_dir, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass

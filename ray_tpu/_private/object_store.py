@@ -1,0 +1,182 @@
+"""Per-host shared-memory object store (plasma equivalent).
+
+The reference implements this tier in C++ (``src/ray/object_manager/plasma/``:
+``PlasmaStore``, mmap'd dlmalloc arenas, UDS clients with fd-passing). Our
+TPU-native design keeps the same semantics — create/seal/get/release with
+zero-copy reads shared across every process on a host — but uses two
+interchangeable backends:
+
+  * ``NativeStore`` — the C++ arena allocator in ``native/shm_store.cc``
+    (one big POSIX shm segment, offset-based allocation, lock in shared
+    memory). Preferred when the compiled extension is available.
+  * ``PyShmStore`` — one POSIX shm segment per object via
+    ``multiprocessing.shared_memory``. Always available; slightly higher
+    per-object syscall cost but identical semantics.
+
+Both give readers a writable-mapped ``memoryview`` over the same physical
+pages the writer filled — the property the TPU data path needs so host
+buffers can feed ``jax.device_put`` without a copy.
+
+Object layout inside the segment: raw payload bytes produced by
+``serialization.dumps_into`` (msgpack meta header + pickle5 out-of-band
+buffers). Sealing is tracked by the store index, not in-band.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from multiprocessing import shared_memory, resource_tracker
+from typing import Dict, Optional
+
+from .ids import ObjectID
+
+_PREFIX = "rtpu"
+
+
+def _untrack(shm: shared_memory.SharedMemory):
+    """Stop the resource_tracker from owning this segment.
+
+    The store's lifetime is managed by the head node process (the GCS deletes
+    segments on final deref / shutdown); per-process resource trackers would
+    otherwise unlink segments when any single process exits.
+    """
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+class PlasmaObjectView:
+    """A sealed object: zero-copy view plus the backing handle."""
+
+    __slots__ = ("data", "_shm")
+
+    def __init__(self, data: memoryview, shm=None):
+        self.data = data
+        self._shm = shm
+
+    def close(self):
+        try:
+            self.data.release()
+        except BufferError:
+            pass
+        if self._shm is not None:
+            self._shm.close()
+
+
+class PyShmStore:
+    """One shm segment per object. Segment name is derived from the id."""
+
+    def __init__(self, session_name: str):
+        self._session = session_name
+        # Objects this process created but not yet sealed.
+        self._pending: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        # Cache of attached segments (reader side).
+        self._attached: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def _name(self, object_id: ObjectID) -> str:
+        return f"{_PREFIX}_{self._session}_{object_id.hex()[:32]}"
+
+    def create(self, object_id: ObjectID, nbytes: int) -> memoryview:
+        nbytes = max(nbytes, 1)
+        shm = shared_memory.SharedMemory(
+            name=self._name(object_id), create=True, size=nbytes
+        )
+        _untrack(shm)
+        with self._lock:
+            self._pending[object_id] = shm
+        return shm.buf[:nbytes]
+
+    def seal(self, object_id: ObjectID):
+        with self._lock:
+            shm = self._pending.pop(object_id, None)
+            if shm is not None:
+                self._attached[object_id] = shm
+
+    def abort(self, object_id: ObjectID):
+        with self._lock:
+            shm = self._pending.pop(object_id, None)
+        if shm is not None:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def get(self, object_id: ObjectID, nbytes: int) -> Optional[PlasmaObjectView]:
+        """Attach to a sealed object. Returns None if the segment is gone."""
+        with self._lock:
+            shm = self._attached.get(object_id)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=self._name(object_id))
+            except FileNotFoundError:
+                return None
+            _untrack(shm)
+            with self._lock:
+                self._attached.setdefault(object_id, shm)
+        return PlasmaObjectView(shm.buf[:nbytes], None)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            if object_id in self._attached:
+                return True
+        try:
+            shm = shared_memory.SharedMemory(name=self._name(object_id))
+        except FileNotFoundError:
+            return False
+        _untrack(shm)
+        with self._lock:
+            self._attached.setdefault(object_id, shm)
+        return True
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            shm = self._attached.pop(object_id, None)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=self._name(object_id))
+                _untrack(shm)
+            except FileNotFoundError:
+                return
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        try:
+            shm.close()
+        except BufferError:
+            pass
+
+    def close(self):
+        with self._lock:
+            for shm in list(self._pending.values()) + list(self._attached.values()):
+                try:
+                    shm.close()
+                except BufferError:
+                    # A zero-copy view (e.g. a numpy array backed by this
+                    # segment) is still alive in user code; leave the mapping
+                    # to process exit.
+                    pass
+            self._pending.clear()
+            self._attached.clear()
+
+
+def _try_native_store(session_name: str, capacity: int):
+    try:
+        from .shm_native import NativeStore
+
+        return NativeStore(session_name, capacity)
+    except Exception:
+        return None
+
+
+def make_store(session_name: str, capacity: int = 0, prefer_native: bool = True):
+    """Create the host object store client for this process."""
+    if prefer_native and not os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+        store = _try_native_store(session_name, capacity)
+        if store is not None:
+            return store
+    return PyShmStore(session_name)

@@ -1,0 +1,280 @@
+"""``@remote`` machinery: remote functions and actor classes.
+
+Analog of the reference's ``python/ray/remote_function.py:40``
+(``RemoteFunction``), ``python/ray/actor.py:581`` (``ActorClass``,
+``ActorHandle``, ``ActorMethod``). Functions are cloudpickled once,
+registered in the GCS KV under a content hash, and fetched/cached by
+workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+from typing import Any, Dict, List, Optional, Union
+
+import cloudpickle
+
+from .ids import ActorID
+from .serialization import INLINE_THRESHOLD, serialize
+from .worker import ObjectRef, global_worker
+
+_DEFAULT_TASK_OPTS = dict(
+    num_cpus=1, num_tpus=0, resources=None, num_returns=1, max_retries=3,
+    name=None, scheduling_strategy=None, runtime_env=None,
+    placement_group=None, placement_group_bundle_index=None,
+)
+_DEFAULT_ACTOR_OPTS = dict(
+    num_cpus=0, num_tpus=0, resources=None, max_restarts=0,
+    max_task_retries=0, name=None, namespace=None, lifetime=None,
+    max_concurrency=None, scheduling_strategy=None, runtime_env=None,
+    placement_group=None, placement_group_bundle_index=None,
+)
+
+
+def _build_resources(opts: dict) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("resources"):
+        res.update({k: float(v) for k, v in opts["resources"].items()})
+    if not res:
+        res = {"CPU": 0.0}
+    return res
+
+
+def _strategy_opts(opts: dict) -> dict:
+    """Translate user scheduling options to wire opts (pg/bix/sched)."""
+    out = {}
+    strategy = opts.get("scheduling_strategy")
+    pg = opts.get("placement_group")
+    if pg is None and strategy is not None and hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        out["bix"] = strategy.placement_group_bundle_index
+    if pg is not None:
+        out["pg"] = pg.id.binary() if hasattr(pg, "id") else pg
+        if opts.get("placement_group_bundle_index") is not None:
+            out["bix"] = opts["placement_group_bundle_index"]
+    if isinstance(strategy, str):
+        out["sched"] = strategy
+    elif strategy is not None and hasattr(strategy, "node_id"):
+        out["sched"] = {"type": "node_affinity", "node_id": strategy.node_id,
+                        "soft": strategy.soft}
+    return out
+
+
+def _prepare_args(args: tuple, kwargs: dict) -> dict:
+    """Serialize call arguments; large blobs go to shared memory.
+
+    Mirrors the reference's inline-vs-plasma arg split
+    (``DependencyResolver`` inlining, ``transport/dependency_resolver.h``):
+    small args travel in the control message, large ones are put into the
+    object store and fetched zero-copy by the executing worker.
+    """
+    w = global_worker()
+    sobj = serialize((args, kwargs))
+    if sobj.total_size <= INLINE_THRESHOLD:
+        return {"args": sobj.to_bytes()}
+    oid = w.put_serialized(sobj)
+    # Hold a reference until the consuming task is done: register then let
+    # the GCS-side refcount keep it; the executing worker borrows it.
+    return {"argsref": oid.binary(), "argsn": sobj.total_size}
+
+
+class RemoteFunction:
+    def __init__(self, fn, opts: Optional[dict] = None):
+        self._fn = fn
+        self._opts = dict(_DEFAULT_TASK_OPTS)
+        if opts:
+            self._opts.update(opts)
+        self._blob: Optional[bytes] = None
+        self._fid: Optional[str] = None
+        self._registered_sessions: set = set()
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote().")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        opts = dict(self._opts)
+        opts.update(overrides)
+        rf = RemoteFunction(self._fn, opts)
+        rf._blob = self._blob
+        rf._fid = self._fid
+        rf._registered_sessions = self._registered_sessions
+        return rf
+
+    def _ensure_registered(self) -> str:
+        w = global_worker()
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._fn)
+            self._fid = (
+                f"{self.__name__}-{hashlib.sha1(self._blob).hexdigest()[:16]}")
+        if w.session_name not in self._registered_sessions:
+            w.kv_put(self._fid, self._blob, ns="fn")
+            self._registered_sessions.add(w.session_name)
+        return self._fid
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        w = global_worker()
+        fid = self._ensure_registered()
+        opts = self._opts
+        wire_opts = {
+            "res": _build_resources(opts),
+            "retries": opts.get("max_retries", 3),
+            "name": opts.get("name") or self.__name__,
+        }
+        if opts.get("runtime_env"):
+            wire_opts["runtime_env"] = opts["runtime_env"]
+        wire_opts.update(_strategy_opts(opts))
+        nret = opts.get("num_returns", 1)
+        msg_args = _prepare_args(args, kwargs)
+        refs = w.submit_task(fid, msg_args, nret, wire_opts)
+        return refs[0] if nret == 1 else refs
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._call(self._name, args, kwargs,
+                                  self._num_returns, {})
+
+    def options(self, num_returns: Optional[int] = None, **kw):
+        m = ActorMethod(self._handle, self._name,
+                        num_returns or self._num_returns)
+        return m
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Actor method {self._name} cannot be called directly; use "
+            f"{self._name}.remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: List[str],
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+        self._max_task_retries = max_task_retries
+
+    @property
+    def _id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor has no method {name!r}; available: "
+                f"{sorted(self._method_names)}")
+        return ActorMethod(self, name)
+
+    def _call(self, method: str, args: tuple, kwargs: dict,
+              num_returns: int, extra_opts: dict):
+        w = global_worker()
+        msg_args = _prepare_args(args, kwargs)
+        opts = {"retries": self._max_task_retries}
+        opts.update(extra_opts)
+        refs = w.submit_actor_task_msg(self._actor_id, method, msg_args,
+                                       num_returns, opts)
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (_rebuild_actor_handle,
+                (self._actor_id.binary(), self._method_names,
+                 self._max_task_retries))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+
+def _rebuild_actor_handle(aid_bytes, method_names, max_task_retries):
+    return ActorHandle(ActorID(aid_bytes), method_names, max_task_retries)
+
+
+class ActorClass:
+    def __init__(self, cls, opts: Optional[dict] = None):
+        self._cls = cls
+        self._opts = dict(_DEFAULT_ACTOR_OPTS)
+        if opts:
+            self._opts.update(opts)
+        self._blob: Optional[bytes] = None
+        self._fid: Optional[str] = None
+        self._registered_sessions: set = set()
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"Actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote().")
+
+    def options(self, **overrides) -> "ActorClass":
+        opts = dict(self._opts)
+        opts.update(overrides)
+        ac = ActorClass(self._cls, opts)
+        ac._blob = self._blob
+        ac._fid = self._fid
+        ac._registered_sessions = self._registered_sessions
+        return ac
+
+    def _method_names(self) -> List[str]:
+        return [n for n, m in inspect.getmembers(self._cls)
+                if callable(m) and not n.startswith("__")]
+
+    def _ensure_registered(self) -> str:
+        w = global_worker()
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._cls)
+            self._fid = (
+                f"{self.__name__}-{hashlib.sha1(self._blob).hexdigest()[:16]}")
+        if w.session_name not in self._registered_sessions:
+            w.kv_put(self._fid, self._blob, ns="fn")
+            self._registered_sessions.add(w.session_name)
+        return self._fid
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        w = global_worker()
+        fid = self._ensure_registered()
+        opts = self._opts
+        wire_opts = {
+            "res": _build_resources(opts),
+            "max_restarts": opts.get("max_restarts", 0),
+            "name": opts.get("name"),
+            "namespace": opts.get("namespace") or w.namespace,
+            "lifetime": opts.get("lifetime"),
+            "max_concurrency": opts.get("max_concurrency"),
+        }
+        if opts.get("runtime_env"):
+            wire_opts["runtime_env"] = opts["runtime_env"]
+        wire_opts.update(_strategy_opts(opts))
+        msg_args = _prepare_args(args, kwargs)
+        aid = w.create_actor_msg(fid, msg_args, wire_opts)
+        return ActorHandle(aid, self._method_names(),
+                           opts.get("max_task_retries", 0))
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes."""
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0])
+                                          or inspect.isclass(args[0])):
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote takes keyword arguments only")
+    return wrap

@@ -1,0 +1,146 @@
+"""Serialization: cloudpickle + pickle5 out-of-band buffers into shared memory.
+
+Mirrors the reference's ``SerializationContext``
+(``python/ray/_private/serialization.py:122``): cloudpickle for arbitrary
+Python objects, custom reducers for ``ObjectRef``/``ActorHandle`` (installed
+by ``worker.py``), and zero-copy handling of large binary buffers (numpy /
+jax host arrays) which land 64-byte-aligned in the shared-memory segment so
+they can be mapped straight into ``jax.device_put``.
+
+Segment layout::
+
+    u32 header_len | msgpack header | padding | buffer_0 | padding | buffer_1 ...
+
+header = {"p": pickle_bytes, "o": [buffer offsets], "l": [buffer lengths]}
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import traceback
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+import msgpack
+
+_U32 = struct.Struct("<I")
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    """Pickle bytes plus out-of-band buffers, ready to be written."""
+
+    __slots__ = ("pickle_bytes", "buffers", "_header", "_offsets", "total_size")
+
+    def __init__(self, pickle_bytes: bytes, buffers: List[pickle.PickleBuffer]):
+        self.pickle_bytes = pickle_bytes
+        self.buffers = [b.raw() for b in buffers]
+        offsets: List[int] = []
+        lens = [len(b) for b in self.buffers]
+        header = msgpack.packb(
+            {"p": pickle_bytes, "o": [], "l": lens}, use_bin_type=True
+        )
+        # Offsets depend on header length; header length depends on offsets'
+        # encoded size. Fix-point in two passes (offset ints encode stably the
+        # second time because we pad the data start to alignment).
+        pos = _align(4 + len(header) + 16 * len(lens))
+        for ln in lens:
+            offsets.append(pos)
+            pos = _align(pos + ln)
+        header = msgpack.packb(
+            {"p": pickle_bytes, "o": offsets, "l": lens}, use_bin_type=True
+        )
+        if 4 + len(header) > offsets[0] if offsets else False:
+            raise RuntimeError("serialization header overflow")
+        self._header = header
+        self._offsets = offsets
+        self.total_size = pos if self.buffers else 4 + len(header)
+
+    def write_into(self, buf: memoryview):
+        buf[:4] = _U32.pack(len(self._header))
+        buf[4 : 4 + len(self._header)] = self._header
+        for off, b in zip(self._offsets, self.buffers):
+            buf[off : off + len(b)] = b
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return SerializedObject(pickled, buffers)
+
+
+def deserialize(data: memoryview) -> Any:
+    data = memoryview(data)
+    (header_len,) = _U32.unpack(data[:4])
+    header = msgpack.unpackb(data[4 : 4 + header_len], raw=False)
+    buffers = [
+        data[off : off + ln] for off, ln in zip(header["o"], header["l"])
+    ]
+    return pickle.loads(header["p"], buffers=buffers)
+
+
+INLINE_THRESHOLD = 100 * 1024  # match the reference's 100KB inline-return limit
+
+
+class TaskError(Exception):
+    """An exception raised inside a task, re-raised at ``get`` on the caller.
+
+    Equivalent of the reference's ``RayTaskError``
+    (``python/ray/exceptions.py``): carries the remote traceback text and the
+    original cause when it is picklable.
+    """
+
+    def __init__(self, function_name: str, tb_str: str, cause: Optional[BaseException] = None):
+        self.function_name = function_name
+        self.tb_str = tb_str
+        self.cause = cause
+        super().__init__(tb_str)
+
+    def __str__(self):
+        return (
+            f"task {self.function_name} failed with the following error:\n"
+            f"{self.tb_str}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.tb_str, self.cause))
+
+
+class WorkerCrashedError(Exception):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(Exception):
+    """The actor is dead (crashed, killed, or out of restarts)."""
+
+
+class ObjectLostError(Exception):
+    """The object's value was lost and could not be reconstructed."""
+
+
+class GetTimeoutError(TimeoutError):
+    """``get`` exceeded its timeout."""
+
+
+class TaskCancelledError(Exception):
+    """The task was cancelled before or during execution."""
+
+
+def pack_error(function_name: str, exc: BaseException) -> SerializedObject:
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        err = TaskError(function_name, tb, exc)
+        return serialize(err)
+    except Exception:
+        # Cause not picklable — drop it, keep the traceback text.
+        return serialize(TaskError(function_name, tb, None))

@@ -1,0 +1,565 @@
+"""Client-side runtime shared by drivers and worker processes.
+
+This is the analog of the reference's ``CoreWorker``
+(``src/ray/core_worker/core_worker.h:271``) + the Python driver glue
+(``python/ray/_private/worker.py``): object put/get/wait, task submission,
+actor calls, and reference counting. The C++ reference splits owner-side
+bookkeeping (TaskManager, ReferenceCounter) from the Python frontend; here
+both live in one class running an asyncio IO thread, with direct
+worker-to-worker connections for actor calls (the reference's
+``ActorTaskSubmitter`` direct gRPC path, ``transport/actor_task_submitter.h:75``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from concurrent.futures import Future as SyncFuture
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import protocol, serialization
+from .ids import ActorID, ObjectID, TaskID, WorkerID, _Counter
+from .object_store import make_store
+from .serialization import (
+    ActorDiedError,
+    GetTimeoutError,
+    INLINE_THRESHOLD,
+    TaskError,
+    deserialize,
+    serialize,
+)
+
+_global_worker: Optional["Worker"] = None
+
+
+def global_worker() -> "Worker":
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first.")
+    return _global_worker
+
+
+def set_global_worker(w: Optional["Worker"]):
+    global _global_worker
+    _global_worker = w
+
+
+class ObjectRef:
+    """A reference to an eventually-available remote value.
+
+    Analog of the reference's ``ObjectRef`` (``python/ray/_raylet.pyx`` +
+    ``reference_count.h:64``): hashable, serializable (with borrower
+    incref at pickling time), awaitable via ``get``.
+    """
+
+    __slots__ = ("id", "_worker", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, worker: Optional["Worker"] = None,
+                 *, borrowed: bool = False):
+        self.id = object_id
+        self._worker = worker if worker is not None else _global_worker
+        if borrowed and self._worker is not None:
+            self._worker.queue_ref_delta(object_id, +1)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self) -> TaskID:
+        return self.id.task_id()
+
+    def future(self) -> SyncFuture:
+        return self._worker.object_future(self.id)
+
+    def __reduce__(self):
+        # A serialized ref must be resolvable by the receiver: values held
+        # only in this process's memory store are promoted to the GCS first.
+        if self._worker is not None:
+            self._worker.promote_on_serialize(self.id)
+        return (_deserialize_object_ref, (self.id.binary(),))
+
+    def __del__(self):
+        w = self._worker
+        if w is not None and not w.closed:
+            w.queue_ref_delta(self.id, -1)
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __await__(self):
+        return self._await_impl().__await__()
+
+    async def _await_impl(self):
+        fut = self.future()
+        return await asyncio.wrap_future(fut)
+
+
+def _deserialize_object_ref(id_bytes: bytes) -> ObjectRef:
+    return ObjectRef(ObjectID(id_bytes), borrowed=True)
+
+
+class _ActorConn:
+    """Cached direct connection to an actor's worker process."""
+
+    def __init__(self, addr: str, conn: protocol.Connection):
+        self.addr = addr
+        self.conn = conn
+
+
+class Worker:
+    """Per-process runtime: IO thread + GCS connection + object store."""
+
+    def __init__(self, role: str = "driver"):
+        self.role = role
+        self.worker_id = WorkerID.from_random()
+        self.namespace = "default"
+        self.closed = False
+        self.session_name: Optional[str] = None
+        self.session_dir: Optional[str] = None
+        self.node_id: Optional[bytes] = None
+        self.gcs: Optional[protocol.Connection] = None
+        self.store = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._put_counter = _Counter()
+        # oid -> SyncFuture resolving to ("inline", bytes) | ("shm", nbytes)
+        self._object_futures: Dict[ObjectID, SyncFuture] = {}
+        self._memory_store: Dict[ObjectID, bytes] = {}
+        self._ref_deltas: Dict[ObjectID, int] = {}
+        self._ref_lock = threading.Lock()
+        self._actor_conns: Dict[ActorID, _ActorConn] = {}
+        self._actor_locks: Dict[ActorID, asyncio.Lock] = {}
+        self._dead_actors: Dict[ActorID, str] = {}
+        self._registered_inline: set = set()
+        self._promote_pending: set = set()
+        self._flusher_handle = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def connect(self, gcs_address: str,
+                loop: Optional[asyncio.AbstractEventLoop] = None,
+                node_id: Optional[bytes] = None):
+        """Connect to the GCS. If ``loop`` is None an IO thread is started."""
+        self.gcs_address = gcs_address
+        self.node_id = node_id
+        if loop is None:
+            self.loop = asyncio.new_event_loop()
+            self._loop_thread = threading.Thread(
+                target=self._run_loop, name="ray_tpu-io", daemon=True)
+            self._loop_thread.start()
+        else:
+            self.loop = loop
+        hello = self.run_async(self._connect_async(gcs_address))
+        self.session_name = hello["session"]
+        self.session_dir = hello["session_dir"]
+        self.store = make_store(self.session_name)
+        return hello
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run_async(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the IO loop from any thread and wait."""
+        if (threading.current_thread() is self._loop_thread):
+            raise RuntimeError("run_async called from the IO thread")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    async def _connect_async(self, gcs_address: str) -> dict:
+        reader, writer = await protocol.connect(gcs_address)
+        self.gcs = protocol.Connection(
+            reader, writer, handler=self._on_gcs_push,
+            on_close=self._on_gcs_close)
+        self.gcs.start()
+        hello = {
+            "t": "hello", "role": self.role,
+            "worker_id": self.worker_id.binary(),
+            "pid": os.getpid(),
+        }
+        if self.node_id is not None:
+            hello["node_id"] = self.node_id
+        reply = await self.gcs.request(hello, timeout=30)
+        self._flusher_handle = self.loop.call_later(0.1, self._flush_refs_cb)
+        return reply
+
+    def _on_gcs_close(self):
+        if not self.closed:
+            for fut in list(self._object_futures.values()):
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError("lost connection to the cluster"))
+
+    def disconnect(self):
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.run_async(self._disconnect_async(), timeout=5)
+        except Exception:
+            pass
+        if self._loop_thread is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._loop_thread.join(timeout=5)
+        if self.store is not None:
+            self.store.close()
+
+    async def _disconnect_async(self):
+        self._flush_refs()
+        if self.gcs is not None:
+            await self.gcs.close()
+        for ac in self._actor_conns.values():
+            await ac.conn.close()
+
+    # ----------------------------------------------------------- ref counts
+
+    def queue_ref_delta(self, object_id: ObjectID, delta: int):
+        if self.closed:
+            return
+        with self._ref_lock:
+            self._ref_deltas[object_id] = self._ref_deltas.get(object_id, 0) + delta
+
+    def _flush_refs_cb(self):
+        self._flush_refs()
+        if not self.closed:
+            self._flusher_handle = self.loop.call_later(0.1, self._flush_refs_cb)
+
+    def _flush_refs(self):
+        with self._ref_lock:
+            deltas = [(oid.binary(), d) for oid, d in self._ref_deltas.items()
+                      if d != 0]
+            self._ref_deltas.clear()
+        if deltas and self.gcs is not None and not self.gcs.closed:
+            try:
+                self.gcs.send({"t": "ref", "d": deltas})
+            except ConnectionError:
+                pass
+
+    # -------------------------------------------------------------- objects
+
+    def object_future(self, object_id: ObjectID) -> SyncFuture:
+        fut = self._object_futures.get(object_id)
+        if fut is None:
+            fut = SyncFuture()
+            self._object_futures[object_id] = fut
+            if object_id in self._memory_store:
+                fut.set_result(("inline", self._memory_store[object_id]))
+            else:
+                # Ask the GCS; reply resolves the future.
+                asyncio.run_coroutine_threadsafe(
+                    self._wait_remote(object_id, fut), self.loop)
+        return fut
+
+    async def _wait_remote(self, object_id: ObjectID, fut: SyncFuture):
+        try:
+            reply = await self.gcs.request(
+                {"t": "obj_wait", "oid": object_id.binary()})
+            if fut.done():
+                return
+            if not reply.get("ok"):
+                fut.set_exception(serialization.ObjectLostError(
+                    reply.get("err", "object lost")))
+            elif reply["where"] == "inline":
+                fut.set_result(("inline", reply["data"]))
+            else:
+                fut.set_result(("shm", reply["nbytes"]))
+        except (ConnectionError, asyncio.CancelledError) as e:
+            if not fut.done():
+                fut.set_exception(ConnectionError(str(e)))
+
+    def _resolve_value(self, object_id: ObjectID, where: str, payload) -> Any:
+        if where == "inline":
+            value = deserialize(memoryview(payload))
+        else:
+            view = self.store.get(object_id, payload)
+            if view is None:
+                raise serialization.ObjectLostError(
+                    f"object {object_id.hex()} missing from the local store")
+            try:
+                value = deserialize(view.data)
+            finally:
+                pass  # view kept alive by value's buffers if zero-copy
+        if isinstance(value, TaskError):
+            raise value.cause if isinstance(value.cause, Exception) else value
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        futs = [self.object_future(r.id) for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r, fut in zip(refs, futs):
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                where, payload = fut.result(remaining)
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get timed out after {timeout}s waiting for {r}")
+            out.append(self._resolve_value(r.id, where, payload))
+        return out
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self._put_counter.next())
+        sobj = serialize(value)
+        if sobj.total_size <= INLINE_THRESHOLD:
+            data = sobj.to_bytes()
+            self._memory_store[oid] = data
+            self.run_async(self.gcs.request({
+                "t": "obj_put", "oid": oid.binary(),
+                "nbytes": len(data), "data": data}))
+        else:
+            buf = self.store.create(oid, sobj.total_size)
+            sobj.write_into(buf)
+            self.store.seal(oid)
+            self.run_async(self.gcs.request({
+                "t": "obj_put", "oid": oid.binary(),
+                "nbytes": sobj.total_size, "shm": True}))
+        return ObjectRef(oid, self)
+
+    def put_serialized(self, sobj: serialization.SerializedObject,
+                       oid: Optional[ObjectID] = None,
+                       register: bool = True) -> ObjectID:
+        """Write an already-serialized object into the store (worker side)."""
+        if oid is None:
+            oid = ObjectID.for_put(self._put_counter.next())
+        buf = self.store.create(oid, sobj.total_size)
+        sobj.write_into(buf)
+        self.store.seal(oid)
+        if register:
+            self.gcs.send({"t": "obj_put", "oid": oid.binary(),
+                           "nbytes": sobj.total_size, "shm": True})
+        return oid
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        futs = {r: self.object_future(r.id) for r in refs}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        while len(ready) < num_returns:
+            pending = [r for r in refs if r not in ready]
+            done_now = [r for r in pending if futs[r].done()]
+            ready.extend(done_now)
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.001)
+        ready = ready[: max(num_returns, len(ready))]
+        not_ready = [r for r in refs if r not in ready]
+        return ready, not_ready
+
+    # ---------------------------------------------------------------- tasks
+
+    def promote_on_serialize(self, object_id: ObjectID):
+        """Register a locally-held inline value with the GCS so a borrower
+        can resolve the ref (lazy ownership promotion)."""
+        if object_id in self._registered_inline:
+            return
+        self._registered_inline.add(object_id)
+        data = self._memory_store.get(object_id)
+        if data is None:
+            # Value not here yet (in-flight actor call) — promote on arrival.
+            self._promote_pending.add(object_id)
+            return
+        self.loop.call_soon_threadsafe(self._send_gcs, {
+            "t": "obj_put", "oid": object_id.binary(),
+            "nbytes": len(data), "data": bytes(data)})
+
+    def push_result(self, tid_bytes: bytes, results: List[dict]):
+        """Handle a task_done push from the GCS (we are the owner)."""
+        for r in results:
+            oid = ObjectID(r["oid"])
+            if r.get("data") is not None:
+                self._memory_store[oid] = r["data"]
+                payload: Tuple[str, Any] = ("inline", r["data"])
+                if oid in self._promote_pending:
+                    self._promote_pending.discard(oid)
+                    self._send_gcs({"t": "obj_put", "oid": oid.binary(),
+                                    "nbytes": len(r["data"]),
+                                    "data": bytes(r["data"])})
+            else:
+                payload = ("shm", r["nbytes"])
+            fut = self._object_futures.get(oid)
+            if fut is None:
+                fut = SyncFuture()
+                self._object_futures[oid] = fut
+            if not fut.done():
+                fut.set_result(payload)
+
+    async def _on_gcs_push(self, msg: dict):
+        t = msg.get("t")
+        if t == "task_done":
+            self.push_result(msg["tid"], msg["results"])
+        elif t == "actor_dead":
+            aid = ActorID(msg["aid"])
+            self._dead_actors[aid] = msg.get("cause", "actor died")
+            ac = self._actor_conns.pop(aid, None)
+            if ac is not None:
+                await ac.conn.close()
+        elif t == "exec" or t == "actor_init" or t == "cancel" or t == "exit":
+            # Only worker processes receive these; the executor overrides.
+            await self.handle_control(msg)
+
+    async def handle_control(self, msg: dict):  # overridden in worker_main
+        pass
+
+    def submit_task(self, fid: str, msg_args: dict, num_returns: int,
+                    opts: dict) -> List[ObjectRef]:
+        tid = TaskID.from_random()
+        msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
+               "nret": num_returns, "opts": opts, **msg_args}
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(tid, i + 1)
+            fut = SyncFuture()
+            self._object_futures[oid] = fut
+            refs.append(ObjectRef(oid, self))
+        self.loop.call_soon_threadsafe(self._send_gcs, msg)
+        return refs
+
+    def _send_gcs(self, msg: dict):
+        if self.gcs is not None and not self.gcs.closed:
+            try:
+                self.gcs.send(msg)
+            except ConnectionError:
+                pass
+
+    def cancel_task(self, tid: TaskID, force: bool):
+        self.loop.call_soon_threadsafe(self._send_gcs, {
+            "t": "task_cancel", "tid": tid.binary(), "force": force})
+
+    # --------------------------------------------------------------- actors
+
+    def create_actor_msg(self, fid: str, msg_args: dict, opts: dict) -> ActorID:
+        aid = ActorID.from_random()
+        reply = self.run_async(self.gcs.request({
+            "t": "actor_create", "aid": aid.binary(), "fid": fid,
+            "opts": opts, **msg_args}))
+        if not reply.get("ok"):
+            raise ValueError(reply.get("err", "actor creation failed"))
+        return aid
+
+    async def _get_actor_conn(self, actor_id: ActorID) -> _ActorConn:
+        ac = self._actor_conns.get(actor_id)
+        if ac is not None and not ac.conn.closed:
+            return ac
+        if actor_id in self._dead_actors:
+            raise ActorDiedError(self._dead_actors[actor_id])
+        reply = await self.gcs.request(
+            {"t": "actor_get", "aid": actor_id.binary()})
+        if not reply.get("ok"):
+            self._dead_actors[actor_id] = reply.get("err", "actor died")
+            raise ActorDiedError(self._dead_actors[actor_id])
+        addr = reply["addr"]
+        reader, writer = await protocol.connect(addr)
+        conn = protocol.Connection(reader, writer)
+        conn.start()
+        ac = _ActorConn(addr, conn)
+        self._actor_conns[actor_id] = ac
+        return ac
+
+    def submit_actor_task_msg(self, actor_id: ActorID, method: str,
+                              msg_args: dict, num_returns: int,
+                              opts: dict) -> List[ObjectRef]:
+        tid = TaskID.from_random()
+        refs = []
+        oids = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(tid, i + 1)
+            fut = SyncFuture()
+            self._object_futures[oid] = fut
+            oids.append(oid)
+            refs.append(ObjectRef(oid, self))
+        asyncio.run_coroutine_threadsafe(
+            self._actor_call(actor_id, tid, method, msg_args,
+                             num_returns, opts, oids,
+                             opts.get("retries", 0)),
+            self.loop)
+        return refs
+
+    async def _actor_call(self, actor_id: ActorID, tid: TaskID, method: str,
+                          msg_args: dict, num_returns: int, opts: dict,
+                          oids: List[ObjectID], retries: int):
+        try:
+            # Per-actor lock: conn resolution + the synchronous send happen
+            # in submission order (FIFO per caller); reply waits overlap.
+            lock = self._actor_locks.setdefault(actor_id, asyncio.Lock())
+            async with lock:
+                ac = await self._get_actor_conn(actor_id)
+                reply_fut = ac.conn.request_nowait({
+                    "t": "actor_call", "aid": actor_id.binary(),
+                    "tid": tid.binary(), "m": method,
+                    "nret": num_returns, "opts": opts, **msg_args})
+            reply = await reply_fut
+            results = reply["results"]
+            # Register large (shm) actor-call results with the GCS: we are
+            # the owner; this makes the ref resolvable by borrowers.
+            for r in results:
+                if r.get("shm"):
+                    self._send_gcs({"t": "obj_put", "oid": r["oid"],
+                                    "nbytes": r["nbytes"], "shm": True})
+            self.push_result(tid.binary(), results)
+        except (ConnectionError, ActorDiedError) as e:
+            if retries != 0:
+                # Re-resolve (the actor may be restarting) and try again.
+                await asyncio.sleep(0.05)
+                self._actor_conns.pop(actor_id, None)
+                await self._actor_call(actor_id, tid, method, args_blob,
+                                       num_returns, opts, oids,
+                                       retries - 1 if retries > 0 else retries)
+                return
+            cause = self._dead_actors.get(actor_id, str(e) or "actor died")
+            err = serialize(ActorDiedError(cause)).to_bytes()
+            self.push_result(tid.binary(), [
+                {"oid": oid.binary(), "nbytes": len(err), "data": err}
+                for oid in oids])
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.loop.call_soon_threadsafe(self._send_gcs, {
+            "t": "actor_kill", "aid": actor_id.binary(),
+            "no_restart": no_restart})
+
+    def get_actor_id_by_name(self, name: str, namespace: Optional[str]) -> ActorID:
+        reply = self.run_async(self.gcs.request({
+            "t": "actor_by_name", "name": name, "namespace": namespace}))
+        if not reply.get("ok"):
+            raise ValueError(reply.get("err"))
+        return ActorID(reply["aid"])
+
+    # ------------------------------------------------------------------ kv
+
+    def kv_put(self, key: str, value: bytes, ns: str = ""):
+        self.run_async(self.gcs.request(
+            {"t": "kv_put", "ns": ns, "k": key, "v": value}))
+
+    def kv_get(self, key: str, ns: str = "") -> Optional[bytes]:
+        reply = self.run_async(self.gcs.request(
+            {"t": "kv_get", "ns": ns, "k": key}))
+        return reply.get("v") if reply.get("ok") else None
+
+    def kv_del(self, key: str, ns: str = ""):
+        self.run_async(self.gcs.request({"t": "kv_del", "ns": ns, "k": key}))
+
+    def kv_keys(self, prefix: str = "", ns: str = "") -> List[str]:
+        reply = self.run_async(self.gcs.request(
+            {"t": "kv_keys", "ns": ns, "prefix": prefix}))
+        return reply.get("keys", [])
+
+    # ----------------------------------------------------------- inspection
+
+    def cluster_info(self) -> dict:
+        return self.run_async(self.gcs.request({"t": "cluster_info"}))
+
+    def request_gcs(self, msg: dict, timeout: Optional[float] = 60) -> dict:
+        return self.run_async(self.gcs.request(msg), timeout)

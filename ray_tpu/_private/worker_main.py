@@ -1,0 +1,342 @@
+"""Worker process: task/actor executor.
+
+Analog of the reference's worker-side CoreWorker loop
+(``CoreWorker::RunTaskExecutionLoop`` ``core_worker.h:326`` +
+``TaskReceiver::HandleTask`` ``transport/task_receiver.h:91``): receives
+tasks from the GCS scheduler over its control connection, receives direct
+actor calls on its own listening socket, executes Python functions on an
+executor thread (sequential per actor, matching the reference's
+``ActorSchedulingQueue`` ordering), and writes results inline or to the
+shared-memory store.
+
+Workers deliberately do NOT import jax/numpy at startup: heavyweight imports
+happen inside user functions, so per-task ``runtime_env['env_vars']`` (e.g.
+``JAX_PLATFORMS``) set before the import still takes effect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import ctypes
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from . import protocol, serialization
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+from .serialization import INLINE_THRESHOLD, deserialize, pack_error, serialize
+from .worker import ObjectRef, Worker, set_global_worker
+
+
+class Executor:
+    def __init__(self, worker: Worker, listen_path: str):
+        self.worker = worker
+        self.listen_path = listen_path
+        self.fn_cache: Dict[str, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self.actor_opts: dict = {}
+        # Sequential executor preserves actor method ordering; normal tasks
+        # also run here one at a time.
+        self.pool = ThreadPoolExecutor(max_workers=1,
+                                       thread_name_prefix="exec")
+        self.async_sem: Optional[asyncio.Semaphore] = None
+        self.current_task_thread: Optional[int] = None
+        self.current_task_id: Optional[bytes] = None
+        self.cancelled: set = set()
+        self.die_after_task = False
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self):
+        self._server = await protocol.serve(
+            "unix:" + self.listen_path, self._on_direct_client)
+
+    async def _on_direct_client(self, reader, writer):
+        conn = protocol.Connection(reader, writer)
+        conn._handler = lambda msg: self._on_direct_msg(conn, msg)
+        conn.start()
+
+    async def _on_direct_msg(self, conn: protocol.Connection, msg: dict):
+        t = msg.get("t")
+        if t == "actor_call":
+            # Submission order == arrival order: the executor pool is FIFO
+            # and we enqueue before any await.
+            asyncio.get_running_loop().create_task(
+                self._run_actor_call(conn, msg))
+        elif t == "ping":
+            conn.reply(msg, {"ok": True})
+
+    # ------------------------------------------------------------ functions
+
+    def _get_function(self, fid: str):
+        fn = self.fn_cache.get(fid)
+        if fn is None:
+            blob = self.worker.kv_get(fid, ns="fn")
+            if blob is None:
+                raise RuntimeError(f"function {fid} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self.fn_cache[fid] = fn
+        return fn
+
+    def _load_args(self, msg: dict) -> Tuple[tuple, dict]:
+        if msg.get("argsref") is not None:
+            oid = ObjectID(msg["argsref"])
+            view = self.worker.store.get(oid, msg.get("argsn", 0))
+            if view is None:
+                # Not local (other host) — fall back to a GCS fetch.
+                ref = ObjectRef(oid, self.worker, borrowed=True)
+                args, kwargs = self.worker.get([ref])[0]
+                return args, kwargs
+            args, kwargs = deserialize(view.data)
+        else:
+            args, kwargs = deserialize(memoryview(msg["args"]))
+        # Resolve top-level ObjectRef arguments (reference semantics:
+        # ``DependencyResolver`` inlines resolved args, nested refs stay refs).
+        flat = list(args)
+        ref_idx = [i for i, a in enumerate(flat) if isinstance(a, ObjectRef)]
+        if ref_idx:
+            vals = self.worker.get([flat[i] for i in ref_idx])
+            for i, v in zip(ref_idx, vals):
+                flat[i] = v
+        kw_ref = {k: v for k, v in kwargs.items() if isinstance(v, ObjectRef)}
+        if kw_ref:
+            vals = self.worker.get(list(kw_ref.values()))
+            for (k, _), v in zip(kw_ref.items(), vals):
+                kwargs[k] = v
+        return tuple(flat), kwargs
+
+    def _apply_runtime_env(self, opts: dict):
+        renv = opts.get("runtime_env") or {}
+        env_vars = renv.get("env_vars") or {}
+        if env_vars:
+            os.environ.update({k: str(v) for k, v in env_vars.items()})
+            # Env mutations (e.g. JAX_PLATFORMS) can poison this worker for
+            # other tasks — retire it after this task like the reference's
+            # dedicated runtime-env workers.
+            if self.actor_id is None:
+                self.die_after_task = True
+
+    def _pack_results(self, tid_bytes: bytes, values: List[Any],
+                      register_shm: bool) -> List[dict]:
+        tid = TaskID(tid_bytes)
+        out = []
+        for i, value in enumerate(values):
+            oid = ObjectID.for_task_return(tid, i + 1)
+            sobj = serialize(value)
+            if sobj.total_size <= INLINE_THRESHOLD:
+                out.append({"oid": oid.binary(), "nbytes": sobj.total_size,
+                            "data": sobj.to_bytes()})
+            else:
+                buf = self.worker.store.create(oid, sobj.total_size)
+                sobj.write_into(buf)
+                self.worker.store.seal(oid)
+                out.append({"oid": oid.binary(), "nbytes": sobj.total_size,
+                            "shm": True})
+        return out
+
+    def _error_results(self, tid_bytes: bytes, nret: int, fn_name: str,
+                       exc: BaseException) -> List[dict]:
+        tid = TaskID(tid_bytes)
+        blob = pack_error(fn_name, exc).to_bytes()
+        return [{"oid": ObjectID.for_task_return(tid, i + 1).binary(),
+                 "nbytes": len(blob), "data": blob} for i in range(nret)]
+
+    # ---------------------------------------------------------- normal task
+
+    async def run_task(self, msg: dict):
+        loop = asyncio.get_running_loop()
+        tid = msg["tid"]
+        nret = msg.get("nret", 1)
+        opts = msg.get("opts") or {}
+        fn_name = opts.get("name", "unknown")
+        try:
+            results = await loop.run_in_executor(
+                self.pool, self._execute_sync, msg, tid, nret, opts)
+        except Exception as e:  # noqa: BLE001
+            results = self._error_results(tid, nret, fn_name, e)
+        self.worker.gcs.send({"t": "task_done", "tid": tid,
+                              "results": results})
+        if self.die_after_task:
+            await asyncio.sleep(0.01)
+            os._exit(0)
+
+    def _execute_sync(self, msg: dict, tid: bytes, nret: int,
+                      opts: dict) -> List[dict]:
+        self.current_task_thread = threading.get_ident()
+        self.current_task_id = tid
+        fn_name = opts.get("name", "unknown")
+        try:
+            self._apply_runtime_env(opts)
+            fn = self._get_function(msg["fid"])
+            args, kwargs = self._load_args(msg)
+            value = fn(*args, **kwargs)
+            if asyncio.iscoroutine(value):
+                value = asyncio.run(value)
+            values = self._split_returns(value, nret)
+            return self._pack_results(tid, values, register_shm=False)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                e = serialization.TaskCancelledError(str(e))
+            return self._error_results(tid, nret, fn_name, e)
+        finally:
+            self.current_task_thread = None
+            self.current_task_id = None
+
+    @staticmethod
+    def _split_returns(value: Any, nret: int) -> List[Any]:
+        if nret == 1:
+            return [value]
+        vals = list(value)
+        if len(vals) != nret:
+            raise ValueError(
+                f"task declared num_returns={nret} but returned {len(vals)}")
+        return vals
+
+    # --------------------------------------------------------------- actors
+
+    async def init_actor(self, msg: dict):
+        loop = asyncio.get_running_loop()
+        self.actor_id = ActorID(msg["aid"])
+        self.actor_opts = msg.get("opts") or {}
+        max_c = self.actor_opts.get("max_concurrency")
+        if max_c and max_c > 1:
+            self.pool = ThreadPoolExecutor(max_workers=max_c,
+                                           thread_name_prefix="exec")
+        self.async_sem = asyncio.Semaphore(max_c or 1000)
+        try:
+            await loop.run_in_executor(self.pool, self._init_actor_sync, msg)
+            self.worker.gcs.send({"t": "actor_ready",
+                                  "aid": msg["aid"]})
+        except Exception as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            self.worker.gcs.send({"t": "actor_init_err", "aid": msg["aid"],
+                                  "err": f"{e}\n{tb}"})
+            self.actor_id = None
+
+    def _init_actor_sync(self, msg: dict):
+        self._apply_runtime_env(msg.get("opts") or {})
+        cls = self._get_function(msg["fid"])
+        args, kwargs = self._load_args(msg)
+        self.actor_instance = cls(*args, **kwargs)
+
+    async def _run_actor_call(self, conn: protocol.Connection, msg: dict):
+        loop = asyncio.get_running_loop()
+        tid = msg["tid"]
+        nret = msg.get("nret", 1)
+        method_name = msg["m"]
+        try:
+            if self.actor_instance is None:
+                raise serialization.ActorDiedError("actor not initialized")
+            method = getattr(self.actor_instance, method_name)
+            if asyncio.iscoroutinefunction(method):
+                async with self.async_sem:
+                    args, kwargs = await loop.run_in_executor(
+                        None, self._load_args, msg)
+                    value = await method(*args, **kwargs)
+                    values = self._split_returns(value, nret)
+                    results = self._pack_results(tid, values, True)
+            else:
+                results = await loop.run_in_executor(
+                    self.pool, self._execute_method_sync, method, msg, tid,
+                    nret)
+        except BaseException as e:  # noqa: BLE001
+            results = self._error_results(tid, nret, method_name, e)
+        if not conn.closed:
+            conn.reply(msg, {"results": results})
+
+    def _execute_method_sync(self, method, msg: dict, tid: bytes,
+                             nret: int) -> List[dict]:
+        self.current_task_thread = threading.get_ident()
+        self.current_task_id = tid
+        try:
+            args, kwargs = self._load_args(msg)
+            value = method(*args, **kwargs)
+            values = self._split_returns(value, nret)
+            return self._pack_results(tid, values, register_shm=True)
+        finally:
+            self.current_task_thread = None
+            self.current_task_id = None
+
+    # ---------------------------------------------------------------- misc
+
+    def cancel(self, tid: bytes, force: bool):
+        if force:
+            os._exit(1)
+        if self.current_task_id == tid and self.current_task_thread:
+            # Best-effort interrupt of the executing thread (the reference
+            # raises KeyboardInterrupt in the worker the same way).
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(self.current_task_thread),
+                ctypes.py_object(KeyboardInterrupt))
+
+
+async def amain(args):
+    worker = Worker(role="worker")
+    worker.loop = asyncio.get_running_loop()
+    worker._loop_thread = threading.main_thread()
+    worker.node_id = bytes.fromhex(args.node_id)
+
+    listen_path = os.path.join(
+        args.session_dir, f"w_{worker.worker_id.hex()[:12]}.sock")
+    executor = Executor(worker, listen_path)
+    stop = asyncio.Event()
+
+    async def handle_control(msg: dict):
+        t = msg.get("t")
+        if t == "exec":
+            asyncio.get_running_loop().create_task(executor.run_task(msg))
+        elif t == "actor_init":
+            asyncio.get_running_loop().create_task(executor.init_actor(msg))
+        elif t == "cancel":
+            executor.cancel(msg["tid"], msg.get("force", False))
+        elif t == "exit":
+            stop.set()
+
+    worker.handle_control = handle_control
+    await executor.start()
+
+    reader, writer = await protocol.connect(args.gcs)
+    worker.gcs = protocol.Connection(
+        reader, writer, handler=worker._on_gcs_push,
+        on_close=lambda: stop.set())
+    worker.gcs.start()
+    reply = await worker.gcs.request({
+        "t": "hello", "role": "worker",
+        "worker_id": worker.worker_id.binary(),
+        "node_id": worker.node_id,
+        "addr": "unix:" + listen_path,
+        "pid": os.getpid(),
+    }, timeout=30)
+    worker.session_name = reply["session"]
+    worker.session_dir = reply["session_dir"]
+    from .object_store import make_store
+
+    worker.store = make_store(worker.session_name)
+    set_global_worker(worker)
+    worker._flusher_handle = worker.loop.call_later(0.1, worker._flush_refs_cb)
+
+    await stop.wait()
+    worker._flush_refs()
+    try:
+        os.unlink(listen_path)
+    except OSError:
+        pass
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    args = parser.parse_args()
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

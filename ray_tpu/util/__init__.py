@@ -1,0 +1,16 @@
+from .placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+    placement_group_table,
+)
+from .scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "placement_group_table", "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver's dryrun validates the same way).
+Mirrors the reference's in-process multi-node testing stance
+(``python/ray/cluster_utils.py:135``): tests never need real clusters.
+"""
+
+import os
+
+# Must be set before jax imports anywhere in the test process tree.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    """A started ray_tpu cluster shared by a test module."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def cpu_mesh8():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must provide 8 virtual CPU devices"
+    return devices[:8]

@@ -1,0 +1,201 @@
+"""Actor tests (model: reference ``python/ray/tests/test_actor.py``)."""
+
+import time
+
+import pytest
+
+
+def test_basic_actor(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_method_ordering(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_actor_error(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote())
+
+
+def test_actor_init_error(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class BadInit:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def m(self):
+            return 1
+
+    b = BadInit.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.m.remote())
+
+
+def test_named_actor(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    r = Registry.options(name="registry-test").remote()
+    assert ray_tpu.get(r.set.remote("a", 1))
+    r2 = ray_tpu.get_actor("registry-test")
+    assert ray_tpu.get(r2.get.remote("a")) == 1
+
+
+def test_kill_actor(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(v.ping.remote())
+
+
+def test_actor_restart(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=3)
+    class Phoenix:
+        def __init__(self):
+            self.state = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "alive"
+
+    p = Phoenix.options(max_restarts=2, max_task_retries=3).remote()
+    pid1 = ray_tpu.get(p.pid.remote())
+    p.die.remote()
+    time.sleep(1.0)
+    # Restarted actor serves again (possibly after retry)
+    assert ray_tpu.get(p.ping.remote()) == "alive"
+    pid2 = ray_tpu.get(p.pid.remote())
+    assert pid1 != pid2
+
+
+def test_async_actor(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t, tag):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return tag
+
+    a = AsyncWorker.options(max_concurrency=8).remote()
+    t0 = time.time()
+    refs = [a.work.remote(0.3, i) for i in range(6)]
+    out = ray_tpu.get(refs)
+    elapsed = time.time() - t0
+    assert sorted(out) == list(range(6))
+    # Concurrent: 6 x 0.3s sleeps overlap
+    assert elapsed < 1.5
+
+
+def test_actor_handle_passing(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+            return True
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(handle, v):
+        import ray_tpu as rt
+
+        return rt.get(handle.set.remote(v))
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, 99))
+    assert ray_tpu.get(s.get.remote()) == 99
+
+
+def test_detached_actor_listed(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class D:
+        def ping(self):
+            return 1
+
+    d = D.options(name="detached-one", lifetime="detached").remote()
+    assert ray_tpu.get(d.ping.remote()) == 1
+    ray_tpu.kill(d)

@@ -1,0 +1,82 @@
+"""Object store tests (model: reference ``test_basic_2.py`` / plasma tests)."""
+
+import numpy as np
+import pytest
+
+
+def test_put_get_roundtrip(ray_cluster):
+    ray_tpu = ray_cluster
+    for value in [1, "s", [1, 2], {"a": (1, 2)}, None, b"bytes", 3.14]:
+        assert ray_tpu.get(ray_tpu.put(value)) == value
+
+
+def test_put_get_numpy_zero_copy(ray_cluster):
+    ray_tpu = ray_cluster
+    arr = np.random.rand(1024, 256).astype(np.float32)
+    out = ray_tpu.get(ray_tpu.put(arr))
+    np.testing.assert_array_equal(arr, out)
+    # Large arrays come back as views over shared memory (zero-copy).
+    assert not out.flags["OWNDATA"]
+
+
+def test_put_of_ref_rejected(ray_cluster):
+    ray_tpu = ray_cluster
+    with pytest.raises(TypeError):
+        ray_tpu.put(ray_tpu.put(1))
+
+
+def test_ref_passed_through_task(ray_cluster):
+    ray_tpu = ray_cluster
+    ref = ray_tpu.put(np.arange(100_000))
+
+    @ray_tpu.remote
+    def total(r):
+        return int(r.sum())
+
+    assert ray_tpu.get(total.remote(ref)) == sum(range(100_000))
+
+
+def test_ref_forwarded_between_tasks(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def make():
+        import numpy as _np
+
+        return _np.ones(200_000)
+
+    @ray_tpu.remote
+    def use(container):
+        import ray_tpu as rt
+
+        return float(rt.get(container["r"]).sum())
+
+    r = make.remote()
+    assert ray_tpu.get(use.remote({"r": r})) == 200_000.0
+
+
+def test_get_list(ray_cluster):
+    ray_tpu = ray_cluster
+    refs = [ray_tpu.put(i) for i in range(10)]
+    assert ray_tpu.get(refs) == list(range(10))
+
+
+def test_wait_all(ray_cluster):
+    ray_tpu = ray_cluster
+    refs = [ray_tpu.put(i) for i in range(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=5, timeout=5)
+    assert len(ready) == 5 and not not_ready
+
+
+def test_shared_get_same_object(ray_cluster):
+    """Two tasks getting the same large ref both see the data."""
+    ray_tpu = ray_cluster
+    arr = np.random.rand(300_000)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def check(r, expected_sum):
+        return abs(float(r.sum()) - expected_sum) < 1e-6
+
+    s = float(arr.sum())
+    assert all(ray_tpu.get([check.remote(ref, s) for _ in range(4)]))

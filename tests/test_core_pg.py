@@ -1,0 +1,66 @@
+"""Placement group tests (model: reference ``test_placement_group.py``)."""
+
+import pytest
+
+
+def test_pg_create_and_use(ray_cluster):
+    ray_tpu = ray_cluster
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote
+    def where():
+        import os
+
+        return os.getpid()
+
+    refs = [
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(2)
+    ]
+    pids = ray_tpu.get(refs)
+    assert len(pids) == 2
+    remove_placement_group(pg)
+
+
+def test_pg_strict_pack_single_node(ray_cluster):
+    ray_tpu = ray_cluster
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(10)
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_times_out(ray_cluster):
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1000}], strategy="PACK")
+    assert not pg.wait(0.5)
+    remove_placement_group(pg)
+
+
+def test_pg_strict_spread_needs_nodes(ray_cluster):
+    """STRICT_SPREAD with more bundles than nodes can't place."""
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg.wait(0.5)  # single-node cluster
+    remove_placement_group(pg)
+
+
+def test_pg_table(ray_cluster):
+    from ray_tpu.util import placement_group, placement_group_table, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="table-test")
+    assert pg.wait(10)
+    table = placement_group_table()
+    assert any(v["name"] == "table-test" for v in table.values())
+    remove_placement_group(pg)

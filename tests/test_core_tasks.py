@@ -1,0 +1,174 @@
+"""Task API tests (model: reference ``python/ray/tests/test_basic.py``)."""
+
+import time
+
+import pytest
+
+
+def test_basic_task(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_args_kwargs(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def f(a, b, c=0, d=0):
+        return a + b + c + d
+
+    assert ray_tpu.get(f.remote(1, 2, c=3, d=4)) == 10
+
+
+def test_many_tasks(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(100)]
+    assert ray_tpu.get(refs) == [i * i for i in range(100)]
+
+
+def test_task_error_propagates(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("expected failure")
+
+    with pytest.raises(ValueError, match="expected failure"):
+        ray_tpu.get(boom.remote())
+
+
+def test_nested_tasks(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+
+        return rt.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_object_ref_args(ray_cluster):
+    """Top-level refs are resolved; the task sees values."""
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def produce():
+        return 5
+
+    @ray_tpu.remote
+    def consume(x, y):
+        assert not hasattr(x, "id")  # not an ObjectRef
+        return x + y
+
+    r = produce.remote()
+    assert ray_tpu.get(consume.remote(r, 3)) == 8
+
+
+def test_nested_ref_in_container_stays_ref(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def produce():
+        return 7
+
+    @ray_tpu.remote
+    def consume(lst):
+        import ray_tpu as rt
+
+        assert isinstance(lst[0], rt.ObjectRef)
+        return rt.get(lst[0])
+
+    assert ray_tpu.get(consume.remote([produce.remote()])) == 7
+
+
+def test_multiple_returns(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_options_override(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def idn(x):
+        return x
+
+    r = idn.options(num_returns=2).remote((1, 2))
+    assert ray_tpu.get(list(r)) == [1, 2]
+
+
+def test_large_args_and_returns(ray_cluster):
+    import numpy as np
+
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def echo_sum(arr):
+        return arr, float(arr.sum())
+
+    arr = np.ones((512, 1024), dtype=np.float32)
+    out, s = ray_tpu.get(echo_sum.remote(arr))
+    assert s == float(arr.sum())
+    assert out.shape == arr.shape
+
+
+def test_wait(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.2)
+
+
+def test_direct_call_rejected(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
