@@ -1,0 +1,89 @@
+"""Per-process JAX platform pinning.
+
+The TPU chip is a process-exclusive resource: only one process per host may
+own it (libtpu acquires it at backend init). The reference handles GPU
+visibility with ``CUDA_VISIBLE_DEVICES`` injection in the raylet worker pool
+(``python/ray/_private/accelerators``); the TPU analog is pinning the JAX
+platform per worker: workers without a TPU resource grant must run jax on
+CPU, the one TPU-granted worker gets the chip.
+
+Some PJRT plugin environments (e.g. tunneled dev pods) override the
+``JAX_PLATFORMS`` env var at import time, so env vars alone are unreliable;
+this module installs a post-import hook that applies
+``jax.config.update("jax_platforms", ...)`` the moment jax is imported —
+paying zero cost in workers that never touch jax.
+"""
+
+from __future__ import annotations
+
+import importlib.abc
+import importlib.util
+import os
+import sys
+
+ENV_VAR = "RAY_TPU_JAX_PLATFORM"
+
+
+def apply(platform: str | None = None):
+    """Apply the platform to an already-imported (or importable) jax."""
+    platform = platform or os.environ.get(ENV_VAR)
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
+class _JaxPostImportHook(importlib.abc.MetaPathFinder):
+    """Applies the platform config right after ``jax`` executes.
+
+    The hook stays installed until ``exec_module`` actually runs (a bare
+    ``find_spec('jax')`` probe from optional-dependency checks must not
+    disarm it); it de-registers itself only once the config is applied.
+    """
+
+    def find_spec(self, name, path, target=None):
+        if name != "jax":
+            return None
+        # Avoid re-entrancy during the nested lookup, then re-install so a
+        # spec probe that never executes the module doesn't disarm us.
+        try:
+            sys.meta_path.remove(self)
+        except ValueError:
+            return None
+        try:
+            spec = importlib.util.find_spec("jax")
+        finally:
+            if "jax" not in sys.modules:
+                sys.meta_path.insert(0, self)
+        if spec is None or spec.loader is None:
+            return spec
+        orig_loader = spec.loader
+        hook = self
+
+        class _Loader(importlib.abc.Loader):
+            def create_module(self, s):
+                return orig_loader.create_module(s)
+
+            def exec_module(self, mod):
+                orig_loader.exec_module(mod)
+                platform = os.environ.get(ENV_VAR)
+                if platform:
+                    mod.config.update("jax_platforms", platform)
+                try:
+                    sys.meta_path.remove(hook)
+                except ValueError:
+                    pass
+
+        spec.loader = _Loader()
+        return spec
+
+
+def install_hook():
+    """Install the post-import hook if a platform override is requested."""
+    if not os.environ.get(ENV_VAR):
+        return
+    if "jax" in sys.modules:
+        apply()
+        return
+    sys.meta_path.insert(0, _JaxPostImportHook())

@@ -100,6 +100,23 @@ _WORKER_BOOTSTRAP = (
     "main()\n"
 )
 
+# Head/agent processes bootstrap the same way: ``-S`` skips slow site
+# processing AND the inherited path covers drivers that import ray_tpu from
+# a source checkout rather than an installed package.
+_HEAD_BOOTSTRAP = (
+    "import sys, os\n"
+    "sys.path[:0] = os.environ['RAY_TPU_SYS_PATH'].split(os.pathsep)\n"
+    "from ray_tpu._private.node import head_main\n"
+    "head_main()\n"
+)
+
+_AGENT_BOOTSTRAP = (
+    "import sys, os\n"
+    "sys.path[:0] = os.environ['RAY_TPU_SYS_PATH'].split(os.pathsep)\n"
+    "from ray_tpu._private.node import agent_main\n"
+    "agent_main()\n"
+)
+
 
 def worker_sys_path() -> str:
     """The parent's import path, for ``python -S`` worker bootstrap."""
@@ -294,15 +311,17 @@ class HeadNode:
         self.session_dir = new_session_dir()
         self.resources = detect_node_resources(num_cpus, num_tpus, resources)
         self.address = "unix:" + os.path.join(self.session_dir, "gcs.sock")
-        cmd = [sys.executable, "-m", "ray_tpu._private.head_entry",
+        cmd = [sys.executable, "-S", "-c", _HEAD_BOOTSTRAP,
                "--session-dir", self.session_dir,
                "--resources", json.dumps(self.resources),
                "--num-initial-workers", str(num_initial_workers)]
         if not probe_tpu:
             cmd.append("--no-probe-tpu")
+        env = {**os.environ, "RAY_TPU_SYS_PATH": worker_sys_path()}
         self.proc = subprocess.Popen(
             cmd,
             start_new_session=True,
+            env=env,
             stdout=open(os.path.join(self.session_dir, "gcs.out"), "ab"),
             stderr=subprocess.STDOUT)
         ready = os.path.join(self.session_dir, "gcs.ready")

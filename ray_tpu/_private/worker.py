@@ -163,6 +163,16 @@ class Worker:
         self.session_name = hello["session"]
         self.session_dir = hello["session_dir"]
         self.store = make_store(self.session_name)
+        if self.role == "driver":
+            # Export the driver's import path so workers can unpickle
+            # functions defined in driver-side modules (the reference ships
+            # the working_dir / py_modules runtime env for this; same-host
+            # workers just need the path list).
+            import json
+            import sys
+
+            paths = [os.getcwd()] + [p for p in sys.path if p]
+            self.kv_put("driver_sys_path", json.dumps(paths).encode())
         return hello
 
     def _run_loop(self):
@@ -355,7 +365,7 @@ class Worker:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(0.001)
-        ready = ready[: max(num_returns, len(ready))]
+        ready = ready[:num_returns]
         not_ready = [r for r in refs if r not in ready]
         return ready, not_ready
 
@@ -515,7 +525,7 @@ class Worker:
                 # Re-resolve (the actor may be restarting) and try again.
                 await asyncio.sleep(0.05)
                 self._actor_conns.pop(actor_id, None)
-                await self._actor_call(actor_id, tid, method, args_blob,
+                await self._actor_call(actor_id, tid, method, msg_args,
                                        num_returns, opts, oids,
                                        retries - 1 if retries > 0 else retries)
                 return

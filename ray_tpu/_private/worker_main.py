@@ -74,12 +74,33 @@ class Executor:
 
     # ------------------------------------------------------------ functions
 
+    def _sync_driver_sys_path(self):
+        """Merge the driver's sys.path so by-reference pickles resolve.
+
+        Re-fetched on every function-cache miss (rare) rather than latched:
+        a new driver connecting to a long-lived cluster updates the key and
+        existing workers must pick up its module directories.
+        """
+        import json
+
+        blob = self.worker.kv_get("driver_sys_path")
+        if not blob:
+            return
+        try:
+            paths = json.loads(bytes(blob))
+        except Exception:
+            return
+        for p in paths:
+            if p not in sys.path:
+                sys.path.append(p)
+
     def _get_function(self, fid: str):
         fn = self.fn_cache.get(fid)
         if fn is None:
             blob = self.worker.kv_get(fid, ns="fn")
             if blob is None:
                 raise RuntimeError(f"function {fid} not found in GCS")
+            self._sync_driver_sys_path()
             fn = cloudpickle.loads(blob)
             self.fn_cache[fid] = fn
         return fn
@@ -330,6 +351,9 @@ async def amain(args):
 
 
 def main():
+    from .jax_platform import install_hook
+
+    install_hook()
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs", required=True)
     parser.add_argument("--node-id", required=True)

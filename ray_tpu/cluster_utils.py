@@ -66,8 +66,10 @@ class Cluster:
         node_id = NodeID.from_random()
         res = detect_node_resources(num_cpus=num_cpus, num_tpus=num_tpus,
                                     resources=resources)
+        from ._private.node import _AGENT_BOOTSTRAP, worker_sys_path
+
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.agent_entry",
+            [sys.executable, "-S", "-c", _AGENT_BOOTSTRAP,
              "--gcs", self.address,
              "--session-dir", self.head.session_dir,
              "--resources", json.dumps(res),
@@ -77,7 +79,8 @@ class Cluster:
             stdout=open(os.path.join(self.head.session_dir,
                                      f"agent-{node_id.hex()[:8]}.out"), "ab"),
             stderr=subprocess.STDOUT,
-            env={**os.environ, "RAY_TPU_NODE_ID": node_id.hex()},
+            env={**os.environ, "RAY_TPU_NODE_ID": node_id.hex(),
+                 "RAY_TPU_SYS_PATH": worker_sys_path()},
         )
         handle = NodeHandle(proc, node_id.hex(), res)
         self.worker_nodes.append(handle)

@@ -1,0 +1,16 @@
+from .llama import (
+    LLAMA3_1B,
+    LLAMA3_8B,
+    LLAMA_DEBUG,
+    LlamaConfig,
+    flops_per_token,
+    forward,
+    generate_greedy,
+    init_params,
+    loss_fn,
+)
+
+__all__ = [
+    "LlamaConfig", "LLAMA3_8B", "LLAMA3_1B", "LLAMA_DEBUG", "init_params",
+    "forward", "loss_fn", "generate_greedy", "flops_per_token",
+]

@@ -1,0 +1,14 @@
+from .attention import dense_attention, flash_attention, pallas_flash_reference
+from .layers import (
+    apply_rope,
+    cross_entropy_loss,
+    rms_norm,
+    rope_frequencies,
+    swiglu,
+)
+
+__all__ = [
+    "dense_attention", "flash_attention", "pallas_flash_reference",
+    "rms_norm", "rope_frequencies", "apply_rope", "swiglu",
+    "cross_entropy_loss",
+]

@@ -1,0 +1,28 @@
+from .mesh import (
+    AXES,
+    MeshSpec,
+    batch_sharding,
+    data_axes,
+    local_batch_size,
+    make_mesh,
+    mesh_spec_from_string,
+    replicated,
+)
+from .sharding import (
+    LLAMA_RULES,
+    apply_shardings,
+    constrain,
+    shardings_for_tree,
+    spec_for,
+)
+from . import collectives
+from .ring_attention import make_ring_attention, ring_attention
+from .ulysses import make_ulysses_attention, ulysses_attention
+
+__all__ = [
+    "AXES", "MeshSpec", "make_mesh", "mesh_spec_from_string",
+    "batch_sharding", "replicated", "data_axes", "local_batch_size",
+    "LLAMA_RULES", "spec_for", "shardings_for_tree", "apply_shardings",
+    "constrain", "collectives", "ring_attention", "make_ring_attention",
+    "ulysses_attention", "make_ulysses_attention",
+]

@@ -1,0 +1,126 @@
+"""Device mesh construction: the TPU-native replacement for NCCL groups.
+
+The reference's tensor plane is NCCL process groups bootstrapped by
+``ray.train.torch.config._setup_torch_process_group``
+(``python/ray/train/torch/config.py:66``) and cupy-NCCL communicators
+(``python/ray/util/collective/collective_group/nccl_collective_group.py``).
+On TPU that entire tier collapses into *mesh construction*: XLA compiles
+collectives directly into the program, routed over ICI. So the framework's
+"communicator bootstrap" is: pick axis sizes → ``jax.sharding.Mesh`` →
+annotate shardings → jit.
+
+Axes convention (superset of every strategy the stack uses):
+  ``dp``    pure data parallel (replicated params)
+  ``fsdp``  data parallel with sharded params/opt-state (ZeRO-3)
+  ``tp``    tensor parallel (megatron-style row/col sharding)
+  ``sp``    sequence/context parallel (ring attention)
+  ``ep``    expert parallel (MoE)
+  ``pp``    pipeline parallel
+Any axis of size 1 is free. Batch is sharded over (dp, fsdp, sp) — sp also
+splits the sequence dimension.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout; ``-1`` on one axis means "the rest"."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes {sizes} = {fixed} devices but {n_devices} present")
+        return MeshSpec(**sizes)
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.sizes().values())
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``Mesh`` with the canonical axis order.
+
+    Axis order matters for ICI locality: the innermost axes (``tp``, ``sp``)
+    get adjacent devices (same-host / same-ring neighbors on a slice), while
+    ``dp``/``pp`` span hosts where traffic is sparse (gradient reduction once
+    per step / microbatch boundaries). This mirrors how the scaling-book
+    recipe lays out meshes, and replaces the reference's per-group NCCL
+    topology tuning.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    spec = (spec or MeshSpec(dp=-1)).resolve(len(devices))
+    sizes = spec.sizes()
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def mesh_spec_from_string(s: str, n_devices: Optional[int] = None) -> MeshSpec:
+    """Parse "dp=2,tp=4" style strings (CLI/config-friendly)."""
+    sizes: Dict[str, int] = {}
+    if s:
+        for part in s.split(","):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in AXES:
+                raise ValueError(f"unknown mesh axis {k!r}; valid: {AXES}")
+            sizes[k] = int(v)
+    spec = MeshSpec(**sizes)
+    if n_devices is not None:
+        spec = spec.resolve(n_devices)
+    return spec
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input batch sharding: batch over data-like axes, seq over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp", "ep"), "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("dp", "fsdp", "ep") if mesh.shape[a] > 1)
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    n = math.prod(mesh.shape[a] for a in ("dp", "fsdp", "ep"))
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel degree {n}")
+    return global_batch // n

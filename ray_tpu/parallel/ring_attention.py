@@ -1,0 +1,121 @@
+"""Ring attention: exact attention over sequences sharded on the ``sp`` axis.
+
+Absent from the reference entirely (SURVEY.md §5 "Long-context /
+sequence parallelism: absent") — the reference only exposes NCCL p2p
+channels that external libraries could build this on. Here it is native:
+KV blocks rotate around the ``sp`` ring via ``ppermute`` while each device
+holds its Q shard, accumulating softmax online (flash-attention style
+running max/denominator), so attention over length L costs L/sp memory per
+device and the KV transfer overlaps compute on ICI.
+
+Use inside ``jax.shard_map`` with sequence dim sharded on ``sp``:
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis="sp", causal=True),
+        mesh=mesh,
+        in_specs=P(("dp","fsdp"), "sp", None, None), ...)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, scale):
+    """One q-block x kv-block attention with running-softmax stats.
+
+    Returns (unnormalized_out, row_max, row_sumexp). Shapes:
+      q: [B, Lq, H, D], k/v: [B, Lk, H, D]
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)  # [B, H, Lq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [B, H, Lq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None,
+                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Exact attention with KV rotating around the ``axis`` ring.
+
+    Args (per-device shards, inside shard_map):
+      q, k, v: [B, L_local, H, D]
+      causal: apply causal mask in *global* coordinates.
+    Returns: [B, L_local, H, D]
+    """
+    B, Lq, H, D = q.shape
+    if k.shape[2] != H:  # GQA: repeat KV heads to match Q heads
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    n = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    if scale is None:
+        scale = D ** -0.5
+
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, i):
+        o_acc, m_acc, l_acc, kv = carry
+        k_blk, v_blk = kv
+        src_idx = (my_idx - i) % n  # whose KV block we currently hold
+        bias = None
+        if causal:
+            # Global positions: q row r on this device = my_idx*Lq + r;
+            # kv col c in this block = src_idx*Lk + c.
+            Lk = k_blk.shape[1]
+            q_pos = my_idx * Lq + jnp.arange(Lq)
+            k_pos = src_idx * Lk + jnp.arange(Lk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, NEG_INF)[None, None]
+        o_blk, m_blk, l_blk = _block_attn(
+            q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            bias, scale)
+        # Online-softmax merge of (o_acc, m_acc, l_acc) with the new block.
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)  # rescale old accumulator
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_acc * alpha + l_blk * beta
+        o_new = (o_acc * alpha.transpose(0, 2, 1)[..., None]
+                 + o_blk * beta.transpose(0, 2, 1)[..., None])
+        # Rotate KV to the next ring position (overlaps with next compute).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_blk, axis, perm)
+        v_nxt = lax.ppermute(v_blk, axis, perm)
+        return (o_new, m_new, l_new, (k_nxt, v_nxt)), None
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    (o, m, l, _), _ = lax.scan(
+        step, (o0, m0, l0, (k, v)), jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, *, causal: bool = True, axis: str = "sp",
+                        batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
+    """shard_map-wrapped ring attention over a full mesh.
+
+    q/k/v are global arrays [B, L, H, D]; batch sharded over ``batch_axes``,
+    sequence over ``axis``, heads over ``head_axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axes, axis, head_axis, None)
+    fn = functools.partial(ring_attention, axis=axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)

@@ -1,0 +1,107 @@
+"""Parameter/activation sharding rules: DP / FSDP / TP as GSPMD specs.
+
+The reference delegates tensor/expert/pipeline parallelism to user libraries
+(SURVEY.md §2: "TP/PP/SP/EP do not exist as named subsystems"); here they are
+first-class. Rules map parameter-name patterns to ``PartitionSpec``s; XLA
+inserts the collectives (all-gather for FSDP params, reduce-scatter for
+grads, psum for TP activations) — the compiled analog of
+torch DDP/FSDP wrappers (``train/torch/config.py``,
+``rllib/core/learner/torch/torch_learner.py:29``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+# Transformer sharding rules, megatron convention:
+#   attn qkv:   (d_model, heads*head_dim)   -> col-parallel: shard axis 1 on tp
+#   attn out:   (heads*head_dim, d_model)   -> row-parallel: shard axis 0 on tp
+#   mlp up/gate:(d_model, d_ff)             -> col-parallel
+#   mlp down:   (d_ff, d_model)             -> row-parallel
+# fsdp shards the *other* big axis (ZeRO-3).
+LLAMA_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*embedding$", P("tp", "fsdp")),
+    (r".*(wq|wk|wv|w_qkv)$", P("fsdp", "tp")),
+    (r".*wo$", P("tp", "fsdp")),
+    (r".*(w_gate|w_up)$", P("fsdp", "tp")),
+    (r".*w_down$", P("tp", "fsdp")),
+    (r".*lm_head$", P("fsdp", "tp")),
+    (r".*(norm|scale|bias)$", P()),
+    (r".*", P()),
+)
+
+
+def spec_for(path: str, rules: Sequence[Tuple[str, P]] = LLAMA_RULES) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            return spec
+    return P()
+
+
+def _tree_paths(tree: PyTree) -> PyTree:
+    """Mirror tree with '/'-joined string paths at the leaves."""
+
+    def path_str(path) -> str:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        return "/".join(parts)
+
+    paths = []
+    jax.tree_util.tree_flatten_with_path(tree)  # validate
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [path_str(path) for path, _ in flat])
+
+
+def shardings_for_tree(tree: PyTree, mesh: Mesh,
+                       rules: Sequence[Tuple[str, P]] = LLAMA_RULES) -> PyTree:
+    """PartitionSpec tree for a parameter pytree by name patterns.
+
+    Specs referencing mesh axes of size 1 are harmless (XLA treats them as
+    unsharded), so one rule set serves every MeshSpec.
+    """
+    paths = _tree_paths(tree)
+
+    def leaf_sharding(path: str, leaf) -> NamedSharding:
+        spec = spec_for(path, rules)
+        # Drop sharded axes that don't divide the dimension.
+        dims = getattr(leaf, "shape", ())
+        cleaned = []
+        for i, axis in enumerate(spec):
+            if axis is None or i >= len(dims):
+                cleaned.append(None)
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            cleaned.append(axis if dims[i] % size == 0 else None)
+        while cleaned and cleaned[-1] is None:
+            cleaned.pop()
+        return NamedSharding(mesh, P(*cleaned))
+
+    return jax.tree.map(leaf_sharding, paths, tree)
+
+
+def apply_shardings(tree: PyTree, shardings: PyTree) -> PyTree:
+    """Device-put a host pytree onto its shardings (initial placement)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+def constrain(tree: PyTree, shardings: PyTree) -> PyTree:
+    """In-jit sharding constraints (GSPMD hints)."""
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, s), tree, shardings)

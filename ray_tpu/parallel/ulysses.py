@@ -1,0 +1,66 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism via all-to-all.
+
+Absent from the reference (SURVEY.md §5). Complements ring attention: where
+ring keeps heads local and rotates KV, Ulysses all-to-alls activations so
+each device holds *all* tokens for a slice of heads, runs dense attention
+locally, then transposes back. Cheaper than ring when H >= sp and sequences
+are moderate; ring wins at extreme lengths. Both ride the same ``sp`` axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _seq_to_heads(x: jax.Array, axis: str) -> jax.Array:
+    """[B, L/n, H, D] -> [B, L, H/n, D] over the sp ring."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _heads_to_seq(x: jax.Array, axis: str) -> jax.Array:
+    """[B, L, H/n, D] -> [B, L/n, H, D]."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-all.
+
+    Per-device shards inside shard_map: q/k/v [B, L_local, H, D] with H
+    divisible by the sp degree. ``attn_fn(q, k, v, causal, scale)`` runs the
+    local dense attention (defaults to a flash-style jax implementation).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if k.shape[2] != q.shape[2]:  # GQA: repeat KV heads to match Q heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qh = _seq_to_heads(q, axis)
+    kh = _seq_to_heads(k, axis)
+    vh = _seq_to_heads(v, axis)
+    if attn_fn is None:
+        from ..ops.attention import dense_attention
+
+        out = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return _heads_to_seq(out, axis)
+
+
+def make_ulysses_attention(mesh, *, causal: bool = True, axis: str = "sp",
+                           batch_axes=("dp", "fsdp")):
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axes, axis, None, None)
+    fn = functools.partial(ulysses_attention, axis=axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)

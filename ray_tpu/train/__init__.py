@@ -1,0 +1,15 @@
+from .checkpoint import Checkpoint, load_pytree, save_pytree
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from .trainer import JaxTrainer, Result
+
+__all__ = [
+    "JaxTrainer", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
+    "FailureConfig", "CheckpointConfig", "report", "get_context",
+    "get_checkpoint", "get_dataset_shard", "save_pytree", "load_pytree",
+]

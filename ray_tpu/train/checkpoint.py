@@ -1,0 +1,108 @@
+"""Directory-based checkpoints + pytree (de)serialization.
+
+Analog of the reference's ``ray.train.Checkpoint``
+(``python/ray/train/_checkpoint.py``): a handle to a directory of files.
+Pytree helpers use orbax when available (async-capable, sharding-aware — the
+right tool for sharded TPU params) with a numpy/pickle fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def get_metadata(self) -> Dict[str, Any]:
+        meta = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, metadata: Dict[str, Any]):
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def _has_orbax() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def save_pytree(tree: Any, path: str, *, use_orbax: Optional[bool] = None):
+    """Save a (possibly sharded) jax pytree under ``path``."""
+    os.makedirs(path, exist_ok=True)
+    if use_orbax is None:
+        use_orbax = _has_orbax()
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        target = os.path.join(os.path.abspath(path), "state")
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        ckptr.save(target, tree)
+        ckptr.wait_until_finished()
+    else:
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            pickle.dump({"leaves": [jax.device_get(x) for x in leaves],
+                         "treedef": treedef}, f)
+
+
+def load_pytree(path: str, target: Any = None) -> Any:
+    """Load a pytree; with ``target`` (an abstract or concrete pytree with
+    shardings) orbax restores directly onto devices (resharded restore)."""
+    orbax_dir = os.path.join(path, "state")
+    if os.path.isdir(orbax_dir) and _has_orbax():
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        if target is not None:
+            import jax
+
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None)), target)
+            return ckptr.restore(os.path.abspath(orbax_dir), abstract)
+        return ckptr.restore(os.path.abspath(orbax_dir))
+    with open(os.path.join(path, "state.pkl"), "rb") as f:
+        data = pickle.load(f)
+    import jax
+
+    return jax.tree.unflatten(data["treedef"], data["leaves"])

@@ -1,0 +1,64 @@
+"""Train/AIR config dataclasses.
+
+Analogs of the reference's ``python/ray/air/config.py`` (``ScalingConfig``,
+``RunConfig``, ``FailureConfig``, ``CheckpointConfig``) with TPU-native
+fields: workers are *hosts* (one process per TPU host, jax multi-controller
+style), and ``topology`` requests a slice shape instead of GPU counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers (host processes) and what each needs.
+
+    ``num_workers`` mirrors the reference's field
+    (``air/config.py`` ScalingConfig); ``use_tpu`` replaces ``use_gpu``;
+    ``chips_per_worker`` is the per-host TPU chip count (4 for v5e hosts,
+    4 for v5p).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5p-64" — slice gang request
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res["TPU"] = float(self.chips_per_worker or 1)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: -1 = infinite retries (reference: air/config.py)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+
+    def resolved_storage_path(self) -> str:
+        return os.path.expanduser(
+            self.storage_path or "~/ray_tpu_results")

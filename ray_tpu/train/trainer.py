@@ -1,0 +1,184 @@
+"""JaxTrainer: data-parallel training orchestration on TPU worker groups.
+
+The reference's ``TorchTrainer`` path (SURVEY.md §3.4: ``BaseTrainer.fit``
+→ Tune trial → ``BackendExecutor`` → ``WorkerGroup`` of actors → NCCL
+process group → train loop with ``ray.train.report``) re-designed TPU-first:
+the NCCL bootstrap becomes jax.distributed + mesh construction, gradient
+all-reduce is compiled into the step function by GSPMD, and checkpoints are
+orbax pytrees. ``fit()`` drives the group, streams results, and restarts
+from the latest checkpoint on worker failure (``FailureConfig``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+from .checkpoint import Checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[Exception] = None
+    metrics_dataframe: Any = None
+
+    @property
+    def best_checkpoints(self) -> List[Checkpoint]:
+        if not os.path.isdir(self.path):
+            return []
+        out = []
+        for d in sorted(os.listdir(self.path)):
+            if d.startswith("checkpoint_"):
+                out.append(Checkpoint(os.path.join(self.path, d)))
+        return out
+
+
+@ray_tpu.remote
+class _ResultCollector:
+    """Aggregates per-worker reports (the reference's results queue →
+    ``TrainingIterator``, ``train/trainer.py:36``)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.history: List[dict] = []
+        self.latest_checkpoint: Optional[str] = None
+        self._pending: Dict[int, dict] = {}
+
+    def push(self, rank: int, metrics: dict, checkpoint_path):
+        if checkpoint_path:
+            self.latest_checkpoint = checkpoint_path
+        self._pending[rank] = metrics
+        if rank == 0:
+            self.history.append(metrics)
+        return True
+
+    def state(self):
+        return {"history": list(self.history),
+                "latest_checkpoint": self.latest_checkpoint}
+
+
+class JaxTrainer:
+    """Run ``train_loop_per_worker`` on a gang of TPU host workers.
+
+    Example::
+
+        def train_loop(config):
+            mesh = ray_tpu.train.get_context().get_mesh()
+            ...
+            ray_tpu.train.report({"loss": loss}, checkpoint=ckpt)
+
+        trainer = JaxTrainer(
+            train_loop,
+            scaling_config=ScalingConfig(num_workers=4, use_tpu=True,
+                                         chips_per_worker=4),
+        )
+        result = trainer.fit()
+    """
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        run_name = self.run_config.name or f"JaxTrainer_{uuid.uuid4().hex[:8]}"
+        storage = self.run_config.resolved_storage_path()
+        run_path = os.path.join(storage, run_name)
+        os.makedirs(run_path, exist_ok=True)
+        failure_cfg = self.run_config.failure_config or FailureConfig()
+        max_failures = failure_cfg.max_failures
+        restore_path = (self.resume_from_checkpoint.path
+                        if self.resume_from_checkpoint else None)
+        attempt = 0
+        while True:
+            result = self._run_attempt(run_name, storage, restore_path)
+            if result.error is None:
+                return result
+            attempt += 1
+            if max_failures >= 0 and attempt > max_failures:
+                return result
+            # Restart from the latest persisted checkpoint (reference:
+            # ``TuneController._schedule_trial_restore`` tune_controller.py:1791)
+            if result.checkpoint is not None:
+                restore_path = result.checkpoint.path
+
+    def _run_attempt(self, run_name: str, storage: str,
+                     restore_path: Optional[str]) -> Result:
+        sc = self.scaling_config
+        collector = _ResultCollector.remote(sc.num_workers)
+        group = WorkerGroup(sc.num_workers, sc.worker_resources(),
+                            sc.placement_strategy)
+        run_path = os.path.join(storage, run_name)
+        try:
+            fn_blob = cloudpickle.dumps(self.train_loop)
+            # Pre-split datasets into per-worker shards
+            shard_refs: List[Dict[str, Any]] = [
+                {} for _ in range(sc.num_workers)]
+            for name, ds in self.datasets.items():
+                if hasattr(ds, "streaming_split"):
+                    shards = ds.streaming_split(sc.num_workers)
+                    for i, sh in enumerate(shards):
+                        shard_refs[i][name] = sh
+                else:
+                    for i in range(sc.num_workers):
+                        shard_refs[i][name] = ds
+            futs = []
+            for rank, w in enumerate(group.workers):
+                session_kwargs = dict(
+                    world_rank=rank, world_size=sc.num_workers,
+                    local_rank=0, run_name=run_name, storage_path=storage,
+                    restore_path=restore_path)
+                futs.append(w.run.remote(fn_blob, self.train_loop_config,
+                                         session_kwargs, collector,
+                                         shard_refs[rank]))
+            outs = ray_tpu.get(futs)
+            state = ray_tpu.get(collector.state.remote())
+            err: Optional[Exception] = None
+            for rank, o in enumerate(outs):
+                if not o.get("ok"):
+                    err = RuntimeError(
+                        f"worker {rank} failed:\n{o.get('tb')}")
+                    break
+            metrics = state["history"][-1] if state["history"] else None
+            ckpt = (Checkpoint(state["latest_checkpoint"])
+                    if state["latest_checkpoint"] else None)
+            return Result(metrics=metrics, checkpoint=ckpt, path=run_path,
+                          error=err)
+        except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError,
+                ConnectionError) as e:
+            try:
+                state = ray_tpu.get(collector.state.remote())
+            except Exception:
+                state = {"history": [], "latest_checkpoint": None}
+            ckpt = (Checkpoint(state["latest_checkpoint"])
+                    if state["latest_checkpoint"] else None)
+            return Result(metrics=None, checkpoint=ckpt, path=run_path,
+                          error=e)
+        finally:
+            group.shutdown()
+            try:
+                ray_tpu.kill(collector)
+            except Exception:
+                pass

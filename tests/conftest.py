@@ -2,18 +2,27 @@
 
 Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver's dryrun validates the same way).
+The tunnel PJRT plugin in this environment force-sets ``JAX_PLATFORMS=axon``,
+so the env var alone is not enough — ``jax.config.update`` must run after
+import (``ray_tpu._private.jax_platform``); worker subprocesses get the same
+via the ``RAY_TPU_JAX_PLATFORM`` post-import hook.
+
 Mirrors the reference's in-process multi-node testing stance
 (``python/ray/cluster_utils.py:135``): tests never need real clusters.
 """
 
 import os
 
-# Must be set before jax imports anywhere in the test process tree.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes a backend anywhere in the test tree.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["RAY_TPU_JAX_PLATFORM"] = "cpu"  # workers inherit this
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -28,10 +37,8 @@ def ray_cluster():
     ray_tpu.shutdown()
 
 
-@pytest.fixture()
+@pytest.fixture(scope="session")
 def cpu_mesh8():
-    import jax
-
     devices = jax.devices("cpu")
     assert len(devices) >= 8, "conftest must provide 8 virtual CPU devices"
     return devices[:8]

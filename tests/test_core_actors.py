@@ -113,6 +113,10 @@ def test_kill_actor(ray_cluster):
 def test_actor_restart(ray_cluster):
     ray_tpu = ray_cluster
 
+    import tempfile
+
+    marker = tempfile.mktemp()
+
     @ray_tpu.remote(max_restarts=2, max_task_retries=3)
     class Phoenix:
         def __init__(self):
@@ -123,17 +127,22 @@ def test_actor_restart(ray_cluster):
 
             return os.getpid()
 
-        def die(self):
+        def die(self, marker):
+            # One-shot: with max_task_retries the die call itself is
+            # retried after restart (reference semantics), so guard it.
             import os
 
-            os._exit(1)
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                os._exit(1)
+            return "already died once"
 
         def ping(self):
             return "alive"
 
     p = Phoenix.options(max_restarts=2, max_task_retries=3).remote()
     pid1 = ray_tpu.get(p.pid.remote())
-    p.die.remote()
+    p.die.remote(marker)
     time.sleep(1.0)
     # Restarted actor serves again (possibly after retry)
     assert ray_tpu.get(p.ping.remote()) == "alive"

@@ -1,0 +1,114 @@
+"""Llama model tests: shapes, learning, decode, and sharded training step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import (
+    LLAMA_DEBUG,
+    LlamaConfig,
+    forward,
+    generate_greedy,
+    init_params,
+    loss_fn,
+)
+from ray_tpu.parallel import (
+    MeshSpec,
+    apply_shardings,
+    batch_sharding,
+    make_mesh,
+    shardings_for_tree,
+)
+
+
+def test_forward_shape():
+    cfg = LLAMA_DEBUG
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert jnp.isfinite(logits).all()
+
+
+def test_param_count_formula():
+    cfg = LLAMA_DEBUG
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.param_count()
+
+
+def test_loss_decreases():
+    cfg = LLAMA_DEBUG
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_generate():
+    cfg = LLAMA_DEBUG
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    out = generate_greedy(params, prompt, cfg, max_new=8)
+    assert out.shape == (2, 8)
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+
+def test_generate_matches_forward():
+    """First generated token == argmax of forward logits (KV-cache check)."""
+    cfg = LLAMA_DEBUG
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0,
+                                cfg.vocab_size)
+    logits = forward(params, prompt, cfg, remat=False)
+    expected_first = jnp.argmax(logits[:, -1], axis=-1)
+    out = generate_greedy(params, prompt, cfg, max_new=4)
+    assert int(out[0, 0]) == int(expected_first[0])
+
+
+@pytest.mark.parametrize("spec", [MeshSpec(fsdp=4, tp=2),
+                                  MeshSpec(dp=2, fsdp=2, tp=2)])
+def test_sharded_train_step(cpu_mesh8, spec):
+    """Full fsdp+tp sharded train step on the 8-device CPU mesh."""
+    cfg = LLAMA_DEBUG
+    mesh = make_mesh(spec, devices=cpu_mesh8)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    shardings = shardings_for_tree(params, mesh)
+    params = apply_shardings(params, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                cfg.vocab_size)
+    tokens = jax.device_put(tokens, batch_sharding(mesh))
+    opt = optax.sgd(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, {"tokens": tokens}, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params2, opt_state, loss = step(params, opt_state, tokens)
+    assert jnp.isfinite(loss)
+    # Params keep their shardings through the step.
+    wq = params2["layers"][0]["wq"]
+    assert wq.sharding.spec == shardings["layers"][0]["wq"].spec

@@ -1,0 +1,150 @@
+"""JaxTrainer tests (model: reference ``python/ray/train/tests``)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def storage(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("train_storage"))
+
+
+def _simple_loop(config):
+    """Linear-model train loop with cross-worker gradient allreduce."""
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu.train as train
+    from ray_tpu.parallel.collectives import HostCollectiveGroup
+    from ray_tpu.train.checkpoint import save_pytree
+
+    ctx = train.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    group = HostCollectiveGroup(config["group"], world, rank)
+
+    rng = np.random.RandomState(rank)
+    x = rng.rand(64, 4).astype(np.float32)
+    true_w = np.arange(4, dtype=np.float32)
+    y = x @ true_w
+    w = jnp.zeros(4)
+
+    @jax.jit
+    def grad_fn(w, x, y):
+        return jax.grad(lambda w: jnp.mean((x @ w - y) ** 2))(w)
+
+    for step in range(config["steps"]):
+        g = grad_fn(w, x, y)
+        g = jnp.asarray(group.allreduce(np.asarray(g), op="mean"))
+        w = w - 0.5 * g
+        loss = float(np.mean((x @ np.asarray(w) - y) ** 2))
+        ckpt = None
+        if rank == 0:
+            d = tempfile.mkdtemp()
+            save_pytree({"w": w}, d)
+            ckpt = Checkpoint.from_directory(d)
+        train.report({"loss": loss, "step": step}, checkpoint=ckpt)
+
+
+def test_jax_trainer_2_workers(ray_cluster, storage):
+    trainer = JaxTrainer(
+        _simple_loop,
+        train_loop_config={"steps": 30, "group": "t2w"},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="basic", storage_path=storage),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["loss"] < 0.5
+    assert result.checkpoint is not None
+    from ray_tpu.train.checkpoint import load_pytree
+
+    state = load_pytree(result.checkpoint.path)
+    assert np.allclose(np.asarray(state["w"]), np.arange(4), atol=0.5)
+
+
+def test_trainer_reports_all_steps(ray_cluster, storage):
+    def loop(config):
+        import ray_tpu.train as train
+
+        for i in range(3):
+            train.report({"i": i})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="steps", storage_path=storage))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics == {"i": 2}
+
+
+def test_trainer_error_propagates(ray_cluster, storage):
+    def loop(config):
+        raise ValueError("train loop exploded")
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="err", storage_path=storage))
+    result = trainer.fit()
+    assert result.error is not None
+    assert "train loop exploded" in str(result.error)
+
+
+def test_trainer_failure_restart(ray_cluster, storage):
+    """Worker crashes once; FailureConfig restarts from checkpoint."""
+    marker = os.path.join(tempfile.mkdtemp(), "crashed")
+
+    def loop(config):
+        import os as _os
+
+        import ray_tpu.train as train
+        from ray_tpu.train import Checkpoint
+        from ray_tpu.train.checkpoint import load_pytree, save_pytree
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = load_pytree(ckpt.path)["step"] + 1
+        for step in range(start, 4):
+            d = tempfile.mkdtemp()
+            save_pytree({"step": step}, d)
+            train.report({"step": step}, Checkpoint.from_directory(d))
+            if step == 1 and not _os.path.exists(config["marker"]):
+                open(config["marker"], "w").write("x")
+                _os._exit(1)
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="restart", storage_path=storage,
+                             failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 3
+
+
+def test_trainer_dataset_shards(ray_cluster, storage):
+    def loop(config):
+        import ray_tpu.train as train
+
+        shard = train.get_dataset_shard("train")
+        train.report({"n": len(shard)})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ds", storage_path=storage),
+        datasets={"train": [1, 2, 3, 4]})
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["n"] == 4  # plain lists are broadcast
