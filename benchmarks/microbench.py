@@ -1,0 +1,152 @@
+"""Control-plane microbenchmarks, mirroring the reference's harness
+(``python/ray/_private/ray_perf.py:93`` → ``release/perf_metrics/
+microbenchmark.json``) so numbers are comparable to BASELINE.md.
+
+Run: ``python benchmarks/microbench.py [--quick]``
+Prints one JSON object with metric -> ops/s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+
+
+def timeit(name, fn, number: int, results: dict):
+    t0 = time.perf_counter()
+    fn(number)
+    dt = time.perf_counter() - t0
+    results[name] = round(number / dt, 1)
+    print(f"{name}: {number / dt:.1f} /s", flush=True)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    scale = 0.2 if args.quick else 1.0
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    results: dict = {}
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    # warmup: spin workers
+    ray_tpu.get([tiny.remote() for _ in range(20)])
+
+    def tasks_sync(n):
+        for _ in range(n):
+            ray_tpu.get(tiny.remote())
+
+    timeit("single_client_tasks_sync", tasks_sync, int(200 * scale), results)
+
+    def tasks_async(n):
+        ray_tpu.get([tiny.remote() for _ in range(n)])
+
+    timeit("single_client_tasks_async", tasks_async, int(2000 * scale),
+           results)
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return b"ok"
+
+        def with_arg(self, arr):
+            return arr.nbytes
+
+    a = Actor.remote()
+    ray_tpu.get(a.ping.remote())
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(a.ping.remote())
+
+    timeit("1_1_actor_calls_sync", actor_sync, int(500 * scale), results)
+
+    def actor_async(n):
+        ray_tpu.get([a.ping.remote() for _ in range(n)])
+
+    timeit("1_1_actor_calls_async", actor_async, int(5000 * scale), results)
+
+    actors = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([x.ping.remote() for x in actors])
+
+    def nn_actor_async(n):
+        refs = []
+        for i in range(n):
+            refs.append(actors[i % 4].ping.remote())
+        ray_tpu.get(refs)
+
+    timeit("n_n_actor_calls_async", nn_actor_async, int(5000 * scale),
+           results)
+
+    arr = np.zeros(100 * 1024, dtype=np.uint8)  # 100KB arg
+
+    def nn_actor_arg(n):
+        refs = []
+        for i in range(n):
+            refs.append(actors[i % 4].with_arg.remote(arr))
+        ray_tpu.get(refs)
+
+    timeit("n_n_actor_calls_with_arg_async", nn_actor_arg, int(1000 * scale),
+           results)
+
+    small = {"k": 1}
+
+    def put_small(n):
+        for _ in range(n):
+            ray_tpu.put(small)
+
+    timeit("single_client_put_calls", put_small, int(1000 * scale), results)
+
+    val_ref = ray_tpu.put(np.arange(100))
+
+    def get_small(n):
+        for _ in range(n):
+            ray_tpu.get(val_ref)
+
+    timeit("single_client_get_calls", get_small, int(2000 * scale), results)
+
+    big = np.zeros((1024, 1024, 16), dtype=np.float32)  # 64 MiB
+
+    def put_gb(n):
+        for _ in range(n):
+            ray_tpu.put(big)
+
+    n_big = max(int(8 * scale), 2)
+    t0 = time.perf_counter()
+    put_gb(n_big)
+    dt = time.perf_counter() - t0
+    results["single_client_put_gigabytes"] = round(
+        big.nbytes * n_big / dt / 1e9, 2)
+    print(f"single_client_put_gigabytes: "
+          f"{results['single_client_put_gigabytes']} GB/s", flush=True)
+
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    def pg_cycle(n):
+        for _ in range(n):
+            pg = placement_group([{"CPU": 0.01}])
+            pg.wait(10)
+            remove_placement_group(pg)
+
+    timeit("placement_group_create/removal", pg_cycle, int(100 * scale),
+           results)
+
+    print(json.dumps(results))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -543,39 +543,60 @@ class GcsServer:
             _res_add(node.avail, worker.acquired)
         worker.acquired = {}
 
+    @staticmethod
+    def _sched_signature(record: TaskRecord) -> tuple:
+        """Scheduling class: tasks with identical placement needs
+        (reference: scheduling classes in ``NormalTaskSubmitter``). Once one
+        task of a class fails to place in a pass, the rest are skipped —
+        this keeps a scheduling pass O(dispatched + distinct classes)
+        instead of O(queue length)."""
+        res = tuple(sorted(record.resources.items()))
+        strategy = record.strategy
+        if isinstance(strategy, dict):
+            strategy = tuple(sorted(strategy.items()))
+        return (res, record.pg, record.bundle, strategy)
+
     def _schedule(self):
         deficit: Dict[NodeID, int] = {}
-        made_progress = True
-        while made_progress and self.pending:
-            made_progress = False
-            requeue = []
-            while self.pending:
-                tid = self.pending.popleft()
-                record = self.tasks.get(tid)
-                if record is None or record.cancelled:
-                    if record is not None:
-                        self._finish_cancelled(record)
-                    continue
-                node = self._pick_node(record)
-                if node is None:
-                    requeue.append(tid)
-                    continue
-                worker = self._grab_idle_worker(node)
-                if worker is None:
-                    deficit[node.node_id] = deficit.get(node.node_id, 0) + 1
-                    requeue.append(tid)
-                    continue
-                worker.state = W_BUSY
-                worker.current_task = tid
-                worker.acquired = self._acquire(node, record)
-                record.state = "running"
-                record.worker_id = worker.worker_id
-                fwd = dict(record.msg)
-                fwd["t"] = "exec"
-                fwd.pop("i", None)
-                worker.conn.send(fwd)
-                made_progress = True
-            self.pending.extend(requeue)
+        blocked: Dict[tuple, int] = {}
+        worker_blocked: Dict[tuple, NodeID] = {}
+        requeue = []
+        while self.pending:
+            tid = self.pending.popleft()
+            record = self.tasks.get(tid)
+            if record is None or record.cancelled:
+                if record is not None:
+                    self._finish_cancelled(record)
+                continue
+            sig = self._sched_signature(record)
+            if sig in blocked:
+                blocked[sig] += 1
+                requeue.append(tid)
+                continue
+            node = self._pick_node(record)
+            if node is None:
+                blocked[sig] = 1
+                requeue.append(tid)
+                continue
+            worker = self._grab_idle_worker(node)
+            if worker is None:
+                blocked[sig] = 1
+                worker_blocked[sig] = node.node_id
+                requeue.append(tid)
+                continue
+            worker.state = W_BUSY
+            worker.current_task = tid
+            worker.acquired = self._acquire(node, record)
+            record.state = "running"
+            record.worker_id = worker.worker_id
+            fwd = dict(record.msg)
+            fwd["t"] = "exec"
+            fwd.pop("i", None)
+            worker.conn.send(fwd)
+        # FIFO order preserved for the skipped tasks.
+        self.pending.extend(requeue)
+        for sig, node_id in worker_blocked.items():
+            deficit[node_id] = deficit.get(node_id, 0) + blocked.get(sig, 1)
         for node_id, d in deficit.items():
             node = self.nodes.get(node_id)
             if node is not None:

@@ -260,6 +260,11 @@ async def head_amain(args):
     finally:
         agent.stopped.set()
         agent.shutdown_workers()
+        if hasattr(gcs.store, "unlink"):
+            try:
+                gcs.store.unlink()
+            except Exception:
+                pass
 
 
 def head_main():
@@ -347,12 +352,17 @@ class HeadNode:
                     os.killpg(self.proc.pid, signal.SIGKILL)
                 except ProcessLookupError:
                     pass
-        # Best-effort cleanup of leaked shm segments for this session.
+        # Best-effort cleanup of leaked shm segments for this session:
+        # per-object segments (PyShmStore) and the native arena.
+        import hashlib
+
         session = os.path.basename(self.session_dir)
+        tag = hashlib.sha1(session.encode()).hexdigest()[:16]
         shm_dir = "/dev/shm"
         try:
             for name in os.listdir(shm_dir):
-                if session[-8:] in name and name.startswith("rtpu"):
+                if name.startswith("rtpu") and (session[-8:] in name
+                                                or tag in name):
                     try:
                         os.unlink(os.path.join(shm_dir, name))
                     except OSError:

@@ -1,0 +1,166 @@
+"""ctypes binding for the C++ arena object store (``native/shm_store.cc``).
+
+Compiles the shared library on first use (g++ is part of the baked image;
+pybind11 is not, hence the plain C ABI + ctypes). The compiled .so is cached
+next to the source keyed by content hash, so rebuilds happen only when the
+C++ changes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import mmap
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from .ids import ObjectID
+from .object_store import PlasmaObjectView
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_lib = None
+_lib_lock = threading.Lock()
+
+DEFAULT_CAPACITY = 4 * 1024**3  # sparse mapping; pages commit on write
+
+
+def _build_lib() -> str:
+    src = os.path.join(_NATIVE_DIR, "shm_store.cc")
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    cache_dir = os.environ.get("RAY_TPU_NATIVE_CACHE",
+                               os.path.join(_NATIVE_DIR, "_build"))
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, f"libshm_store_{digest}.so")
+    if not os.path.exists(out):
+        tmp = out + f".tmp{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp,
+             "-lpthread"],
+            check=True, capture_output=True)
+        os.replace(tmp, out)
+    return out
+
+
+def get_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build_lib())
+            lib.rtpu_store_open.restype = ctypes.c_void_p
+            lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                            ctypes.c_int]
+            lib.rtpu_store_create.restype = ctypes.c_uint64
+            lib.rtpu_store_create.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint64]
+            lib.rtpu_store_seal.restype = ctypes.c_int
+            lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rtpu_store_lookup.restype = ctypes.c_int
+            lib.rtpu_store_lookup.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.rtpu_store_delete.restype = ctypes.c_int
+            lib.rtpu_store_delete.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p]
+            lib.rtpu_store_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.rtpu_store_total_size.restype = ctypes.c_uint64
+            lib.rtpu_store_total_size.argtypes = [ctypes.c_void_p]
+            lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
+            lib.rtpu_store_unlink.argtypes = [ctypes.c_char_p]
+            _lib = lib
+        return _lib
+
+
+class NativeStore:
+    """Arena-backed store client; same interface as ``PyShmStore``."""
+
+    def __init__(self, session_name: str, capacity: int = 0):
+        self.lib = get_lib()
+        # shm name limit: keep it short and unique per session.
+        tag = hashlib.sha1(session_name.encode()).hexdigest()[:16]
+        self._name = f"/rtpu_{tag}".encode()
+        cap = capacity or DEFAULT_CAPACITY
+        self.handle = self.lib.rtpu_store_open(self._name, cap, 1)
+        if not self.handle:
+            raise OSError("failed to open native shm store")
+        total = self.lib.rtpu_store_total_size(self.handle)
+        # Python-side mmap of the same segment for zero-copy memoryviews
+        # (ctypes pointers can't produce safe releasable buffers).
+        fd = os.open(f"/dev/shm{self._name.decode()}", os.O_RDWR)
+        try:
+            self._mmap = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mmap)
+
+    @staticmethod
+    def _key(object_id: ObjectID) -> bytes:
+        return object_id.binary()
+
+    def create(self, object_id: ObjectID, nbytes: int) -> memoryview:
+        nbytes = max(nbytes, 1)
+        off = self.lib.rtpu_store_create(self.handle, self._key(object_id),
+                                         nbytes)
+        if off == 0:
+            raise MemoryError(
+                f"native store out of memory allocating {nbytes} bytes")
+        return self._view[off:off + nbytes]
+
+    def seal(self, object_id: ObjectID):
+        self.lib.rtpu_store_seal(self.handle, self._key(object_id))
+
+    def abort(self, object_id: ObjectID):
+        self.lib.rtpu_store_delete(self.handle, self._key(object_id))
+
+    def get(self, object_id: ObjectID, nbytes: int) -> Optional[PlasmaObjectView]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self.lib.rtpu_store_lookup(self.handle, self._key(object_id),
+                                        ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        n = int(size.value)
+        return PlasmaObjectView(self._view[off.value:off.value + n], None)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        return self.lib.rtpu_store_lookup(
+            self.handle, self._key(object_id),
+            ctypes.byref(off), ctypes.byref(size)) == 0
+
+    def delete(self, object_id: ObjectID):
+        self.lib.rtpu_store_delete(self.handle, self._key(object_id))
+
+    def stats(self) -> Dict[str, int]:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        self.lib.rtpu_store_stats(self.handle, ctypes.byref(used),
+                                  ctypes.byref(cap), ctypes.byref(num))
+        return {"bytes_in_use": used.value, "capacity": cap.value,
+                "num_objects": num.value}
+
+    def close(self):
+        try:
+            self._view.release()
+        except BufferError:
+            pass
+        try:
+            self._mmap.close()
+        except (BufferError, ValueError):
+            pass
+        if self.handle:
+            self.lib.rtpu_store_close(self.handle)
+            self.handle = None
+
+    def unlink(self):
+        self.lib.rtpu_store_unlink(self._name)

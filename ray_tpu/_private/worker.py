@@ -101,7 +101,8 @@ class ObjectRef:
 
     async def _await_impl(self):
         fut = self.future()
-        return await asyncio.wrap_future(fut)
+        where, payload = await asyncio.wrap_future(fut)
+        return self._worker._resolve_value(self.id, where, payload)
 
 
 def _deserialize_object_ref(id_bytes: bytes) -> ObjectRef:
@@ -340,15 +341,21 @@ class Worker:
     def put_serialized(self, sobj: serialization.SerializedObject,
                        oid: Optional[ObjectID] = None,
                        register: bool = True) -> ObjectID:
-        """Write an already-serialized object into the store (worker side)."""
+        """Write an already-serialized object into the store.
+
+        Safe from any thread: shm create/seal are plain syscalls and the GCS
+        registration is marshalled onto the IO loop (asyncio transports are
+        not thread-safe).
+        """
         if oid is None:
             oid = ObjectID.for_put(self._put_counter.next())
         buf = self.store.create(oid, sobj.total_size)
         sobj.write_into(buf)
         self.store.seal(oid)
         if register:
-            self.gcs.send({"t": "obj_put", "oid": oid.binary(),
-                           "nbytes": sobj.total_size, "shm": True})
+            self.loop.call_soon_threadsafe(self._send_gcs, {
+                "t": "obj_put", "oid": oid.binary(),
+                "nbytes": sobj.total_size, "shm": True})
         return oid
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,

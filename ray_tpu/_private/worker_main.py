@@ -348,6 +348,16 @@ async def amain(args):
         os.unlink(listen_path)
     except OSError:
         pass
+    await asyncio.sleep(0.01)  # let final frames flush
+    # Hard exit: ``ray.kill`` semantics are immediate termination — don't
+    # wait for executor threads still running user code. Flush stdio first
+    # so buffered task prints reach the worker log.
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    os._exit(0)
 
 
 def main():

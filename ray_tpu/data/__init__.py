@@ -1,0 +1,22 @@
+from .block import BlockAccessor, to_block
+from .dataset import Dataset, MaterializedDataset
+from .iterator import DataIterator
+from .read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Dataset", "MaterializedDataset", "DataIterator", "BlockAccessor",
+    "to_block", "from_items", "from_numpy", "from_pandas", "from_arrow",
+    "range", "read_parquet", "read_csv", "read_json", "read_text",
+    "read_numpy",
+]

@@ -1,0 +1,113 @@
+"""Blocks: the unit of data movement (reference: ``python/ray/data/block.py``).
+
+A block is a pyarrow Table living in the shared-memory object store; the
+``BlockAccessor`` normalizes between arrow / pandas / numpy-dict batch
+formats. Arrow's columnar layout maps straight onto the zero-copy plasma
+path: a worker writing a block and a TPU host reading it share pages, and
+``to_numpy`` slices feed ``jax.device_put`` without copies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+Batch = Union["pa.Table", Dict[str, np.ndarray], "pd.DataFrame", List[dict]]
+
+
+def _is_pandas(x) -> bool:
+    try:
+        import pandas as pd
+
+        return isinstance(x, pd.DataFrame)
+    except ImportError:
+        return False
+
+
+def to_block(data: Batch) -> "pa.Table":
+    """Normalize any batch format into an arrow Table block."""
+    if pa is not None and isinstance(data, pa.Table):
+        return data
+    if _is_pandas(data):
+        return pa.Table.from_pandas(data, preserve_index=False)
+    if isinstance(data, dict):
+        cols = {}
+        for k, v in data.items():
+            v = np.asarray(v)
+            if v.ndim > 1:
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(v.reshape(-1)), v.shape[-1]) \
+                    if v.ndim == 2 else pa.array(list(v))
+            else:
+                cols[k] = pa.array(v)
+        return pa.table(cols)
+    if isinstance(data, list):
+        if data and isinstance(data[0], dict):
+            return pa.Table.from_pylist(data)
+        return pa.table({"item": pa.array(data)})
+    if isinstance(data, np.ndarray):
+        return to_block({"data": data})
+    raise TypeError(f"cannot convert {type(data)} to a block")
+
+
+class BlockAccessor:
+    def __init__(self, block: "pa.Table"):
+        self.block = block
+
+    @staticmethod
+    def for_block(block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def to_arrow(self) -> "pa.Table":
+        return self.block
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in self.block.column_names:
+            col = self.block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                flat = col.combine_chunks().flatten().to_numpy(
+                    zero_copy_only=False)
+                out[name] = flat.reshape(-1, width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("pyarrow", "arrow"):
+            return self.to_arrow()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("numpy", "default"):
+            return self.to_numpy()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def slice(self, start: int, end: int) -> "pa.Table":
+        return self.block.slice(start, end - start)
+
+    def rows(self) -> Iterable[dict]:
+        return self.block.to_pylist()
+
+    @staticmethod
+    def concat(blocks: List["pa.Table"]) -> "pa.Table":
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        return pa.concat_tables(blocks, promote_options="default")

@@ -1,0 +1,367 @@
+"""Lazy, streaming distributed datasets.
+
+Re-design of the reference's Ray Data core (``python/ray/data/``): logical
+plan → fused task pipelines → streaming pull-based execution with bounded
+in-flight tasks (the ``StreamingExecutor`` + backpressure policy role,
+``data/_internal/execution/streaming_executor.py:48``). Chained row/batch
+transforms are fused into a single task per block (the reference's
+MapOperator fusion), so a block goes plasma→worker→plasma once per fused
+stage, not once per op. All-to-all ops (repartition, shuffle, sort) are
+fusion barriers, as in the reference's exchange operators.
+
+TPU-relevant shape: blocks are arrow tables in shared memory; the training
+ingest path (``iter_batches`` / ``streaming_split``) feeds zero-copy numpy
+views to ``jax.device_put`` on the TPU host.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+import math
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+
+from .block import BlockAccessor, to_block
+
+# ------------------------------------------------------------------ plan ops
+
+
+class _Op:
+    """A per-block transform (fusable)."""
+
+    def __init__(self, kind: str, fn: Optional[Callable] = None,
+                 batch_size: Optional[int] = None,
+                 batch_format: str = "numpy", **kw):
+        self.kind = kind
+        self.fn = fn
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+        self.kw = kw
+
+    def apply(self, block):
+        acc = BlockAccessor(block)
+        if self.kind == "map_batches":
+            out_batches = []
+            n = acc.num_rows()
+            bs = self.batch_size or n or 1
+            for start in range(0, max(n, 1), bs):
+                batch = BlockAccessor(
+                    acc.slice(start, min(start + bs, n))
+                ).to_batch(self.batch_format)
+                res = self.fn(batch)
+                out_batches.append(to_block(res))
+            return BlockAccessor.concat(out_batches) if out_batches else block
+        if self.kind == "map":
+            return to_block([self.fn(r) for r in acc.rows()])
+        if self.kind == "flat_map":
+            out: List[dict] = []
+            for r in acc.rows():
+                out.extend(self.fn(r))
+            return to_block(out) if out else block.slice(0, 0)
+        if self.kind == "filter":
+            rows = [r for r in acc.rows() if self.fn(r)]
+            return to_block(rows) if rows else block.slice(0, 0)
+        if self.kind == "add_column":
+            import pyarrow as pa
+
+            col = self.fn(acc.to_numpy())
+            return block.append_column(self.kw["name"], pa.array(col))
+        if self.kind == "drop_columns":
+            return block.drop_columns(self.kw["cols"])
+        if self.kind == "select_columns":
+            return block.select(self.kw["cols"])
+        if self.kind == "rename_columns":
+            mapping = self.kw["mapping"]
+            return block.rename_columns(
+                [mapping.get(c, c) for c in block.column_names])
+        raise ValueError(f"unknown op {self.kind}")
+
+
+def _run_pipeline(source, ops: List[_Op]):
+    """The fused per-block task body (executes on a worker)."""
+    block = source() if callable(source) else source
+    if not isinstance(block, (list, tuple)):
+        blocks = [block]
+    else:
+        blocks = list(block)
+    outs = []
+    for b in blocks:
+        b = to_block(b)
+        for op in ops:
+            b = op.apply(b)
+        outs.append(b)
+    return BlockAccessor.concat(outs) if len(outs) > 1 else outs[0]
+
+
+@ray_tpu.remote
+def _pipeline_task(source, ops):
+    return _run_pipeline(source, ops)
+
+
+# ---------------------------------------------------------------- dataset
+
+
+class Dataset:
+    """Lazy dataset: input sources + fused transform chain.
+
+    ``_sources`` is a list of callables (readers) OR ObjectRefs/blocks.
+    """
+
+    def __init__(self, sources: List[Any], ops: Optional[List[_Op]] = None,
+                 ray_remote_args: Optional[dict] = None):
+        self._sources = sources
+        self._ops = ops or []
+        self._remote_args = ray_remote_args or {}
+
+    # --------------------------------------------------------- transforms
+
+    def _with_op(self, op: _Op) -> "Dataset":
+        return Dataset(self._sources, self._ops + [op], self._remote_args)
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    concurrency: Optional[int] = None,
+                    **ray_remote_args) -> "Dataset":
+        """Reference: ``Dataset.map_batches`` (``data/dataset.py:394``)."""
+        ds = self._with_op(_Op("map_batches", fn, batch_size, batch_format))
+        if ray_remote_args:
+            ds._remote_args = {**self._remote_args, **ray_remote_args}
+        return ds
+
+    def map(self, fn: Callable, **kw) -> "Dataset":
+        return self._with_op(_Op("map", fn))
+
+    def flat_map(self, fn: Callable, **kw) -> "Dataset":
+        return self._with_op(_Op("flat_map", fn))
+
+    def filter(self, fn: Callable, **kw) -> "Dataset":
+        return self._with_op(_Op("filter", fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._with_op(_Op("add_column", fn, name=name))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(_Op("drop_columns", cols=cols))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(_Op("select_columns", cols=cols))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._with_op(_Op("rename_columns", mapping=mapping))
+
+    # ------------------------------------------------------- execution
+
+    def _stream_refs(self, sources=None) -> Iterator[ray_tpu.ObjectRef]:
+        """Streaming executor: bounded in-flight fused tasks, yield refs in
+        completion order (backpressure = window size)."""
+        sources = self._sources if sources is None else sources
+        try:
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+        except Exception:
+            cpus = 4
+        window = max(2, cpus * 2)
+        task = _pipeline_task
+        if self._remote_args:
+            opts = {k: v for k, v in self._remote_args.items()
+                    if k in ("num_cpus", "num_tpus", "resources",
+                             "max_retries")}
+            if opts:
+                task = _pipeline_task.options(**opts)
+        pending: List[ray_tpu.ObjectRef] = []
+        it = iter(sources)
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < window:
+                try:
+                    src = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(task.remote(src, self._ops))
+            if not pending:
+                break
+            ready, pending = ray_tpu.wait(pending, num_returns=1,
+                                          timeout=None)
+            yield from ready
+
+    def materialize(self) -> "MaterializedDataset":
+        blocks = ray_tpu.get(list(self._stream_refs()))
+        return MaterializedDataset(
+            [to_block(b) for b in blocks], [], self._remote_args)
+
+    def _all_blocks(self) -> List[Any]:
+        return ray_tpu.get(list(self._stream_refs()))
+
+    # ---------------------------------------------------- all-to-all ops
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        blocks = self._all_blocks()
+        big = BlockAccessor.concat(blocks)
+        n = big.num_rows
+        per = math.ceil(n / num_blocks) if num_blocks else n
+        out = [big.slice(i * per, min(per, n - i * per))
+               for i in range(num_blocks) if i * per < n or i == 0]
+        return Dataset(out, [], self._remote_args)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        blocks = self._all_blocks()
+        big = BlockAccessor.concat(blocks)
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(big.num_rows)
+        shuffled = big.take(perm)
+        k = max(len(blocks), 1)
+        per = math.ceil(big.num_rows / k)
+        out = [shuffled.slice(i * per, per) for i in range(k)
+               if i * per < big.num_rows]
+        return Dataset(out or [shuffled], [], self._remote_args)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        blocks = self._all_blocks()
+        big = BlockAccessor.concat(blocks)
+        order = "descending" if descending else "ascending"
+        out = big.sort_by([(key, order)])
+        return Dataset([out], [], self._remote_args)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        sources = list(self._sources)
+        ops = list(self._ops)
+        if any(o._ops for o in others) or ops:
+            # Materialize to normalize op chains.
+            blocks = self._all_blocks()
+            for o in others:
+                blocks.extend(o._all_blocks())
+            return Dataset(blocks, [], self._remote_args)
+        for o in others:
+            sources.extend(o._sources)
+        return Dataset(sources, [], self._remote_args)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n datasets by round-robin over source blocks."""
+        shards: List[List[Any]] = [[] for _ in range(n)]
+        for i, src in enumerate(self._sources):
+            shards[i % n].append(src)
+        return [Dataset(s, list(self._ops), self._remote_args)
+                for s in shards]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """Per-worker streaming shards (reference: ``dataset.py:1390``)."""
+        from .iterator import DataIterator
+
+        return [DataIterator(ds) for ds in self.split(n)]
+
+    def iterator(self) -> "DataIterator":
+        from .iterator import DataIterator
+
+        return DataIterator(self)
+
+    # ------------------------------------------------------- consumption
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None):
+        return self.iterator().iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref in self._stream_refs():
+            block = ray_tpu.get(ref)
+            yield from BlockAccessor(block).rows()
+
+    def take(self, limit: int = 20) -> List[dict]:
+        out: List[dict] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
+
+    def take_all(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self._all_blocks())
+
+    def schema(self):
+        for ref in self._stream_refs():
+            return BlockAccessor(ray_tpu.get(ref)).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def num_blocks(self) -> int:
+        return len(self._sources)
+
+    def limit(self, n: int) -> "Dataset":
+        rows = self.take(n)
+        return Dataset([to_block(rows)], [], self._remote_args)
+
+    def show(self, limit: int = 20):
+        for row in self.take(limit):
+            print(row)
+
+    def stats(self) -> str:
+        return (f"Dataset(num_blocks={self.num_blocks()}, "
+                f"ops={[o.kind for o in self._ops]})")
+
+    # aggregations
+    def sum(self, on: str):
+        return builtins.sum(
+            float(BlockAccessor(b).to_numpy()[on].sum())
+            for b in self._all_blocks())
+
+    def min(self, on: str):
+        return builtins.min(
+            BlockAccessor(b).to_numpy()[on].min() for b in self._all_blocks())
+
+    def max(self, on: str):
+        return builtins.max(
+            BlockAccessor(b).to_numpy()[on].max() for b in self._all_blocks())
+
+    def mean(self, on: str):
+        tot, n = 0.0, 0
+        for b in self._all_blocks():
+            col = BlockAccessor(b).to_numpy()[on]
+            tot += float(col.sum())
+            n += len(col)
+        return tot / max(n, 1)
+
+    # ---------------------------------------------------------- writing
+
+    def write_parquet(self, path: str):
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            block = ray_tpu.get(ref)
+            pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str):
+        import os
+
+        import pyarrow.csv as pcsv
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            block = ray_tpu.get(ref)
+            pcsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+
+    def __repr__(self):
+        return self.stats()
+
+
+class MaterializedDataset(Dataset):
+    """All blocks resident (reference: ``MaterializedDataset``)."""

@@ -1,0 +1,108 @@
+"""DataIterator: the per-consumer batch stream.
+
+Reference: ``python/ray/data/iterator.py`` (``iter_batches`` at
+``dataset.py:3837``, ``iter_torch_batches`` at ``:3908``). The TPU analog of
+``iter_torch_batches`` is ``iter_jax_batches``: numpy batches placed onto
+device (optionally onto a sharded mesh layout) ready for a pjit step.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .block import BlockAccessor
+
+
+class DataIterator:
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def _iter_blocks(self):
+        for ref in self._dataset._stream_refs():
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
+        rng = np.random.RandomState(local_shuffle_seed)
+        carry = None  # leftover rows as an arrow table
+        shuffle_buf = deque()
+        buffered_rows = 0
+
+        def emit(table):
+            return BlockAccessor(table).to_batch(batch_format)
+
+        for block in self._iter_blocks():
+            if carry is not None:
+                block = BlockAccessor.concat([carry, block])
+                carry = None
+            if local_shuffle_buffer_size:
+                shuffle_buf.append(block)
+                buffered_rows += block.num_rows
+                if buffered_rows < local_shuffle_buffer_size:
+                    continue
+                merged = BlockAccessor.concat(list(shuffle_buf))
+                shuffle_buf.clear()
+                buffered_rows = 0
+                block = merged.take(rng.permutation(merged.num_rows))
+            n = block.num_rows
+            start = 0
+            while n - start >= batch_size:
+                yield emit(block.slice(start, batch_size))
+                start += batch_size
+            if start < n:
+                carry = block.slice(start, n - start)
+        if shuffle_buf:
+            merged = BlockAccessor.concat(list(shuffle_buf))
+            if carry is not None:
+                merged = BlockAccessor.concat([carry, merged])
+            carry = merged.take(rng.permutation(merged.num_rows))
+        if carry is not None and carry.num_rows:
+            n = carry.num_rows
+            start = 0
+            while n - start >= batch_size:
+                yield emit(carry.slice(start, batch_size))
+                start += batch_size
+            if start < n and not drop_last:
+                yield emit(carry.slice(start, n - start))
+
+    def iter_rows(self) -> Iterator[dict]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         dtypes: Optional[Dict[str, Any]] = None,
+                         sharding=None, drop_last: bool = True,
+                         **kw) -> Iterator[Dict[str, Any]]:
+        """Numpy batches placed on device (the ``iter_torch_batches`` analog).
+
+        ``sharding`` may be a ``NamedSharding`` (global-batch layout on a
+        mesh) — batches are device_put with it, giving the pjit-ready input
+        placement; without it, arrays go to the default device.
+        """
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last, **kw):
+            out = {}
+            for k, v in batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                out[k] = (jax.device_put(v, sharding) if sharding is not None
+                          else jax.device_put(v))
+            yield out
+
+    def materialize(self):
+        return self._dataset.materialize()
+
+    def stats(self) -> str:
+        return self._dataset.stats()

@@ -1,0 +1,141 @@
+"""Dataset creation APIs (reference: ``python/ray/data/read_api.py``).
+
+Readers are lazy: each source is a callable executed inside a task, so a
+``read_parquet`` over 1000 files schedules 1000 (fused) read+transform
+tasks with streaming backpressure.
+"""
+
+from __future__ import annotations
+
+import functools
+import glob as globlib
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .block import to_block
+from .dataset import Dataset
+
+
+def _expand_paths(paths: Union[str, List[str]], suffix: str = "") -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in globlib.glob(os.path.join(p, "**", "*"),
+                                        recursive=True)
+                if os.path.isfile(f) and f.endswith(suffix)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files found for {paths}")
+    return out
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    import builtins
+
+    n = len(items)
+    if parallelism <= 0:
+        parallelism = min(max(1, n // 1000), 200) if n else 1
+    per = math.ceil(n / parallelism) if n else 1
+    blocks = []
+    for i in builtins.range(0, n, per) if n else [0]:
+        chunk = items[i:i + per]
+        if chunk and isinstance(chunk[0], dict):
+            blocks.append(to_block(chunk))
+        else:
+            blocks.append(to_block({"item": np.asarray(chunk)
+                                    if chunk else np.array([])}))
+    return Dataset(blocks)
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:
+    import builtins
+
+    if parallelism <= 0:
+        parallelism = min(200, max(1, n // 50000)) if n else 1
+    per = math.ceil(n / parallelism) if n else 1
+    sources = []
+    for i in builtins.range(0, n, per):
+        lo, hi = i, min(i + per, n)
+        sources.append(functools.partial(_range_block, lo, hi))
+    return Dataset(sources or [to_block({"id": np.array([], np.int64)})])
+
+
+def _range_block(lo: int, hi: int):
+    return {"id": np.arange(lo, hi, dtype=np.int64)}
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return Dataset([to_block({column: arr})])
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([to_block(df)])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([table])
+
+
+def _read_parquet_file(path: str, columns):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path, columns=columns)
+
+
+def read_parquet(paths: Union[str, List[str]], *,
+                 columns: Optional[List[str]] = None,
+                 parallelism: int = -1, **kw) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+    return Dataset([functools.partial(_read_parquet_file, f, columns)
+                    for f in files])
+
+
+def _read_csv_file(path: str):
+    import pyarrow.csv as pcsv
+
+    return pcsv.read_csv(path)
+
+
+def read_csv(paths: Union[str, List[str]], **kw) -> Dataset:
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_csv_file, f) for f in files])
+
+
+def _read_json_file(path: str):
+    import pyarrow.json as pjson
+
+    return pjson.read_json(path)
+
+
+def read_json(paths: Union[str, List[str]], **kw) -> Dataset:
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_json_file, f) for f in files])
+
+
+def _read_text_file(path: str):
+    with open(path) as f:
+        return {"text": np.array([ln.rstrip("\n") for ln in f])}
+
+
+def read_text(paths: Union[str, List[str]], **kw) -> Dataset:
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_text_file, f) for f in files])
+
+
+def _read_numpy_file(path: str):
+    return {"data": np.load(path)}
+
+
+def read_numpy(paths: Union[str, List[str]], **kw) -> Dataset:
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_numpy_file, f) for f in files])

@@ -1,4 +1,5 @@
 from .llama import (
+    generate_sample,
     LLAMA3_1B,
     LLAMA3_8B,
     LLAMA_DEBUG,
@@ -12,5 +13,5 @@ from .llama import (
 
 __all__ = [
     "LlamaConfig", "LLAMA3_8B", "LLAMA3_1B", "LLAMA_DEBUG", "init_params",
-    "forward", "loss_fn", "generate_greedy", "flops_per_token",
+    "forward", "loss_fn", "generate_greedy", "generate_sample", "flops_per_token",
 ]

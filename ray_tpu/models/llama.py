@@ -181,49 +181,88 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     return 6 * n_params + attn
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature"))
-def generate_greedy(params, prompt: jax.Array, cfg: LlamaConfig,
-                    max_new: int = 32, temperature: float = 0.0):
-    """Simple KV-cached autoregressive decode (correctness-oriented)."""
+def _decode_step(params, tokens, caches, start, cfg: LlamaConfig, cos, sin):
+    """One cached forward over ``tokens`` beginning at position ``start``."""
+    x = params["embedding"][tokens].astype(cfg.dtype)
+    positions = start + jnp.arange(tokens.shape[1])[None, :]
+    positions = jnp.broadcast_to(positions, tokens.shape)
+    new_caches = []
+    for layer, (kc, vc) in zip(params["layers"], caches):
+        a, nc = _attention_block(
+            layer, x, cos, sin, cfg, None,
+            kv_cache=(kc, vc, start), positions=positions)
+        x = x + a
+        x = x + _mlp_block(layer, x, cfg)
+        new_caches.append((nc[0], nc[1]))
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    head = (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    return jnp.dot(x, head.astype(x.dtype)), new_caches
+
+
+def _prefill(params, prompt, cfg: LlamaConfig, max_new: int):
     B, L = prompt.shape
     total = L + max_new
-    k_cache = [jnp.zeros((B, total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
-               for _ in range(cfg.n_layers)]
-    v_cache = [jnp.zeros((B, total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
-               for _ in range(cfg.n_layers)]
+    caches = [
+        (jnp.zeros((B, total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+         jnp.zeros((B, total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
+        for _ in range(cfg.n_layers)
+    ]
     cos, sin = rope_frequencies(cfg.head_dim, total, cfg.rope_theta)
+    logits, caches = _decode_step(params, prompt, caches, 0, cfg, cos, sin)
+    return logits, caches, L, cos, sin
 
-    def step_model(tokens, caches, start):
-        x = params["embedding"][tokens].astype(cfg.dtype)
-        positions = start + jnp.arange(tokens.shape[1])[None, :]
-        positions = jnp.broadcast_to(positions, tokens.shape)
-        new_caches = []
-        for layer, (kc, vc) in zip(params["layers"], caches):
-            a, nc = _attention_block(
-                layer, x, cos, sin, cfg, None,
-                kv_cache=(kc, vc, start), positions=positions)
-            x = x + a
-            x = x + _mlp_block(layer, x, cfg)
-            new_caches.append((nc[0], nc[1]))
-        x = rms_norm(x, params["norm"], cfg.norm_eps)
-        head = (params["embedding"].T if cfg.tie_embeddings
-                else params["lm_head"])
-        return jnp.dot(x, head.astype(x.dtype)), new_caches
 
-    logits, caches = step_model(prompt, list(zip(k_cache, v_cache)), 0)
-    next_tok = jnp.argmax(logits[:, -1], axis=-1)
-    out = [next_tok]
+def _generate(params, prompt, cfg: LlamaConfig, max_new: int, pick):
+    """Shared scan-based decode loop; ``pick(logits, key) -> tokens``."""
+    logits, caches, L, cos, sin = _prefill(params, prompt, cfg, max_new)
+    key0 = jax.random.PRNGKey(0)
+    key0, sub = jax.random.split(key0)
+    next_tok = pick(logits[:, -1], sub)
 
-    def body(carry, i):
-        caches, tok, pos = carry
-        logits, caches = step_model(tok[:, None], caches, pos)
-        nxt = jnp.argmax(logits[:, -1], axis=-1)
-        return (caches, nxt, pos + 1), nxt
-
-    # Python loop unrolled under jit would be huge; use scan over steps.
     def scan_body(carry, _):
-        return body(carry, 0)
+        caches, tok, pos, key = carry
+        logits, caches = _decode_step(params, tok[:, None], caches, pos,
+                                      cfg, cos, sin)
+        key, sub = jax.random.split(key)
+        nxt = pick(logits[:, -1], sub)
+        return (caches, nxt, pos + 1, key), nxt
 
-    (caches, tok, _), toks = jax.lax.scan(
-        scan_body, (caches, next_tok, L), None, length=max_new - 1)
+    (_, _, _, _), toks = jax.lax.scan(
+        scan_body, (caches, next_tok, L, key0), None, length=max_new - 1)
+    return jnp.concatenate([next_tok[:, None], toks.T], axis=1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new"))
+def generate_greedy(params, prompt: jax.Array, cfg: LlamaConfig,
+                    max_new: int = 32):
+    """KV-cached greedy decode. For sampling use ``generate_sample``."""
+    return _generate(params, prompt, cfg, max_new,
+                     lambda logits, key: jnp.argmax(logits, axis=-1))
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new"))
+def generate_sample(params, prompt: jax.Array, cfg: LlamaConfig,
+                    key: jax.Array, max_new: int = 32,
+                    temperature: float = 1.0):
+    """KV-cached sampled decode with temperature."""
+    logits, caches, L, cos, sin = _prefill(params, prompt, cfg, max_new)
+
+    def pick(logits, k):
+        return jax.random.categorical(
+            k, logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6))
+
+    key, sub = jax.random.split(key)
+    next_tok = pick(logits[:, -1], sub)
+
+    def scan_body(carry, _):
+        caches, tok, pos, k = carry
+        logits, caches = _decode_step(params, tok[:, None], caches, pos,
+                                      cfg, cos, sin)
+        k, sub = jax.random.split(k)
+        nxt = pick(logits[:, -1], sub)
+        return (caches, nxt, pos + 1, k), nxt
+
+    (_, _, _, _), toks = jax.lax.scan(
+        scan_body, (caches, next_tok, L, key), None, length=max_new - 1)
     return jnp.concatenate([next_tok[:, None], toks.T], axis=1)

@@ -114,6 +114,10 @@ def _flash_attention_bhld(q, k, v, causal, scale, block_q, block_k,
     from jax.experimental import pallas as pl
 
     BH, L, D = q.shape
+    if L % block_q or L % block_k:
+        raise ValueError(
+            f"sequence length {L} must be divisible by block_q={block_q} "
+            f"and block_k={block_k}")
     grid = (BH, L // block_q)
     kernel = functools.partial(_flash_kernel, scale=scale, block_k=block_k,
                                causal=causal, seq_len=L)

@@ -124,6 +124,12 @@ class HostCollectiveGroup:
                     f"allreduce timed out: {len(parts)}/{self.world_size}")
             if len(parts) < self.world_size:
                 time.sleep(0.005)
+        # Everyone finishing round r implies everyone has READ round r-1,
+        # so our own r-1 slot can be garbage-collected (bounds KV growth;
+        # a restarted member reusing the name then blocks loudly instead of
+        # silently averaging stale data).
+        if self._round > 0:
+            w.kv_del(f"r{self._round - 1}:{self.rank}", ns=ns)
         self._round += 1
         stacked = np.stack([parts[r] for r in range(self.world_size)])
         if op == "sum":

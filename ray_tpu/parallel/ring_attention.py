@@ -56,10 +56,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Returns: [B, L_local, H, D]
     """
     B, Lq, H, D = q.shape
-    if k.shape[2] != H:  # GQA: repeat KV heads to match Q heads
-        rep = H // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA KV stays in grouped form while rotating around the ring (1/group
+    # the ICI bytes); heads are repeated per-block inside _block_attn.
+    kv_rep = H // k.shape[2]
     n = lax.axis_size(axis)
     my_idx = lax.axis_index(axis)
     if scale is None:
@@ -70,6 +69,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def step(carry, i):
         o_acc, m_acc, l_acc, kv = carry
         k_blk, v_blk = kv
+        if kv_rep > 1:
+            k_cmp = jnp.repeat(k_blk, kv_rep, axis=2)
+            v_cmp = jnp.repeat(v_blk, kv_rep, axis=2)
+        else:
+            k_cmp, v_cmp = k_blk, v_blk
         src_idx = (my_idx - i) % n  # whose KV block we currently hold
         bias = None
         if causal:
@@ -81,7 +85,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             mask = q_pos[:, None] >= k_pos[None, :]
             bias = jnp.where(mask, 0.0, NEG_INF)[None, None]
         o_blk, m_blk, l_blk = _block_attn(
-            q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            q32, k_cmp.astype(jnp.float32), v_cmp.astype(jnp.float32),
             bias, scale)
         # Online-softmax merge of (o_acc, m_acc, l_acc) with the new block.
         m_new = jnp.maximum(m_acc, m_blk)

@@ -58,8 +58,6 @@ def _tree_paths(tree: PyTree) -> PyTree:
                 parts.append(str(p))
         return "/".join(parts)
 
-    paths = []
-    jax.tree_util.tree_flatten_with_path(tree)  # validate
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     return jax.tree_util.tree_unflatten(
         treedef, [path_str(path) for path, _ in flat])

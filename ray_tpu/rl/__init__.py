@@ -1,0 +1,9 @@
+from .algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
+from .env_runner import EnvRunner, EnvRunnerGroup
+from .learner import Learner, LearnerGroup, gae
+from .rl_module import MLPModuleConfig
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "EnvRunner",
+    "EnvRunnerGroup", "Learner", "LearnerGroup", "gae", "MLPModuleConfig",
+]

@@ -1,0 +1,143 @@
+"""EnvRunner: sampling actors over gymnasium vector envs.
+
+Reference: ``SingleAgentEnvRunner`` (``rllib/env/single_agent_env_runner.py:
+64``) grouped by ``EnvRunnerGroup`` (``rllib/env/env_runner_group.py``) with
+fault-tolerant apply (``env/env_runner.py:28`` FaultAwareApply). Runners do
+host-side inference with the current RLModule weights and return fixed-size
+rollout batches as numpy dicts (zero-copy through the object store).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class EnvRunner:
+    def __init__(self, env_id: str, num_envs: int, module_cfg_blob: bytes,
+                 seed: int = 0, env_fn_blob: Optional[bytes] = None):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+
+        from . import rl_module
+
+        self.rl_module = rl_module
+        if env_fn_blob is not None:
+            env_fn = cloudpickle.loads(env_fn_blob)
+            self.env = gym.vector.SyncVectorEnv(
+                [lambda i=i: env_fn() for i in range(num_envs)])
+        else:
+            self.env = gym.make_vec(env_id, num_envs=num_envs,
+                                    vectorization_mode="sync")
+        self.cfg = cloudpickle.loads(module_cfg_blob)
+        self.key = jax.random.PRNGKey(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.num_envs = num_envs
+        # episode-return bookkeeping
+        self._ep_return = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, dtype=np.int64)
+        self.completed_returns: List[float] = []
+        self.completed_lengths: List[int] = []
+
+    def sample(self, weights_ref, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect ``num_steps`` per env; returns flat [T*N, ...] arrays
+        plus bootstrap values."""
+        import jax
+
+        from . import rl_module
+
+        params = weights_ref  # resolved ObjectRef -> params pytree
+        obs_buf, act_buf, logp_buf, rew_buf, done_buf, val_buf = \
+            [], [], [], [], [], []
+        for _ in range(num_steps):
+            self.key, sub = jax.random.split(self.key)
+            actions, logp, value = rl_module.sample_actions(
+                params, self.obs, sub)
+            nxt, rew, term, trunc, _ = self.env.step(actions)
+            done = np.logical_or(term, trunc)
+            obs_buf.append(self.obs.copy())
+            act_buf.append(actions)
+            logp_buf.append(logp)
+            rew_buf.append(rew)
+            done_buf.append(done)
+            val_buf.append(value)
+            self._ep_return += rew
+            self._ep_len += 1
+            for i in np.nonzero(done)[0]:
+                self.completed_returns.append(float(self._ep_return[i]))
+                self.completed_lengths.append(int(self._ep_len[i]))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+            self.obs = nxt
+        _, last_value = rl_module.forward_jit(params, np.asarray(self.obs))
+        return {
+            "obs": np.stack(obs_buf),            # [T, N, obs]
+            "actions": np.stack(act_buf),        # [T, N]
+            "logp": np.stack(logp_buf),
+            "rewards": np.stack(rew_buf).astype(np.float32),
+            "dones": np.stack(done_buf),
+            "values": np.stack(val_buf).astype(np.float32),
+            "bootstrap_value": np.asarray(last_value, np.float32),  # [N]
+        }
+
+    def episode_stats(self, clear: bool = True) -> Dict[str, Any]:
+        out = {"returns": list(self.completed_returns),
+               "lengths": list(self.completed_lengths)}
+        if clear:
+            self.completed_returns = []
+            self.completed_lengths = []
+        return out
+
+    def ping(self):
+        return True
+
+
+class EnvRunnerGroup:
+    """Fault-aware group of sampling actors (EnvRunnerGroup analog)."""
+
+    def __init__(self, env_id: str, num_runners: int, num_envs_per_runner: int,
+                 module_cfg, env_fn=None, seed: int = 0):
+        import cloudpickle
+
+        self.env_id = env_id
+        self.num_envs_per_runner = num_envs_per_runner
+        self._make = lambda i: EnvRunner.options(max_restarts=2).remote(
+            env_id, num_envs_per_runner, cloudpickle.dumps(module_cfg),
+            seed + i,
+            cloudpickle.dumps(env_fn) if env_fn is not None else None)
+        self.runners = [self._make(i) for i in range(num_runners)]
+        ray_tpu.get([r.ping.remote() for r in self.runners])
+
+    def sample(self, weights_ref, num_steps: int) -> List[Dict[str, np.ndarray]]:
+        """Synchronous parallel sample; dead runners are replaced
+        (reference: FaultAwareApply restart semantics)."""
+        refs = [r.sample.remote(weights_ref, num_steps)
+                for r in self.runners]
+        out = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(ray_tpu.get(ref, timeout=300))
+            except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
+                self.runners[i] = self._make(i)
+                out.append(ray_tpu.get(self.runners[i].sample.remote(
+                    weights_ref, num_steps), timeout=300))
+        return out
+
+    def episode_stats(self) -> Dict[str, list]:
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self.runners])
+        return {
+            "returns": [x for s in stats for x in s["returns"]],
+            "lengths": [x for s in stats for x in s["lengths"]],
+        }
+
+    def shutdown(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,76 @@
+"""PPO loss + jitted update (reference: ``rllib/algorithms/ppo/ppo_learner``
+losses — clipped surrogate + value clip + entropy bonus)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PPOUpdate(NamedTuple):
+    step: Any
+    compute_grads: Any
+    apply_grads: Any
+
+
+def make_ppo_update(opt, hparams: dict) -> PPOUpdate:
+    from . import rl_module
+
+    clip = hparams.get("clip_param", 0.2)
+    vf_clip = hparams.get("vf_clip_param", 10.0)
+    vf_coeff = hparams.get("vf_loss_coeff", 0.5)
+    ent_coeff = hparams.get("entropy_coeff", 0.01)
+
+    def loss_fn(params, batch):
+        logits, values = rl_module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch["actions"].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr1 = ratio * adv
+        surr2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+        pi_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+        # Clipped value loss (reference PPO learner semantics)
+        vf_err = jnp.square(values - batch["returns"])
+        vf_clipped = batch["values"] + jnp.clip(
+            values - batch["values"], -vf_clip, vf_clip)
+        vf_err2 = jnp.square(vf_clipped - batch["returns"])
+        vf_loss = 0.5 * jnp.mean(jnp.maximum(vf_err, vf_err2))
+        entropy = -jnp.mean(
+            jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+        total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        stats = {
+            "policy_loss": pi_loss, "vf_loss": vf_loss, "entropy": entropy,
+            "total_loss": total,
+            "kl": jnp.mean(batch["logp"] - logp),
+        }
+        return total, stats
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        import optax
+
+        (_, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, stats
+
+    @jax.jit
+    def compute_grads(params, batch):
+        (_, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        return grads, stats
+
+    @jax.jit
+    def apply_grads(params, opt_state, grads):
+        import optax
+
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    return PPOUpdate(step, compute_grads, apply_grads)

@@ -1,0 +1,78 @@
+"""RLModule: the framework-neutral policy/value model, in JAX.
+
+Reference: ``rllib/core/rl_module/rl_module.py`` — an RLModule owns the
+forward passes for exploration/inference/training. Here it is a functional
+pytree (like ``models/llama.py``): ``init`` makes params, pure ``forward_*``
+functions produce action logits + value estimates, so the same module runs
+in env-runner actors (CPU/host inference) and learner actors (TPU update)
+without framework glue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPModuleConfig:
+    obs_dim: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+def init(cfg: MLPModuleConfig, key: jax.Array) -> Dict[str, Any]:
+    sizes = (cfg.obs_dim,) + tuple(cfg.hidden)
+    params: Dict[str, Any] = {"layers": []}
+    keys = jax.random.split(key, len(sizes) + 1)
+    for i in range(len(sizes) - 1):
+        k1, k2 = jax.random.split(keys[i])
+        params["layers"].append({
+            "w": jax.random.normal(k1, (sizes[i], sizes[i + 1]),
+                                   cfg.dtype) * np.sqrt(2.0 / sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],), cfg.dtype),
+        })
+    k1, k2 = jax.random.split(keys[-1])
+    params["pi"] = {
+        "w": jax.random.normal(k1, (sizes[-1], cfg.num_actions),
+                               cfg.dtype) * 0.01,
+        "b": jnp.zeros((cfg.num_actions,), cfg.dtype),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(k2, (sizes[-1], 1), cfg.dtype) * 1.0,
+        "b": jnp.zeros((1,), cfg.dtype),
+    }
+    return params
+
+
+def _trunk(params, obs):
+    h = obs
+    for layer in params["layers"]:
+        h = jnp.tanh(h @ layer["w"] + layer["b"])
+    return h
+
+
+def forward(params, obs) -> Tuple[jax.Array, jax.Array]:
+    """Returns (action_logits [B, A], value [B])."""
+    h = _trunk(params, obs)
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+@jax.jit
+def forward_jit(params, obs):
+    return forward(params, obs)
+
+
+def sample_actions(params, obs, key) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exploration forward: sampled actions + logp + value (numpy out)."""
+    logits, value = forward_jit(params, jnp.asarray(obs))
+    actions = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), actions]
+    return (np.asarray(actions), np.asarray(logp), np.asarray(value))

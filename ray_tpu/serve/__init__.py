@@ -1,0 +1,146 @@
+"""serve: model serving on the actor runtime.
+
+Reference API surface: ``serve.run`` (``serve/api.py:491``),
+``@serve.deployment``, ``DeploymentHandle``, dynamic batching, HTTP ingress.
+"""
+
+from __future__ import annotations
+
+import cloudpickle
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from .batching import batch
+from .controller import get_controller, reset_controller_cache
+from .deployment import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    DeploymentResponse,
+    deployment,
+)
+from .proxy import ProxyActor, Request
+
+_proxy = None
+_proxy_port: Optional[int] = None
+
+
+def _collect_graph(app: Application, out: Dict[str, Application],
+                   app_name: str):
+    """Walk bind args for nested Applications (model composition)."""
+    out[app.deployment.name] = app
+    new_args = []
+    for a in app.args:
+        if isinstance(a, Application):
+            _collect_graph(a, out, app_name)
+            new_args.append(DeploymentHandle(a.deployment.name, app_name))
+        else:
+            new_args.append(a)
+    app.args = tuple(new_args)
+    new_kwargs = {}
+    for k, a in app.kwargs.items():
+        if isinstance(a, Application):
+            _collect_graph(a, out, app_name)
+            new_kwargs[k] = DeploymentHandle(a.deployment.name, app_name)
+        else:
+            new_kwargs[k] = a
+    app.kwargs = new_kwargs
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application; returns the ingress handle
+    (reference: ``serve.run`` ``serve/api.py:491``)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects Deployment.bind(...)")
+    graph: Dict[str, Application] = {}
+    _collect_graph(target, graph, name)
+    specs = []
+    for dep_name, app in graph.items():
+        d = app.deployment
+        specs.append({
+            "name": d.name,
+            "blob": cloudpickle.dumps(d._target),
+            "init_args": app.args,
+            "init_kwargs": app.kwargs,
+            "is_class": d.is_class,
+            "num_replicas": d.num_replicas,
+            "actor_options": d.ray_actor_options,
+            "user_config": d.user_config,
+        })
+    ctl = get_controller()
+    ray_tpu.get(ctl.deploy.remote(name, specs))
+    if route_prefix is not None:
+        _ensure_proxy()
+        ray_tpu.get(_proxy.register.remote(
+            route_prefix, name, target.deployment.name))
+    return DeploymentHandle(target.deployment.name, name)
+
+
+def _ensure_proxy(port: int = 0):
+    global _proxy, _proxy_port
+    if _proxy is not None:
+        return
+    _proxy = ProxyActor.options(name="SERVE_PROXY",
+                                lifetime="detached").remote()
+    _proxy_port = ray_tpu.get(_proxy.start.remote(port=port))
+
+
+def get_proxy_port() -> Optional[int]:
+    if _proxy is None:
+        return None
+    return _proxy_port
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ctl = get_controller()
+    deps = ray_tpu.get(ctl.list_deployments.remote(name))
+    app = deps.get(name)
+    if not app:
+        raise ValueError(f"no app named {name!r}")
+    return DeploymentHandle(next(iter(app)), name)
+
+
+def delete(name: str = "default"):
+    ctl = get_controller()
+    ray_tpu.get(ctl.delete_app.remote(name))
+
+
+def status() -> dict:
+    ctl = get_controller()
+    return ray_tpu.get(ctl.list_deployments.remote())
+
+
+def shutdown():
+    global _proxy, _proxy_port
+    try:
+        ctl = get_controller()
+        for app in list(ray_tpu.get(ctl.list_deployments.remote())):
+            ray_tpu.get(ctl.delete_app.remote(app))
+        ray_tpu.kill(ctl)
+    except Exception:
+        pass
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+    _proxy = None
+    _proxy_port = None
+    reset_controller_cache()
+
+
+__all__ = [
+    "deployment", "Deployment", "Application", "DeploymentHandle",
+    "DeploymentResponse", "Request", "run", "delete", "status", "shutdown",
+    "batch", "get_deployment_handle", "get_app_handle", "get_proxy_port",
+]

@@ -1,0 +1,176 @@
+"""ServeController: the singleton reconciler for apps and replicas.
+
+Reference: ``ServeController`` (``serve/_private/controller.py:84``) +
+``DeploymentState`` reconciliation (``deployment_state.py:1245``). Holds the
+desired state {app -> deployments -> num_replicas}, creates/kills replica
+actors to match, restarts dead replicas (health loop), and applies simple
+request-based autoscaling when an ``autoscaling_config`` is present.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        # app -> dep name -> {"deployment": blob..., "replicas": [handles]}
+        self.apps: Dict[str, Dict[str, dict]] = {}
+
+    def deploy(self, app_name: str, deployments: List[dict]):
+        """deployments: [{name, blob, init_args, init_kwargs, is_class,
+        num_replicas, actor_options, user_config}]"""
+        from .deployment import Replica
+
+        app = self.apps.setdefault(app_name, {})
+        for spec in deployments:
+            current = app.get(spec["name"])
+            if current is not None:
+                for r in current["replicas"]:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+            replicas = []
+            for i in range(spec["num_replicas"]):
+                opts = dict(spec.get("actor_options") or {})
+                opts.setdefault("max_concurrency", 100)
+                r = Replica.options(**opts).remote(
+                    spec["blob"], tuple(spec.get("init_args") or ()),
+                    spec.get("init_kwargs") or {}, spec["is_class"])
+                replicas.append(r)
+            if spec.get("user_config") is not None:
+                ray_tpu.get([r.reconfigure.remote(spec["user_config"])
+                             for r in replicas])
+            app[spec["name"]] = {"spec": spec, "replicas": replicas}
+        # Block until all replicas respond (deployment is ready).
+        for dep in app.values():
+            ray_tpu.get([r.health_check.remote() for r in dep["replicas"]])
+        return True
+
+    def get_replicas(self, app_name: str, deployment_name: str):
+        app = self.apps.get(app_name, {})
+        dep = app.get(deployment_name)
+        return list(dep["replicas"]) if dep else []
+
+    def list_deployments(self, app_name: str = None):
+        out = {}
+        for an, deps in self.apps.items():
+            if app_name is not None and an != app_name:
+                continue
+            out[an] = {name: {"num_replicas": len(d["replicas"])}
+                       for name, d in deps.items()}
+        return out
+
+    def delete_app(self, app_name: str):
+        deps = self.apps.pop(app_name, {})
+        for dep in deps.values():
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    def scale(self, app_name: str, deployment_name: str, num_replicas: int):
+        """Manual / autoscaler-driven replica count change."""
+        from .deployment import Replica
+
+        dep = self.apps.get(app_name, {}).get(deployment_name)
+        if dep is None:
+            return False
+        spec = dep["spec"]
+        cur = dep["replicas"]
+        if num_replicas > len(cur):
+            for _ in range(num_replicas - len(cur)):
+                opts = dict(spec.get("actor_options") or {})
+                opts.setdefault("max_concurrency", 100)
+                r = Replica.options(**opts).remote(
+                    spec["blob"], tuple(spec.get("init_args") or ()),
+                    spec.get("init_kwargs") or {}, spec["is_class"])
+                cur.append(r)
+            ray_tpu.get([r.health_check.remote() for r in cur])
+        elif num_replicas < len(cur):
+            for r in cur[num_replicas:]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            dep["replicas"] = cur[:num_replicas]
+        return True
+
+    def check_health(self):
+        """Replace dead replicas (reference: DeploymentState health loop)."""
+        from .deployment import Replica
+
+        replaced = 0
+        for app in self.apps.values():
+            for dep in app.values():
+                alive = []
+                for r in dep["replicas"]:
+                    try:
+                        ray_tpu.get(r.health_check.remote(), timeout=5)
+                        alive.append(r)
+                    except Exception:
+                        replaced += 1
+                spec = dep["spec"]
+                while len(alive) < spec["num_replicas"]:
+                    opts = dict(spec.get("actor_options") or {})
+                    opts.setdefault("max_concurrency", 100)
+                    alive.append(Replica.options(**opts).remote(
+                        spec["blob"], tuple(spec.get("init_args") or ()),
+                        spec.get("init_kwargs") or {}, spec["is_class"]))
+                dep["replicas"] = alive
+        return replaced
+
+
+_controller = None
+
+
+def get_controller():
+    """Get or start the singleton controller (detached named actor)."""
+    global _controller
+    if _controller is not None:
+        return _controller
+    try:
+        _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        # Probe it.
+        ray_tpu.get(_controller.list_deployments.remote(), timeout=10)
+    except Exception:
+        _controller = ServeController.options(
+            name=CONTROLLER_NAME, lifetime="detached").remote()
+    return _controller
+
+
+async def get_controller_async():
+    """Event-loop-safe controller lookup (used inside async replicas; the
+    controller always exists by the time a replica runs)."""
+    global _controller
+    if _controller is not None:
+        return _controller
+    from ray_tpu import _AnyMethodActorHandle
+    from ray_tpu._private.ids import ActorID
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    reply = await w.gcs.request({"t": "actor_by_name",
+                                 "name": CONTROLLER_NAME,
+                                 "namespace": w.namespace})
+    if not reply.get("ok"):
+        raise RuntimeError("serve controller is not running")
+    _controller = _AnyMethodActorHandle(ActorID(reply["aid"]), [], 0)
+    return _controller
+
+
+def reset_controller_cache():
+    global _controller
+    _controller = None

@@ -1,0 +1,261 @@
+"""Deployments, handles, and routing.
+
+Reference surface: ``@serve.deployment`` (``python/ray/serve/api.py:246``),
+``Deployment`` (``serve/deployment.py:64``), ``DeploymentHandle``
+(``serve/handle.py:618``) with power-of-two-choices replica scheduling
+(``serve/_private/replica_scheduler/pow_2_scheduler.py:52``). Replicas are
+plain actors; the handle keeps local in-flight counts and picks the less
+loaded of two random replicas — same algorithm, no separate router actor
+hop.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like result of ``handle.remote()`` (reference:
+    ``serve/handle.py`` DeploymentResponse). Works from driver threads
+    (``.result()``) and inside async replicas (``await``)."""
+
+    def __init__(self, ref: Optional[ray_tpu.ObjectRef],
+                 on_done: Callable[[], None],
+                 async_coro=None):
+        self._ref = ref
+        self._on_done = on_done
+        self._coro = async_coro
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    def result(self, timeout: Optional[float] = None):
+        if self._ref is None:
+            raise RuntimeError(
+                "this response was created on the event loop; use `await`")
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._finish()
+
+    def __await__(self):
+        async def _wait():
+            try:
+                if self._coro is not None:
+                    return await self._coro
+                return await self._ref
+            finally:
+                self._finish()
+
+        return _wait().__await__()
+
+
+@ray_tpu.remote
+class Replica:
+    """One deployment replica hosting the user callable."""
+
+    def __init__(self, cls_or_fn_blob: bytes, init_args: tuple,
+                 init_kwargs: dict, is_class: bool):
+        import cloudpickle
+
+        target = cloudpickle.loads(cls_or_fn_blob)
+        # Re-bind nested deployment handles (model composition).
+        if is_class:
+            self.callable = target(*init_args, **init_kwargs)
+        else:
+            self.callable = target
+
+    async def handle_request_async(self, method: str, args: tuple,
+                                   kwargs: dict):
+        import asyncio
+
+        target = getattr(self.callable, method, None)
+        if target is None and method == "__call__":
+            target = self.callable
+        if target is None:
+            raise AttributeError(f"deployment has no method {method!r}")
+        out = target(*args, **kwargs)
+        if asyncio.iscoroutine(out):
+            out = await out
+        return out
+
+    def reconfigure(self, user_config):
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+    def health_check(self):
+        if hasattr(self.callable, "check_health"):
+            self.callable.check_health()
+        return True
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.method_name = method_name
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._rng = random.Random()
+
+    @staticmethod
+    def _on_io_thread() -> bool:
+        from ray_tpu._private.worker import global_worker
+
+        import threading
+
+        w = global_worker()
+        return threading.current_thread() is w._loop_thread
+
+    def _refresh(self):
+        from .controller import get_controller
+
+        ctl = get_controller()
+        self._replicas = ray_tpu.get(ctl.get_replicas.remote(
+            self.app_name, self.deployment_name))
+        self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    async def _refresh_async(self):
+        from .controller import get_controller_async
+
+        ctl = await get_controller_async()
+        self._replicas = await ctl.get_replicas.remote(
+            self.app_name, self.deployment_name)
+        self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             method_name or self.method_name)
+        h._replicas = self._replicas
+        h._inflight = self._inflight
+        return h
+
+    def _pick(self) -> int:
+        """Power-of-two-choices by local in-flight count."""
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = self._rng.sample(range(n), 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def _submit(self, args, kwargs):
+        idx = self._pick()
+        replica = self._replicas[idx]
+        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        ref = replica.handle_request_async.remote(
+            self.method_name, args, kwargs)
+
+        def done():
+            self._inflight[idx] = max(0, self._inflight.get(idx, 1) - 1)
+
+        return ref, done
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if self._replicas:
+            ref, done = self._submit(args, kwargs)
+            return DeploymentResponse(ref, done)
+        if self._on_io_thread():
+            # Inside an async replica: replica discovery must not block the
+            # event loop — resolve it as part of the awaited chain.
+            async def call():
+                await self._refresh_async()
+                if not self._replicas:
+                    raise RuntimeError(
+                        f"deployment {self.deployment_name!r} has no "
+                        f"replicas")
+                ref, done = self._submit(args, kwargs)
+                try:
+                    return await ref
+                finally:
+                    done()
+
+            return DeploymentResponse(None, lambda: None,
+                                      async_coro=call())
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no replicas")
+        ref, done = self._submit(args, kwargs)
+        return DeploymentResponse(ref, done)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self.method_name))
+
+
+class Application:
+    """A bound deployment graph node (``Deployment.bind`` result)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target: Callable, name: str,
+                 num_replicas: int = 1,
+                 ray_actor_options: Optional[dict] = None,
+                 user_config: Any = None,
+                 max_ongoing_requests: int = 100,
+                 autoscaling_config: Optional[dict] = None):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.user_config = user_config
+        self.max_ongoing_requests = max_ongoing_requests
+        self.autoscaling_config = autoscaling_config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, *, num_replicas: Optional[int] = None,
+                name: Optional[str] = None,
+                ray_actor_options: Optional[dict] = None,
+                user_config: Any = None,
+                autoscaling_config: Optional[dict] = None,
+                max_ongoing_requests: Optional[int] = None) -> "Deployment":
+        return Deployment(
+            self._target,
+            name or self.name,
+            num_replicas if num_replicas is not None else self.num_replicas,
+            ray_actor_options or self.ray_actor_options,
+            user_config if user_config is not None else self.user_config,
+            max_ongoing_requests or self.max_ongoing_requests,
+            autoscaling_config or self.autoscaling_config)
+
+    @property
+    def is_class(self) -> bool:
+        import inspect
+
+        return inspect.isclass(self._target)
+
+
+def deployment(target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, ray_actor_options: Optional[dict] = None,
+               user_config: Any = None, max_ongoing_requests: int = 100,
+               autoscaling_config: Optional[dict] = None):
+    """``@serve.deployment`` decorator (reference: ``serve/api.py:246``)."""
+
+    def wrap(t):
+        return Deployment(t, name or t.__name__, num_replicas,
+                          ray_actor_options, user_config,
+                          max_ongoing_requests, autoscaling_config)
+
+    if target is not None:
+        return wrap(target)
+    return wrap

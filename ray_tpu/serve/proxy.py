@@ -1,0 +1,96 @@
+"""HTTP ingress proxy.
+
+Reference: ``ProxyActor`` (``serve/proxy.py:1129``) — an aiohttp server in
+an actor forwarding requests to the app's ingress deployment handle. JSON
+bodies are parsed into a lightweight ``Request``; handler returns are
+serialized as JSON (dict/list) or text.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+class Request:
+    """What an HTTP-ingress deployment receives (starlette-Request-like)."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 body: bytes, headers: Dict[str, str]):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self._body = body
+        self.headers = headers
+
+    def json(self) -> Any:
+        return json.loads(self._body or b"null")
+
+    def body(self) -> bytes:
+        return self._body
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query_params,
+                          self._body, self.headers))
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self):
+        self.apps: Dict[str, str] = {}  # route_prefix -> (app, ingress dep)
+        self.handles: Dict[str, Any] = {}
+        self.port: Optional[int] = None
+        self._runner = None
+
+    async def register(self, route_prefix: str, app_name: str,
+                       ingress_deployment: str):
+        from .deployment import DeploymentHandle
+
+        self.handles[route_prefix] = DeploymentHandle(
+            ingress_deployment, app_name)
+        return True
+
+    async def unregister(self, route_prefix: str):
+        self.handles.pop(route_prefix, None)
+        return True
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        from aiohttp import web
+
+        async def handler(request: "web.Request"):
+            path = request.path
+            match = None
+            for prefix in sorted(self.handles, key=len, reverse=True):
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/") or prefix == "/":
+                    match = prefix
+                    break
+            if match is None:
+                return web.Response(status=404, text="no app for route")
+            body = await request.read()
+            req = Request(request.method, path, dict(request.query), body,
+                          dict(request.headers))
+            handle = self.handles[match]
+            try:
+                result = await handle.remote(req)
+            except Exception as e:  # noqa: BLE001
+                return web.Response(status=500, text=str(e))
+            if isinstance(result, (dict, list)):
+                return web.json_response(result)
+            if isinstance(result, bytes):
+                return web.Response(body=result)
+            return web.Response(text=str(result))
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def get_port(self):
+        return self.port

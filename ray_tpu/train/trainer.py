@@ -127,10 +127,18 @@ class JaxTrainer:
     def _run_attempt(self, run_name: str, storage: str,
                      restore_path: Optional[str]) -> Result:
         sc = self.scaling_config
-        collector = _ResultCollector.remote(sc.num_workers)
-        group = WorkerGroup(sc.num_workers, sc.worker_resources(),
-                            sc.placement_strategy)
         run_path = os.path.join(storage, run_name)
+        collector = _ResultCollector.remote(sc.num_workers)
+        try:
+            group = WorkerGroup(sc.num_workers, sc.worker_resources(),
+                                sc.placement_strategy)
+        except Exception as e:  # noqa: BLE001 — e.g. infeasible resources
+            try:
+                ray_tpu.kill(collector)
+            except Exception:
+                pass
+            return Result(metrics=None, checkpoint=None, path=run_path,
+                          error=e)
         try:
             fn_blob = cloudpickle.dumps(self.train_loop)
             # Pre-split datasets into per-worker shards

@@ -1,0 +1,34 @@
+from ..train.session import get_checkpoint, get_context, report
+from .schedulers import ASHAScheduler, FIFOScheduler, PopulationBasedTraining
+from .search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .tuner import ResultGrid, TuneConfig, Tuner
+
+
+def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
+        scheduler=None, name=None, storage_path=None, **kw):
+    """``tune.run`` compatibility wrapper around ``Tuner`` (reference:
+    ``python/ray/tune/tune.py:267``)."""
+    from ..train.config import RunConfig
+
+    tuner = Tuner(
+        trainable, param_space=config or {},
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler),
+        run_config=RunConfig(name=name, storage_path=storage_path))
+    return tuner.fit()
+
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "run", "report", "get_context",
+    "get_checkpoint", "choice", "uniform", "loguniform", "randint",
+    "quniform", "sample_from", "grid_search", "FIFOScheduler",
+    "ASHAScheduler", "PopulationBasedTraining",
+]

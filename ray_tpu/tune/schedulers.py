@@ -1,0 +1,134 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), PBT.
+
+Reference: ``python/ray/tune/schedulers/`` — ``async_hyperband.py``
+(ASHAScheduler), ``pbt.py`` (PopulationBasedTraining). The controller calls
+``on_result`` for every report and acts on the returned decision.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "continue"
+STOP = "stop"
+# PBT: stop current run; restart with new config from a donor checkpoint.
+EXPLOIT = "exploit"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class ASHAScheduler(FIFOScheduler):
+    """Async successive halving: at each rung, trials below the top
+    ``1/reduction_factor`` quantile of completed rung results stop early."""
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_results: Dict[int, List[float]] = {r: [] for r in self.rungs}
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self.rungs):
+            if t == rung:
+                vals = self.rung_results[rung]
+                vals.append(float(metric) if self.mode == "max"
+                            else -float(metric))
+                if len(vals) < self.rf:
+                    return CONTINUE  # not enough data: optimistic continue
+                cutoff_idx = max(0, math.ceil(len(vals) / self.rf) - 1)
+                cutoff = sorted(vals, reverse=True)[cutoff_idx]
+                return CONTINUE if vals[-1] >= cutoff else STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT: at each perturbation interval, bottom-quantile trials clone the
+    checkpoint of a top-quantile trial and mutate hyperparameters
+    (reference: ``tune/schedulers/pbt.py`` exploit/explore)."""
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, Dict[str, Any]] = {}  # trial -> last result
+        self.last_perturb: Dict[str, int] = {}
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        self.latest[trial_id] = result
+        if t - self.last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self.last_perturb[trial_id] = t
+        scores = {tid: (r.get(self.metric, -float("inf"))
+                        if self.mode == "max"
+                        else -r.get(self.metric, float("inf")))
+                  for tid, r in self.latest.items()}
+        if len(scores) < 2:
+            return CONTINUE
+        ranked = sorted(scores, key=scores.get, reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        if trial_id in ranked[-k:] and trial_id not in ranked[:k]:
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit_target(self, trial_id: str) -> Optional[str]:
+        scores = {tid: (r.get(self.metric, -float("inf"))
+                        if self.mode == "max"
+                        else -r.get(self.metric, float("inf")))
+                  for tid, r in self.latest.items()}
+        ranked = sorted(scores, key=scores.get, reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        top = [t for t in ranked[:k] if t != trial_id]
+        return self.rng.choice(top) if top else None
+
+    def mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            elif callable(spec):
+                out[key] = spec()
+            elif hasattr(spec, "sample"):
+                out[key] = spec.sample(self.rng)
+            elif key in out and isinstance(out[key], (int, float)):
+                factor = self.rng.choice([0.8, 1.2])
+                out[key] = out[key] * factor
+        return out

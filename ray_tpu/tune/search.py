@@ -1,0 +1,144 @@
+"""Search spaces + variant generation.
+
+Reference: ``python/ray/tune/search/`` — the basic variant generator
+(grid + random sampling) plus the sampling-primitive API
+(``tune.choice/uniform/loguniform/randint/grid_search``).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def sample_from(fn: Callable) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def _walk(space: Any, path=()):
+    """Yield (path, value) for nested dict leaves."""
+    if isinstance(space, dict):
+        for k, v in space.items():
+            yield from _walk(v, path + (k,))
+    else:
+        yield path, space
+
+
+def _set_path(d: dict, path, value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
+                      seed: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Expand grid axes (cartesian) x num_samples random draws.
+
+    Matches the reference semantics: each grid combination is run
+    ``num_samples`` times, with random domains re-sampled per run.
+    """
+    rng = random.Random(seed)
+    grids = [(p, v.values) for p, v in _walk(param_space)
+             if isinstance(v, GridSearch)]
+    randoms = [(p, v) for p, v in _walk(param_space) if isinstance(v, Domain)]
+    constants = [(p, v) for p, v in _walk(param_space)
+                 if not isinstance(v, (Domain, GridSearch))]
+    grid_combos = (list(itertools.product(*[vals for _, vals in grids]))
+                   if grids else [()])
+    variants = []
+    for combo in grid_combos:
+        for _ in range(num_samples):
+            cfg: Dict[str, Any] = {}
+            for p, v in constants:
+                _set_path(cfg, p, copy.deepcopy(v))
+            for (p, _), val in zip(grids, combo):
+                _set_path(cfg, p, val)
+            for p, dom in randoms:
+                _set_path(cfg, p, dom.sample(rng))
+            variants.append(cfg)
+    return variants

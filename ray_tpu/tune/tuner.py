@@ -1,0 +1,303 @@
+"""Tuner: the HPO controller driving trial actors.
+
+Re-design of the reference's ``TuneController`` event loop
+(``python/ray/tune/execution/tune_controller.py:68``; ``Tuner`` at
+``tune/tuner.py:44``): trials are actors created on demand up to
+``max_concurrent_trials``; every ``report`` streams to a collector actor;
+the driver loop applies scheduler decisions (ASHA early-stop kills the
+trial actor; PBT exploit clones a donor checkpoint and restarts with
+mutated hyperparameters).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.trainer import JaxTrainer, Result
+
+from .schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler, PopulationBasedTraining
+from .search import generate_variants
+
+
+class TuneConfig:
+    def __init__(self, *, metric: Optional[str] = None, mode: str = "max",
+                 num_samples: int = 1, scheduler=None,
+                 max_concurrent_trials: Optional[int] = None,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.scheduler = scheduler
+        self.max_concurrent_trials = max_concurrent_trials
+        self.seed = seed
+
+
+@ray_tpu.remote
+class _TuneCollector:
+    def __init__(self):
+        self.reports: Dict[str, List[dict]] = {}
+        self.checkpoints: Dict[str, str] = {}
+        self.cursor: Dict[str, int] = {}
+
+    def push(self, trial_id: str, metrics: dict, checkpoint_path):
+        self.reports.setdefault(trial_id, []).append(metrics)
+        if checkpoint_path:
+            self.checkpoints[trial_id] = checkpoint_path
+        return True
+
+    def new_reports(self):
+        """Reports not yet seen by the controller."""
+        out = []
+        for tid, hist in self.reports.items():
+            start = self.cursor.get(tid, 0)
+            for r in hist[start:]:
+                out.append((tid, r))
+            self.cursor[tid] = len(hist)
+        return out
+
+    def state(self):
+        return {"reports": self.reports, "checkpoints": self.checkpoints}
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Runs one trial's function with a tune session."""
+
+    def run(self, fn_blob: bytes, config: dict, trial_id: str,
+            storage_path: str, exp_name: str, collector,
+            restore_path: Optional[str]):
+        import traceback
+
+        from ray_tpu.train import session as session_mod
+
+        fn = cloudpickle.loads(fn_blob)
+
+        class _TuneReporter:
+            def push(self, rank, metrics, ckpt_path):
+                return collector.push.remote(trial_id, metrics, ckpt_path)
+
+        sess = session_mod.init_session(
+            world_rank=0, world_size=1, local_rank=0,
+            run_name=os.path.join(exp_name, trial_id),
+            storage_path=storage_path,
+            result_actor=None, restore_path=restore_path)
+        # tune-flavored report: inject training_iteration, push via collector
+        orig_report = sess.report
+
+        def tune_report(metrics, checkpoint=None):
+            metrics = dict(metrics)
+            metrics.setdefault("training_iteration", sess.iteration + 1)
+            ckpt_path = None
+            if checkpoint is not None:
+                import shutil
+
+                dest = os.path.join(storage_path, exp_name, trial_id,
+                                    f"checkpoint_{sess.iteration:06d}")
+                if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                    os.makedirs(os.path.dirname(dest), exist_ok=True)
+                    if os.path.exists(dest):
+                        shutil.rmtree(dest)
+                    shutil.copytree(checkpoint.path, dest)
+                ckpt_path = dest
+            sess.iteration += 1
+            ray_tpu.get(collector.push.remote(trial_id, metrics, ckpt_path))
+
+        sess.report = tune_report
+        try:
+            fn(config)
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "err": str(e), "tb": traceback.format_exc()}
+        finally:
+            session_mod.shutdown_session()
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict):
+        self.id = trial_id
+        self.config = config
+        self.state = "PENDING"
+        self.actor = None
+        self.run_ref = None
+        self.restore_path: Optional[str] = None
+        self.killed_by_scheduler = False
+        self.error: Optional[str] = None
+        self.last_result: Optional[dict] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric=None, mode="max"):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        candidates = [r for r in self._results
+                      if r.metrics and metric in r.metrics]
+        if not candidates:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return (max if mode == "max" else min)(candidates, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics or {} for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable, *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        tc = self.tune_config
+        exp_name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        storage = self.run_config.resolved_storage_path()
+        os.makedirs(os.path.join(storage, exp_name), exist_ok=True)
+        scheduler = tc.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", None) is None and hasattr(
+                scheduler, "metric"):
+            scheduler.metric = tc.metric
+        # Trainable normalization: JaxTrainer -> run its loop via fit()
+        if isinstance(self.trainable, JaxTrainer):
+            trainer = self.trainable
+            space = dict(self.param_space)
+
+            def fn(config):
+                import ray_tpu.train.session as sm
+
+                loop_cfg = dict(trainer.train_loop_config or {})
+                loop_cfg.update(config.get("train_loop_config", config))
+                trainer.train_loop(loop_cfg)
+
+            fn_blob = cloudpickle.dumps(fn)
+            variants = generate_variants(
+                space.get("train_loop_config", space),
+                tc.num_samples, tc.seed)
+            variants = [{"train_loop_config": v} for v in variants]
+        else:
+            fn_blob = cloudpickle.dumps(self.trainable)
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+        trials = [Trial(f"trial_{i:04d}", cfg)
+                  for i, cfg in enumerate(variants)]
+        collector = _TuneCollector.remote()
+        try:
+            cpus = ray_tpu.cluster_resources().get("CPU", 2)
+        except Exception:
+            cpus = 2
+        max_concurrent = tc.max_concurrent_trials or max(1, int(cpus))
+        self._run_loop(trials, fn_blob, collector, scheduler, exp_name,
+                       storage, max_concurrent)
+        state = ray_tpu.get(collector.state.remote())
+        results = []
+        for t in trials:
+            hist = state["reports"].get(t.id, [])
+            ckpt = state["checkpoints"].get(t.id)
+            results.append(Result(
+                metrics=hist[-1] if hist else None,
+                checkpoint=Checkpoint(ckpt) if ckpt else None,
+                path=os.path.join(storage, exp_name, t.id),
+                error=RuntimeError(t.error) if t.error else None))
+        try:
+            ray_tpu.kill(collector)
+        except Exception:
+            pass
+        return ResultGrid(results, tc.metric, tc.mode)
+
+    def _run_loop(self, trials, fn_blob, collector, scheduler, exp_name,
+                  storage, max_concurrent):
+        pending = list(trials)
+        running: List[Trial] = []
+        trial_by_id = {t.id: t for t in trials}
+
+        def launch(trial: Trial):
+            trial.actor = _TrialActor.remote()
+            trial.run_ref = trial.actor.run.remote(
+                fn_blob, trial.config, trial.id, storage, exp_name,
+                collector, trial.restore_path)
+            trial.state = "RUNNING"
+            running.append(trial)
+
+        while pending or running:
+            while pending and len(running) < max_concurrent:
+                launch(pending.pop(0))
+            # Drain new reports -> scheduler decisions
+            for tid, result in ray_tpu.get(collector.new_reports.remote()):
+                trial = trial_by_id[tid]
+                trial.last_result = result
+                if trial.state != "RUNNING":
+                    continue
+                decision = scheduler.on_result(tid, result)
+                if decision == STOP:
+                    trial.killed_by_scheduler = True
+                    ray_tpu.kill(trial.actor)
+                elif decision == EXPLOIT and isinstance(
+                        scheduler, PopulationBasedTraining):
+                    donor_id = scheduler.exploit_target(tid)
+                    if donor_id is not None:
+                        donor = trial_by_id[donor_id]
+                        state = ray_tpu.get(collector.state.remote())
+                        donor_ckpt = state["checkpoints"].get(donor_id)
+                        trial.killed_by_scheduler = True
+                        ray_tpu.kill(trial.actor)
+                        # Requeue: donor config mutated + donor checkpoint.
+                        clone = Trial(tid + "r", scheduler.mutate(
+                            dict(donor.config)))
+                        clone.restore_path = donor_ckpt
+                        trial_by_id[clone.id] = clone
+                        trials.append(clone)
+                        pending.append(clone)
+            if not running:
+                continue
+            refs = [t.run_ref for t in running]
+            done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.05)
+            for ref in done:
+                trial = next(t for t in running if t.run_ref == ref)
+                running.remove(trial)
+                try:
+                    out = ray_tpu.get(ref)
+                    if not out.get("ok"):
+                        trial.state = "ERROR"
+                        trial.error = out.get("tb") or out.get("err")
+                    else:
+                        trial.state = "TERMINATED"
+                except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError) as e:
+                    if trial.killed_by_scheduler:
+                        trial.state = "TERMINATED"  # early-stopped
+                    else:
+                        trial.state = "ERROR"
+                        trial.error = str(e)
+                if trial.actor is not None:
+                    try:
+                        ray_tpu.kill(trial.actor)
+                    except Exception:
+                        pass

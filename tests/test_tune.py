@@ -1,0 +1,146 @@
+"""Tune tests (model: reference ``python/ray/tune/tests``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def _objective(config):
+    # Quadratic bowl: best at x=3
+    score = -(config["x"] - 3) ** 2
+    tune.report({"score": score, "x": config["x"]})
+
+
+def test_grid_search(ray_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.metrics["x"] == 3
+
+
+def test_random_search(ray_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"x": tune.uniform(0, 6)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=8, seed=0),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 8
+    assert all(0 <= r.metrics["x"] <= 6 for r in grid if r.metrics)
+
+
+def test_search_space_primitives():
+    from ray_tpu.tune.search import generate_variants
+
+    variants = generate_variants({
+        "a": tune.grid_search([1, 2]),
+        "b": tune.choice(["p", "q"]),
+        "c": tune.randint(0, 10),
+        "d": tune.loguniform(1e-4, 1e-1),
+        "e": "const",
+        "nested": {"f": tune.grid_search([10, 20])},
+    }, num_samples=2, seed=1)
+    assert len(variants) == 2 * 2 * 2  # grid(2) x grid(2) x samples(2)
+    for v in variants:
+        assert v["b"] in ("p", "q")
+        assert 0 <= v["c"] < 10
+        assert 1e-4 <= v["d"] <= 1e-1
+        assert v["e"] == "const"
+        assert v["nested"]["f"] in (10, 20)
+
+
+def test_trial_error_captured(ray_cluster, tmp_path):
+    def bad(config):
+        if config["x"] == 1:
+            raise RuntimeError("trial exploded")
+        tune.report({"score": 1})
+
+    grid = tune.Tuner(
+        bad, param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path))).fit()
+    assert len(grid.errors) == 1
+    assert "trial exploded" in str(grid.errors[0])
+
+
+def test_asha_stops_bad_trials(ray_cluster, tmp_path):
+    """Bad trials stop early at rungs; good trial runs to max_t."""
+
+    def trainable(config):
+        import time
+
+        for i in range(20):
+            tune.report({"score": config["quality"] * (i + 1),
+                         "training_iteration": i + 1})
+            # Weak trials are slower, so the strong trial reaches each rung
+            # first and sets the cutoff (async halving judges late arrivals
+            # against earlier ones — a weak trial that reports first passes
+            # optimistically, which is correct ASHA behavior).
+            time.sleep(0.01 + (1.0 - config["quality"]) * 0.08)
+
+    scheduler = tune.ASHAScheduler(metric="score", mode="max", max_t=20,
+                                   grace_period=2, reduction_factor=2)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([1.0, 0.5, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=scheduler,
+                                    max_concurrent_trials=4),
+        run_config=RunConfig(storage_path=str(tmp_path))).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 20.0  # quality=1.0 ran all 20 iters
+    iters = sorted(r.metrics["training_iteration"] for r in grid
+                   if r.metrics)
+    assert iters[0] < 20  # at least one trial stopped early
+
+
+def test_tune_run_wrapper(ray_cluster, tmp_path):
+    grid = tune.run(_objective, config={"x": tune.grid_search([2, 3])},
+                    metric="score", mode="max",
+                    storage_path=str(tmp_path))
+    assert grid.get_best_result().metrics["x"] == 3
+
+
+def test_pbt_exploit(ray_cluster, tmp_path):
+    """Low performers clone high-performer checkpoints with mutation."""
+
+    def trainable(config):
+        import os
+        import tempfile
+
+        from ray_tpu.train import Checkpoint
+        from ray_tpu.train.checkpoint import load_pytree, save_pytree
+
+        start, value = 0, 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            st = load_pytree(ckpt.path)
+            start, value = int(st["i"]) + 1, float(st["value"])
+        for i in range(start, 12):
+            value += config["lr"]
+            d = tempfile.mkdtemp()
+            save_pytree({"i": i, "value": value}, d)
+            tune.report({"value": value, "training_iteration": i + 1},
+                        checkpoint=Checkpoint.from_directory(d))
+
+    scheduler = tune.PopulationBasedTraining(
+        metric="value", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 1.0]}, seed=0)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=tune.TuneConfig(metric="value", mode="max",
+                                    scheduler=scheduler,
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=str(tmp_path))).fit()
+    best = grid.get_best_result()
+    assert best.metrics["value"] >= 10  # lr=1.0 lineage reaches ~12
