@@ -157,6 +157,13 @@ class _AnyMethodActorHandle(ActorHandle):
         return ActorMethod(self, name)
 
 
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace of task/actor execution events (``ray.timeline``)."""
+    from ray_tpu.util.state import timeline as _timeline
+
+    return _timeline(filename)
+
+
 def nodes() -> List[dict]:
     info = _worker_mod.global_worker().cluster_info()
     return [
@@ -190,7 +197,7 @@ def available_resources() -> Dict[str, float]:
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
-    "available_resources", "ObjectRef", "ActorHandle", "ActorClass",
+    "available_resources", "timeline", "ObjectRef", "ActorHandle", "ActorClass",
     "RemoteFunction", "TaskError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
 ]

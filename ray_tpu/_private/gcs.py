@@ -101,7 +101,9 @@ class WorkerInfo:
 
 class TaskRecord:
     __slots__ = ("task_id", "msg", "owner", "retries_left", "state", "worker_id",
-                 "cancelled", "resources", "pg", "bundle", "strategy", "returns")
+                 "cancelled", "resources", "pg", "bundle", "strategy", "returns",
+                 "name", "ts_created", "ts_running", "ts_done", "error",
+                 "node_id")
 
     def __init__(self, task_id: TaskID, msg: dict, owner: "ClientConn"):
         self.task_id = task_id
@@ -113,9 +115,17 @@ class TaskRecord:
         self.pg = opts.get("pg")
         self.bundle = opts.get("bix")
         self.strategy = opts.get("sched") or "DEFAULT"
+        self.name = opts.get("name", "")
         self.state = "pending"
         self.worker_id: Optional[WorkerID] = None
+        self.node_id: Optional[NodeID] = None
         self.cancelled = False
+        # Task-event timestamps (reference: per-task state-transition events
+        # collected by GcsTaskManager, gcs_task_manager.h:86).
+        self.ts_created = time.time()
+        self.ts_running = 0.0
+        self.ts_done = 0.0
+        self.error = False
         self.returns: List[ObjectID] = [
             ObjectID.for_task_return(task_id, i + 1)
             for i in range(msg.get("nret", 1))
@@ -210,13 +220,27 @@ class GcsServer:
         self._shutdown_event = asyncio.Event()
         self._sched_wakeup = asyncio.Event()
         self._owned_objects: Dict[int, Set[ObjectID]] = {}  # id(client) -> oids
+        # Observability stores (reference: GcsTaskManager task-event store
+        # gcs_task_manager.h:86; metrics agent metrics_agent.py). Both bounded.
+        self._done_tasks: deque = deque()  # TaskID, GC'd beyond max
+        self.max_done_tasks = 10_000
+        self.task_events: deque = deque(maxlen=50_000)
+        # (sender_key, name, tags_tuple) -> metric dict
+        self.metrics: Dict[tuple, dict] = {}
+        self.counters: Dict[str, float] = {
+            "tasks_submitted": 0, "tasks_finished": 0, "tasks_failed": 0,
+            "tasks_retried": 0, "actors_created": 0, "actors_restarted": 0,
+            "objects_stored": 0,
+        }
 
     # ------------------------------------------------------------------ serve
 
-    async def start(self, address: str):
+    async def start(self, address: str, *extra_addresses: str):
         self._server = await protocol.serve(address, self._on_client)
+        self._extra_servers = [await protocol.serve(a, self._on_client)
+                               for a in extra_addresses]
         asyncio.get_running_loop().create_task(self._scheduler_loop())
-        logger.info("GCS listening on %s", address)
+        logger.info("GCS listening on %s", [address, *extra_addresses])
 
     async def wait_shutdown(self):
         await self._shutdown_event.wait()
@@ -295,6 +319,10 @@ class GcsServer:
     def _on_disconnect(self, client: ClientConn):
         if client in self.clients:
             self.clients.remove(client)
+        sender = (client.worker_id.hex() if client.worker_id
+                  else str(id(client)))
+        for key in [k for k in self.metrics if k[0] == sender]:
+            del self.metrics[key]
         if client.role == "worker" and client.worker_id is not None:
             asyncio.get_running_loop().create_task(
                 self._on_worker_death(client.worker_id))
@@ -342,6 +370,7 @@ class GcsServer:
         entry.inline = inline
         entry.on_shm = on_shm
         entry.ready = True
+        self.counters["objects_stored"] += 1
         if on_shm:
             self.shm_bytes += nbytes
         for conn, req in entry.waiters:
@@ -446,6 +475,7 @@ class GcsServer:
     async def _h_submit(self, client, msg):
         tid = TaskID(msg["tid"])
         record = TaskRecord(tid, msg, client)
+        self.counters["tasks_submitted"] += 1
         self.tasks[tid] = record
         for oid in record.returns:
             entry = self._obj(oid)
@@ -589,6 +619,8 @@ class GcsServer:
             worker.acquired = self._acquire(node, record)
             record.state = "running"
             record.worker_id = worker.worker_id
+            record.node_id = node.node_id
+            record.ts_running = time.time()
             fwd = dict(record.msg)
             fwd["t"] = "exec"
             fwd.pop("i", None)
@@ -647,6 +679,12 @@ class GcsServer:
             self._wake_scheduler()
             return
         record.state = "done"
+        record.ts_done = time.time()
+        record.error = bool(msg.get("err"))
+        self.counters["tasks_finished"] += 1
+        if record.error:
+            self.counters["tasks_failed"] += 1
+        self._gc_done_task(record)
         for r in msg["results"]:
             entry = self._obj(ObjectID(r["oid"]))
             self._mark_ready(entry, r["nbytes"], r.get("data"),
@@ -660,6 +698,9 @@ class GcsServer:
         from . import serialization
 
         record.state = "done"
+        record.ts_done = time.time()
+        record.error = True
+        self._gc_done_task(record)
         err = serialization.serialize(
             serialization.TaskCancelledError(record.task_id.hex())).to_bytes()
         results = [{"oid": oid.binary(), "nbytes": len(err), "data": err}
@@ -701,6 +742,7 @@ class GcsServer:
             record.retries_left -= 1
             record.state = "pending"
             record.worker_id = None
+            self.counters["tasks_retried"] += 1
             logger.info("retrying task %s (%d retries left)",
                         tid.hex()[:8], record.retries_left)
             self.pending.append(tid)
@@ -716,6 +758,10 @@ class GcsServer:
                 self._mark_ready(self._obj(ObjectID(r["oid"])), r["nbytes"],
                                  r["data"], False)
             record.state = "done"
+            record.ts_done = time.time()
+            record.error = True
+            self.counters["tasks_failed"] += 1
+            self._gc_done_task(record)
             if not record.owner.conn.closed:
                 record.owner.conn.send({"t": "task_done", "tid": tid.binary(),
                                         "results": results})
@@ -758,6 +804,7 @@ class GcsServer:
                 return
             self.named_actors[key] = aid
         self.actors[aid] = record
+        self.counters["actors_created"] += 1
         client.conn.reply(msg, {"ok": True})
         self._try_place_actor(record)
 
@@ -883,6 +930,7 @@ class GcsServer:
         if (record.restarts_used < record.max_restarts
                 or record.max_restarts < 0):
             record.restarts_used += 1
+            self.counters["actors_restarted"] += 1
             record.state = A_RESTARTING
             record.worker_id = None
             record.addr = None
@@ -1022,6 +1070,134 @@ class GcsServer:
                 "strategy": p.strategy, "bundles": p.bundles}
                for p in self.pgs.values()]
         client.conn.reply(msg, {"ok": True, "pgs": out})
+
+    # -------------------------------------------------- task events / metrics
+
+    def _gc_done_task(self, record: TaskRecord):
+        """Bound the completed-task table (reference: GcsTaskManager caps
+        stored task events, gcs_task_manager.h:86)."""
+        self._done_tasks.append(record.task_id)
+        while len(self._done_tasks) > self.max_done_tasks:
+            old = self._done_tasks.popleft()
+            rec = self.tasks.get(old)
+            if rec is not None and rec.state == "done":
+                del self.tasks[old]
+
+    async def _h_task_events(self, client, msg):
+        """Profile events pushed from worker TaskEventBuffers
+        (reference: task_event_buffer.h:220)."""
+        self.task_events.extend(msg["events"])
+
+    async def _h_metrics_push(self, client, msg):
+        sender = (client.worker_id.hex() if client.worker_id
+                  else str(id(client)))
+        for m in msg["m"]:
+            tags = tuple(sorted((m.get("tags") or {}).items()))
+            self.metrics[(sender, m["name"], tags)] = m
+
+    async def _h_metrics_get(self, client, msg):
+        """Aggregate pushed metrics across processes + GCS-internal counters.
+
+        Counters/sums add across senders; gauges keep the latest per tag-set
+        (mirroring the per-node metrics agent aggregation,
+        python/ray/_private/metrics_agent.py).
+        """
+        agg: Dict[tuple, dict] = {}
+        for (sender, name, tags), m in self.metrics.items():
+            key = (name, tags)
+            cur = agg.get(key)
+            if cur is None:
+                cur = {"name": name, "tags": dict(tags),
+                       "type": m.get("type", "gauge"), "value": 0.0}
+                agg[key] = cur
+            if m.get("type") == "gauge":
+                cur["value"] = m.get("value", 0.0)
+            else:
+                cur["value"] += m.get("value", 0.0)
+            if m.get("buckets"):
+                buckets = cur.setdefault("buckets", {})
+                for b, c in m["buckets"].items():
+                    buckets[b] = buckets.get(b, 0) + c
+                cur["count"] = cur.get("count", 0) + m.get("count", 0)
+        out = list(agg.values())
+        for name, v in self.counters.items():
+            out.append({"name": f"gcs_{name}", "tags": {}, "type": "counter",
+                        "value": v})
+        out.append({"name": "gcs_object_store_bytes", "tags": {},
+                    "type": "gauge", "value": float(self.shm_bytes)})
+        out.append({"name": "gcs_pending_tasks", "tags": {}, "type": "gauge",
+                    "value": float(len(self.pending))})
+        out.append({"name": "gcs_alive_nodes", "tags": {}, "type": "gauge",
+                    "value": float(sum(1 for n in self.nodes.values()
+                                       if n.alive))})
+        out.append({"name": "gcs_alive_actors", "tags": {}, "type": "gauge",
+                    "value": float(sum(1 for a in self.actors.values()
+                                       if a.state == A_ALIVE))})
+        client.conn.reply(msg, {"ok": True, "metrics": out})
+
+    async def _h_state_list(self, client, msg):
+        """Unified state listing (reference: state API server side,
+        dashboard/state_aggregator.py sourcing GCS tables)."""
+        kind = msg["kind"]
+        limit = msg.get("limit", 1000)
+        out: List[dict] = []
+        if kind == "nodes":
+            for n in self.nodes.values():
+                out.append({"node_id": n.node_id.hex(), "alive": n.alive,
+                            "hostname": n.hostname, "total": n.total,
+                            "avail": n.avail, "workers": len(n.workers)})
+        elif kind == "workers":
+            for w in self.workers.values():
+                out.append({"worker_id": w.worker_id.hex(),
+                            "node_id": w.node_id.hex(), "pid": w.pid,
+                            "state": w.state,
+                            "actor_id": w.actor_id.hex() if w.actor_id else "",
+                            "task_id": (w.current_task.hex()
+                                        if w.current_task else "")})
+        elif kind == "actors":
+            for a in self.actors.values():
+                out.append({"actor_id": a.actor_id.hex(), "state": a.state,
+                            "name": a.name or "", "namespace": a.namespace,
+                            "node_id": a.node_id.hex() if a.node_id else "",
+                            "pid": (self.workers[a.worker_id].pid
+                                    if a.worker_id in self.workers else 0),
+                            "restarts": a.restarts_used,
+                            "detached": a.detached,
+                            "death_cause": a.death_cause or ""})
+        elif kind == "tasks":
+            for t in self.tasks.values():
+                out.append({"task_id": t.task_id.hex(), "state": t.state,
+                            "name": t.name, "error": t.error,
+                            "node_id": t.node_id.hex() if t.node_id else "",
+                            "worker_id": (t.worker_id.hex()
+                                          if t.worker_id else ""),
+                            "resources": t.resources,
+                            "creation_time": t.ts_created,
+                            "start_time": t.ts_running,
+                            "end_time": t.ts_done})
+        elif kind == "objects":
+            for o in self.objects.values():
+                out.append({"object_id": o.object_id.hex(),
+                            "nbytes": o.nbytes, "ready": o.ready,
+                            "refcount": o.refcount,
+                            "where": ("spilled" if o.spilled else
+                                      "shm" if o.on_shm else "inline"),
+                            "reconstructable": o.producing_task is not None})
+        elif kind == "placement_groups":
+            for p in self.pgs.values():
+                out.append({"pg_id": p.pg_id.hex(), "state": p.state,
+                            "name": p.name, "strategy": p.strategy,
+                            "bundles": p.bundles,
+                            "placement": [nid.hex() if nid else ""
+                                          for nid in p.placement]})
+        elif kind == "task_events":
+            out = list(self.task_events)
+        else:
+            client.conn.reply(msg, {"ok": False,
+                                    "err": f"unknown kind {kind!r}"})
+            return
+        client.conn.reply(msg, {"ok": True, "items": out[:limit],
+                                "total": len(out)})
 
     # ----------------------------------------------------------- inspection
 

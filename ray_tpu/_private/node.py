@@ -32,6 +32,21 @@ def default_session_root() -> str:
     return os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
 
 
+def get_node_ip_address() -> str:
+    """This host's externally-reachable IP (reference:
+    ``services.get_node_ip_address`` — UDP connect trick, no packets sent)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
 def new_session_dir() -> str:
     root = default_session_root()
     name = f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:8]}"
@@ -243,8 +258,13 @@ async def head_amain(args):
                         "object_store_memory", DEFAULT_STORE_CAPACITY)))
     address = "unix:" + os.path.join(args.session_dir, "gcs.sock")
     if args.port:
-        address = f"0.0.0.0:{args.port}"
-    await gcs.start(address)
+        # TCP for remote drivers/agents + the local UDS for same-host
+        # workers (the reference similarly serves gRPC on a port while
+        # workers register over a local socket, node_manager.h:119).
+        await gcs.start(f"0.0.0.0:{args.port}", address)
+        address = f"{args.host or get_node_ip_address()}:{args.port}"
+    else:
+        await gcs.start(address)
     agent = NodeAgent(
         "unix:" + os.path.join(args.session_dir, "gcs.sock"),
         args.session_dir, resources,
@@ -279,6 +299,7 @@ def head_main():
     parser.add_argument("--resources", required=True)
     parser.add_argument("--num-initial-workers", type=int, default=2)
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="")
     parser.add_argument("--no-probe-tpu", action="store_true")
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))

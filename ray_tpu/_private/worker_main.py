@@ -22,6 +22,7 @@ import ctypes
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -52,6 +53,28 @@ class Executor:
         self.cancelled: set = set()
         self.die_after_task = False
         self._server: Optional[asyncio.AbstractServer] = None
+        # TaskEventBuffer (reference: task_event_buffer.h:220): bounded local
+        # buffer of profile events, flushed to the GCS periodically.
+        self.events: List[dict] = []
+
+    def record_event(self, tid: bytes, name: str, kind: str,
+                     start: float, end: float, ok: bool):
+        if len(self.events) < 10_000:
+            self.events.append({
+                "task_id": TaskID(tid).hex() if len(tid) >= 8 else "",
+                "name": name, "kind": kind,
+                "worker_id": self.worker.worker_id.hex(),
+                "node_id": self.worker.node_id.hex()
+                if self.worker.node_id else "",
+                "pid": os.getpid(), "start": start, "end": end, "ok": ok})
+
+    def flush_events(self):
+        if self.events and self.worker.gcs and not self.worker.gcs.closed:
+            batch, self.events = self.events, []
+            try:
+                self.worker.gcs.send({"t": "task_events", "events": batch})
+            except ConnectionError:
+                pass
 
     async def start(self):
         self._server = await protocol.serve(
@@ -166,7 +189,8 @@ class Executor:
         tid = TaskID(tid_bytes)
         blob = pack_error(fn_name, exc).to_bytes()
         return [{"oid": ObjectID.for_task_return(tid, i + 1).binary(),
-                 "nbytes": len(blob), "data": blob} for i in range(nret)]
+                 "nbytes": len(blob), "data": blob, "_err": True}
+                for i in range(nret)]
 
     # ---------------------------------------------------------- normal task
 
@@ -176,14 +200,20 @@ class Executor:
         nret = msg.get("nret", 1)
         opts = msg.get("opts") or {}
         fn_name = opts.get("name", "unknown")
+        t0 = time.time()
+        err = False
         try:
             results = await loop.run_in_executor(
                 self.pool, self._execute_sync, msg, tid, nret, opts)
+            err = any([r.pop("_err", False) for r in results])
         except Exception as e:  # noqa: BLE001
             results = self._error_results(tid, nret, fn_name, e)
+            err = True
+        self.record_event(tid, fn_name, "task", t0, time.time(), not err)
         self.worker.gcs.send({"t": "task_done", "tid": tid,
-                              "results": results})
+                              "results": results, "err": err})
         if self.die_after_task:
+            self.flush_events()
             await asyncio.sleep(0.01)
             os._exit(0)
 
@@ -251,6 +281,8 @@ class Executor:
         tid = msg["tid"]
         nret = msg.get("nret", 1)
         method_name = msg["m"]
+        t0 = time.time()
+        ok = True
         try:
             if self.actor_instance is None:
                 raise serialization.ActorDiedError("actor not initialized")
@@ -268,6 +300,10 @@ class Executor:
                     nret)
         except BaseException as e:  # noqa: BLE001
             results = self._error_results(tid, nret, method_name, e)
+            ok = False
+        for r in results:
+            r.pop("_err", None)
+        self.record_event(tid, method_name, "actor_call", t0, time.time(), ok)
         if not conn.closed:
             conn.reply(msg, {"results": results})
 
@@ -322,6 +358,11 @@ async def amain(args):
     worker.handle_control = handle_control
     await executor.start()
 
+    async def flush_events_loop():
+        while not stop.is_set():
+            await asyncio.sleep(0.5)
+            executor.flush_events()
+
     reader, writer = await protocol.connect(args.gcs)
     worker.gcs = protocol.Connection(
         reader, writer, handler=worker._on_gcs_push,
@@ -341,8 +382,10 @@ async def amain(args):
     worker.store = make_store(worker.session_name)
     set_global_worker(worker)
     worker._flusher_handle = worker.loop.call_later(0.1, worker._flush_refs_cb)
+    asyncio.get_running_loop().create_task(flush_events_loop())
 
     await stop.wait()
+    executor.flush_events()
     worker._flush_refs()
     try:
         os.unlink(listen_path)

@@ -156,8 +156,8 @@ class Dataset:
     # ------------------------------------------------------- execution
 
     def _stream_refs(self, sources=None) -> Iterator[ray_tpu.ObjectRef]:
-        """Streaming executor: bounded in-flight fused tasks, yield refs in
-        completion order (backpressure = window size)."""
+        """Streaming executor: bounded in-flight fused tasks, yielded in
+        submission order (backpressure = window size)."""
         sources = self._sources if sources is None else sources
         try:
             cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
@@ -184,9 +184,11 @@ class Dataset:
                 pending.append(task.remote(src, self._ops))
             if not pending:
                 break
-            ready, pending = ray_tpu.wait(pending, num_returns=1,
-                                          timeout=None)
-            yield from ready
+            # Submission order preserved (deterministic block order, like the
+            # reference's ordered output bundles); the window still keeps
+            # `window` tasks in flight, so pipelining is unaffected.
+            ray_tpu.wait(pending[:1], num_returns=1, timeout=None)
+            yield pending.pop(0)
 
     def materialize(self) -> "MaterializedDataset":
         blocks = ray_tpu.get(list(self._stream_refs()))

@@ -1,0 +1,275 @@
+"""``python -m ray_tpu`` command-line interface.
+
+Analog of the reference's ``ray`` CLI (``python/ray/scripts/scripts.py``):
+``start/stop/status/list/summary/timeline/metrics/job``. Cluster bootstrap
+for multi-host TPU pods: ``start --head --port P`` on the pod's head host,
+``start --address HOST:P`` on every other host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ADDR_FILE = "/tmp/ray_tpu/ray_current_cluster"
+
+
+def _save_address(address: str):
+    os.makedirs(os.path.dirname(ADDR_FILE), exist_ok=True)
+    with open(ADDR_FILE, "w") as f:
+        f.write(address)
+
+
+def _load_address(explicit: str = "") -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    if os.path.exists(ADDR_FILE):
+        return open(ADDR_FILE).read().strip()
+    raise SystemExit("no running cluster found; pass --address or run "
+                     "`python -m ray_tpu start --head` first")
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_start(args):
+    from ray_tpu._private.node import (
+        _AGENT_BOOTSTRAP, _HEAD_BOOTSTRAP, detect_node_resources,
+        new_session_dir, worker_sys_path)
+
+    resources = json.loads(args.resources) if args.resources else None
+    res = detect_node_resources(args.num_cpus, args.num_tpus, resources)
+    env = {**os.environ, "RAY_TPU_SYS_PATH": worker_sys_path()}
+    if args.head:
+        session_dir = new_session_dir()
+        cmd = [sys.executable, "-S", "-c", _HEAD_BOOTSTRAP,
+               "--session-dir", session_dir,
+               "--resources", json.dumps(res),
+               "--num-initial-workers", str(args.num_initial_workers),
+               "--port", str(args.port)]
+        if args.host:
+            cmd += ["--host", args.host]
+        proc = subprocess.Popen(
+            cmd, env=env, start_new_session=True,
+            stdout=open(os.path.join(session_dir, "gcs.out"), "ab"),
+            stderr=subprocess.STDOUT)
+        ready = os.path.join(session_dir, "gcs.ready")
+        deadline = time.time() + 30
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                out = open(os.path.join(session_dir, "gcs.out")).read()
+                raise SystemExit(f"head failed to start:\n{out}")
+            if time.time() > deadline:
+                raise SystemExit("timed out waiting for head")
+            time.sleep(0.05)
+        address = open(ready).read().strip()
+        _save_address(address)
+        print(f"ray_tpu head started (pid {proc.pid}).")
+        print(f"  address: {address}")
+        print(f"  session: {session_dir}")
+        print("Connect with ray_tpu.init("
+              f"address={address!r}) or join hosts with:\n"
+              f"  python -m ray_tpu start --address {address}")
+    else:
+        address = _load_address(args.address)
+        session_dir = new_session_dir()
+        cmd = [sys.executable, "-S", "-c", _AGENT_BOOTSTRAP,
+               "--gcs", address,
+               "--session-dir", session_dir,
+               "--resources", json.dumps(res),
+               "--num-initial-workers", str(args.num_initial_workers)]
+        proc = subprocess.Popen(
+            cmd, env=env, start_new_session=True,
+            stdout=open(os.path.join(session_dir, "agent.out"), "ab"),
+            stderr=subprocess.STDOUT)
+        print(f"ray_tpu node agent started (pid {proc.pid}), "
+              f"joined {address}")
+
+
+def cmd_stop(args):
+    address = _load_address(args.address)
+    try:
+        rt = _connect(address)
+        rt._worker_mod.global_worker().request_gcs({"t": "shutdown"},
+                                                   timeout=5)
+        print("cluster stopped")
+    except Exception as e:  # noqa: BLE001
+        print(f"could not reach cluster at {address}: {e}")
+    try:
+        os.unlink(ADDR_FILE)
+    except OSError:
+        pass
+
+
+def cmd_status(args):
+    rt = _connect(_load_address(args.address))
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    nodes = rt.nodes()
+    print(f"======== Cluster status ({len(nodes)} nodes) ========")
+    print("Resources")
+    for k in sorted(total):
+        used = total[k] - avail.get(k, 0.0)
+        if k == "memory" or k == "object_store_memory":
+            print(f"  {used / 1e9:.1f}GiB/{total[k] / 1e9:.1f}GiB {k}")
+        else:
+            print(f"  {used:g}/{total[k]:g} {k}")
+    print("Nodes")
+    for n in nodes:
+        state = "ALIVE" if n["Alive"] else "DEAD"
+        print(f"  {n['NodeID'][:12]} {state:6} {n['NodeManagerHostname']} "
+              f"workers={n['Workers']}")
+
+
+def cmd_list(args):
+    from ray_tpu.util import state
+
+    _connect(_load_address(args.address))
+    fn = {
+        "nodes": state.list_nodes, "workers": state.list_workers,
+        "actors": state.list_actors, "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }[args.kind]
+    items = fn(limit=args.limit)
+    if args.format == "json":
+        print(json.dumps(items, indent=2, default=str))
+        return
+    if not items:
+        print(f"no {args.kind}")
+        return
+    cols = list(items[0].keys())
+    widths = {c: max(len(c), *(len(str(i.get(c, ""))[:40]) for i in items))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for i in items:
+        print("  ".join(str(i.get(c, ""))[:40].ljust(widths[c])
+                        for c in cols))
+
+
+def cmd_summary(args):
+    from ray_tpu.util import state
+
+    _connect(_load_address(args.address))
+    summary = state.summarize_tasks()
+    for name, states in sorted(summary.items()):
+        desc = ", ".join(f"{k}={v}" for k, v in sorted(states.items()))
+        print(f"{name}: {desc}")
+
+
+def cmd_timeline(args):
+    from ray_tpu.util import state
+
+    _connect(_load_address(args.address))
+    events = state.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
+def cmd_metrics(args):
+    from ray_tpu.util import state
+
+    _connect(_load_address(args.address))
+    sys.stdout.write(state.prometheus_metrics())
+
+
+def cmd_job(args):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient(_load_address(args.address))
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint),
+                                   runtime_env=json.loads(args.runtime_env)
+                                   if args.runtime_env else None)
+        print(f"submitted job {job_id}")
+        if not args.no_wait:
+            status = client.wait_until_finish(job_id)
+            print(f"job {job_id} finished: {status}")
+            sys.stdout.write(client.get_job_logs(job_id))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        client.stop_job(args.job_id)
+        print(f"stopped job {args.job_id}")
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['job_id']}  {j['status']:10}  {j['entrypoint'][:60]}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node or join a cluster")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--host", default="")
+    p.add_argument("--address", default="")
+    p.add_argument("--num-cpus", type=int)
+    p.add_argument("--num-tpus", type=int)
+    p.add_argument("--resources", default="")
+    p.add_argument("--num-initial-workers", type=int, default=2)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the cluster")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster resource summary")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["nodes", "workers", "actors", "tasks",
+                                    "objects", "placement-groups"])
+    p.add_argument("--address", default="")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="task summary by function name")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline", help="export Chrome trace of task events")
+    p.add_argument("--address", default="")
+    p.add_argument("-o", "--output", default="ray_tpu_timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("metrics", help="dump Prometheus metrics")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("job", help="job submission")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", default="")
+    j.add_argument("--runtime-env", default="")
+    j.add_argument("--no-wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+        j.add_argument("--address", default="")
+    j = jsub.add_parser("list")
+    j.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_job)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

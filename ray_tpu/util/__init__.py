@@ -9,8 +9,10 @@ from .scheduling_strategies import (
     PlacementGroupSchedulingStrategy,
 )
 
+from . import metrics, state
+
 __all__ = [
     "PlacementGroup", "placement_group", "remove_placement_group",
     "placement_group_table", "NodeAffinitySchedulingStrategy",
-    "PlacementGroupSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy", "metrics", "state",
 ]

@@ -1,0 +1,157 @@
+"""State API, metrics, task events, timeline (SURVEY §5 observability)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics, state
+
+
+def test_list_nodes_workers(ray_cluster):
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1
+    assert all("node_id" in n and n["alive"] for n in nodes)
+    deadline = time.time() + 10
+    workers = []
+    while time.time() < deadline and not workers:
+        workers = state.list_workers()
+        time.sleep(0.1)
+    assert len(workers) >= 1
+    assert all(w["pid"] > 0 for w in workers)
+
+
+def test_list_tasks_and_events(ray_cluster):
+    @ray_tpu.remote
+    def traced_fn(x):
+        time.sleep(0.01)
+        return x + 1
+
+    refs = [traced_fn.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs) == [1, 2, 3, 4]
+
+    tasks = state.list_tasks(limit=10000)
+    named = [t for t in tasks if t["name"] == "traced_fn"]
+    assert len(named) >= 4
+    done = [t for t in named if t["state"] == "done"]
+    assert len(done) >= 4
+    for t in done:
+        assert t["end_time"] >= t["start_time"] >= t["creation_time"] > 0
+        assert not t["error"]
+
+    # task events flush on a 0.5s cadence from workers
+    deadline = time.time() + 5
+    events = []
+    while time.time() < deadline:
+        events = [e for e in state.list_task_events()
+                  if e["name"] == "traced_fn"]
+        if len(events) >= 4:
+            break
+        time.sleep(0.2)
+    assert len(events) >= 4
+    assert all(e["end"] >= e["start"] for e in events)
+    assert all(e["ok"] for e in events)
+
+
+def test_failed_task_marked(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("x")
+
+    ref = boom.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get(ref)
+    tasks = [t for t in state.list_tasks(limit=10000)
+             if t["name"] == "boom"]
+    assert tasks and any(t["error"] for t in tasks)
+
+
+def test_summarize_tasks(ray_cluster):
+    @ray_tpu.remote
+    def sum_me():
+        return 0
+
+    ray_tpu.get([sum_me.remote() for _ in range(3)])
+    summary = state.summarize_tasks()
+    assert summary.get("sum_me", {}).get("done", 0) >= 3
+
+
+def test_timeline_export(ray_cluster, tmp_path):
+    @ray_tpu.remote
+    def tl_fn():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([tl_fn.remote() for _ in range(2)])
+    time.sleep(1.0)  # event flush
+    out = str(tmp_path / "trace.json")
+    trace = ray_tpu.timeline(out)
+    assert os.path.exists(out)
+    loaded = json.load(open(out))
+    assert len(loaded) == len(trace)
+    mine = [e for e in loaded if e["name"] == "tl_fn"]
+    assert len(mine) >= 2
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in mine)
+
+
+def test_metrics_counter_gauge_histogram(ray_cluster):
+    c = metrics.Counter("test_count", "desc", tag_keys=("k",))
+    c.inc(1, tags={"k": "a"})
+    c.inc(2, tags={"k": "a"})
+    c.inc(5, tags={"k": "b"})
+    g = metrics.Gauge("test_gauge")
+    g.set(42.5)
+    h = metrics.Histogram("test_hist", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(10.0)
+    metrics.flush_now()
+    time.sleep(0.2)
+
+    got = {m["name"]: m for m in state.list_metrics()
+           if m["name"].startswith("test_")}
+    counts = [m for m in state.list_metrics() if m["name"] == "test_count"]
+    assert {tuple(m["tags"].items()): m["value"] for m in counts} == {
+        (("k", "a"),): 3.0, (("k", "b"),): 5.0}
+    assert got["test_gauge"]["value"] == 42.5
+    hist = got["test_hist"]
+    assert hist["buckets"]["0.1"] == 1
+    assert hist["buckets"]["1.0"] == 2
+    assert hist["buckets"]["+Inf"] == 3
+
+
+def test_gcs_internal_metrics(ray_cluster):
+    @ray_tpu.remote
+    def m_task():
+        return 1
+
+    ray_tpu.get(m_task.remote())
+    names = {m["name"]: m["value"] for m in state.list_metrics()}
+    assert names.get("gcs_tasks_submitted", 0) >= 1
+    assert names.get("gcs_tasks_finished", 0) >= 1
+    assert names.get("gcs_alive_nodes", 0) >= 1
+
+
+def test_prometheus_export(ray_cluster):
+    metrics.Gauge("prom_gauge").set(7)
+    text = state.prometheus_metrics()
+    assert "# TYPE prom_gauge gauge" in text
+    assert "prom_gauge 7" in text
+    assert "gcs_tasks_submitted" in text
+
+
+def test_metric_tag_validation(ray_cluster):
+    c = metrics.Counter("tagged", tag_keys=("a",))
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_list_objects_and_pgs(ray_cluster):
+    ref = ray_tpu.put(list(range(100)))
+    objs = state.list_objects(limit=10000)
+    assert any(o["object_id"] == ref.hex() for o in objs)
+    del ref
