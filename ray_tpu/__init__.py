@@ -64,6 +64,15 @@ def init(address: Optional[str] = None, *,
         raise RuntimeError("ray_tpu.init() called twice; use "
                            "ignore_reinit_error=True to allow this.")
     if address is None:
+        # Submitted jobs / joined drivers auto-connect to their cluster
+        # (reference: RAY_ADDRESS, python/ray/_private/worker.py:1262).
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
+    if address == "auto":
+        address = None
+        cur = "/tmp/ray_tpu/ray_current_cluster"
+        if os.path.exists(cur):
+            address = open(cur).read().strip() or None
+    if address is None:
         from ._private.node import HeadNode
 
         res = dict(resources or {})

@@ -363,6 +363,7 @@ async def amain(args):
             await asyncio.sleep(0.5)
             executor.flush_events()
 
+    worker.gcs_address = args.gcs
     reader, writer = await protocol.connect(args.gcs)
     worker.gcs = protocol.Connection(
         reader, writer, handler=worker._on_gcs_push,

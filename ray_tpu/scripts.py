@@ -188,7 +188,12 @@ def cmd_job(args):
 
     client = JobSubmissionClient(_load_address(args.address))
     if args.job_cmd == "submit":
-        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint),
+        import shlex
+
+        words = args.entrypoint
+        if words and words[0] == "--":
+            words = words[1:]
+        job_id = client.submit_job(entrypoint=shlex.join(words),
                                    runtime_env=json.loads(args.runtime_env)
                                    if args.runtime_env else None)
         print(f"submitted job {job_id}")

@@ -1,0 +1,88 @@
+"""Job submission SDK (SURVEY §2.2 job submission)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import (
+    FAILED, RUNNING, STOPPED, SUCCEEDED, JobSubmissionClient)
+
+
+@pytest.fixture(scope="module")
+def client(ray_cluster):
+    return JobSubmissionClient()
+
+
+def test_submit_and_succeed(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    assert job_id.startswith("raysubmit_")
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+
+
+def test_job_uses_cluster(client, tmp_path):
+    """A submitted driver connects back to the same cluster via
+    RAY_TPU_ADDRESS and runs a task on it."""
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"
+        "@ray_tpu.remote\n"
+        "def f(): return 41\n"
+        "print('task says', ray_tpu.get(f.remote()) + 1)\n"
+    )
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_finish(job_id, timeout=120)
+    logs = client.get_job_logs(job_id)
+    assert status == SUCCEEDED, logs
+    assert "task says 42" in logs
+
+
+def test_failing_job(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    assert client.wait_until_finish(job_id, timeout=60) == FAILED
+    assert "exit code 3" in client.get_job_info(job_id)["message"]
+
+
+def test_stop_job(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    deadline = time.time() + 10
+    while client.get_job_status(job_id) != RUNNING and time.time() < deadline:
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finish(job_id, timeout=30) == STOPPED
+
+
+def test_runtime_env_vars(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c "
+                   "'import os; print(\"VAL=\" + os.environ[\"MY_VAR\"])'",
+        runtime_env={"env_vars": {"MY_VAR": "xyz"}})
+    assert client.wait_until_finish(job_id, timeout=60) == SUCCEEDED
+    assert "VAL=xyz" in client.get_job_logs(job_id)
+
+
+def test_list_jobs_and_metadata(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'print(1)'",
+        metadata={"owner": "test"})
+    client.wait_until_finish(job_id, timeout=60)
+    jobs = {j["job_id"]: j for j in client.list_jobs()}
+    assert job_id in jobs
+    assert jobs[job_id]["metadata"] == {"owner": "test"}
+    assert jobs[job_id]["entrypoint"].endswith("'print(1)'")
+
+
+def test_duplicate_submission_id(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'print(1)'",
+        submission_id="fixed_id_1")
+    assert job_id == "fixed_id_1"
+    with pytest.raises(ValueError):
+        client.submit_job(entrypoint="true", submission_id="fixed_id_1")
