@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
+import random
 import struct
 from typing import Any, Awaitable, Callable, Dict, Optional
 
@@ -26,6 +28,34 @@ import msgpack
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
+
+# RPC chaos (reference: src/ray/rpc/rpc_chaos.h:23 — env-var-driven failure
+# injection). ``RAY_TPU_RPC_FAILURE="actor_call=0.2,submit=0.1"`` fails that
+# fraction of outgoing frames of the named types with a ConnectionError
+# before they reach the wire. Client-side only; retry paths must absorb it.
+_rpc_chaos: Dict[str, float] = {}
+
+
+def reload_rpc_chaos():
+    _rpc_chaos.clear()
+    spec = os.environ.get("RAY_TPU_RPC_FAILURE", "")
+    for part in filter(None, spec.split(",")):
+        mtype, _, prob = part.partition("=")
+        try:
+            _rpc_chaos[mtype.strip()] = float(prob)
+        except ValueError:
+            pass
+
+
+reload_rpc_chaos()
+
+
+def _maybe_inject_failure(msg: dict):
+    if _rpc_chaos:
+        prob = _rpc_chaos.get(msg.get("t", ""))
+        if prob and random.random() < prob:
+            raise ConnectionError(
+                f"injected RPC failure for {msg.get('t')!r}")
 
 
 def pack(msg: dict) -> bytes:
@@ -113,6 +143,7 @@ class Connection:
         """Fire-and-forget send."""
         if self._closed:
             raise ConnectionError("connection closed")
+        _maybe_inject_failure(msg)
         self.writer.write(pack(msg))
 
     def request_nowait(self, msg: dict) -> asyncio.Future:
@@ -124,6 +155,7 @@ class Connection:
         """
         if self._closed:
             raise ConnectionError("connection closed")
+        _maybe_inject_failure(msg)
         rid = next(self._req_ids)
         msg["i"] = rid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

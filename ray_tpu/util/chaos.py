@@ -1,0 +1,139 @@
+"""Chaos-testing harness: component killers + RPC fault injection control.
+
+Analog of the reference's chaos tooling: ``ResourceKillerActor`` /
+``WorkerKillerActor`` / ``RayletKiller`` (``python/ray/_private/test_utils.py:
+1433,1500,1536``, driven by ``python/ray/tests/test_chaos.py``) and the C++
+RPC chaos env-var injection (``src/ray/rpc/rpc_chaos.h:23``, see
+``ray_tpu._private.protocol`` for the injection point).
+
+Killer methods are synchronous on purpose: they run on the actor's executor
+thread so the state-API round-trips they make don't re-enter the worker's IO
+loop. ``max_concurrency=2`` lets ``stop()`` land while ``run()`` loops.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class WorkerKillerActor:
+    """Kills busy task-worker processes on an interval (SIGKILL), exercising
+    task retries. Runs until ``stop()``."""
+
+    def __init__(self, kill_interval_s: float = 0.3,
+                 max_kills: int = 1_000_000, seed: int = 0):
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.killed_pids = []
+        self._stop = False
+        self._rng = random.Random(seed)
+
+    def run(self):
+        from ray_tpu.util import state
+
+        while not self._stop and len(self.killed_pids) < self.max_kills:
+            try:
+                victims = [w for w in state.list_workers()
+                           if w["state"] == "busy" and w["pid"] != os.getpid()]
+            except Exception:
+                victims = []
+            if victims:
+                victim = self._rng.choice(victims)
+                try:
+                    os.kill(victim["pid"], signal.SIGKILL)
+                    self.killed_pids.append(victim["pid"])
+                except (ProcessLookupError, PermissionError):
+                    pass
+            time.sleep(self.kill_interval_s)
+        return len(self.killed_pids)
+
+    def stop(self):
+        self._stop = True
+        return list(self.killed_pids)
+
+    def kills(self):
+        return list(self.killed_pids)
+
+
+@ray_tpu.remote
+class ActorKillerActor:
+    """Kills alive actor workers (except itself and excluded names) on an
+    interval, exercising actor restarts."""
+
+    def __init__(self, kill_interval_s: float = 0.5, exclude=()):
+        self.kill_interval_s = kill_interval_s
+        self.exclude = set(exclude) | {"_chaos_actor_killer",
+                                       "_chaos_worker_killer",
+                                       "_ray_tpu_job_manager"}
+        self.killed = 0
+        self._stop = False
+
+    def run(self):
+        from ray_tpu.util import state
+
+        while not self._stop:
+            try:
+                victims = [a for a in state.list_actors()
+                           if a["state"] == "alive"
+                           and a["name"] not in self.exclude
+                           and a["pid"] not in (0, os.getpid())]
+            except Exception:
+                victims = []
+            if victims:
+                victim = random.choice(victims)
+                try:
+                    os.kill(victim["pid"], signal.SIGKILL)
+                    self.killed += 1
+                except (ProcessLookupError, PermissionError):
+                    pass
+            time.sleep(self.kill_interval_s)
+        return self.killed
+
+    def stop(self):
+        self._stop = True
+        return self.killed
+
+
+def get_and_run_worker_killer(kill_interval_s: float = 0.3,
+                              max_kills: int = 1_000_000):
+    """Start a WorkerKillerActor and kick off its kill loop."""
+    killer = WorkerKillerActor.options(
+        name="_chaos_worker_killer", max_concurrency=2).remote(
+            kill_interval_s=kill_interval_s, max_kills=max_kills)
+    killer.run.remote()
+    return killer
+
+
+def get_and_run_actor_killer(kill_interval_s: float = 0.5, exclude=()):
+    killer = ActorKillerActor.options(
+        name="_chaos_actor_killer", max_concurrency=2).remote(
+            kill_interval_s=kill_interval_s, exclude=exclude)
+    killer.run.remote()
+    return killer
+
+
+RPC_FAILURE_ENV = "RAY_TPU_RPC_FAILURE"
+
+
+def set_rpc_failure(spec: str):
+    """Enable client-side RPC chaos in THIS process.
+
+    ``spec`` is ``"type=prob,type=prob"`` — e.g. ``"actor_call=0.2"`` makes
+    20% of outgoing actor_call frames fail with a connection error before
+    hitting the wire (reference: ``RAY_testing_rpc_failure``,
+    ``rpc_chaos.h:23``). Empty string disables.
+    """
+    from ray_tpu._private import protocol
+
+    os.environ[RPC_FAILURE_ENV] = spec
+    protocol.reload_rpc_chaos()
+
+
+def clear_rpc_failure():
+    set_rpc_failure("")

@@ -1,0 +1,123 @@
+"""Chaos / fault-injection suite (reference: python/ray/tests/test_chaos.py,
+test_component_failures*.py, rpc_chaos.h)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import chaos
+
+
+@pytest.fixture()
+def fresh_cluster():
+    """Private cluster per test: killers leave corpses behind."""
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_tasks_survive_worker_killer(fresh_cluster):
+    """200 tasks complete while a killer SIGKILLs busy workers: retries
+    (default 3) absorb every kill."""
+
+    @ray_tpu.remote(max_retries=10)
+    def slow_square(x):
+        time.sleep(0.05)
+        return x * x
+
+    killer = chaos.get_and_run_worker_killer(kill_interval_s=0.2,
+                                             max_kills=15)
+    refs = [slow_square.remote(i) for i in range(200)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == [i * i for i in range(200)]
+    kills = ray_tpu.get(killer.stop.remote())
+    assert len(kills) >= 1, "killer never fired; chaos not exercised"
+
+
+def test_actor_survives_killer_with_restarts(fresh_cluster):
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=-1)
+    class Stateless:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def add(self, a, b):
+            return a + b
+
+    a = Stateless.remote()
+    first_pid = ray_tpu.get(a.pid.remote())
+    killer = chaos.get_and_run_actor_killer(kill_interval_s=0.3)
+    deadline = time.time() + 30
+    restarted = False
+    while time.time() < deadline and not restarted:
+        try:
+            restarted = ray_tpu.get(a.pid.remote(), timeout=10) != first_pid
+        except ray_tpu.ActorDiedError:
+            time.sleep(0.2)
+    ray_tpu.get(killer.stop.remote())
+    assert restarted, "actor was never killed+restarted"
+    # Still functional after restart(s).
+    assert ray_tpu.get(a.add.remote(2, 3), timeout=30) == 5
+
+
+def test_rpc_chaos_actor_calls_retry(fresh_cluster):
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=5)
+    class Echo:
+        def echo(self, x):
+            return x
+
+    e = Echo.remote()
+    assert ray_tpu.get(e.echo.remote(0)) == 0  # warm connection
+    chaos.set_rpc_failure("actor_call=0.3")
+    try:
+        out = ray_tpu.get([e.echo.remote(i) for i in range(50)], timeout=60)
+        assert out == list(range(50))
+    finally:
+        chaos.clear_rpc_failure()
+
+
+def test_rpc_chaos_spec_parsing():
+    from ray_tpu._private import protocol
+
+    chaos.set_rpc_failure("a=0.5, b=1.0,bad,c=oops")
+    try:
+        assert protocol._rpc_chaos == {"a": 0.5, "b": 1.0}
+        hits = 0
+        for _ in range(100):
+            try:
+                protocol._maybe_inject_failure({"t": "b"})
+            except ConnectionError:
+                hits += 1
+        assert hits == 100  # prob 1.0 always fails
+        for _ in range(100):
+            protocol._maybe_inject_failure({"t": "other"})  # never fails
+    finally:
+        chaos.clear_rpc_failure()
+        assert protocol._rpc_chaos == {}
+
+
+def test_detached_actor_survives_driver_exit():
+    """A detached actor outlives its creating driver (reference:
+    lifetime='detached' semantics) within one cluster lifetime."""
+    ray_tpu.init(num_cpus=2, probe_tpu=False, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        class KV:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v):
+                self.d[k] = v
+                return True
+
+            def get(self, k):
+                return self.d.get(k)
+
+        kv = KV.options(name="chaos_kv", lifetime="detached").remote()
+        assert ray_tpu.get(kv.put.remote("a", 1))
+        kv2 = ray_tpu.get_actor("chaos_kv")
+        assert ray_tpu.get(kv2.get.remote("a")) == 1
+    finally:
+        ray_tpu.shutdown()
