@@ -77,6 +77,7 @@ class NodeInfo:
         self.idle_workers: deque = deque()  # WorkerID
         self.workers: Set[WorkerID] = set()
         self.spawning = 0
+        self.last_active = time.time()  # autoscaler idle tracking
 
     def utilization(self) -> float:
         cpu_t = self.total.get("CPU", 0.0)
@@ -1134,6 +1135,35 @@ class GcsServer:
                     "value": float(sum(1 for a in self.actors.values()
                                        if a.state == A_ALIVE))})
         client.conn.reply(msg, {"ok": True, "metrics": out})
+
+    async def _h_autoscaler_state(self, client, msg):
+        """Demand + idle view for the autoscaler (reference: GCS
+        AutoscalerStateService, autoscaler.proto:315 /
+        gcs_autoscaler_state_manager.cc)."""
+        now = time.time()
+        demands: List[Dict[str, float]] = []
+        for tid in self.pending:
+            record = self.tasks.get(tid)
+            if record is not None and record.pg is None:
+                demands.append(record.resources)
+        for a in self.actors.values():
+            if a.state in (A_PENDING, A_RESTARTING) and a.pg is None:
+                demands.append(a.resources)
+        for p in self.pgs.values():
+            if p.state == "pending":
+                demands.extend(p.bundles)
+        nodes = []
+        for n in self.nodes.values():
+            busy = any(
+                (w := self.workers.get(wid)) is not None
+                and w.state in (W_BUSY, W_ACTOR) for wid in n.workers)
+            if busy or demands:
+                n.last_active = now
+            nodes.append({"node_id": n.node_id.hex(), "alive": n.alive,
+                          "total": n.total, "avail": n.avail,
+                          "idle_s": 0.0 if busy else now - n.last_active})
+        client.conn.reply(msg, {"ok": True, "demands": demands,
+                                "nodes": nodes})
 
     async def _h_state_list(self, client, msg):
         """Unified state listing (reference: state API server side,

@@ -308,7 +308,12 @@ def head_main():
 
 async def agent_amain(args):
     resources = json.loads(args.resources)
+    # The launcher (autoscaler provider / cluster_utils) pre-assigns the node
+    # id via env so it can map instances to registered nodes.
+    node_id_hex = os.environ.get("RAY_TPU_NODE_ID")
     agent = NodeAgent(args.gcs, args.session_dir, resources,
+                      node_id=NodeID(bytes.fromhex(node_id_hex))
+                      if node_id_hex else None,
                       num_initial_workers=args.num_initial_workers,
                       env_overrides=json.loads(args.env or "{}"))
     await agent.start()

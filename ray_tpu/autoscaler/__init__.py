@@ -1,0 +1,17 @@
+"""Autoscaler: reconciler-style cluster elasticity (reference: autoscaler v2,
+``python/ray/autoscaler/v2/autoscaler.py:42``)."""
+
+from .autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from .node_provider import (
+    LocalNodeProvider,
+    NodeProvider,
+    TPUSliceNodeProvider,
+)
+from .scheduler import ResourceDemandScheduler
+from .testing import AutoscalingCluster
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "NodeTypeConfig", "NodeProvider",
+    "LocalNodeProvider", "TPUSliceNodeProvider", "ResourceDemandScheduler",
+    "AutoscalingCluster",
+]

@@ -1,0 +1,143 @@
+"""The autoscaler reconciler.
+
+Analog of the reference's v2 ``Autoscaler`` (``autoscaler/v2/autoscaler.py:
+42``) + ``InstanceManager`` state machine (``v2/instance_manager/
+instance_manager.py:29``): each ``update()`` reads the GCS demand/idle view
+(``autoscaler_state``), plans launches with ``ResourceDemandScheduler``,
+launches via the provider, and terminates nodes idle past the timeout
+(never below ``min_workers``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .node_provider import NodeInstance, NodeProvider
+from .scheduler import ResourceDemandScheduler
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+    update_interval_s: float = 1.0
+    # Max instances launched per update round (reference: upscaling_speed).
+    max_launches_per_round: int = 100
+
+    def scheduler_types(self) -> Dict[str, dict]:
+        return {name: {"resources": dict(c.resources),
+                       "min_workers": c.min_workers,
+                       "max_workers": c.max_workers}
+                for name, c in self.node_types.items()}
+
+
+class Autoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
+                 gcs_address: str):
+        self.config = config
+        self.provider = provider
+        self.gcs_address = gcs_address
+        self.scheduler = ResourceDemandScheduler(config.scheduler_types())
+        self._client = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.launched_total = 0
+        self.terminated_total = 0
+
+    # ------------------------------------------------------------ plumbing
+
+    def _gcs(self):
+        if self._client is None or self._client.closed:
+            from ray_tpu._private.worker import Worker
+
+            self._client = Worker(role="driver")
+            self._client.connect(self.gcs_address)
+        return self._client
+
+    def _state(self) -> dict:
+        return self._gcs().request_gcs({"t": "autoscaler_state"}, timeout=10)
+
+    # ----------------------------------------------------------- reconcile
+
+    def update(self) -> dict:
+        """One reconcile round; returns a summary for tests/logging."""
+        state = self._state()
+        instances = self.provider.non_terminated_nodes()
+        by_node_id = {i.node_id_hex: i for i in instances}
+        counts: Dict[str, int] = {}
+        for inst in instances:
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+
+        alive_nodes = [n for n in state["nodes"] if n["alive"]]
+        demands = list(state["demands"])
+        # Capacity the scheduler may pack onto: live node availability.
+        avail = [dict(n["avail"]) for n in alive_nodes]
+        plan = self.scheduler.get_nodes_to_launch(demands, avail, counts)
+
+        launched: List[NodeInstance] = []
+        budget = self.config.max_launches_per_round
+        for name, count in plan.items():
+            cfg = self.config.node_types[name]
+            for _ in range(min(count, budget)):
+                launched.append(self.provider.create_node(
+                    name, dict(cfg.resources)))
+                budget -= 1
+        self.launched_total += len(launched)
+
+        # Idle termination: only provider-managed nodes, never below
+        # min_workers, never while demand is pending.
+        terminated = []
+        if not demands:
+            for n in alive_nodes:
+                inst = by_node_id.get(n["node_id"])
+                if inst is None:
+                    continue  # head / externally-managed node
+                cfg = self.config.node_types.get(inst.node_type)
+                min_w = cfg.min_workers if cfg else 0
+                live = counts.get(inst.node_type, 0)
+                if (n["idle_s"] > self.config.idle_timeout_s
+                        and live - len([t for t in terminated
+                                        if t.node_type == inst.node_type])
+                        > min_w):
+                    self.provider.terminate_node(inst.instance_id)
+                    terminated.append(inst)
+        self.terminated_total += len(terminated)
+        return {"demands": len(demands),
+                "launched": [i.node_type for i in launched],
+                "terminated": [i.node_type for i in terminated]}
+
+    # ------------------------------------------------------------- driving
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ray_tpu-autoscaler")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            self._stop.wait(self.config.update_interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._client is not None:
+            self._client.disconnect()
+            self._client = None

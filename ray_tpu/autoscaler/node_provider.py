@@ -1,0 +1,167 @@
+"""Node providers: pluggable "cloud" backends for the autoscaler.
+
+Analog of the reference's ``NodeProvider`` plugin surface
+(``python/ray/autoscaler/node_provider.py``; fake provider
+``autoscaler/_private/fake_multi_node/node_provider.py``; GCP TPU pods
+``_private/gcp/node_provider.py:21,93``). The local provider launches real
+node-agent subprocesses on this machine (the fake-multi-node strategy), so
+autoscaler logic runs against genuinely registering/disappearing nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+
+class NodeInstance:
+    def __init__(self, instance_id: str, node_type: str,
+                 node_id_hex: str, resources: Dict[str, float]):
+        self.instance_id = instance_id
+        self.node_type = node_type
+        self.node_id_hex = node_id_hex
+        self.resources = resources
+        self.created_at = time.time()
+
+
+class NodeProvider(ABC):
+    """Minimal provider contract the reconciler drives."""
+
+    @abstractmethod
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> NodeInstance:
+        ...
+
+    @abstractmethod
+    def terminate_node(self, instance_id: str):
+        ...
+
+    @abstractmethod
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        ...
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launches node agents as subprocesses joined to a running cluster."""
+
+    def __init__(self, gcs_address: str, session_dir: str,
+                 num_initial_workers: int = 1):
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.num_initial_workers = num_initial_workers
+        self._instances: Dict[str, NodeInstance] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> NodeInstance:
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.node import _AGENT_BOOTSTRAP, worker_sys_path
+
+        node_id = NodeID.from_random()
+        instance_id = f"local-{uuid.uuid4().hex[:8]}"
+        res = dict(resources)
+        res.setdefault("memory", 1 << 30)
+        res.setdefault("object_store_memory", 1 << 30)
+        proc = subprocess.Popen(
+            [sys.executable, "-S", "-c", _AGENT_BOOTSTRAP,
+             "--gcs", self.gcs_address,
+             "--session-dir", self.session_dir,
+             "--resources", json.dumps(res),
+             "--num-initial-workers", str(self.num_initial_workers)],
+            start_new_session=True,
+            stdout=open(os.path.join(
+                self.session_dir, f"as-agent-{instance_id}.out"), "ab"),
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "RAY_TPU_NODE_ID": node_id.hex(),
+                 "RAY_TPU_SYS_PATH": worker_sys_path()},
+        )
+        inst = NodeInstance(instance_id, node_type, node_id.hex(), res)
+        with self._lock:
+            self._instances[instance_id] = inst
+            self._procs[instance_id] = proc
+        return inst
+
+    def terminate_node(self, instance_id: str):
+        with self._lock:
+            inst = self._instances.pop(instance_id, None)
+            proc = self._procs.pop(instance_id, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(3)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        return inst
+
+    def non_terminated_nodes(self) -> List[NodeInstance]:
+        with self._lock:
+            out = []
+            for iid, inst in list(self._instances.items()):
+                proc = self._procs.get(iid)
+                if proc is not None and proc.poll() is not None:
+                    # Node died underneath us (chaos, crash).
+                    self._instances.pop(iid, None)
+                    self._procs.pop(iid, None)
+                    continue
+                out.append(inst)
+            return out
+
+    def terminate_all(self):
+        for inst in self.non_terminated_nodes():
+            self.terminate_node(inst.instance_id)
+
+
+class TPUSliceNodeProvider(LocalNodeProvider):
+    """Models TPU pod slices: one "instance" = one slice = N hosts, each
+    host carrying ``chips_per_host`` TPU chips; the slice's first host gets
+    the ``TPU-<gen>-head`` marker resource so gang-scheduling can target
+    whole slices (reference: ``TPUAcceleratorManager`` pod detection,
+    ``python/ray/_private/accelerators/tpu.py:71``; GCPTPU node type,
+    ``gcp/node_provider.py:93``).
+    """
+
+    def __init__(self, gcs_address: str, session_dir: str,
+                 generation: str = "v5p", hosts_per_slice: int = 1,
+                 chips_per_host: int = 4):
+        super().__init__(gcs_address, session_dir)
+        self.generation = generation
+        self.hosts_per_slice = hosts_per_slice
+        self.chips_per_host = chips_per_host
+        self._slice_hosts: Dict[str, List[str]] = {}
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> NodeInstance:
+        slice_name = f"{self.generation}-{uuid.uuid4().hex[:6]}"
+        hosts = []
+        first = None
+        for h in range(self.hosts_per_slice):
+            res = dict(resources)
+            res["TPU"] = float(self.chips_per_host)
+            res[f"TPU-{self.generation}-slice-{slice_name}"] = 1.0
+            if h == 0:
+                res[f"TPU-{self.generation}-head"] = 1.0
+            inst = super().create_node(node_type, res)
+            hosts.append(inst.instance_id)
+            if first is None:
+                first = inst
+        self._slice_hosts[first.instance_id] = hosts
+        return first
+
+    def terminate_node(self, instance_id: str):
+        for host_id in self._slice_hosts.pop(instance_id, [instance_id]):
+            super().terminate_node(host_id)

@@ -1,0 +1,176 @@
+"""Autoscaler v2: scheduler unit tests + end-to-end elasticity on the fake
+provider (SURVEY §4 (b): fake node provider so autoscaler logic is testable
+locally)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalingCluster, ResourceDemandScheduler)
+
+
+# ------------------------------------------------------- scheduler unit tests
+
+
+def test_scheduler_packs_existing_capacity():
+    s = ResourceDemandScheduler(
+        {"m1": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 5}})
+    plan = s.get_nodes_to_launch(
+        demands=[{"CPU": 1}] * 3, node_avail=[{"CPU": 4}],
+        current_counts={})
+    assert plan == {}  # fits on the existing node
+
+
+def test_scheduler_launches_for_overflow():
+    s = ResourceDemandScheduler(
+        {"m1": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 5}})
+    plan = s.get_nodes_to_launch(
+        demands=[{"CPU": 1}] * 10, node_avail=[{"CPU": 2}],
+        current_counts={"m1": 1})
+    # 2 fit on existing; 8 need 2 new 4-CPU nodes.
+    assert plan == {"m1": 2}
+
+
+def test_scheduler_respects_max_workers():
+    s = ResourceDemandScheduler(
+        {"m1": {"resources": {"CPU": 1}, "min_workers": 0, "max_workers": 2}})
+    plan = s.get_nodes_to_launch(
+        demands=[{"CPU": 1}] * 10, node_avail=[], current_counts={"m1": 1})
+    assert plan == {"m1": 1}  # capped at max_workers=2 total
+
+
+def test_scheduler_min_workers_without_demand():
+    s = ResourceDemandScheduler(
+        {"m1": {"resources": {"CPU": 1}, "min_workers": 3, "max_workers": 5}})
+    plan = s.get_nodes_to_launch(demands=[], node_avail=[],
+                                 current_counts={"m1": 1})
+    assert plan == {"m1": 2}
+
+
+def test_scheduler_picks_cheapest_feasible_type():
+    s = ResourceDemandScheduler({
+        "big": {"resources": {"CPU": 16}, "min_workers": 0, "max_workers": 5},
+        "small": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 5},
+    })
+    plan = s.get_nodes_to_launch(demands=[{"CPU": 1}], node_avail=[],
+                                 current_counts={})
+    assert plan == {"small": 1}
+
+
+def test_scheduler_infeasible_demand_ignored():
+    s = ResourceDemandScheduler(
+        {"m1": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 5}})
+    plan = s.get_nodes_to_launch(demands=[{"CPU": 64}], node_avail=[],
+                                 current_counts={})
+    assert plan == {}
+
+
+# --------------------------------------------------------------- end to end
+
+
+def test_autoscaling_cluster_scales_up_and_down():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "cpu_worker": {"resources": {"CPU": 2, "scale_res": 2},
+                           "min_workers": 0, "max_workers": 3},
+        },
+        idle_timeout_s=3.0, update_interval_s=0.25)
+    try:
+        cluster.start()
+        cluster.connect()
+
+        @ray_tpu.remote(num_cpus=1, resources={"scale_res": 1})
+        def needs_worker():
+            time.sleep(0.2)
+            return 1
+
+        # No node has scale_res yet -> autoscaler must launch one.
+        refs = [needs_worker.remote() for _ in range(4)]
+        assert ray_tpu.get(refs, timeout=90) == [1] * 4
+        assert cluster.autoscaler.launched_total >= 1
+        nodes = [n for n in ray_tpu.nodes()
+                 if n["Alive"] and n["Resources"].get("scale_res")]
+        assert len(nodes) >= 1
+
+        # Scale down after idle timeout.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            alive = [n for n in ray_tpu.nodes()
+                     if n["Alive"] and n["Resources"].get("scale_res")]
+            if not alive:
+                break
+            time.sleep(0.5)
+        assert not alive, "idle worker node was never terminated"
+        assert cluster.autoscaler.terminated_total >= 1
+    finally:
+        cluster.shutdown()
+
+
+def test_autoscaling_cluster_min_workers_kept():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "steady": {"resources": {"CPU": 1, "steady_res": 1},
+                       "min_workers": 1, "max_workers": 2},
+        },
+        idle_timeout_s=1.0, update_interval_s=0.25)
+    try:
+        cluster.start()
+        cluster.connect()
+        deadline = time.time() + 60
+        nodes = []
+        while time.time() < deadline:
+            nodes = [n for n in ray_tpu.nodes()
+                     if n["Alive"] and n["Resources"].get("steady_res")]
+            if nodes:
+                break
+            time.sleep(0.25)
+        assert nodes, "min_workers node never launched"
+        # Idle well past the timeout: min_workers floor must hold.
+        time.sleep(3.0)
+        nodes = [n for n in ray_tpu.nodes()
+                 if n["Alive"] and n["Resources"].get("steady_res")]
+        assert nodes, "min_workers node was wrongly terminated"
+    finally:
+        cluster.shutdown()
+
+
+def test_tpu_slice_provider_markers():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "v5p_slice": {"resources": {"CPU": 1},
+                          "min_workers": 1, "max_workers": 2},
+        },
+        idle_timeout_s=30.0, update_interval_s=0.25,
+        tpu=True, generation="v5p", hosts_per_slice=2, chips_per_host=4)
+    try:
+        cluster.start()
+        cluster.connect()
+        deadline = time.time() + 60
+        tpu_nodes = []
+        while time.time() < deadline:
+            tpu_nodes = [n for n in ray_tpu.nodes()
+                         if n["Alive"] and n["Resources"].get("TPU")]
+            if len(tpu_nodes) >= 2:
+                break
+            time.sleep(0.25)
+        assert len(tpu_nodes) == 2, "slice should register 2 hosts"
+        heads = [n for n in tpu_nodes
+                 if any(k.startswith("TPU-v5p-head")
+                        for k in n["Resources"])]
+        assert len(heads) == 1, "exactly one host carries the head marker"
+        assert all(n["Resources"]["TPU"] == 4.0 for n in tpu_nodes)
+        # Gang-schedule onto the slice via the head marker.
+
+        @ray_tpu.remote(num_cpus=0, num_tpus=1,
+                        resources={"TPU-v5p-head": 1})
+        def on_slice_head():
+            return "ok"
+
+        assert ray_tpu.get(on_slice_head.remote(), timeout=60) == "ok"
+    finally:
+        cluster.shutdown()
