@@ -120,6 +120,12 @@ class RemoteFunction:
             self._registered_sessions.add(w.session_name)
         return self._fid
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ``dag/dag_node.py`` bind API)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         w = global_worker()
         fid = self._ensure_registered()
@@ -148,6 +154,13 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._call(self._name, args, kwargs,
                                   self._num_returns, {})
+
+    def bind(self, *args, **kwargs):
+        """Lazy method-call node on a live actor handle."""
+        from ray_tpu.dag import ClassMethodNode, _HandleNode
+
+        return ClassMethodNode(_HandleNode(self._handle), self._name,
+                               args, kwargs)
 
     def options(self, num_returns: Optional[int] = None, **kw):
         m = ActorMethod(self._handle, self._name,
@@ -242,6 +255,12 @@ class ActorClass:
             w.kv_put(self._fid, self._blob, ns="fn")
             self._registered_sessions.add(w.session_name)
         return self._fid
+
+    def bind(self, *args, **kwargs):
+        """Lazy actor-construction DAG node."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         w = global_worker()

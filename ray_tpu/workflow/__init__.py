@@ -1,0 +1,251 @@
+"""Workflows: durable DAG execution with per-step checkpointing + resume.
+
+Analog of the reference's ``python/ray/workflow``: each step of a bound DAG
+runs as a cluster task and its result is persisted to storage
+(``workflow/workflow_storage.py``); re-running or resuming a workflow loads
+completed steps from storage instead of re-executing
+(``workflow_state_from_storage.py``). Step identity is the node's position
+in the deterministic topological order plus the function name.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+
+# Workflow statuses (reference: workflow/common.py WorkflowStatus)
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+RESUMABLE = "RESUMABLE"
+
+_default_storage = None
+_lock = threading.Lock()
+_cancel_flags: Dict[str, bool] = {}
+
+
+def init(storage: Optional[str] = None):
+    """Set the storage root for workflow metadata + step results."""
+    global _default_storage
+    _default_storage = storage or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu_workflows")
+    os.makedirs(_default_storage, exist_ok=True)
+    return _default_storage
+
+
+def _storage() -> str:
+    if _default_storage is None:
+        init()
+    return _default_storage
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage(), workflow_id)
+
+
+def _status_path(workflow_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), "status.json")
+
+
+def _write_status(workflow_id: str, status: str, extra: Optional[dict] = None):
+    os.makedirs(_wf_dir(workflow_id), exist_ok=True)
+    doc = {"workflow_id": workflow_id, "status": status,
+           "updated_at": time.time()}
+    if extra:
+        doc.update(extra)
+    tmp = _status_path(workflow_id) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, _status_path(workflow_id))
+
+
+def _read_status(workflow_id: str) -> dict:
+    try:
+        with open(_status_path(workflow_id)) as f:
+            return json.load(f)
+    except OSError:
+        raise ValueError(f"no workflow with id {workflow_id!r}")
+
+
+def _step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic step id per node: topo index + name."""
+    ids: Dict[int, str] = {}
+    for i, node in enumerate(dag.topo_order()):
+        name = ""
+        if isinstance(node, FunctionNode):
+            name = getattr(node._fn, "__name__", "fn")
+        ids[id(node)] = f"{i:04d}_{name or type(node).__name__}"
+    return ids
+
+
+def _step_path(workflow_id: str, step_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), "steps", f"{step_id}.pkl")
+
+
+class WorkflowCanceledError(RuntimeError):
+    pass
+
+
+def _execute(dag: DAGNode, workflow_id: str, input_args: tuple) -> Any:
+    """Run the DAG, checkpointing each FunctionNode result; previously
+    checkpointed steps short-circuit (the resume path)."""
+    steps_dir = os.path.join(_wf_dir(workflow_id), "steps")
+    os.makedirs(steps_dir, exist_ok=True)
+    # Persist the DAG itself so resume() can re-run without the caller
+    # rebuilding it (reference: workflow spec storage).
+    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
+    if not os.path.exists(dag_path):
+        with open(dag_path, "wb") as f:
+            cloudpickle.dump((dag, input_args), f)
+
+    ids = _step_ids(dag)
+    cache: Dict[int, Any] = {}
+    for node in dag.topo_order():
+        if _cancel_flags.get(workflow_id):
+            raise WorkflowCanceledError(workflow_id)
+        step_id = ids[id(node)]
+        path = _step_path(workflow_id, step_id)
+        if isinstance(node, FunctionNode) and os.path.exists(path):
+            with open(path, "rb") as f:
+                cache[id(node)] = ray_tpu.put(cloudpickle.load(f))
+            continue
+        out = node._execute_self(cache, input_args, {})
+        if isinstance(node, FunctionNode):
+            value = ray_tpu.get(out)  # barrier: durability per step
+            with open(path + ".tmp", "wb") as f:
+                cloudpickle.dump(value, f)
+            os.replace(path + ".tmp", path)
+            out = ray_tpu.put(value)
+        cache[id(node)] = out
+    result = cache[id(dag)]
+    if isinstance(dag, MultiOutputNode):
+        return [ray_tpu.get(r) for r in result]
+    return ray_tpu.get(result)
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        args: tuple = ()) -> Any:
+    """Execute a DAG durably; returns the final output value."""
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000)}"
+    with _lock:
+        _cancel_flags.pop(workflow_id, None)
+    _write_status(workflow_id, RUNNING)
+    try:
+        result = _execute(dag, workflow_id, args)
+    except WorkflowCanceledError:
+        _write_status(workflow_id, CANCELED)
+        raise
+    except Exception as e:
+        _write_status(workflow_id, FAILED, {"error": repr(e)})
+        raise
+    _write_status(workflow_id, SUCCESSFUL)
+    out_path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    with open(out_path, "wb") as f:
+        cloudpickle.dump(result, f)
+    return result
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              args: tuple = ()):
+    """Like run() but returns a concurrent Future."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000)}"
+    pool = ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(run, dag, workflow_id=workflow_id, args=args)
+    fut.workflow_id = workflow_id
+    pool.shutdown(wait=False)
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a FAILED/CANCELED/RESUMABLE workflow; completed steps load
+    from storage (reference: workflow_state_from_storage.py)."""
+    status = _read_status(workflow_id)
+    if status["status"] == SUCCESSFUL:
+        return get_output(workflow_id)
+    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
+    with open(dag_path, "rb") as f:
+        dag, input_args = cloudpickle.load(f)
+    with _lock:
+        _cancel_flags.pop(workflow_id, None)
+    return run(dag, workflow_id=workflow_id, args=input_args)
+
+
+def resume_all() -> List[str]:
+    """Resume every non-successful stored workflow; returns their ids."""
+    resumed = []
+    for wf in list_all():
+        if wf["status"] in (FAILED, CANCELED, RUNNING, RESUMABLE):
+            try:
+                resume(wf["workflow_id"])
+                resumed.append(wf["workflow_id"])
+            except Exception:
+                pass
+    return resumed
+
+
+def get_status(workflow_id: str) -> str:
+    return _read_status(workflow_id)["status"]
+
+
+def get_output(workflow_id: str) -> Any:
+    out_path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    if not os.path.exists(out_path):
+        status = get_status(workflow_id)
+        raise ValueError(
+            f"workflow {workflow_id} has no output (status={status})")
+    with open(out_path, "rb") as f:
+        return cloudpickle.load(f)
+
+
+def get_metadata(workflow_id: str) -> dict:
+    doc = _read_status(workflow_id)
+    steps_dir = os.path.join(_wf_dir(workflow_id), "steps")
+    try:
+        doc["checkpointed_steps"] = sorted(
+            f[:-4] for f in os.listdir(steps_dir) if f.endswith(".pkl"))
+    except OSError:
+        doc["checkpointed_steps"] = []
+    return doc
+
+
+def list_all() -> List[dict]:
+    root = _storage()
+    out = []
+    for name in sorted(os.listdir(root)):
+        try:
+            out.append(_read_status(name))
+        except ValueError:
+            continue
+    return out
+
+
+def cancel(workflow_id: str):
+    """Request cancellation of a workflow running in this process."""
+    with _lock:
+        _cancel_flags[workflow_id] = True
+    _write_status(workflow_id, CANCELED)
+
+
+def delete(workflow_id: str):
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+__all__ = [
+    "init", "run", "run_async", "resume", "resume_all", "get_status",
+    "get_output", "get_metadata", "list_all", "cancel", "delete",
+    "InputNode", "MultiOutputNode",
+    "RUNNING", "SUCCESSFUL", "FAILED", "CANCELED", "RESUMABLE",
+]

@@ -1,0 +1,126 @@
+"""Workflows + DAG binding (reference: python/ray/workflow, python/ray/dag)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(autouse=True)
+def wf_storage(tmp_path):
+    workflow.init(str(tmp_path / "wf"))
+    yield
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def mul(a, b):
+    return a * b
+
+
+def test_dag_bind_execute(ray_cluster):
+    dag = add.bind(mul.bind(2, 3), 4)
+    ref = dag.execute()
+    assert ray_tpu.get(ref) == 10
+
+
+def test_dag_input_node(ray_cluster):
+    with InputNode() as inp:
+        dag = add.bind(inp, 10)
+    assert ray_tpu.get(dag.execute(5)) == 15
+    assert ray_tpu.get(dag.execute(7)) == 17
+
+
+def test_dag_multi_output(ray_cluster):
+    with InputNode() as inp:
+        dag = MultiOutputNode([add.bind(inp, 1), mul.bind(inp, 2)])
+    refs = dag.execute(10)
+    assert ray_tpu.get(refs) == [11, 20]
+
+
+def test_dag_actor_node(ray_cluster):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Acc.bind(100)
+    dag = node.add.bind(5)
+    assert ray_tpu.get(dag.execute()) == 105
+    # Same ClassNode → same actor instance across executions.
+    assert ray_tpu.get(dag.execute()) == 110
+
+
+def test_workflow_run_and_output(ray_cluster):
+    dag = add.bind(mul.bind(3, 3), 1)
+    assert workflow.run(dag, workflow_id="w_basic") == 10
+    assert workflow.get_status("w_basic") == workflow.SUCCESSFUL
+    assert workflow.get_output("w_basic") == 10
+    meta = workflow.get_metadata("w_basic")
+    assert len(meta["checkpointed_steps"]) == 2
+
+
+def test_workflow_resume_skips_done_steps(ray_cluster, tmp_path):
+    """A step that fails on first run but succeeds on resume; the earlier
+    step must NOT re-execute (its count file proves it ran once)."""
+    count_a = tmp_path / "count_a.txt"
+    flag = tmp_path / "fail_once.flag"
+    flag.write_text("fail")
+
+    @ray_tpu.remote(max_retries=0)
+    def step_a():
+        n = int(count_a.read_text()) if count_a.exists() else 0
+        count_a.write_text(str(n + 1))
+        return 5
+
+    @ray_tpu.remote(max_retries=0)
+    def step_b(x):
+        if flag.exists():
+            raise RuntimeError("transient failure")
+        return x * 2
+
+    dag = step_b.bind(step_a.bind())
+    with pytest.raises(RuntimeError):
+        workflow.run(dag, workflow_id="w_resume")
+    assert workflow.get_status("w_resume") == workflow.FAILED
+
+    flag.unlink()  # clear the failure condition
+    assert workflow.resume("w_resume") == 10
+    assert workflow.get_status("w_resume") == workflow.SUCCESSFUL
+    assert count_a.read_text() == "1", "step_a re-executed on resume"
+
+
+def test_workflow_list_and_delete(ray_cluster):
+    workflow.run(add.bind(1, 2), workflow_id="w_list_1")
+    workflow.run(add.bind(3, 4), workflow_id="w_list_2")
+    ids = {w["workflow_id"] for w in workflow.list_all()}
+    assert {"w_list_1", "w_list_2"} <= ids
+    workflow.delete("w_list_1")
+    ids = {w["workflow_id"] for w in workflow.list_all()}
+    assert "w_list_1" not in ids
+
+
+def test_workflow_with_input_args(ray_cluster):
+    with InputNode() as inp:
+        dag = mul.bind(add.bind(inp, 1), 3)
+    assert workflow.run(dag, workflow_id="w_inp", args=(4,)) == 15
+    # Resume of a successful workflow returns the stored output.
+    assert workflow.resume("w_inp") == 15
+
+
+def test_workflow_run_async(ray_cluster):
+    fut = workflow.run_async(add.bind(20, 22), workflow_id="w_async")
+    assert fut.result(timeout=60) == 42
+    assert workflow.get_status("w_async") == workflow.SUCCESSFUL
