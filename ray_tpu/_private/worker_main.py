@@ -53,6 +53,7 @@ class Executor:
         self.cancelled: set = set()
         self.die_after_task = False
         self._server: Optional[asyncio.AbstractServer] = None
+        self.dags: Dict[str, dict] = {}  # compiled-DAG stage plans
         # TaskEventBuffer (reference: task_event_buffer.h:220): bounded local
         # buffer of profile events, flushed to the GCS periodically.
         self.events: List[dict] = []
@@ -92,8 +93,79 @@ class Executor:
             # and we enqueue before any await.
             asyncio.get_running_loop().create_task(
                 self._run_actor_call(conn, msg))
+        elif t == "dag_input":
+            asyncio.get_running_loop().create_task(
+                self._run_dag_stage(conn, msg))
+        elif t == "dag_setup":
+            await self._dag_setup(conn, msg)
+        elif t == "dag_register_sink":
+            d = self.dags.get(msg["dag"])
+            if d is not None:
+                d["sink"] = conn
+            conn.reply(msg, {"ok": d is not None})
+        elif t == "dag_teardown":
+            d = self.dags.pop(msg["dag"], None)
+            if d is not None and d.get("next") is not None:
+                await d["next"].close()
+            conn.reply(msg, {"ok": True})
         elif t == "ping":
             conn.reply(msg, {"ok": True})
+
+    # ------------------------------------------------- compiled DAG stages
+    # Reference: compiled actor pipelines bypassing the normal RPC path
+    # (dag/compiled_dag_node.py:668) over shared-memory/NCCL channels
+    # (experimental/channel/). Here a stage receives its input on its own
+    # socket, executes, and forwards DIRECTLY to the next stage's socket —
+    # one hop per stage instead of a driver round-trip per stage.
+
+    async def _dag_setup(self, conn: protocol.Connection, msg: dict):
+        next_conn = None
+        if msg.get("next_addr"):
+            try:
+                reader, writer = await protocol.connect(msg["next_addr"])
+                next_conn = protocol.Connection(reader, writer)
+                next_conn.start()
+            except OSError as e:
+                conn.reply(msg, {"ok": False, "err": str(e)})
+                return
+        self.dags[msg["dag"]] = {
+            "method": msg["m"], "next": next_conn, "sink": None}
+        conn.reply(msg, {"ok": True})
+
+    async def _run_dag_stage(self, conn: protocol.Connection, msg: dict):
+        loop = asyncio.get_running_loop()
+        d = self.dags.get(msg["dag"])
+        if d is None:
+            return
+        seq = msg["seq"]
+        if msg.get("err"):
+            payload, err = msg["val"], True
+        else:
+            try:
+                payload = await loop.run_in_executor(
+                    self.pool, self._dag_stage_sync, d["method"], msg["val"])
+                err = False
+            except BaseException as e:  # noqa: BLE001
+                payload = pack_error(d["method"], e).to_bytes()
+                err = True
+        out = {"t": "dag_input", "dag": msg["dag"], "seq": seq,
+               "val": payload, "err": err}
+        target = d.get("next")
+        if target is None:
+            out["t"] = "dag_output"
+            target = d.get("sink")
+        if target is not None and not target.closed:
+            try:
+                target.send(out)
+            except ConnectionError:
+                pass
+
+    def _dag_stage_sync(self, method_name: str, blob) -> bytes:
+        if self.actor_instance is None:
+            raise serialization.ActorDiedError("actor not initialized")
+        value = deserialize(memoryview(blob))
+        out = getattr(self.actor_instance, method_name)(value)
+        return serialize(out).to_bytes()
 
     # ------------------------------------------------------------ functions
 

@@ -70,6 +70,13 @@ class DAGNode:
     def _execute_self(self, cache, input_args, input_kwargs):
         raise NotImplementedError
 
+    def experimental_compile(self, max_inflight: int = 10):
+        """Compile this (linear, actor-method) DAG into a persistent
+        pipeline (reference: ``dag/dag_node.py:184``)."""
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, max_inflight=max_inflight)
+
 
 class InputNode(DAGNode):
     """Placeholder for execute()-time input (reference: dag/input_node.py).

@@ -1,0 +1,193 @@
+"""Compiled actor pipelines (aDAG equivalent).
+
+Analog of the reference's ``CompiledDAG`` (``dag/compiled_dag_node.py:668``)
++ channel layer (``experimental/channel/shared_memory_channel.py``,
+``nccl_group.py``): ``dag.experimental_compile()`` pre-resolves a linear
+actor pipeline so each ``execute()`` flows input → stage0 → stage1 → … →
+driver with ONE direct hop per stage (no per-stage driver round-trip, no
+GCS involvement, no function-table lookups). On TPU the tensor hot path
+stays inside jitted programs; this compiled path is the host-side
+orchestration channel (the reference's NCCL channels correspond to in-jit
+ICI collectives here — see ray_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent.futures import Future as SyncFuture
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.worker import global_worker
+from . import ClassMethodNode, ClassNode, DAGNode, InputNode, _HandleNode
+
+
+class CompiledDAGRef:
+    """Future-like handle for one compiled execution."""
+
+    def __init__(self, fut: SyncFuture, dag: "CompiledDAG"):
+        self._fut = fut
+        self._dag = dag
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        blob, err = self._fut.result(timeout)
+        value = serialization.deserialize(memoryview(blob))
+        if err:
+            if isinstance(value, serialization.TaskError):
+                raise value.cause if isinstance(value.cause, Exception) \
+                    else value
+            raise value if isinstance(value, Exception) \
+                else RuntimeError(str(value))
+        return value
+
+
+class CompiledDAG:
+    def __init__(self, dag: DAGNode, max_inflight: int = 10):
+        self._dag = dag
+        self._max_inflight = max_inflight
+        self._dag_id = f"cdag_{uuid.uuid4().hex[:12]}"
+        self._stages: List[dict] = []
+        self._seq = 0
+        self._futures: Dict[int, SyncFuture] = {}
+        self._inflight = threading.Semaphore(max_inflight)
+        self._input_conn: Optional[protocol.Connection] = None
+        self._sink_conn: Optional[protocol.Connection] = None
+        self._torn_down = False
+        self._lock = threading.Lock()
+        self._compile()
+
+    # ------------------------------------------------------------- compile
+
+    def _linearize(self) -> List[ClassMethodNode]:
+        """Validate the DAG is a linear chain of actor-method calls fed by
+        one InputNode; return stages in execution order."""
+        order = [n for n in self._dag.topo_order()
+                 if isinstance(n, ClassMethodNode)]
+        if not order:
+            raise ValueError(
+                "experimental_compile requires actor-method nodes "
+                "(use ActorClass.bind() / method.bind())")
+        prev: DAGNode = None
+        for i, node in enumerate(order):
+            value_args = [a for a in node._bound_args[1:]
+                          if isinstance(a, DAGNode)]
+            if len(node._bound_args) != 2 or node._bound_kwargs:
+                raise ValueError(
+                    "compiled DAGs support single-argument method stages; "
+                    f"stage {i} has {len(node._bound_args) - 1} args")
+            upstream = node._bound_args[1]
+            if i == 0:
+                if not isinstance(upstream, InputNode):
+                    raise ValueError("first stage must consume InputNode")
+            elif upstream is not prev:
+                raise ValueError(
+                    "compiled DAGs must form a linear chain; stage "
+                    f"{i}'s input is not stage {i - 1}'s output")
+            prev = node
+        if self._dag is not prev:
+            raise ValueError("the DAG output must be the last stage")
+        return order
+
+    def _actor_handle(self, node: ClassMethodNode):
+        parent = node._bound_args[0]
+        if isinstance(parent, _HandleNode):
+            return parent._handle
+        if isinstance(parent, ClassNode):
+            return parent._execute_self({}, (), {})
+        raise ValueError("compiled stage must be bound to an actor")
+
+    def _compile(self):
+        w = global_worker()
+        stages = self._linearize()
+        handles = [self._actor_handle(n) for n in stages]
+        addrs = []
+        for h in handles:
+            ac = w.run_async(w._get_actor_conn(h._id))
+            addrs.append(ac.addr)
+        # Set up stages back-to-front so downstream sockets exist first.
+        for i in reversed(range(len(stages))):
+            next_addr = addrs[i + 1] if i + 1 < len(stages) else None
+            ac = w.run_async(w._get_actor_conn(handles[i]._id))
+            reply = w.run_async(ac.conn.request({
+                "t": "dag_setup", "dag": self._dag_id,
+                "m": stages[i]._method, "next_addr": next_addr}))
+            if not reply.get("ok"):
+                raise RuntimeError(
+                    f"dag_setup failed on stage {i}: {reply.get('err')}")
+        # Dedicated driver connections: input to stage0, sink from last.
+        self._input_conn = w.run_async(self._open(addrs[0]))
+        self._sink_conn = w.run_async(self._open(addrs[-1],
+                                                 handler=self._on_sink))
+        reply = w.run_async(self._sink_conn.request(
+            {"t": "dag_register_sink", "dag": self._dag_id}))
+        if not reply.get("ok"):
+            raise RuntimeError("dag_register_sink failed")
+        self._handles = handles
+
+    async def _open(self, addr: str, handler=None) -> protocol.Connection:
+        reader, writer = await protocol.connect(addr)
+        conn = protocol.Connection(reader, writer, handler=handler)
+        conn.start()
+        return conn
+
+    async def _on_sink(self, msg: dict):
+        if msg.get("t") != "dag_output" or msg.get("dag") != self._dag_id:
+            return
+        fut = self._futures.pop(msg["seq"], None)
+        if fut is not None and not fut.done():
+            fut.set_result((msg["val"], msg.get("err", False)))
+        self._inflight.release()
+
+    # ------------------------------------------------------------- execute
+
+    def execute(self, value: Any) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        self._inflight.acquire()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        fut: SyncFuture = SyncFuture()
+        self._futures[seq] = fut
+        blob = serialization.serialize(value).to_bytes()
+        w = global_worker()
+        w.loop.call_soon_threadsafe(self._send_input, {
+            "t": "dag_input", "dag": self._dag_id, "seq": seq, "val": blob})
+        return CompiledDAGRef(fut, self)
+
+    def _send_input(self, msg: dict):
+        try:
+            self._input_conn.send(msg)
+        except ConnectionError as e:
+            fut = self._futures.pop(msg["seq"], None)
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+            self._inflight.release()
+
+    # ------------------------------------------------------------ teardown
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        w = global_worker()
+        for h in getattr(self, "_handles", []):
+            try:
+                ac = w.run_async(w._get_actor_conn(h._id))
+                w.run_async(ac.conn.request(
+                    {"t": "dag_teardown", "dag": self._dag_id}), 5)
+            except Exception:
+                pass
+        for conn in (self._input_conn, self._sink_conn):
+            if conn is not None:
+                try:
+                    w.run_async(conn.close())
+                except Exception:
+                    pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

@@ -1,0 +1,116 @@
+"""Compiled actor pipelines (reference: dag/compiled_dag_node.py aDAGs)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, add):
+        self.add = add
+
+    def step(self, x):
+        return x + self.add
+
+    def boom(self, x):
+        raise ValueError(f"bad input {x}")
+
+
+def test_compiled_linear_pipeline(ray_cluster):
+    a, b, c = Stage.remote(1), Stage.remote(10), Stage.remote(100)
+    with InputNode() as inp:
+        dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(0).get(timeout=30) == 111
+        assert cdag.execute(5).get(timeout=30) == 116
+        # Many executions through the persistent pipeline.
+        refs = [cdag.execute(i) for i in range(50)]
+        assert [r.get(timeout=30) for r in refs] == [111 + i
+                                                    for i in range(50)]
+    finally:
+        cdag.teardown()
+
+
+def test_compiled_with_class_bind(ray_cluster):
+    with InputNode() as inp:
+        dag = Stage.bind(7).step.bind(inp)
+    cdag = dag.experimental_compile()
+    try:
+        assert cdag.execute(1).get(timeout=30) == 8
+    finally:
+        cdag.teardown()
+
+
+def test_compiled_error_propagates(ray_cluster):
+    a, b = Stage.remote(1), Stage.remote(2)
+    with InputNode() as inp:
+        dag = b.step.bind(a.boom.bind(inp))
+    cdag = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="bad input"):
+            cdag.execute(3).get(timeout=30)
+        # Pipeline still alive after an error.
+        with InputNode() as inp2:
+            pass
+    finally:
+        cdag.teardown()
+
+
+def test_compiled_rejects_nonlinear(ray_cluster):
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        d1 = a.step.bind(inp)
+    # Plain function DAGs can't compile.
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with pytest.raises(ValueError):
+        f.bind(1).experimental_compile()
+
+
+def test_compiled_teardown_blocks_execute(ray_cluster):
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        dag = a.step.bind(inp)
+    cdag = dag.experimental_compile()
+    assert cdag.execute(0).get(timeout=30) == 1
+    cdag.teardown()
+    with pytest.raises(RuntimeError):
+        cdag.execute(1)
+
+
+def test_compiled_faster_than_uncompiled(ray_cluster):
+    """The point of compiling: N pipelined executions beat N sequential
+    3-stage driver-orchestrated rounds."""
+    a, b, c = Stage.remote(1), Stage.remote(1), Stage.remote(1)
+    with InputNode() as inp:
+        dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+    cdag = dag.experimental_compile()
+    n = 30
+    try:
+        cdag.execute(0).get(timeout=30)  # warm
+        t0 = time.perf_counter()
+        refs = [cdag.execute(i) for i in range(n)]
+        out_c = [r.get(timeout=60) for r in refs]
+        t_compiled = time.perf_counter() - t0
+
+        ray_tpu.get(c.step.remote(0))  # warm normal path conns
+        t0 = time.perf_counter()
+        out_u = []
+        for i in range(n):
+            x = ray_tpu.get(a.step.remote(i))
+            x = ray_tpu.get(b.step.remote(x))
+            out_u.append(ray_tpu.get(c.step.remote(x)))
+        t_uncompiled = time.perf_counter() - t0
+        assert out_c == out_u
+        assert t_compiled < t_uncompiled, (
+            f"compiled {t_compiled:.4f}s not faster than "
+            f"uncompiled {t_uncompiled:.4f}s")
+    finally:
+        cdag.teardown()
