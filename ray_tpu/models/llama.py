@@ -161,14 +161,19 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     return jnp.dot(x, head.astype(x.dtype))
 
 
+def next_token_targets(tokens: jax.Array) -> jax.Array:
+    """Shifted targets with -100 (ignore) padding the final position."""
+    return jnp.concatenate(
+        [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+
+
 def loss_fn(params, batch, cfg: LlamaConfig, attn_impl=None,
             remat: bool = True):
     """Next-token loss. batch: {"tokens": [B, L]} or {"tokens", "targets"}."""
     tokens = batch["tokens"]
     targets = batch.get("targets")
     if targets is None:
-        targets = jnp.concatenate(
-            [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        targets = next_token_targets(tokens)
     logits = forward(params, tokens, cfg, attn_impl=attn_impl, remat=remat)
     loss, n = cross_entropy_loss(logits, targets)
     return loss

@@ -16,6 +16,15 @@ from .sharding import (
     spec_for,
 )
 from . import collectives
+from .pipeline import (
+    make_pipelined_loss,
+    make_stage_fn,
+    pipeline_shardings,
+    spmd_pipeline,
+    stack_layers,
+    to_pipeline_params,
+    unstack_layers,
+)
 from .ring_attention import make_ring_attention, ring_attention
 from .ulysses import make_ulysses_attention, ulysses_attention
 
@@ -25,4 +34,6 @@ __all__ = [
     "LLAMA_RULES", "spec_for", "shardings_for_tree", "apply_shardings",
     "constrain", "collectives", "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention",
+    "spmd_pipeline", "make_stage_fn", "stack_layers", "unstack_layers",
+    "pipeline_shardings", "make_pipelined_loss", "to_pipeline_params",
 ]

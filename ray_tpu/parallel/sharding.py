@@ -63,6 +63,23 @@ def _tree_paths(tree: PyTree) -> PyTree:
         treedef, [path_str(path) for path, _ in flat])
 
 
+def clean_spec(spec: P, dims: Sequence[int], mesh: Mesh) -> P:
+    """Drop spec axes that don't divide the corresponding dimension."""
+    cleaned = []
+    for i, axis in enumerate(spec):
+        if axis is None or i >= len(dims):
+            cleaned.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        cleaned.append(axis if dims[i] % size == 0 else None)
+    while cleaned and cleaned[-1] is None:
+        cleaned.pop()
+    return P(*cleaned)
+
+
 def shardings_for_tree(tree: PyTree, mesh: Mesh,
                        rules: Sequence[Tuple[str, P]] = LLAMA_RULES) -> PyTree:
     """PartitionSpec tree for a parameter pytree by name patterns.
@@ -74,21 +91,8 @@ def shardings_for_tree(tree: PyTree, mesh: Mesh,
 
     def leaf_sharding(path: str, leaf) -> NamedSharding:
         spec = spec_for(path, rules)
-        # Drop sharded axes that don't divide the dimension.
         dims = getattr(leaf, "shape", ())
-        cleaned = []
-        for i, axis in enumerate(spec):
-            if axis is None or i >= len(dims):
-                cleaned.append(None)
-                continue
-            axes = axis if isinstance(axis, tuple) else (axis,)
-            size = 1
-            for a in axes:
-                size *= mesh.shape[a]
-            cleaned.append(axis if dims[i] % size == 0 else None)
-        while cleaned and cleaned[-1] is None:
-            cleaned.pop()
-        return NamedSharding(mesh, P(*cleaned))
+        return NamedSharding(mesh, clean_spec(spec, dims, mesh))
 
     return jax.tree.map(leaf_sharding, paths, tree)
 
