@@ -11,7 +11,17 @@ from .llama import (
     loss_fn,
 )
 
+from . import mixtral
+from .mixtral import (
+    MIXTRAL_8X7B,
+    MIXTRAL_DEBUG,
+    MixtralConfig,
+    mixtral_shardings,
+)
+
 __all__ = [
     "LlamaConfig", "LLAMA3_8B", "LLAMA3_1B", "LLAMA_DEBUG", "init_params",
     "forward", "loss_fn", "generate_greedy", "generate_sample", "flops_per_token",
+    "mixtral", "MixtralConfig", "MIXTRAL_8X7B", "MIXTRAL_DEBUG",
+    "mixtral_shardings",
 ]

@@ -64,7 +64,9 @@ LLAMA_DEBUG = LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
 
 def _dense(key, shape, dtype, scale=None):
     if scale is None:
-        scale = 1.0 / math.sqrt(shape[0])
+        # fan-in is the second-to-last dim (== dim 0 for 2-D weights,
+        # correct for stacked [E, in, out] expert weights too)
+        scale = 1.0 / math.sqrt(shape[-2] if len(shape) > 1 else shape[0])
     return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
 
 

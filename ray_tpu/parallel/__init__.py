@@ -16,6 +16,12 @@ from .sharding import (
     spec_for,
 )
 from . import collectives
+from .moe import (
+    ep_moe_ffn,
+    expert_shardings,
+    make_ep_moe_ffn,
+    moe_ffn_dense,
+)
 from .pipeline import (
     make_pipelined_loss,
     make_stage_fn,
@@ -36,4 +42,5 @@ __all__ = [
     "ulysses_attention", "make_ulysses_attention",
     "spmd_pipeline", "make_stage_fn", "stack_layers", "unstack_layers",
     "pipeline_shardings", "make_pipelined_loss", "to_pipeline_params",
+    "moe_ffn_dense", "ep_moe_ffn", "make_ep_moe_ffn", "expert_shardings",
 ]

@@ -1,0 +1,216 @@
+"""Expert parallelism: MoE routing + all_to_all dispatch over the ``ep`` axis.
+
+The reference has no MoE/expert-parallel subsystem (SURVEY.md §2
+parallelism inventory — EP "does not exist as a named subsystem"); here it
+is first-class and TPU-native. Experts live sharded over the ``ep`` mesh
+axis; tokens are dispatched to their routed experts with a single
+``lax.all_to_all`` each way (ICI-friendly, compiled into the program by
+XLA), using the capacity-buffer formulation so every shape is static.
+
+Two implementations with identical semantics:
+  * ``moe_ffn_dense`` — computes every expert on every token and weights
+    by the top-k gates. O(E) FLOPs; the correctness oracle and the
+    single-device path.
+  * ``ep_moe_ffn`` — capacity-based dispatch/combine inside ``shard_map``.
+    Exact vs the dense path whenever no token is dropped (capacity_factor
+    high enough); drops lowest-priority assignments otherwise, like
+    Switch/GShard.
+
+Tensor parallelism composes inside the expert FFN the same way as in the
+pipeline stages: col-parallel gate/up, row-parallel down + psum over
+``tp``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def router_probs(x: jax.Array, w_router: jax.Array) -> jax.Array:
+    """Softmax router. x: [..., D], w_router: [D, E] -> [..., E] fp32."""
+    return jax.nn.softmax(
+        jnp.dot(x.astype(jnp.float32), w_router.astype(jnp.float32)))
+
+
+def top_k_gates(probs: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k gate values (renormalized, Mixtral-style) and expert indices."""
+    vals, idx = lax.top_k(probs, k)
+    vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-9)
+    return vals, idx
+
+
+def load_balance_loss(probs: jax.Array, gate_idx: jax.Array,
+                      n_experts: int) -> jax.Array:
+    """Switch-style aux loss: E * sum_e(frac_tokens_e * mean_prob_e)."""
+    assign = jax.nn.one_hot(gate_idx[..., 0], n_experts)  # top-1 assignment
+    frac_tokens = assign.reshape(-1, n_experts).mean(0)
+    mean_probs = probs.reshape(-1, n_experts).mean(0)
+    return n_experts * jnp.sum(frac_tokens * mean_probs)
+
+
+def _expert_ffn(h: jax.Array, experts: Dict[str, jax.Array],
+                tp_psum: bool) -> jax.Array:
+    """SwiGLU over stacked experts. h: [E, S, D], weights [E, D, F]/[E, F, D]."""
+    g = jnp.einsum("esd,edf->esf", h, experts["w_gate"])
+    u = jnp.einsum("esd,edf->esf", h, experts["w_up"])
+    y = jnp.einsum("esf,efd->esd", jax.nn.silu(g) * u, experts["w_down"])
+    if tp_psum:
+        y = lax.psum(y, "tp")
+    return y
+
+
+def moe_ffn_dense(x: jax.Array, w_router: jax.Array,
+                  experts: Dict[str, jax.Array], k: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Reference MoE: all experts computed, gated by top-k weights.
+
+    x: [B, L, D]; experts leaves have leading dim E.
+    Returns (out [B, L, D], aux_loss scalar).
+    """
+    E = w_router.shape[1]
+    probs = router_probs(x, w_router)
+    gate_vals, gate_idx = top_k_gates(probs, k)
+    gates = jnp.sum(
+        jax.nn.one_hot(gate_idx, E) * gate_vals[..., None], axis=-2)  # [B,L,E]
+    B, L, D = x.shape
+    y = _expert_ffn(jnp.repeat(x.reshape(1, B * L, D), E, axis=0),
+                    experts, tp_psum=False)  # [E, B*L, D]
+    out = jnp.einsum("te,etd->td", gates.reshape(B * L, E).astype(y.dtype),
+                     y).reshape(B, L, D)
+    aux = load_balance_loss(probs, gate_idx, E)
+    return out.astype(x.dtype), aux
+
+
+def default_capacity(tokens_per_device: int, n_experts: int, k: int,
+                     capacity_factor: float) -> int:
+    """Static per-expert capacity *per device* (GShard convention): each
+    device may send at most C of its tokens to any one expert, so an
+    expert's total buffer across the group is ep * C = cf * total * k / E."""
+    return max(k, int(math.ceil(
+        capacity_factor * tokens_per_device * k / n_experts)))
+
+
+def ep_moe_ffn(x: jax.Array, w_router: jax.Array,
+               experts_local: Dict[str, jax.Array], k: int,
+               capacity: int, axis: str = "ep", tp_psum: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel MoE inside ``shard_map``.
+
+    x: [B_local, L, D] (this device's token shard — ``ep`` doubles as a
+    data axis for non-MoE compute, so tokens are already distributed).
+    experts_local: this device's expert shard, leading dim E/ep.
+    Returns (out [B_local, L, D], aux_loss scalar, psum-averaged over ep).
+    """
+    ep = lax.axis_size(axis)
+    E = w_router.shape[1]
+    E_local = E // ep
+    B, L, D = x.shape
+    T = B * L
+    xt = x.reshape(T, D)
+
+    probs = router_probs(xt, w_router)           # [T, E]
+    gate_vals, gate_idx = top_k_gates(probs, k)  # [T, k]
+    mask = jax.nn.one_hot(gate_idx, E)           # [T, k, E]
+
+    # Capacity assignment: earlier gate slots get priority, then token
+    # order (GShard). dispatch/combine: [T, E, C].
+    counts = jnp.zeros((E,), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    for j in range(k):
+        m = mask[:, j]                                  # [T, E]
+        pos = jnp.cumsum(m, axis=0) - 1 + counts[None]  # queue position
+        counts = counts + m.sum(0)
+        keep = m * (pos < capacity)
+        slot = jax.nn.one_hot((pos * m).sum(-1).astype(jnp.int32), capacity)
+        d_j = keep[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gate_vals[:, j][:, None, None]
+
+    # Gather each expert's token buffer, then exchange so every device
+    # holds the full (ep * C) buffer for its local experts.
+    buf = jnp.einsum("tec,td->ecd", dispatch, xt.astype(jnp.float32))
+    buf = buf.reshape(ep, E_local, capacity, D)
+    buf = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)
+    buf = buf.transpose(1, 0, 2, 3).reshape(E_local, ep * capacity, D)
+
+    y = _expert_ffn(buf.astype(x.dtype), experts_local, tp_psum=tp_psum)
+
+    # Route results back to the owning tokens.
+    y = y.astype(jnp.float32).reshape(E_local, ep, capacity, D)
+    y = y.transpose(1, 0, 2, 3)
+    y = lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+    y = y.reshape(E, capacity, D)
+    out = jnp.einsum("tec,ecd->td", combine, y).reshape(B, L, D)
+
+    aux = load_balance_loss(probs, gate_idx, E)
+    aux = lax.pmean(aux, axis)
+    return out.astype(x.dtype), aux
+
+
+def make_ep_moe_ffn(mesh, k: int, capacity_factor: float = 2.0,
+                    batch_axes=("dp", "fsdp", "ep")):
+    """shard_map-wrapped expert-parallel MoE over a full mesh.
+
+    Takes global arrays: x [B, L, D] (batch sharded over ``batch_axes``),
+    w_router [D, E] replicated, experts tree with leading dim E sharded
+    over ``ep`` (and tp on the ffn dims). Returns (out, aux).
+    """
+    tp = mesh.shape["tp"]
+
+    expert_specs = {
+        "w_gate": P("ep", None, "tp"),
+        "w_up": P("ep", None, "tp"),
+        "w_down": P("ep", "tp", None),
+    }
+
+    def fn(x, w_router, experts):
+        E = w_router.shape[1]
+        n_data = math.prod(mesh.shape[a] for a in batch_axes)
+        tokens_local = (x.shape[0] // n_data) * x.shape[1]
+        capacity = default_capacity(tokens_local, E, k, capacity_factor)
+
+        def local(x, w_router, experts_local):
+            out, aux = ep_moe_ffn(x, w_router, experts_local, k, capacity,
+                                  tp_psum=tp > 1)
+            # ep_moe_ffn pmeans over ep; the other data axes hold different
+            # token shards, so average those too before claiming P().
+            for a in batch_axes:
+                if a != "ep":
+                    aux = lax.pmean(aux, a)
+            return out, aux
+
+        out, aux = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(batch_axes, None, None), P(), expert_specs),
+            out_specs=(P(batch_axes, None, None), P()),
+            check_vma=False,
+        )(x, w_router, experts)
+        return out, aux
+
+    return fn
+
+
+def expert_shardings(experts: Any, mesh) -> Any:
+    """NamedShardings for a stacked expert tree: dim 0 -> ep, ffn dims tp."""
+    from jax.sharding import NamedSharding
+
+    from .sharding import clean_spec
+
+    specs = {
+        "w_gate": P("ep", "fsdp", "tp"),
+        "w_up": P("ep", "fsdp", "tp"),
+        "w_down": P("ep", "tp", "fsdp"),
+    }
+
+    def one(name, leaf):
+        return NamedSharding(
+            mesh, clean_spec(specs.get(name, P("ep")), leaf.shape, mesh))
+
+    return {name: one(name, leaf) for name, leaf in experts.items()}

@@ -203,10 +203,15 @@ def make_pipelined_loss(mesh, cfg, n_microbatches: int,
     if cfg.n_layers % pp:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={pp}")
-    if cfg.n_heads % mesh.shape["tp"] or cfg.n_kv_heads % mesh.shape["tp"]:
+    tp = mesh.shape["tp"]
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
         raise ValueError(
             f"heads ({cfg.n_heads}/{cfg.n_kv_heads}) not divisible by "
-            f"tp={mesh.shape['tp']}")
+            f"tp={tp}")
+    if cfg.d_ff % tp:
+        # clean_spec would silently drop the tp sharding while the stage
+        # body still psums over tp, double-counting the MLP.
+        raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
