@@ -50,6 +50,7 @@ def init(address: Optional[str] = None, *,
          probe_tpu: bool = True,
          ignore_reinit_error: bool = False,
          object_store_memory: Optional[int] = None,
+         port: int = 0,
          log_to_driver: bool = True):
     """Start (or connect to) a ray_tpu cluster.
 
@@ -72,6 +73,15 @@ def init(address: Optional[str] = None, *,
         cur = "/tmp/ray_tpu/ray_current_cluster"
         if os.path.exists(cur):
             address = open(cur).read().strip() or None
+    client_mode = False
+    if address is not None and address.startswith("ray://"):
+        # Remote-driver ("Ray Client") connection — reference:
+        # ``python/ray/util/client/`` ray:// proxy. Here the same control
+        # protocol serves remote drivers directly; client mode switches the
+        # object plane to the GCS transfer relay since no host store is
+        # shared with the cluster.
+        address = address[len("ray://"):]
+        client_mode = True
     if address is None:
         from ._private.node import HeadNode
 
@@ -81,15 +91,24 @@ def init(address: Optional[str] = None, *,
         _head_node = HeadNode(num_cpus=num_cpus, num_tpus=num_tpus,
                               resources=res or None,
                               num_initial_workers=num_initial_workers,
-                              probe_tpu=probe_tpu)
+                              probe_tpu=probe_tpu, port=port)
         address = _head_node.address
     w = _worker_mod.Worker(role="driver")
     w.namespace = namespace
-    w.connect(address)
+    w.connect(address, client_mode=client_mode)
     _worker_mod.set_global_worker(w)
     _initialized = True
     atexit.register(shutdown)
     return address
+
+
+def client_server_address() -> Optional[str]:
+    """``ray://`` address remote drivers can connect to, if this cluster was
+    started with ``init(port=...)`` (reference: Ray Client server,
+    ``python/ray/util/client/server/``)."""
+    if _head_node is not None and _head_node.tcp_address:
+        return "ray://" + _head_node.tcp_address
+    return None
 
 
 def shutdown():

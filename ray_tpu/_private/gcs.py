@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -135,7 +136,8 @@ class TaskRecord:
 
 class ObjectEntry:
     __slots__ = ("object_id", "nbytes", "ready", "inline", "on_shm", "refcount",
-                 "waiters", "producing_task", "spilled")
+                 "waiters", "producing_task", "spilled", "holders", "owner",
+                 "sightings")
 
     def __init__(self, object_id: ObjectID):
         self.object_id = object_id
@@ -147,6 +149,20 @@ class ObjectEntry:
         self.waiters: List[Tuple[protocol.Connection, dict]] = []
         self.producing_task: Optional[dict] = None  # retained spec for recon
         self.spilled: Optional[str] = None
+        # Object-directory bits (reference: ObjectDirectory on the
+        # object-location pubsub channel, object_manager/object_directory.h):
+        # which nodes' host stores hold the bytes, and the owning client conn
+        # (serves uploads for store namespaces no node shares, e.g. remote
+        # ray:// client drivers).
+        self.holders: Set[bytes] = set()
+        self.owner: Optional["ClientConn"] = None
+        # Client serials that may hold zero-copy views of this object (were
+        # handed a "shm" reply). The arena-backed native store must never
+        # free a block such a client could still map — plasma's client-pin
+        # rule (plasma never evicts objects with active client references);
+        # per-object-segment stores don't need it (unlink keeps live
+        # mappings valid).
+        self.sightings: Set[int] = set()
 
 
 class ActorRecord:
@@ -186,12 +202,16 @@ class PGRecord:
         self.ready_waiters: List[Tuple[protocol.Connection, dict]] = []
 
 
+_client_serial = iter(range(1, 1 << 62)).__next__
+
+
 class ClientConn:
     """A registered client: driver, worker, or node agent."""
 
     def __init__(self, conn: protocol.Connection):
         self.conn = conn
         self.role = "unknown"
+        self.serial = _client_serial()
         self.worker_id: Optional[WorkerID] = None
         self.node_id: Optional[NodeID] = None
 
@@ -203,6 +223,12 @@ class GcsServer:
         self.session_dir = session_dir
         self.store_capacity = store_capacity
         self.store = make_store(session_name, store_capacity)
+        # Arena-backed stores reuse freed blocks, so deletion while a live
+        # client maps the block corrupts its view; per-object segments are
+        # safe (see ObjectEntry.sightings).
+        self._arena_store = type(self.store).__name__ == "NativeStore"
+        self._live_client_serials: Set[int] = set()
+        self._pull_tasks: Set[asyncio.Task] = set()
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.workers: Dict[WorkerID, WorkerInfo] = {}
         self.tasks: Dict[TaskID, TaskRecord] = {}
@@ -255,6 +281,7 @@ class GcsServer:
         )
         client.conn = conn
         self.clients.append(client)
+        self._live_client_serials.add(client.serial)
         conn.start()
 
     async def _dispatch(self, client: ClientConn, msg: dict):
@@ -320,6 +347,7 @@ class GcsServer:
     def _on_disconnect(self, client: ClientConn):
         if client in self.clients:
             self.clients.remove(client)
+        self._live_client_serials.discard(client.serial)
         sender = (client.worker_id.hex() if client.worker_id
                   else str(id(client)))
         for key in [k for k in self.metrics if k[0] == sender]:
@@ -392,10 +420,15 @@ class GcsServer:
         oid = ObjectID(msg["oid"])
         entry = self._obj(oid)
         if entry.ready:  # duplicate registration
+            if client.node_id is not None and msg.get("shm"):
+                entry.holders.add(client.node_id.binary())
             if msg.get("i") is not None:
                 client.conn.reply(msg, {"ok": True})
             return
         entry.refcount += 1  # the owner's initial reference
+        entry.owner = client
+        if client.node_id is not None and msg.get("shm"):
+            entry.holders.add(client.node_id.binary())
         self._owned_objects.setdefault(id(client), set()).add(oid)
         self._mark_ready(entry, msg["nbytes"], msg.get("data"),
                          msg.get("shm", False))
@@ -405,11 +438,13 @@ class GcsServer:
     async def _h_obj_wait(self, client, msg):
         oid = ObjectID(msg["oid"])
         entry = self._obj(oid)
+        if entry.spilled is not None:
+            self._restore_spilled(entry)
+        entry.sightings.add(client.serial)
         if entry.ready:
             client.conn.reply(msg, self._obj_reply(entry))
-        elif entry.spilled is not None or self._try_reconstruct(entry):
-            entry.waiters.append((client.conn, msg))
         else:
+            self._try_reconstruct(entry)
             entry.waiters.append((client.conn, msg))
 
     async def _h_obj_contains(self, client, msg):
@@ -417,6 +452,69 @@ class GcsServer:
         entry = self.objects.get(oid)
         client.conn.reply(msg, {"ok": True,
                                 "ready": bool(entry and entry.ready)})
+
+    async def _h_obj_pull(self, client, msg):
+        """Serve the raw bytes of an object to a host that doesn't share a
+        store with any holder.
+
+        This is the control-plane half of the reference's object-manager
+        Push/Pull transfer (``object_manager/object_manager.h:117-206``):
+        locate a holder via the object directory, have it upload, relay to
+        the requester. Runs as its own task so a slow holder doesn't block
+        this client's other messages.
+        """
+        task = asyncio.get_running_loop().create_task(
+            self._do_pull(client, msg))
+        # The loop holds tasks weakly; anchor it until done.
+        self._pull_tasks.add(task)
+        task.add_done_callback(self._pull_tasks.discard)
+
+    async def _do_pull(self, client, msg):
+        oid = ObjectID(msg["oid"])
+        entry = self.objects.get(oid)
+        if entry is None or not entry.ready:
+            client.conn.reply(msg, {"ok": False, "err": "object not ready"})
+            return
+        if entry.inline is not None:
+            client.conn.reply(msg, {"ok": True, "data": entry.inline})
+            return
+        if entry.spilled is not None:
+            try:
+                with open(entry.spilled, "rb") as f:
+                    client.conn.reply(msg, {"ok": True, "data": f.read()})
+                return
+            except OSError:
+                pass
+        # Head-host store (the GCS shares it with head-node workers).
+        view = self.store.get(oid, entry.nbytes)
+        if view is not None:
+            try:
+                client.conn.reply(msg, {"ok": True, "data": bytes(view.data)})
+            finally:
+                view.close()
+            return
+        # Relay from a worker on a holder node, else from the owning client
+        # (e.g. a remote ray:// driver whose store nobody shares).
+        uploaders = [w.conn for w in self.workers.values()
+                     if w.node_id.binary() in entry.holders
+                     and not w.conn.closed]
+        if entry.owner is not None and entry.owner.conn is not None \
+                and not entry.owner.conn.closed \
+                and entry.owner.conn is not client.conn:
+            uploaders.append(entry.owner.conn)
+        for conn in uploaders:
+            try:
+                reply = await conn.request(
+                    {"t": "obj_upload", "oid": msg["oid"],
+                     "nbytes": entry.nbytes}, timeout=30)
+            except (ConnectionError, asyncio.TimeoutError):
+                continue
+            if reply.get("ok") and reply.get("data") is not None:
+                client.conn.reply(msg, {"ok": True, "data": reply["data"]})
+                return
+        client.conn.reply(msg, {"ok": False,
+                                "err": f"no holder could serve "
+                                       f"{oid.hex()[:16]}"})
 
     async def _h_ref(self, client, msg):
         for oid_bytes, delta in msg["d"]:
@@ -435,23 +533,134 @@ class GcsServer:
         self.zero_ref_lru[entry.object_id] = entry.nbytes
 
     def _maybe_evict(self):
-        """LRU-evict zero-ref shm objects when over capacity.
+        """LRU-evict zero-ref shm objects when over capacity, then spill
+        referenced ones to disk.
 
-        Mirrors plasma's LRU eviction (``plasma/eviction_policy.h:105``): we
-        never delete a referenced object; zero-ref objects are kept warm until
-        the store passes capacity.
+        Mirrors plasma's LRU eviction (``plasma/eviction_policy.h:105``) plus
+        the raylet's object spilling (``raylet/local_object_manager.h:41``):
+        we never *delete* a referenced object; once zero-ref eviction can't
+        free enough, referenced shm objects are written to session-dir spill
+        files and their shm segments released, restored on demand.
         """
         if self.store_capacity <= 0:
             return
-        while self.shm_bytes > self.store_capacity and self.zero_ref_lru:
+        self._free_to(self.store_capacity)
+
+    def _pinned(self, entry: ObjectEntry) -> bool:
+        """True if an arena-store block may still be mapped by a live
+        client (then it must not be freed; see ObjectEntry.sightings)."""
+        if not self._arena_store:
+            return False
+        entry.sightings &= self._live_client_serials
+        return bool(entry.sightings)
+
+    def _free_to(self, target_bytes: int):
+        skipped = []
+        while self.shm_bytes > target_bytes and self.zero_ref_lru:
             oid, nbytes = self.zero_ref_lru.popitem(last=False)
             entry = self.objects.get(oid)
             if entry is None or not entry.ready:
                 continue
+            if self._pinned(entry):
+                skipped.append((oid, nbytes))
+                continue
             if entry.on_shm:
                 self.store.delete(oid)
                 self.shm_bytes -= nbytes
+            if entry.spilled is not None:
+                try:
+                    os.unlink(entry.spilled)
+                except OSError:
+                    pass
             del self.objects[oid]
+        for oid, nbytes in skipped:
+            self.zero_ref_lru.setdefault(oid, nbytes)
+        if self.shm_bytes > target_bytes:
+            self._spill_until_under(target_bytes)
+
+    async def _h_store_pressure(self, client, msg):
+        """A client's store.create hit allocator exhaustion: free space.
+
+        The backpressure half of plasma's ``CreateRequestQueue``
+        (``plasma/create_request_queue.h``) — evict zero-ref objects, then
+        spill referenced ones, until the request fits.
+        """
+        nbytes = int(msg.get("nbytes", 0))
+        if self.store_capacity > 0:
+            target = max(0, self.store_capacity - nbytes)
+        else:
+            # Unlimited logical capacity but the physical arena filled:
+            # free at least the requested amount.
+            target = max(0, self.shm_bytes - nbytes)
+        self._free_to(target)
+        client.conn.reply(msg, {"ok": True})
+
+    def _spill_dir(self) -> str:
+        path = os.path.join(self.session_dir, "spill")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _spill_until_under(self, target_bytes: int):
+        # Oldest-first over referenced, ready, head-host shm objects.
+        for entry in list(self.objects.values()):
+            if self.shm_bytes <= target_bytes:
+                break
+            if not (entry.ready and entry.on_shm and entry.spilled is None):
+                continue
+            if self._pinned(entry):
+                continue
+            view = self.store.get(entry.object_id, entry.nbytes)
+            if view is None:
+                continue  # lives on another host's store; their agent spills
+            path = os.path.join(self._spill_dir(),
+                                entry.object_id.hex() + ".bin")
+            try:
+                with open(path, "wb") as f:
+                    f.write(view.data)
+            except OSError:
+                logger.exception("spill write failed for %s",
+                                 entry.object_id.hex())
+                continue
+            finally:
+                view.close()
+            entry.spilled = path
+            entry.on_shm = False
+            self.store.delete(entry.object_id)
+            self.shm_bytes -= entry.nbytes
+            logger.info("spilled %s (%d bytes) to %s",
+                        entry.object_id.hex()[:16], entry.nbytes, path)
+
+    def _restore_spilled(self, entry: ObjectEntry) -> bool:
+        """Read a spill file back into the head-host store."""
+        if entry.spilled is None:
+            return True
+        try:
+            with open(entry.spilled, "rb") as f:
+                data = f.read()
+        except OSError:
+            logger.exception("spill restore failed for %s",
+                             entry.object_id.hex())
+            return False
+        try:
+            buf = self.store.create(entry.object_id, len(data))
+            buf[:len(data)] = data
+            self.store.seal(entry.object_id)
+        except FileExistsError:
+            pass
+        except MemoryError:
+            self._free_to(max(0, self.store_capacity - len(data)))
+            buf = self.store.create(entry.object_id, len(data))
+            buf[:len(data)] = data
+            self.store.seal(entry.object_id)
+        try:
+            os.unlink(entry.spilled)
+        except OSError:
+            pass
+        entry.spilled = None
+        entry.on_shm = True
+        self.shm_bytes += entry.nbytes
+        self._maybe_evict()
+        return True
 
     def _try_reconstruct(self, entry: ObjectEntry) -> bool:
         """Lineage reconstruction: resubmit the producing task.
@@ -688,6 +897,8 @@ class GcsServer:
         self._gc_done_task(record)
         for r in msg["results"]:
             entry = self._obj(ObjectID(r["oid"]))
+            if client.node_id is not None and r.get("shm"):
+                entry.holders.add(client.node_id.binary())
             self._mark_ready(entry, r["nbytes"], r.get("data"),
                              r.get("shm", False))
         if record.owner.conn is not None and not record.owner.conn.closed:

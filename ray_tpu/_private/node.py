@@ -85,14 +85,21 @@ def detect_node_resources(num_cpus: Optional[int] = None,
         pass
     out["memory"] = float(mem or 1 << 30)
     out["object_store_memory"] = float(DEFAULT_STORE_CAPACITY)
+    # Chip count requires an explicit signal (option, env override, or the
+    # async libtpu probe) — pod-topology env vars alone aren't trusted
+    # because tunneled/dev hosts export stale topology. Once a count is
+    # known, the accelerator manager contributes the slice markers
+    # (pod-type + head resource) for gang scheduling.
     if num_tpus is not None:
-        if num_tpus > 0:
-            out["TPU"] = float(num_tpus)
+        chips = float(num_tpus)
     else:
-        env_chips = os.environ.get("RAY_TPU_CHIPS")
-        if env_chips:
-            out["TPU"] = float(env_chips)
+        chips = float(os.environ.get("RAY_TPU_CHIPS") or 0)
         # else: async probe later (agent sends update_resources)
+    if chips > 0:
+        from ray_tpu.accelerators import get_accelerator_manager
+
+        out["TPU"] = chips
+        out.update(get_accelerator_manager("TPU").get_pod_slice_markers(chips))
     if resources:
         out.update(resources)
     return out
@@ -195,9 +202,16 @@ class NodeAgent:
         except Exception:
             n = 0
         if n > 0 and self.conn and not self.conn.closed:
+            # Probe confirmed real chips: attach slice markers for
+            # gang scheduling (reference: tpu.py:71 pod-head resource).
+            from ray_tpu.accelerators import get_accelerator_manager
+
+            res = {"TPU": float(n)}
+            res.update(get_accelerator_manager(
+                "TPU").get_pod_slice_markers(n))
             self.conn.send({"t": "update_resources",
                             "node_id": self.node_id.binary(),
-                            "resources": {"TPU": float(n)}})
+                            "resources": res})
 
     def spawn_worker(self):
         env = dict(os.environ)
@@ -271,10 +285,12 @@ async def head_amain(args):
         num_initial_workers=args.num_initial_workers,
         probe_tpu=not args.no_probe_tpu)
     await agent.start()
-    # Signal readiness to the parent driver.
+    # Signal readiness to the parent driver. Atomic rename: the parent
+    # polls for existence and immediately reads the (load-bearing) address.
     ready = os.path.join(args.session_dir, "gcs.ready")
-    with open(ready, "w") as f:
+    with open(ready + ".tmp", "w") as f:
         f.write(address)
+    os.rename(ready + ".tmp", ready)
     try:
         await gcs.wait_shutdown()
     finally:
@@ -338,14 +354,18 @@ class HeadNode:
     """Driver-side handle that spawns and supervises the head process."""
 
     def __init__(self, num_cpus=None, num_tpus=None, resources=None,
-                 num_initial_workers: int = 2, probe_tpu: bool = True):
+                 num_initial_workers: int = 2, probe_tpu: bool = True,
+                 port: int = 0):
         self.session_dir = new_session_dir()
         self.resources = detect_node_resources(num_cpus, num_tpus, resources)
         self.address = "unix:" + os.path.join(self.session_dir, "gcs.sock")
+        self.tcp_address: Optional[str] = None
         cmd = [sys.executable, "-S", "-c", _HEAD_BOOTSTRAP,
                "--session-dir", self.session_dir,
                "--resources", json.dumps(self.resources),
                "--num-initial-workers", str(num_initial_workers)]
+        if port:
+            cmd += ["--port", str(port)]
         if not probe_tpu:
             cmd.append("--no-probe-tpu")
         env = {**os.environ, "RAY_TPU_SYS_PATH": worker_sys_path()}
@@ -364,6 +384,8 @@ class HeadNode:
             if time.time() > deadline:
                 raise TimeoutError("timed out waiting for the head process")
             time.sleep(0.01)
+        if port:
+            self.tcp_address = open(ready).read().strip() or None
 
     def stop(self):
         if self.proc.poll() is None:

@@ -34,6 +34,21 @@ from .ids import ObjectID
 _PREFIX = "rtpu"
 
 
+def _quiet_shm_del(self):
+    # CPython's SharedMemory.__del__ raises a noisy "Exception ignored:
+    # BufferError: cannot close exported pointers exist" at interpreter
+    # shutdown when zero-copy views (numpy arrays over shm) are still alive.
+    # That teardown order is fine for us — the mapping dies with the
+    # process — so swallow it.
+    try:
+        self.close()
+    except (BufferError, OSError):
+        pass
+
+
+shared_memory.SharedMemory.__del__ = _quiet_shm_del
+
+
 def _untrack(shm: shared_memory.SharedMemory):
     """Stop the resource_tracker from owning this segment.
 

@@ -113,7 +113,11 @@ class Connection:
                 if msg is None:
                     break
                 rid = msg.get("i")
-                if rid is not None and rid in self._pending:
+                # "r" marks a reply: requests and replies share the "i"
+                # field but the two sides allocate ids independently, so a
+                # peer-initiated request must not be mistaken for a reply to
+                # ours (both directions issue requests on this connection).
+                if rid is not None and msg.get("r") and rid in self._pending:
                     fut = self._pending.pop(rid)
                     if not fut.done():
                         fut.set_result(msg)
@@ -173,6 +177,7 @@ class Connection:
     def reply(self, req: dict, msg: dict):
         """Send the reply to a received request."""
         msg["i"] = req["i"]
+        msg["r"] = 1
         self.send(msg)
 
     async def drain(self):

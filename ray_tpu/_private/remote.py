@@ -65,6 +65,33 @@ def _strategy_opts(opts: dict) -> dict:
     return out
 
 
+class _PreparedRenvCache:
+    """Per-callable cache of the prepared (uploaded) runtime_env wire form.
+
+    Packaging a working_dir re-zips and re-hashes the whole tree; doing
+    that once per ``.remote()`` call would crater submission throughput,
+    so the wire form is cached per (session, options-identity).
+    """
+
+    __slots__ = ("session", "value")
+
+    def __init__(self):
+        self.session = None
+        self.value = None
+
+
+def _prepared_runtime_env_impl(cache: _PreparedRenvCache, opts: dict):
+    if not opts.get("runtime_env"):
+        return None
+    w = global_worker()
+    if cache.session != w.session_name:
+        from ray_tpu.runtime_env import prepare_runtime_env
+
+        cache.value = prepare_runtime_env(opts["runtime_env"])
+        cache.session = w.session_name
+    return cache.value
+
+
 def _prepare_args(args: tuple, kwargs: dict) -> dict:
     """Serialize call arguments; large blobs go to shared memory.
 
@@ -92,8 +119,12 @@ class RemoteFunction:
         self._blob: Optional[bytes] = None
         self._fid: Optional[str] = None
         self._registered_sessions: set = set()
+        self._renv_cache = _PreparedRenvCache()
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _prepared_runtime_env(self, opts: dict):
+        return _prepared_runtime_env_impl(self._renv_cache, opts)
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -135,8 +166,9 @@ class RemoteFunction:
             "retries": opts.get("max_retries", 3),
             "name": opts.get("name") or self.__name__,
         }
-        if opts.get("runtime_env"):
-            wire_opts["runtime_env"] = opts["runtime_env"]
+        renv = self._prepared_runtime_env(opts)
+        if renv:
+            wire_opts["runtime_env"] = renv
         wire_opts.update(_strategy_opts(opts))
         nret = opts.get("num_returns", 1)
         msg_args = _prepare_args(args, kwargs)
@@ -225,7 +257,11 @@ class ActorClass:
         self._blob: Optional[bytes] = None
         self._fid: Optional[str] = None
         self._registered_sessions: set = set()
+        self._renv_cache = _PreparedRenvCache()
         self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def _prepared_runtime_env(self, opts: dict):
+        return _prepared_runtime_env_impl(self._renv_cache, opts)
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -274,8 +310,9 @@ class ActorClass:
             "lifetime": opts.get("lifetime"),
             "max_concurrency": opts.get("max_concurrency"),
         }
-        if opts.get("runtime_env"):
-            wire_opts["runtime_env"] = opts["runtime_env"]
+        renv = self._prepared_runtime_env(opts)
+        if renv:
+            wire_opts["runtime_env"] = renv
         wire_opts.update(_strategy_opts(opts))
         msg_args = _prepare_args(args, kwargs)
         aid = w.create_actor_msg(fid, msg_args, wire_opts)

@@ -125,6 +125,7 @@ class Worker:
         self.worker_id = WorkerID.from_random()
         self.namespace = "default"
         self.closed = False
+        self.client_mode = False
         self.session_name: Optional[str] = None
         self.session_dir: Optional[str] = None
         self.node_id: Optional[bytes] = None
@@ -149,10 +150,19 @@ class Worker:
 
     def connect(self, gcs_address: str,
                 loop: Optional[asyncio.AbstractEventLoop] = None,
-                node_id: Optional[bytes] = None):
-        """Connect to the GCS. If ``loop`` is None an IO thread is started."""
+                node_id: Optional[bytes] = None,
+                client_mode: bool = False):
+        """Connect to the GCS. If ``loop`` is None an IO thread is started.
+
+        ``client_mode`` is the ``ray://`` remote-driver path (reference:
+        Ray Client, ``python/ray/util/client/``): this process does NOT
+        share a host shm store with any cluster node, so it uses a private
+        store namespace and every non-inline object moves through the GCS
+        object-transfer relay (obj_pull / obj_upload).
+        """
         self.gcs_address = gcs_address
         self.node_id = node_id
+        self.client_mode = client_mode
         if loop is None:
             self.loop = asyncio.new_event_loop()
             self._loop_thread = threading.Thread(
@@ -163,7 +173,10 @@ class Worker:
         hello = self.run_async(self._connect_async(gcs_address))
         self.session_name = hello["session"]
         self.session_dir = hello["session_dir"]
-        self.store = make_store(self.session_name)
+        store_ns = self.session_name
+        if client_mode:
+            store_ns = f"{self.session_name}-c{self.worker_id.hex()[:8]}"
+        self.store = make_store(store_ns)
         if self.role == "driver":
             # Export the driver's import path so workers can unpickle
             # functions defined in driver-side modules (the reference ships
@@ -294,17 +307,49 @@ class Worker:
         else:
             view = self.store.get(object_id, payload)
             if view is None:
-                raise serialization.ObjectLostError(
-                    f"object {object_id.hex()} missing from the local store")
-            try:
-                value = deserialize(view.data)
-            finally:
-                pass  # view kept alive by value's buffers if zero-copy
+                # Not in this host's store: pull through the GCS relay
+                # (other host / remote client / spilled).
+                value = deserialize(memoryview(
+                    self._pull_object(object_id)))
+            else:
+                try:
+                    value = deserialize(view.data)
+                finally:
+                    pass  # view kept alive by value's buffers if zero-copy
         if isinstance(value, TaskError):
             raise value.cause if isinstance(value.cause, Exception) else value
         if isinstance(value, Exception):
             raise value
         return value
+
+    def _pull_object(self, object_id: ObjectID) -> bytes:
+        """Fetch object bytes via the GCS transfer relay; cache locally.
+
+        Client-side half of the reference's object-manager Pull
+        (``object_manager/pull_manager.h:52``).
+        """
+        try:
+            reply = self.request_gcs(
+                {"t": "obj_pull", "oid": object_id.binary()}, timeout=60)
+        except (ConnectionError, TimeoutError) as e:
+            raise serialization.ObjectLostError(
+                f"pull of {object_id.hex()} failed: {e}")
+        if not reply.get("ok") or reply.get("data") is None:
+            raise serialization.ObjectLostError(
+                f"object {object_id.hex()} missing from the local store and "
+                f"unpullable: {reply.get('err', 'no data')}")
+        data = reply["data"]
+        try:
+            # Cache in our host store so repeat reads are zero-copy local.
+            buf = self.store.create(object_id, len(data))
+            buf[:len(data)] = data
+            self.store.seal(object_id)
+            view = self.store.get(object_id, len(data))
+            if view is not None:
+                return view.data
+        except Exception:
+            pass
+        return data
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         futs = [self.object_future(r.id) for r in refs]
@@ -320,6 +365,22 @@ class Worker:
             out.append(self._resolve_value(r.id, where, payload))
         return out
 
+    def create_in_store(self, oid: ObjectID, nbytes: int):
+        """store.create with backpressure: on allocator exhaustion, ask the
+        GCS to evict/spill (reference: plasma ``CreateRequestQueue``
+        backpressure, ``plasma/create_request_queue.h``) and retry."""
+        for attempt in range(6):
+            try:
+                return self.store.create(oid, nbytes)
+            except MemoryError:
+                try:
+                    self.request_gcs({"t": "store_pressure",
+                                      "nbytes": nbytes}, timeout=30)
+                except Exception:
+                    pass
+                time.sleep(0.02 * (attempt + 1))
+        return self.store.create(oid, nbytes)
+
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self._put_counter.next())
         sobj = serialize(value)
@@ -330,7 +391,7 @@ class Worker:
                 "t": "obj_put", "oid": oid.binary(),
                 "nbytes": len(data), "data": data}))
         else:
-            buf = self.store.create(oid, sobj.total_size)
+            buf = self.create_in_store(oid, sobj.total_size)
             sobj.write_into(buf)
             self.store.seal(oid)
             self.run_async(self.gcs.request({
@@ -349,7 +410,7 @@ class Worker:
         """
         if oid is None:
             oid = ObjectID.for_put(self._put_counter.next())
-        buf = self.store.create(oid, sobj.total_size)
+        buf = self.create_in_store(oid, sobj.total_size)
         sobj.write_into(buf)
         self.store.seal(oid)
         if register:
@@ -418,6 +479,19 @@ class Worker:
         t = msg.get("t")
         if t == "task_done":
             self.push_result(msg["tid"], msg["results"])
+        elif t == "obj_upload":
+            # Serve our host store's bytes to the GCS object-transfer relay
+            # (reference: object manager Push, object_manager.h:206).
+            oid = ObjectID(msg["oid"])
+            view = self.store.get(oid, msg.get("nbytes", 0))
+            if view is None:
+                self.gcs.reply(msg, {"ok": False})
+            else:
+                try:
+                    self.gcs.reply(msg, {"ok": True,
+                                         "data": bytes(view.data)})
+                finally:
+                    view.close()
         elif t == "actor_dead":
             aid = ActorID(msg["aid"])
             self._dead_actors[aid] = msg.get("cause", "actor died")

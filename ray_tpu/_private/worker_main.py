@@ -229,14 +229,17 @@ class Executor:
 
     def _apply_runtime_env(self, opts: dict):
         renv = opts.get("runtime_env") or {}
-        env_vars = renv.get("env_vars") or {}
-        if env_vars:
-            os.environ.update({k: str(v) for k, v in env_vars.items()})
-            # Env mutations (e.g. JAX_PLATFORMS) can poison this worker for
-            # other tasks — retire it after this task like the reference's
-            # dedicated runtime-env workers.
-            if self.actor_id is None:
-                self.die_after_task = True
+        if not renv:
+            return
+        from ray_tpu.runtime_env import setup_runtime_env
+
+        ctx = setup_runtime_env(
+            renv, fetch=lambda uri: self.worker.kv_get(uri, ns="pkg"))
+        # Env/cwd/sys.path mutations (e.g. JAX_PLATFORMS) poison this worker
+        # for other tasks — retire it after this task like the reference's
+        # dedicated runtime-env workers.
+        if ctx.taints_worker and self.actor_id is None:
+            self.die_after_task = True
 
     def _pack_results(self, tid_bytes: bytes, values: List[Any],
                       register_shm: bool) -> List[dict]:
@@ -249,7 +252,7 @@ class Executor:
                 out.append({"oid": oid.binary(), "nbytes": sobj.total_size,
                             "data": sobj.to_bytes()})
             else:
-                buf = self.worker.store.create(oid, sobj.total_size)
+                buf = self.worker.create_in_store(oid, sobj.total_size)
                 sobj.write_into(buf)
                 self.worker.store.seal(oid)
                 out.append({"oid": oid.binary(), "nbytes": sobj.total_size,

@@ -1,0 +1,41 @@
+"""Pluggable accelerator managers.
+
+Analog of the reference's ``python/ray/_private/accelerators/`` (ABC in
+``accelerator.py``, TPU pod-slice detection in ``tpu.py:71``
+``TPUAcceleratorManager``). The TPU manager is the load-bearing one here:
+it detects the slice topology from the TPU runtime environment and exposes
+the pod-head marker resource that lets multi-host slices gang-schedule.
+"""
+
+from .accelerator import AcceleratorManager
+from .tpu import TPUAcceleratorManager
+
+_MANAGERS = {"TPU": TPUAcceleratorManager()}
+
+
+def get_accelerator_manager(resource_name: str = "TPU") -> AcceleratorManager:
+    return _MANAGERS[resource_name]
+
+
+def get_all_accelerator_managers():
+    return dict(_MANAGERS)
+
+
+def detect_accelerator_resources() -> dict:
+    """Schedulable resources contributed by every accelerator on this host."""
+    out: dict = {}
+    for mgr in _MANAGERS.values():
+        n = mgr.get_current_node_num_accelerators()
+        if n > 0:
+            out[mgr.resource_name] = float(n)
+        out.update(mgr.get_current_node_extra_resources())
+    return out
+
+
+__all__ = [
+    "AcceleratorManager",
+    "TPUAcceleratorManager",
+    "get_accelerator_manager",
+    "get_all_accelerator_managers",
+    "detect_accelerator_resources",
+]

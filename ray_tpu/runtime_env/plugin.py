@@ -1,0 +1,237 @@
+"""Runtime-env plugin API + built-in plugins.
+
+Analog of the reference's ``python/ray/_private/runtime_env/plugin.py``
+(``RuntimeEnvPlugin`` ABC with per-field ``validate``/``create``/
+``modify_context`` hooks, priority-ordered). Driver side, ``prepare`` turns
+local paths into uploaded content-addressed URIs; worker side, ``create``
+materializes the URI and folds its effect into the ``RuntimeEnvContext``.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from .context import RuntimeEnvContext
+from .packaging import (ensure_local_package, package_directory,
+                        package_file)
+
+
+class RuntimeEnvPlugin:
+    """One plugin per runtime_env key."""
+
+    name: str = ""
+    priority: int = 10  # lower runs first
+
+    def validate(self, value: Any) -> None:
+        """Raise ValueError on a malformed field value."""
+
+    def prepare(self, value: Any, upload: Callable[[str, bytes], None]
+                ) -> Any:
+        """Driver-side: rewrite the value to a wire-safe form (upload any
+        local files via ``upload(uri, data)``). Default: pass through."""
+        return value
+
+    def create(self, value: Any, ctx: RuntimeEnvContext,
+               fetch: Callable[[str], Optional[bytes]]) -> None:
+        """Worker-side: materialize resources and mutate ``ctx``."""
+
+
+_REGISTRY: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a non-empty name")
+    _REGISTRY[plugin.name] = plugin
+
+
+def unregister_plugin(name: str) -> None:
+    _REGISTRY.pop(name, None)
+
+
+def get_plugins() -> List[RuntimeEnvPlugin]:
+    return sorted(_REGISTRY.values(), key=lambda p: (p.priority, p.name))
+
+
+# ------------------------------------------------------------- built-ins
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError("env_vars must be a dict of str->str")
+        for k, v in value.items():
+            if not isinstance(k, str) or not isinstance(v, (str, int, float)):
+                raise ValueError(f"env_vars entry {k!r}: keys must be str, "
+                                 f"values str/number")
+
+    def create(self, value, ctx, fetch):
+        ctx.env_vars.update({k: str(v) for k, v in value.items()})
+        ctx.taints_worker = True
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    """Ships a driver-local directory to every worker and chdirs into it.
+
+    Reference: ``runtime_env/working_dir.py`` (upload on submit, download +
+    extract per node, cwd + sys.path entry for the task).
+    """
+
+    name = "working_dir"
+    priority = 1
+
+    def validate(self, value):
+        if not isinstance(value, (str, dict)):
+            raise ValueError("working_dir must be a path or {'uri': ...}")
+        if isinstance(value, str) and value.startswith(("http://", "https://",
+                                                        "s3://", "gs://")):
+            raise ValueError(
+                "remote working_dir URIs are not supported in this "
+                "zero-egress build; pass a local directory")
+
+    def prepare(self, value, upload):
+        if isinstance(value, dict):  # already prepared
+            return value
+        excludes = None
+        uri, data = package_directory(value, excludes)
+        upload(uri, data)
+        return {"uri": uri}
+
+    def create(self, value, ctx, fetch):
+        path = ensure_local_package(value["uri"], fetch)
+        ctx.working_dir = path
+        ctx.py_paths.append(path)
+        ctx.taints_worker = True
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    """Ships extra importable modules (dirs or wheels) to workers.
+
+    Reference: ``runtime_env/py_modules.py``. Each entry lands on
+    ``sys.path``; a directory entry's *parent* semantics follow the
+    reference (the directory itself is the importable package, so its
+    extracted root is put on the path under the package name).
+    """
+
+    name = "py_modules"
+    priority = 2
+
+    def validate(self, value):
+        if not isinstance(value, (list, tuple)):
+            raise ValueError("py_modules must be a list of paths")
+
+    def prepare(self, value, upload):
+        out = []
+        for item in value:
+            if isinstance(item, dict):
+                out.append(item)
+                continue
+            if os.path.isdir(item):
+                pkg_name = os.path.basename(os.path.normpath(item))
+                uri, data = package_directory(item)
+                upload(uri, data)
+                out.append({"uri": uri, "module": pkg_name})
+            else:
+                uri, data = package_file(item)
+                upload(uri, data)
+                out.append({"uri": uri})
+        return out
+
+    def create(self, value, ctx, fetch):
+        for item in value:
+            path = ensure_local_package(item["uri"], fetch)
+            if item.get("module"):
+                # Extracted dir IS the package: expose it under its name.
+                shim = os.path.join(path + "_parent")
+                os.makedirs(shim, exist_ok=True)
+                link = os.path.join(shim, item["module"])
+                if not os.path.exists(link):
+                    try:
+                        os.symlink(path, link)
+                    except OSError:
+                        pass
+                ctx.py_paths.append(shim)
+            else:
+                whls = glob.glob(os.path.join(path, "*.whl"))
+                ctx.py_paths.extend(whls or [path])
+        ctx.taints_worker = True
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """pip requirements for a task/actor.
+
+    The reference materializes a virtualenv per requirements list
+    (``runtime_env/pip.py``). This build runs zero-egress, so by default the
+    plugin *verifies* the requested distributions are already importable in
+    the cluster image and fails fast with a clear error otherwise; set
+    ``RAY_TPU_RUNTIME_ENV_ALLOW_PIP=1`` to let workers shell out to pip
+    (air-gapped wheels / internal indexes).
+    """
+
+    name = "pip"
+    priority = 3
+
+    def validate(self, value):
+        if isinstance(value, dict):
+            value = value.get("packages", [])
+        if not isinstance(value, (list, tuple)):
+            raise ValueError("pip must be a list of requirements or "
+                             "{'packages': [...]}")
+
+    @staticmethod
+    def _dist_name(req: str) -> str:
+        for sep in ("==", ">=", "<=", "~=", ">", "<", "!", "[", ";", " "):
+            req = req.split(sep)[0]
+        return req.strip().replace("-", "_")
+
+    def create(self, value, ctx, fetch):
+        pkgs = value.get("packages", value) if isinstance(value, dict) \
+            else value
+        if os.environ.get("RAY_TPU_RUNTIME_ENV_ALLOW_PIP") == "1":
+            import subprocess
+            import sys as _sys
+
+            subprocess.run([_sys.executable, "-m", "pip", "install",
+                            *pkgs], check=True)
+            ctx.taints_worker = True
+            return
+        missing = []
+        for req in pkgs:
+            name = self._dist_name(req)
+            if importlib.util.find_spec(name) is None:
+                try:
+                    import importlib.metadata as md
+
+                    md.distribution(name)
+                except Exception:
+                    missing.append(req)
+        if missing:
+            raise RuntimeError(
+                f"runtime_env pip packages not present in the cluster image "
+                f"(zero-egress build; no installs): {missing}. Bake them "
+                f"into the image or set RAY_TPU_RUNTIME_ENV_ALLOW_PIP=1.")
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Named conda env activation is not supported in this build (workers
+    share one interpreter); fail loudly instead of silently ignoring."""
+
+    name = "conda"
+    priority = 3
+
+    def validate(self, value):
+        raise ValueError(
+            "runtime_env['conda'] is not supported by this build: workers "
+            "share the baked cluster image. Use 'pip' (verification mode) "
+            "or 'py_modules'/'working_dir' to ship code.")
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+           PipPlugin(), CondaPlugin()):
+    register_plugin(_p)
