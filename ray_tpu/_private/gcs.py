@@ -438,8 +438,16 @@ class GcsServer:
     async def _h_obj_wait(self, client, msg):
         oid = ObjectID(msg["oid"])
         entry = self._obj(oid)
-        if entry.spilled is not None:
-            self._restore_spilled(entry)
+        if entry.spilled is not None and not self._restore_spilled(entry):
+            # Can't re-admit to the store: serve the disk bytes inline.
+            try:
+                with open(entry.spilled, "rb") as f:
+                    client.conn.reply(msg, {"ok": True, "where": "inline",
+                                            "data": f.read(),
+                                            "nbytes": entry.nbytes})
+                return
+            except OSError:
+                pass
         entry.sightings.add(client.serial)
         if entry.ready:
             client.conn.reply(msg, self._obj_reply(entry))
@@ -648,10 +656,15 @@ class GcsServer:
         except FileExistsError:
             pass
         except MemoryError:
-            self._free_to(max(0, self.store_capacity - len(data)))
-            buf = self.store.create(entry.object_id, len(data))
-            buf[:len(data)] = data
-            self.store.seal(entry.object_id)
+            try:
+                self._free_to(max(0, self.store_capacity - len(data)))
+                buf = self.store.create(entry.object_id, len(data))
+                buf[:len(data)] = data
+                self.store.seal(entry.object_id)
+            except MemoryError:
+                # Store still full (e.g. everything pinned): leave the
+                # object on disk; readers fall back to the inline/pull path.
+                return False
         try:
             os.unlink(entry.spilled)
         except OSError:
@@ -899,6 +912,10 @@ class GcsServer:
             entry = self._obj(ObjectID(r["oid"]))
             if client.node_id is not None and r.get("shm"):
                 entry.holders.add(client.node_id.binary())
+            if r.get("shm"):
+                # The owner gets this result pushed directly (no obj_wait),
+                # and may map it zero-copy — pin for the arena store.
+                entry.sightings.add(record.owner.serial)
             self._mark_ready(entry, r["nbytes"], r.get("data"),
                              r.get("shm", False))
         if record.owner.conn is not None and not record.owner.conn.closed:

@@ -34,19 +34,22 @@ from .ids import ObjectID
 _PREFIX = "rtpu"
 
 
-def _quiet_shm_del(self):
-    # CPython's SharedMemory.__del__ raises a noisy "Exception ignored:
-    # BufferError: cannot close exported pointers exist" at interpreter
-    # shutdown when zero-copy views (numpy arrays over shm) are still alive.
-    # That teardown order is fine for us — the mapping dies with the
-    # process — so swallow it.
-    try:
-        self.close()
-    except (BufferError, OSError):
-        pass
+class _Segment(shared_memory.SharedMemory):
+    """SharedMemory whose finalizer tolerates live zero-copy exports.
 
+    CPython's ``SharedMemory.__del__`` raises a noisy "Exception ignored:
+    BufferError: cannot close exported pointers exist" at interpreter
+    shutdown when zero-copy views (numpy arrays over shm) are still alive.
+    That teardown order is fine for us — the mapping dies with the process —
+    so our own segments swallow it. Scoped as a subclass so user code's
+    SharedMemory keeps stdlib behavior.
+    """
 
-shared_memory.SharedMemory.__del__ = _quiet_shm_del
+    def __del__(self):
+        try:
+            self.close()
+        except (BufferError, OSError):
+            pass
 
 
 def _untrack(shm: shared_memory.SharedMemory):
@@ -96,7 +99,7 @@ class PyShmStore:
 
     def create(self, object_id: ObjectID, nbytes: int) -> memoryview:
         nbytes = max(nbytes, 1)
-        shm = shared_memory.SharedMemory(
+        shm = _Segment(
             name=self._name(object_id), create=True, size=nbytes
         )
         _untrack(shm)
@@ -126,7 +129,7 @@ class PyShmStore:
             shm = self._attached.get(object_id)
         if shm is None:
             try:
-                shm = shared_memory.SharedMemory(name=self._name(object_id))
+                shm = _Segment(name=self._name(object_id))
             except FileNotFoundError:
                 return None
             _untrack(shm)
@@ -139,7 +142,7 @@ class PyShmStore:
             if object_id in self._attached:
                 return True
         try:
-            shm = shared_memory.SharedMemory(name=self._name(object_id))
+            shm = _Segment(name=self._name(object_id))
         except FileNotFoundError:
             return False
         _untrack(shm)
@@ -152,7 +155,7 @@ class PyShmStore:
             shm = self._attached.pop(object_id, None)
         if shm is None:
             try:
-                shm = shared_memory.SharedMemory(name=self._name(object_id))
+                shm = _Segment(name=self._name(object_id))
                 _untrack(shm)
             except FileNotFoundError:
                 return

@@ -65,31 +65,35 @@ def _strategy_opts(opts: dict) -> dict:
     return out
 
 
-class _PreparedRenvCache:
-    """Per-callable cache of the prepared (uploaded) runtime_env wire form.
-
-    Packaging a working_dir re-zips and re-hashes the whole tree; doing
-    that once per ``.remote()`` call would crater submission throughput,
-    so the wire form is cached per (session, options-identity).
-    """
-
-    __slots__ = ("session", "value")
-
-    def __init__(self):
-        self.session = None
-        self.value = None
+# Session-scoped cache of prepared (uploaded) runtime_env wire forms,
+# keyed by the env's value. Packaging a working_dir re-zips and re-hashes
+# the whole tree; doing that once per ``.remote()`` call — including the
+# ``fn.options(runtime_env={...}).remote()``-in-a-loop pattern, where every
+# call builds a fresh dict — would crater submission throughput. Caveat
+# (shared with the reference's URI cache): edits to the directory *during*
+# a session are not re-uploaded for an identical runtime_env value.
+_RENV_WIRE_CACHE: Dict[tuple, dict] = {}
 
 
-def _prepared_runtime_env_impl(cache: _PreparedRenvCache, opts: dict):
-    if not opts.get("runtime_env"):
+def _prepared_runtime_env(opts: dict):
+    renv = opts.get("runtime_env")
+    if not renv:
         return None
     w = global_worker()
-    if cache.session != w.session_name:
-        from ray_tpu.runtime_env import prepare_runtime_env
+    try:
+        key = (w.session_name, repr(sorted(renv.items(), key=repr)))
+    except Exception:
+        key = None
+    if key is not None and key in _RENV_WIRE_CACHE:
+        return _RENV_WIRE_CACHE[key]
+    from ray_tpu.runtime_env import prepare_runtime_env
 
-        cache.value = prepare_runtime_env(opts["runtime_env"])
-        cache.session = w.session_name
-    return cache.value
+    wire = prepare_runtime_env(renv)
+    if key is not None:
+        if len(_RENV_WIRE_CACHE) > 256:
+            _RENV_WIRE_CACHE.clear()
+        _RENV_WIRE_CACHE[key] = wire
+    return wire
 
 
 def _prepare_args(args: tuple, kwargs: dict) -> dict:
@@ -119,12 +123,8 @@ class RemoteFunction:
         self._blob: Optional[bytes] = None
         self._fid: Optional[str] = None
         self._registered_sessions: set = set()
-        self._renv_cache = _PreparedRenvCache()
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
-
-    def _prepared_runtime_env(self, opts: dict):
-        return _prepared_runtime_env_impl(self._renv_cache, opts)
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -166,7 +166,7 @@ class RemoteFunction:
             "retries": opts.get("max_retries", 3),
             "name": opts.get("name") or self.__name__,
         }
-        renv = self._prepared_runtime_env(opts)
+        renv = _prepared_runtime_env(opts)
         if renv:
             wire_opts["runtime_env"] = renv
         wire_opts.update(_strategy_opts(opts))
@@ -257,11 +257,7 @@ class ActorClass:
         self._blob: Optional[bytes] = None
         self._fid: Optional[str] = None
         self._registered_sessions: set = set()
-        self._renv_cache = _PreparedRenvCache()
         self.__name__ = getattr(cls, "__name__", "Actor")
-
-    def _prepared_runtime_env(self, opts: dict):
-        return _prepared_runtime_env_impl(self._renv_cache, opts)
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -310,7 +306,7 @@ class ActorClass:
             "lifetime": opts.get("lifetime"),
             "max_concurrency": opts.get("max_concurrency"),
         }
-        renv = self._prepared_runtime_env(opts)
+        renv = _prepared_runtime_env(opts)
         if renv:
             wire_opts["runtime_env"] = renv
         wire_opts.update(_strategy_opts(opts))

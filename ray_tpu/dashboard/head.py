@@ -1,0 +1,248 @@
+"""Dashboard head: detached actor hosting the REST API + UI.
+
+Endpoint map (reference modules in ``python/ray/dashboard/modules/``):
+  GET  /                      web UI                 (client/)
+  GET  /healthz               liveness               (healthz/)
+  GET  /api/cluster           summary cards          (node/, reporter/)
+  GET  /api/nodes             node table             (node/)
+  GET  /api/workers           worker table           (node/)
+  GET  /api/actors            actor table            (actor/)
+  GET  /api/tasks             task table             (state/)
+  GET  /api/task_summary      per-name state counts  (state_aggregator.py)
+  GET  /api/objects           object table           (state/)
+  GET  /api/placement_groups  PG table               (state/)
+  GET  /api/timeline          chrome-trace events    (``ray timeline``)
+  GET  /api/metrics           metric snapshot (JSON) (metrics/)
+  GET  /metrics               Prometheus text        (metrics agent)
+  GET  /api/jobs              job list               (job/)
+  POST /api/jobs              submit {entrypoint}    (job/sdk.py:35)
+  GET  /api/jobs/{id}         job info
+  GET  /api/jobs/{id}/logs    job driver logs
+  POST /api/jobs/{id}/stop    stop job
+  GET  /api/logs              session log file list  (log/)
+  GET  /api/logs/{name}       one log file's tail
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import ray_tpu
+
+DASHBOARD_ACTOR_NAME = "_ray_tpu_dashboard"
+
+
+class DashboardActor:
+    """Runs the aiohttp server inside a worker process (async actor)."""
+
+    def __init__(self):
+        self._runner = None
+        self._port = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        from aiohttp import web
+
+        from .ui import INDEX_HTML
+
+        app = web.Application()
+
+        def json_api(fn):
+            # Handlers block on GCS round-trips (state API uses the worker's
+            # IO loop), so they must run on an executor thread, not the
+            # event loop serving HTTP.
+            import asyncio
+            import functools
+
+            async def handler(request):
+                loop = asyncio.get_running_loop()
+                try:
+                    result = await loop.run_in_executor(
+                        None, functools.partial(fn, request))
+                    return web.json_response(result)
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response({"error": str(e)}, status=500)
+            return handler
+
+        async def index(request):
+            return web.Response(text=INDEX_HTML, content_type="text/html")
+
+        async def healthz(request):
+            return web.Response(text="ok")
+
+        def cluster(request):
+            from ray_tpu.util import state
+
+            nodes = ray_tpu.nodes()
+            summary = state.summarize_tasks()
+            running = sum(s.get("running", 0) for s in summary.values())
+            actors = [a for a in state.list_actors()
+                      if a.get("state") == "alive"]
+            return {
+                "num_nodes": len([n for n in nodes if n["Alive"]]),
+                "resources": ray_tpu.cluster_resources(),
+                "available": ray_tpu.available_resources(),
+                "num_actors": len(actors),
+                "running_tasks": running,
+            }
+
+        def state_ep(kind):
+            def ep(request):
+                from ray_tpu.util import state
+
+                limit = int(request.query.get("limit", "1000"))
+                return getattr(state, f"list_{kind}")(limit)
+            return ep
+
+        def task_summary(request):
+            from ray_tpu.util import state
+
+            return state.summarize_tasks()
+
+        def timeline(request):
+            from ray_tpu.util import state
+
+            return state.timeline()
+
+        def metrics_json(request):
+            from ray_tpu.util import state
+
+            return state.list_metrics()
+
+        async def metrics_prom(request):
+            import asyncio
+
+            from ray_tpu.util import state
+
+            text = await asyncio.get_running_loop().run_in_executor(
+                None, state.prometheus_metrics)
+            return web.Response(text=text, content_type="text/plain")
+
+        def jobs_list(request):
+            from ray_tpu.job import JobSubmissionClient
+
+            return JobSubmissionClient().list_jobs()
+
+        async def jobs_submit(request):
+            import asyncio
+
+            from ray_tpu.job import JobSubmissionClient
+
+            body = await request.json()
+
+            def do():
+                return {"job_id": JobSubmissionClient().submit_job(
+                    entrypoint=body["entrypoint"],
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"))}
+
+            try:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    None, do)
+                return web.json_response(result)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=500)
+
+        def job_ep(method):
+            def ep(request):
+                from ray_tpu.job import JobSubmissionClient
+
+                cli = JobSubmissionClient()
+                jid = request.match_info["job_id"]
+                if method == "info":
+                    return cli.get_job_info(jid)
+                if method == "logs":
+                    return {"logs": cli.get_job_logs(jid)}
+                return {"stopped": cli.stop_job(jid)}
+            return ep
+
+        def logs_list(request):
+            from ray_tpu._private.worker import global_worker
+
+            d = global_worker().session_dir
+            out = []
+            for name in sorted(os.listdir(d)):
+                p = os.path.join(d, name)
+                if os.path.isfile(p) and (name.endswith(".out")
+                                          or name.endswith(".log")):
+                    out.append({"name": name, "size": os.path.getsize(p)})
+            return out
+
+        def logs_file(request):
+            from ray_tpu._private.worker import global_worker
+
+            name = os.path.basename(request.match_info["name"])
+            tail = int(request.query.get("tail", "200"))
+            p = os.path.join(global_worker().session_dir, name)
+            if not os.path.isfile(p):
+                return {"error": "no such log"}
+            with open(p, "r", errors="replace") as f:
+                lines = f.readlines()
+            return {"name": name, "lines": lines[-tail:]}
+
+        app.router.add_get("/", index)
+        app.router.add_get("/healthz", healthz)
+        app.router.add_get("/api/cluster", json_api(cluster))
+        for kind in ("nodes", "workers", "actors", "tasks", "objects",
+                     "placement_groups"):
+            app.router.add_get(f"/api/{kind}", json_api(state_ep(kind)))
+        app.router.add_get("/api/task_summary", json_api(task_summary))
+        app.router.add_get("/api/timeline", json_api(timeline))
+        app.router.add_get("/api/metrics", json_api(metrics_json))
+        app.router.add_get("/metrics", metrics_prom)
+        app.router.add_get("/api/jobs", json_api(jobs_list))
+        app.router.add_post("/api/jobs", jobs_submit)
+        app.router.add_get("/api/jobs/{job_id}", json_api(job_ep("info")))
+        app.router.add_get("/api/jobs/{job_id}/logs",
+                           json_api(job_ep("logs")))
+        app.router.add_post("/api/jobs/{job_id}/stop",
+                            json_api(job_ep("stop")))
+        app.router.add_get("/api/logs", json_api(logs_list))
+        app.router.add_get("/api/logs/{name}", json_api(logs_file))
+
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def get_url(self) -> str:
+        return f"http://127.0.0.1:{self._port}"
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> str:
+    """Start (or return the existing) dashboard; returns its URL."""
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_ACTOR_NAME)
+        return ray_tpu.get(actor.get_url.remote())
+    except ValueError:
+        pass
+    actor = ray_tpu.remote(DashboardActor).options(
+        name=DASHBOARD_ACTOR_NAME, lifetime="detached",
+        num_cpus=0).remote()
+    actual = ray_tpu.get(actor.start.remote(host, port))
+    url = f"http://{host}:{actual}"
+    return url
+
+
+def get_dashboard_url() -> Optional[str]:
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_ACTOR_NAME)
+        return ray_tpu.get(actor.get_url.remote())
+    except ValueError:
+        return None
+
+
+def stop_dashboard():
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_ACTOR_NAME)
+    except ValueError:
+        return
+    ray_tpu.get(actor.stop.remote())
+    ray_tpu.kill(actor)

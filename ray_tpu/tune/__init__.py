@@ -1,6 +1,12 @@
 from ..train.session import get_checkpoint, get_context, report
-from .schedulers import ASHAScheduler, FIFOScheduler, PopulationBasedTraining
+from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
+                         MedianStoppingRule, PopulationBasedTraining)
 from .search import (
+    BasicVariantGenerator,
+    BayesOptSearcher,
+    ConcurrencyLimiter,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -30,5 +36,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "run", "report", "get_context",
     "get_checkpoint", "choice", "uniform", "loguniform", "randint",
     "quniform", "sample_from", "grid_search", "FIFOScheduler",
-    "ASHAScheduler", "PopulationBasedTraining",
+    "ASHAScheduler", "PopulationBasedTraining", "HyperBandScheduler",
+    "MedianStoppingRule", "Searcher", "BasicVariantGenerator",
+    "TPESearcher", "BayesOptSearcher", "ConcurrencyLimiter",
 ]

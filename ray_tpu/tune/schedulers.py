@@ -132,3 +132,80 @@ class PopulationBasedTraining(FIFOScheduler):
                 factor = self.rng.choice([0.8, 1.2])
                 out[key] = out[key] * factor
         return out
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' running averages at the same step (reference:
+    ``tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 4, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.history: Dict[str, List[float]] = {}
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        v = float(metric) if self.mode == "max" else -float(metric)
+        self.history.setdefault(trial_id, []).append(v)
+        if t <= self.grace_period:
+            return CONTINUE
+        step = len(self.history[trial_id])
+        others = [h for tid, h in self.history.items()
+                  if tid != trial_id and len(h) >= step]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        my_avg = sum(self.history[trial_id]) / step
+        other_avgs = sorted(sum(h[:step]) / step for h in others)
+        median = other_avgs[len(other_avgs) // 2]
+        return STOP if my_avg < median else CONTINUE
+
+
+class HyperBandScheduler(FIFOScheduler):
+    """Synchronous-flavored HyperBand simplified to banded successive
+    halving: each trial is assigned round-robin to a bracket with its own
+    (grace, rf) budget; within a bracket, ASHA rung logic applies
+    (reference: ``tune/schedulers/hyperband.py``; ASHA is the async variant
+    the reference recommends, implemented above)."""
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # Brackets: s_max+1 ASHA instances with increasing grace periods.
+        import math as _m
+
+        s_max = int(_m.log(max_t, reduction_factor))
+        self.brackets: List[ASHAScheduler] = []
+        for s in range(s_max + 1):
+            grace = max(1, max_t // (reduction_factor ** s))
+            self.brackets.append(None)  # placeholder, built lazily
+            self.brackets[s] = ASHAScheduler(
+                metric=metric, mode=mode, time_attr=time_attr,
+                max_t=max_t, grace_period=grace,
+                reduction_factor=reduction_factor)
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket(self, trial_id: str) -> ASHAScheduler:
+        if trial_id not in self._assignment:
+            self._assignment[trial_id] = self._next % len(self.brackets)
+            self._next += 1
+        b = self.brackets[self._assignment[trial_id]]
+        b.metric = b.metric or self.metric
+        return b
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return self._bracket(trial_id).on_result(trial_id, result)

@@ -29,13 +29,14 @@ from .search import generate_variants
 
 class TuneConfig:
     def __init__(self, *, metric: Optional[str] = None, mode: str = "max",
-                 num_samples: int = 1, scheduler=None,
+                 num_samples: int = 1, scheduler=None, search_alg=None,
                  max_concurrent_trials: Optional[int] = None,
                  seed: Optional[int] = None):
         self.metric = metric
         self.mode = mode
         self.num_samples = num_samples
         self.scheduler = scheduler
+        self.search_alg = search_alg  # Searcher (TPE/BayesOpt/...) or None
         self.max_concurrent_trials = max_concurrent_trials
         self.seed = seed
 
@@ -187,9 +188,12 @@ class Tuner:
                 scheduler, "metric"):
             scheduler.metric = tc.metric
         # Trainable normalization: JaxTrainer -> run its loop via fit()
+        wrap_key = None
         if isinstance(self.trainable, JaxTrainer):
             trainer = self.trainable
             space = dict(self.param_space)
+            search_space = space.get("train_loop_config", space)
+            wrap_key = "train_loop_config"
 
             def fn(config):
                 import ray_tpu.train.session as sm
@@ -199,24 +203,39 @@ class Tuner:
                 trainer.train_loop(loop_cfg)
 
             fn_blob = cloudpickle.dumps(fn)
-            variants = generate_variants(
-                space.get("train_loop_config", space),
-                tc.num_samples, tc.seed)
-            variants = [{"train_loop_config": v} for v in variants]
         else:
             fn_blob = cloudpickle.dumps(self.trainable)
-            variants = generate_variants(self.param_space, tc.num_samples,
-                                         tc.seed)
-        trials = [Trial(f"trial_{i:04d}", cfg)
-                  for i, cfg in enumerate(variants)]
+            search_space = self.param_space
+        searcher = tc.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(tc.metric, tc.mode, search_space)
+            issued = [0]
+
+            def next_config(trial_id):
+                # A sample slot is consumed only once the searcher actually
+                # yields a config — backpressure polls (ConcurrencyLimiter
+                # returning None) must not burn samples.
+                if issued[0] >= tc.num_samples:
+                    return "exhausted"
+                cfg = searcher.suggest(trial_id)
+                if cfg is not None:
+                    issued[0] += 1
+                return cfg
+        else:
+            queue = generate_variants(search_space, tc.num_samples, tc.seed)
+
+            def next_config(trial_id):
+                return queue.pop(0) if queue else "exhausted"
+        trials: List[Trial] = []
         collector = _TuneCollector.remote()
         try:
             cpus = ray_tpu.cluster_resources().get("CPU", 2)
         except Exception:
             cpus = 2
         max_concurrent = tc.max_concurrent_trials or max(1, int(cpus))
-        self._run_loop(trials, fn_blob, collector, scheduler, exp_name,
-                       storage, max_concurrent)
+        self._run_loop(trials, next_config, wrap_key, fn_blob, collector,
+                       scheduler, searcher, exp_name, storage,
+                       max_concurrent)
         state = ray_tpu.get(collector.state.remote())
         results = []
         for t in trials:
@@ -233,11 +252,32 @@ class Tuner:
             pass
         return ResultGrid(results, tc.metric, tc.mode)
 
-    def _run_loop(self, trials, fn_blob, collector, scheduler, exp_name,
-                  storage, max_concurrent):
-        pending = list(trials)
+    def _run_loop(self, trials, next_config, wrap_key, fn_blob, collector,
+                  scheduler, searcher, exp_name, storage, max_concurrent):
+        pending: List[Trial] = []
         running: List[Trial] = []
-        trial_by_id = {t.id: t for t in trials}
+        trial_by_id: Dict[str, Trial] = {}
+        exhausted = False
+        trial_counter = [0]
+
+        def make_trial() -> Optional[Trial]:
+            nonlocal exhausted
+            if exhausted:
+                return None
+            tid = f"trial_{trial_counter[0]:04d}"
+            cfg = next_config(tid)
+            if cfg == "exhausted":
+                exhausted = True
+                return None
+            if cfg is None:  # searcher backpressure (ConcurrencyLimiter)
+                return None
+            trial_counter[0] += 1
+            if wrap_key is not None:
+                cfg = {wrap_key: cfg}
+            t = Trial(tid, cfg)
+            trials.append(t)
+            trial_by_id[tid] = t
+            return t
 
         def launch(trial: Trial):
             trial.actor = _TrialActor.remote()
@@ -247,13 +287,27 @@ class Tuner:
             trial.state = "RUNNING"
             running.append(trial)
 
-        while pending or running:
+        while True:
             while pending and len(running) < max_concurrent:
                 launch(pending.pop(0))
+            while not exhausted and len(running) < max_concurrent:
+                t = make_trial()
+                if t is None:
+                    break  # exhausted, or searcher backpressure
+                launch(t)
+            if not running and not pending:
+                if exhausted:
+                    break
+                # Searcher declined with nothing in flight (shouldn't
+                # persist); brief backoff then retry.
+                time.sleep(0.05)
+                continue
             # Drain new reports -> scheduler decisions
             for tid, result in ray_tpu.get(collector.new_reports.remote()):
                 trial = trial_by_id[tid]
                 trial.last_result = result
+                if searcher is not None:
+                    searcher.on_trial_result(tid, result)
                 if trial.state != "RUNNING":
                     continue
                 decision = scheduler.on_result(tid, result)
@@ -296,6 +350,8 @@ class Tuner:
                     else:
                         trial.state = "ERROR"
                         trial.error = str(e)
+                if searcher is not None:
+                    searcher.on_trial_complete(trial.id, trial.last_result)
                 if trial.actor is not None:
                     try:
                         ray_tpu.kill(trial.actor)

@@ -1,0 +1,247 @@
+"""Collective communication library over actors.
+
+Analog of the reference's ``ray.util.collective``
+(``python/ray/util/collective/collective.py:40-615``: ``init_collective_group``,
+``allreduce``/``allgather``/``reducescatter``/``broadcast``/``send``/``recv``,
+NCCL + Gloo backends). TPU-native re-design:
+
+* **"shm" backend** (default, the Gloo analog): host-memory collectives for
+  control-plane / CPU tensors. A per-group coordinator actor rendezvouses
+  all ranks per operation; payloads ride the shared-memory object store, so
+  intra-host traffic is zero-copy and inter-host goes through the transfer
+  relay.
+* **"tpu" backend**: *compiled* collectives — on TPU the fast path is XLA
+  collectives over ICI emitted inside a jitted program (``psum`` /
+  ``all_gather`` / ``ppermute`` via ``shard_map``), not a runtime library
+  call. ``init_collective_group(backend="tpu")`` therefore refuses with a
+  pointer to ``ray_tpu.parallel.collectives`` — the moral equivalent of
+  NCCL here is the compiler (SURVEY.md §5 "distributed communication
+  backend" mandate).
+
+Semantics notes: every collective is a synchronous rendezvous (all ranks
+must call it); operations on one group are sequenced by per-rank call
+counts, so ranks must issue the same collectives in the same order — the
+same contract NCCL/Gloo impose.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+}
+
+
+class _Coordinator:
+    """Per-group rendezvous actor (async). One instance per group name."""
+
+    def __init__(self, world_size: int):
+        self.world = world_size
+        self._ops: Dict[tuple, dict] = {}  # (kind, seq) -> state
+        self._lock = None  # created lazily on the actor's loop
+
+    def _get(self, kind: str, seq: int) -> dict:
+        import asyncio
+
+        key = (kind, seq)
+        st = self._ops.get(key)
+        if st is None:
+            st = {"parts": {}, "event": asyncio.Event(), "result": None}
+            self._ops[key] = st
+        return st
+
+    async def collect(self, kind: str, seq: int, rank: int, data: Any,
+                      op: str = "sum", src_rank: int = 0) -> Any:
+        """Generic all-to-one-to-all rendezvous; returns this rank's part."""
+        import asyncio
+
+        st = self._get(kind, seq)
+        st["parts"][rank] = data
+        if len(st["parts"]) == self.world:
+            parts = [st["parts"][r] for r in range(self.world)]
+            if kind == "allreduce":
+                st["result"] = _REDUCERS[op](np.stack(
+                    [np.asarray(p) for p in parts]))
+            elif kind == "allgather":
+                st["result"] = [np.asarray(p) for p in parts]
+            elif kind == "reducescatter":
+                red = _REDUCERS[op](np.stack([np.asarray(p) for p in parts]))
+                st["result"] = np.array_split(red, self.world)
+            elif kind == "broadcast":
+                st["result"] = np.asarray(st["parts"][src_rank])
+            elif kind == "barrier":
+                st["result"] = True
+            st["event"].set()
+        else:
+            await asyncio.wait_for(st["event"].wait(), timeout=300)
+        result = st["result"]
+        # Last rank out cleans up.
+        st.setdefault("taken", set()).add(rank)
+        if len(st["taken"]) == self.world:
+            self._ops.pop((kind, seq), None)
+        if kind == "reducescatter":
+            return result[rank]
+        return result
+
+    async def send(self, seq: int, dst: int, data: Any):
+        st = self._get(f"p2p-{dst}", seq)
+        st["result"] = data
+        st["event"].set()
+
+    async def recv(self, seq: int, dst: int) -> Any:
+        import asyncio
+
+        st = self._get(f"p2p-{dst}", seq)
+        await asyncio.wait_for(st["event"].wait(), timeout=300)
+        self._ops.pop((f"p2p-{dst}", seq), None)
+        return st["result"]
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int,
+                 coordinator: "ray_tpu.ActorHandle"):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self.seqs: Dict[str, int] = {}
+        self.lock = threading.Lock()
+
+    def next_seq(self, kind: str) -> int:
+        with self.lock:
+            s = self.seqs.get(kind, 0)
+            self.seqs[kind] = s + 1
+            return s
+
+
+_groups: Dict[str, _GroupState] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default") -> None:
+    """Join a collective group (call once per rank, any process)."""
+    if backend in ("tpu", "xla", "ici"):
+        raise ValueError(
+            "On TPU, collectives are compiled into the program: use "
+            "ray_tpu.parallel (Mesh + shard_map psum/all_gather/ppermute) "
+            "inside jit instead of a runtime collective group. The 'shm' "
+            "backend covers host-memory tensors.")
+    if backend not in ("shm", "gloo"):
+        raise ValueError(f"unknown collective backend {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside world of {world_size}")
+    name = f"_collective_{group_name}"
+    try:
+        coord = ray_tpu.get_actor(name)
+    except ValueError:
+        try:
+            coord = ray_tpu.remote(_Coordinator).options(
+                name=name, lifetime="detached", num_cpus=0).remote(world_size)
+        except Exception:
+            coord = ray_tpu.get_actor(name)  # lost the creation race
+    _groups[group_name] = _GroupState(group_name, world_size, rank, coord)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    st = _groups.pop(group_name, None)
+    if st is not None and st.rank == 0:
+        try:
+            ray_tpu.kill(st.coordinator)
+        except Exception:
+            pass
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
+
+
+def _g(group_name: str) -> _GroupState:
+    st = _groups.get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"process; call init_collective_group first")
+    return st
+
+
+def _rendezvous(kind: str, tensor, group_name: str, **kw):
+    st = _g(group_name)
+    seq = st.next_seq(kind)
+    return ray_tpu.get(st.coordinator.collect.remote(
+        kind, seq, st.rank, tensor, **kw), timeout=300)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    """All ranks contribute; every rank gets the elementwise reduction."""
+    out = _rendezvous("allreduce", np.asarray(tensor), group_name, op=op)
+    return out
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    """Every rank gets the list of all ranks' tensors (rank order)."""
+    return _rendezvous("allgather", np.asarray(tensor), group_name)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    """Reduce across ranks, then scatter row-chunks; rank i gets chunk i."""
+    return _rendezvous("reducescatter", np.asarray(tensor), group_name,
+                       op=op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Every rank gets ``src_rank``'s tensor."""
+    return _rendezvous("broadcast", np.asarray(tensor), group_name,
+                       src_rank=src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    _rendezvous("barrier", None, group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    st = _g(group_name)
+    seq = st.next_seq(f"p2p-{dst_rank}")
+    ray_tpu.get(st.coordinator.send.remote(seq, dst_rank,
+                                           np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    """Receive the next tensor addressed to this rank.
+
+    (Point-to-point ordering is per-destination FIFO; ``src_rank`` is
+    accepted for API parity with the reference but delivery is by send
+    order, matching single-sender usage.)
+    """
+    st = _g(group_name)
+    seq = st.seqs.get(f"p2p-{st.rank}-recv", 0)
+    st.seqs[f"p2p-{st.rank}-recv"] = seq + 1
+    return ray_tpu.get(st.coordinator.recv.remote(seq, st.rank),
+                       timeout=300)

@@ -1,0 +1,125 @@
+"""Actor-based collective library tests.
+
+Reference behaviors: ``python/ray/util/collective/collective.py:258-615``
+(allreduce/allgather/reducescatter/broadcast/send/recv over a declared
+group), exercised here across actor ranks like the reference's
+``tests/test_collective_*``.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, world, rank, group):
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(world, rank, group_name=group)
+        self.rank = rank
+        self.group = group
+
+    def do_allreduce(self, value):
+        from ray_tpu.util import collective
+
+        return collective.allreduce(np.full(4, value, dtype=np.float64),
+                                    group_name=self.group)
+
+    def do_allgather(self):
+        from ray_tpu.util import collective
+
+        return collective.allgather(np.full(2, self.rank, dtype=np.int64),
+                                    group_name=self.group)
+
+    def do_reducescatter(self):
+        from ray_tpu.util import collective
+
+        return collective.reducescatter(
+            np.arange(8, dtype=np.float64) + self.rank,
+            group_name=self.group)
+
+    def do_broadcast(self):
+        from ray_tpu.util import collective
+
+        return collective.broadcast(
+            np.full(3, self.rank * 10, dtype=np.int64), src_rank=1,
+            group_name=self.group)
+
+    def do_barrier(self):
+        from ray_tpu.util import collective
+
+        collective.barrier(group_name=self.group)
+        return True
+
+    def do_sendrecv(self, peer):
+        from ray_tpu.util import collective
+
+        if self.rank == 0:
+            collective.send(np.array([42.0, 7.0]), dst_rank=1,
+                            group_name=self.group)
+            return None
+        return collective.recv(src_rank=0, group_name=self.group)
+
+
+@pytest.fixture(scope="module")
+def group(ray_cluster):
+    world = 3
+    ranks = [Rank.remote(world, r, "tg") for r in range(world)]
+    # init happens in __init__; a first collective confirms wiring
+    yield ranks
+    from ray_tpu.util import collective
+
+
+def _fanout(ranks, method, *args):
+    return ray_tpu.get([getattr(r, method).remote(*args) for r in ranks],
+                       timeout=60)
+
+
+def test_allreduce(group):
+    outs = _fanout(group, "do_allreduce", 2.0)
+    for o in outs:
+        np.testing.assert_array_equal(o, np.full(4, 6.0))
+
+
+def test_allgather(group):
+    outs = _fanout(group, "do_allgather")
+    for o in outs:
+        assert len(o) == 3
+        for r, part in enumerate(o):
+            np.testing.assert_array_equal(part, np.full(2, r))
+
+
+def test_reducescatter(group):
+    outs = _fanout(group, "do_reducescatter")
+    # sum over ranks of (arange(8)+r) = 3*arange(8) + 3
+    full = 3 * np.arange(8, dtype=np.float64) + 3
+    got = np.concatenate(outs)
+    np.testing.assert_array_equal(got, full)
+
+
+def test_broadcast(group):
+    outs = _fanout(group, "do_broadcast")
+    for o in outs:
+        np.testing.assert_array_equal(o, np.full(3, 10))
+
+
+def test_barrier(group):
+    assert _fanout(group, "do_barrier") == [True, True, True]
+
+
+def test_send_recv(ray_cluster):
+    world = 2
+    ranks = [Rank.remote(world, r, "p2p") for r in range(world)]
+    outs = ray_tpu.get([r.do_sendrecv.remote(1 - i)
+                        for i, r in enumerate(ranks)], timeout=60)
+    assert outs[0] is None
+    np.testing.assert_array_equal(outs[1], np.array([42.0, 7.0]))
+
+
+def test_tpu_backend_points_to_compiled_path(ray_cluster):
+    from ray_tpu.util import collective
+
+    with pytest.raises(ValueError, match="compiled into the program"):
+        collective.init_collective_group(2, 0, backend="tpu")

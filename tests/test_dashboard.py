@@ -1,0 +1,102 @@
+"""Dashboard REST API tests (reference: python/ray/dashboard/)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def dashboard_url(ray_cluster):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    url = start_dashboard(port=0)
+    yield url
+    stop_dashboard()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = r.read().decode()
+        ctype = r.headers.get("content-type", "")
+    return body, ctype
+
+
+def _get_json(url):
+    body, _ = _get(url)
+    return json.loads(body)
+
+
+def test_index_and_health(dashboard_url):
+    body, ctype = _get(dashboard_url + "/")
+    assert "ray_tpu dashboard" in body and "text/html" in ctype
+    body, _ = _get(dashboard_url + "/healthz")
+    assert body == "ok"
+
+
+def test_cluster_and_nodes(dashboard_url):
+    c = _get_json(dashboard_url + "/api/cluster")
+    assert c["num_nodes"] >= 1
+    assert c["resources"].get("CPU", 0) > 0
+    nodes = _get_json(dashboard_url + "/api/nodes")
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+
+
+def test_actors_tasks_after_activity(dashboard_url):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.options(name="dash_pinger").remote()
+    assert ray_tpu.get(p.ping.remote()) == "pong"
+    assert ray_tpu.get([work.remote(i) for i in range(3)]) == [1, 2, 3]
+
+    actors = _get_json(dashboard_url + "/api/actors")
+    assert any(a.get("name") == "dash_pinger" for a in actors)
+    summary = _get_json(dashboard_url + "/api/task_summary")
+    assert any("work" in name for name in summary)
+
+
+def test_metrics_endpoints(dashboard_url):
+    mj = _get_json(dashboard_url + "/api/metrics")
+    assert isinstance(mj, list)
+    prom, _ = _get(dashboard_url + "/metrics")
+    assert "ray_tpu" in prom or prom == "" or "#" in prom
+
+
+def test_jobs_roundtrip(dashboard_url):
+    import urllib.request
+
+    req = urllib.request.Request(
+        dashboard_url + "/api/jobs",
+        data=json.dumps({"entrypoint":
+                         "python -c \"print('dash-job-ran')\""}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        jid = json.loads(r.read())["job_id"]
+    import time
+    deadline = time.time() + 60
+    status = None
+    while time.time() < deadline:
+        info = _get_json(dashboard_url + f"/api/jobs/{jid}")
+        status = info.get("status")
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.3)
+    assert status == "SUCCEEDED", status
+    logs = _get_json(dashboard_url + f"/api/jobs/{jid}/logs")
+    assert "dash-job-ran" in logs["logs"]
+
+
+def test_logs_endpoints(dashboard_url):
+    files = _get_json(dashboard_url + "/api/logs")
+    assert any(f["name"].endswith(".out") for f in files)
+    one = _get_json(dashboard_url + "/api/logs/" + files[0]["name"])
+    assert "lines" in one

@@ -1,0 +1,146 @@
+"""Searcher + new scheduler tests.
+
+Reference behaviors: ``python/ray/tune/search/`` (TPE via hyperopt,
+bayesopt, ConcurrencyLimiter) and ``tune/schedulers/`` (median stopping,
+HyperBand). Convergence checks use a deterministic synthetic objective so
+the searchers' exploitation is measurable without a cluster.
+"""
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune import (BayesOptSearcher, ConcurrencyLimiter,
+                          HyperBandScheduler, MedianStoppingRule,
+                          TPESearcher)
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+
+def _drive(searcher, objective, n=40):
+    """Sequential suggest -> observe loop; returns all (cfg, score)."""
+    out = []
+    for i in range(n):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert cfg is not None
+        score = objective(cfg)
+        searcher.on_trial_complete(tid, {"score": score})
+        out.append((cfg, score))
+    return out
+
+
+def test_tpe_beats_random_on_quadratic():
+    space = {"x": tune.uniform(-5, 5), "y": tune.uniform(-5, 5)}
+
+    def objective(cfg):
+        return -(cfg["x"] - 2) ** 2 - (cfg["y"] + 1) ** 2
+
+    tpe = TPESearcher(metric="score", mode="max", n_initial=8, seed=0)
+    tpe.set_search_properties("score", "max", space)
+    hist = _drive(tpe, objective, 50)
+    late = [s for _, s in hist[25:]]
+    early = [s for _, s in hist[:10]]
+    assert max(late) > -0.8, "TPE should get close to the optimum"
+    assert sum(late) / len(late) > sum(early) / len(early), \
+        "TPE should improve over its random warmup"
+
+
+def test_tpe_categorical():
+    space = {"algo": tune.choice(["a", "b", "c"]),
+             "lr": tune.loguniform(1e-5, 1e-1)}
+
+    def objective(cfg):
+        base = {"a": 0.0, "b": 5.0, "c": 1.0}[cfg["algo"]]
+        import math
+
+        return base - abs(math.log10(cfg["lr"]) + 3)  # best: b, lr=1e-3
+
+    tpe = TPESearcher(metric="score", mode="max", n_initial=10, seed=1)
+    tpe.set_search_properties("score", "max", space)
+    hist = _drive(tpe, objective, 60)
+    late_algos = [c["algo"] for c, _ in hist[40:]]
+    assert late_algos.count("b") > len(late_algos) // 2, \
+        "TPE should favor the best categorical arm"
+
+
+def test_bayesopt_converges():
+    space = {"x": tune.uniform(0.0, 1.0)}
+
+    def objective(cfg):
+        return -(cfg["x"] - 0.7) ** 2
+
+    bo = BayesOptSearcher(metric="score", mode="max", n_initial=5, seed=0)
+    bo.set_search_properties("score", "max", space)
+    hist = _drive(bo, objective, 25)
+    best_x = max(hist, key=lambda cs: cs[1])[0]["x"]
+    assert abs(best_x - 0.7) < 0.1
+
+
+def test_bayesopt_rejects_categorical():
+    bo = BayesOptSearcher(metric="score", mode="max")
+    bo.set_search_properties("score", "max", {"c": tune.choice([1, 2])})
+    with pytest.raises(ValueError, match="continuous"):
+        bo.suggest("t0")
+
+
+def test_concurrency_limiter():
+    space = {"x": tune.uniform(0, 1)}
+    tpe = TPESearcher(metric="score", mode="max", seed=0)
+    lim = ConcurrencyLimiter(tpe, max_concurrent=2)
+    lim.set_search_properties("score", "max", space)
+    assert lim.suggest("a") is not None
+    assert lim.suggest("b") is not None
+    assert lim.suggest("c") is None  # over the cap
+    lim.on_trial_complete("a", {"score": 1.0})
+    assert lim.suggest("c") is not None
+
+
+def test_median_stopping_rule():
+    rule = MedianStoppingRule(metric="m", mode="max", grace_period=2,
+                              min_samples_required=2)
+    # Three trials: two good, one clearly bad after grace.
+    for t in range(1, 6):
+        assert rule.on_result("good1", {"training_iteration": t,
+                                        "m": 10.0}) == CONTINUE
+        assert rule.on_result("good2", {"training_iteration": t,
+                                        "m": 9.0}) == CONTINUE
+        d = rule.on_result("bad", {"training_iteration": t, "m": 1.0})
+        if t <= 2:
+            assert d == CONTINUE
+        else:
+            assert d == STOP
+            break
+
+
+def test_hyperband_brackets_stop_bad_trials():
+    hb = HyperBandScheduler(metric="m", mode="max", max_t=9,
+                            reduction_factor=3)
+    assert len(hb.brackets) >= 2
+    # All trials in some bracket; a bad trial eventually stops, max_t stops all.
+    decisions = []
+    for t in range(1, 10):
+        decisions.append(hb.on_result("x", {"training_iteration": t,
+                                            "m": 1.0}))
+    assert STOP in decisions or decisions[-1] == CONTINUE  # max_t reached
+    assert hb.on_result("x", {"training_iteration": 9, "m": 1.0}) == STOP
+
+
+def test_tuner_with_tpe_searcher(ray_cluster, tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    def trainable(config):
+        x = config["x"]
+        tune.report({"score": -(x - 3.0) ** 2})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(-10, 10)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=12,
+            search_alg=TPESearcher(metric="score", mode="max", n_initial=4,
+                                   seed=0),
+            max_concurrent_trials=3),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 12
+    best = grid.get_best_result()
+    assert best.metrics["score"] > -4.0
