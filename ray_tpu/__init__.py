@@ -18,6 +18,14 @@ import atexit
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
+from ._private.jax_platform import install_hook as _install_jax_hook
+
+# Honor RAY_TPU_JAX_PLATFORM in THIS process too (workers already do via
+# worker_main): a driver that pins itself to CPU must not grab the
+# process-exclusive TPU chip — or block on a remote tunnel — just by
+# deserializing a jax array.
+_install_jax_hook()
+
 from ._private import worker as _worker_mod
 from ._private.ids import ActorID, NodeID, ObjectID, TaskID
 from ._private.remote import ActorClass, ActorHandle, ActorMethod, RemoteFunction, remote

@@ -1,9 +1,23 @@
 from .algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
+from .connectors import (ClipRewards, ConnectorPipeline, FlattenObs,
+                         GAEConnector, NormalizeObs, default_env_to_module,
+                         default_learner_pipeline)
+from .dqn import DQN, DQNConfig
 from .env_runner import EnvRunner, EnvRunnerGroup
+from .impala import IMPALA, IMPALAConfig
 from .learner import Learner, LearnerGroup, gae
+from .multi_agent import MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO
+from .offline import BC, MARWIL, episodes_to_rows
+from .replay import ReplayBuffer
 from .rl_module import MLPModuleConfig
+from .vtrace import vtrace
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "EnvRunner",
-    "EnvRunnerGroup", "Learner", "LearnerGroup", "gae", "MLPModuleConfig",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "IMPALA", "IMPALAConfig", "EnvRunner", "EnvRunnerGroup", "Learner",
+    "LearnerGroup", "gae", "vtrace", "MLPModuleConfig", "ReplayBuffer",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+    "BC", "MARWIL", "episodes_to_rows",
+    "ConnectorPipeline", "FlattenObs", "NormalizeObs", "ClipRewards",
+    "GAEConnector", "default_env_to_module", "default_learner_pipeline",
 ]

@@ -114,6 +114,10 @@ class Algorithm:
     """Base: owns the runner group + learner group; subclasses define
     ``training_step``. Checkpointable via get/set state."""
 
+    # Value-based subclasses bring their own learner (e.g. DQN's TD
+    # learner); policy-gradient ones use the PPO-style LearnerGroup.
+    _uses_learner_group = True
+
     def __init__(self, config: AlgorithmConfig):
         if not ray_tpu.is_initialized():
             ray_tpu.init(ignore_reinit_error=True)
@@ -128,10 +132,11 @@ class Algorithm:
             config.env, config.num_env_runners,
             config.num_envs_per_env_runner, self.module_cfg,
             env_fn=config.env_fn, seed=config.seed)
-        self.learner_group = LearnerGroup(
-            self.module_cfg, config.hparams(),
-            num_learners=config.num_learners, use_tpu=config.use_tpu,
-            seed=config.seed)
+        if self._uses_learner_group:
+            self.learner_group = LearnerGroup(
+                self.module_cfg, config.hparams(),
+                num_learners=config.num_learners, use_tpu=config.use_tpu,
+                seed=config.seed)
 
     def _probe_env_spaces(self) -> dict:
         import gymnasium as gym
@@ -185,7 +190,8 @@ class Algorithm:
 
     def stop(self):
         self.env_runner_group.shutdown()
-        self.learner_group.shutdown()
+        if self._uses_learner_group:
+            self.learner_group.shutdown()
 
 
 class PPO(Algorithm):
@@ -207,13 +213,16 @@ class PPO(Algorithm):
                            ro["bootstrap_value"], cfg.gamma, cfg.lambda_)
             T, N = ro["rewards"].shape
             flat = lambda x: x.reshape(T * N, *x.shape[2:])  # noqa: E731
+            # Drop NEXT_STEP-autoreset pseudo-rows (env ignored the action).
+            keep = flat(ro["mask"]) if "mask" in ro else \
+                np.ones(T * N, bool)
             batches.append({
-                "obs": flat(ro["obs"]).astype(np.float32),
-                "actions": flat(ro["actions"]),
-                "logp": flat(ro["logp"]).astype(np.float32),
-                "advantages": flat(adv),
-                "returns": flat(ret),
-                "values": flat(ro["values"]),
+                "obs": flat(ro["obs"]).astype(np.float32)[keep],
+                "actions": flat(ro["actions"])[keep],
+                "logp": flat(ro["logp"]).astype(np.float32)[keep],
+                "advantages": flat(adv)[keep],
+                "returns": flat(ret)[keep],
+                "values": flat(ro["values"])[keep],
             })
         batch = {k: np.concatenate([b[k] for b in batches])
                  for k in batches[0]}

@@ -1,0 +1,206 @@
+"""DQN: double Q-learning with target network + replay.
+
+Reference: ``rllib/algorithms/dqn/`` (``dqn.py`` training_step: sample →
+store to replay → train on replayed minibatches → periodic target sync;
+``dqn_rainbow_learner.py`` double-Q TD loss). The ``pi`` head of the MLP
+module serves as the Q-function. JAX-native jitted update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .replay import ReplayBuffer
+
+
+def make_dqn_update(opt, hparams: dict):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from . import rl_module
+
+    gamma = hparams.get("gamma", 0.99)
+
+    def loss_fn(params, target_params, batch):
+        q, _ = rl_module.forward(params, batch["obs"])
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"].astype(jnp.int32)[:, None], axis=1)[:, 0]
+        # Double DQN: online net picks the argmax, target net evaluates.
+        q_next_online, _ = rl_module.forward(params, batch["next_obs"])
+        q_next_target, _ = rl_module.forward(target_params,
+                                             batch["next_obs"])
+        best = jnp.argmax(q_next_online, axis=-1)
+        q_next = jnp.take_along_axis(q_next_target, best[:, None],
+                                     axis=1)[:, 0]
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_next)
+        td = q_taken - target
+        loss = jnp.mean(batch.get("_weights", jnp.ones_like(td))
+                        * jnp.square(td)) * 0.5
+        return loss, {"td_error": jnp.mean(jnp.abs(td)), "loss": loss,
+                      "q_mean": jnp.mean(q_taken), "_td": td}
+
+    @jax.jit
+    def step(params, target_params, opt_state, batch):
+        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, target_params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, stats
+
+    return step
+
+
+@ray_tpu.remote
+class _DQNLearner:
+    def __init__(self, module_cfg_blob: bytes, hparams: dict, seed: int = 0):
+        import cloudpickle
+        import jax
+        import optax
+
+        from . import rl_module
+
+        self.cfg = cloudpickle.loads(module_cfg_blob)
+        self.hparams = hparams
+        self.params = rl_module.init(self.cfg, jax.random.PRNGKey(seed))
+        self.target_params = self.params
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(hparams.get("grad_clip", 10.0)),
+            optax.adam(hparams.get("lr", 1e-3)))
+        self.opt_state = self.opt.init(self.params)
+        self.update_fn = make_dqn_update(self.opt, hparams)
+        self.updates_done = 0
+
+    def get_weights(self):
+        return self.params
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "target_params": self.target_params,
+                "opt_state": self.opt_state,
+                "updates_done": self.updates_done}
+
+    def set_state(self, state: dict) -> bool:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+        self.updates_done = state.get("updates_done", 0)
+        return True
+
+    def train_on(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        jb = {k: v for k, v in batch.items() if k != "_indices"}
+        self.params, self.opt_state, stats = self.update_fn(
+            self.params, self.target_params, self.opt_state, jb)
+        self.updates_done += 1
+        if self.updates_done % self.hparams.get(
+                "target_network_update_freq", 50) == 0:
+            self.target_params = self.params
+        td = np.asarray(stats.pop("_td"))
+        return {"stats": {k: float(v) for k, v in stats.items()},
+                "td_abs": np.abs(td)}
+
+
+class DQN(Algorithm):
+    """training_step (reference ``dqn.py``): sample ε-greedy transitions →
+    add to replay → ``num_updates`` minibatch TD steps → priorities back."""
+
+    _uses_learner_group = False
+
+    def __init__(self, config: "DQNConfig"):
+        super().__init__(config)
+        import cloudpickle
+
+        self.learner = _DQNLearner.remote(
+            cloudpickle.dumps(self.module_cfg), config.hparams()
+            | {"gamma": config.gamma,
+               "target_network_update_freq":
+               config.target_network_update_freq},
+            seed=config.seed)
+        self.replay = ReplayBuffer.remote(
+            capacity=config.replay_capacity,
+            prioritized=config.prioritized_replay, seed=config.seed)
+        self.epsilon = config.initial_epsilon
+
+    def get_state(self) -> dict:
+        return {"learner": ray_tpu.get(self.learner.get_state.remote()),
+                "epsilon": self.epsilon,
+                "iteration": self.iteration}
+
+    def set_state(self, state: dict):
+        ray_tpu.get(self.learner.set_state.remote(state["learner"]))
+        self.epsilon = state.get("epsilon", self.epsilon)
+        self.iteration = state.get("iteration", 0)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        w = self.learner.get_weights.remote()
+        rollouts = self.env_runner_group.sample_transitions(
+            w, cfg.rollout_fragment_length, self.epsilon)
+        batch = {k: np.concatenate([r[k] for r in rollouts])
+                 for k in rollouts[0]}
+        self._total_env_steps += len(batch["obs"])
+        size = ray_tpu.get(self.replay.add_batch.remote(batch))
+        self.epsilon = max(
+            cfg.final_epsilon,
+            self.epsilon - cfg.epsilon_decay_per_iter)
+        stats: Dict[str, Any] = {}
+        if size >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                mb = ray_tpu.get(self.replay.sample.remote(
+                    cfg.train_batch_size))
+                if mb is None:
+                    break
+                idx = mb.pop("_indices")
+                out = ray_tpu.get(self.learner.train_on.remote(mb))
+                stats = out["stats"]
+                if cfg.prioritized_replay:
+                    self.replay.update_priorities.remote(idx, out["td_abs"])
+        self.learner_weights_ref = w
+        return {"learner": stats, "epsilon": self.epsilon,
+                "replay_size": size,
+                "num_env_steps_sampled": len(batch["obs"])}
+
+    def stop(self):
+        self.env_runner_group.shutdown()
+        for a in (self.learner, self.replay):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self.lr = 1e-3
+        self.replay_capacity = 50_000
+        self.prioritized_replay = False
+        self.learning_starts = 1_000
+        self.train_batch_size = 64
+        self.num_updates_per_iter = 16
+        self.target_network_update_freq = 50
+        self.initial_epsilon = 1.0
+        self.final_epsilon = 0.05
+        self.epsilon_decay_per_iter = 0.05
+
+    def training(self, *, replay_capacity=None, prioritized_replay=None,
+                 learning_starts=None, num_updates_per_iter=None,
+                 target_network_update_freq=None, initial_epsilon=None,
+                 final_epsilon=None, epsilon_decay_per_iter=None, **kw):
+        super().training(**kw)
+        for name, val in [
+                ("replay_capacity", replay_capacity),
+                ("prioritized_replay", prioritized_replay),
+                ("learning_starts", learning_starts),
+                ("num_updates_per_iter", num_updates_per_iter),
+                ("target_network_update_freq", target_network_update_freq),
+                ("initial_epsilon", initial_epsilon),
+                ("final_epsilon", final_epsilon),
+                ("epsilon_decay_per_iter", epsilon_decay_per_iter)]:
+            if val is not None:
+                setattr(self, name, val)
+        return self

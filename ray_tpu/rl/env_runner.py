@@ -38,6 +38,18 @@ class EnvRunner:
         self.key = jax.random.PRNGKey(seed)
         self.obs, _ = self.env.reset(seed=seed)
         self.num_envs = num_envs
+        # gymnasium 1.x NEXT_STEP autoreset: the step after a done ignores
+        # the action and returns the reset obs with zero reward. Those
+        # pseudo-steps must be masked out of training data.
+        try:
+            from gymnasium.vector import AutoresetMode
+
+            self._next_step_autoreset = (
+                getattr(self.env, "autoreset_mode", None)
+                == AutoresetMode.NEXT_STEP)
+        except ImportError:
+            self._next_step_autoreset = False
+        self._prev_done = np.zeros(num_envs, bool)
         # episode-return bookkeeping
         self._ep_return = np.zeros(num_envs)
         self._ep_len = np.zeros(num_envs, dtype=np.int64)
@@ -52,9 +64,10 @@ class EnvRunner:
         from . import rl_module
 
         params = weights_ref  # resolved ObjectRef -> params pytree
-        obs_buf, act_buf, logp_buf, rew_buf, done_buf, val_buf = \
-            [], [], [], [], [], []
+        obs_buf, act_buf, logp_buf, rew_buf, done_buf, val_buf, mask_buf = \
+            [], [], [], [], [], [], []
         for _ in range(num_steps):
+            valid = ~self._prev_done  # False on NEXT_STEP autoreset steps
             self.key, sub = jax.random.split(self.key)
             actions, logp, value = rl_module.sample_actions(
                 params, self.obs, sub)
@@ -66,13 +79,16 @@ class EnvRunner:
             rew_buf.append(rew)
             done_buf.append(done)
             val_buf.append(value)
+            mask_buf.append(valid)
             self._ep_return += rew
-            self._ep_len += 1
+            self._ep_len += valid.astype(np.int64)
             for i in np.nonzero(done)[0]:
                 self.completed_returns.append(float(self._ep_return[i]))
                 self.completed_lengths.append(int(self._ep_len[i]))
                 self._ep_return[i] = 0.0
                 self._ep_len[i] = 0
+            self._prev_done = done if self._next_step_autoreset else \
+                np.zeros(self.num_envs, bool)
             self.obs = nxt
         _, last_value = rl_module.forward_jit(params, np.asarray(self.obs))
         return {
@@ -82,7 +98,53 @@ class EnvRunner:
             "rewards": np.stack(rew_buf).astype(np.float32),
             "dones": np.stack(done_buf),
             "values": np.stack(val_buf).astype(np.float32),
+            "mask": np.stack(mask_buf),          # [T, N] valid rows
             "bootstrap_value": np.asarray(last_value, np.float32),  # [N]
+        }
+
+    def sample_transitions(self, weights_ref, num_steps: int,
+                           epsilon: float) -> Dict[str, np.ndarray]:
+        """Off-policy sampling: flat (s, a, r, s', done) transitions with
+        epsilon-greedy exploration (DQN-family runners)."""
+        import jax
+
+        from . import rl_module
+
+        params = weights_ref
+        obs_b, act_b, rew_b, nxt_b, done_b, mask_b = [], [], [], [], [], []
+        for _ in range(num_steps):
+            valid = ~self._prev_done  # False on NEXT_STEP autoreset steps
+            self.key, sub = jax.random.split(self.key)
+            actions = rl_module.epsilon_greedy_actions(
+                params, self.obs, sub, epsilon)
+            nxt, rew, term, trunc, _ = self.env.step(actions)
+            # Terminations bootstrap to 0; truncations are NOT terminal for
+            # the Bellman target (gymnasium semantics).
+            obs_b.append(self.obs.copy())
+            act_b.append(actions)
+            rew_b.append(rew)
+            nxt_b.append(nxt.copy())
+            done_b.append(term)
+            mask_b.append(valid)
+            done = np.logical_or(term, trunc)
+            self._ep_return += rew
+            self._ep_len += valid.astype(np.int64)
+            for i in np.nonzero(done)[0]:
+                self.completed_returns.append(float(self._ep_return[i]))
+                self.completed_lengths.append(int(self._ep_len[i]))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+            self._prev_done = done if self._next_step_autoreset else \
+                np.zeros(self.num_envs, bool)
+            self.obs = nxt
+        cat = lambda xs: np.concatenate(xs, axis=0)  # noqa: E731
+        keep = cat(mask_b)
+        return {
+            "obs": cat(obs_b).astype(np.float32)[keep],
+            "actions": cat(act_b).astype(np.int64)[keep],
+            "rewards": cat(rew_b).astype(np.float32)[keep],
+            "next_obs": cat(nxt_b).astype(np.float32)[keep],
+            "dones": cat(done_b).astype(np.float32)[keep],
         }
 
     def episode_stats(self, clear: bool = True) -> Dict[str, Any]:
@@ -113,20 +175,33 @@ class EnvRunnerGroup:
         self.runners = [self._make(i) for i in range(num_runners)]
         ray_tpu.get([r.ping.remote() for r in self.runners])
 
-    def sample(self, weights_ref, num_steps: int) -> List[Dict[str, np.ndarray]]:
-        """Synchronous parallel sample; dead runners are replaced
-        (reference: FaultAwareApply restart semantics)."""
-        refs = [r.sample.remote(weights_ref, num_steps)
-                for r in self.runners]
+    def _fanout(self, method: str, *args) -> List[Dict[str, np.ndarray]]:
+        """Fault-tolerant parallel call on every runner: a dead runner is
+        replaced and retried once (FaultAwareApply restart semantics,
+        ``env/env_runner.py:28``)."""
+        refs = [getattr(r, method).remote(*args) for r in self.runners]
         out = []
         for i, ref in enumerate(refs):
             try:
                 out.append(ray_tpu.get(ref, timeout=300))
             except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
                 self.runners[i] = self._make(i)
-                out.append(ray_tpu.get(self.runners[i].sample.remote(
-                    weights_ref, num_steps), timeout=300))
+                out.append(ray_tpu.get(
+                    getattr(self.runners[i], method).remote(*args),
+                    timeout=300))
         return out
+
+    def sample(self, weights_ref, num_steps: int) -> List[Dict[str, np.ndarray]]:
+        return self._fanout("sample", weights_ref, num_steps)
+
+    def sample_transitions(self, weights_ref, num_steps: int,
+                           epsilon: float) -> List[Dict[str, np.ndarray]]:
+        return self._fanout("sample_transitions", weights_ref, num_steps,
+                            epsilon)
+
+    def restart_runner(self, i: int):
+        self.runners[i] = self._make(i)
+        return self.runners[i]
 
     def episode_stats(self) -> Dict[str, list]:
         stats = ray_tpu.get([r.episode_stats.remote() for r in self.runners])

@@ -1,0 +1,147 @@
+"""Offline RL: dataset readers + BC / MARWIL.
+
+Reference: ``rllib/offline/`` (offline data via Ray Data) and
+``rllib/algorithms/bc``, ``rllib/algorithms/marwil`` — behavior cloning is
+pure supervised policy learning from logged (obs, action) pairs; MARWIL
+weights the imitation loss by exponentiated advantages so better-than-
+average logged actions dominate. Datasets stream through
+``ray_tpu.data.Dataset`` the same way the reference streams through Ray
+Data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+def episodes_to_rows(rollout: Dict[str, np.ndarray]) -> Iterator[dict]:
+    """Flatten a [T, N] rollout batch into per-step rows for offline
+    storage (the reference logs SampleBatch rows the same way)."""
+    T, N = rollout["rewards"].shape
+    for t in range(T):
+        for n in range(N):
+            yield {
+                "obs": rollout["obs"][t, n].tolist(),
+                "action": int(rollout["actions"][t, n]),
+                "reward": float(rollout["rewards"][t, n]),
+                "done": bool(rollout["dones"][t, n]),
+            }
+
+
+class BC:
+    """Behavior cloning from a ``ray_tpu.data.Dataset`` of rows with
+    ``obs`` (list[float]) and ``action`` (int) columns."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden=(64, 64), lr: float = 1e-3, seed: int = 0,
+                 beta: float = 0.0, vf_coeff: float = 1.0):
+        import jax
+        import optax
+
+        from .rl_module import MLPModuleConfig, init
+
+        self.cfg = MLPModuleConfig(obs_dim=obs_dim, num_actions=num_actions,
+                                   hidden=tuple(hidden))
+        self.params = init(self.cfg, jax.random.PRNGKey(seed))
+        self.opt = optax.adam(lr)
+        self.opt_state = self.opt.init(self.params)
+        # beta=0 => plain BC; beta>0 => MARWIL advantage weighting.
+        self.beta = beta
+        self.vf_coeff = vf_coeff
+        self._step = self._make_step()
+        self.iteration = 0
+
+    def _make_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from . import rl_module
+
+        beta = self.beta
+        vf_coeff = self.vf_coeff
+
+        def loss_fn(params, batch):
+            logits, values = rl_module.forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"].astype(jnp.int32)[:, None],
+                axis=1)[:, 0]
+            if beta > 0.0:
+                # MARWIL: exp(beta * advantage) weighted imitation +
+                # value regression toward monte-carlo returns.
+                adv = batch["returns"] - values
+                w = jnp.exp(beta * jax.lax.stop_gradient(
+                    adv / (jnp.abs(adv).mean() + 1e-8)))
+                pi_loss = -jnp.mean(w * logp)
+                vf_loss = jnp.mean(jnp.square(adv))
+                total = pi_loss + vf_coeff * vf_loss
+                stats = {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                         "total_loss": total}
+            else:
+                total = -jnp.mean(logp)
+                stats = {"total_loss": total}
+            return total, stats
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, stats
+
+        return step
+
+    @staticmethod
+    def _batch_from_rows(rows: Dict[str, np.ndarray],
+                         need_returns: bool) -> Dict[str, np.ndarray]:
+        batch = {
+            "obs": np.asarray([np.asarray(o, np.float32)
+                               for o in rows["obs"]]),
+            "actions": np.asarray(rows["action"], np.int64),
+        }
+        if need_returns:
+            if "return" in rows:
+                batch["returns"] = np.asarray(rows["return"], np.float32)
+            else:
+                # Monte-carlo returns from (reward, done) row order.
+                r = np.asarray(rows["reward"], np.float32)
+                d = np.asarray(rows["done"], bool)
+                ret = np.zeros_like(r)
+                acc = 0.0
+                for i in range(len(r) - 1, -1, -1):
+                    acc = r[i] + 0.99 * (0.0 if d[i] else acc)
+                    ret[i] = acc
+                batch["returns"] = ret
+        return batch
+
+    def train_on_dataset(self, ds, *, epochs: int = 1,
+                         batch_size: int = 256) -> Dict[str, float]:
+        stats: Dict[str, Any] = {}
+        for _ in range(epochs):
+            for rows in ds.iter_batches(batch_size=batch_size,
+                                        batch_format="numpy"):
+                batch = self._batch_from_rows(rows, self.beta > 0.0)
+                self.params, self.opt_state, stats = self._step(
+                    self.params, self.opt_state, batch)
+                self.iteration += 1
+        return {k: float(v) for k, v in stats.items()}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from . import rl_module
+
+        logits, _ = rl_module.forward_jit(self.params, jnp.asarray(obs))
+        return np.asarray(np.argmax(logits, axis=-1))
+
+
+class MARWIL(BC):
+    """Monotonic advantage re-weighted imitation learning
+    (reference: ``rllib/algorithms/marwil``)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, beta: float = 1.0,
+                 **kw):
+        super().__init__(obs_dim, num_actions, beta=beta, **kw)

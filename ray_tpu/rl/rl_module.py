@@ -76,3 +76,17 @@ def sample_actions(params, obs, key) -> Tuple[np.ndarray, np.ndarray, np.ndarray
     actions = jax.random.categorical(key, logits)
     logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), actions]
     return (np.asarray(actions), np.asarray(logp), np.asarray(value))
+
+
+def epsilon_greedy_actions(params, obs, key, epsilon: float) -> np.ndarray:
+    """Q-learning exploration: argmax-Q with epsilon random actions.
+
+    For value-based algorithms the ``pi`` head's logits ARE the Q-values
+    (reference: DQN's RLModule emits Q per action).
+    """
+    q, _ = forward_jit(params, jnp.asarray(obs))
+    k1, k2 = jax.random.split(key)
+    greedy = jnp.argmax(q, axis=-1)
+    rand = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+    explore = jax.random.uniform(k2, greedy.shape) < epsilon
+    return np.asarray(jnp.where(explore, rand, greedy))

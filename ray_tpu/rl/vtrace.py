@@ -1,0 +1,40 @@
+"""V-trace off-policy correction (IMPALA).
+
+Reference: ``rllib/algorithms/impala/`` vtrace_torch/tf — importance-
+weighted multi-step value targets with clipped rho/c (Espeholt et al.
+2018). Computed as a reverse scan over [T, N] arrays; numpy here (it runs
+on the learner's host path right before the jitted update, like GAE).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def vtrace(behaviour_logp: np.ndarray, target_logp: np.ndarray,
+           rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
+           bootstrap_value: np.ndarray, gamma: float = 0.99,
+           clip_rho: float = 1.0, clip_c: float = 1.0
+           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (vs, pg_advantages), both [T, N].
+
+    vs are the v-trace value targets; pg_advantages are the clipped-rho
+    weighted advantages for the policy gradient.
+    """
+    T, N = rewards.shape
+    rho = np.minimum(np.exp(target_logp - behaviour_logp), clip_rho)
+    c = np.minimum(np.exp(target_logp - behaviour_logp), clip_c)
+    nonterminal = 1.0 - dones.astype(np.float32)
+    values_tp1 = np.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho * (rewards + gamma * values_tp1 * nonterminal - values)
+    vs_minus_v = np.zeros((T, N), np.float32)
+    acc = np.zeros(N, np.float32)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + gamma * nonterminal[t] * c[t] * acc
+        vs_minus_v[t] = acc
+    vs = vs_minus_v + values
+    vs_tp1 = np.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * vs_tp1 * nonterminal - values)
+    return vs.astype(np.float32), pg_adv.astype(np.float32)

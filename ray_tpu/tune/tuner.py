@@ -296,12 +296,10 @@ class Tuner:
                     break  # exhausted, or searcher backpressure
                 launch(t)
             if not running and not pending:
-                if exhausted:
-                    break
-                # Searcher declined with nothing in flight (shouldn't
-                # persist); brief backoff then retry.
-                time.sleep(0.05)
-                continue
+                # With nothing in flight a searcher has no backpressure
+                # reason to decline (ConcurrencyLimiter's live set is
+                # empty), so a None here means it is out of suggestions.
+                break
             # Drain new reports -> scheduler decisions
             for tid, result in ray_tpu.get(collector.new_reports.remote()):
                 trial = trial_by_id[tid]
