@@ -198,6 +198,11 @@ class Dataset:
     def _all_blocks(self) -> List[Any]:
         return ray_tpu.get(list(self._stream_refs()))
 
+    def _concat_all(self):
+        """Materialize the whole dataset as one arrow table."""
+        return BlockAccessor.concat(
+            [to_block(b) for b in self._all_blocks()])
+
     # ---------------------------------------------------- all-to-all ops
 
     def repartition(self, num_blocks: int) -> "Dataset":
@@ -317,6 +322,37 @@ class Dataset:
         return (f"Dataset(num_blocks={self.num_blocks()}, "
                 f"ops={[o.kind for o in self._ops]})")
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two equal-length datasets (reference:
+        ``Dataset.zip``). Right-hand duplicate columns get a ``_1``
+        suffix."""
+        left = self._concat_all()
+        right = other._concat_all()
+        if left.num_rows != right.num_rows:
+            raise ValueError(
+                f"zip requires equal row counts: {left.num_rows} vs "
+                f"{right.num_rows}")
+        out = left
+        for name in right.column_names:
+            col = right.column(name)
+            if name in out.column_names:
+                name = name + "_1"
+            out = out.append_column(name, col)
+        return Dataset([out], [], self._remote_args)
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by a key column (reference: ``Dataset.groupby`` →
+        ``GroupedData``)."""
+        return GroupedData(self, key)
+
+    def unique(self, column: str) -> List[Any]:
+        import pyarrow.compute as pc
+
+        return pc.unique(self._concat_all().column(column)).to_pylist()
+
+    def to_pandas(self):
+        return self._concat_all().to_pandas()
+
     # aggregations
     def sum(self, on: str):
         return builtins.sum(
@@ -338,6 +374,12 @@ class Dataset:
             tot += float(col.sum())
             n += len(col)
         return tot / max(n, 1)
+
+    def std(self, on: str, ddof: int = 1):
+        import pyarrow.compute as pc
+
+        return float(pc.stddev(self._concat_all().column(on),
+                               ddof=ddof).as_py())
 
     # ---------------------------------------------------------- writing
 
@@ -367,3 +409,80 @@ class Dataset:
 
 class MaterializedDataset(Dataset):
     """All blocks resident (reference: ``MaterializedDataset``)."""
+
+
+def _apply_group_fn(fn, table):
+    out = fn(BlockAccessor(table).to_numpy())
+    return to_block(out)
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby``: per-key aggregations + map_groups.
+
+    Reference: ``python/ray/data/grouped_data.py`` (``GroupedData.count/
+    sum/mean/min/max/std/aggregate/map_groups``). Aggregations lower onto
+    arrow's hash group_by kernels; ``map_groups`` runs the UDF per group as
+    parallel tasks.
+    """
+
+    def __init__(self, dataset: Dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    def _big(self):
+        return self._ds._concat_all()
+
+    def aggregate(self, *aggs: tuple) -> Dataset:
+        """``aggs`` are (column, fn) pairs with fn in
+        {sum, mean, min, max, count, stddev}."""
+        arrow_fns = {"sum": "sum", "mean": "mean", "min": "min",
+                     "max": "max", "count": "count", "std": "stddev",
+                     "stddev": "stddev"}
+        spec = [(col, arrow_fns[fn]) for col, fn in aggs]
+        out = self._big().group_by(self._key).aggregate(spec)
+        # Arrow names results "<col>_<fn>"; match the reference's
+        # "<fn>(<col>)" naming.
+        renames = {f"{col}_{afn}": f"{fn}({col})"
+                   for (col, fn), (_, afn) in zip(aggs, spec)}
+        out = out.rename_columns(
+            [renames.get(c, c) for c in out.column_names])
+        return Dataset([out], [], self._ds._remote_args)
+
+    def count(self) -> Dataset:
+        out = self._big().group_by(self._key).aggregate([([], "count_all")])
+        out = out.rename_columns(
+            ["count()" if c == "count_all" else c
+             for c in out.column_names])
+        return Dataset([out], [], self._ds._remote_args)
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate((on, "sum"))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate((on, "mean"))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate((on, "min"))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate((on, "max"))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate((on, "std"))
+
+    def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Any]
+                   ) -> Dataset:
+        """Run ``fn(group_batch) -> batch`` once per group, in parallel
+        tasks; results union into a new Dataset."""
+        import functools
+
+        import pyarrow.compute as pc
+
+        big = self._big()
+        keys = pc.unique(big.column(self._key)).to_pylist()
+        sources = []
+        for k in keys:
+            mask = pc.equal(big.column(self._key), k)
+            sources.append(functools.partial(
+                _apply_group_fn, fn, big.filter(mask)))
+        return Dataset(sources, [], self._ds._remote_args)

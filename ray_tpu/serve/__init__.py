@@ -12,6 +12,7 @@ from typing import Any, Dict, Optional
 import ray_tpu
 
 from .batching import batch
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .controller import get_controller, reset_controller_cache
 from .deployment import (
     Application,
@@ -48,15 +49,130 @@ def _collect_graph(app: Application, out: Dict[str, Application],
     app.kwargs = new_kwargs
 
 
+class _LocalResponse:
+    """DeploymentResponse stand-in for local testing mode."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+    def __await__(self):
+        async def _v():
+            return self._value
+        return _v().__await__()
+
+
+def _run_coro_in_thread(coro):
+    """Run a coroutine to completion on a fresh thread+loop.
+
+    ``asyncio.run`` in a dedicated thread sidesteps "event loop already
+    running" when local handle calls nest (async ingress awaiting an async
+    downstream), and closes the loop when done. The caller's contextvars
+    (multiplexed model id) are carried across the thread boundary.
+    """
+    import asyncio
+    import contextvars
+    import threading
+
+    ctx = contextvars.copy_context()
+    result: list = []
+    error: list = []
+
+    def runner():
+        try:
+            result.append(ctx.run(asyncio.run, coro))
+        except BaseException as e:  # noqa: BLE001
+            error.append(e)
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join()
+    if error:
+        raise error[0]
+    return result[0]
+
+
+class _LocalHandle:
+    """In-process deployment handle (reference: serve's
+    ``local_testing_mode.py`` — run deployments without a cluster)."""
+
+    def __init__(self, instance, method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
+        self._instance = instance
+        self._method = method_name
+        self._model_id = multiplexed_model_id
+
+    def options(self, method_name=None, multiplexed_model_id=None):
+        # `is not None` (not falsy-or): clearing back to "" must work,
+        # matching DeploymentHandle.options semantics.
+        return _LocalHandle(
+            self._instance,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> _LocalResponse:
+        import asyncio
+
+        from .multiplex import (_reset_multiplexed_model_id,
+                                _set_multiplexed_model_id)
+
+        # Set for this call only — and always (even to ""), so a stale id
+        # from a previous multiplexed call can't leak into this one.
+        token = _set_multiplexed_model_id(self._model_id)
+        try:
+            target = getattr(self._instance, self._method, None)
+            if target is None and self._method == "__call__":
+                target = self._instance
+            out = target(*args, **kwargs)
+            if asyncio.iscoroutine(out):
+                out = _run_coro_in_thread(out)
+            return _LocalResponse(out)
+        finally:
+            _reset_multiplexed_model_id(token)
+
+
+def _run_local(target: Application, name: str,
+               instances: Optional[Dict[str, Any]] = None) -> _LocalHandle:
+    # Dedup by deployment name, matching cluster mode's _collect_graph:
+    # a diamond graph shares ONE instance of a deployment, not one per
+    # bind site.
+    if instances is None:
+        instances = {}
+    dep = target.deployment
+    if dep.name in instances:
+        return _LocalHandle(instances[dep.name])
+    args = [(_run_local(a, name, instances)
+             if isinstance(a, Application) else a) for a in target.args]
+    kwargs = {k: (_run_local(a, name, instances)
+                  if isinstance(a, Application) else a)
+              for k, a in target.kwargs.items()}
+    instance = dep._target(*args, **kwargs) if dep.is_class else dep._target
+    instances[dep.name] = instance
+    return _LocalHandle(instance)
+
+
 def run(target: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/",
-        _blocking: bool = True) -> DeploymentHandle:
+        _blocking: bool = True,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application; returns the ingress handle
     (reference: ``serve.run`` ``serve/api.py:491``)."""
-    if not ray_tpu.is_initialized():
-        ray_tpu.init(ignore_reinit_error=True)
     if not isinstance(target, Application):
         raise TypeError("serve.run expects Deployment.bind(...)")
+    if _local_testing_mode:
+        # Everything in-process, no actors/cluster: the unit-test mode the
+        # reference ships as ``serve/_private/local_testing_mode.py``.
+        return _run_local(target, name)
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
     graph: Dict[str, Application] = {}
     _collect_graph(target, graph, name)
     specs = []
@@ -143,4 +259,5 @@ __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "Request", "run", "delete", "status", "shutdown",
     "batch", "get_deployment_handle", "get_app_handle", "get_proxy_port",
+    "multiplexed", "get_multiplexed_model_id",
 ]

@@ -75,6 +75,11 @@ class Replica:
                                    kwargs: dict):
         import asyncio
 
+        model_id = kwargs.pop("_multiplexed_model_id", "")
+        if model_id:
+            from .multiplex import _set_multiplexed_model_id
+
+            _set_multiplexed_model_id(model_id)
         target = getattr(self.callable, method, None)
         if target is None and method == "__call__":
             target = self.callable
@@ -98,10 +103,12 @@ class Replica:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
+        self.multiplexed_model_id = multiplexed_model_id
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
         self._rng = random.Random()
@@ -131,9 +138,14 @@ class DeploymentHandle:
             self.app_name, self.deployment_name)
         self._inflight = {i: 0 for i in range(len(self._replicas))}
 
-    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self.app_name,
-                             method_name or self.method_name)
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self.method_name,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self.multiplexed_model_id)
         h._replicas = self._replicas
         h._inflight = self._inflight
         return h
@@ -150,6 +162,9 @@ class DeploymentHandle:
         idx = self._pick()
         replica = self._replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        if self.multiplexed_model_id:
+            kwargs = {**kwargs,
+                      "_multiplexed_model_id": self.multiplexed_model_id}
         ref = replica.handle_request_async.remote(
             self.method_name, args, kwargs)
 
@@ -193,7 +208,8 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self.method_name))
+                (self.deployment_name, self.app_name, self.method_name,
+                 self.multiplexed_model_id))
 
 
 class Application:

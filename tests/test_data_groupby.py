@@ -1,0 +1,71 @@
+"""Data: groupby/aggregate, zip, unique, std (reference:
+``python/ray/data/grouped_data.py``, ``Dataset.zip``)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_cluster):
+    yield
+
+
+def _rows():
+    return [{"g": ["a", "b"][i % 2], "x": float(i), "y": i * 2}
+            for i in range(10)]
+
+
+def test_groupby_count_sum_mean():
+    ds = rdata.from_items(_rows())
+    counts = {r["g"]: r["count()"]
+              for r in ds.groupby("g").count().take_all()}
+    assert counts == {"a": 5, "b": 5}
+    sums = {r["g"]: r["sum(x)"] for r in ds.groupby("g").sum("x").take_all()}
+    assert sums == {"a": 0 + 2 + 4 + 6 + 8, "b": 1 + 3 + 5 + 7 + 9}
+    means = {r["g"]: r["mean(y)"]
+             for r in ds.groupby("g").mean("y").take_all()}
+    assert means == {"a": 8.0, "b": 10.0}
+
+
+def test_groupby_multi_aggregate():
+    ds = rdata.from_items(_rows())
+    out = ds.groupby("g").aggregate(("x", "min"), ("x", "max"),
+                                    ("y", "sum")).take_all()
+    by_g = {r["g"]: r for r in out}
+    assert by_g["a"]["min(x)"] == 0.0 and by_g["a"]["max(x)"] == 8.0
+    assert by_g["b"]["sum(y)"] == (1 + 3 + 5 + 7 + 9) * 2
+
+
+def test_groupby_map_groups():
+    ds = rdata.from_items(_rows())
+
+    def center(batch):
+        x = batch["x"]
+        return {"g": batch["g"], "x_centered": x - x.mean()}
+
+    out = ds.groupby("g").map_groups(center)
+    rows = out.take_all()
+    assert len(rows) == 10
+    for g in ("a", "b"):
+        vals = [r["x_centered"] for r in rows if r["g"] == g]
+        assert abs(sum(vals)) < 1e-9
+
+
+def test_zip_and_unique_and_std():
+    a = rdata.from_items([{"x": i} for i in range(6)])
+    b = rdata.from_items([{"y": i * 10} for i in range(6)])
+    z = a.zip(b)
+    rows = z.take_all()
+    assert all(r["y"] == r["x"] * 10 for r in rows)
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(rdata.from_items([{"y": 1}]))
+    dup = rdata.from_items([{"x": i} for i in range(3)])
+    z2 = a.limit(3).zip(dup)  # duplicate column name -> x_1
+    assert "x_1" in z2.columns()
+    ds = rdata.from_items([{"g": "a"}, {"g": "b"}, {"g": "a"}])
+    assert sorted(ds.unique("g")) == ["a", "b"]
+    nums = rdata.from_items([{"v": float(v)} for v in [2, 4, 4, 4, 5, 5, 7, 9]])
+    assert abs(nums.std("v") - np.std([2, 4, 4, 4, 5, 5, 7, 9], ddof=1)) \
+        < 1e-9

@@ -1,0 +1,158 @@
+"""Serve multiplexing + local testing mode (reference:
+``python/ray/serve/multiplex.py``, ``serve/_private/local_testing_mode.py``).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture()
+def clean_serve(ray_cluster):
+    yield
+    serve.shutdown()
+
+
+def test_multiplexed_replica(clean_serve):
+    @serve.deployment(num_replicas=1)
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(model_id)}
+
+        async def __call__(self, x: float):
+            model = await self.get_model(
+                serve.get_multiplexed_model_id())
+            return x * model["scale"]
+
+        def load_count(self):
+            return len(self.loads)
+
+    handle = serve.run(Multi.bind(), route_prefix=None)
+    h_a = handle.options(multiplexed_model_id="aa")
+    h_b = handle.options(multiplexed_model_id="bbb")
+    assert h_a.remote(2.0).result(timeout=30) == 4.0
+    assert h_b.remote(2.0).result(timeout=30) == 6.0
+    # Cache hit: second call to the same model must not reload.
+    assert h_a.remote(3.0).result(timeout=30) == 6.0
+    loads = handle.options(method_name="load_count").remote().result(
+        timeout=30)
+    assert loads == 2
+    # Third model evicts the LRU entry (max 2).
+    h_c = handle.options(multiplexed_model_id="cccc")
+    assert h_c.remote(1.0).result(timeout=30) == 4.0
+    assert handle.options(method_name="load_count").remote().result(
+        timeout=30) == 3
+
+
+def test_local_testing_mode_composition():
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, x):
+            return self.doubler.remote(x).result() + 1
+
+    handle = serve.run(Ingress.bind(Doubler.bind()),
+                       _local_testing_mode=True)
+    assert handle.remote(10).result() == 21
+
+
+def test_local_testing_mode_multiplex():
+    @serve.deployment
+    class M:
+        @serve.multiplexed(max_num_models_per_replica=4)
+        async def load(self, mid):
+            return mid.upper()
+
+        async def __call__(self):
+            return await self.load(serve.get_multiplexed_model_id())
+
+    handle = serve.run(M.bind(), _local_testing_mode=True)
+    assert handle.options(multiplexed_model_id="abc").remote().result() \
+        == "ABC"
+
+
+def test_local_testing_mode_nested_async():
+    """Async ingress awaiting an async downstream must not deadlock the
+    local-mode event loop, and a stale model id must not leak between
+    calls."""
+
+    @serve.deployment
+    class AsyncDoubler:
+        async def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class AsyncIngress:
+        def __init__(self, d):
+            self.d = d
+
+        async def __call__(self, x):
+            inner = self.d.remote(x).result()
+            return inner + 1
+
+    handle = serve.run(AsyncIngress.bind(AsyncDoubler.bind()),
+                       _local_testing_mode=True)
+    assert handle.remote(5).result() == 11
+
+    @serve.deployment
+    class IdEcho:
+        def __call__(self):
+            return serve.get_multiplexed_model_id()
+
+    h = serve.run(IdEcho.bind(), _local_testing_mode=True)
+    assert h.options(multiplexed_model_id="m1").remote().result() == "m1"
+    assert h.remote().result() == ""  # no leak from the previous call
+
+
+def test_local_testing_mode_diamond_shares_instance():
+    @serve.deployment
+    class Shared:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    @serve.deployment
+    class A:
+        def __init__(self, s):
+            self.s = s
+
+        def __call__(self):
+            return self.s.bump.remote().result()
+
+    @serve.deployment
+    class B:
+        def __init__(self, s):
+            self.s = s
+
+        def __call__(self):
+            return self.s.bump.remote().result()
+
+    @serve.deployment
+    class Top:
+        def __init__(self, a, b):
+            self.a, self.b = a, b
+
+        def __call__(self):
+            return self.a.remote().result(), self.b.remote().result()
+
+    s = Shared.bind()
+    handle = serve.run(Top.bind(A.bind(s), B.bind(s)),
+                       _local_testing_mode=True)
+    # One shared instance => counter goes 1 then 2 (not 1, 1).
+    assert handle.remote().result() == (1, 2)
