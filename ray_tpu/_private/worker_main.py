@@ -300,6 +300,18 @@ class Executor:
         try:
             self._apply_runtime_env(opts)
             fn = self._get_function(msg["fid"])
+            if opts.get("xlang"):
+                # Cross-language call (C++ client): msgpack args in, raw
+                # msgpack result bytes out — the owner is not a Python
+                # process and reads the result directly
+                # (ray_tpu/cross_language.py).
+                from ray_tpu.cross_language import execute_xlang_task
+
+                tid_obj = TaskID(tid)
+                data = execute_xlang_task(fn, bytes(msg.get("args") or b""))
+                return [{"oid": ObjectID.for_task_return(
+                    tid_obj, 1).binary(), "nbytes": len(data),
+                    "data": data}]
             args, kwargs = self._load_args(msg)
             value = fn(*args, **kwargs)
             if asyncio.iscoroutine(value):
@@ -309,6 +321,15 @@ class Executor:
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 e = serialization.TaskCancelledError(str(e))
+            if opts.get("xlang"):
+                import msgpack
+
+                data = msgpack.packb(
+                    {"__xlang_error__": f"{type(e).__name__}: {e}"},
+                    use_bin_type=True)
+                return [{"oid": ObjectID.for_task_return(
+                    TaskID(tid), 1).binary(), "nbytes": len(data),
+                    "data": data, "_err": True}]
             return self._error_results(tid, nret, fn_name, e)
         finally:
             self.current_task_thread = None

@@ -1,0 +1,45 @@
+"""Cross-language task calls (C++ → Python).
+
+Analog of the reference's ``python/ray/cross_language.py`` + the C++ user
+API (``cpp/include/ray/api/``): a Python driver registers named functions;
+a C++ client (``native/cpp_client/ray_tpu_client.hpp``) submits tasks that
+call them by name, with arguments and results encoded as plain msgpack —
+the same language-neutral interchange the reference uses for cross-language
+calls. Worker-side dispatch: a task whose options carry ``xlang`` decodes
+``args`` as a msgpack array and msgpack-encodes the return value, so the
+non-Python owner can read the result bytes directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import cloudpickle
+
+from ._private.worker import global_worker
+
+
+def register_function(name: str, fn: Callable) -> None:
+    """Expose ``fn`` to non-Python clients under ``name``.
+
+    The function must accept/return msgpack-representable values (numbers,
+    strings, bytes, lists, dicts).
+    """
+    if not name or "/" in name:
+        raise ValueError(f"invalid cross-language function name {name!r}")
+    w = global_worker()
+    w.kv_put(name, cloudpickle.dumps(fn), ns="fn")
+
+
+def unregister_function(name: str) -> None:
+    w = global_worker()
+    w.kv_del(name, ns="fn")
+
+
+def execute_xlang_task(fn: Callable, raw_args: Any) -> bytes:
+    """Worker-side xlang execution: msgpack in, msgpack out."""
+    import msgpack
+
+    args = msgpack.unpackb(raw_args, raw=False) if raw_args else []
+    value = fn(*args)
+    return msgpack.packb(value, use_bin_type=True)

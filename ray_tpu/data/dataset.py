@@ -335,9 +335,11 @@ class Dataset:
         out = left
         for name in right.column_names:
             col = right.column(name)
-            if name in out.column_names:
-                name = name + "_1"
-            out = out.append_column(name, col)
+            new_name, k = name, 0
+            while new_name in out.column_names:
+                k += 1
+                new_name = f"{name}_{k}"
+            out = out.append_column(new_name, col)
         return Dataset([out], [], self._remote_args)
 
     def groupby(self, key: str) -> "GroupedData":
@@ -435,15 +437,22 @@ class GroupedData:
     def aggregate(self, *aggs: tuple) -> Dataset:
         """``aggs`` are (column, fn) pairs with fn in
         {sum, mean, min, max, count, stddev}."""
+        import pyarrow.compute as pc
+
         arrow_fns = {"sum": "sum", "mean": "mean", "min": "min",
                      "max": "max", "count": "count", "std": "stddev",
                      "stddev": "stddev"}
-        spec = [(col, arrow_fns[fn]) for col, fn in aggs]
+        # Sample stddev (ddof=1), consistent with Dataset.std and the
+        # reference's GroupedData.std default; arrow's kernel defaults to
+        # population stddev.
+        spec = [(col, arrow_fns[fn], pc.VarianceOptions(ddof=1))
+                if arrow_fns[fn] == "stddev" else (col, arrow_fns[fn])
+                for col, fn in aggs]
         out = self._big().group_by(self._key).aggregate(spec)
         # Arrow names results "<col>_<fn>"; match the reference's
         # "<fn>(<col>)" naming.
-        renames = {f"{col}_{afn}": f"{fn}({col})"
-                   for (col, fn), (_, afn) in zip(aggs, spec)}
+        renames = {f"{col}_{s[1]}": f"{fn}({col})"
+                   for (col, fn), s in zip(aggs, spec)}
         out = out.rename_columns(
             [renames.get(c, c) for c in out.column_names])
         return Dataset([out], [], self._ds._remote_args)
