@@ -16,6 +16,8 @@ from __future__ import annotations
 import functools
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -41,9 +43,108 @@ def detect_peak_flops(device) -> float:
     return 1e12  # CPU placeholder so the script still runs
 
 
+def _kill_stale_chip_holders():
+    """Kill leftover framework processes that may hold the TPU.
+
+    Workers spawned by earlier test/bench sessions can outlive them and pin
+    the (single, tunneled) chip; the round-1 bench failed with a bare
+    ``UNAVAILABLE`` for exactly this reason. The bench requires exclusive
+    chip access, so reap them first.
+    """
+    me = os.getpid()
+    killed = []
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
+        try:
+            with open(f"/proc/{pid_s}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if "ray_tpu._private" in cmd or "ray_tpu/_private" in cmd:
+            try:
+                os.kill(int(pid_s), signal.SIGKILL)
+                killed.append(int(pid_s))
+            except OSError:
+                pass
+    if killed:
+        time.sleep(1.0)
+    return killed
+
+
+def _probe_tpu(timeout_s: float) -> dict:
+    """Probe TPU backend init in a subprocess (init can hang, not just fail)."""
+    code = (
+        "import jax, json, sys\n"
+        "ds = jax.devices()\n"
+        "d = ds[0]\n"
+        "print(json.dumps({'platform': d.platform,"
+        " 'kind': getattr(d, 'device_kind', ''), 'n': len(ds)}))\n"
+    )
+    env = dict(os.environ)
+    env.pop("RAY_TPU_JAX_PLATFORM", None)
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "err": f"backend init hung > {timeout_s:.0f}s"}
+    if out.returncode != 0:
+        tail = out.stderr.decode(errors="replace").strip().splitlines()
+        return {"ok": False, "err": " | ".join(tail[-3:]) if tail else
+                f"probe rc={out.returncode}"}
+    try:
+        info = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    except Exception:
+        return {"ok": False, "err": "probe output unparsable"}
+    info["ok"] = True
+    return info
+
+
+def acquire_tpu() -> dict:
+    """Robust backend acquisition: cleanup, then probe with retry+backoff.
+
+    Returns the last probe result; ``ok`` False means every attempt failed
+    and the caller should fall back to CPU with diagnostics.
+    """
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
+    timeout_s = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+    diag: dict = {"attempts": []}
+    # First, one non-destructive attempt — don't touch other processes if
+    # the chip is simply free.
+    last = _probe_tpu(min(timeout_s, 60.0))
+    diag["attempts"].append("ok" if last.get("ok") else last.get("err"))
+    if last.get("ok"):
+        last["diag"] = diag
+        return last
+    # The chip may be pinned by leftover framework processes from an
+    # earlier session; reap them (opt out: BENCH_KEEP_CLUSTER=1) and retry.
+    if os.environ.get("BENCH_KEEP_CLUSTER") != "1":
+        killed = _kill_stale_chip_holders()
+        if killed:
+            diag["killed_stale_pids"] = killed
+    for i in range(attempts):
+        last = _probe_tpu(timeout_s)
+        diag["attempts"].append(last.get("err") if not last.get("ok")
+                                else "ok")
+        if last.get("ok"):
+            break
+        time.sleep(min(10.0 * (i + 1), 30.0))
+    last["diag"] = diag
+    return last
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
+    tpu_probe = acquire_tpu()
     import jax
+
+    if not tpu_probe.get("ok"):
+        # No chip: run the CPU smoke so the driver still records a JSON
+        # line, with the TPU failure diagnostics attached. The env var is
+        # not enough — the axon PJRT hook force-sets JAX_PLATFORMS, so pin
+        # the platform through jax.config.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import optax
 
@@ -94,21 +195,25 @@ def main():
     tok_per_sec = tokens_per_step * steps / dt
     flops = flops_per_token(cfg, seq) * tok_per_sec
     mfu = flops / detect_peak_flops(dev)
+    extra = {
+        "mfu": round(mfu, 4),
+        "first_loss": round(first_loss, 3),
+        "loss": round(final_loss, 4),
+        "device": str(dev),
+        "params_b": round(cfg.param_count() / 1e9, 3),
+        "batch": batch, "seq": seq, "steps": steps,
+        "step_time_s": round(dt / steps, 4),
+    }
+    if not on_tpu:
+        extra["tpu_unavailable"] = tpu_probe.get("err", "unknown")
+        extra["tpu_diag"] = tpu_probe.get("diag", {})
     print(json.dumps({
         "metric": f"llama_{cfg.param_count()/1e9:.1f}B_train_tokens_per_sec_per_chip"
                   + ("" if on_tpu else "_cpu_smoke"),
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "first_loss": round(first_loss, 3),
-            "loss": round(final_loss, 4),
-            "device": str(dev),
-            "params_b": round(cfg.param_count() / 1e9, 3),
-            "batch": batch, "seq": seq, "steps": steps,
-            "step_time_s": round(dt / steps, 4),
-        },
+        "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
+        "extra": extra,
     }))
 
 

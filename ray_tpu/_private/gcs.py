@@ -105,7 +105,7 @@ class TaskRecord:
     __slots__ = ("task_id", "msg", "owner", "retries_left", "state", "worker_id",
                  "cancelled", "resources", "pg", "bundle", "strategy", "returns",
                  "name", "ts_created", "ts_running", "ts_done", "error",
-                 "node_id")
+                 "node_id", "sig")
 
     def __init__(self, task_id: TaskID, msg: dict, owner: "ClientConn"):
         self.task_id = task_id
@@ -118,6 +118,15 @@ class TaskRecord:
         self.bundle = opts.get("bix")
         self.strategy = opts.get("sched") or "DEFAULT"
         self.name = opts.get("name", "")
+        # Scheduling class (reference: scheduling classes keyed by resource
+        # shape in NormalTaskSubmitter): tasks with identical placement needs
+        # share one pending queue, so a scheduling pass is O(dispatched +
+        # distinct classes), never O(queue length).
+        strategy = self.strategy
+        if isinstance(strategy, dict):
+            strategy = tuple(sorted(strategy.items()))
+        self.sig = (tuple(sorted(self.resources.items())), self.pg,
+                    self.bundle, strategy)
         self.state = "pending"
         self.worker_id: Optional[WorkerID] = None
         self.node_id: Optional[NodeID] = None
@@ -202,6 +211,34 @@ class PGRecord:
         self.ready_waiters: List[Tuple[protocol.Connection, dict]] = []
 
 
+class PendingQueues:
+    """Pending tasks bucketed by scheduling class (``TaskRecord.sig``).
+
+    One deque per class keeps FIFO order within a class; a blocked class is
+    skipped in O(1) instead of re-examining each of its tasks every pass.
+    """
+
+    __slots__ = ("qs", "count")
+
+    def __init__(self):
+        self.qs: Dict[tuple, deque] = {}
+        self.count = 0
+
+    def append(self, record: "TaskRecord"):
+        q = self.qs.get(record.sig)
+        if q is None:
+            q = self.qs[record.sig] = deque()
+        q.append(record.task_id)
+        self.count += 1
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __iter__(self):
+        for q in self.qs.values():
+            yield from q
+
+
 _client_serial = iter(range(1, 1 << 62)).__next__
 
 
@@ -232,7 +269,7 @@ class GcsServer:
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.workers: Dict[WorkerID, WorkerInfo] = {}
         self.tasks: Dict[TaskID, TaskRecord] = {}
-        self.pending: deque = deque()  # TaskID
+        self.pending = PendingQueues()
         self.objects: Dict[ObjectID, ObjectEntry] = {}
         self.zero_ref_lru: "OrderedDict[ObjectID, int]" = OrderedDict()
         self.shm_bytes = 0
@@ -689,7 +726,7 @@ class GcsServer:
             return True  # already being recomputed
         record = TaskRecord(tid, spec["msg"], spec["owner"])
         self.tasks[tid] = record
-        self.pending.append(tid)
+        self.pending.append(record)
         self._wake_scheduler()
         return True
 
@@ -706,7 +743,7 @@ class GcsServer:
             self._owned_objects.setdefault(id(client), set()).add(oid)
             if record.retries_left > 0:
                 entry.producing_task = {"msg": msg, "owner": client}
-        self.pending.append(tid)
+        self.pending.append(record)
         self._wake_scheduler()
 
     async def _h_task_cancel(self, client, msg):
@@ -720,6 +757,19 @@ class GcsServer:
             if w is not None and not w.conn.closed:
                 w.conn.send({"t": "cancel", "tid": msg["tid"],
                              "force": msg.get("force", False)})
+        elif record.state == "pending":
+            # Reap immediately: a cancelled task queued behind a blocked
+            # class head would otherwise never be re-examined.
+            q = self.pending.qs.get(record.sig)
+            if q is not None:
+                try:
+                    q.remove(tid)
+                    self.pending.count -= 1
+                    if not q:
+                        del self.pending.qs[record.sig]
+                except ValueError:
+                    pass
+            self._finish_cancelled(record)
 
     def _wake_scheduler(self):
         self._sched_wakeup.set()
@@ -796,62 +846,60 @@ class GcsServer:
             _res_add(node.avail, worker.acquired)
         worker.acquired = {}
 
-    @staticmethod
-    def _sched_signature(record: TaskRecord) -> tuple:
-        """Scheduling class: tasks with identical placement needs
-        (reference: scheduling classes in ``NormalTaskSubmitter``). Once one
-        task of a class fails to place in a pass, the rest are skipped —
-        this keeps a scheduling pass O(dispatched + distinct classes)
-        instead of O(queue length)."""
-        res = tuple(sorted(record.resources.items()))
-        strategy = record.strategy
-        if isinstance(strategy, dict):
-            strategy = tuple(sorted(strategy.items()))
-        return (res, record.pg, record.bundle, strategy)
-
     def _schedule(self):
+        """One scheduling pass: O(dispatched + distinct scheduling classes).
+
+        Classes are served round-robin, one dispatch per class per cycle
+        (no class can starve another); a class that blocks (no feasible
+        node, or no idle worker) is skipped wholesale for the rest of the
+        pass — its per-task state never needs re-examination.
+        """
         deficit: Dict[NodeID, int] = {}
-        blocked: Dict[tuple, int] = {}
-        worker_blocked: Dict[tuple, NodeID] = {}
-        requeue = []
-        while self.pending:
-            tid = self.pending.popleft()
-            record = self.tasks.get(tid)
-            if record is None or record.cancelled:
-                if record is not None:
-                    self._finish_cancelled(record)
-                continue
-            sig = self._sched_signature(record)
-            if sig in blocked:
-                blocked[sig] += 1
-                requeue.append(tid)
-                continue
-            node = self._pick_node(record)
-            if node is None:
-                blocked[sig] = 1
-                requeue.append(tid)
-                continue
-            worker = self._grab_idle_worker(node)
-            if worker is None:
-                blocked[sig] = 1
-                worker_blocked[sig] = node.node_id
-                requeue.append(tid)
-                continue
-            worker.state = W_BUSY
-            worker.current_task = tid
-            worker.acquired = self._acquire(node, record)
-            record.state = "running"
-            record.worker_id = worker.worker_id
-            record.node_id = node.node_id
-            record.ts_running = time.time()
-            fwd = dict(record.msg)
-            fwd["t"] = "exec"
-            fwd.pop("i", None)
-            worker.conn.send(fwd)
-        # FIFO order preserved for the skipped tasks.
-        self.pending.extend(requeue)
-        for sig, node_id in worker_blocked.items():
-            deficit[node_id] = deficit.get(node_id, 0) + blocked.get(sig, 1)
+        qs = self.pending.qs
+        active = list(qs.keys())
+        while active:
+            still_active = []
+            for sig in active:
+                q = qs.get(sig)
+                while q:
+                    tid = q[0]
+                    record = self.tasks.get(tid)
+                    if record is None or record.cancelled:
+                        q.popleft()
+                        self.pending.count -= 1
+                        if record is not None:
+                            self._finish_cancelled(record)
+                        continue
+                    break
+                if not q:
+                    qs.pop(sig, None)
+                    continue
+                node = self._pick_node(record)
+                if node is None:
+                    continue  # class infeasible this pass
+                worker = self._grab_idle_worker(node)
+                if worker is None:
+                    deficit[node.node_id] = (
+                        deficit.get(node.node_id, 0) + len(q))
+                    continue
+                q.popleft()
+                self.pending.count -= 1
+                worker.state = W_BUSY
+                worker.current_task = tid
+                worker.acquired = self._acquire(node, record)
+                record.state = "running"
+                record.worker_id = worker.worker_id
+                record.node_id = node.node_id
+                record.ts_running = time.time()
+                fwd = dict(record.msg)
+                fwd["t"] = "exec"
+                fwd.pop("i", None)
+                worker.conn.send(fwd)
+                if q:
+                    still_active.append(sig)
+                else:
+                    qs.pop(sig, None)
+            active = still_active
         for node_id, d in deficit.items():
             node = self.nodes.get(node_id)
             if node is not None:
@@ -974,7 +1022,7 @@ class GcsServer:
             self.counters["tasks_retried"] += 1
             logger.info("retrying task %s (%d retries left)",
                         tid.hex()[:8], record.retries_left)
-            self.pending.append(tid)
+            self.pending.append(record)
         else:
             from . import serialization
 

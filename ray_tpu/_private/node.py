@@ -303,6 +303,29 @@ async def head_amain(args):
                 pass
 
 
+def _run_with_optional_profile(coro_factory, tag: str):
+    """Run the process main loop, optionally under cProfile.
+
+    ``RAY_TPU_PROFILE=<dir>`` dumps per-process ``.pstats`` files there —
+    the framework's on-demand profiling hook (reference: py-spy/memray
+    drivers in ``dashboard/modules/reporter/profile_manager.py``).
+    """
+    prof_dir = os.environ.get("RAY_TPU_PROFILE")
+    if not prof_dir:
+        asyncio.run(coro_factory())
+        return
+    import cProfile
+
+    prof = cProfile.Profile()
+    prof.enable()
+    try:
+        asyncio.run(coro_factory())
+    finally:
+        prof.disable()
+        os.makedirs(prof_dir, exist_ok=True)
+        prof.dump_stats(os.path.join(prof_dir, f"{tag}_{os.getpid()}.pstats"))
+
+
 def head_main():
     import argparse
     import logging
@@ -319,7 +342,7 @@ def head_main():
     parser.add_argument("--no-probe-tpu", action="store_true")
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
-    asyncio.run(head_amain(args))
+    _run_with_optional_profile(lambda: head_amain(args), "head")
 
 
 async def agent_amain(args):
@@ -347,7 +370,7 @@ def agent_main():
     parser.add_argument("--env", default="{}")
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
-    asyncio.run(agent_amain(args))
+    _run_with_optional_profile(lambda: agent_amain(args), "agent")
 
 
 class HeadNode:

@@ -102,9 +102,40 @@ class Connection:
         self._req_ids = itertools.count(1)
         self._closed = False
         self._read_task: Optional[asyncio.Task] = None
+        # Write coalescing: frames queued within one loop iteration go out
+        # in a single transport write / syscall. Under load (thousands of
+        # small control frames per second) this collapses per-message send
+        # syscalls, the dominant cost of the control plane.
+        self._wbuf: list = []
+        self._flush_scheduled = False
 
     def start(self):
         self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    def _write_frame(self, data: bytes):
+        if self._flush_scheduled:
+            # A frame already went out this loop tick: buffer the rest of
+            # the burst for one combined write at the end of the tick.
+            self._wbuf.append(data)
+            return
+        self._flush_scheduled = True
+        asyncio.get_running_loop().call_soon(self._flush_wbuf)
+        try:
+            self.writer.write(data)
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            self._mark_closed()
+
+    def _flush_wbuf(self):
+        self._flush_scheduled = False
+        if self._closed or not self._wbuf:
+            self._wbuf.clear()
+            return
+        data = self._wbuf[0] if len(self._wbuf) == 1 else b"".join(self._wbuf)
+        self._wbuf.clear()
+        try:
+            self.writer.write(data)
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            self._mark_closed()
 
     async def _read_loop(self):
         try:
@@ -148,7 +179,7 @@ class Connection:
         if self._closed:
             raise ConnectionError("connection closed")
         _maybe_inject_failure(msg)
-        self.writer.write(pack(msg))
+        self._write_frame(pack(msg))
 
     def request_nowait(self, msg: dict) -> asyncio.Future:
         """Synchronously send a request; returns the reply future.
@@ -164,7 +195,7 @@ class Connection:
         msg["i"] = rid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        self.writer.write(pack(msg))
+        self._write_frame(pack(msg))
         return fut
 
     async def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
@@ -184,6 +215,8 @@ class Connection:
         await self.writer.drain()
 
     async def close(self):
+        if self._wbuf and not self._closed:
+            self._flush_wbuf()
         if self._read_task is not None:
             self._read_task.cancel()
         self._mark_closed()

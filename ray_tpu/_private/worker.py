@@ -16,6 +16,7 @@ import asyncio
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future as SyncFuture
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -109,12 +110,22 @@ def _deserialize_object_ref(id_bytes: bytes) -> ObjectRef:
     return ObjectRef(ObjectID(id_bytes), borrowed=True)
 
 
-class _ActorConn:
-    """Cached direct connection to an actor's worker process."""
+class _ActorChannel:
+    """Per-actor direct connection plus its FIFO submission queue.
 
-    def __init__(self, addr: str, conn: protocol.Connection):
-        self.addr = addr
-        self.conn = conn
+    The reference keeps per-actor ordered queues in ``ActorTaskSubmitter``
+    (``transport/actor_task_submitter.h:75``); here the queue holds calls
+    made before the direct connection is up — once established, calls are
+    sent synchronously from the IO loop in submission order.
+    """
+
+    __slots__ = ("conn", "sendq", "connecting", "addr")
+
+    def __init__(self):
+        self.conn: Optional[protocol.Connection] = None
+        self.sendq: deque = deque()
+        self.connecting = False
+        self.addr: Optional[str] = None
 
 
 class Worker:
@@ -139,9 +150,13 @@ class Worker:
         self._memory_store: Dict[ObjectID, bytes] = {}
         self._ref_deltas: Dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
-        self._actor_conns: Dict[ActorID, _ActorConn] = {}
-        self._actor_locks: Dict[ActorID, asyncio.Lock] = {}
+        self._actor_chans: Dict[ActorID, _ActorChannel] = {}
         self._dead_actors: Dict[ActorID, str] = {}
+        # Outbound message queue: producer threads enqueue, a single loop
+        # wakeup drains the burst (write coalescing in protocol.Connection
+        # then collapses the burst into one syscall).
+        self._out_q: deque = deque()
+        self._out_lock = threading.Lock()
         self._registered_inline: set = set()
         self._promote_pending: set = set()
         self._flusher_handle = None
@@ -242,8 +257,9 @@ class Worker:
         self._flush_refs()
         if self.gcs is not None:
             await self.gcs.close()
-        for ac in self._actor_conns.values():
-            await ac.conn.close()
+        for ch in self._actor_chans.values():
+            if ch.conn is not None:
+                await ch.conn.close()
 
     # ----------------------------------------------------------- ref counts
 
@@ -421,20 +437,29 @@ class Worker:
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        futs = {r: self.object_future(r.id) for r in refs}
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as cf_wait
+
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready: List[ObjectRef] = []
-        while len(ready) < num_returns:
-            pending = [r for r in refs if r not in ready]
-            done_now = [r for r in pending if futs[r].done()]
-            ready.extend(done_now)
-            if len(ready) >= num_returns:
+        futs = [self.object_future(r.id) for r in refs]
+        while True:
+            not_done = [f for f in futs if not f.done()]
+            if len(futs) - len(not_done) >= num_returns or not not_done:
                 break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            time.sleep(0.001)
-        ready = ready[:num_returns]
-        not_ready = [r for r in refs if r not in ready]
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+            # Real blocking wait (condition variable under the hood) — no
+            # 1ms busy-poll (the reference blocks in plasma Wait the same
+            # way).
+            cf_wait(not_done, timeout=remaining,
+                    return_when=FIRST_COMPLETED)
+        done_idx = [i for i, f in enumerate(futs) if f.done()][:num_returns]
+        done_set = set(done_idx)
+        ready = [refs[i] for i in done_idx]
+        not_ready = [r for i, r in enumerate(refs) if i not in done_set]
         return ready, not_ready
 
     # ---------------------------------------------------------------- tasks
@@ -495,9 +520,9 @@ class Worker:
         elif t == "actor_dead":
             aid = ActorID(msg["aid"])
             self._dead_actors[aid] = msg.get("cause", "actor died")
-            ac = self._actor_conns.pop(aid, None)
-            if ac is not None:
-                await ac.conn.close()
+            ch = self._actor_chans.pop(aid, None)
+            if ch is not None and ch.conn is not None:
+                await ch.conn.close()
         elif t == "exec" or t == "actor_init" or t == "cancel" or t == "exit":
             # Only worker processes receive these; the executor overrides.
             await self.handle_control(msg)
@@ -516,7 +541,7 @@ class Worker:
             fut = SyncFuture()
             self._object_futures[oid] = fut
             refs.append(ObjectRef(oid, self))
-        self.loop.call_soon_threadsafe(self._send_gcs, msg)
+        self.send_gcs_threadsafe(msg)
         return refs
 
     def _send_gcs(self, msg: dict):
@@ -526,9 +551,21 @@ class Worker:
             except ConnectionError:
                 pass
 
+    def send_gcs_threadsafe(self, msg: dict):
+        """Queue a fire-and-forget GCS message from any thread.
+
+        A burst of messages (e.g. a submit loop) costs one loop wakeup and,
+        with connection write coalescing, one syscall — the analog of the
+        reference's batched gRPC stream writes."""
+        with self._out_lock:
+            self._out_q.append(msg)
+            wake = len(self._out_q) == 1
+        if wake:
+            self.loop.call_soon_threadsafe(self._drain_out)
+
     def cancel_task(self, tid: TaskID, force: bool):
-        self.loop.call_soon_threadsafe(self._send_gcs, {
-            "t": "task_cancel", "tid": tid.binary(), "force": force})
+        self.send_gcs_threadsafe(
+            {"t": "task_cancel", "tid": tid.binary(), "force": force})
 
     # --------------------------------------------------------------- actors
 
@@ -540,25 +577,6 @@ class Worker:
         if not reply.get("ok"):
             raise ValueError(reply.get("err", "actor creation failed"))
         return aid
-
-    async def _get_actor_conn(self, actor_id: ActorID) -> _ActorConn:
-        ac = self._actor_conns.get(actor_id)
-        if ac is not None and not ac.conn.closed:
-            return ac
-        if actor_id in self._dead_actors:
-            raise ActorDiedError(self._dead_actors[actor_id])
-        reply = await self.gcs.request(
-            {"t": "actor_get", "aid": actor_id.binary()})
-        if not reply.get("ok"):
-            self._dead_actors[actor_id] = reply.get("err", "actor died")
-            raise ActorDiedError(self._dead_actors[actor_id])
-        addr = reply["addr"]
-        reader, writer = await protocol.connect(addr)
-        conn = protocol.Connection(reader, writer)
-        conn.start()
-        ac = _ActorConn(addr, conn)
-        self._actor_conns[actor_id] = ac
-        return ac
 
     def submit_actor_task_msg(self, actor_id: ActorID, method: str,
                               msg_args: dict, num_returns: int,
@@ -572,49 +590,167 @@ class Worker:
             self._object_futures[oid] = fut
             oids.append(oid)
             refs.append(ObjectRef(oid, self))
-        asyncio.run_coroutine_threadsafe(
-            self._actor_call(actor_id, tid, method, msg_args,
-                             num_returns, opts, oids,
-                             opts.get("retries", 0)),
-            self.loop)
+        call = {"t": "actor_call", "aid": actor_id.binary(),
+                "tid": tid.binary(), "m": method,
+                "nret": num_returns, "opts": opts, **msg_args}
+        item = (actor_id, call, oids, opts.get("retries", 0))
+        with self._out_lock:
+            self._out_q.append(item)
+            wake = len(self._out_q) == 1
+        if wake:
+            self.loop.call_soon_threadsafe(self._drain_out)
         return refs
 
-    async def _actor_call(self, actor_id: ActorID, tid: TaskID, method: str,
-                          msg_args: dict, num_returns: int, opts: dict,
-                          oids: List[ObjectID], retries: int):
-        try:
-            # Per-actor lock: conn resolution + the synchronous send happen
-            # in submission order (FIFO per caller); reply waits overlap.
-            lock = self._actor_locks.setdefault(actor_id, asyncio.Lock())
-            async with lock:
-                ac = await self._get_actor_conn(actor_id)
-                reply_fut = ac.conn.request_nowait({
-                    "t": "actor_call", "aid": actor_id.binary(),
-                    "tid": tid.binary(), "m": method,
-                    "nret": num_returns, "opts": opts, **msg_args})
-            reply = await reply_fut
-            results = reply["results"]
-            # Register large (shm) actor-call results with the GCS: we are
-            # the owner; this makes the ref resolvable by borrowers.
-            for r in results:
-                if r.get("shm"):
-                    self._send_gcs({"t": "obj_put", "oid": r["oid"],
-                                    "nbytes": r["nbytes"], "shm": True})
-            self.push_result(tid.binary(), results)
-        except (ConnectionError, ActorDiedError) as e:
-            if retries != 0:
-                # Re-resolve (the actor may be restarting) and try again.
-                await asyncio.sleep(0.05)
-                self._actor_conns.pop(actor_id, None)
-                await self._actor_call(actor_id, tid, method, msg_args,
-                                       num_returns, opts, oids,
-                                       retries - 1 if retries > 0 else retries)
+    def _drain_out(self):  # runs on the IO loop
+        with self._out_lock:
+            if not self._out_q:
                 return
-            cause = self._dead_actors.get(actor_id, str(e) or "actor died")
-            err = serialize(ActorDiedError(cause)).to_bytes()
-            self.push_result(tid.binary(), [
-                {"oid": oid.binary(), "nbytes": len(err), "data": err}
-                for oid in oids])
+            msgs = list(self._out_q)
+            self._out_q.clear()
+        for m in msgs:
+            if isinstance(m, dict):
+                self._send_gcs(m)
+            else:
+                self._dispatch_actor_call(*m)
+
+    def _dispatch_actor_call(self, actor_id: ActorID, call: dict,
+                             oids: List[ObjectID], retries: int):
+        """Send an actor call, preserving per-actor FIFO submission order.
+
+        Fast path (established connection, empty backlog): synchronous
+        ``request_nowait`` — no coroutine, no lock; the reply resolves via a
+        future callback. Calls made before the connection exists queue on
+        the channel and are flushed in order by the connect task."""
+        ch = self._actor_chans.get(actor_id)
+        if ch is None:
+            ch = self._actor_chans[actor_id] = _ActorChannel()
+        if ch.conn is not None and not ch.conn.closed and not ch.sendq:
+            try:
+                fut = ch.conn.request_nowait(call)
+            except ConnectionError:
+                self._actor_call_failed(actor_id, call, oids, retries,
+                                        ConnectionError("connection closed"))
+                return
+            fut.add_done_callback(
+                lambda f: self._on_actor_reply(f, actor_id, call, oids,
+                                               retries))
+            return
+        ch.sendq.append((call, oids, retries))
+        if not ch.connecting:
+            ch.connecting = True
+            self.loop.create_task(self._connect_and_flush(actor_id, ch))
+
+    async def _connect_and_flush(self, actor_id: ActorID, ch: _ActorChannel):
+        try:
+            if ch.conn is None or ch.conn.closed:
+                if actor_id in self._dead_actors:
+                    raise ActorDiedError(self._dead_actors[actor_id])
+                reply = await self.gcs.request(
+                    {"t": "actor_get", "aid": actor_id.binary()})
+                if not reply.get("ok"):
+                    self._dead_actors[actor_id] = reply.get("err",
+                                                            "actor died")
+                    raise ActorDiedError(self._dead_actors[actor_id])
+                reader, writer = await protocol.connect(reply["addr"])
+                conn = protocol.Connection(reader, writer)
+                conn.start()
+                ch.addr = reply["addr"]
+                ch.conn = conn
+        except (ConnectionError, OSError, ActorDiedError) as e:
+            ch.connecting = False
+            backlog, ch.sendq = list(ch.sendq), deque()
+            exc = (e if isinstance(e, ActorDiedError)
+                   else ConnectionError(str(e)))
+            for call, oids, retries in backlog:
+                self._actor_call_failed(actor_id, call, oids, retries, exc)
+            return
+        ch.connecting = False
+        self._flush_channel(actor_id, ch)
+
+    def _flush_channel(self, actor_id: ActorID, ch: _ActorChannel):
+        """Send the channel's backlog synchronously — order preserved, one
+        coalesced write for the whole burst."""
+        while ch.sendq:
+            call, oids, retries = ch.sendq.popleft()
+            try:
+                fut = ch.conn.request_nowait(call)
+            except ConnectionError as e:
+                self._actor_call_failed(actor_id, call, oids, retries, e)
+                continue
+            fut.add_done_callback(
+                lambda f, c=call, o=oids, r=retries:
+                    self._on_actor_reply(f, actor_id, c, o, r))
+
+    async def _get_actor_conn(self, actor_id: ActorID) -> _ActorChannel:
+        """Resolve and return the actor's live channel (addr + conn).
+
+        Cold-path helper for callers that need the raw connection (the
+        compiled-DAG compiler); actor calls use ``_dispatch_actor_call``.
+        """
+        ch = self._actor_chans.get(actor_id)
+        if ch is None:
+            ch = self._actor_chans[actor_id] = _ActorChannel()
+        while ch.connecting:
+            await asyncio.sleep(0.005)
+        if ch.conn is not None and not ch.conn.closed:
+            return ch
+        if actor_id in self._dead_actors:
+            raise ActorDiedError(self._dead_actors[actor_id])
+        ch.connecting = True
+        try:
+            reply = await self.gcs.request(
+                {"t": "actor_get", "aid": actor_id.binary()})
+            if not reply.get("ok"):
+                self._dead_actors[actor_id] = reply.get("err", "actor died")
+                raise ActorDiedError(self._dead_actors[actor_id])
+            reader, writer = await protocol.connect(reply["addr"])
+            ch.addr = reply["addr"]
+            ch.conn = protocol.Connection(reader, writer)
+            ch.conn.start()
+        finally:
+            ch.connecting = False
+        # Calls queued by _dispatch_actor_call while we were connecting
+        # would otherwise strand (their flush task was suppressed by the
+        # connecting flag).
+        self._flush_channel(actor_id, ch)
+        return ch
+
+    def _on_actor_reply(self, fut: asyncio.Future, actor_id: ActorID,
+                        call: dict, oids: List[ObjectID], retries: int):
+        if fut.cancelled():
+            exc: Optional[BaseException] = ConnectionError("call cancelled")
+        else:
+            exc = fut.exception()
+        if exc is not None:
+            self._actor_call_failed(actor_id, call, oids, retries, exc)
+            return
+        reply = fut.result()
+        results = reply["results"]
+        # Register large (shm) actor-call results with the GCS: we are
+        # the owner; this makes the ref resolvable by borrowers.
+        for r in results:
+            if r.get("shm"):
+                self._send_gcs({"t": "obj_put", "oid": r["oid"],
+                                "nbytes": r["nbytes"], "shm": True})
+        self.push_result(call["tid"], results)
+
+    def _actor_call_failed(self, actor_id: ActorID, call: dict,
+                           oids: List[ObjectID], retries: int,
+                           exc: BaseException):
+        if retries != 0 and isinstance(exc, (ConnectionError, ActorDiedError)):
+            # Re-resolve (the actor may be restarting) and try again.
+            ch = self._actor_chans.get(actor_id)
+            if ch is not None and (ch.conn is None or ch.conn.closed):
+                self._actor_chans.pop(actor_id, None)
+            self.loop.call_later(
+                0.05, self._dispatch_actor_call, actor_id, call, oids,
+                retries - 1 if retries > 0 else retries)
+            return
+        cause = self._dead_actors.get(actor_id, str(exc) or "actor died")
+        err = serialize(ActorDiedError(cause)).to_bytes()
+        self.push_result(call["tid"], [
+            {"oid": oid.binary(), "nbytes": len(err), "data": err}
+            for oid in oids])
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.loop.call_soon_threadsafe(self._send_gcs, {

@@ -502,6 +502,7 @@ async def amain(args):
 
 def main():
     from .jax_platform import install_hook
+    from .node import _run_with_optional_profile
 
     install_hook()
     parser = argparse.ArgumentParser()
@@ -509,7 +510,7 @@ def main():
     parser.add_argument("--node-id", required=True)
     parser.add_argument("--session-dir", required=True)
     args = parser.parse_args()
-    asyncio.run(amain(args))
+    _run_with_optional_profile(lambda: amain(args), "worker")
 
 
 if __name__ == "__main__":
