@@ -99,6 +99,11 @@ class WorkerInfo:
         self.current_task: Optional[TaskID] = None
         self.actor_id: Optional[ActorID] = None
         self.acquired: Dict[str, float] = {}
+        # Lease state (reference: worker leases granted by the raylet,
+        # node_manager.h:522): while leased, the owner driver pushes tasks
+        # directly to the worker and the GCS only tracks the grant.
+        self.leased_to: Optional["ClientConn"] = None
+        self.lease_ctx = None  # the LeaseDemand (for resource release)
 
 
 class TaskRecord:
@@ -145,8 +150,7 @@ class TaskRecord:
 
 class ObjectEntry:
     __slots__ = ("object_id", "nbytes", "ready", "inline", "on_shm", "refcount",
-                 "waiters", "producing_task", "spilled", "holders", "owner",
-                 "sightings")
+                 "waiters", "producing_task", "spilled", "holders", "owner")
 
     def __init__(self, object_id: ObjectID):
         self.object_id = object_id
@@ -165,13 +169,6 @@ class ObjectEntry:
         # ray:// client drivers).
         self.holders: Set[bytes] = set()
         self.owner: Optional["ClientConn"] = None
-        # Client serials that may hold zero-copy views of this object (were
-        # handed a "shm" reply). The arena-backed native store must never
-        # free a block such a client could still map — plasma's client-pin
-        # rule (plasma never evicts objects with active client references);
-        # per-object-segment stores don't need it (unlink keeps live
-        # mappings valid).
-        self.sightings: Set[int] = set()
 
 
 class ActorRecord:
@@ -196,6 +193,29 @@ class ActorRecord:
         self.death_cause: Optional[str] = None
 
 
+class ObsTaskRecord:
+    """Observability-only task record built from owner task notes (the
+    direct lease path never routes task state through the scheduler)."""
+
+    __slots__ = ("task_id", "state", "name", "error", "node_id", "worker_id",
+                 "resources", "ts_created", "ts_running", "ts_done",
+                 "cancelled", "pg")
+
+    def __init__(self, task_id: TaskID):
+        self.task_id = task_id
+        self.state = "pending"
+        self.name = ""
+        self.error = False
+        self.node_id: Optional[NodeID] = None
+        self.worker_id: Optional[WorkerID] = None
+        self.resources: Dict[str, float] = {}
+        self.ts_created = 0.0
+        self.ts_running = 0.0
+        self.ts_done = 0.0
+        self.cancelled = False
+        self.pg = None
+
+
 class PGRecord:
     def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
                  strategy: str, name: str, owner: "ClientConn"):
@@ -211,11 +231,41 @@ class PGRecord:
         self.ready_waiters: List[Tuple[protocol.Connection, dict]] = []
 
 
+class LeaseDemand:
+    """A driver's request for N leased workers of one scheduling class.
+
+    Reference: ``RequestWorkerLease`` (node_manager.proto:387) — the grant
+    hands the worker to the driver, which then pushes tasks to it directly
+    (``NormalTaskSubmitter`` lease reuse, normal_task_submitter.h:108).
+    Scheduled through the same pending queues as GCS-dispatched tasks so
+    placement strategies and fairness apply uniformly.
+    """
+
+    __slots__ = ("client", "key", "count", "resources", "pg", "bundle",
+                 "strategy", "sig", "cancelled")
+
+    def __init__(self, client: "ClientConn", msg: dict):
+        self.client = client
+        self.key = msg["key"]  # opaque class token, echoed in grants
+        self.count = max(1, int(msg.get("n", 1)))
+        self.resources = msg.get("res") or {"CPU": 1.0}
+        self.pg = msg.get("pg")
+        self.bundle = msg.get("bix")
+        self.strategy = msg.get("sched") or "DEFAULT"
+        self.cancelled = False
+        strategy = self.strategy
+        if isinstance(strategy, dict):
+            strategy = tuple(sorted(strategy.items()))
+        self.sig = (tuple(sorted(self.resources.items())), self.pg,
+                    self.bundle, strategy, id(client))
+
+
 class PendingQueues:
-    """Pending tasks bucketed by scheduling class (``TaskRecord.sig``).
+    """Pending work bucketed by scheduling class (``record.sig``): task
+    records (GCS-dispatched path) and lease demands (direct path).
 
     One deque per class keeps FIFO order within a class; a blocked class is
-    skipped in O(1) instead of re-examining each of its tasks every pass.
+    skipped in O(1) instead of re-examining each of its entries every pass.
     """
 
     __slots__ = ("qs", "count")
@@ -224,12 +274,25 @@ class PendingQueues:
         self.qs: Dict[tuple, deque] = {}
         self.count = 0
 
-    def append(self, record: "TaskRecord"):
+    def append(self, record):
         q = self.qs.get(record.sig)
         if q is None:
             q = self.qs[record.sig] = deque()
-        q.append(record.task_id)
+        q.append(record)
         self.count += 1
+
+    def remove(self, record) -> bool:
+        q = self.qs.get(record.sig)
+        if q is None:
+            return False
+        try:
+            q.remove(record)
+        except ValueError:
+            return False
+        self.count -= 1
+        if not q:
+            del self.qs[record.sig]
+        return True
 
     def __len__(self) -> int:
         return self.count
@@ -260,11 +323,10 @@ class GcsServer:
         self.session_dir = session_dir
         self.store_capacity = store_capacity
         self.store = make_store(session_name, store_capacity)
-        # Arena-backed stores reuse freed blocks, so deletion while a live
-        # client maps the block corrupts its view; per-object segments are
-        # safe (see ObjectEntry.sightings).
-        self._arena_store = type(self.store).__name__ == "NativeStore"
-        self._live_client_serials: Set[int] = set()
+        # Reader safety on delete is enforced natively via per-object pins
+        # in the arena itself (native/shm_store.cc rtpu_store_acquire/
+        # release) — plasma's client-pin rule without GCS-side bookkeeping.
+        # Page population happens per-process in NativeStore.
         self._pull_tasks: Set[asyncio.Task] = set()
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.workers: Dict[WorkerID, WorkerInfo] = {}
@@ -284,6 +346,7 @@ class GcsServer:
         self._shutdown_event = asyncio.Event()
         self._sched_wakeup = asyncio.Event()
         self._owned_objects: Dict[int, Set[ObjectID]] = {}  # id(client) -> oids
+        self._client_by_wid: Dict[bytes, ClientConn] = {}
         # Observability stores (reference: GcsTaskManager task-event store
         # gcs_task_manager.h:86; metrics agent metrics_agent.py). Both bounded.
         self._done_tasks: deque = deque()  # TaskID, GC'd beyond max
@@ -318,7 +381,6 @@ class GcsServer:
         )
         client.conn = conn
         self.clients.append(client)
-        self._live_client_serials.add(client.serial)
         conn.start()
 
     async def _dispatch(self, client: ClientConn, msg: dict):
@@ -364,6 +426,8 @@ class GcsServer:
             worker_id = WorkerID(msg["worker_id"])
             client.worker_id = worker_id
             self.drivers.append(client)
+        if client.worker_id is not None:
+            self._client_by_wid[client.worker_id.binary()] = client
         client.conn.reply(msg, {
             "ok": True,
             "session": self.session_name,
@@ -384,12 +448,22 @@ class GcsServer:
     def _on_disconnect(self, client: ClientConn):
         if client in self.clients:
             self.clients.remove(client)
-        self._live_client_serials.discard(client.serial)
+        if (client.worker_id is not None
+                and self._client_by_wid.get(client.worker_id.binary())
+                is client):
+            del self._client_by_wid[client.worker_id.binary()]
         sender = (client.worker_id.hex() if client.worker_id
                   else str(id(client)))
         for key in [k for k in self.metrics if k[0] == sender]:
             del self.metrics[key]
         if client.role == "worker" and client.worker_id is not None:
+            # Objects owned by this worker (from its nested submissions).
+            for oid in self._owned_objects.pop(id(client), set()):
+                entry = self.objects.get(oid)
+                if entry is not None:
+                    entry.refcount -= 1
+                    if entry.refcount <= 0 and entry.ready:
+                        self._lru_touch(entry)
             asyncio.get_running_loop().create_task(
                 self._on_worker_death(client.worker_id))
         elif client.role == "driver":
@@ -462,11 +536,18 @@ class GcsServer:
             if msg.get("i") is not None:
                 client.conn.reply(msg, {"ok": True})
             return
+        # ``owner_wid``: a leased worker registering a task result on
+        # behalf of the task's owner (the submitting driver/worker) —
+        # ownership and the initial reference belong to that owner.
+        owner = client
+        owner_wid = msg.get("owner_wid")
+        if owner_wid is not None:
+            owner = self._client_by_wid.get(bytes(owner_wid), client)
         entry.refcount += 1  # the owner's initial reference
-        entry.owner = client
+        entry.owner = owner
         if client.node_id is not None and msg.get("shm"):
             entry.holders.add(client.node_id.binary())
-        self._owned_objects.setdefault(id(client), set()).add(oid)
+        self._owned_objects.setdefault(id(owner), set()).add(oid)
         self._mark_ready(entry, msg["nbytes"], msg.get("data"),
                          msg.get("shm", False))
         if msg.get("i") is not None:
@@ -485,7 +566,6 @@ class GcsServer:
                 return
             except OSError:
                 pass
-        entry.sightings.add(client.serial)
         if entry.ready:
             client.conn.reply(msg, self._obj_reply(entry))
         else:
@@ -591,25 +671,16 @@ class GcsServer:
             return
         self._free_to(self.store_capacity)
 
-    def _pinned(self, entry: ObjectEntry) -> bool:
-        """True if an arena-store block may still be mapped by a live
-        client (then it must not be freed; see ObjectEntry.sightings)."""
-        if not self._arena_store:
-            return False
-        entry.sightings &= self._live_client_serials
-        return bool(entry.sightings)
-
     def _free_to(self, target_bytes: int):
-        skipped = []
         while self.shm_bytes > target_bytes and self.zero_ref_lru:
             oid, nbytes = self.zero_ref_lru.popitem(last=False)
             entry = self.objects.get(oid)
             if entry is None or not entry.ready:
                 continue
-            if self._pinned(entry):
-                skipped.append((oid, nbytes))
-                continue
             if entry.on_shm:
+                # Arena delete defers the actual free while readers hold
+                # pins (rtpu_store_delete -> doomed state), so this is
+                # always safe to issue.
                 self.store.delete(oid)
                 self.shm_bytes -= nbytes
             if entry.spilled is not None:
@@ -618,8 +689,6 @@ class GcsServer:
                 except OSError:
                     pass
             del self.objects[oid]
-        for oid, nbytes in skipped:
-            self.zero_ref_lru.setdefault(oid, nbytes)
         if self.shm_bytes > target_bytes:
             self._spill_until_under(target_bytes)
 
@@ -651,8 +720,6 @@ class GcsServer:
             if self.shm_bytes <= target_bytes:
                 break
             if not (entry.ready and entry.on_shm and entry.spilled is None):
-                continue
-            if self._pinned(entry):
                 continue
             view = self.store.get(entry.object_id, entry.nbytes)
             if view is None:
@@ -760,16 +827,66 @@ class GcsServer:
         elif record.state == "pending":
             # Reap immediately: a cancelled task queued behind a blocked
             # class head would otherwise never be re-examined.
-            q = self.pending.qs.get(record.sig)
-            if q is not None:
-                try:
-                    q.remove(tid)
-                    self.pending.count -= 1
-                    if not q:
-                        del self.pending.qs[record.sig]
-                except ValueError:
-                    pass
+            self.pending.remove(record)
             self._finish_cancelled(record)
+
+    # ---------------------------------------------------------------- leases
+
+    async def _h_lease_req(self, client, msg):
+        """A driver wants ``n`` leased workers for one scheduling class."""
+        self.pending.append(LeaseDemand(client, msg))
+        self._wake_scheduler()
+
+    async def _h_lease_ret(self, client, msg):
+        """A driver returns a leased worker; it becomes schedulable again."""
+        worker = self.workers.get(WorkerID(msg["wid"]))
+        if worker is None or worker.leased_to is not client:
+            return
+        self._release_lease(worker)
+        self._wake_scheduler()
+
+    def _release_lease(self, worker: WorkerInfo):
+        self._release(worker, worker.lease_ctx)
+        worker.leased_to = None
+        worker.lease_ctx = None
+        if worker.state == W_BUSY:
+            worker.state = W_IDLE
+            node = self.nodes.get(worker.node_id)
+            if node is not None and not worker.conn.closed:
+                node.idle_workers.append(worker.worker_id)
+
+    async def _h_task_notes(self, client, msg):
+        """Batched task-state reports from owners (direct-path tasks).
+
+        Keeps the observability table (state API / dashboard / summaries)
+        populated even though leased-path tasks never route through the
+        GCS scheduler. Reference: task events flowing to GcsTaskManager
+        (gcs_task_manager.h:86)."""
+        for n in msg["notes"]:
+            tid = TaskID(n["tid"])
+            rec = self.tasks.get(tid)
+            if rec is None:
+                rec = ObsTaskRecord(tid)
+                self.tasks[tid] = rec
+                self.counters["tasks_submitted"] += 1
+            rec.name = n.get("name", rec.name)
+            rec.state = n.get("state", rec.state)
+            rec.error = bool(n.get("error", rec.error))
+            rec.ts_created = n.get("created", rec.ts_created)
+            rec.ts_running = n.get("start", rec.ts_running)
+            rec.ts_done = n.get("end", rec.ts_done)
+            if n.get("res"):
+                rec.resources = n["res"]
+            if n.get("wid"):
+                rec.worker_id = WorkerID(n["wid"])
+                w = self.workers.get(rec.worker_id)
+                if w is not None:
+                    rec.node_id = w.node_id
+            if rec.state == "done":
+                self.counters["tasks_finished"] += 1
+                if rec.error:
+                    self.counters["tasks_failed"] += 1
+                self._gc_done_task(rec)
 
     def _wake_scheduler(self):
         self._sched_wakeup.set()
@@ -862,12 +979,13 @@ class GcsServer:
             for sig in active:
                 q = qs.get(sig)
                 while q:
-                    tid = q[0]
-                    record = self.tasks.get(tid)
-                    if record is None or record.cancelled:
+                    record = q[0]
+                    if record.cancelled or (
+                            isinstance(record, LeaseDemand)
+                            and record.client.conn.closed):
                         q.popleft()
                         self.pending.count -= 1
-                        if record is not None:
+                        if not isinstance(record, LeaseDemand):
                             self._finish_cancelled(record)
                         continue
                     break
@@ -879,22 +997,37 @@ class GcsServer:
                     continue  # class infeasible this pass
                 worker = self._grab_idle_worker(node)
                 if worker is None:
+                    pend = (record.count if isinstance(record, LeaseDemand)
+                            else len(q))
                     deficit[node.node_id] = (
-                        deficit.get(node.node_id, 0) + len(q))
+                        deficit.get(node.node_id, 0) + pend)
                     continue
-                q.popleft()
-                self.pending.count -= 1
                 worker.state = W_BUSY
-                worker.current_task = tid
                 worker.acquired = self._acquire(node, record)
-                record.state = "running"
-                record.worker_id = worker.worker_id
-                record.node_id = node.node_id
-                record.ts_running = time.time()
-                fwd = dict(record.msg)
-                fwd["t"] = "exec"
-                fwd.pop("i", None)
-                worker.conn.send(fwd)
+                if isinstance(record, LeaseDemand):
+                    worker.leased_to = record.client
+                    worker.lease_ctx = record
+                    record.client.conn.send({
+                        "t": "lease_grant", "key": record.key,
+                        "wid": worker.worker_id.binary(),
+                        "addr": worker.addr,
+                        "nid": node.node_id.binary()})
+                    record.count -= 1
+                    if record.count <= 0:
+                        q.popleft()
+                        self.pending.count -= 1
+                else:
+                    q.popleft()
+                    self.pending.count -= 1
+                    worker.current_task = record.task_id
+                    record.state = "running"
+                    record.worker_id = worker.worker_id
+                    record.node_id = node.node_id
+                    record.ts_running = time.time()
+                    fwd = dict(record.msg)
+                    fwd["t"] = "exec"
+                    fwd.pop("i", None)
+                    worker.conn.send(fwd)
                 if q:
                     still_active.append(sig)
                 else:
@@ -960,10 +1093,6 @@ class GcsServer:
             entry = self._obj(ObjectID(r["oid"]))
             if client.node_id is not None and r.get("shm"):
                 entry.holders.add(client.node_id.binary())
-            if r.get("shm"):
-                # The owner gets this result pushed directly (no obj_wait),
-                # and may map it zero-copy — pin for the arena store.
-                entry.sightings.add(record.owner.serial)
             self._mark_ready(entry, r["nbytes"], r.get("data"),
                              r.get("shm", False))
         if record.owner.conn is not None and not record.owner.conn.closed:
@@ -1004,6 +1133,16 @@ class GcsServer:
         # Actor death
         if worker.actor_id is not None:
             await self._on_actor_worker_death(worker.actor_id, worker)
+            return
+        # Leased worker death: release the grant and tell the owner — the
+        # owner-side TaskManager handles retries of its in-flight tasks.
+        if worker.leased_to is not None:
+            owner = worker.leased_to
+            self._release_lease(worker)
+            if not owner.conn.closed:
+                owner.conn.send({"t": "lease_dead",
+                                 "wid": worker_id.binary()})
+            self._wake_scheduler()
             return
         # Task retry (reference: TaskManager retries, task_manager.h:210)
         tid = worker.current_task
@@ -1054,7 +1193,11 @@ class GcsServer:
 
     def _on_driver_exit(self, client: ClientConn):
         """Non-detached actors owned by an exiting driver are killed; its
-        objects are dereferenced."""
+        objects are dereferenced; its worker leases are reclaimed."""
+        for worker in self.workers.values():
+            if worker.leased_to is client:
+                self._release_lease(worker)
+        self._wake_scheduler()
         for actor in list(self.actors.values()):
             if actor.owner is client and not actor.detached:
                 asyncio.get_running_loop().create_task(
@@ -1418,10 +1561,10 @@ class GcsServer:
         gcs_autoscaler_state_manager.cc)."""
         now = time.time()
         demands: List[Dict[str, float]] = []
-        for tid in self.pending:
-            record = self.tasks.get(tid)
-            if record is not None and record.pg is None:
-                demands.append(record.resources)
+        for record in self.pending:
+            if record.pg is None:
+                n = record.count if isinstance(record, LeaseDemand) else 1
+                demands.extend([record.resources] * n)
         for a in self.actors.values():
             if a.state in (A_PENDING, A_RESTARTING) and a.pg is None:
                 demands.append(a.resources)

@@ -66,13 +66,26 @@ def _untrack(shm: shared_memory.SharedMemory):
 
 
 class PlasmaObjectView:
-    """A sealed object: zero-copy view plus the backing handle."""
+    """A sealed object: zero-copy view plus the backing handle.
 
-    __slots__ = ("data", "_shm")
+    ``release_cb`` (arena-backed stores) drops the block's reader pin;
+    call ``close()`` exactly once, or hand the pin to the deserialized
+    value's buffers via ``serialization.deserialize(..., pin=...)`` and
+    call ``transfer()`` instead.
+    """
 
-    def __init__(self, data: memoryview, shm=None):
+    __slots__ = ("data", "_shm", "_release_cb")
+
+    def __init__(self, data: memoryview, shm=None, release_cb=None):
         self.data = data
         self._shm = shm
+        self._release_cb = release_cb
+
+    def transfer(self):
+        """Detach the release callback (ownership moved to a _Pin)."""
+        cb = self._release_cb
+        self._release_cb = None
+        return cb
 
     def close(self):
         try:
@@ -81,6 +94,10 @@ class PlasmaObjectView:
             pass
         if self._shm is not None:
             self._shm.close()
+        cb = self._release_cb
+        self._release_cb = None
+        if cb is not None:
+            cb()
 
 
 class PyShmStore:

@@ -79,13 +79,57 @@ def serialize(value: Any) -> SerializedObject:
     return SerializedObject(pickled, buffers)
 
 
-def deserialize(data: memoryview) -> Any:
+class _Pin:
+    """Releases a shared-store reader pin when the last buffer dies."""
+
+    __slots__ = ("release",)
+
+    def __init__(self, release):
+        self.release = release
+
+    def __del__(self):
+        cb = self.release
+        self.release = None
+        if cb is not None:
+            cb()
+
+
+class _PinnedBuffer:
+    """Out-of-band buffer wrapper keeping its arena pin alive (PEP 688).
+
+    Values unpickled zero-copy (numpy/jax arrays over shared memory) hold
+    these via their buffer base chain; when the last one is collected the
+    pin drops and the arena block becomes recyclable — plasma's
+    client-side buffer release (``plasma/client.cc`` Release) without a
+    store round-trip.
+    """
+
+    __slots__ = ("mv", "pin")
+
+    def __init__(self, mv: memoryview, pin: "_Pin"):
+        self.mv = mv
+        self.pin = pin
+
+    def __buffer__(self, flags):
+        return memoryview(self.mv)
+
+
+def deserialize(data: memoryview, pin=None) -> Any:
     data = memoryview(data)
     (header_len,) = _U32.unpack(data[:4])
     header = msgpack.unpackb(data[4 : 4 + header_len], raw=False)
-    buffers = [
-        data[off : off + ln] for off, ln in zip(header["o"], header["l"])
-    ]
+    if pin is not None and header["o"]:
+        holder = _Pin(pin)
+        buffers = [
+            _PinnedBuffer(data[off : off + ln], holder)
+            for off, ln in zip(header["o"], header["l"])
+        ]
+    else:
+        if pin is not None:
+            pin()  # no out-of-band buffers -> nothing zero-copy to pin
+        buffers = [
+            data[off : off + ln] for off, ln in zip(header["o"], header["l"])
+        ]
     return pickle.loads(header["p"], buffers=buffers)
 
 

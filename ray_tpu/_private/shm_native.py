@@ -14,6 +14,7 @@ import mmap
 import os
 import subprocess
 import threading
+import time
 from typing import Dict, Optional
 
 from .ids import ObjectID
@@ -64,6 +65,17 @@ def get_lib():
                 ctypes.c_void_p, ctypes.c_char_p,
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64)]
+            lib.rtpu_store_acquire.restype = ctypes.c_int
+            lib.rtpu_store_acquire.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.rtpu_store_release.restype = ctypes.c_int
+            lib.rtpu_store_release.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
+            lib.rtpu_store_prefault_step.restype = ctypes.c_int
+            lib.rtpu_store_prefault_step.argtypes = [ctypes.c_void_p,
+                                                     ctypes.c_uint64]
             lib.rtpu_store_delete.restype = ctypes.c_int
             lib.rtpu_store_delete.argtypes = [ctypes.c_void_p,
                                               ctypes.c_char_p]
@@ -100,6 +112,30 @@ class NativeStore:
         finally:
             os.close(fd)
         self._view = memoryview(self._mmap)
+        self._total = total
+        # Populate this process's page tables in the background: without
+        # it every first write/read of a page in THIS process takes a
+        # minor fault (~2 GB/s ceiling); populated, copies run at memory
+        # speed (~10 GB/s). MADV_POPULATE_WRITE also zero-allocates pages
+        # on first touch arena-wide, so whichever process runs first does
+        # the tmpfs allocation once for everyone.
+        threading.Thread(target=self._populate_pages, daemon=True,
+                         name="arena-populate").start()
+
+    def _populate_pages(self, window: int = 64 << 20):
+        MADV_POPULATE_WRITE = 23  # Linux 5.14+
+        try:
+            os.nice(19)  # per-thread on Linux
+        except OSError:
+            pass
+        time.sleep(0.5)  # let process startup win the CPU first
+        for off in range(0, self._total, window):
+            try:
+                self._mmap.madvise(MADV_POPULATE_WRITE, off,
+                                   min(window, self._total - off))
+            except (OSError, ValueError):
+                return
+            time.sleep(0.003)
 
     @staticmethod
     def _key(object_id: ObjectID) -> bytes:
@@ -112,6 +148,18 @@ class NativeStore:
         if off == 0:
             raise MemoryError(
                 f"native store out of memory allocating {nbytes} bytes")
+        if nbytes >= (1 << 20):
+            # Populate the destination range up front: ~2x faster than
+            # per-page zero-fill faults when cold, ~free when the
+            # background populate already covered it.
+            start = off & ~0xFFF
+            try:
+                self._mmap.madvise(23,  # MADV_POPULATE_WRITE
+                                   start,
+                                   min(off - start + nbytes,
+                                       self._total - start))
+            except (OSError, ValueError):
+                pass
         return self._view[off:off + nbytes]
 
     def seal(self, object_id: ObjectID):
@@ -121,14 +169,47 @@ class NativeStore:
         self.lib.rtpu_store_delete(self.handle, self._key(object_id))
 
     def get(self, object_id: ObjectID, nbytes: int) -> Optional[PlasmaObjectView]:
+        """Pin + map a sealed object. The returned view holds a pin on the
+        arena block (plasma's client-pin rule): the block cannot be
+        recycled until ``view.close()`` — or, for zero-copy reads, until
+        the deserialized value's buffers are garbage-collected (the pin is
+        handed to them via ``serialization.deserialize(..., pin=...)``)."""
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        rc = self.lib.rtpu_store_lookup(self.handle, self._key(object_id),
-                                        ctypes.byref(off), ctypes.byref(size))
+        rc = self.lib.rtpu_store_acquire(self.handle, self._key(object_id),
+                                         ctypes.byref(off), ctypes.byref(size))
         if rc != 0:
             return None
         n = int(size.value)
-        return PlasmaObjectView(self._view[off.value:off.value + n], None)
+        return PlasmaObjectView(
+            self._view[off.value:off.value + n], None,
+            release_cb=lambda oid=object_id: self.release(oid))
+
+    def release(self, object_id: ObjectID):
+        self.lib.rtpu_store_release(self.handle, self._key(object_id))
+
+    def prefault(self, window: int = 32 << 20):
+        """Touch every free page once so later first writes take minor
+        faults (~10 GB/s) instead of zero-fill major faults (~1.4 GB/s).
+        Incremental (arena lock held per window only); progress is shared
+        via a cursor in the arena header, so the sweep runs once per
+        session. Run from a background thread at head start; deprioritized
+        so short-lived sessions (tests) barely pay for it."""
+        import time as _time
+
+        try:
+            os.nice(19)
+        except OSError:
+            pass
+        _time.sleep(1.0)  # let session startup win the CPU first
+        while True:
+            try:
+                more = self.lib.rtpu_store_prefault_step(self.handle, window)
+            except Exception:
+                return
+            if not more:
+                return
+            _time.sleep(0.005)
 
     def contains(self, object_id: ObjectID) -> bool:
         off = ctypes.c_uint64()

@@ -110,6 +110,59 @@ def _deserialize_object_ref(id_bytes: bytes) -> ObjectRef:
     return ObjectRef(ObjectID(id_bytes), borrowed=True)
 
 
+class _Lease:
+    """A worker leased to this process for one scheduling class."""
+
+    __slots__ = ("wid", "addr", "conn", "busy", "dead", "idle_handle")
+
+    def __init__(self, wid: bytes, addr: str):
+        self.wid = wid
+        self.addr = addr
+        self.conn: Optional[protocol.Connection] = None
+        self.busy = 0
+        self.dead = False
+        self.idle_handle = None
+
+
+class _TaskClass:
+    """Driver-side state for one scheduling class: pending queue + leases.
+
+    The analog of the reference's per-scheduling-class lease pools in
+    ``NormalTaskSubmitter`` (``transport/normal_task_submitter.h:74,108``):
+    tasks of a class share leased workers; tasks are pushed directly to
+    the leased worker and the lease is reused until the queue drains.
+    """
+
+    __slots__ = ("key", "wire", "queue", "leases", "demand")
+
+    def __init__(self, key: str, wire: dict):
+        self.key = key
+        self.wire = wire  # res/sched/pg/bix for lease_req
+        self.queue: deque = deque()  # _TaskItem
+        self.leases: Dict[bytes, _Lease] = {}
+        self.demand = 0  # leases requested but not yet granted
+
+
+class _TaskItem:
+    __slots__ = ("msg", "oids", "retries", "cancelled", "name", "created")
+
+    def __init__(self, msg: dict, oids: List[ObjectID], retries: int,
+                 name: str):
+        self.msg = msg
+        self.oids = oids
+        self.retries = retries
+        self.cancelled = False
+        self.name = name
+        self.created = time.time()
+
+
+# In-flight pipeline depth per leased worker: >1 overlaps the push/reply
+# hop with execution; the worker executes serially regardless.
+_LEASE_WINDOW = 8
+_MAX_LEASES_PER_CLASS = 64
+_LEASE_IDLE_RETURN_S = 0.25
+
+
 class _ActorChannel:
     """Per-actor direct connection plus its FIFO submission queue.
 
@@ -157,6 +210,12 @@ class Worker:
         # then collapses the burst into one syscall).
         self._out_q: deque = deque()
         self._out_lock = threading.Lock()
+        # Direct task path (worker leases).
+        self._task_classes: Dict[str, _TaskClass] = {}
+        self._leases_by_wid: Dict[bytes, tuple] = {}  # wid -> (cls, lease)
+        self._inflight: Dict[bytes, tuple] = {}  # tid -> (cls, lease, item)
+        self._task_specs: Dict[bytes, tuple] = {}  # oid -> (key, wire, item)
+        self._task_notes: deque = deque()
         self._registered_inline: set = set()
         self._promote_pending: set = set()
         self._flusher_handle = None
@@ -260,6 +319,9 @@ class Worker:
         for ch in self._actor_chans.values():
             if ch.conn is not None:
                 await ch.conn.close()
+        for cls, lease in list(self._leases_by_wid.values()):
+            if lease.conn is not None:
+                await lease.conn.close()
 
     # ----------------------------------------------------------- ref counts
 
@@ -279,9 +341,29 @@ class Worker:
             deltas = [(oid.binary(), d) for oid, d in self._ref_deltas.items()
                       if d != 0]
             self._ref_deltas.clear()
-        if deltas and self.gcs is not None and not self.gcs.closed:
+        if deltas:
+            for oid_b, d in deltas:
+                if d < 0:
+                    # Released refs no longer need lineage specs.
+                    self._task_specs.pop(oid_b, None)
+            if self.gcs is not None and not self.gcs.closed:
+                try:
+                    self.gcs.send({"t": "ref", "d": deltas})
+                except ConnectionError:
+                    pass
+        self._flush_notes()
+
+    def _queue_task_note(self, note: dict):
+        self._task_notes.append(note)
+        if len(self._task_notes) == 1:
+            self.loop.call_soon(self._flush_notes)
+
+    def _flush_notes(self):
+        if self._task_notes and self.gcs is not None and not self.gcs.closed:
+            notes = list(self._task_notes)
+            self._task_notes.clear()
             try:
-                self.gcs.send({"t": "ref", "d": deltas})
+                self.gcs.send({"t": "task_notes", "notes": notes})
             except ConnectionError:
                 pass
 
@@ -325,24 +407,25 @@ class Worker:
             if view is None:
                 # Not in this host's store: pull through the GCS relay
                 # (other host / remote client / spilled).
-                value = deserialize(memoryview(
-                    self._pull_object(object_id)))
+                view = self._pull_object(object_id)
+            if isinstance(view, (bytes, bytearray, memoryview)):
+                value = deserialize(memoryview(view))
             else:
-                try:
-                    value = deserialize(view.data)
-                finally:
-                    pass  # view kept alive by value's buffers if zero-copy
+                # Zero-copy read: the arena pin transfers to the value's
+                # buffers and drops when they are garbage-collected.
+                value = deserialize(view.data, pin=view.transfer())
         if isinstance(value, TaskError):
             raise value.cause if isinstance(value.cause, Exception) else value
         if isinstance(value, Exception):
             raise value
         return value
 
-    def _pull_object(self, object_id: ObjectID) -> bytes:
+    def _pull_object(self, object_id: ObjectID):
         """Fetch object bytes via the GCS transfer relay; cache locally.
 
         Client-side half of the reference's object-manager Pull
-        (``object_manager/pull_manager.h:52``).
+        (``object_manager/pull_manager.h:52``). Returns a store view
+        (zero-copy, pinned) when caching succeeds, else raw bytes.
         """
         try:
             reply = self.request_gcs(
@@ -362,7 +445,7 @@ class Worker:
             self.store.seal(object_id)
             view = self.store.get(object_id, len(data))
             if view is not None:
-                return view.data
+                return view
         except Exception:
             pass
         return data
@@ -372,13 +455,22 @@ class Worker:
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         for r, fut in zip(refs, futs):
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            try:
-                where, payload = fut.result(remaining)
-            except TimeoutError:
-                raise GetTimeoutError(
-                    f"get timed out after {timeout}s waiting for {r}")
-            out.append(self._resolve_value(r.id, where, payload))
+            for attempt in range(4):
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                try:
+                    where, payload = fut.result(remaining)
+                    out.append(self._resolve_value(r.id, where, payload))
+                    break
+                except TimeoutError:
+                    raise GetTimeoutError(
+                        f"get timed out after {timeout}s waiting for {r}")
+                except serialization.ObjectLostError:
+                    # Owner-side lineage reconstruction: resubmit the
+                    # producing task and wait again.
+                    if attempt == 3 or not self.maybe_reconstruct(r.id):
+                        raise
+                    fut = self.object_future(r.id)
         return out
 
     def create_in_store(self, oid: ObjectID, nbytes: int):
@@ -504,6 +596,10 @@ class Worker:
         t = msg.get("t")
         if t == "task_done":
             self.push_result(msg["tid"], msg["results"])
+        elif t == "lease_grant":
+            self._on_lease_grant(msg)
+        elif t == "lease_dead":
+            self._on_lease_dead(msg)
         elif t == "obj_upload":
             # Serve our host store's bytes to the GCS object-transfer relay
             # (reference: object manager Push, object_manager.h:206).
@@ -533,15 +629,46 @@ class Worker:
     def submit_task(self, fid: str, msg_args: dict, num_returns: int,
                     opts: dict) -> List[ObjectRef]:
         tid = TaskID.from_random()
-        msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
-               "nret": num_returns, "opts": opts, **msg_args}
         refs = []
+        oids = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(tid, i + 1)
             fut = SyncFuture()
             self._object_futures[oid] = fut
+            oids.append(oid)
             refs.append(ObjectRef(oid, self))
-        self.send_gcs_threadsafe(msg)
+        if self.client_mode:
+            # Remote (ray://) drivers cannot reach worker sockets: route
+            # through the GCS scheduler (reference: Ray Client proxying).
+            msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
+                   "nret": num_returns, "opts": opts, **msg_args}
+            self.send_gcs_threadsafe(msg)
+            return refs
+        # Direct path: lease workers for this scheduling class and push
+        # the task straight to one (reference hot path, §3.2: lease reuse
+        # + PushTask, normal_task_submitter.h:108).
+        msg = {"t": "exec", "tid": tid.binary(), "fid": fid,
+               "nret": num_returns, "opts": opts,
+               "owner": self.worker_id.binary(), **msg_args}
+        # Scheduling class key + lease_req fields: invariant per opts dict
+        # (shared wire_opts cached on the RemoteFunction) — compute once.
+        cached = opts.get("_cls")
+        if cached is None:
+            wire = {"res": opts.get("res") or {"CPU": 1.0}}
+            for k in ("sched", "pg", "bix"):
+                if opts.get(k) is not None:
+                    wire[k] = opts[k]
+            key = repr((sorted(wire["res"].items()), wire.get("pg"),
+                        wire.get("bix"), wire.get("sched")))
+            cached = opts["_cls"] = (key, wire)
+        key, wire = cached
+        item = _TaskItem(msg, oids, opts.get("retries", 0),
+                         opts.get("name", ""))
+        with self._out_lock:
+            self._out_q.append(("task", key, wire, item))
+            wake = len(self._out_q) == 1
+        if wake:
+            self.loop.call_soon_threadsafe(self._drain_out)
         return refs
 
     def _send_gcs(self, msg: dict):
@@ -563,7 +690,204 @@ class Worker:
         if wake:
             self.loop.call_soon_threadsafe(self._drain_out)
 
+    # --------------------------------------------------- direct task leases
+
+    def _pump_class(self, cls: _TaskClass):
+        """Dispatch queued tasks onto leased workers; grow/shrink leases."""
+        for lease in list(cls.leases.values()):
+            if lease.dead:
+                cls.leases.pop(lease.wid, None)
+                continue
+            if lease.conn is None or lease.conn.closed:
+                continue
+            while cls.queue and lease.busy < _LEASE_WINDOW:
+                self._send_exec(cls, lease, cls.queue.popleft())
+            if not cls.queue and lease.busy == 0 and lease.idle_handle is None:
+                lease.idle_handle = self.loop.call_later(
+                    _LEASE_IDLE_RETURN_S, self._return_lease, cls, lease)
+        backlog = len(cls.queue)
+        if backlog:
+            capacity = sum(_LEASE_WINDOW - l.busy for l in cls.leases.values()
+                           if not l.dead and (l.conn is None
+                                              or not l.conn.closed))
+            want = min(backlog, _MAX_LEASES_PER_CLASS) - len(cls.leases) \
+                - cls.demand
+            if capacity == 0 and want > 0:
+                cls.demand += want
+                self._send_gcs({"t": "lease_req", "key": cls.key,
+                                "n": want, **cls.wire})
+
+    def _send_exec(self, cls: _TaskClass, lease: _Lease, item: _TaskItem):
+        if item.cancelled:
+            self._finish_item_error(
+                item, serialization.TaskCancelledError("cancelled"))
+            return
+        if lease.idle_handle is not None:
+            lease.idle_handle.cancel()
+            lease.idle_handle = None
+        try:
+            fut = lease.conn.request_nowait(item.msg)
+        except ConnectionError:
+            cls.queue.appendleft(item)
+            self._on_lease_broken(cls, lease)
+            return
+        lease.busy += 1
+        self._inflight[item.msg["tid"]] = ("inflight", cls, lease, item)
+        fut.add_done_callback(
+            lambda f, c=cls, l=lease, it=item: self._on_exec_reply(f, c, l,
+                                                                   it))
+
+    def _on_exec_reply(self, fut: asyncio.Future, cls: _TaskClass,
+                       lease: _Lease, item: _TaskItem):
+        lease.busy -= 1
+        tid = item.msg["tid"]
+        self._inflight.pop(tid, None)
+        if fut.cancelled() or fut.exception() is not None:
+            # Worker died mid-task (lease conn broke): retry elsewhere.
+            self._on_lease_broken(cls, lease)
+            if item.cancelled:
+                self._finish_item_error(
+                    item, serialization.TaskCancelledError("cancelled"))
+            elif item.retries != 0:
+                item.retries -= 1 if item.retries > 0 else 0
+                cls.queue.appendleft(item)
+                self._inflight[tid] = ("queued", cls, item)
+            else:
+                self._finish_item_error(item, serialization.WorkerCrashedError(
+                    "worker died while executing task"))
+            self._pump_class(cls)
+            return
+        reply = fut.result()
+        results = reply["results"]
+        self.push_result(tid, results)
+        self._queue_task_note({
+            "tid": tid, "name": item.name, "state": "done",
+            "error": bool(reply.get("err")), "created": item.created,
+            "start": reply.get("t0", 0.0), "end": reply.get("t1", 0.0),
+            "wid": lease.wid})
+        # Keep the spec for owner-side lineage reconstruction
+        # (reference: ObjectRecoveryManager, object_recovery_manager.h:41)
+        # while the object may still be lost; dropped on ref release.
+        if not reply.get("err") and item.retries != 0:
+            for r in results:
+                if r.get("shm"):
+                    self._task_specs[bytes(r["oid"])] = (cls.key, cls.wire,
+                                                         item)
+        self._pump_class(cls)
+
+    def _finish_item_error(self, item: _TaskItem, exc: Exception):
+        err = serialize(exc).to_bytes()
+        self.push_result(item.msg["tid"], [
+            {"oid": oid.binary(), "nbytes": len(err), "data": err,
+             "err": True}
+            for oid in item.oids])
+        self._queue_task_note({
+            "tid": item.msg["tid"], "name": item.name, "state": "done",
+            "error": True, "created": item.created})
+
+    def _on_lease_broken(self, cls: _TaskClass, lease: _Lease):
+        if lease.dead:
+            return
+        lease.dead = True
+        cls.leases.pop(lease.wid, None)
+        self._leases_by_wid.pop(lease.wid, None)
+        if lease.idle_handle is not None:
+            lease.idle_handle.cancel()
+            lease.idle_handle = None
+        if lease.conn is not None and not lease.conn.closed:
+            self.loop.create_task(lease.conn.close())
+
+    def _return_lease(self, cls: _TaskClass, lease: _Lease):
+        lease.idle_handle = None
+        if lease.dead or cls.queue or lease.busy > 0:
+            self._pump_class(cls)
+            return
+        lease.dead = True
+        cls.leases.pop(lease.wid, None)
+        self._leases_by_wid.pop(lease.wid, None)
+        self._send_gcs({"t": "lease_ret", "wid": lease.wid})
+        if lease.conn is not None and not lease.conn.closed:
+            self.loop.create_task(lease.conn.close())
+
+    def _on_lease_grant(self, msg: dict):
+        cls = self._task_classes.get(msg["key"])
+        if cls is not None:
+            cls.demand = max(0, cls.demand - 1)
+        if cls is None or (not cls.queue and not cls.leases):
+            # Demand evaporated — hand the worker straight back.
+            self._send_gcs({"t": "lease_ret", "wid": msg["wid"]})
+            return
+        lease = _Lease(bytes(msg["wid"]), msg["addr"])
+        cls.leases[lease.wid] = lease
+        self._leases_by_wid[lease.wid] = (cls, lease)
+        self.loop.create_task(self._connect_lease(cls, lease))
+
+    async def _connect_lease(self, cls: _TaskClass, lease: _Lease):
+        try:
+            reader, writer = await protocol.connect(lease.addr)
+        except OSError:
+            self._on_lease_broken(cls, lease)
+            self._send_gcs({"t": "lease_ret", "wid": lease.wid})
+            self._pump_class(cls)
+            return
+        lease.conn = protocol.Connection(reader, writer)
+        lease.conn.start()
+        self._pump_class(cls)
+
+    def _on_lease_dead(self, msg: dict):
+        entry = self._leases_by_wid.get(bytes(msg["wid"]))
+        if entry is None:
+            return
+        cls, lease = entry
+        self._on_lease_broken(cls, lease)
+        # In-flight replies fail via the closing conn; just refresh demand.
+        self._pump_class(cls)
+
+    def maybe_reconstruct(self, object_id: ObjectID) -> bool:
+        """Owner-side lineage reconstruction: resubmit the producing task
+        for a lost object (reference: object_recovery_manager.h:41)."""
+        spec = self._task_specs.pop(object_id.binary(), None)
+        if spec is None:
+            return False
+        key, wire, item = spec
+        for oid in item.oids:
+            self._object_futures.pop(oid, None)
+            fut = SyncFuture()
+            self._object_futures[oid] = fut
+        item.retries -= 1 if item.retries > 0 else 0
+        with self._out_lock:
+            self._out_q.append(("task", key, wire, item))
+            wake = len(self._out_q) == 1
+        if wake:
+            self.loop.call_soon_threadsafe(self._drain_out)
+        return True
+
     def cancel_task(self, tid: TaskID, force: bool):
+        entry = self._inflight.get(tid.binary())
+        if entry is not None:
+            def _do_cancel():
+                e = self._inflight.get(tid.binary())
+                if e is None:
+                    return
+                if e[0] == "queued":
+                    _, cls, item = e
+                    item.cancelled = True
+                    try:
+                        cls.queue.remove(item)
+                    except ValueError:
+                        pass
+                    self._inflight.pop(tid.binary(), None)
+                    self._finish_item_error(
+                        item, serialization.TaskCancelledError(tid.hex()))
+                else:
+                    _, cls, lease, item = e
+                    item.cancelled = True
+                    if lease.conn is not None and not lease.conn.closed:
+                        lease.conn.send({"t": "cancel",
+                                         "tid": tid.binary(),
+                                         "force": force})
+            self.loop.call_soon_threadsafe(_do_cancel)
+            return
         self.send_gcs_threadsafe(
             {"t": "task_cancel", "tid": tid.binary(), "force": force})
 
@@ -593,7 +917,7 @@ class Worker:
         call = {"t": "actor_call", "aid": actor_id.binary(),
                 "tid": tid.binary(), "m": method,
                 "nret": num_returns, "opts": opts, **msg_args}
-        item = (actor_id, call, oids, opts.get("retries", 0))
+        item = ("actor", actor_id, call, oids, opts.get("retries", 0))
         with self._out_lock:
             self._out_q.append(item)
             wake = len(self._out_q) == 1
@@ -607,11 +931,22 @@ class Worker:
                 return
             msgs = list(self._out_q)
             self._out_q.clear()
+        pumped = set()
         for m in msgs:
             if isinstance(m, dict):
                 self._send_gcs(m)
-            else:
-                self._dispatch_actor_call(*m)
+            elif m[0] == "actor":
+                self._dispatch_actor_call(*m[1:])
+            else:  # ("task", key, wire, item)
+                _, key, wire, item = m
+                cls = self._task_classes.get(key)
+                if cls is None:
+                    cls = self._task_classes[key] = _TaskClass(key, wire)
+                cls.queue.append(item)
+                self._inflight[item.msg["tid"]] = ("queued", cls, item)
+                pumped.add(key)
+        for key in pumped:
+            self._pump_class(self._task_classes[key])
 
     def _dispatch_actor_call(self, actor_id: ActorID, call: dict,
                              oids: List[ObjectID], retries: int):

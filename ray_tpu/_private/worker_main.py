@@ -24,6 +24,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -53,6 +54,7 @@ class Executor:
         self.cancelled: set = set()
         self.die_after_task = False
         self._server: Optional[asyncio.AbstractServer] = None
+        self._direct_q: deque = deque()  # (conn, msg) leased exec pushes
         self.dags: Dict[str, dict] = {}  # compiled-DAG stage plans
         # TaskEventBuffer (reference: task_event_buffer.h:220): bounded local
         # buffer of profile events, flushed to the GCS periodically.
@@ -93,6 +95,17 @@ class Executor:
             # and we enqueue before any await.
             asyncio.get_running_loop().create_task(
                 self._run_actor_call(conn, msg))
+        elif t == "exec":
+            # Leased direct task push (reference: PushTask straight to the
+            # leased worker, core_worker.proto:444) — the reply carries the
+            # results back to the owner without a GCS hop. Tasks queued in
+            # the same window run as one executor batch (one thread-hop
+            # pair per batch, not per task).
+            self._direct_q.append((conn, msg))
+            if len(self._direct_q) == 1:
+                asyncio.get_running_loop().create_task(self._drain_execs())
+        elif t == "cancel":
+            self.cancel(msg["tid"], msg.get("force", False))
         elif t == "dag_input":
             asyncio.get_running_loop().create_task(
                 self._run_dag_stage(conn, msg))
@@ -209,7 +222,7 @@ class Executor:
                 ref = ObjectRef(oid, self.worker, borrowed=True)
                 args, kwargs = self.worker.get([ref])[0]
                 return args, kwargs
-            args, kwargs = deserialize(view.data)
+            args, kwargs = deserialize(view.data, pin=view.transfer())
         else:
             args, kwargs = deserialize(memoryview(msg["args"]))
         # Resolve top-level ObjectRef arguments (reference semantics:
@@ -269,7 +282,57 @@ class Executor:
 
     # ---------------------------------------------------------- normal task
 
+    async def _drain_execs(self):
+        loop = asyncio.get_running_loop()
+        while self._direct_q:
+            batch = list(self._direct_q)
+            self._direct_q.clear()
+            replies = await loop.run_in_executor(
+                self.pool, self._exec_batch, [m for _, m in batch])
+            for (conn, msg), reply in zip(batch, replies):
+                if reply is None:  # skipped: worker is retiring
+                    continue
+                for r in reply["results"]:
+                    if r.get("shm"):
+                        self.worker.gcs.send({
+                            "t": "obj_put", "oid": r["oid"],
+                            "nbytes": r["nbytes"], "shm": True,
+                            "owner_wid": msg.get("owner")})
+                if not conn.closed:
+                    conn.reply(msg, reply)
+            if self.die_after_task:
+                self.flush_events()
+                await asyncio.sleep(0.01)
+                os._exit(0)
+
+    def _exec_batch(self, msgs: List[dict]) -> List[Optional[dict]]:
+        out: List[Optional[dict]] = []
+        for msg in msgs:
+            if self.die_after_task:
+                # Runtime-env-tainted worker retires: unprocessed pushes
+                # fail over to a fresh lease via the owner's retry path.
+                out.append(None)
+                continue
+            tid = msg["tid"]
+            nret = msg.get("nret", 1)
+            opts = msg.get("opts") or {}
+            fn_name = opts.get("name", "unknown")
+            t0 = time.time()
+            try:
+                results = self._execute_sync(msg, tid, nret, opts)
+                err = any([r.pop("_err", False) for r in results])
+            except Exception as e:  # noqa: BLE001
+                results = self._error_results(tid, nret, fn_name, e)
+                for r in results:
+                    r.pop("_err", None)
+                err = True
+            t1 = time.time()
+            self.record_event(tid, fn_name, "task", t0, t1, not err)
+            out.append({"results": results, "err": err, "t0": t0, "t1": t1})
+        return out
+
     async def run_task(self, msg: dict):
+        """GCS-dispatched execution (client-mode drivers and relays)."""
         loop = asyncio.get_running_loop()
         tid = msg["tid"]
         nret = msg.get("nret", 1)
