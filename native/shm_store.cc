@@ -373,6 +373,28 @@ int rtpu_store_prefault_step(void* handle, uint64_t window) {
   return end < h->capacity ? 1 : 0;
 }
 
+// Enumerate sealed objects: fills keys_out (kKeyLen bytes each) and
+// sizes_out up to max entries; returns the number written. Used by a
+// restarted GCS to rebuild its object directory from the surviving arena
+// (the reference instead replays object locations from raylet resync;
+// here the arena IS the per-host object state and outlives the GCS).
+uint64_t rtpu_store_list(void* handle, uint8_t* keys_out,
+                         uint64_t* sizes_out, uint64_t max) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (lock(h->hdr) != 0) return 0;
+  uint64_t n = 0;
+  for (uint32_t i = 0; i < kIndexSlots && n < max; ++i) {
+    Slot* s = &h->hdr->slots[i];
+    if (s->state == 2) {
+      memcpy(keys_out + n * kKeyLen, s->key, kKeyLen);
+      sizes_out[n] = s->size;
+      ++n;
+    }
+  }
+  pthread_mutex_unlock(&h->hdr->mutex);
+  return n;
+}
+
 void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
                       uint64_t* num_objects) {
   Handle* h = static_cast<Handle*>(handle);

@@ -59,6 +59,7 @@ def init(address: Optional[str] = None, *,
          ignore_reinit_error: bool = False,
          object_store_memory: Optional[int] = None,
          port: int = 0,
+         host: str = "",
          log_to_driver: bool = True):
     """Start (or connect to) a ray_tpu cluster.
 
@@ -99,7 +100,7 @@ def init(address: Optional[str] = None, *,
         _head_node = HeadNode(num_cpus=num_cpus, num_tpus=num_tpus,
                               resources=res or None,
                               num_initial_workers=num_initial_workers,
-                              probe_tpu=probe_tpu, port=port)
+                              probe_tpu=probe_tpu, port=port, host=host)
         address = _head_node.address
     w = _worker_mod.Worker(role="driver")
     w.namespace = namespace

@@ -191,6 +191,10 @@ class ActorRecord:
         self.node_id: Optional[NodeID] = None
         self.addr_waiters: List[Tuple[protocol.Connection, dict]] = []
         self.death_cause: Optional[str] = None
+        # GCS-restart recovery (owner re-linked by worker_id on driver
+        # reconnect; ``restored`` marks records awaiting re-claim).
+        self.owner_wid: Optional[bytes] = None
+        self.restored = False
 
 
 class ObsTaskRecord:
@@ -318,11 +322,13 @@ class ClientConn:
 
 class GcsServer:
     def __init__(self, session_name: str, session_dir: str,
-                 store_capacity: int = 0):
+                 store_capacity: int = 0, persist: bool = True):
         self.session_name = session_name
         self.session_dir = session_dir
         self.store_capacity = store_capacity
-        self.store = make_store(session_name, store_capacity)
+        self.store = make_store(
+            session_name, store_capacity,
+            populate=store_capacity if store_capacity > 0 else (2 << 30))
         # Reader safety on delete is enforced natively via per-object pins
         # in the arena itself (native/shm_store.cc rtpu_store_acquire/
         # release) — plasma's client-pin rule without GCS-side bookkeeping.
@@ -359,6 +365,136 @@ class GcsServer:
             "tasks_retried": 0, "actors_created": 0, "actors_restarted": 0,
             "objects_stored": 0,
         }
+        # Durable state + crash recovery (reference: GCS tables through the
+        # Redis store client, store_client_kv.cc, replayed by
+        # gcs_init_data.cc). WAL + snapshot live in the session dir.
+        self.restart_requested = False
+        self.resumed = False
+        # Instance identity: clients compare epochs across reconnects to
+        # tell "the GCS restarted, resync everything" from "my own link
+        # blipped against a live GCS, replay nothing".
+        self.epoch = os.urandom(8).hex()
+        self._driver_exit_graces: Dict[bytes, Any] = {}
+        self.log = None
+        if persist:
+            from .gcs_persistence import GcsLog
+
+            self.log = GcsLog(session_dir)
+            self._replay_persisted()
+        if self.resumed:
+            # Adoption grace: actors not re-claimed by surviving workers
+            # within the window get restarted (or declared dead).
+            self._adoption_deadline = time.time() + 5.0
+        else:
+            self._adoption_deadline = 0.0
+
+    # --------------------------------------------------------- persistence
+
+    def _log_append(self, op: str, payload):
+        if self.log is not None:
+            try:
+                self.log.append(op, payload)
+                self.log.maybe_compact(self._make_snapshot)
+            except OSError:
+                logger.exception("GCS WAL append failed; disabling WAL")
+                self.log = None
+
+    def _make_snapshot(self) -> dict:
+        return {
+            "kv": [[ns, k, v] for (ns, k), v in self.kv.items()],
+            "actors": [r.msg for r in self.actors.values()
+                       if r.state != A_DEAD],
+            "pgs": [{"pgid": p.pg_id.binary(), "bundles": p.bundles,
+                     "strategy": p.strategy, "name": p.name}
+                    for p in self.pgs.values()],
+            "inline": [[e.object_id.binary(), e.inline]
+                       for e in self.objects.values()
+                       if e.ready and e.inline is not None],
+        }
+
+    def _replay_persisted(self):
+        """Rebuild durable tables from snapshot+WAL and the surviving shm
+        arena. Ephemeral state (nodes, workers, leases, refcounts) comes
+        back from reconnecting peers (resync hellos)."""
+        snapshot, wal = self.log.load()
+        had_any = snapshot is not None
+        if snapshot:
+            for ns, k, v in snapshot.get("kv", []):
+                self.kv[(ns, k)] = v
+            for msg in snapshot.get("actors", []):
+                self._restore_actor(msg)
+            for p in snapshot.get("pgs", []):
+                self._restore_pg(p)
+            for oid_b, data in snapshot.get("inline", []):
+                entry = self._obj(ObjectID(bytes(oid_b)))
+                if not entry.ready:
+                    entry.nbytes = len(data)
+                    entry.inline = data
+                    entry.ready = True
+        for op, payload in wal:
+            had_any = True
+            if op == "kv":
+                self.kv[(payload[0], payload[1])] = payload[2]
+            elif op == "kvd":
+                self.kv.pop((payload[0], payload[1]), None)
+            elif op == "actor":
+                self._restore_actor(payload)
+            elif op == "actord":
+                aid = ActorID(bytes(payload))
+                rec = self.actors.pop(aid, None)
+                if rec is not None and rec.name is not None:
+                    self.named_actors.pop((rec.namespace, rec.name), None)
+            elif op == "pg":
+                self._restore_pg(payload)
+            elif op == "pgd":
+                self.pgs.pop(PlacementGroupID(bytes(payload)), None)
+            elif op == "obj":
+                entry = self._obj(ObjectID(bytes(payload[0])))
+                if not entry.ready:
+                    entry.nbytes = len(payload[1])
+                    entry.inline = payload[1]
+                    entry.ready = True
+            elif op == "objd":
+                self.objects.pop(ObjectID(bytes(payload)), None)
+        if not had_any:
+            return
+        self.resumed = True
+        # The shm arena outlives the GCS process: rescan its index to
+        # rebuild the directory of host-store objects.
+        self._restored_oids: List[ObjectID] = []
+        if hasattr(self.store, "list_objects"):
+            try:
+                for oid, nbytes in self.store.list_objects():
+                    entry = self._obj(oid)
+                    if not entry.ready:
+                        entry.nbytes = nbytes
+                        entry.on_shm = True
+                        entry.ready = True
+                        self.shm_bytes += nbytes
+                        self._restored_oids.append(oid)
+            except Exception:
+                logger.exception("arena rescan failed")
+        logger.info(
+            "GCS resumed from WAL: %d kv, %d actors, %d pgs, %d objects",
+            len(self.kv), len(self.actors), len(self.pgs), len(self.objects))
+
+    def _restore_actor(self, msg: dict):
+        aid = ActorID(bytes(msg["aid"]))
+        record = ActorRecord(aid, msg, None)
+        record.restored = True
+        if msg.get("owner_wid") is not None:
+            record.owner_wid = bytes(msg["owner_wid"])
+        self.actors[aid] = record
+        if record.name is not None:
+            self.named_actors[(record.namespace, record.name)] = aid
+        # state stays A_PENDING until a surviving worker re-claims it
+        # (resync hello) or the adoption grace expires and it restarts.
+
+    def _restore_pg(self, p: dict):
+        pgid = PlacementGroupID(bytes(p["pgid"]))
+        self.pgs[pgid] = PGRecord(pgid, p["bundles"], p["strategy"],
+                                  p.get("name", ""), None)
+        # state "pending": rescheduled once agents re-register.
 
     # ------------------------------------------------------------------ serve
 
@@ -367,7 +503,44 @@ class GcsServer:
         self._extra_servers = [await protocol.serve(a, self._on_client)
                                for a in extra_addresses]
         asyncio.get_running_loop().create_task(self._scheduler_loop())
+        if self.resumed:
+            asyncio.get_running_loop().call_later(
+                max(0.0, self._adoption_deadline - time.time()),
+                self._finish_adoption)
         logger.info("GCS listening on %s", [address, *extra_addresses])
+
+    def _finish_adoption(self):
+        """End of the post-restart grace window: restored actors nobody
+        re-claimed lost their worker during the outage — apply the normal
+        death/restart policy; orphans whose owner never reconnected die."""
+        # Arena-restored objects still at refcount 0 have no surviving
+        # referrer: enter them into the zero-ref LRU so they can be
+        # evicted — otherwise orphaned bytes would pin the store forever.
+        for oid in getattr(self, "_restored_oids", []):
+            entry = self.objects.get(oid)
+            if entry is not None and entry.ready and entry.refcount <= 0:
+                self._lru_touch(entry)
+        self._restored_oids = []
+        for record in list(self.actors.values()):
+            if not record.restored or record.state != A_PENDING:
+                continue
+            record.restored = False
+            if record.owner is None and not record.detached:
+                record.state = A_DEAD
+                record.death_cause = "owner driver lost during GCS outage"
+                self._cleanup_dead_actor(record)
+            elif (record.restarts_used < record.max_restarts
+                    or record.max_restarts < 0):
+                record.restarts_used += 1
+                self.counters["actors_restarted"] += 1
+                record.state = A_RESTARTING
+                logger.info("restarting actor %s lost during GCS outage",
+                            record.actor_id.hex()[:8])
+                self._try_place_actor(record)
+            else:
+                record.state = A_DEAD
+                record.death_cause = "actor worker lost during GCS outage"
+                self._cleanup_dead_actor(record)
 
     async def wait_shutdown(self):
         await self._shutdown_event.wait()
@@ -404,8 +577,25 @@ class GcsServer:
         if role == "agent":
             node_id = NodeID(msg["node_id"])
             client.node_id = node_id
-            self.nodes[node_id] = NodeInfo(
+            node = NodeInfo(
                 node_id, msg["resources"], msg.get("hostname", ""), client.conn)
+            self.nodes[node_id] = node
+            # Adopt surviving workers that resynced before their agent
+            # (GCS restart: reconnect order is arbitrary).
+            for w in self.workers.values():
+                if w.node_id == node_id and not w.conn.closed:
+                    node.workers.add(w.worker_id)
+                    if w.state == W_IDLE:
+                        node.idle_workers.append(w.worker_id)
+                    elif w.state == W_ACTOR and not w.acquired:
+                        # Actor claimed before its node registered: charge
+                        # the actor's resources now.
+                        rec = (self.actors.get(w.actor_id)
+                               if w.actor_id else None)
+                        if rec is not None:
+                            w.acquired = self._acquire(node, rec)
+                    elif w.acquired:
+                        _res_sub(node.avail, w.acquired)
             logger.info("node %s joined: %s", node_id.hex()[:8], msg["resources"])
             self._wake_scheduler()
         elif role == "worker":
@@ -420,18 +610,72 @@ class GcsServer:
             if node is not None:
                 node.workers.add(worker_id)
                 node.spawning = max(0, node.spawning - 1)
+            claimed = False
+            stale_actor = False
+            aid_b = msg.get("actor_id")
+            if aid_b is not None:
+                # Resync: a surviving actor worker re-claims its actor
+                # after a GCS restart (reference: raylet/worker resync,
+                # gcs_init_data.cc + test_gcs_fault_tolerance.py). A claim
+                # is only valid when the record is unbound (restored) or
+                # already bound to THIS worker — otherwise a transiently
+                # disconnected worker would steal back an actor the live
+                # GCS already restarted elsewhere, leaving two instances.
+                record = self.actors.get(ActorID(bytes(aid_b)))
+                if record is not None and record.worker_id not in (
+                        None, worker_id):
+                    stale_actor = True
+                    record = None
+                if record is not None and record.state in (A_PENDING,
+                                                           A_RESTARTING,
+                                                           A_ALIVE):
+                    info.state = W_ACTOR
+                    info.actor_id = record.actor_id
+                    record.worker_id = worker_id
+                    record.node_id = node_id
+                    record.addr = info.addr
+                    record.state = A_ALIVE
+                    if node is not None:
+                        info.acquired = self._acquire(node, record)
+                    for conn, req in record.addr_waiters:
+                        if not conn.closed:
+                            conn.reply(req, {"ok": True, "state": A_ALIVE,
+                                             "addr": record.addr})
+                    record.addr_waiters.clear()
+                    record.restored = False
+                    claimed = True
+            if stale_actor:
+                # Its actor lives elsewhere now: this worker's instance is
+                # an orphan — retire the process rather than let the
+                # scheduler treat it as an idle plain worker.
+                client.conn.send({"t": "exit"})
+            elif not claimed and node is not None:
                 node.idle_workers.append(worker_id)
             self._wake_scheduler()
         elif role == "driver":
             worker_id = WorkerID(msg["worker_id"])
             client.worker_id = worker_id
             self.drivers.append(client)
+            wid_b = worker_id.binary()
+            # A reconnect within the exit grace window cancels the pending
+            # driver-death cleanup (the link blipped; the driver is alive).
+            grace = self._driver_exit_graces.pop(wid_b, None)
+            if grace is not None:
+                grace.cancel()
+            # Re-link actors to their reconnecting owner so owner-exit
+            # cleanup keeps working after a GCS restart or link blip.
+            for record in self.actors.values():
+                prev = record.owner
+                if record.owner_wid == wid_b or (
+                        prev is not None and prev.worker_id == worker_id):
+                    record.owner = client
         if client.worker_id is not None:
             self._client_by_wid[client.worker_id.binary()] = client
         client.conn.reply(msg, {
             "ok": True,
             "session": self.session_name,
             "session_dir": self.session_dir,
+            "epoch": self.epoch,
         })
 
     async def _h_update_resources(self, client: ClientConn, msg: dict):
@@ -446,6 +690,11 @@ class GcsServer:
         self._wake_scheduler()
 
     def _on_disconnect(self, client: ClientConn):
+        if self.restart_requested:
+            # Teardown of the old instance during a control-plane restart:
+            # peers are alive and will resync with the new instance — no
+            # death handling.
+            return
         if client in self.clients:
             self.clients.remove(client)
         if (client.worker_id is not None
@@ -469,14 +718,30 @@ class GcsServer:
         elif client.role == "driver":
             if client in self.drivers:
                 self.drivers.remove(client)
-            self._on_driver_exit(client)
+            # Grace before death handling: a driver whose TCP link blipped
+            # reconnects within seconds; killing its actors and releasing
+            # its leases immediately would be wrong (the resync path,
+            # unlike a GCS restart, replays nothing into a live GCS).
+            wid_b = (client.worker_id.binary()
+                     if client.worker_id is not None else None)
+            if wid_b is not None:
+                old = self._driver_exit_graces.pop(wid_b, None)
+                if old is not None:
+                    old.cancel()
+                self._driver_exit_graces[wid_b] = \
+                    asyncio.get_running_loop().call_later(
+                        3.0, self._driver_exit_after_grace, wid_b, client)
+            else:
+                self._on_driver_exit(client)
         elif client.role == "agent" and client.node_id is not None:
             self._on_node_death(client.node_id)
 
     # ------------------------------------------------------------- KV store
 
     async def _h_kv_put(self, client, msg):
-        self.kv[(msg.get("ns", ""), msg["k"])] = msg["v"]
+        ns = msg.get("ns", "")
+        self.kv[(ns, msg["k"])] = msg["v"]
+        self._log_append("kv", [ns, msg["k"], msg["v"]])
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
 
@@ -485,7 +750,9 @@ class GcsServer:
         client.conn.reply(msg, {"ok": v is not None, "v": v})
 
     async def _h_kv_del(self, client, msg):
-        self.kv.pop((msg.get("ns", ""), msg["k"]), None)
+        ns = msg.get("ns", "")
+        self.kv.pop((ns, msg["k"]), None)
+        self._log_append("kvd", [ns, msg["k"]])
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
 
@@ -550,6 +817,10 @@ class GcsServer:
         self._owned_objects.setdefault(id(owner), set()).add(oid)
         self._mark_ready(entry, msg["nbytes"], msg.get("data"),
                          msg.get("shm", False))
+        if msg.get("data") is not None:
+            # Inline payloads are durable (small by definition); shm objects
+            # need no WAL — the arena survives a GCS crash and is rescanned.
+            self._log_append("obj", [msg["oid"], msg["data"]])
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
 
@@ -688,6 +959,8 @@ class GcsServer:
                     os.unlink(entry.spilled)
                 except OSError:
                     pass
+            if entry.inline is not None:
+                self._log_append("objd", oid.binary())
             del self.objects[oid]
         if self.shm_bytes > target_bytes:
             self._spill_until_under(target_bytes)
@@ -1191,6 +1464,10 @@ class GcsServer:
         for wid in list(node.workers):
             asyncio.get_running_loop().create_task(self._on_worker_death(wid))
 
+    def _driver_exit_after_grace(self, wid_b: bytes, client: ClientConn):
+        self._driver_exit_graces.pop(wid_b, None)
+        self._on_driver_exit(client)
+
     def _on_driver_exit(self, client: ClientConn):
         """Non-detached actors owned by an exiting driver are killed; its
         objects are dereferenced; its worker leases are reclaimed."""
@@ -1225,6 +1502,10 @@ class GcsServer:
             self.named_actors[key] = aid
         self.actors[aid] = record
         self.counters["actors_created"] += 1
+        wal_msg = {k: v for k, v in msg.items() if k != "i"}
+        if client.worker_id is not None:
+            wal_msg["owner_wid"] = client.worker_id.binary()
+        self._log_append("actor", wal_msg)
         client.conn.reply(msg, {"ok": True})
         self._try_place_actor(record)
 
@@ -1281,6 +1562,7 @@ class GcsServer:
         record.state = A_DEAD
         record.death_cause = "creation task failed"
         record.msg_error = msg.get("err")
+        self._log_append("actord", record.actor_id.binary())
         for conn, req in record.addr_waiters:
             if not conn.closed:
                 conn.reply(req, {"ok": False, "state": A_DEAD,
@@ -1363,6 +1645,7 @@ class GcsServer:
             self._cleanup_dead_actor(record)
 
     def _cleanup_dead_actor(self, record: ActorRecord):
+        self._log_append("actord", record.actor_id.binary())
         for conn, req in record.addr_waiters:
             if not conn.closed:
                 conn.reply(req, {"ok": False, "state": A_DEAD,
@@ -1393,6 +1676,10 @@ class GcsServer:
         record = PGRecord(pg_id, msg["bundles"], msg["strategy"],
                           msg.get("name", ""), client)
         self.pgs[pg_id] = record
+        self._log_append("pg", {"pgid": pg_id.binary(),
+                                "bundles": record.bundles,
+                                "strategy": record.strategy,
+                                "name": record.name})
         placed = self._place_bundles(record)
         if placed:
             record.state = "ready"
@@ -1473,6 +1760,8 @@ class GcsServer:
     async def _h_pg_remove(self, client, msg):
         pg_id = PlacementGroupID(msg["pgid"])
         record = self.pgs.pop(pg_id, None)
+        if record is not None:
+            self._log_append("pgd", pg_id.binary())
         if record is not None and record.state == "ready":
             for node_id, bundle, avail in zip(
                     record.placement, record.bundles, record.bundle_avail):
@@ -1681,3 +1970,36 @@ class GcsServer:
             client.conn.reply(msg, {"ok": True})
         await asyncio.sleep(0.05)
         self._shutdown_event.set()
+
+    async def _h_gcs_restart(self, client, msg):
+        """Chaos/test hook: crash-restart the control plane in place.
+
+        Drops every client connection and discards ALL in-memory state; the
+        supervisor (head_amain) builds a fresh GcsServer that recovers from
+        the WAL + arena while agents/workers/drivers reconnect and resync —
+        the same recovery path as a real GCS process death (reference:
+        ``test_gcs_fault_tolerance.py`` restarting gcs_server).
+        """
+        logger.warning("GCS restart injected (chaos)")
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+        self.restart_requested = True
+        await asyncio.sleep(0.02)  # let the reply flush
+        self._shutdown_event.set()
+
+    async def stop_serving(self):
+        """Close listeners and all client connections (restart path)."""
+        for srv in [self._server, *getattr(self, "_extra_servers", [])]:
+            if srv is not None:
+                srv.close()
+                try:
+                    await srv.wait_closed()
+                except Exception:
+                    pass
+        for client in list(self.clients):
+            try:
+                await client.conn.close()
+            except Exception:
+                pass
+        if self.log is not None:
+            self.log.close()

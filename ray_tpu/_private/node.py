@@ -174,10 +174,18 @@ class NodeAgent:
         self.stopped = asyncio.Event()
 
     async def start(self):
+        await self._connect_and_register()
+        for _ in range(self.num_initial_workers):
+            self.spawn_worker()
+        if self.probe_tpu and "TPU" not in self.resources:
+            asyncio.get_running_loop().create_task(self._probe_tpu())
+        asyncio.get_running_loop().create_task(self._reap_loop())
+
+    async def _connect_and_register(self):
         reader, writer = await protocol.connect(self.gcs_address)
         self.conn = protocol.Connection(
             reader, writer, handler=self._on_msg,
-            on_close=lambda: self.stopped.set())
+            on_close=self._on_gcs_close)
         self.conn.start()
         await self.conn.request({
             "t": "hello", "role": "agent",
@@ -185,11 +193,26 @@ class NodeAgent:
             "resources": self.resources,
             "hostname": os.uname().nodename,
         }, timeout=30)
-        for _ in range(self.num_initial_workers):
-            self.spawn_worker()
-        if self.probe_tpu and "TPU" not in self.resources:
-            asyncio.get_running_loop().create_task(self._probe_tpu())
-        asyncio.get_running_loop().create_task(self._reap_loop())
+
+    def _on_gcs_close(self):
+        if not self.stopped.is_set():
+            asyncio.get_running_loop().create_task(self._reconnect())
+
+    async def _reconnect(self):
+        """GCS connection lost: retry + re-register (GCS restart resync —
+        reference: raylets resyncing after GCS failover,
+        test_gcs_fault_tolerance.py). Gives up after ~15 s and stops the
+        node, which matches losing the head permanently."""
+        for _ in range(75):
+            if self.stopped.is_set():
+                return
+            await asyncio.sleep(0.2)
+            try:
+                await self._connect_and_register()
+                return
+            except (OSError, ConnectionError, asyncio.TimeoutError):
+                continue
+        self.stopped.set()
 
     async def _probe_tpu(self):
         try:
@@ -267,40 +290,63 @@ async def head_amain(args):
 
     resources = json.loads(args.resources)
     session_name = os.path.basename(args.session_dir)
-    gcs = GcsServer(session_name, args.session_dir,
-                    store_capacity=int(resources.get(
-                        "object_store_memory", DEFAULT_STORE_CAPACITY)))
-    address = "unix:" + os.path.join(args.session_dir, "gcs.sock")
-    if args.port:
-        # TCP for remote drivers/agents + the local UDS for same-host
-        # workers (the reference similarly serves gRPC on a port while
-        # workers register over a local socket, node_manager.h:119).
-        await gcs.start(f"0.0.0.0:{args.port}", address)
-        address = f"{args.host or get_node_ip_address()}:{args.port}"
-    else:
-        await gcs.start(address)
-    agent = NodeAgent(
-        "unix:" + os.path.join(args.session_dir, "gcs.sock"),
-        args.session_dir, resources,
-        num_initial_workers=args.num_initial_workers,
-        probe_tpu=not args.no_probe_tpu)
-    await agent.start()
-    # Signal readiness to the parent driver. Atomic rename: the parent
-    # polls for existence and immediately reads the (load-bearing) address.
-    ready = os.path.join(args.session_dir, "gcs.ready")
-    with open(ready + ".tmp", "w") as f:
-        f.write(address)
-    os.rename(ready + ".tmp", ready)
-    try:
-        await gcs.wait_shutdown()
-    finally:
-        agent.stopped.set()
-        agent.shutdown_workers()
-        if hasattr(gcs.store, "unlink"):
-            try:
-                gcs.store.unlink()
-            except Exception:
-                pass
+    uds = "unix:" + os.path.join(args.session_dir, "gcs.sock")
+    agent = None
+    ready_written = False
+    while True:
+        # Supervisor loop: a GcsServer instance serves until shutdown OR a
+        # (chaos-injected or operator) control-plane restart — the next
+        # instance starts empty and recovers from WAL + arena + resyncs
+        # (reference: GCS restarting from Redis, gcs_init_data.cc).
+        gcs = GcsServer(session_name, args.session_dir,
+                        store_capacity=int(resources.get(
+                            "object_store_memory", DEFAULT_STORE_CAPACITY)))
+        address = uds
+        if args.port:
+            # TCP for remote drivers/agents + the local UDS for same-host
+            # workers (the reference similarly serves gRPC on a port while
+            # workers register over a local socket, node_manager.h:119).
+            # Bind loopback unless a host was explicitly provided: this
+            # socket accepts unauthenticated task submission, so exposing
+            # it on all interfaces must be an operator decision
+            # (--host/host=), not a default.
+            bind_host = args.host or "127.0.0.1"
+            await gcs.start(f"{bind_host}:{args.port}", uds)
+            adv_host = args.host or "127.0.0.1"
+            if args.host in ("0.0.0.0", "::"):
+                adv_host = get_node_ip_address()
+            address = f"{adv_host}:{args.port}"
+        else:
+            await gcs.start(uds)
+        if agent is None:
+            agent = NodeAgent(
+                uds, args.session_dir, resources,
+                num_initial_workers=args.num_initial_workers,
+                probe_tpu=not args.no_probe_tpu)
+            await agent.start()
+        if not ready_written:
+            # Signal readiness to the parent driver. Atomic rename: the
+            # parent polls for existence and immediately reads the
+            # (load-bearing) address.
+            ready = os.path.join(args.session_dir, "gcs.ready")
+            with open(ready + ".tmp", "w") as f:
+                f.write(address)
+            os.rename(ready + ".tmp", ready)
+            ready_written = True
+        try:
+            await gcs.wait_shutdown()
+        finally:
+            if not gcs.restart_requested:
+                agent.stopped.set()
+                agent.shutdown_workers()
+                if hasattr(gcs.store, "unlink"):
+                    try:
+                        gcs.store.unlink()
+                    except Exception:
+                        pass
+        if not gcs.restart_requested:
+            break
+        await gcs.stop_serving()
 
 
 def _run_with_optional_profile(coro_factory, tag: str):
@@ -378,7 +424,7 @@ class HeadNode:
 
     def __init__(self, num_cpus=None, num_tpus=None, resources=None,
                  num_initial_workers: int = 2, probe_tpu: bool = True,
-                 port: int = 0):
+                 port: int = 0, host: str = ""):
         self.session_dir = new_session_dir()
         self.resources = detect_node_resources(num_cpus, num_tpus, resources)
         self.address = "unix:" + os.path.join(self.session_dir, "gcs.sock")
@@ -389,6 +435,8 @@ class HeadNode:
                "--num-initial-workers", str(num_initial_workers)]
         if port:
             cmd += ["--port", str(port)]
+        if host:
+            cmd += ["--host", host]
         if not probe_tpu:
             cmd.append("--no-probe-tpu")
         env = {**os.environ, "RAY_TPU_SYS_PATH": worker_sys_path()}

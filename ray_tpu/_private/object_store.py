@@ -199,19 +199,26 @@ class PyShmStore:
             self._attached.clear()
 
 
-def _try_native_store(session_name: str, capacity: int):
+def _try_native_store(session_name: str, capacity: int, populate: int):
     try:
         from .shm_native import NativeStore
 
-        return NativeStore(session_name, capacity)
+        return NativeStore(session_name, capacity, populate=populate)
     except Exception:
         return None
 
 
-def make_store(session_name: str, capacity: int = 0, prefer_native: bool = True):
-    """Create the host object store client for this process."""
+def make_store(session_name: str, capacity: int = 0, prefer_native: bool = True,
+               populate: int = 0):
+    """Create the host object store client for this process.
+
+    ``populate`` (bytes) starts the background page-commit sweep over that
+    much of the arena and should be set by exactly one process per host
+    (the GCS/head): tmpfs page commits are arena-wide, and N concurrent
+    populaters just multiply the kernel work.
+    """
     if prefer_native and not os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
-        store = _try_native_store(session_name, capacity)
+        store = _try_native_store(session_name, capacity, populate)
         if store is not None:
             return store
     return PyShmStore(session_name)

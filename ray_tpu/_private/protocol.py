@@ -239,6 +239,13 @@ async def serve(
     address: str, client_connected_cb: Callable
 ) -> asyncio.AbstractServer:
     if address.startswith("unix:"):
-        return await asyncio.start_unix_server(client_connected_cb, address[5:])
+        path = address[5:]
+        try:
+            # Stale socket file from a crashed/restarted server: closing an
+            # asyncio unix server does not unlink its path.
+            os.unlink(path)
+        except OSError:
+            pass
+        return await asyncio.start_unix_server(client_connected_cb, path)
     host, _, port = address.rpartition(":")
     return await asyncio.start_server(client_connected_cb, host, int(port))

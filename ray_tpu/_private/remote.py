@@ -96,22 +96,42 @@ def _prepared_runtime_env(opts: dict):
     return wire
 
 
-def _prepare_args(args: tuple, kwargs: dict) -> dict:
+def _prepare_args(args: tuple, kwargs: dict,
+                  collect_deps: bool = False) -> dict:
     """Serialize call arguments; large blobs go to shared memory.
 
     Mirrors the reference's inline-vs-plasma arg split
     (``DependencyResolver`` inlining, ``transport/dependency_resolver.h``):
     small args travel in the control message, large ones are put into the
     object store and fetched zero-copy by the executing worker.
+
+    ``collect_deps`` additionally reports top-level ObjectRef arguments so
+    the submitter can defer dispatch until they resolve — pushing a task
+    whose args are still being computed would park it on a worker that
+    then blocks, deadlocking pipelines whose producer tasks queue behind
+    it (the reference resolves dependencies BEFORE taking a lease,
+    ``transport/dependency_resolver.h``).
     """
     w = global_worker()
+    out: dict = {}
+    if collect_deps:
+        from .worker import ObjectRef
+
+        deps = [a.id.binary() for a in args if isinstance(a, ObjectRef)]
+        deps += [v.id.binary() for v in kwargs.values()
+                 if isinstance(v, ObjectRef)]
+        if deps:
+            out["deps"] = deps
     sobj = serialize((args, kwargs))
     if sobj.total_size <= INLINE_THRESHOLD:
-        return {"args": sobj.to_bytes()}
+        out["args"] = sobj.to_bytes()
+        return out
     oid = w.put_serialized(sobj)
     # Hold a reference until the consuming task is done: register then let
     # the GCS-side refcount keep it; the executing worker borrows it.
-    return {"argsref": oid.binary(), "argsn": sobj.total_size}
+    out["argsref"] = oid.binary()
+    out["argsn"] = sobj.total_size
+    return out
 
 
 class RemoteFunction:
@@ -177,7 +197,7 @@ class RemoteFunction:
             wire_opts.update(_strategy_opts(opts))
             self._wire_opts = wire_opts
         nret = opts.get("num_returns", 1)
-        msg_args = _prepare_args(args, kwargs)
+        msg_args = _prepare_args(args, kwargs, collect_deps=True)
         refs = w.submit_task(fid, msg_args, nret, wire_opts)
         return refs[0] if nret == 1 else refs
 

@@ -79,6 +79,10 @@ def get_lib():
             lib.rtpu_store_delete.restype = ctypes.c_int
             lib.rtpu_store_delete.argtypes = [ctypes.c_void_p,
                                               ctypes.c_char_p]
+            lib.rtpu_store_list.restype = ctypes.c_uint64
+            lib.rtpu_store_list.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
             lib.rtpu_store_stats.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),
@@ -94,7 +98,8 @@ def get_lib():
 class NativeStore:
     """Arena-backed store client; same interface as ``PyShmStore``."""
 
-    def __init__(self, session_name: str, capacity: int = 0):
+    def __init__(self, session_name: str, capacity: int = 0,
+                 populate: int = 0):
         self.lib = get_lib()
         # shm name limit: keep it short and unique per session.
         tag = hashlib.sha1(session_name.encode()).hexdigest()[:16]
@@ -113,29 +118,71 @@ class NativeStore:
             os.close(fd)
         self._view = memoryview(self._mmap)
         self._total = total
-        # Populate this process's page tables in the background: without
-        # it every first write/read of a page in THIS process takes a
-        # minor fault (~2 GB/s ceiling); populated, copies run at memory
-        # speed (~10 GB/s). MADV_POPULATE_WRITE also zero-allocates pages
-        # on first touch arena-wide, so whichever process runs first does
-        # the tmpfs allocation once for everyone.
-        threading.Thread(target=self._populate_pages, daemon=True,
-                         name="arena-populate").start()
+        # Serializes close() against calls that can legally arrive after
+        # shutdown (view release_cb from buffer GC, the prefault thread).
+        self._close_lock = threading.Lock()
+        # madvise must go through ctypes, NOT mmap.madvise: CPython holds
+        # the GIL across the syscall, and MADV_POPULATE_WRITE of a cold
+        # 64 MiB window takes ~25 ms — enough to stall the whole process
+        # (IO loop included) once per window from the populate thread.
+        # ctypes foreign calls release the GIL.
+        anchor = (ctypes.c_char * 1).from_buffer(self._mmap)
+        self._base_addr = ctypes.addressof(anchor)
+        del anchor
+        self._libc = ctypes.CDLL(None, use_errno=True)
+        if populate:
+            # Commit the first ``populate`` bytes of tmpfs pages up front
+            # (zero-fill major faults are ~1.4 GB/s; committed pages take
+            # cheap minor faults in every process). Page commits are
+            # ARENA-wide, so exactly one process per host (the GCS/head)
+            # runs this — N populaters would just multiply the kernel work.
+            #
+            # On hosts with plenty of cores the whole sweep runs on a
+            # background thread for free. On tiny hosts a background
+            # sweep would either starve (nice) or steal the workload's
+            # core (not nice) — there, commit the hot first-fit region
+            # synchronously at store open (a one-time ~0.5 s startup cost)
+            # and leave only the tail to the background.
+            nbytes = min(populate, total)
+            sync_bytes = 0
+            if (os.cpu_count() or 1) <= 4:
+                sync_bytes = min(nbytes, 1 << 30)
+                self._madvise(0, sync_bytes)
+            if nbytes > sync_bytes:
+                threading.Thread(
+                    target=self._populate_pages,
+                    args=(nbytes, sync_bytes), daemon=True,
+                    name="arena-populate").start()
 
-    def _populate_pages(self, window: int = 64 << 20):
-        MADV_POPULATE_WRITE = 23  # Linux 5.14+
+    def _madvise(self, off: int, length: int, advice: int = 23) -> bool:
+        """madvise via libc (releases the GIL). 23 = MADV_POPULATE_WRITE
+        (Linux 5.14+). Returns False when the kernel rejects the advice."""
+        if length <= 0:
+            return True
+        rc = self._libc.madvise(
+            ctypes.c_void_p(self._base_addr + off),
+            ctypes.c_size_t(length), ctypes.c_int(advice))
+        return rc == 0
+
+    def _populate_pages(self, nbytes: int, start: int = 0,
+                        window: int = 16 << 20):
+        # Commits near full speed, overlapping session startup — worker
+        # interpreter spawns are seconds long, so this typically finishes
+        # before user code runs. Short windows + small sleeps keep any
+        # single steal of a busy core to ~6 ms.
         try:
             os.nice(19)  # per-thread on Linux
         except OSError:
             pass
-        time.sleep(0.5)  # let process startup win the CPU first
-        for off in range(0, self._total, window):
-            try:
-                self._mmap.madvise(MADV_POPULATE_WRITE, off,
-                                   min(window, self._total - off))
-            except (OSError, ValueError):
+        time.sleep(0.2)
+        for off in range(start, nbytes, window):
+            if not self.handle:
                 return
-            time.sleep(0.003)
+            # No close-lock needed: madvise on an unmapped range fails with
+            # ENOMEM (returning False) rather than faulting.
+            if not self._madvise(off, min(window, nbytes - off)):
+                return
+            time.sleep(0.002)
 
     @staticmethod
     def _key(object_id: ObjectID) -> bytes:
@@ -153,13 +200,8 @@ class NativeStore:
             # per-page zero-fill faults when cold, ~free when the
             # background populate already covered it.
             start = off & ~0xFFF
-            try:
-                self._mmap.madvise(23,  # MADV_POPULATE_WRITE
-                                   start,
-                                   min(off - start + nbytes,
-                                       self._total - start))
-            except (OSError, ValueError):
-                pass
+            self._madvise(start, min(off - start + nbytes,
+                                     self._total - start))
         return self._view[off:off + nbytes]
 
     def seal(self, object_id: ObjectID):
@@ -186,30 +228,12 @@ class NativeStore:
             release_cb=lambda oid=object_id: self.release(oid))
 
     def release(self, object_id: ObjectID):
-        self.lib.rtpu_store_release(self.handle, self._key(object_id))
-
-    def prefault(self, window: int = 32 << 20):
-        """Touch every free page once so later first writes take minor
-        faults (~10 GB/s) instead of zero-fill major faults (~1.4 GB/s).
-        Incremental (arena lock held per window only); progress is shared
-        via a cursor in the arena header, so the sweep runs once per
-        session. Run from a background thread at head start; deprioritized
-        so short-lived sessions (tests) barely pay for it."""
-        import time as _time
-
-        try:
-            os.nice(19)
-        except OSError:
-            pass
-        _time.sleep(1.0)  # let session startup win the CPU first
-        while True:
-            try:
-                more = self.lib.rtpu_store_prefault_step(self.handle, window)
-            except Exception:
-                return
-            if not more:
-                return
-            _time.sleep(0.005)
+        # Zero-copy views release lazily (buffer GC), possibly after
+        # close() at interpreter exit — a freed/NULL handle would segfault.
+        with self._close_lock:
+            if self.handle:
+                self.lib.rtpu_store_release(self.handle,
+                                            self._key(object_id))
 
     def contains(self, object_id: ObjectID) -> bool:
         off = ctypes.c_uint64()
@@ -219,7 +243,24 @@ class NativeStore:
             ctypes.byref(off), ctypes.byref(size)) == 0
 
     def delete(self, object_id: ObjectID):
-        self.lib.rtpu_store_delete(self.handle, self._key(object_id))
+        with self._close_lock:
+            if self.handle:
+                self.lib.rtpu_store_delete(self.handle, self._key(object_id))
+
+    def list_objects(self, max_objects: int = 65536):
+        """Enumerate sealed objects as [(ObjectID, nbytes)] — the restart
+        path a recovering GCS uses to rebuild its object directory from
+        the surviving arena."""
+        keys = (ctypes.c_uint8 * (20 * max_objects))()
+        sizes = (ctypes.c_uint64 * max_objects)()
+        n = int(self.lib.rtpu_store_list(self.handle, keys, sizes,
+                                         max_objects))
+        out = []
+        raw = bytes(keys)
+        for i in range(n):
+            out.append((ObjectID(raw[i * 20:(i + 1) * 20]),
+                        int(sizes[i])))
+        return out
 
     def stats(self) -> Dict[str, int]:
         used = ctypes.c_uint64()
@@ -239,9 +280,10 @@ class NativeStore:
             self._mmap.close()
         except (BufferError, ValueError):
             pass
-        if self.handle:
-            self.lib.rtpu_store_close(self.handle)
-            self.handle = None
+        with self._close_lock:
+            if self.handle:
+                self.lib.rtpu_store_close(self.handle)
+                self.handle = None
 
     def unlink(self):
         self.lib.rtpu_store_unlink(self._name)

@@ -61,8 +61,10 @@ class ObjectRef:
                  *, borrowed: bool = False):
         self.id = object_id
         self._worker = worker if worker is not None else _global_worker
-        if borrowed and self._worker is not None:
-            self._worker.queue_ref_delta(object_id, +1)
+        if self._worker is not None:
+            self._worker.note_ref_live(object_id, +1)
+            if borrowed:
+                self._worker.queue_ref_delta(object_id, +1)
 
     def hex(self) -> str:
         return self.id.hex()
@@ -86,6 +88,7 @@ class ObjectRef:
     def __del__(self):
         w = self._worker
         if w is not None and not w.closed:
+            w.note_ref_live(self.id, -1)
             w.queue_ref_delta(self.id, -1)
 
     def __hash__(self):
@@ -144,7 +147,8 @@ class _TaskClass:
 
 
 class _TaskItem:
-    __slots__ = ("msg", "oids", "retries", "cancelled", "name", "created")
+    __slots__ = ("msg", "oids", "retries", "cancelled", "name", "created",
+                 "deps_left")
 
     def __init__(self, msg: dict, oids: List[ObjectID], retries: int,
                  name: str):
@@ -154,6 +158,7 @@ class _TaskItem:
         self.cancelled = False
         self.name = name
         self.created = time.time()
+        self.deps_left = 0
 
 
 # In-flight pipeline depth per leased worker: >1 overlaps the push/reply
@@ -202,6 +207,9 @@ class Worker:
         self._object_futures: Dict[ObjectID, SyncFuture] = {}
         self._memory_store: Dict[ObjectID, bytes] = {}
         self._ref_deltas: Dict[ObjectID, int] = {}
+        # Net live local refs per object — the resync payload that rebuilds
+        # GCS refcounts after a control-plane restart.
+        self._live_refs: Dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
         self._actor_chans: Dict[ActorID, _ActorChannel] = {}
         self._dead_actors: Dict[ActorID, str] = {}
@@ -288,15 +296,79 @@ class Worker:
         if self.node_id is not None:
             hello["node_id"] = self.node_id
         reply = await self.gcs.request(hello, timeout=30)
+        self._gcs_epoch = reply.get("epoch")
         self._flusher_handle = self.loop.call_later(0.1, self._flush_refs_cb)
         return reply
 
     def _on_gcs_close(self):
-        if not self.closed:
-            for fut in list(self._object_futures.values()):
-                if not fut.done():
-                    fut.set_exception(
-                        ConnectionError("lost connection to the cluster"))
+        if self.closed:
+            return
+        # The control plane may be restarting (GCS fault tolerance,
+        # reference: test_gcs_fault_tolerance.py driver reconnect): retry
+        # before failing the world. Workers spawned by worker_main manage
+        # their own reconnect; this path serves drivers and ray:// clients.
+        self.loop.create_task(self._reconnect_gcs())
+
+    async def _reconnect_gcs(self):
+        for _ in range(75):
+            if self.closed:
+                return
+            await asyncio.sleep(0.2)
+            try:
+                reader, writer = await protocol.connect(self.gcs_address)
+            except OSError:
+                continue
+            conn = protocol.Connection(
+                reader, writer, handler=self._on_gcs_push,
+                on_close=self._on_gcs_close)
+            conn.start()
+            try:
+                reply = await conn.request({
+                    "t": "hello", "role": self.role,
+                    "worker_id": self.worker_id.binary(),
+                    "pid": os.getpid(),
+                    **({"node_id": self.node_id}
+                       if self.node_id is not None else {}),
+                }, timeout=30)
+            except (ConnectionError, asyncio.TimeoutError):
+                await conn.close()
+                continue
+            self.gcs = conn
+            new_epoch = reply.get("epoch")
+            restarted = new_epoch != getattr(self, "_gcs_epoch", None)
+            self._gcs_epoch = new_epoch
+            self._resync_after_reconnect(gcs_restarted=restarted)
+            return
+        # Reconnect window exhausted: the cluster is really gone.
+        for fut in list(self._object_futures.values()):
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError("lost connection to the cluster"))
+
+    def _resync_after_reconnect(self, gcs_restarted: bool = True):
+        """Rebuild GCS-side state that only this process knows.
+
+        1. Live ref counts — ONLY when the GCS actually restarted (epoch
+           changed): a fresh instance starts all refcounts at zero.
+           Replaying them into a surviving GCS after a mere link blip
+           would double-count.
+        2. obj_wait re-subscriptions for every unresolved future.
+        3. Owned inline values not yet re-registered (promote-pending).
+        Lease demand refreshes itself on the next pump.
+        """
+        if gcs_restarted:
+            with self._ref_lock:
+                live = [(oid.binary(), n)
+                        for oid, n in self._live_refs.items()]
+            if live:
+                self._send_gcs({"t": "ref", "d": live})
+        for oid, fut in list(self._object_futures.items()):
+            if not fut.done() and oid not in self._memory_store:
+                asyncio.run_coroutine_threadsafe(
+                    self._wait_remote(oid, fut), self.loop)
+        for cls in self._task_classes.values():
+            cls.demand = 0
+            self._pump_class(cls)
 
     def disconnect(self):
         if self.closed:
@@ -324,6 +396,17 @@ class Worker:
                 await lease.conn.close()
 
     # ----------------------------------------------------------- ref counts
+
+    def note_ref_live(self, object_id: ObjectID, delta: int):
+        """Local ObjectRef liveness bookkeeping (no wire traffic): the
+        count a resync replays to rebuild GCS refcounts after a
+        control-plane restart."""
+        with self._ref_lock:
+            live = self._live_refs.get(object_id, 0) + delta
+            if live > 0:
+                self._live_refs[object_id] = live
+            else:
+                self._live_refs.pop(object_id, None)
 
     def queue_ref_delta(self, object_id: ObjectID, delta: int):
         if self.closed:
@@ -631,6 +714,7 @@ class Worker:
         tid = TaskID.from_random()
         refs = []
         oids = []
+        deps = msg_args.pop("deps", None)
         for i in range(num_returns):
             oid = ObjectID.for_task_return(tid, i + 1)
             fut = SyncFuture()
@@ -664,12 +748,45 @@ class Worker:
         key, wire = cached
         item = _TaskItem(msg, oids, opts.get("retries", 0),
                          opts.get("name", ""))
-        with self._out_lock:
-            self._out_q.append(("task", key, wire, item))
-            wake = len(self._out_q) == 1
-        if wake:
-            self.loop.call_soon_threadsafe(self._drain_out)
+        # Dependency resolution BEFORE dispatch (reference:
+        # ``DependencyResolver``, transport/dependency_resolver.h): a task
+        # whose ObjectRef args are still being computed must not occupy a
+        # leased worker — it would block in arg-load while its producers
+        # queue behind it, deadlocking multi-stage pipelines.
+        unresolved: List[ObjectID] = []
+        for oid_b in deps or ():
+            d_oid = ObjectID(bytes(oid_b))
+            if d_oid in self._memory_store:
+                continue
+            fut = self._object_futures.get(d_oid)
+            if fut is None or not fut.done():
+                unresolved.append(d_oid)
+        if unresolved:
+            self._defer_for_deps(key, wire, item, unresolved)
+        else:
+            with self._out_lock:
+                self._out_q.append(("task", key, wire, item))
+                wake = len(self._out_q) == 1
+            if wake:
+                self.loop.call_soon_threadsafe(self._drain_out)
         return refs
+
+    def _defer_for_deps(self, key: str, wire: dict, item: _TaskItem,
+                        deps: List[ObjectID]):
+        item.deps_left = len(deps)
+
+        def on_dep(_fut):
+            with self._out_lock:
+                item.deps_left -= 1
+                if item.deps_left != 0:
+                    return
+                self._out_q.append(("task", key, wire, item))
+                wake = len(self._out_q) == 1
+            if wake:
+                self.loop.call_soon_threadsafe(self._drain_out)
+
+        for d_oid in deps:
+            self.object_future(d_oid).add_done_callback(on_dep)
 
     def _send_gcs(self, msg: dict):
         if self.gcs is not None and not self.gcs.closed:

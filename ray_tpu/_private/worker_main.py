@@ -44,17 +44,23 @@ class Executor:
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self.actor_opts: dict = {}
-        # Sequential executor preserves actor method ordering; normal tasks
-        # also run here one at a time.
+        # Sequential executor preserves actor method ordering.
         self.pool = ThreadPoolExecutor(max_workers=1,
                                        thread_name_prefix="exec")
+        # Plain (non-actor) tasks run concurrently: the lease window
+        # pipelines several pushes onto this worker, and a BLOCKING task
+        # (collective rendezvous, sleep, IO) must not wedge the ones queued
+        # behind it — the thread pool gives queued tasks their own stack
+        # while the GIL keeps CPU-bound work effectively serial.
+        self.task_pool = ThreadPoolExecutor(max_workers=8,
+                                            thread_name_prefix="task")
         self.async_sem: Optional[asyncio.Semaphore] = None
-        self.current_task_thread: Optional[int] = None
-        self.current_task_id: Optional[bytes] = None
+        self.running_tasks: Dict[bytes, int] = {}  # tid -> thread ident
         self.cancelled: set = set()
         self.die_after_task = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._direct_q: deque = deque()  # (conn, msg) leased exec pushes
+        self._draining = False
         self.dags: Dict[str, dict] = {}  # compiled-DAG stage plans
         # TaskEventBuffer (reference: task_event_buffer.h:220): bounded local
         # buffer of profile events, flushed to the GCS periodically.
@@ -98,11 +104,10 @@ class Executor:
         elif t == "exec":
             # Leased direct task push (reference: PushTask straight to the
             # leased worker, core_worker.proto:444) — the reply carries the
-            # results back to the owner without a GCS hop. Tasks queued in
-            # the same window run as one executor batch (one thread-hop
-            # pair per batch, not per task).
+            # results back to the owner without a GCS hop.
             self._direct_q.append((conn, msg))
-            if len(self._direct_q) == 1:
+            if not self._draining:
+                self._draining = True
                 asyncio.get_running_loop().create_task(self._drain_execs())
         elif t == "cancel":
             self.cancel(msg["tid"], msg.get("force", False))
@@ -284,52 +289,63 @@ class Executor:
 
     async def _drain_execs(self):
         loop = asyncio.get_running_loop()
-        while self._direct_q:
-            batch = list(self._direct_q)
-            self._direct_q.clear()
-            replies = await loop.run_in_executor(
-                self.pool, self._exec_batch, [m for _, m in batch])
-            for (conn, msg), reply in zip(batch, replies):
-                if reply is None:  # skipped: worker is retiring
+        try:
+            while self._direct_q:
+                conn, msg = self._direct_q.popleft()
+                if self.die_after_task:
+                    # Runtime-env-tainted worker retires: unprocessed
+                    # pushes fail over to a fresh lease via the owner's
+                    # retry path.
                     continue
-                for r in reply["results"]:
-                    if r.get("shm"):
-                        self.worker.gcs.send({
-                            "t": "obj_put", "oid": r["oid"],
-                            "nbytes": r["nbytes"], "shm": True,
-                            "owner_wid": msg.get("owner")})
-                if not conn.closed:
-                    conn.reply(msg, reply)
-            if self.die_after_task:
-                self.flush_events()
-                await asyncio.sleep(0.01)
-                os._exit(0)
+                if (msg.get("opts") or {}).get("runtime_env"):
+                    # runtime_env setup mutates process-global state (env
+                    # vars, cwd, sys.path): run EXCLUSIVELY — drain
+                    # in-flight tasks first, and hold new ones until it
+                    # finishes (a tainting env then retires the worker
+                    # before anything else runs under the wrong env).
+                    while self.running_tasks:
+                        await asyncio.sleep(0.005)
+                    await loop.run_in_executor(
+                        self.task_pool, self._exec_one, conn, msg, loop)
+                    continue
+                self.task_pool.submit(self._exec_one, conn, msg, loop)
+        finally:
+            self._draining = False
 
-    def _exec_batch(self, msgs: List[dict]) -> List[Optional[dict]]:
-        out: List[Optional[dict]] = []
-        for msg in msgs:
-            if self.die_after_task:
-                # Runtime-env-tainted worker retires: unprocessed pushes
-                # fail over to a fresh lease via the owner's retry path.
-                out.append(None)
-                continue
-            tid = msg["tid"]
-            nret = msg.get("nret", 1)
-            opts = msg.get("opts") or {}
-            fn_name = opts.get("name", "unknown")
-            t0 = time.time()
-            try:
-                results = self._execute_sync(msg, tid, nret, opts)
-                err = any([r.pop("_err", False) for r in results])
-            except Exception as e:  # noqa: BLE001
-                results = self._error_results(tid, nret, fn_name, e)
-                for r in results:
-                    r.pop("_err", None)
-                err = True
-            t1 = time.time()
-            self.record_event(tid, fn_name, "task", t0, t1, not err)
-            out.append({"results": results, "err": err, "t0": t0, "t1": t1})
-        return out
+    def _send_exec_reply(self, conn, msg: dict, reply: dict):
+        """Runs on the IO loop: register shm results, reply to the owner."""
+        for r in reply["results"]:
+            if r.get("shm"):
+                self.worker.gcs.send({
+                    "t": "obj_put", "oid": r["oid"],
+                    "nbytes": r["nbytes"], "shm": True,
+                    "owner_wid": msg.get("owner")})
+        if not conn.closed:
+            conn.reply(msg, reply)
+        if self.die_after_task:
+            self.flush_events()
+            loop = asyncio.get_running_loop()
+            loop.call_later(0.01, os._exit, 0)
+
+    def _exec_one(self, conn, msg: dict, loop):
+        tid = msg["tid"]
+        nret = msg.get("nret", 1)
+        opts = msg.get("opts") or {}
+        fn_name = opts.get("name", "unknown")
+        t0 = time.time()
+        try:
+            results = self._execute_sync(msg, tid, nret, opts)
+            err = any([r.pop("_err", False) for r in results])
+        except Exception as e:  # noqa: BLE001
+            results = self._error_results(tid, nret, fn_name, e)
+            for r in results:
+                r.pop("_err", None)
+            err = True
+        t1 = time.time()
+        self.record_event(tid, fn_name, "task", t0, t1, not err)
+        loop.call_soon_threadsafe(
+            self._send_exec_reply, conn, msg,
+            {"results": results, "err": err, "t0": t0, "t1": t1})
 
     async def run_task(self, msg: dict):
         """GCS-dispatched execution (client-mode drivers and relays)."""
@@ -357,8 +373,7 @@ class Executor:
 
     def _execute_sync(self, msg: dict, tid: bytes, nret: int,
                       opts: dict) -> List[dict]:
-        self.current_task_thread = threading.get_ident()
-        self.current_task_id = tid
+        self.running_tasks[tid] = threading.get_ident()
         fn_name = opts.get("name", "unknown")
         try:
             self._apply_runtime_env(opts)
@@ -395,8 +410,7 @@ class Executor:
                     "data": data, "_err": True}]
             return self._error_results(tid, nret, fn_name, e)
         finally:
-            self.current_task_thread = None
-            self.current_task_id = None
+            self.running_tasks.pop(tid, None)
 
     @staticmethod
     def _split_returns(value: Any, nret: int) -> List[Any]:
@@ -468,27 +482,26 @@ class Executor:
 
     def _execute_method_sync(self, method, msg: dict, tid: bytes,
                              nret: int) -> List[dict]:
-        self.current_task_thread = threading.get_ident()
-        self.current_task_id = tid
+        self.running_tasks[tid] = threading.get_ident()
         try:
             args, kwargs = self._load_args(msg)
             value = method(*args, **kwargs)
             values = self._split_returns(value, nret)
             return self._pack_results(tid, values, register_shm=True)
         finally:
-            self.current_task_thread = None
-            self.current_task_id = None
+            self.running_tasks.pop(tid, None)
 
     # ---------------------------------------------------------------- misc
 
     def cancel(self, tid: bytes, force: bool):
         if force:
             os._exit(1)
-        if self.current_task_id == tid and self.current_task_thread:
+        ident = self.running_tasks.get(tid)
+        if ident:
             # Best-effort interrupt of the executing thread (the reference
             # raises KeyboardInterrupt in the worker the same way).
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_ulong(self.current_task_thread),
+                ctypes.c_ulong(ident),
                 ctypes.py_object(KeyboardInterrupt))
 
 
@@ -523,18 +536,44 @@ async def amain(args):
             executor.flush_events()
 
     worker.gcs_address = args.gcs
-    reader, writer = await protocol.connect(args.gcs)
-    worker.gcs = protocol.Connection(
-        reader, writer, handler=worker._on_gcs_push,
-        on_close=lambda: stop.set())
-    worker.gcs.start()
-    reply = await worker.gcs.request({
-        "t": "hello", "role": "worker",
-        "worker_id": worker.worker_id.binary(),
-        "node_id": worker.node_id,
-        "addr": "unix:" + listen_path,
-        "pid": os.getpid(),
-    }, timeout=30)
+
+    async def connect_gcs() -> dict:
+        reader, writer = await protocol.connect(args.gcs)
+        worker.gcs = protocol.Connection(
+            reader, writer, handler=worker._on_gcs_push,
+            on_close=on_gcs_close)
+        worker.gcs.start()
+        hello = {
+            "t": "hello", "role": "worker",
+            "worker_id": worker.worker_id.binary(),
+            "node_id": worker.node_id,
+            "addr": "unix:" + listen_path,
+            "pid": os.getpid(),
+        }
+        if executor.actor_id is not None:
+            # Resync after a GCS restart: re-claim our live actor so the
+            # restored record binds to this worker instead of restarting
+            # (reference: worker resync after GCS failover).
+            hello["actor_id"] = executor.actor_id.binary()
+        return await worker.gcs.request(hello, timeout=30)
+
+    def on_gcs_close():
+        if not stop.is_set():
+            asyncio.get_running_loop().create_task(reconnect_gcs())
+
+    async def reconnect_gcs():
+        for _ in range(75):
+            if stop.is_set():
+                return
+            await asyncio.sleep(0.2)
+            try:
+                await connect_gcs()
+                return
+            except (OSError, ConnectionError, asyncio.TimeoutError):
+                continue
+        stop.set()
+
+    reply = await connect_gcs()
     worker.session_name = reply["session"]
     worker.session_dir = reply["session_dir"]
     from .object_store import make_store

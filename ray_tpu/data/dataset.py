@@ -102,6 +102,95 @@ def _pipeline_task(source, ops):
     return _run_pipeline(source, ops)
 
 
+@ray_tpu.remote
+class _PoolWorker:
+    """Stateful map worker (reference: ``ActorPoolMapOperator``,
+    ``execution/operators/actor_pool_map_operator.py``): callable-class
+    UDFs are constructed ONCE here and reused across blocks — the pattern
+    for expensive-init transforms (model weights, tokenizers)."""
+
+    def __init__(self, ops: List[_Op]):
+        self._ops = ops
+        for op in self._ops:
+            if op.kw.get("udf_cls") is not None:
+                op.fn = op.kw["udf_cls"](
+                    *op.kw.get("fn_args", ()), **op.kw.get("fn_kwargs", {}))
+
+    def run(self, source):
+        return _run_pipeline(source, self._ops)
+
+
+def _resolved_nbytes(ref) -> int:
+    """Size of an already-resolved block ref (0 if unknown) — feeds the
+    streaming executor's memory-budget window."""
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        fut = global_worker()._object_futures.get(ref.id)
+        if fut is not None and fut.done():
+            where, payload = fut.result()
+            return payload if where == "shm" else len(payload)
+    except Exception:
+        pass
+    return 0
+
+
+# ------------------------------------------------------- exchange tasks
+# All-to-all ops (repartition / shuffle / sort) run as two distributed
+# stages — a partitioning map per input block and a combining reduce per
+# output partition — so no process ever materializes the whole dataset
+# (reference: ``data/_internal/planner/exchange/`` push-based shuffle;
+# the round-1 driver-side ``_concat_all`` versions were driver-memory-bound).
+
+
+@ray_tpu.remote
+def _exchange_split(source, ops, n, how, seed, cuts, key):
+    """Partition one (piped) block into ``n`` sub-blocks."""
+    block = _run_pipeline(source, ops)
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    if rows == 0:
+        return [block.slice(0, 0)] * n if n > 1 else block.slice(0, 0)
+    if how == "repartition":
+        idx = np.arange(rows)
+        parts = [block.take(idx[i::n]) for i in range(n)]
+    elif how == "shuffle":
+        rng = np.random.RandomState(seed)
+        assign = rng.randint(0, n, size=rows)
+        parts = [block.take(np.nonzero(assign == i)[0]) for i in range(n)]
+    elif how == "sort":
+        col = acc.to_numpy()[key]
+        assign = np.searchsorted(np.asarray(cuts), col, side="right")
+        parts = [block.take(np.nonzero(assign == i)[0]) for i in range(n)]
+    else:
+        raise ValueError(how)
+    return parts if n > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _exchange_reduce(how, seed, key, descending, *parts):
+    """Combine one output partition's sub-blocks."""
+    out = BlockAccessor.concat([to_block(p) for p in parts])
+    if how == "shuffle":
+        rng = np.random.RandomState(seed)
+        out = out.take(rng.permutation(out.num_rows))
+    elif how == "sort":
+        out = out.sort_by(
+            [(key, "descending" if descending else "ascending")])
+    return out
+
+
+@ray_tpu.remote
+def _sample_keys(source, ops, key, k):
+    """Sample up to k key values from one block (sort range-partitioning)."""
+    block = _run_pipeline(source, ops)
+    col = BlockAccessor(block).to_numpy()[key]
+    if len(col) <= k:
+        return np.asarray(col)
+    idx = np.random.RandomState(0).choice(len(col), size=k, replace=False)
+    return np.asarray(col)[idx]
+
+
 # ---------------------------------------------------------------- dataset
 
 
@@ -116,18 +205,39 @@ class Dataset:
         self._sources = sources
         self._ops = ops or []
         self._remote_args = ray_remote_args or {}
+        # Set when an op carries a callable-class UDF (actor-pool compute).
+        self._actor_pool_size: Optional[int] = None
 
     # --------------------------------------------------------- transforms
 
     def _with_op(self, op: _Op) -> "Dataset":
-        return Dataset(self._sources, self._ops + [op], self._remote_args)
+        ds = Dataset(self._sources, self._ops + [op], self._remote_args)
+        ds._actor_pool_size = self._actor_pool_size
+        return ds
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
                     concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
                     **ray_remote_args) -> "Dataset":
-        """Reference: ``Dataset.map_batches`` (``data/dataset.py:394``)."""
-        ds = self._with_op(_Op("map_batches", fn, batch_size, batch_format))
+        """Reference: ``Dataset.map_batches`` (``data/dataset.py:394``).
+
+        A callable CLASS ``fn`` selects the actor-pool compute strategy
+        (reference: ``ActorPoolMapOperator``): ``concurrency`` actors are
+        created, the class is constructed once per actor, and blocks
+        stream through the pool — the shape for expensive-init UDFs.
+        """
+        if isinstance(fn, type):
+            op = _Op("map_batches", None, batch_size, batch_format,
+                     udf_cls=fn, fn_args=fn_constructor_args,
+                     fn_kwargs=fn_constructor_kwargs or {})
+            ds = self._with_op(op)
+            ds._actor_pool_size = concurrency or 2
+        else:
+            ds = self._with_op(
+                _Op("map_batches", fn, batch_size, batch_format))
+            ds._actor_pool_size = self._actor_pool_size
         if ray_remote_args:
             ds._remote_args = {**self._remote_args, **ray_remote_args}
         return ds
@@ -155,15 +265,37 @@ class Dataset:
 
     # ------------------------------------------------------- execution
 
+    def _memory_budget(self) -> int:
+        """Bytes of object store this stream may keep in flight
+        (reference: backpressure policies bounding streaming execution by
+        store usage, ``execution/backpressure_policy/``)."""
+        import os
+
+        env = os.environ.get("RAY_TPU_DATA_MEMORY_LIMIT")
+        if env:
+            return int(env)
+        try:
+            cap = int(ray_tpu.cluster_resources().get(
+                "object_store_memory", 0))
+        except Exception:
+            cap = 0
+        return max(64 << 20, cap // 4)
+
     def _stream_refs(self, sources=None) -> Iterator[ray_tpu.ObjectRef]:
         """Streaming executor: bounded in-flight fused tasks, yielded in
-        submission order (backpressure = window size)."""
+        submission order. Backpressure is the min of a CPU window and a
+        store-memory budget (in-flight blocks × observed block size)."""
         sources = self._sources if sources is None else sources
+        if self._actor_pool_size:
+            yield from self._stream_refs_actor_pool(sources)
+            return
         try:
             cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
         except Exception:
             cpus = 4
-        window = max(2, cpus * 2)
+        cpu_window = max(2, cpus * 2)
+        budget = self._memory_budget()
+        est_block = 0  # rolling estimate of produced block bytes
         task = _pipeline_task
         if self._remote_args:
             opts = {k: v for k, v in self._remote_args.items()
@@ -175,6 +307,9 @@ class Dataset:
         it = iter(sources)
         exhausted = False
         while pending or not exhausted:
+            window = cpu_window
+            if est_block > 0:
+                window = max(2, min(cpu_window, budget // est_block))
             while not exhausted and len(pending) < window:
                 try:
                     src = next(it)
@@ -188,7 +323,45 @@ class Dataset:
             # reference's ordered output bundles); the window still keeps
             # `window` tasks in flight, so pipelining is unaffected.
             ray_tpu.wait(pending[:1], num_returns=1, timeout=None)
-            yield pending.pop(0)
+            ref = pending.pop(0)
+            nbytes = _resolved_nbytes(ref)
+            if nbytes:
+                est_block = (est_block + nbytes) // 2 if est_block else nbytes
+            yield ref
+
+    def _stream_refs_actor_pool(self, sources) -> Iterator[ray_tpu.ObjectRef]:
+        """Actor-pool compute: blocks stream through N stateful actors,
+        bounded in-flight per actor (reference: ActorPoolMapOperator)."""
+        n = self._actor_pool_size or 2
+        opts = {k: v for k, v in self._remote_args.items()
+                if k in ("num_cpus", "num_tpus", "resources")}
+        pool = [_PoolWorker.options(**opts).remote(self._ops)
+                for _ in range(n)]
+        try:
+            per_actor = 2
+            pending: List[ray_tpu.ObjectRef] = []
+            it = iter(sources)
+            exhausted = False
+            i = 0
+            while pending or not exhausted:
+                while not exhausted and len(pending) < n * per_actor:
+                    try:
+                        src = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(pool[i % n].run.remote(src))
+                    i += 1
+                if not pending:
+                    break
+                ray_tpu.wait(pending[:1], num_returns=1, timeout=None)
+                yield pending.pop(0)
+        finally:
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
 
     def materialize(self) -> "MaterializedDataset":
         blocks = ray_tpu.get(list(self._stream_refs()))
@@ -204,34 +377,81 @@ class Dataset:
             [to_block(b) for b in self._all_blocks()])
 
     # ---------------------------------------------------- all-to-all ops
+    # Two-stage distributed exchange (split per input block, reduce per
+    # output partition): the driver holds only REFS, never rows — unlike
+    # round 1's driver-side concat, datasets larger than any single
+    # process's memory stream through workers block by block.
 
-    def repartition(self, num_blocks: int) -> "Dataset":
-        blocks = self._all_blocks()
-        big = BlockAccessor.concat(blocks)
-        n = big.num_rows
-        per = math.ceil(n / num_blocks) if num_blocks else n
-        out = [big.slice(i * per, min(per, n - i * per))
-               for i in range(num_blocks) if i * per < n or i == 0]
+    def _exchange_inputs(self):
+        """(sources, ops) for exchange stages. Class-UDF ops only exist
+        inside pool actors — run the pipeline through the pool first and
+        exchange the materialized block refs."""
+        if self._actor_pool_size:
+            return list(self._stream_refs()), []
+        return self._sources, self._ops
+
+    def _exchange(self, n: int, how: str, seed: Optional[int] = None,
+                  cuts=None, key: Optional[str] = None,
+                  descending: bool = False, inputs=None) -> "Dataset":
+        n = max(int(n), 1)
+        sources, ops = inputs if inputs is not None \
+            else self._exchange_inputs()
+        split = _exchange_split.options(num_returns=n)
+        sub_refs: List[List[ray_tpu.ObjectRef]] = []
+        for b_idx, src in enumerate(sources):
+            # Distinct split seed per block: one shared seed would draw the
+            # SAME assignment stream in every block, co-partitioning rows
+            # at equal offsets (a biased shuffle).
+            blk_seed = None if seed is None else seed + b_idx * 1000003
+            refs = split.remote(src, ops, n, how, blk_seed, cuts, key)
+            if n == 1:
+                refs = [refs]
+            sub_refs.append(refs)
+        out = []
+        for i in range(n):
+            parts = [refs[i] for refs in sub_refs]
+            if not parts:
+                continue
+            out.append(_exchange_reduce.remote(
+                how, None if seed is None else seed + i, key, descending,
+                *parts))
         return Dataset(out, [], self._remote_args)
 
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._exchange(num_blocks, "repartition")
+
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        blocks = self._all_blocks()
-        big = BlockAccessor.concat(blocks)
-        rng = np.random.RandomState(seed)
-        perm = rng.permutation(big.num_rows)
-        shuffled = big.take(perm)
-        k = max(len(blocks), 1)
-        per = math.ceil(big.num_rows / k)
-        out = [shuffled.slice(i * per, per) for i in range(k)
-               if i * per < big.num_rows]
-        return Dataset(out or [shuffled], [], self._remote_args)
+        k = max(len(self._sources), 1)
+        return self._exchange(
+            k, "shuffle",
+            seed=int(seed) if seed is not None
+            else int(np.random.randint(0, 2**31)))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        blocks = self._all_blocks()
-        big = BlockAccessor.concat(blocks)
-        order = "descending" if descending else "ascending"
-        out = big.sort_by([(key, order)])
-        return Dataset([out], [], self._remote_args)
+        k = max(len(self._sources), 1)
+        if k == 1:
+            return self._exchange(1, "sort", key=key, descending=descending,
+                                  cuts=[])
+        # Sample-based range partitioning: per-block key samples pick k-1
+        # cutpoints; only the (tiny) samples ever reach the driver.
+        # Inputs computed ONCE so an actor-pool pipeline is not re-run for
+        # the sampling pass.
+        inputs = self._exchange_inputs()
+        s_sources, s_ops = inputs
+        samples = ray_tpu.get([
+            _sample_keys.remote(src, s_ops, key, 64)
+            for src in s_sources])
+        allk = np.sort(np.concatenate([np.asarray(s) for s in samples]))
+        if len(allk) == 0:
+            return self._exchange(1, "sort", key=key, descending=descending,
+                                  cuts=[], inputs=inputs)
+        idx = (np.arange(1, k) * len(allk)) // k
+        cuts = allk[idx].tolist()
+        ds = self._exchange(k, "sort", key=key, descending=descending,
+                            cuts=cuts, inputs=inputs)
+        if descending:
+            ds._sources = list(reversed(ds._sources))
+        return ds
 
     def union(self, *others: "Dataset") -> "Dataset":
         sources = list(self._sources)
@@ -355,33 +575,43 @@ class Dataset:
     def to_pandas(self):
         return self._concat_all().to_pandas()
 
-    # aggregations
+    # aggregations — streamed block-at-a-time (constant driver memory)
+
+    def _iter_columns(self, on: str):
+        for ref in self._stream_refs():
+            block = ray_tpu.get(ref)
+            col = BlockAccessor(block).to_numpy()[on]
+            if len(col):
+                yield col
+
     def sum(self, on: str):
-        return builtins.sum(
-            float(BlockAccessor(b).to_numpy()[on].sum())
-            for b in self._all_blocks())
+        return builtins.sum(float(c.sum()) for c in self._iter_columns(on))
 
     def min(self, on: str):
-        return builtins.min(
-            BlockAccessor(b).to_numpy()[on].min() for b in self._all_blocks())
+        return builtins.min(c.min() for c in self._iter_columns(on))
 
     def max(self, on: str):
-        return builtins.max(
-            BlockAccessor(b).to_numpy()[on].max() for b in self._all_blocks())
+        return builtins.max(c.max() for c in self._iter_columns(on))
 
     def mean(self, on: str):
         tot, n = 0.0, 0
-        for b in self._all_blocks():
-            col = BlockAccessor(b).to_numpy()[on]
+        for col in self._iter_columns(on):
             tot += float(col.sum())
             n += len(col)
         return tot / max(n, 1)
 
     def std(self, on: str, ddof: int = 1):
-        import pyarrow.compute as pc
-
-        return float(pc.stddev(self._concat_all().column(on),
-                               ddof=ddof).as_py())
+        # Streaming two-pass-free variance via (n, sum, sumsq) combine.
+        n, s, ss = 0, 0.0, 0.0
+        for col in self._iter_columns(on):
+            col = col.astype(np.float64)
+            n += len(col)
+            s += float(col.sum())
+            ss += float((col * col).sum())
+        if n <= ddof:
+            return float("nan")
+        var = (ss - s * s / n) / (n - ddof)
+        return float(math.sqrt(max(var, 0.0)))
 
     # ---------------------------------------------------------- writing
 

@@ -27,6 +27,9 @@ class _Aggregator:
     """Batches rollout refs into a learner-ready train batch (reference:
     IMPALA aggregation workers, ``impala.py:637-643``)."""
 
+    def ping(self) -> bool:
+        return True
+
     def build_batch(self, *rollouts) -> Dict[str, np.ndarray]:
         keys = ("obs", "actions", "logp", "rewards", "dones", "values",
                 "mask")
@@ -90,13 +93,20 @@ class IMPALA(Algorithm):
                     [p["bootstrap_value"] for p in parts], axis=0)
         except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError,
                 ray_tpu.ObjectLostError):
-            # A sampler died mid-rollout: replace the dead runner(s), drop
-            # this round (FaultAwareApply restart semantics).
+            # A sampler or aggregator died mid-round: replace the dead
+            # actor(s), drop this round (FaultAwareApply restart semantics).
             for i, ref in rollouts:
                 try:
                     ray_tpu.get(ref, timeout=1)
                 except Exception:
                     self.env_runner_group.restart_runner(i)
+            # Dead aggregators would otherwise poison every later round the
+            # round-robin lands on them.
+            for j, a in enumerate(self.aggregators):
+                try:
+                    ray_tpu.get(a.ping.remote(), timeout=5)
+                except Exception:
+                    self.aggregators[j] = _Aggregator.remote()
             return {"learner": {}, "num_env_steps_sampled": 0}
         self._refill()  # keep samplers busy while we update
 

@@ -36,7 +36,8 @@ class BC:
 
     def __init__(self, obs_dim: int, num_actions: int,
                  hidden=(64, 64), lr: float = 1e-3, seed: int = 0,
-                 beta: float = 0.0, vf_coeff: float = 1.0):
+                 beta: float = 0.0, vf_coeff: float = 1.0,
+                 gamma: float = 0.99):
         import jax
         import optax
 
@@ -50,6 +51,7 @@ class BC:
         # beta=0 => plain BC; beta>0 => MARWIL advantage weighting.
         self.beta = beta
         self.vf_coeff = vf_coeff
+        self.gamma = gamma
         self._step = self._make_step()
         self.iteration = 0
 
@@ -95,35 +97,54 @@ class BC:
         return step
 
     @staticmethod
-    def _batch_from_rows(rows: Dict[str, np.ndarray],
-                         need_returns: bool) -> Dict[str, np.ndarray]:
+    def _batch_from_rows(rows: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         batch = {
             "obs": np.asarray([np.asarray(o, np.float32)
                                for o in rows["obs"]]),
             "actions": np.asarray(rows["action"], np.int64),
         }
-        if need_returns:
-            if "return" in rows:
-                batch["returns"] = np.asarray(rows["return"], np.float32)
-            else:
-                # Monte-carlo returns from (reward, done) row order.
-                r = np.asarray(rows["reward"], np.float32)
-                d = np.asarray(rows["done"], bool)
-                ret = np.zeros_like(r)
-                acc = 0.0
-                for i in range(len(r) - 1, -1, -1):
-                    acc = r[i] + 0.99 * (0.0 if d[i] else acc)
-                    ret[i] = acc
-                batch["returns"] = ret
+        if "return" in rows:
+            batch["returns"] = np.asarray(rows["return"], np.float32)
         return batch
+
+    def _precompute_returns(self, ds, batch_size: int) -> Optional[np.ndarray]:
+        """Monte-carlo returns over the FULL dataset in row order.
+
+        Computed once, not per ``iter_batches`` chunk: episodes spanning
+        chunk boundaries would otherwise get truncated returns (the
+        accumulator must survive from the last row of the dataset back to
+        the first).
+        """
+        rewards, dones = [], []
+        for rows in ds.iter_batches(batch_size=batch_size,
+                                    batch_format="numpy"):
+            if "return" in rows:
+                return None  # dataset ships precomputed returns
+            rewards.append(np.asarray(rows["reward"], np.float32))
+            dones.append(np.asarray(rows["done"], bool))
+        r = np.concatenate(rewards) if rewards else np.zeros(0, np.float32)
+        d = np.concatenate(dones) if dones else np.zeros(0, bool)
+        ret = np.zeros_like(r)
+        acc = 0.0
+        for i in range(len(r) - 1, -1, -1):
+            acc = r[i] + self.gamma * (0.0 if d[i] else acc)
+            ret[i] = acc
+        return ret
 
     def train_on_dataset(self, ds, *, epochs: int = 1,
                          batch_size: int = 256) -> Dict[str, float]:
         stats: Dict[str, Any] = {}
+        returns_all = (self._precompute_returns(ds, batch_size)
+                       if self.beta > 0.0 else None)
         for _ in range(epochs):
+            offset = 0
             for rows in ds.iter_batches(batch_size=batch_size,
                                         batch_format="numpy"):
-                batch = self._batch_from_rows(rows, self.beta > 0.0)
+                batch = self._batch_from_rows(rows)
+                n = len(batch["actions"])
+                if returns_all is not None:
+                    batch["returns"] = returns_all[offset:offset + n]
+                offset += n
                 self.params, self.opt_state, stats = self._step(
                     self.params, self.opt_state, batch)
                 self.iteration += 1

@@ -27,6 +27,7 @@ same contract NCCL/Gloo impose.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -153,10 +154,23 @@ def init_collective_group(world_size: int, rank: int,
         coord = ray_tpu.get_actor(name)
     except ValueError:
         try:
-            coord = ray_tpu.remote(_Coordinator).options(
+            ray_tpu.remote(_Coordinator).options(
                 name=name, lifetime="detached", num_cpus=0).remote(world_size)
         except Exception:
-            coord = ray_tpu.get_actor(name)  # lost the creation race
+            pass  # lost the creation race — resolve below
+        # Re-resolve through the name registry regardless of who won the
+        # creation race: racing ranks must all converge on the REGISTERED
+        # instance, not on their own provisional handle, or the rendezvous
+        # deadlocks split across two coordinators.
+        deadline = time.time() + 30
+        while True:
+            try:
+                coord = ray_tpu.get_actor(name)
+                break
+            except ValueError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
     _groups[group_name] = _GroupState(group_name, world_size, rank, coord)
 
 

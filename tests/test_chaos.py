@@ -23,10 +23,12 @@ def test_tasks_survive_worker_killer(fresh_cluster):
 
     @ray_tpu.remote(max_retries=10)
     def slow_square(x):
-        time.sleep(0.05)
+        # Long enough that the workload spans several kill intervals even
+        # with workers running queued tasks concurrently.
+        time.sleep(0.3)
         return x * x
 
-    killer = chaos.get_and_run_worker_killer(kill_interval_s=0.2,
+    killer = chaos.get_and_run_worker_killer(kill_interval_s=0.15,
                                              max_kills=15)
     refs = [slow_square.remote(i) for i in range(200)]
     out = ray_tpu.get(refs, timeout=120)
