@@ -405,6 +405,22 @@ void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
   pthread_mutex_unlock(&h->hdr->mutex);
 }
 
+// Populated watermark: bytes from arena start whose tmpfs pages are
+// known-committed (the head's populate sweep advances it). Clients skip
+// their create-time MADV_POPULATE_WRITE inside the watermark — faulting
+// during the copy is cheaper than re-walking present pages.
+void rtpu_store_set_populated(void* handle, uint64_t bytes) {
+  Header* hdr = static_cast<Handle*>(handle)->hdr;
+  if (lock(hdr) == 0) {
+    if (bytes > hdr->prefault_cursor) hdr->prefault_cursor = bytes;
+    pthread_mutex_unlock(&hdr->mutex);
+  }
+}
+
+uint64_t rtpu_store_get_populated(void* handle) {
+  return static_cast<Handle*>(handle)->hdr->prefault_cursor;
+}
+
 uint8_t* rtpu_store_base(void* handle) {
   return static_cast<Handle*>(handle)->base;
 }

@@ -351,7 +351,11 @@ class GcsServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self._shutdown_event = asyncio.Event()
         self._sched_wakeup = asyncio.Event()
-        self._owned_objects: Dict[int, Set[ObjectID]] = {}  # id(client) -> oids
+        # Owner key -> registered oids. Keyed by the owner's STABLE
+        # worker_id (falling back to connection identity for anonymous
+        # clients) so a reconnecting owner keeps its registrations and its
+        # eventual exit dereferences them.
+        self._owned_objects: Dict[Any, Set[ObjectID]] = {}
         self._client_by_wid: Dict[bytes, ClientConn] = {}
         # Observability stores (reference: GcsTaskManager task-event store
         # gcs_task_manager.h:86; metrics agent metrics_agent.py). Both bounded.
@@ -400,10 +404,17 @@ class GcsServer:
                 self.log = None
 
     def _make_snapshot(self) -> dict:
+        actors = []
+        for r in self.actors.values():
+            if r.state == A_DEAD:
+                continue
+            m = {k: v for k, v in r.msg.items() if k != "i"}
+            if r.owner_wid is not None:
+                m["owner_wid"] = r.owner_wid
+            actors.append(m)
         return {
             "kv": [[ns, k, v] for (ns, k), v in self.kv.items()],
-            "actors": [r.msg for r in self.actors.values()
-                       if r.state != A_DEAD],
+            "actors": actors,
             "pgs": [{"pgid": p.pg_id.binary(), "bundles": p.bundles,
                      "strategy": p.strategy, "name": p.name}
                     for p in self.pgs.values()],
@@ -706,8 +717,16 @@ class GcsServer:
         for key in [k for k in self.metrics if k[0] == sender]:
             del self.metrics[key]
         if client.role == "worker" and client.worker_id is not None:
+            # A half-open socket can die AFTER the worker already
+            # reconnected and re-registered: the stale conn's disconnect
+            # must not kill the fresh registration (split-brain actor
+            # restarts otherwise).
+            w = self.workers.get(client.worker_id)
+            if w is not None and w.conn is not client.conn:
+                return
             # Objects owned by this worker (from its nested submissions).
-            for oid in self._owned_objects.pop(id(client), set()):
+            for oid in self._owned_objects.pop(self._owner_key(client),
+                                               set()):
                 entry = self.objects.get(oid)
                 if entry is not None:
                     entry.refcount -= 1
@@ -764,6 +783,12 @@ class GcsServer:
 
     # ------------------------------------------------------------- objects
 
+    @staticmethod
+    def _owner_key(client: "ClientConn"):
+        if client.worker_id is not None:
+            return client.worker_id.binary()
+        return id(client)
+
     def _obj(self, object_id: ObjectID) -> ObjectEntry:
         entry = self.objects.get(object_id)
         if entry is None:
@@ -814,7 +839,8 @@ class GcsServer:
         entry.owner = owner
         if client.node_id is not None and msg.get("shm"):
             entry.holders.add(client.node_id.binary())
-        self._owned_objects.setdefault(id(owner), set()).add(oid)
+        self._owned_objects.setdefault(self._owner_key(owner),
+                                       set()).add(oid)
         self._mark_ready(entry, msg["nbytes"], msg.get("data"),
                          msg.get("shm", False))
         if msg.get("data") is not None:
@@ -1080,7 +1106,8 @@ class GcsServer:
         for oid in record.returns:
             entry = self._obj(oid)
             entry.refcount += 1
-            self._owned_objects.setdefault(id(client), set()).add(oid)
+            self._owned_objects.setdefault(self._owner_key(client),
+                                           set()).add(oid)
             if record.retries_left > 0:
                 entry.producing_task = {"msg": msg, "owner": client}
         self.pending.append(record)
@@ -1129,37 +1156,38 @@ class GcsServer:
                 node.idle_workers.append(worker.worker_id)
 
     async def _h_task_notes(self, client, msg):
-        """Batched task-state reports from owners (direct-path tasks).
+        """Batched task-completion reports from owners (direct-path tasks).
 
         Keeps the observability table (state API / dashboard / summaries)
         populated even though leased-path tasks never route through the
-        GCS scheduler. Reference: task events flowing to GcsTaskManager
-        (gcs_task_manager.h:86)."""
-        for n in msg["notes"]:
-            tid = TaskID(n["tid"])
-            rec = self.tasks.get(tid)
+        GCS scheduler. Positional rows — (tid, name, error, created,
+        start, end, wid) — because this handler runs once per completed
+        task on a busy head. Reference: task events flowing to
+        GcsTaskManager (gcs_task_manager.h:86)."""
+        tasks = self.tasks
+        counters = self.counters
+        for tid_b, name, error, created, start, end, wid in msg["n"]:
+            tid = TaskID(tid_b)
+            rec = tasks.get(tid)
             if rec is None:
                 rec = ObsTaskRecord(tid)
-                self.tasks[tid] = rec
-                self.counters["tasks_submitted"] += 1
-            rec.name = n.get("name", rec.name)
-            rec.state = n.get("state", rec.state)
-            rec.error = bool(n.get("error", rec.error))
-            rec.ts_created = n.get("created", rec.ts_created)
-            rec.ts_running = n.get("start", rec.ts_running)
-            rec.ts_done = n.get("end", rec.ts_done)
-            if n.get("res"):
-                rec.resources = n["res"]
-            if n.get("wid"):
-                rec.worker_id = WorkerID(n["wid"])
+                tasks[tid] = rec
+                counters["tasks_submitted"] += 1
+            rec.name = name
+            rec.state = "done"
+            rec.error = bool(error)
+            rec.ts_created = created
+            rec.ts_running = start
+            rec.ts_done = end
+            if wid:
+                rec.worker_id = WorkerID(wid)
                 w = self.workers.get(rec.worker_id)
                 if w is not None:
                     rec.node_id = w.node_id
-            if rec.state == "done":
-                self.counters["tasks_finished"] += 1
-                if rec.error:
-                    self.counters["tasks_failed"] += 1
-                self._gc_done_task(rec)
+            counters["tasks_finished"] += 1
+            if rec.error:
+                counters["tasks_failed"] += 1
+            self._gc_done_task(rec)
 
     def _wake_scheduler(self):
         self._sched_wakeup.set()
@@ -1480,7 +1508,7 @@ class GcsServer:
                 asyncio.get_running_loop().create_task(
                     self._kill_actor(actor, no_restart=True,
                                      cause="owner driver exited"))
-        for oid in self._owned_objects.pop(id(client), set()):
+        for oid in self._owned_objects.pop(self._owner_key(client), set()):
             entry = self.objects.get(oid)
             if entry is not None:
                 entry.refcount -= 1
@@ -1505,6 +1533,9 @@ class GcsServer:
         wal_msg = {k: v for k, v in msg.items() if k != "i"}
         if client.worker_id is not None:
             wal_msg["owner_wid"] = client.worker_id.binary()
+            # On the record too: snapshot compaction serializes records,
+            # and owner re-linking after a restart matches by owner_wid.
+            record.owner_wid = client.worker_id.binary()
         self._log_append("actor", wal_msg)
         client.conn.reply(msg, {"ok": True})
         self._try_place_actor(record)
@@ -1733,6 +1764,36 @@ class GcsServer:
                         break
                 else:
                     return False
+        elif strategy == "STRICT_ICI":
+            # All bundles confined to ONE TPU slice (ICI domain) so the
+            # group's collectives ride ICI, never DCN — the mesh-aware
+            # strategy SURVEY §7 step 3 calls for (slice identity comes
+            # from the accelerator manager's TPU-slice-* markers,
+            # accelerators/tpu.py). Hosts without a slice marker count as
+            # single-host domains.
+            domains: Dict[str, List[NodeInfo]] = {}
+            for n in nodes:
+                dom = next((k for k in n.total
+                            if k.startswith("TPU-slice-")),
+                           f"host-{n.node_id.hex()}")
+                domains.setdefault(dom, []).append(n)
+            for dom in sorted(domains):
+                members = domains[dom]
+                trial_staged = {n.node_id: dict(staged[n.node_id])
+                                for n in members}
+                trial: List[Optional[NodeID]] = []
+                for b in record.bundles:
+                    for n in members:
+                        if self._stage(trial_staged[n.node_id], b):
+                            trial.append(n.node_id)
+                            break
+                    else:
+                        break
+                if len(trial) == len(record.bundles):
+                    placement = trial
+                    break
+            else:
+                return False
         else:  # PACK / SPREAD: best-effort
             order = nodes if strategy == "PACK" else nodes[::-1]
             for idx, b in enumerate(record.bundles):
@@ -1794,8 +1855,24 @@ class GcsServer:
 
     async def _h_task_events(self, client, msg):
         """Profile events pushed from worker TaskEventBuffers
-        (reference: task_event_buffer.h:220)."""
-        self.task_events.extend(msg["events"])
+        (reference: task_event_buffer.h:220). Stored raw (positional rows
+        + batch header); decoded to dicts only when the state API reads
+        them — the hot path here is append-only."""
+        wid = bytes(msg.get("wid") or b"")
+        nid = bytes(msg.get("nid") or b"")
+        pid = msg.get("pid", 0)
+        for row in msg["ev"]:
+            self.task_events.append((wid, nid, pid, row))
+
+    @staticmethod
+    def _event_to_dict(ev) -> dict:
+        wid, nid, pid, (tid, name, kind, start, end, ok) = ev
+        return {
+            "task_id": TaskID(tid).hex() if len(tid) >= 8 else "",
+            "name": name, "kind": kind,
+            "worker_id": wid.hex(), "node_id": nid.hex(), "pid": pid,
+            "start": start, "end": end, "ok": bool(ok),
+        }
 
     async def _h_metrics_push(self, client, msg):
         sender = (client.worker_id.hex() if client.worker_id
@@ -1929,7 +2006,7 @@ class GcsServer:
                             "placement": [nid.hex() if nid else ""
                                           for nid in p.placement]})
         elif kind == "task_events":
-            out = list(self.task_events)
+            out = [self._event_to_dict(e) for e in self.task_events]
         else:
             client.conn.reply(msg, {"ok": False,
                                     "err": f"unknown kind {kind!r}"})

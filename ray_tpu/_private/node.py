@@ -203,16 +203,10 @@ class NodeAgent:
         reference: raylets resyncing after GCS failover,
         test_gcs_fault_tolerance.py). Gives up after ~15 s and stops the
         node, which matches losing the head permanently."""
-        for _ in range(75):
-            if self.stopped.is_set():
-                return
-            await asyncio.sleep(0.2)
-            try:
-                await self._connect_and_register()
-                return
-            except (OSError, ConnectionError, asyncio.TimeoutError):
-                continue
-        self.stopped.set()
+        ok = await protocol.reconnect_with_retry(
+            self._connect_and_register, should_stop=self.stopped.is_set)
+        if not ok and not self.stopped.is_set():
+            self.stopped.set()
 
     async def _probe_tpu(self):
         try:
@@ -285,6 +279,35 @@ class NodeAgent:
                     p.kill()
 
 
+async def _orphan_watch(get_gcs):
+    """Supervised head: exit once the spawning driver is gone (PPID
+    reparented) and no drivers are connected."""
+    spawner_ppid = os.getppid()
+    while True:
+        await asyncio.sleep(5.0)
+        if os.getppid() == spawner_ppid:
+            continue
+        gcs = get_gcs()
+        if any(not d.conn.closed for d in gcs.drivers):
+            continue
+        await asyncio.sleep(10.0)  # grace: a driver may be reconnecting
+        gcs = get_gcs()
+        if os.getppid() != spawner_ppid and not any(
+                not d.conn.closed for d in gcs.drivers):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "orphaned head (spawner died, no drivers): shutting down")
+            for w in gcs.workers.values():
+                if not w.conn.closed:
+                    try:
+                        w.conn.send({"t": "exit"})
+                    except ConnectionError:
+                        pass
+            gcs._shutdown_event.set()
+            return
+
+
 async def head_amain(args):
     from .gcs import GcsServer
 
@@ -324,6 +347,14 @@ async def head_amain(args):
                 num_initial_workers=args.num_initial_workers,
                 probe_tpu=not args.no_probe_tpu)
             await agent.start()
+            if args.supervised:
+                # Orphan cleanup (reference: subreaper, src/ray/util/
+                # subreaper.cc): a head spawned BY a driver must not
+                # outlive it — if that driver dies without a clean
+                # shutdown (SIGKILL, test-runner timeout), PPID reparents
+                # and we tear the session down once no drivers remain.
+                asyncio.get_running_loop().create_task(
+                    _orphan_watch(lambda: gcs))
         if not ready_written:
             # Signal readiness to the parent driver. Atomic rename: the
             # parent polls for existence and immediately reads the
@@ -386,6 +417,7 @@ def head_main():
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--host", default="")
     parser.add_argument("--no-probe-tpu", action="store_true")
+    parser.add_argument("--supervised", action="store_true")
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
     _run_with_optional_profile(lambda: head_amain(args), "head")
@@ -437,6 +469,7 @@ class HeadNode:
             cmd += ["--port", str(port)]
         if host:
             cmd += ["--host", host]
+        cmd.append("--supervised")  # driver-spawned: die if orphaned
         if not probe_tpu:
             cmd.append("--no-probe-tpu")
         env = {**os.environ, "RAY_TPU_SYS_PATH": worker_sys_path()}

@@ -227,6 +227,24 @@ class Connection:
             pass
 
 
+async def reconnect_with_retry(attempt, *, should_stop=None,
+                               attempts: int = 75, delay: float = 0.2) -> bool:
+    """Shared reconnect policy for every GCS client (driver, worker, node
+    agent): retry ``attempt`` (an async callable performing connect +
+    re-hello) for ~``attempts*delay`` seconds, returning True on success.
+    One place to tune the retry budget for all peers."""
+    for _ in range(attempts):
+        if should_stop is not None and should_stop():
+            return False
+        await asyncio.sleep(delay)
+        try:
+            await attempt()
+            return True
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            continue
+    return False
+
+
 async def connect(address: str) -> tuple:
     """Open a stream to ``address`` — 'unix:<path>' or 'host:port'."""
     if address.startswith("unix:"):

@@ -83,6 +83,10 @@ def get_lib():
             lib.rtpu_store_list.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+            lib.rtpu_store_set_populated.argtypes = [ctypes.c_void_p,
+                                                     ctypes.c_uint64]
+            lib.rtpu_store_get_populated.restype = ctypes.c_uint64
+            lib.rtpu_store_get_populated.argtypes = [ctypes.c_void_p]
             lib.rtpu_store_stats.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64),
@@ -130,6 +134,8 @@ class NativeStore:
         self._base_addr = ctypes.addressof(anchor)
         del anchor
         self._libc = ctypes.CDLL(None, use_errno=True)
+        # Bytes of the arena this PROCESS's page tables already cover.
+        self._walked = 0
         if populate:
             # Commit the first ``populate`` bytes of tmpfs pages up front
             # (zero-fill major faults are ~1.4 GB/s; committed pages take
@@ -148,11 +154,21 @@ class NativeStore:
             if (os.cpu_count() or 1) <= 4:
                 sync_bytes = min(nbytes, 1 << 30)
                 self._madvise(0, sync_bytes)
+                self.lib.rtpu_store_set_populated(self.handle, sync_bytes)
+                self._walked = sync_bytes
             if nbytes > sync_bytes:
                 threading.Thread(
                     target=self._populate_pages,
                     args=(nbytes, sync_bytes), daemon=True,
                     name="arena-populate").start()
+        else:
+            # Client store: the head commits pages; this process still
+            # takes a ~1us shared-memory minor fault per 4K page on first
+            # touch. A deprioritized background walk of the committed
+            # region populates THIS process's page tables so steady-state
+            # creates/reads run fault-free.
+            threading.Thread(target=self._walk_committed, daemon=True,
+                             name="arena-walk").start()
 
     def _madvise(self, off: int, length: int, advice: int = 23) -> bool:
         """madvise via libc (releases the GIL). 23 = MADV_POPULATE_WRITE
@@ -163,6 +179,36 @@ class NativeStore:
             ctypes.c_void_p(self._base_addr + off),
             ctypes.c_size_t(length), ctypes.c_int(advice))
         return rc == 0
+
+    def _walk_committed(self, window: int = 16 << 20):
+        """Client-side page-table walk over the head-committed region
+        (tracked by the arena's populated watermark). ~0.5 ms of kernel
+        work per 16 MiB window on present pages; paced to stay out of the
+        workload's way."""
+        try:
+            os.nice(19)
+        except OSError:
+            pass
+        time.sleep(1.0)  # let this process's startup win the CPU first
+        off = 0
+        idle_rounds = 0
+        while idle_rounds < 50:  # stop once the watermark stops moving
+            with self._close_lock:
+                # C calls take the freed-Handle guard; madvise needs none
+                # (unmapped ranges fail with ENOMEM, no fault).
+                if not self.handle:
+                    return
+                limit = int(self.lib.rtpu_store_get_populated(self.handle))
+            if off >= limit:
+                idle_rounds += 1
+                time.sleep(0.1)
+                continue
+            idle_rounds = 0
+            if not self._madvise(off, min(window, limit - off)):
+                return
+            off = min(off + window, limit)
+            self._walked = off
+            time.sleep(0.01)
 
     def _populate_pages(self, nbytes: int, start: int = 0,
                         window: int = 16 << 20):
@@ -176,12 +222,18 @@ class NativeStore:
             pass
         time.sleep(0.2)
         for off in range(start, nbytes, window):
+            # madvise needs no close-lock (unmapped ranges fail with
+            # ENOMEM, no fault); the C watermark call does — close() frees
+            # the Handle it dereferences.
             if not self.handle:
                 return
-            # No close-lock needed: madvise on an unmapped range fails with
-            # ENOMEM (returning False) rather than faulting.
             if not self._madvise(off, min(window, nbytes - off)):
                 return
+            with self._close_lock:
+                if not self.handle:
+                    return
+                self.lib.rtpu_store_set_populated(
+                    self.handle, min(off + window, nbytes))
             time.sleep(0.002)
 
     @staticmethod
@@ -195,10 +247,12 @@ class NativeStore:
         if off == 0:
             raise MemoryError(
                 f"native store out of memory allocating {nbytes} bytes")
-        if nbytes >= (1 << 20):
-            # Populate the destination range up front: ~2x faster than
-            # per-page zero-fill faults when cold, ~free when the
-            # background populate already covered it.
+        if nbytes >= (1 << 20) and off + nbytes > self._walked:
+            # Populate the destination range up front. Cold pages: ~2x
+            # faster than zero-fill faults during the copy. Committed
+            # pages: still ~2x faster than taking shared-memory minor
+            # faults inline (~1us each). Skipped only once this process's
+            # background page-table walk has covered the range.
             start = off & ~0xFFF
             self._madvise(start, min(off - start + nbytes,
                                      self._total - start))

@@ -310,14 +310,8 @@ class Worker:
         self.loop.create_task(self._reconnect_gcs())
 
     async def _reconnect_gcs(self):
-        for _ in range(75):
-            if self.closed:
-                return
-            await asyncio.sleep(0.2)
-            try:
-                reader, writer = await protocol.connect(self.gcs_address)
-            except OSError:
-                continue
+        async def attempt():
+            reader, writer = await protocol.connect(self.gcs_address)
             conn = protocol.Connection(
                 reader, writer, handler=self._on_gcs_push,
                 on_close=self._on_gcs_close)
@@ -332,12 +326,16 @@ class Worker:
                 }, timeout=30)
             except (ConnectionError, asyncio.TimeoutError):
                 await conn.close()
-                continue
+                raise
             self.gcs = conn
             new_epoch = reply.get("epoch")
             restarted = new_epoch != getattr(self, "_gcs_epoch", None)
             self._gcs_epoch = new_epoch
             self._resync_after_reconnect(gcs_restarted=restarted)
+
+        ok = await protocol.reconnect_with_retry(
+            attempt, should_stop=lambda: self.closed)
+        if ok or self.closed:
             return
         # Reconnect window exhausted: the cluster is really gone.
         for fut in list(self._object_futures.values()):
@@ -358,6 +356,9 @@ class Worker:
         """
         if gcs_restarted:
             with self._ref_lock:
+                # Queued deltas are already folded into _live_refs; the
+                # fresh instance gets the snapshot, not the stream.
+                self._ref_deltas.clear()
                 live = [(oid.binary(), n)
                         for oid, n in self._live_refs.items()]
             if live:
@@ -420,23 +421,33 @@ class Worker:
             self._flusher_handle = self.loop.call_later(0.1, self._flush_refs_cb)
 
     def _flush_refs(self):
+        # Deltas are only dequeued once actually SENT: dropping them while
+        # the GCS link is down (reconnect in progress) would permanently
+        # skew refcounts on a surviving GCS — the epoch-gated resync
+        # replays live counts only after a real GCS restart.
+        if self.gcs is None or self.gcs.closed:
+            return
         with self._ref_lock:
             deltas = [(oid.binary(), d) for oid, d in self._ref_deltas.items()
                       if d != 0]
             self._ref_deltas.clear()
         if deltas:
+            try:
+                self.gcs.send({"t": "ref", "d": deltas})
+            except ConnectionError:
+                with self._ref_lock:
+                    for oid_b, d in deltas:
+                        oid = ObjectID(oid_b)
+                        self._ref_deltas[oid] = \
+                            self._ref_deltas.get(oid, 0) + d
+                return
             for oid_b, d in deltas:
                 if d < 0:
                     # Released refs no longer need lineage specs.
                     self._task_specs.pop(oid_b, None)
-            if self.gcs is not None and not self.gcs.closed:
-                try:
-                    self.gcs.send({"t": "ref", "d": deltas})
-                except ConnectionError:
-                    pass
         self._flush_notes()
 
-    def _queue_task_note(self, note: dict):
+    def _queue_task_note(self, note: tuple):
         self._task_notes.append(note)
         if len(self._task_notes) == 1:
             self.loop.call_soon(self._flush_notes)
@@ -446,7 +457,10 @@ class Worker:
             notes = list(self._task_notes)
             self._task_notes.clear()
             try:
-                self.gcs.send({"t": "task_notes", "notes": notes})
+                # Positional rows, not dicts: the head decodes thousands of
+                # these per second and string-key decoding is the dominant
+                # cost of the observability plane on a busy host.
+                self.gcs.send({"t": "task_notes", "n": notes})
             except ConnectionError:
                 pass
 
@@ -573,21 +587,30 @@ class Worker:
         return self.store.create(oid, nbytes)
 
     def put(self, value: Any) -> ObjectRef:
+        """Store a value, returning its ref.
+
+        Registration with the GCS is fire-and-forget: frames on the GCS
+        connection are FIFO, so any later message that could cause a
+        borrower to resolve this ref (a submit carrying it, a serialized
+        handoff) is ordered AFTER the registration — no ack round-trip
+        needed (an RTT per put halves small-put throughput on a busy
+        host; the reference's plasma create is similarly local-only).
+        """
         oid = ObjectID.for_put(self._put_counter.next())
         sobj = serialize(value)
         if sobj.total_size <= INLINE_THRESHOLD:
             data = sobj.to_bytes()
             self._memory_store[oid] = data
-            self.run_async(self.gcs.request({
+            self.send_gcs_threadsafe({
                 "t": "obj_put", "oid": oid.binary(),
-                "nbytes": len(data), "data": data}))
+                "nbytes": len(data), "data": data})
         else:
             buf = self.create_in_store(oid, sobj.total_size)
             sobj.write_into(buf)
             self.store.seal(oid)
-            self.run_async(self.gcs.request({
+            self.send_gcs_threadsafe({
                 "t": "obj_put", "oid": oid.binary(),
-                "nbytes": sobj.total_size, "shm": True}))
+                "nbytes": sobj.total_size, "shm": True})
         return ObjectRef(oid, self)
 
     def put_serialized(self, sobj: serialization.SerializedObject,
@@ -877,11 +900,10 @@ class Worker:
         reply = fut.result()
         results = reply["results"]
         self.push_result(tid, results)
-        self._queue_task_note({
-            "tid": tid, "name": item.name, "state": "done",
-            "error": bool(reply.get("err")), "created": item.created,
-            "start": reply.get("t0", 0.0), "end": reply.get("t1", 0.0),
-            "wid": lease.wid})
+        # Positional: (tid, name, error, created, start, end, wid).
+        self._queue_task_note((
+            tid, item.name, 1 if reply.get("err") else 0, item.created,
+            reply.get("t0", 0.0), reply.get("t1", 0.0), lease.wid))
         # Keep the spec for owner-side lineage reconstruction
         # (reference: ObjectRecoveryManager, object_recovery_manager.h:41)
         # while the object may still be lost; dropped on ref release.
@@ -898,9 +920,8 @@ class Worker:
             {"oid": oid.binary(), "nbytes": len(err), "data": err,
              "err": True}
             for oid in item.oids])
-        self._queue_task_note({
-            "tid": item.msg["tid"], "name": item.name, "state": "done",
-            "error": True, "created": item.created})
+        self._queue_task_note((
+            item.msg["tid"], item.name, 1, item.created, 0.0, 0.0, None))
 
     def _on_lease_broken(self, cls: _TaskClass, lease: _Lease):
         if lease.dead:

@@ -68,20 +68,21 @@ class Executor:
 
     def record_event(self, tid: bytes, name: str, kind: str,
                      start: float, end: float, ok: bool):
+        # Positional rows; per-worker constants (wid/nid/pid) ride once per
+        # flushed batch, not once per event — this runs on every task.
         if len(self.events) < 10_000:
-            self.events.append({
-                "task_id": TaskID(tid).hex() if len(tid) >= 8 else "",
-                "name": name, "kind": kind,
-                "worker_id": self.worker.worker_id.hex(),
-                "node_id": self.worker.node_id.hex()
-                if self.worker.node_id else "",
-                "pid": os.getpid(), "start": start, "end": end, "ok": ok})
+            self.events.append((bytes(tid), name, kind, start, end,
+                                1 if ok else 0))
 
     def flush_events(self):
         if self.events and self.worker.gcs and not self.worker.gcs.closed:
             batch, self.events = self.events, []
             try:
-                self.worker.gcs.send({"t": "task_events", "events": batch})
+                self.worker.gcs.send({
+                    "t": "task_events", "ev": batch,
+                    "wid": self.worker.worker_id.binary(),
+                    "nid": self.worker.node_id or b"",
+                    "pid": os.getpid()})
             except ConnectionError:
                 pass
 
@@ -308,6 +309,9 @@ class Executor:
                     await loop.run_in_executor(
                         self.task_pool, self._exec_one, conn, msg, loop)
                     continue
+                # Register BEFORE the pool picks it up: the exclusivity
+                # poll above must see queued-but-not-yet-started tasks.
+                self.running_tasks.setdefault(msg["tid"], 0)
                 self.task_pool.submit(self._exec_one, conn, msg, loop)
         finally:
             self._draining = False
@@ -562,16 +566,10 @@ async def amain(args):
             asyncio.get_running_loop().create_task(reconnect_gcs())
 
     async def reconnect_gcs():
-        for _ in range(75):
-            if stop.is_set():
-                return
-            await asyncio.sleep(0.2)
-            try:
-                await connect_gcs()
-                return
-            except (OSError, ConnectionError, asyncio.TimeoutError):
-                continue
-        stop.set()
+        ok = await protocol.reconnect_with_retry(
+            connect_gcs, should_stop=stop.is_set)
+        if not ok and not stop.is_set():
+            stop.set()
 
     reply = await connect_gcs()
     worker.session_name = reply["session"]

@@ -115,7 +115,32 @@ class TPUAcceleratorManager(AcceleratorManager):
         out = {f"TPU-{pod}": float(num_chips)}
         if self.get_current_node_tpu_worker_id() == 0:
             out[f"TPU-{pod}-head"] = 1.0
+        slice_id = self.get_current_slice_id()
+        if slice_id:
+            # Unique-per-slice marker: every host of one slice exports the
+            # same id, so the scheduler can confine a placement group to
+            # one ICI domain (STRICT_ICI) — two same-type slices are
+            # otherwise indistinguishable by the TPU-<pod> markers alone.
+            out[f"TPU-slice-{slice_id}"] = 1.0
         return out
+
+    @staticmethod
+    def get_current_slice_id() -> Optional[str]:
+        """Stable identity shared by all hosts of this slice.
+
+        Every host in a slice sees the same ``TPU_WORKER_HOSTNAMES`` (the
+        GKE/TPU-VM runtime exports it); its hash names the ICI domain.
+        ``TPU_NAME`` wins when present (explicit, human-readable).
+        """
+        name = os.environ.get("TPU_NAME")
+        if name:
+            return name
+        hostnames = os.environ.get(WORKER_HOSTNAMES_ENV)
+        if hostnames:
+            import hashlib
+
+            return hashlib.sha1(hostnames.encode()).hexdigest()[:12]
+        return None
 
     def get_current_node_extra_resources(self) -> Dict[str, float]:
         return self.get_pod_slice_markers(

@@ -29,6 +29,16 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None  # e.g. "v5p-64" — slice gang request
+    # jax.distributed rendezvous across the worker group. None = auto:
+    # on for multi-host TPU groups (a multi-host mesh REQUIRES it), off
+    # for CPU groups unless requested (reference analog: Train always
+    # builds the torch process group for num_workers > 1).
+    jax_distributed: Optional[bool] = None
+
+    def should_init_jax_distributed(self) -> bool:
+        if self.jax_distributed is not None:
+            return self.jax_distributed and self.num_workers > 1
+        return self.use_tpu and self.num_workers > 1
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -129,14 +129,22 @@ class JaxTrainer:
         sc = self.scaling_config
         run_path = os.path.join(storage, run_name)
         collector = _ResultCollector.remote(sc.num_workers)
+        group = None
         try:
             group = WorkerGroup(sc.num_workers, sc.worker_resources(),
                                 sc.placement_strategy)
+            if sc.should_init_jax_distributed():
+                # The mesh worker group primitive (SURVEY §7 hard part 2):
+                # co-scheduled host actors enter one jax.distributed
+                # rendezvous so a single pjit program spans the group.
+                group.setup_distributed()
         except Exception as e:  # noqa: BLE001 — e.g. infeasible resources
             try:
                 ray_tpu.kill(collector)
             except Exception:
                 pass
+            if group is not None:
+                group.shutdown()
             return Result(metrics=None, checkpoint=None, path=run_path,
                           error=e)
         try:

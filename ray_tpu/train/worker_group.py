@@ -33,12 +33,28 @@ class TrainWorker:
 
         install_hook()
 
+    def coordinator_endpoint(self) -> str:
+        """Pick a reachable (ip, free port) on THIS host for the jax
+        coordinator service (rank 0 hosts it)."""
+        import socket
+
+        from ray_tpu._private.node import get_node_ip_address
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{get_node_ip_address()}:{port}"
+
     def setup_jax_distributed(self, coordinator: str):
         """Multi-host mesh bootstrap (the NCCL-process-group analog —
-        reference ``train/torch/config.py:66`` ``_setup_torch_process_group``)."""
+        reference ``train/torch/config.py:66`` ``_setup_torch_process_group``):
+        a REAL ``jax.distributed.initialize`` rendezvous, after which
+        ``jax.devices()`` spans every worker's chips and one pjit program
+        runs multi-controller across the group."""
         import jax
 
-        if self.world_size > 1 and os.environ.get("RAY_TPU_JAX_DISTRIBUTED"):
+        if self.world_size > 1:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=self.world_size,
@@ -107,6 +123,19 @@ class WorkerGroup:
             ).remote(rank, num_workers, env_per_worker[rank])
             self.workers.append(w)
         ray_tpu.get([w.ping.remote() for w in self.workers])
+
+    def setup_distributed(self, timeout: float = 120.0):
+        """Run the jax.distributed rendezvous across the group.
+
+        Rank 0's host serves the coordinator; every rank joins IN PARALLEL
+        (the rendezvous is collective — a serial loop would deadlock).
+        """
+        if self.num_workers <= 1:
+            return
+        coordinator = ray_tpu.get(
+            self.workers[0].coordinator_endpoint.remote())
+        ray_tpu.get([w.setup_jax_distributed.remote(coordinator)
+                     for w in self.workers], timeout=timeout)
 
     def run_async(self, method: str, *args, **kwargs):
         return [getattr(w, method).remote(*args, **kwargs)

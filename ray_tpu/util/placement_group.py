@@ -2,6 +2,8 @@
 
 API analog of ``python/ray/util/placement_group.py:211``; strategies
 PACK / SPREAD / STRICT_PACK / STRICT_SPREAD mirror the reference's bundle
+policies; STRICT_ICI (TPU-native, no reference analog) confines every
+bundle to one TPU slice so the group's collectives stay on ICI
 scheduling policies (``raylet/scheduling/policy/bundle_scheduling_policy.cc``).
 On TPU the canonical use is gang-scheduling one worker per pod-slice host
 with STRICT_SPREAD, or pinning a whole job to one host with STRICT_PACK.
@@ -16,7 +18,8 @@ from typing import Dict, List, Optional
 from .._private.ids import PlacementGroupID
 from .._private.worker import global_worker
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    "STRICT_ICI")
 
 
 class PlacementGroup:
