@@ -79,6 +79,10 @@ class NodeInfo:
         self.workers: Set[WorkerID] = set()
         self.spawning = 0
         self.last_active = time.time()  # autoscaler idle tracking
+        # P2P object plane: the agent's chunk-serving address and which
+        # arena it serves ("" = the head-host arena).
+        self.obj_addr: Optional[str] = None
+        self.store_suffix: str = ""
 
     def utilization(self) -> float:
         cpu_t = self.total.get("CPU", 0.0)
@@ -590,6 +594,8 @@ class GcsServer:
             client.node_id = node_id
             node = NodeInfo(
                 node_id, msg["resources"], msg.get("hostname", ""), client.conn)
+            node.obj_addr = msg.get("obj_addr")
+            node.store_suffix = msg.get("store_suffix", "")
             self.nodes[node_id] = node
             # Adopt surviving workers that resynced before their agent
             # (GCS restart: reconnect order is arbitrary).
@@ -712,18 +718,20 @@ class GcsServer:
                 and self._client_by_wid.get(client.worker_id.binary())
                 is client):
             del self._client_by_wid[client.worker_id.binary()]
+        if client.role == "worker" and client.worker_id is not None:
+            # A half-open socket can die AFTER the worker already
+            # reconnected and re-registered: the stale conn's disconnect
+            # must not kill the fresh registration (split-brain actor
+            # restarts otherwise) nor purge its live state — so this guard
+            # runs before ANY cleanup below.
+            w = self.workers.get(client.worker_id)
+            if w is not None and w.conn is not client.conn:
+                return
         sender = (client.worker_id.hex() if client.worker_id
                   else str(id(client)))
         for key in [k for k in self.metrics if k[0] == sender]:
             del self.metrics[key]
         if client.role == "worker" and client.worker_id is not None:
-            # A half-open socket can die AFTER the worker already
-            # reconnected and re-registered: the stale conn's disconnect
-            # must not kill the fresh registration (split-brain actor
-            # restarts otherwise).
-            w = self.workers.get(client.worker_id)
-            if w is not None and w.conn is not client.conn:
-                return
             # Objects owned by this worker (from its nested submissions).
             for oid in self._owned_objects.pop(self._owner_key(client),
                                                set()):
@@ -874,6 +882,55 @@ class GcsServer:
         entry = self.objects.get(oid)
         client.conn.reply(msg, {"ok": True,
                                 "ready": bool(entry and entry.ready)})
+
+    async def _h_obj_report(self, client, msg):
+        """Bulk object-location resync from a node agent (arena rescan
+        after agent or GCS restart)."""
+        if client.node_id is None:
+            return
+        nid_b = client.node_id.binary()
+        for oid_b, nbytes in msg["objs"]:
+            entry = self._obj(ObjectID(bytes(oid_b)))
+            entry.holders.add(nid_b)
+            if not entry.ready:
+                entry.nbytes = nbytes
+                entry.on_shm = True
+                entry.ready = True
+                for conn, req in entry.waiters:
+                    if not conn.closed:
+                        conn.reply(req, self._obj_reply(entry))
+                entry.waiters.clear()
+
+    async def _h_obj_locate(self, client, msg):
+        """Object directory lookup for the P2P object plane (reference:
+        ``ObjectDirectory`` over the object-location pubsub channel,
+        ``object_manager/object_directory.h``): returns the agents a
+        puller can fetch chunks from directly. Inline values come back
+        inline; only locations — never data — transit the GCS here."""
+        oid = ObjectID(msg["oid"])
+        entry = self.objects.get(oid)
+        if entry is None or not entry.ready:
+            client.conn.reply(msg, {"ok": False, "err": "object not ready"})
+            return
+        if entry.inline is not None:
+            client.conn.reply(msg, {"ok": True, "data": entry.inline})
+            return
+        addrs = []
+        for node_id in entry.holders:
+            node = self.nodes.get(NodeID(node_id))
+            if node is not None and node.alive and node.obj_addr:
+                addrs.append(node.obj_addr)
+        if entry.on_shm and self.store.contains(oid):
+            # Head-arena object (e.g. a driver put): served by any agent
+            # attached to the head arena (empty store suffix).
+            for node in self.nodes.values():
+                if (node.alive and node.obj_addr
+                        and node.store_suffix == ""
+                        and node.obj_addr not in addrs):
+                    addrs.append(node.obj_addr)
+        client.conn.reply(msg, {"ok": True, "nbytes": entry.nbytes,
+                                "addrs": addrs,
+                                "spilled": entry.spilled is not None})
 
     async def _h_obj_pull(self, client, msg):
         """Serve the raw bytes of an object to a host that doesn't share a

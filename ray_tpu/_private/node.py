@@ -172,8 +172,12 @@ class NodeAgent:
         self.conn: Optional[protocol.Connection] = None
         self.procs: List[subprocess.Popen] = []
         self.stopped = asyncio.Event()
+        self._obj_server: Optional[asyncio.AbstractServer] = None
+        self.obj_addr: Optional[str] = None
+        self._store = None
 
     async def start(self):
+        await self._start_obj_server()
         await self._connect_and_register()
         for _ in range(self.num_initial_workers):
             self.spawn_worker()
@@ -192,7 +196,80 @@ class NodeAgent:
             "node_id": self.node_id.binary(),
             "resources": self.resources,
             "hostname": os.uname().nodename,
+            "obj_addr": self.obj_addr,
+            "store_suffix": os.environ.get("RAY_TPU_STORE_SUFFIX", ""),
         }, timeout=30)
+        self._report_arena_objects()
+
+    def _report_arena_objects(self):
+        """Re-report this host arena's sealed objects after (re)register:
+        a restarted GCS rescans only the HEAD arena itself; other nodes'
+        directories come back through this resync (reference: raylets
+        resyncing object locations after GCS failover)."""
+        try:
+            store = self._host_store()
+        except Exception:
+            return
+        if not hasattr(store, "list_objects"):
+            return
+        try:
+            objs = store.list_objects()
+        except Exception:
+            return
+        if objs:
+            self.conn.send({
+                "t": "obj_report",
+                "objs": [[oid.binary(), n] for oid, n in objs]})
+
+    # ------------------------------------------------ p2p object serving
+    # The node-to-node half of the object plane (reference: object manager
+    # chunked Push/Pull over dedicated gRPC, object_manager.h:117-206):
+    # each agent serves reads from ITS host's shm arena over TCP; pullers
+    # fetch chunks directly so bulk data never transits the head.
+
+    async def _start_obj_server(self):
+        # Loopback for same-host (UDS-attached) clusters; the node's
+        # reachable IP when the cluster spans hosts (TCP GCS).
+        host = ("127.0.0.1" if self.gcs_address.startswith("unix:")
+                else get_node_ip_address())
+        try:
+            self._obj_server = await protocol.serve(
+                f"{host}:0", self._on_obj_client)
+            port = self._obj_server.sockets[0].getsockname()[1]
+            self.obj_addr = f"{host}:{port}"
+        except OSError:
+            self.obj_addr = None
+
+    async def _on_obj_client(self, reader, writer):
+        conn = protocol.Connection(reader, writer)
+        conn._handler = lambda msg: self._on_obj_msg(conn, msg)
+        conn.start()
+
+    def _host_store(self):
+        if self._store is None:
+            from .object_store import make_store
+
+            self._store = make_store(os.path.basename(self.session_dir))
+        return self._store
+
+    async def _on_obj_msg(self, conn: protocol.Connection, msg: dict):
+        if msg.get("t") != "obj_fetch":
+            return
+        from .ids import ObjectID
+
+        oid = ObjectID(msg["oid"])
+        off = int(msg.get("off", 0))
+        length = int(msg.get("len", 0))
+        view = self._host_store().get(oid, msg.get("nbytes", 0))
+        if view is None:
+            conn.reply(msg, {"ok": False})
+            return
+        try:
+            total = len(view.data)
+            chunk = bytes(view.data[off:off + length]) if length else b""
+            conn.reply(msg, {"ok": True, "data": chunk, "total": total})
+        finally:
+            view.close()
 
     def _on_gcs_close(self):
         if not self.stopped.is_set():

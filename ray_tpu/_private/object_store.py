@@ -217,6 +217,11 @@ def make_store(session_name: str, capacity: int = 0, prefer_native: bool = True,
     (the GCS/head): tmpfs page commits are arena-wide, and N concurrent
     populaters just multiply the kernel work.
     """
+    # Per-node arena isolation: real deployments get one arena per host
+    # naturally; fake multi-node clusters set RAY_TPU_STORE_SUFFIX per
+    # simulated node so cross-"node" object transfer paths are exercised
+    # for real (reference: fake_multi_node provider testing, cluster_utils).
+    session_name += os.environ.get("RAY_TPU_STORE_SUFFIX", "")
     if prefer_native and not os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
         store = _try_native_store(session_name, capacity, populate)
         if store is not None:

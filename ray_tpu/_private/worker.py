@@ -213,11 +213,13 @@ class Worker:
         self._ref_lock = threading.Lock()
         self._actor_chans: Dict[ActorID, _ActorChannel] = {}
         self._dead_actors: Dict[ActorID, str] = {}
+        self._peer_conns: Dict[str, protocol.Connection] = {}  # p2p pulls
         # Outbound message queue: producer threads enqueue, a single loop
         # wakeup drains the burst (write coalescing in protocol.Connection
         # then collapses the burst into one syscall).
         self._out_q: deque = deque()
         self._out_lock = threading.Lock()
+        self._drain_scheduled = False  # a _drain_out wakeup is pending
         # Direct task path (worker leases).
         self._task_classes: Dict[str, _TaskClass] = {}
         self._leases_by_wid: Dict[bytes, tuple] = {}  # wid -> (cls, lease)
@@ -370,6 +372,8 @@ class Worker:
         for cls in self._task_classes.values():
             cls.demand = 0
             self._pump_class(cls)
+        # Flush messages retained while the link was down.
+        self.loop.call_soon(self._drain_out)
 
     def disconnect(self):
         if self.closed:
@@ -389,6 +393,9 @@ class Worker:
         self._flush_refs()
         if self.gcs is not None:
             await self.gcs.close()
+        for conn in self._peer_conns.values():
+            if not conn.closed:
+                await conn.close()
         for ch in self._actor_chans.values():
             if ch.conn is not None:
                 await ch.conn.close()
@@ -518,12 +525,36 @@ class Worker:
         return value
 
     def _pull_object(self, object_id: ObjectID):
-        """Fetch object bytes via the GCS transfer relay; cache locally.
+        """Fetch an object from another node; cache locally.
 
         Client-side half of the reference's object-manager Pull
-        (``object_manager/pull_manager.h:52``). Returns a store view
-        (zero-copy, pinned) when caching succeeds, else raw bytes.
+        (``object_manager/pull_manager.h:52``): locate holders via the
+        GCS object directory, then pull CHUNKS directly from a holder
+        node's agent (peer-to-peer — bulk bytes never transit the head).
+        Falls back to the GCS relay (spilled objects, no serving agent).
+        Returns a store view (zero-copy, pinned) when caching succeeds,
+        else raw bytes.
         """
+        if not self.client_mode:
+            try:
+                loc = self.request_gcs(
+                    {"t": "obj_locate", "oid": object_id.binary()},
+                    timeout=60)
+            except (ConnectionError, TimeoutError) as e:
+                raise serialization.ObjectLostError(
+                    f"locate of {object_id.hex()} failed: {e}")
+            if loc.get("ok") and loc.get("data") is not None:
+                return loc["data"]  # inline value
+            if loc.get("ok"):
+                for addr in loc.get("addrs", []):
+                    try:
+                        view = self._pull_from_peer(addr, object_id,
+                                                    loc["nbytes"])
+                        if view is not None:
+                            return view
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError, TimeoutError):
+                        continue
         try:
             reply = self.request_gcs(
                 {"t": "obj_pull", "oid": object_id.binary()}, timeout=60)
@@ -546,6 +577,77 @@ class Worker:
         except Exception:
             pass
         return data
+
+    _PULL_CHUNK = 4 << 20  # bytes per fetch (reference default: 5 MiB)
+    _PULL_WINDOW = 4  # outstanding chunk requests
+
+    def _pull_from_peer(self, addr: str, object_id: ObjectID, nbytes: int):
+        """Chunked direct pull from a holder node's agent into the local
+        store; seal + register so this node becomes a holder too."""
+        buf = self.create_in_store(object_id, nbytes)
+        cfut = asyncio.run_coroutine_threadsafe(
+            self._pull_chunks_async(addr, object_id, nbytes, buf), self.loop)
+        try:
+            ok = cfut.result(120)
+        except Exception:
+            # The coroutine must be DEAD before the buffer is recycled:
+            # aborting while it still writes would corrupt whatever object
+            # the arena hands this range to next.
+            cfut.cancel()
+            try:
+                cfut.result(10)
+            except Exception:
+                pass
+            self.store.abort(object_id)
+            raise
+        if not ok:
+            self.store.abort(object_id)
+            return None
+        self.store.seal(object_id)
+        self.send_gcs_threadsafe({
+            "t": "obj_put", "oid": object_id.binary(),
+            "nbytes": nbytes, "shm": True})
+        return self.store.get(object_id, nbytes)
+
+    async def _pull_chunks_async(self, addr: str, object_id: ObjectID,
+                                 nbytes: int, buf) -> bool:
+        conn = self._peer_conns.get(addr)
+        if conn is None or conn.closed:
+            reader, writer = await protocol.connect(addr)
+            conn = protocol.Connection(reader, writer)
+            conn.start()
+            self._peer_conns[addr] = conn
+        offs = list(range(0, nbytes, self._PULL_CHUNK))
+        pending: Dict[int, asyncio.Future] = {}
+        i = 0
+        try:
+            while i < len(offs) or pending:
+                while i < len(offs) and len(pending) < self._PULL_WINDOW:
+                    off = offs[i]
+                    pending[off] = conn.request_nowait({
+                        "t": "obj_fetch", "oid": object_id.binary(),
+                        "off": off,
+                        "len": min(self._PULL_CHUNK, nbytes - off),
+                        "nbytes": nbytes})
+                    i += 1
+                done_off = next(iter(pending))
+                reply = await asyncio.wait_for(pending.pop(done_off), 60)
+                if not reply.get("ok"):
+                    return False
+                data = reply["data"]
+                want = min(self._PULL_CHUNK, nbytes - done_off)
+                if len(data) != want or reply.get("total") != nbytes:
+                    # Holder's copy disagrees with the directory (racing
+                    # re-put, stale rescan): sealing a short read would
+                    # spread a corrupt copy cluster-wide.
+                    return False
+                buf[done_off:done_off + len(data)] = data
+        except (ConnectionError, OSError):
+            stale = self._peer_conns.pop(addr, None)
+            if stale is not None and not stale.closed:
+                self.loop.create_task(stale.close())
+            raise
+        return True
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         futs = [self.object_future(r.id) for r in refs]
@@ -744,9 +846,12 @@ class Worker:
             self._object_futures[oid] = fut
             oids.append(oid)
             refs.append(ObjectRef(oid, self))
-        if self.client_mode:
+        if self.client_mode or opts.get("sched") == "SPREAD":
             # Remote (ray://) drivers cannot reach worker sockets: route
             # through the GCS scheduler (reference: Ray Client proxying).
+            # SPREAD tasks route there too — placement is per TASK for
+            # spread semantics, which lease reuse would defeat (every task
+            # of the class would ride the first granted worker).
             msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
                    "nret": num_returns, "opts": opts, **msg_args}
             self.send_gcs_threadsafe(msg)
@@ -789,7 +894,9 @@ class Worker:
         else:
             with self._out_lock:
                 self._out_q.append(("task", key, wire, item))
-                wake = len(self._out_q) == 1
+                wake = not self._drain_scheduled
+            if wake:
+                self._drain_scheduled = True
             if wake:
                 self.loop.call_soon_threadsafe(self._drain_out)
         return refs
@@ -804,7 +911,9 @@ class Worker:
                 if item.deps_left != 0:
                     return
                 self._out_q.append(("task", key, wire, item))
-                wake = len(self._out_q) == 1
+                wake = not self._drain_scheduled
+            if wake:
+                self._drain_scheduled = True
             if wake:
                 self.loop.call_soon_threadsafe(self._drain_out)
 
@@ -826,7 +935,9 @@ class Worker:
         reference's batched gRPC stream writes."""
         with self._out_lock:
             self._out_q.append(msg)
-            wake = len(self._out_q) == 1
+            wake = not self._drain_scheduled
+            if wake:
+                self._drain_scheduled = True
         if wake:
             self.loop.call_soon_threadsafe(self._drain_out)
 
@@ -995,7 +1106,9 @@ class Worker:
         item.retries -= 1 if item.retries > 0 else 0
         with self._out_lock:
             self._out_q.append(("task", key, wire, item))
-            wake = len(self._out_q) == 1
+            wake = not self._drain_scheduled
+            if wake:
+                self._drain_scheduled = True
         if wake:
             self.loop.call_soon_threadsafe(self._drain_out)
         return True
@@ -1058,20 +1171,31 @@ class Worker:
         item = ("actor", actor_id, call, oids, opts.get("retries", 0))
         with self._out_lock:
             self._out_q.append(item)
-            wake = len(self._out_q) == 1
+            wake = not self._drain_scheduled
+            if wake:
+                self._drain_scheduled = True
         if wake:
             self.loop.call_soon_threadsafe(self._drain_out)
         return refs
 
     def _drain_out(self):  # runs on the IO loop
         with self._out_lock:
+            self._drain_scheduled = False
             if not self._out_q:
                 return
             msgs = list(self._out_q)
             self._out_q.clear()
         pumped = set()
+        gcs_down = self.gcs is None or self.gcs.closed
+        retained: List[dict] = []
         for m in msgs:
             if isinstance(m, dict):
+                if gcs_down:
+                    # Keep GCS-bound messages (put registrations, refs)
+                    # until the reconnect lands — dropping them would
+                    # orphan objects the user already holds refs to.
+                    retained.append(m)
+                    continue
                 self._send_gcs(m)
             elif m[0] == "actor":
                 self._dispatch_actor_call(*m[1:])
@@ -1083,6 +1207,11 @@ class Worker:
                 cls.queue.append(item)
                 self._inflight[item.msg["tid"]] = ("queued", cls, item)
                 pumped.add(key)
+        if retained:
+            with self._out_lock:
+                # Prepend so original order holds when the link returns.
+                for m in reversed(retained):
+                    self._out_q.appendleft(m)
         for key in pumped:
             self._pump_class(self._task_classes[key])
 

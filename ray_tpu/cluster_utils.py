@@ -59,7 +59,8 @@ class Cluster:
     def add_node(self, num_cpus: int = 1, num_tpus: int = 0,
                  resources: Optional[Dict[str, float]] = None,
                  num_initial_workers: int = 1,
-                 env: Optional[Dict[str, str]] = None) -> NodeHandle:
+                 env: Optional[Dict[str, str]] = None,
+                 isolate_store: bool = True) -> NodeHandle:
         assert self.address is not None, "cluster has no head"
         from ._private.ids import NodeID
 
@@ -68,6 +69,13 @@ class Cluster:
                                     resources=resources)
         from ._private.node import _AGENT_BOOTSTRAP, worker_sys_path
 
+        child_env = {**os.environ, "RAY_TPU_NODE_ID": node_id.hex(),
+                     "RAY_TPU_SYS_PATH": worker_sys_path()}
+        if isolate_store:
+            # One arena per simulated node: cross-node object movement
+            # exercises the REAL p2p transfer path (on real multi-host
+            # clusters isolation comes from the hosts themselves).
+            child_env["RAY_TPU_STORE_SUFFIX"] = f"-n{node_id.hex()[:8]}"
         proc = subprocess.Popen(
             [sys.executable, "-S", "-c", _AGENT_BOOTSTRAP,
              "--gcs", self.address,
@@ -79,8 +87,7 @@ class Cluster:
             stdout=open(os.path.join(self.head.session_dir,
                                      f"agent-{node_id.hex()[:8]}.out"), "ab"),
             stderr=subprocess.STDOUT,
-            env={**os.environ, "RAY_TPU_NODE_ID": node_id.hex(),
-                 "RAY_TPU_SYS_PATH": worker_sys_path()},
+            env=child_env,
         )
         handle = NodeHandle(proc, node_id.hex(), res)
         self.worker_nodes.append(handle)

@@ -1,0 +1,99 @@
+"""Peer-to-peer object plane: direct node-to-node chunked transfer.
+
+Covers the reference's object manager Push/Pull capability
+(``src/ray/object_manager/object_manager.h:117,206``, chunked transfer +
+``pull_manager.h:52``): with per-node arenas (isolate_store), an object
+produced on node A reaches node B by B pulling 4 MiB chunks DIRECTLY from
+A's agent — the head process never carries the bytes.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    c = Cluster(connect=True)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    assert c.wait_for_nodes(3, timeout=60)
+    assert c.wait_for_workers(timeout=60)
+    yield c
+    c.shutdown()
+
+
+def test_cross_node_object_moves_p2p(two_node_cluster):
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def produce(tag, n):
+        import os
+
+        return (os.environ.get("RAY_TPU_STORE_SUFFIX", ""),
+                np.full(n, 7.0, dtype=np.float64))
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def consume(blob):
+        suffix, arr = blob
+        import os
+
+        return (suffix, os.environ.get("RAY_TPU_STORE_SUFFIX", ""),
+                float(arr.sum()))
+
+    # Produce a ~24 MB object on every node, consume everywhere: at least
+    # one (producer, consumer) pair must cross node arenas.
+    n = 3_000_000
+    prods = [produce.remote(i, n) for i in range(6)]
+    outs = ray_tpu.get([consume.remote(p) for p in prods], timeout=120)
+    crossings = 0
+    for src_suffix, dst_suffix, total in outs:
+        assert total == 7.0 * n
+        if src_suffix != dst_suffix:
+            crossings += 1
+    assert crossings >= 1, "no transfer ever crossed a node arena"
+
+
+def test_driver_gets_remote_object_without_relay_bytes(two_node_cluster):
+    """The driver pulls a remote-node result through the p2p path (the
+    GCS relay remains only as fallback)."""
+
+    @ray_tpu.remote(resources={"CPU": 1})
+    def big():
+        return np.arange(4_000_000, dtype=np.float64)  # 32 MB
+
+    refs = [big.remote() for _ in range(4)]
+    for r in refs:
+        out = ray_tpu.get(r, timeout=120)
+        assert out.shape == (4_000_000,)
+        assert float(out[-1]) == 3_999_999.0
+
+
+def test_object_survives_gcs_restart_on_remote_node(two_node_cluster):
+    """Node arenas outlive a GCS restart; agents re-report locations."""
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote(resources={"CPU": 1})
+    def make():
+        return np.ones(2_000_000, dtype=np.float64)
+
+    ref = ray_tpu.get(ray_tpu.put(ray_tpu.get(make.remote(), timeout=60)))
+    del ref
+
+    ref2 = make.remote()
+    ray_tpu.wait([ref2], num_returns=1, timeout=60)
+
+    w = global_worker()
+    assert w.request_gcs({"t": "gcs_restart"}, timeout=10).get("ok")
+    import time
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            w.cluster_info()
+            break
+        except Exception:
+            time.sleep(0.2)
+    # Location resync: the remote-node object is still fetchable.
+    out = ray_tpu.get(ref2, timeout=60)
+    assert float(out.sum()) == 2_000_000.0
