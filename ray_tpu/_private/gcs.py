@@ -99,6 +99,7 @@ class WorkerInfo:
         self.conn = conn
         self.addr = addr
         self.pid = pid
+        self.env_key = ""  # interpreter env pool ("" = base image)
         self.state = W_IDLE
         self.current_task: Optional[TaskID] = None
         self.actor_id: Optional[ActorID] = None
@@ -114,7 +115,7 @@ class TaskRecord:
     __slots__ = ("task_id", "msg", "owner", "retries_left", "state", "worker_id",
                  "cancelled", "resources", "pg", "bundle", "strategy", "returns",
                  "name", "ts_created", "ts_running", "ts_done", "error",
-                 "node_id", "sig")
+                 "node_id", "sig", "env_key", "env_spec")
 
     def __init__(self, task_id: TaskID, msg: dict, owner: "ClientConn"):
         self.task_id = task_id
@@ -131,11 +132,22 @@ class TaskRecord:
         # shape in NormalTaskSubmitter): tasks with identical placement needs
         # share one pending queue, so a scheduling pass is O(dispatched +
         # distinct classes), never O(queue length).
+        self.env_key = ""
+        self.env_spec = None
+        renv = opts.get("runtime_env")
+        if renv and (renv.get("pip") is not None
+                     or renv.get("uv") is not None):
+            from ray_tpu.runtime_env.pip_env import env_key as _ek
+            from ray_tpu.runtime_env.pip_env import spawn_spec_from_renv
+
+            self.env_spec = spawn_spec_from_renv(renv)
+            if self.env_spec is not None:
+                self.env_key = _ek(self.env_spec)
         strategy = self.strategy
         if isinstance(strategy, dict):
             strategy = tuple(sorted(strategy.items()))
         self.sig = (tuple(sorted(self.resources.items())), self.pg,
-                    self.bundle, strategy)
+                    self.bundle, strategy, self.env_key)
         self.state = "pending"
         self.worker_id: Optional[WorkerID] = None
         self.node_id: Optional[NodeID] = None
@@ -189,6 +201,17 @@ class ActorRecord:
         self.restarts_used = 0
         self.pg = opts.get("pg")
         self.bundle = opts.get("bix")
+        self.env_key = ""
+        self.env_spec = None
+        renv = opts.get("runtime_env")
+        if renv and (renv.get("pip") is not None
+                     or renv.get("uv") is not None):
+            from ray_tpu.runtime_env.pip_env import env_key as _ek
+            from ray_tpu.runtime_env.pip_env import spawn_spec_from_renv
+
+            self.env_spec = spawn_spec_from_renv(renv)
+            if self.env_spec is not None:
+                self.env_key = _ek(self.env_spec)
         self.state = A_PENDING
         self.worker_id: Optional[WorkerID] = None
         self.addr: Optional[str] = None
@@ -250,7 +273,7 @@ class LeaseDemand:
     """
 
     __slots__ = ("client", "key", "count", "resources", "pg", "bundle",
-                 "strategy", "sig", "cancelled")
+                 "strategy", "sig", "cancelled", "env_key", "env_spec")
 
     def __init__(self, client: "ClientConn", msg: dict):
         self.client = client
@@ -261,11 +284,15 @@ class LeaseDemand:
         self.bundle = msg.get("bix")
         self.strategy = msg.get("sched") or "DEFAULT"
         self.cancelled = False
+        # Interpreter env pool this demand draws from ("" = base image);
+        # reference analog: per-runtime-env worker pools, worker_pool.h:174.
+        self.env_key = msg.get("env_key", "")
+        self.env_spec = msg.get("renv_spawn")
         strategy = self.strategy
         if isinstance(strategy, dict):
             strategy = tuple(sorted(strategy.items()))
         self.sig = (tuple(sorted(self.resources.items())), self.pg,
-                    self.bundle, strategy, id(client))
+                    self.bundle, strategy, self.env_key, id(client))
 
 
 class PendingQueues:
@@ -622,6 +649,7 @@ class GcsServer:
             client.node_id = node_id
             info = WorkerInfo(worker_id, node_id, client.conn,
                               msg.get("addr", ""), msg.get("pid", 0))
+            info.env_key = msg.get("env_key", "")
             self.workers[worker_id] = info
             node = self.nodes.get(node_id)
             if node is not None:
@@ -1194,6 +1222,16 @@ class GcsServer:
         self.pending.append(LeaseDemand(client, msg))
         self._wake_scheduler()
 
+    async def _h_spawn_failed(self, client, msg):
+        """Agent could not spawn a worker (e.g. venv build failure):
+        release the spawning slot so the pool doesn't wedge."""
+        node = self.nodes.get(NodeID(msg["node_id"]))
+        if node is not None:
+            node.spawning = max(0, node.spawning - 1)
+        logger.warning("worker spawn failed on %s: %s",
+                       msg.get("node_id", b"").hex()[:8] if msg.get("node_id")
+                       else "?", msg.get("err"))
+
     async def _h_lease_ret(self, client, msg):
         """A driver returns a leased worker; it becomes schedulable again."""
         worker = self.workers.get(WorkerID(msg["wid"]))
@@ -1329,7 +1367,7 @@ class GcsServer:
         node, or no idle worker) is skipped wholesale for the rest of the
         pass — its per-task state never needs re-examination.
         """
-        deficit: Dict[NodeID, int] = {}
+        deficit: Dict[tuple, tuple] = {}  # (node, env) -> (count, spec)
         qs = self.pending.qs
         active = list(qs.keys())
         while active:
@@ -1353,12 +1391,15 @@ class GcsServer:
                 node = self._pick_node(record)
                 if node is None:
                     continue  # class infeasible this pass
-                worker = self._grab_idle_worker(node)
+                env_key = getattr(record, "env_key", "")
+                worker = self._grab_idle_worker(node, env_key)
                 if worker is None:
                     pend = (record.count if isinstance(record, LeaseDemand)
                             else len(q))
-                    deficit[node.node_id] = (
-                        deficit.get(node.node_id, 0) + pend)
+                    dkey = (node.node_id, env_key)
+                    cnt, _ = deficit.get(dkey, (0, None))
+                    deficit[dkey] = (cnt + pend,
+                                     getattr(record, "env_spec", None))
                     continue
                 worker.state = W_BUSY
                 worker.acquired = self._acquire(node, record)
@@ -1391,20 +1432,30 @@ class GcsServer:
                 else:
                     qs.pop(sig, None)
             active = still_active
-        for node_id, d in deficit.items():
+        for (node_id, env_key), (d, env_spec) in deficit.items():
             node = self.nodes.get(node_id)
             if node is not None:
-                self._request_worker(node, demand=d)
+                self._request_worker(node, demand=d, env_key=env_key,
+                                     env_spec=env_spec)
 
-    def _grab_idle_worker(self, node: NodeInfo) -> Optional[WorkerInfo]:
-        while node.idle_workers:
+    def _grab_idle_worker(self, node: NodeInfo,
+                          env_key: str = "") -> Optional[WorkerInfo]:
+        # Per-env worker pools (reference: per-runtime-env pools in
+        # worker_pool.h:174): a base task never lands in a venv worker and
+        # vice versa. Non-matching workers rotate back into the deque.
+        for _ in range(len(node.idle_workers)):
             wid = node.idle_workers.popleft()
             w = self.workers.get(wid)
-            if w is not None and w.state == W_IDLE and not w.conn.closed:
-                return w
+            if w is None or w.state != W_IDLE or w.conn.closed:
+                continue
+            if w.env_key != env_key:
+                node.idle_workers.append(wid)
+                continue
+            return w
         return None
 
-    def _request_worker(self, node: NodeInfo, demand: int = 1):
+    def _request_worker(self, node: NodeInfo, demand: int = 1,
+                        env_key: str = "", env_spec=None):
         """Ask the node agent to spawn workers to cover ``demand`` waiting
         consumers.
 
@@ -1420,10 +1471,14 @@ class GcsServer:
         cap = max(int(node.total.get("CPU", 1)), 1) + 2 + actor_workers
         if node.agent_conn is None or node.agent_conn.closed:
             return
+        spawn_msg: Dict[str, Any] = {"t": "spawn_worker"}
+        if env_spec is not None:
+            spawn_msg["env_spec"] = env_spec
+            spawn_msg["env_key"] = env_key
         while (node.spawning < min(demand, 4)
                and len(node.workers) + node.spawning < cap):
             node.spawning += 1
-            node.agent_conn.send({"t": "spawn_worker"})
+            node.agent_conn.send(spawn_msg)
 
     async def _h_task_done(self, client, msg):
         tid = TaskID(msg["tid"])
@@ -1608,9 +1663,10 @@ class GcsServer:
             asyncio.get_running_loop().call_later(
                 0.05, self._retry_place_actor, record)
             return
-        worker = self._grab_idle_worker(node)
+        worker = self._grab_idle_worker(node, record.env_key)
         if worker is None:
-            self._request_worker(node)
+            self._request_worker(node, env_key=record.env_key,
+                                 env_spec=record.env_spec)
             asyncio.get_running_loop().call_later(
                 0.05, self._retry_place_actor, record)
             return

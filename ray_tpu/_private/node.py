@@ -177,6 +177,7 @@ class NodeAgent:
         self._store = None
 
     async def start(self):
+        self._loop = asyncio.get_running_loop()
         await self._start_obj_server()
         await self._connect_and_register()
         for _ in range(self.num_initial_workers):
@@ -307,15 +308,59 @@ class NodeAgent:
                             "node_id": self.node_id.binary(),
                             "resources": res})
 
-    def spawn_worker(self):
+    def spawn_worker(self, env_spec: Optional[dict] = None,
+                     env_key: str = ""):
+        if env_spec is not None:
+            # Venv workers: the (possibly minutes-long, cached-thereafter)
+            # environment build must not block the agent loop.
+            import threading
+
+            threading.Thread(target=self._spawn_env_worker,
+                             args=(env_spec, env_key), daemon=True).start()
+            return
+        self._spawn(sys.executable, worker_sys_path(), "")
+
+    def _spawn_env_worker(self, env_spec: dict, env_key: str):
+        """Build (or reuse) the spec's venv, then launch the worker under
+        the venv interpreter (reference: dedicated runtime-env workers
+        launched by the runtime-env agent, ``runtime_env/pip.py``)."""
+        try:
+            from ray_tpu.runtime_env.pip_env import ensure_venv
+
+            venv = ensure_venv(env_spec)
+            # venv site-packages FIRST so requested packages override the
+            # parent environment's copies; parent paths follow so the
+            # framework and its deps stay importable.
+            paths = venv["site"] + os.pathsep + worker_sys_path()
+            self._spawn(venv["python"], paths, env_key)
+        except Exception as e:  # noqa: BLE001
+            # Runs on a builder thread: transport writes must be
+            # marshalled onto the agent's event loop.
+            err = str(e)
+            self._loop.call_soon_threadsafe(self._send_spawn_failed, err)
+
+    def _send_spawn_failed(self, err: str):
+        if self.conn is not None and not self.conn.closed:
+            try:
+                self.conn.send({"t": "spawn_failed",
+                                "node_id": self.node_id.binary(),
+                                "err": err})
+            except ConnectionError:
+                pass
+
+    def _spawn(self, python: str, sys_path: str, env_key: str):
         env = dict(os.environ)
         env.update(self.env_overrides)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        env["RAY_TPU_SYS_PATH"] = worker_sys_path()
+        env["RAY_TPU_SYS_PATH"] = sys_path
+        if env_key:
+            env["RAY_TPU_ENV_KEY"] = env_key
+        else:
+            env.pop("RAY_TPU_ENV_KEY", None)
         # ``-S`` skips site processing (~2s in large venvs); the bootstrap
         # restores the parent's sys.path so imports resolve identically.
         proc = subprocess.Popen(
-            [sys.executable, "-S", "-c", _WORKER_BOOTSTRAP,
+            [python, "-S", "-c", _WORKER_BOOTSTRAP,
              "--gcs", self.gcs_address,
              "--node-id", self.node_id.hex(),
              "--session-dir", self.session_dir],
@@ -329,7 +374,7 @@ class NodeAgent:
     async def _on_msg(self, msg: dict):
         t = msg.get("t")
         if t == "spawn_worker":
-            self.spawn_worker()
+            self.spawn_worker(msg.get("env_spec"), msg.get("env_key", ""))
         elif t == "exit":
             self.stopped.set()
 

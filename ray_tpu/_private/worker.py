@@ -870,8 +870,22 @@ class Worker:
             for k in ("sched", "pg", "bix"):
                 if opts.get(k) is not None:
                     wire[k] = opts[k]
+            # Interpreter-level runtime envs (pip/uv) are satisfied at
+            # worker SPAWN (dedicated venv workers), so the env is part of
+            # the scheduling class: leases of different envs never mix.
+            renv = opts.get("runtime_env")
+            if renv and (renv.get("pip") is not None
+                         or renv.get("uv") is not None):
+                from ray_tpu.runtime_env.pip_env import (env_key,
+                                                         spawn_spec_from_renv)
+
+                spec = spawn_spec_from_renv(renv)
+                if spec is not None:
+                    wire["renv_spawn"] = spec
+                    wire["env_key"] = env_key(spec)
             key = repr((sorted(wire["res"].items()), wire.get("pg"),
-                        wire.get("bix"), wire.get("sched")))
+                        wire.get("bix"), wire.get("sched"),
+                        wire.get("env_key")))
             cached = opts["_cls"] = (key, wire)
         key, wire = cached
         item = _TaskItem(msg, oids, opts.get("retries", 0),

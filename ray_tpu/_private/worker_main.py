@@ -553,6 +553,9 @@ async def amain(args):
             "node_id": worker.node_id,
             "addr": "unix:" + listen_path,
             "pid": os.getpid(),
+            # Which interpreter-env pool this worker belongs to ("" =
+            # base image; otherwise a pip/uv venv key set at spawn).
+            "env_key": os.environ.get("RAY_TPU_ENV_KEY", ""),
         }
         if executor.actor_id is not None:
             # Resync after a GCS restart: re-claim our live actor so the

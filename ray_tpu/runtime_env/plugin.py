@@ -164,58 +164,53 @@ class PyModulesPlugin(RuntimeEnvPlugin):
 
 
 class PipPlugin(RuntimeEnvPlugin):
-    """pip requirements for a task/actor.
+    """pip requirements for a task/actor, satisfied by a DEDICATED worker
+    whose interpreter lives in a cached per-spec venv.
 
-    The reference materializes a virtualenv per requirements list
-    (``runtime_env/pip.py``). This build runs zero-egress, so by default the
-    plugin *verifies* the requested distributions are already importable in
-    the cluster image and fails fast with a clear error otherwise; set
-    ``RAY_TPU_RUNTIME_ENV_ALLOW_PIP=1`` to let workers shell out to pip
-    (air-gapped wheels / internal indexes).
+    The reference materializes a virtualenv per requirements list via the
+    runtime-env agent (``runtime_env/pip.py``); here the node agent builds
+    the venv at worker-spawn time (``pip_env.ensure_venv``) and the
+    scheduler keeps per-env worker pools, so by the time user code runs
+    the interpreter IS the environment — this plugin only sanity-checks
+    that routing on the worker side.
+
+    Note: installing from an index needs egress; hermetic setups pass
+    local wheel/source paths with ``{"packages": [...], "no_index": True}``.
     """
 
     name = "pip"
     priority = 3
+    tool = "pip"
 
     def validate(self, value):
-        if isinstance(value, dict):
-            value = value.get("packages", [])
-        if not isinstance(value, (list, tuple)):
-            raise ValueError("pip must be a list of requirements or "
-                             "{'packages': [...]}")
+        from .pip_env import normalize_spec
 
-    @staticmethod
-    def _dist_name(req: str) -> str:
-        for sep in ("==", ">=", "<=", "~=", ">", "<", "!", "[", ";", " "):
-            req = req.split(sep)[0]
-        return req.strip().replace("-", "_")
+        normalize_spec(value, self.tool)
+
+    def prepare(self, value, upload):
+        from .pip_env import normalize_spec
+
+        return normalize_spec(value, self.tool)
 
     def create(self, value, ctx, fetch):
-        pkgs = value.get("packages", value) if isinstance(value, dict) \
-            else value
-        if os.environ.get("RAY_TPU_RUNTIME_ENV_ALLOW_PIP") == "1":
-            import subprocess
-            import sys as _sys
+        from .pip_env import env_key, normalize_spec
 
-            subprocess.run([_sys.executable, "-m", "pip", "install",
-                            *pkgs], check=True)
-            ctx.taints_worker = True
-            return
-        missing = []
-        for req in pkgs:
-            name = self._dist_name(req)
-            if importlib.util.find_spec(name) is None:
-                try:
-                    import importlib.metadata as md
-
-                    md.distribution(name)
-                except Exception:
-                    missing.append(req)
-        if missing:
+        spec = normalize_spec(value, self.tool)
+        want = env_key(spec)
+        have = os.environ.get("RAY_TPU_ENV_KEY", "")
+        if have != want:
             raise RuntimeError(
-                f"runtime_env pip packages not present in the cluster image "
-                f"(zero-egress build; no installs): {missing}. Bake them "
-                f"into the image or set RAY_TPU_RUNTIME_ENV_ALLOW_PIP=1.")
+                f"task with runtime_env {self.tool} spec (env {want}) was "
+                f"dispatched to a worker in env {have or '<base>'} — "
+                f"scheduler env-pool routing failed")
+
+
+class UvPlugin(PipPlugin):
+    """uv-built environments (reference: ``runtime_env/uv.py``): same venv
+    semantics as pip, built with uv when the binary is present."""
+
+    name = "uv"
+    tool = "uv"
 
 
 class CondaPlugin(RuntimeEnvPlugin):
@@ -233,5 +228,5 @@ class CondaPlugin(RuntimeEnvPlugin):
 
 
 for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-           PipPlugin(), CondaPlugin()):
+           PipPlugin(), UvPlugin(), CondaPlugin()):
     register_plugin(_p)

@@ -74,13 +74,21 @@ def test_validate_rejects_unknown_and_conda():
         validate_runtime_env({"conda": "myenv"})
 
 
-def test_pip_verification_mode():
+def test_pip_env_routing_guard(monkeypatch):
+    """pip envs are satisfied at worker spawn (venv workers); the worker-
+    side plugin only checks the scheduler routed the task to a worker of
+    the right env pool (full isolation covered by test_runtime_env_pip)."""
+    from ray_tpu.runtime_env.pip_env import env_key, normalize_spec
+
+    spec = normalize_spec(["numpy"], "pip")
+    monkeypatch.setenv("RAY_TPU_ENV_KEY", env_key(spec))
     ctx = setup_runtime_env({"pip": ["numpy"]}, fetch=lambda u: None,
                             apply=False)
     assert isinstance(ctx, RuntimeEnvContext)
-    with pytest.raises(RuntimeError, match="not present"):
-        setup_runtime_env({"pip": ["definitely-not-a-real-pkg-xyz"]},
-                          fetch=lambda u: None, apply=False)
+    monkeypatch.setenv("RAY_TPU_ENV_KEY", "somethingelse")
+    with pytest.raises(RuntimeError, match="env-pool routing"):
+        setup_runtime_env({"pip": ["numpy"]}, fetch=lambda u: None,
+                          apply=False)
 
 
 def test_custom_plugin_roundtrip():
