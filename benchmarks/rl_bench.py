@@ -1,0 +1,68 @@
+"""RL throughput benchmark: PPO env-steps/second.
+
+The second north-star workload family (BASELINE.json: RLlib PPO
+env-steps/s/chip; the reference publishes no TPU numbers, so this
+establishes the framework's own baseline). Samples with N env-runner
+actors and updates on the GSPMD mesh learner.
+
+Run: ``python benchmarks/rl_bench.py`` — prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU policy/value nets: a tiny MLP is dispatch-bound on a TPU chip, and
+# on tunneled hosts the axon plugin would otherwise leak JAX_PLATFORMS
+# into -S workers that can't register it.
+os.environ.setdefault("RAY_TPU_JAX_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import ray_tpu  # noqa: E402
+
+
+def main():
+    iters = int(os.environ.get("RL_BENCH_ITERS", "8"))
+    runners = int(os.environ.get("RL_BENCH_RUNNERS", "2"))
+
+    from ray_tpu.rl import PPOConfig
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=runners,
+                         num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .learners(mesh_devices=int(os.environ.get(
+                "RL_BENCH_MESH", "1")) or None)
+            .training(train_batch_size=2048, minibatch_size=256,
+                      num_epochs=2)
+            ).build()
+    algo.train()  # warmup: compile + env spin-up
+    t0 = time.perf_counter()
+    steps = 0
+    reward = 0.0
+    for _ in range(iters):
+        out = algo.train()
+        steps += out["num_env_steps_sampled"]
+        reward = out.get("episode_return_mean") or reward
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "ppo_env_steps_per_sec",
+        "value": round(steps / dt, 1),
+        "unit": "env_steps/s",
+        "extra": {"iters": iters, "runners": runners,
+                  "episode_return_mean": round(float(reward or 0.0), 1),
+                  "seconds": round(dt, 2)},
+    }))
+    algo.stop()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ class AlgorithmConfig:
         self.num_envs_per_env_runner = 4
         self.rollout_fragment_length = 64
         self.num_learners = 1
+        self.learner_mesh_devices: Optional[int] = None
         self.use_tpu = False
         self.lr = 3e-4
         self.gamma = 0.99
@@ -66,11 +67,17 @@ class AlgorithmConfig:
         return self
 
     def learners(self, *, num_learners: Optional[int] = None,
-                 use_tpu: Optional[bool] = None, **kw) -> "AlgorithmConfig":
+                 use_tpu: Optional[bool] = None,
+                 mesh_devices: Optional[int] = None,
+                 **kw) -> "AlgorithmConfig":
         if num_learners is not None:
             self.num_learners = max(1, num_learners)
         if use_tpu is not None:
             self.use_tpu = use_tpu
+        if mesh_devices is not None:
+            # GSPMD learner: one process drives a mesh of this many
+            # devices; gradient sync is compiled in (ray_tpu.rl.mesh_learner).
+            self.learner_mesh_devices = max(1, mesh_devices)
         return self
 
     def training(self, *, lr=None, gamma=None, lambda_=None,
@@ -136,7 +143,8 @@ class Algorithm:
             self.learner_group = LearnerGroup(
                 self.module_cfg, config.hparams(),
                 num_learners=config.num_learners, use_tpu=config.use_tpu,
-                seed=config.seed)
+                seed=config.seed,
+                mesh_devices=config.learner_mesh_devices)
 
     def _probe_env_spaces(self) -> dict:
         import gymnasium as gym

@@ -111,15 +111,35 @@ class Learner:
 
 
 class LearnerGroup:
-    """N learner actors over batch shards (``learner_group.py:81`` analog)."""
+    """The learner tier (``learner_group.py:81`` analog).
+
+    Two scaling modes:
+      * ``mesh_devices=K`` (TPU-native default when devices are local):
+        ONE ``MeshLearnerActor`` drives a K-device GSPMD mesh — the
+        gradient sync is compiled into the step (XLA psum over ICI), no
+        actor choreography.
+      * ``num_learners=N`` (host tier): N actors average gradients over
+        the host collective — the reference's DDP-actor shape, kept for
+        CPU rigs and cross-host tiers.
+    """
 
     def __init__(self, module_cfg, hparams: dict, num_learners: int = 1,
-                 use_tpu: bool = False, seed: int = 0):
+                 use_tpu: bool = False, seed: int = 0,
+                 mesh_devices: Optional[int] = None):
         import cloudpickle
         import uuid
 
-        group_name = f"lg_{uuid.uuid4().hex[:8]}" if num_learners > 1 else None
         blob = cloudpickle.dumps(module_cfg)
+        self.mesh_devices = mesh_devices
+        if mesh_devices:
+            from .mesh_learner import MeshLearnerActor
+
+            opts = {"num_tpus": mesh_devices} if use_tpu else {}
+            self.learners = [MeshLearnerActor.options(**opts).remote(
+                blob, hparams, n_devices=mesh_devices, seed=seed)]
+            self.num_learners = 1
+            return
+        group_name = f"lg_{uuid.uuid4().hex[:8]}" if num_learners > 1 else None
         opts = {}
         if use_tpu:
             opts["num_tpus"] = 1

@@ -1,0 +1,72 @@
+"""GSPMD mesh learner: sharded update ≡ single-device update.
+
+Covers VERDICT round-1 item 9: the learner tier running a GSPMD-sharded
+update over a (virtual, 8-device CPU) mesh via the same ``parallel/``
+stack the multichip dryrun validates — replacing actor grad-averaging with
+a compiled-in psum (reference analog: ``learner_group.py:152-167`` DDP).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.mesh_learner import MeshLearner
+from ray_tpu.rl.rl_module import MLPModuleConfig
+
+
+def _fake_batch(n, obs_dim, num_actions, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "obs": rng.randn(n, obs_dim).astype(np.float32),
+        "actions": rng.randint(0, num_actions, size=n).astype(np.int64),
+        "logp": (-np.ones(n)).astype(np.float32),
+        "advantages": rng.randn(n).astype(np.float32),
+        "returns": rng.randn(n).astype(np.float32),
+        "values": rng.randn(n).astype(np.float32),
+    }
+
+
+def test_mesh_update_matches_single_device():
+    import jax
+
+    assert len(jax.devices()) >= 8  # conftest virtual CPU mesh
+    cfg = MLPModuleConfig(obs_dim=6, num_actions=3, hidden=(32, 32))
+    hp = {"lr": 1e-3, "minibatch_size": 64, "num_epochs": 2}
+    batch = _fake_batch(256, 6, 3)
+
+    mesh8 = MeshLearner(cfg, hp, n_devices=8, seed=7)
+    mesh1 = MeshLearner(cfg, hp, n_devices=1, seed=7)
+    stats8 = mesh8.update(batch)
+    stats1 = mesh1.update(batch)
+
+    # Same data, same init: the sharded step is numerically the same
+    # update (global reductions under GSPMD), up to float32 reduce order.
+    assert stats8["total_loss"] == pytest.approx(stats1["total_loss"],
+                                                 rel=1e-4)
+    w8 = jax.tree_util.tree_leaves(mesh8.get_weights())
+    w1 = jax.tree_util.tree_leaves(mesh1.get_weights())
+    for a, b in zip(w8, w1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ppo_on_mesh_learner_smoke():
+    import ray_tpu
+    from ray_tpu.rl import PPOConfig
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    try:
+        algo = (PPOConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=2,
+                             rollout_fragment_length=64)
+                .learners(mesh_devices=4)
+                .training(train_batch_size=256, minibatch_size=64,
+                          num_epochs=2)
+                ).build()
+        r1 = algo.train()
+        r2 = algo.train()
+        assert r2["num_env_steps_sampled"] > 0
+        assert "total_loss" in r2["learner"]
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
