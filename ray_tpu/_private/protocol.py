@@ -76,7 +76,17 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
         payload = await reader.readexactly(length)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
-    return msgpack.unpackb(payload, raw=False)
+    try:
+        return msgpack.unpackb(payload, raw=False)
+    except Exception:
+        # A malformed frame (e.g. int map keys, corrupt payload) must not
+        # kill the read loop — the length prefix keeps the stream
+        # consistent, so skip the frame and keep serving.
+        import logging
+
+        logging.getLogger(__name__).exception(
+            "dropping undecodable %d-byte frame", length)
+        return {}
 
 
 class Connection:
@@ -99,6 +109,11 @@ class Connection:
         self._handler = handler
         self._on_close = on_close
         self._pending: Dict[int, asyncio.Future] = {}
+        # Streaming requests (reference: streaming generators,
+        # _raylet.pyx ObjectRefGenerator): chunks arrive as unsolicited
+        # frames correlated by request id, the final frame closes the
+        # stream. Queue items: ("chunk", msg) | ("end", msg).
+        self._streams: Dict[int, asyncio.Queue] = {}
         self._req_ids = itertools.count(1)
         self._closed = False
         self._read_task: Optional[asyncio.Task] = None
@@ -148,7 +163,11 @@ class Connection:
                 # field but the two sides allocate ids independently, so a
                 # peer-initiated request must not be mistaken for a reply to
                 # ours (both directions issue requests on this connection).
-                if rid is not None and msg.get("r") and rid in self._pending:
+                if rid is not None and msg.get("sc") and rid in self._streams:
+                    self._streams[rid].put_nowait(("chunk", msg))
+                elif rid is not None and msg.get("r") and rid in self._streams:
+                    self._streams.pop(rid).put_nowait(("end", msg))
+                elif rid is not None and msg.get("r") and rid in self._pending:
                     fut = self._pending.pop(rid)
                     if not fut.done():
                         fut.set_result(msg)
@@ -167,6 +186,9 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionError("connection closed"))
         self._pending.clear()
+        for q in self._streams.values():
+            q.put_nowait(("end", {"err": "connection closed"}))
+        self._streams.clear()
         if self._on_close is not None:
             self._on_close()
 
@@ -204,6 +226,23 @@ class Connection:
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
+
+    def request_stream(self, msg: dict) -> asyncio.Queue:
+        """Send a streaming request; returns the chunk queue.
+
+        The peer answers with any number of ``{"i": rid, "sc": 1, ...}``
+        chunk frames followed by one normal reply frame that closes the
+        stream (("end", msg) in the queue).
+        """
+        if self._closed:
+            raise ConnectionError("connection closed")
+        _maybe_inject_failure(msg)
+        rid = next(self._req_ids)
+        msg["i"] = rid
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        self._write_frame(pack(msg))
+        return q
 
     def reply(self, req: dict, msg: dict):
         """Send the reply to a received request."""

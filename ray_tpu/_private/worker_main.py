@@ -36,6 +36,9 @@ from .serialization import INLINE_THRESHOLD, deserialize, pack_error, serialize
 from .worker import ObjectRef, Worker, set_global_worker
 
 
+_MISSING = object()
+
+
 class Executor:
     def __init__(self, worker: Worker, listen_path: str):
         self.worker = worker
@@ -110,6 +113,13 @@ class Executor:
             if not self._draining:
                 self._draining = True
                 asyncio.get_running_loop().create_task(self._drain_execs())
+        elif t == "stream_call":
+            # Streaming actor call (reference: streaming generators,
+            # _raylet.pyx:1079): generator results flow back as chunk
+            # frames on this connection; a single non-generator value is
+            # one chunk. The final reply frame closes the stream.
+            asyncio.get_running_loop().create_task(
+                self._run_stream_call(conn, msg))
         elif t == "cancel":
             self.cancel(msg["tid"], msg.get("force", False))
         elif t == "dag_input":
@@ -118,14 +128,18 @@ class Executor:
         elif t == "dag_setup":
             await self._dag_setup(conn, msg)
         elif t == "dag_register_sink":
-            d = self.dags.get(msg["dag"])
-            if d is not None:
-                d["sink"] = conn
-            conn.reply(msg, {"ok": d is not None})
+            stages = self.dags.get(msg["dag"])
+            if stages is not None:
+                for d in stages.values():
+                    if d["sink_outputs"]:
+                        d["sink"] = conn
+            conn.reply(msg, {"ok": stages is not None})
         elif t == "dag_teardown":
-            d = self.dags.pop(msg["dag"], None)
-            if d is not None and d.get("next") is not None:
-                await d["next"].close()
+            stages = self.dags.pop(msg["dag"], None)
+            for d in (stages or {}).values():
+                for target, _, _ in d["next"]:
+                    if not target.closed:
+                        await target.close()
             conn.reply(msg, {"ok": True})
         elif t == "ping":
             conn.reply(msg, {"ok": True})
@@ -138,52 +152,101 @@ class Executor:
     # one hop per stage instead of a driver round-trip per stage.
 
     async def _dag_setup(self, conn: protocol.Connection, msg: dict):
-        next_conn = None
-        if msg.get("next_addr"):
+        """Register one stage of a compiled DAG on this actor.
+
+        General topology (reference: arbitrary compiled DAGs with an
+        execution schedule, ``dag/compiled_dag_node.py:668`` +
+        ``dag_node_operation.py``): a stage declares how many value slots
+        it gathers per sequence number, bound constants, and a fan-out
+        list of downstream (addr, stage, slot) destinations and/or sink
+        output indices. Execution fires when all slots for a seq arrived.
+        """
+        conns: Dict[str, protocol.Connection] = {}
+        for dest in msg.get("next", []):
+            addr = dest["addr"]
+            if addr in conns:
+                continue
             try:
-                reader, writer = await protocol.connect(msg["next_addr"])
-                next_conn = protocol.Connection(reader, writer)
-                next_conn.start()
+                reader, writer = await protocol.connect(addr)
+                c = protocol.Connection(reader, writer)
+                c.start()
+                conns[addr] = c
             except OSError as e:
                 conn.reply(msg, {"ok": False, "err": str(e)})
                 return
-        self.dags[msg["dag"]] = {
-            "method": msg["m"], "next": next_conn, "sink": None}
+        self.dags.setdefault(msg["dag"], {})[msg["stage"]] = {
+            "method": msg["m"],
+            "slots": int(msg.get("slots", 1)),
+            "consts": dict(msg.get("consts") or {}),
+            "kwconsts": msg.get("kwconsts"),
+            "next": [(conns[d["addr"]], d["stage"], d["slot"])
+                     for d in msg.get("next", [])],
+            "sink_outputs": list(msg.get("sink_outputs", [])),
+            "sink": None,
+            "pending": {},  # seq -> {slot: (blob, err)}
+        }
         conn.reply(msg, {"ok": True})
 
     async def _run_dag_stage(self, conn: protocol.Connection, msg: dict):
         loop = asyncio.get_running_loop()
-        d = self.dags.get(msg["dag"])
+        stages = self.dags.get(msg["dag"])
+        d = stages.get(msg["stage"]) if stages else None
         if d is None:
             return
         seq = msg["seq"]
-        if msg.get("err"):
-            payload, err = msg["val"], True
+        got = d["pending"].setdefault(seq, {})
+        got[int(msg.get("slot", 0))] = (msg["val"], bool(msg.get("err")))
+        if len(got) < d["slots"]:
+            return
+        d["pending"].pop(seq, None)
+        upstream_err = next((v for v, e in got.values() if e), None)
+        if upstream_err is not None:
+            # Propagate the first upstream error without executing.
+            payload, err = upstream_err, True
         else:
             try:
                 payload = await loop.run_in_executor(
-                    self.pool, self._dag_stage_sync, d["method"], msg["val"])
+                    self.pool, self._dag_stage_sync, d,
+                    [got[i][0] for i in range(d["slots"])])
                 err = False
             except BaseException as e:  # noqa: BLE001
                 payload = pack_error(d["method"], e).to_bytes()
                 err = True
-        out = {"t": "dag_input", "dag": msg["dag"], "seq": seq,
-               "val": payload, "err": err}
-        target = d.get("next")
-        if target is None:
-            out["t"] = "dag_output"
-            target = d.get("sink")
-        if target is not None and not target.closed:
-            try:
-                target.send(out)
-            except ConnectionError:
-                pass
+        for target, stage, slot in d["next"]:
+            if not target.closed:
+                try:
+                    target.send({"t": "dag_input", "dag": msg["dag"],
+                                 "stage": stage, "slot": slot, "seq": seq,
+                                 "val": payload, "err": err})
+                except ConnectionError:
+                    pass
+        sink = d.get("sink")
+        if d["sink_outputs"] and sink is not None and not sink.closed:
+            for out_idx in d["sink_outputs"]:
+                try:
+                    sink.send({"t": "dag_output", "dag": msg["dag"],
+                               "out": out_idx, "seq": seq,
+                               "val": payload, "err": err})
+                except ConnectionError:
+                    pass
 
-    def _dag_stage_sync(self, method_name: str, blob) -> bytes:
+    def _dag_stage_sync(self, d: dict, blobs: List[Any]) -> bytes:
         if self.actor_instance is None:
             raise serialization.ActorDiedError("actor not initialized")
-        value = deserialize(memoryview(blob))
-        out = getattr(self.actor_instance, method_name)(value)
+        args: List[Any] = []
+        consts = d["consts"]
+        n_args = d["slots"] + len(consts)
+        bi = 0
+        for pos in range(n_args):
+            c = consts.get(pos, consts.get(str(pos), _MISSING))
+            if c is not _MISSING:
+                args.append(deserialize(memoryview(c)))
+            else:
+                args.append(deserialize(memoryview(blobs[bi])))
+                bi += 1
+        kwargs = (deserialize(memoryview(d["kwconsts"]))
+                  if d.get("kwconsts") else {})
+        out = getattr(self.actor_instance, d["method"])(*args, **kwargs)
         return serialize(out).to_bytes()
 
     # ------------------------------------------------------------ functions
@@ -483,6 +546,62 @@ class Executor:
         self.record_event(tid, method_name, "actor_call", t0, time.time(), ok)
         if not conn.closed:
             conn.reply(msg, {"results": results})
+
+    async def _run_stream_call(self, conn: protocol.Connection, msg: dict):
+        loop = asyncio.get_running_loop()
+
+        def send_chunk(value):
+            if not conn.closed:
+                try:
+                    conn.send({"i": msg["i"], "sc": 1,
+                               "val": serialize(value).to_bytes()})
+                except ConnectionError:
+                    pass
+
+        def finish(err: Optional[str] = None):
+            if not conn.closed:
+                reply = {"end": True}
+                if err is not None:
+                    reply["err"] = err
+                conn.reply(msg, reply)
+
+        try:
+            if self.actor_instance is None:
+                raise serialization.ActorDiedError("actor not initialized")
+            method = getattr(self.actor_instance, msg["m"])
+            args, kwargs = await loop.run_in_executor(
+                None, self._load_args, msg)
+            import inspect
+
+            if inspect.isasyncgenfunction(method):
+                out = method(*args, **kwargs)
+            else:
+                out = await loop.run_in_executor(
+                    self.pool, lambda: method(*args, **kwargs))
+            # Dispatch on what the call PRODUCED — wrappers (e.g. serve's
+            # replica dispatcher) are sync functions that may hand back a
+            # user generator/coroutine/async-generator.
+            if inspect.isasyncgen(out):
+                async for item in out:
+                    send_chunk(item)
+            elif inspect.iscoroutine(out):
+                out = await out
+                if inspect.isasyncgen(out):
+                    async for item in out:
+                        send_chunk(item)
+                else:
+                    send_chunk(out)
+            elif inspect.isgenerator(out):
+                def drain(gen=out):
+                    for item in gen:
+                        loop.call_soon_threadsafe(send_chunk, item)
+
+                await loop.run_in_executor(self.pool, drain)
+            else:
+                send_chunk(out)
+            finish()
+        except BaseException as e:  # noqa: BLE001
+            finish(f"{type(e).__name__}: {e}")
 
     def _execute_method_sync(self, method, msg: dict, tid: bytes,
                              nret: int) -> List[dict]:

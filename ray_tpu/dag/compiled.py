@@ -20,7 +20,8 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import protocol, serialization
 from ray_tpu._private.worker import global_worker
-from . import ClassMethodNode, ClassNode, DAGNode, InputNode, _HandleNode
+from . import (ClassMethodNode, ClassNode, DAGNode, InputNode,
+               MultiOutputNode, _HandleNode)
 
 
 class CompiledDAGRef:
@@ -31,15 +32,18 @@ class CompiledDAGRef:
         self._dag = dag
 
     def get(self, timeout: Optional[float] = None) -> Any:
-        blob, err = self._fut.result(timeout)
-        value = serialization.deserialize(memoryview(blob))
-        if err:
-            if isinstance(value, serialization.TaskError):
-                raise value.cause if isinstance(value.cause, Exception) \
-                    else value
-            raise value if isinstance(value, Exception) \
-                else RuntimeError(str(value))
-        return value
+        parts = self._fut.result(timeout)
+        values = []
+        for blob, err in parts:
+            value = serialization.deserialize(memoryview(blob))
+            if err:
+                if isinstance(value, serialization.TaskError):
+                    raise value.cause if isinstance(value.cause, Exception) \
+                        else value
+                raise value if isinstance(value, Exception) \
+                    else RuntimeError(str(value))
+            values.append(value)
+        return values if self._dag._multi else values[0]
 
 
 class CompiledDAG:
@@ -51,43 +55,73 @@ class CompiledDAG:
         self._seq = 0
         self._futures: Dict[int, SyncFuture] = {}
         self._inflight = threading.Semaphore(max_inflight)
-        self._input_conn: Optional[protocol.Connection] = None
-        self._sink_conn: Optional[protocol.Connection] = None
+        self._partials: Dict[int, Dict[int, tuple]] = {}  # seq -> out->val
         self._torn_down = False
         self._lock = threading.Lock()
         self._compile()
 
     # ------------------------------------------------------------- compile
 
-    def _linearize(self) -> List[ClassMethodNode]:
-        """Validate the DAG is a linear chain of actor-method calls fed by
-        one InputNode; return stages in execution order."""
-        order = [n for n in self._dag.topo_order()
+    def _plan(self):
+        """Build the stage graph: arbitrary topology of actor-method nodes
+        fed by one InputNode, ending at the root node (or MultiOutputNode
+        bundling several terminals). Reference: general compiled DAGs +
+        execution schedule (``dag/compiled_dag_node.py:668``)."""
+        root = self._dag
+        outputs: List[DAGNode]
+        if isinstance(root, MultiOutputNode):
+            outputs = list(root._bound_args)
+            self._n_outputs = len(outputs)
+            self._multi = True
+        else:
+            outputs = [root]
+            self._n_outputs = 1
+            self._multi = False
+        order = [n for n in root.topo_order()
                  if isinstance(n, ClassMethodNode)]
         if not order:
             raise ValueError(
                 "experimental_compile requires actor-method nodes "
                 "(use ActorClass.bind() / method.bind())")
-        prev: DAGNode = None
-        for i, node in enumerate(order):
-            value_args = [a for a in node._bound_args[1:]
-                          if isinstance(a, DAGNode)]
-            if len(node._bound_args) != 2 or node._bound_kwargs:
+        for out in outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor-method nodes")
+        stage_ids = {id(n): i for i, n in enumerate(order)}
+        plan = []
+        for n in order:
+            inputs = []   # (slot_pos, "input" | src_stage_id)
+            consts = {}   # arg position -> serialized constant
+            for pos, a in enumerate(n._bound_args[1:]):
+                if isinstance(a, InputNode):
+                    inputs.append((pos, "input"))
+                elif isinstance(a, ClassMethodNode):
+                    inputs.append((pos, stage_ids[id(a)]))
+                elif isinstance(a, DAGNode):
+                    raise ValueError(
+                        f"unsupported upstream node type {type(a).__name__}")
+                else:
+                    # str keys: msgpack peers reject int map keys
+                    # (strict_map_key), and a crashed read loop looks like
+                    # a silent hang.
+                    consts[str(pos)] = serialization.serialize(a).to_bytes()
+            if not inputs:
                 raise ValueError(
-                    "compiled DAGs support single-argument method stages; "
-                    f"stage {i} has {len(node._bound_args) - 1} args")
-            upstream = node._bound_args[1]
-            if i == 0:
-                if not isinstance(upstream, InputNode):
-                    raise ValueError("first stage must consume InputNode")
-            elif upstream is not prev:
-                raise ValueError(
-                    "compiled DAGs must form a linear chain; stage "
-                    f"{i}'s input is not stage {i - 1}'s output")
-            prev = node
-        if self._dag is not prev:
-            raise ValueError("the DAG output must be the last stage")
-        return order
+                    "every compiled stage needs at least one DAG input")
+            kwconsts = None
+            if n._bound_kwargs:
+                if any(isinstance(v, DAGNode)
+                       for v in n._bound_kwargs.values()):
+                    raise ValueError(
+                        "compiled DAGs do not support DAG-valued kwargs")
+                kwconsts = serialization.serialize(
+                    dict(n._bound_kwargs)).to_bytes()
+            plan.append({
+                "node": n, "stage": stage_ids[id(n)], "inputs": inputs,
+                "consts": consts, "kwconsts": kwconsts,
+                "sink_outputs": [i for i, o in enumerate(outputs)
+                                 if o is n],
+            })
+        return plan
 
     def _actor_handle(self, node: ClassMethodNode):
         parent = node._bound_args[0]
@@ -99,30 +133,55 @@ class CompiledDAG:
 
     def _compile(self):
         w = global_worker()
-        stages = self._linearize()
-        handles = [self._actor_handle(n) for n in stages]
+        plan = self._plan()
+        handles = [self._actor_handle(p["node"]) for p in plan]
         addrs = []
         for h in handles:
             ac = w.run_async(w._get_actor_conn(h._id))
             addrs.append(ac.addr)
-        # Set up stages back-to-front so downstream sockets exist first.
-        for i in reversed(range(len(stages))):
-            next_addr = addrs[i + 1] if i + 1 < len(stages) else None
-            ac = w.run_async(w._get_actor_conn(handles[i]._id))
+        # Consumer map: src stage -> [(dest addr, dest stage, dest slot)].
+        # A stage's value inputs are numbered by slot in arg order.
+        consumers: Dict[int, List[dict]] = {p["stage"]: [] for p in plan}
+        self._input_feeds = []  # [(stage, slot)] receiving the driver input
+        for p in plan:
+            for slot, (pos, src) in enumerate(p["inputs"]):
+                if src == "input":
+                    self._input_feeds.append((p["stage"], slot))
+                else:
+                    consumers[src].append({
+                        "addr": addrs[p["stage"]], "stage": p["stage"],
+                        "slot": slot})
+        # Set up stages downstream-first so destination sockets exist.
+        for p in reversed(plan):
+            ac = w.run_async(w._get_actor_conn(handles[p["stage"]]._id))
+            # Slot->arg-position mapping is implicit: value inputs retain
+            # their relative arg order, constants fill fixed positions.
             reply = w.run_async(ac.conn.request({
                 "t": "dag_setup", "dag": self._dag_id,
-                "m": stages[i]._method, "next_addr": next_addr}))
+                "stage": p["stage"], "m": p["node"]._method,
+                "slots": len(p["inputs"]),
+                "consts": p["consts"], "kwconsts": p["kwconsts"],
+                "next": consumers[p["stage"]],
+                "sink_outputs": p["sink_outputs"]}))
             if not reply.get("ok"):
                 raise RuntimeError(
-                    f"dag_setup failed on stage {i}: {reply.get('err')}")
-        # Dedicated driver connections: input to stage0, sink from last.
-        self._input_conn = w.run_async(self._open(addrs[0]))
-        self._sink_conn = w.run_async(self._open(addrs[-1],
-                                                 handler=self._on_sink))
-        reply = w.run_async(self._sink_conn.request(
-            {"t": "dag_register_sink", "dag": self._dag_id}))
-        if not reply.get("ok"):
-            raise RuntimeError("dag_register_sink failed")
+                    f"dag_setup failed on stage {p['stage']}: "
+                    f"{reply.get('err')}")
+        # Dedicated driver connections: inputs + one sink per terminal.
+        feed_addrs = {addrs[stage] for stage, _ in self._input_feeds}
+        self._feed_conns = {a: w.run_async(self._open(a))
+                            for a in feed_addrs}
+        self._feed_targets = [(addrs[stage], stage, slot)
+                              for stage, slot in self._input_feeds]
+        sink_addrs = {addrs[p["stage"]] for p in plan if p["sink_outputs"]}
+        self._sink_conns = []
+        for a in sink_addrs:
+            c = w.run_async(self._open(a, handler=self._on_sink))
+            reply = w.run_async(c.request(
+                {"t": "dag_register_sink", "dag": self._dag_id}))
+            if not reply.get("ok"):
+                raise RuntimeError("dag_register_sink failed")
+            self._sink_conns.append(c)
         self._handles = handles
 
     async def _open(self, addr: str, handler=None) -> protocol.Connection:
@@ -134,9 +193,15 @@ class CompiledDAG:
     async def _on_sink(self, msg: dict):
         if msg.get("t") != "dag_output" or msg.get("dag") != self._dag_id:
             return
-        fut = self._futures.pop(msg["seq"], None)
+        seq = msg["seq"]
+        parts = self._partials.setdefault(seq, {})
+        parts[msg.get("out", 0)] = (msg["val"], msg.get("err", False))
+        if len(parts) < self._n_outputs:
+            return
+        self._partials.pop(seq, None)
+        fut = self._futures.pop(seq, None)
         if fut is not None and not fut.done():
-            fut.set_result((msg["val"], msg.get("err", False)))
+            fut.set_result([parts[i] for i in range(self._n_outputs)])
         self._inflight.release()
 
     # ------------------------------------------------------------- execute
@@ -152,15 +217,17 @@ class CompiledDAG:
         self._futures[seq] = fut
         blob = serialization.serialize(value).to_bytes()
         w = global_worker()
-        w.loop.call_soon_threadsafe(self._send_input, {
-            "t": "dag_input", "dag": self._dag_id, "seq": seq, "val": blob})
+        w.loop.call_soon_threadsafe(self._send_input, seq, blob)
         return CompiledDAGRef(fut, self)
 
-    def _send_input(self, msg: dict):
+    def _send_input(self, seq: int, blob: bytes):
         try:
-            self._input_conn.send(msg)
+            for addr, stage, slot in self._feed_targets:
+                self._feed_conns[addr].send({
+                    "t": "dag_input", "dag": self._dag_id, "stage": stage,
+                    "slot": slot, "seq": seq, "val": blob, "err": False})
         except ConnectionError as e:
-            fut = self._futures.pop(msg["seq"], None)
+            fut = self._futures.pop(seq, None)
             if fut is not None and not fut.done():
                 fut.set_exception(e)
             self._inflight.release()
@@ -179,12 +246,12 @@ class CompiledDAG:
                     {"t": "dag_teardown", "dag": self._dag_id}), 5)
             except Exception:
                 pass
-        for conn in (self._input_conn, self._sink_conn):
-            if conn is not None:
-                try:
-                    w.run_async(conn.close())
-                except Exception:
-                    pass
+        for conn in (list(getattr(self, "_feed_conns", {}).values())
+                     + list(getattr(self, "_sink_conns", []))):
+            try:
+                w.run_async(conn.close())
+            except Exception:
+                pass
 
     def __del__(self):
         try:

@@ -90,6 +90,23 @@ class Replica:
             out = await out
         return out
 
+    def handle_request_stream(self, spec):
+        """Streaming dispatch: returns whatever the user callable produces
+        (generator / async generator / coroutine / value) — the worker's
+        stream_call executor drives it chunk by chunk."""
+        method, args, kwargs = spec
+        model_id = kwargs.pop("_multiplexed_model_id", "")
+        if model_id:
+            from .multiplex import _set_multiplexed_model_id
+
+            _set_multiplexed_model_id(model_id)
+        target = getattr(self.callable, method, None)
+        if target is None and method == "__call__":
+            target = self.callable
+        if target is None:
+            raise AttributeError(f"deployment has no method {method!r}")
+        return target(*args, **kwargs)
+
     def reconfigure(self, user_config):
         if hasattr(self.callable, "reconfigure"):
             self.callable.reconfigure(user_config)
@@ -200,6 +217,80 @@ class DeploymentHandle:
                 f"deployment {self.deployment_name!r} has no replicas")
         ref, done = self._submit(args, kwargs)
         return DeploymentResponse(ref, done)
+
+    async def stream(self, *args, **kwargs):
+        """Async generator over the replica method's yielded values.
+
+        The streaming ingress path (reference: Serve streaming responses,
+        ``serve/_private/proxy.py:1129`` + streaming generators): chunks
+        flow over the replica's direct channel as the generator produces
+        them — a non-generator handler yields exactly one chunk. Works
+        from any event loop: the transport runs on the runtime's IO loop;
+        foreign loops get chunks bridged thread-safely.
+        """
+        import asyncio
+
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        loop = asyncio.get_running_loop()
+        if loop is w.loop:
+            async for item in self._stream_on_io_loop(args, kwargs):
+                yield item
+            return
+        out_q: asyncio.Queue = asyncio.Queue()
+
+        async def pump():
+            try:
+                async for item in self._stream_on_io_loop(args, kwargs):
+                    loop.call_soon_threadsafe(out_q.put_nowait,
+                                              ("chunk", item))
+                loop.call_soon_threadsafe(out_q.put_nowait, ("end", None))
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(out_q.put_nowait, ("err", e))
+
+        asyncio.run_coroutine_threadsafe(pump(), w.loop)
+        while True:
+            kind, item = await out_q.get()
+            if kind == "chunk":
+                yield item
+            elif kind == "err":
+                raise item
+            else:
+                return
+
+    async def _stream_on_io_loop(self, args, kwargs):
+        from ray_tpu._private import serialization
+        from ray_tpu._private.worker import global_worker
+
+        if not self._replicas:
+            await self._refresh_async()
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas")
+        idx = self._pick()
+        replica = self._replicas[idx]
+        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        if self.multiplexed_model_id:
+            kwargs = {**kwargs,
+                      "_multiplexed_model_id": self.multiplexed_model_id}
+        w = global_worker()
+        try:
+            ch = await w._get_actor_conn(replica._actor_id)
+            q = ch.conn.request_stream({
+                "t": "stream_call", "m": "handle_request_stream",
+                "args": serialization.serialize(
+                    (((self.method_name, args, kwargs),), {})).to_bytes()})
+            while True:
+                kind, m = await q.get()
+                if kind == "chunk":
+                    yield serialization.deserialize(memoryview(m["val"]))
+                else:
+                    if m.get("err"):
+                        raise RuntimeError(m["err"])
+                    return
+        finally:
+            self._inflight[idx] = max(0, self._inflight.get(idx, 1) - 1)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

@@ -59,6 +59,17 @@ class ProxyActor:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         from aiohttp import web
 
+        def encode_chunk(item, sse: bool) -> bytes:
+            if isinstance(item, bytes):
+                raw = item
+            elif isinstance(item, (dict, list)):
+                raw = json.dumps(item).encode()
+            else:
+                raw = str(item).encode()
+            if sse:
+                return b"data: " + raw + b"\n\n"
+            return raw
+
         async def handler(request: "web.Request"):
             path = request.path
             match = None
@@ -73,15 +84,47 @@ class ProxyActor:
             req = Request(request.method, path, dict(request.query), body,
                           dict(request.headers))
             handle = self.handles[match]
+            # Stream-first (reference: Serve streaming responses,
+            # proxy.py:1129): the replica's generator chunks flow straight
+            # to the client; a non-generator handler produces exactly one
+            # chunk and falls through to the plain response shapes below.
+            gen = handle.stream(req)
             try:
-                result = await handle.remote(req)
+                first = await anext(gen)
+            except StopAsyncIteration:
+                return web.Response(status=204)
             except Exception as e:  # noqa: BLE001
                 return web.Response(status=500, text=str(e))
-            if isinstance(result, (dict, list)):
-                return web.json_response(result)
-            if isinstance(result, bytes):
-                return web.Response(body=result)
-            return web.Response(text=str(result))
+            try:
+                second = await anext(gen)
+            except StopAsyncIteration:
+                result = first
+                if isinstance(result, (dict, list)):
+                    return web.json_response(result)
+                if isinstance(result, bytes):
+                    return web.Response(body=result)
+                return web.Response(text=str(result))
+            except Exception as e:  # noqa: BLE001
+                return web.Response(status=500, text=str(e))
+            # ≥2 chunks: a real stream. SSE framing when the client asked
+            # for text/event-stream, raw chunked transfer otherwise.
+            sse = "text/event-stream" in request.headers.get("Accept", "")
+            resp = web.StreamResponse(headers={
+                "Content-Type": ("text/event-stream" if sse
+                                 else "text/plain; charset=utf-8"),
+                "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            await resp.write(encode_chunk(first, sse))
+            await resp.write(encode_chunk(second, sse))
+            try:
+                async for item in gen:
+                    await resp.write(encode_chunk(item, sse))
+            except Exception as e:  # noqa: BLE001
+                await resp.write(encode_chunk(
+                    {"error": str(e)} if sse else f"[stream error: {e}]",
+                    sse))
+            await resp.write_eof()
+            return resp
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", handler)

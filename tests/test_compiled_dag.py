@@ -114,3 +114,78 @@ def test_compiled_faster_than_uncompiled(ray_cluster):
             f"uncompiled {t_uncompiled:.4f}s")
     finally:
         cdag.teardown()
+
+
+def test_compiled_dag_fan_out_fan_in(ray_cluster):
+    """General topology (reference: arbitrary compiled DAGs,
+    dag/compiled_dag_node.py:668): one input fans out to two actors whose
+    outputs fan IN to a combiner stage."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode, experimental_compile
+
+    @ray_tpu.remote
+    class Doubler:
+        def run(self, x):
+            return x * 2
+
+    @ray_tpu.remote
+    class Squarer:
+        def run(self, x):
+            return x * x
+
+    @ray_tpu.remote
+    class Combiner:
+        def run(self, a, b):
+            return a + b
+
+    d, s, c = Doubler.remote(), Squarer.remote(), Combiner.remote()
+    with InputNode() as inp:
+        dag = c.run.bind(d.run.bind(inp), s.run.bind(inp))
+    compiled = experimental_compile(dag)
+    try:
+        for x in (3, 5, 10):
+            assert compiled.execute(x).get(timeout=30) == 2 * x + x * x
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_multi_output(ray_cluster):
+    import ray_tpu
+    from ray_tpu.dag import InputNode, MultiOutputNode, experimental_compile
+
+    @ray_tpu.remote
+    class AddN:
+        def __init__(self, n):
+            self.n = n
+
+        def run(self, x):
+            return x + self.n
+
+    a1, a2 = AddN.remote(10), AddN.remote(100)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a1.run.bind(inp), a2.run.bind(inp)])
+    compiled = experimental_compile(dag)
+    try:
+        assert compiled.execute(5).get(timeout=30) == [15, 105]
+        assert compiled.execute(7).get(timeout=30) == [17, 107]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_constant_args(ray_cluster):
+    import ray_tpu
+    from ray_tpu.dag import InputNode, experimental_compile
+
+    @ray_tpu.remote
+    class Scaler:
+        def run(self, x, factor, offset=0):
+            return x * factor + offset
+
+    sc = Scaler.remote()
+    with InputNode() as inp:
+        dag = sc.run.bind(inp, 3, offset=1)
+    compiled = experimental_compile(dag)
+    try:
+        assert compiled.execute(4).get(timeout=30) == 13
+    finally:
+        compiled.teardown()

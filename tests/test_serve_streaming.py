@@ -1,0 +1,109 @@
+"""Serve streaming responses + streaming actor calls.
+
+Covers the reference's streaming ingress (``serve/_private/proxy.py:1129``
+streaming/SSE responses — the LLM-serving table stake) and the core
+streaming-generator capability it builds on (``_raylet.pyx:1079``):
+generator deployment handlers stream chunk-by-chunk over the replica's
+direct channel, through the handle API and over HTTP (chunked + SSE).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_handle_stream_generator(cluster):
+    @serve.deployment
+    class Tokens:
+        def __call__(self, req):
+            n = int(req.query_params.get("n", 4))
+            for i in range(n):
+                yield f"tok{i}"
+
+    serve.run(Tokens.bind(), name="tok_app", route_prefix="/tok")
+    handle = serve.get_deployment_handle("Tokens", "tok_app")
+
+    async def collect():
+        return [c async for c in handle.stream(
+            _FakeReq({"n": "5"}))]
+
+    class _FakeReq:
+        def __init__(self, q):
+            self.query_params = q
+
+        def __reduce__(self):
+            return (_FakeReq, (self.query_params,))
+
+    import asyncio
+
+    out = asyncio.run(collect())
+    assert out == [f"tok{i}" for i in range(5)]
+
+
+def test_http_streaming_chunked_and_sse(cluster):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, req):
+            for i in range(4):
+                yield {"chunk": i}
+
+    serve.run(Streamer.bind(), name="stream_app", route_prefix="/stream")
+    port = serve.get_proxy_port()
+    url = f"http://127.0.0.1:{port}/stream"
+
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        body = resp.read().decode()
+        assert resp.headers.get("Transfer-Encoding") == "chunked" or body
+    assert [json.loads(x) for x in
+            body.replace("}{", "}\n{").splitlines()] == [
+        {"chunk": i} for i in range(4)]
+
+    sse_req = urllib.request.Request(
+        url, headers={"Accept": "text/event-stream"})
+    with urllib.request.urlopen(sse_req, timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = resp.read().decode().strip().split("\n\n")
+    assert [json.loads(e[len("data: "):]) for e in events] == [
+        {"chunk": i} for i in range(4)]
+
+
+def test_http_non_streaming_unchanged(cluster):
+    @serve.deployment
+    def plain(req):
+        return {"ok": True, "echo": req.query_params.get("x", "")}
+
+    serve.run(plain.bind(), name="plain_app", route_prefix="/plain")
+    port = serve.get_proxy_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/plain?x=42", timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("application/json")
+        assert json.loads(resp.read()) == {"ok": True, "echo": "42"}
+
+
+def test_async_generator_handler(cluster):
+    @serve.deployment
+    class AsyncTokens:
+        async def __call__(self, req):
+            import asyncio
+
+            for i in range(3):
+                await asyncio.sleep(0.01)
+                yield f"a{i}"
+
+    serve.run(AsyncTokens.bind(), name="atok_app", route_prefix="/atok")
+    port = serve.get_proxy_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/atok", timeout=30) as resp:
+        assert resp.read().decode() == "a0a1a2"
