@@ -60,6 +60,33 @@ int main(int argc, char** argv) {
       return 1;
     }
     std::printf("error propagation OK\n");
+
+    // Actor API: create a Python actor, call methods over its direct
+    // channel, observe state, propagate errors.
+    ray_tpu::Actor counter = client.create_actor(
+        "cpp_counter_cls", {ray_tpu::Client::make_int(100)});
+    ray_tpu::Value v1 = counter.call(
+        "add", {ray_tpu::Client::make_int(5)});
+    ray_tpu::Value v2 = counter.call(
+        "add", {ray_tpu::Client::make_int(7)});
+    if (v1.i != 105 || v2.i != 112) {
+      std::fprintf(stderr, "actor calls wrong: %lld %lld\n",
+                   static_cast<long long>(v1.i),
+                   static_cast<long long>(v2.i));
+      return 1;
+    }
+    bool araised = false;
+    try {
+      counter.call("explode", {});
+    } catch (const std::runtime_error& e) {
+      araised = std::string(e.what()).find("remote error") == 0;
+    }
+    if (!araised) {
+      std::fprintf(stderr, "actor error not propagated\n");
+      return 1;
+    }
+    client.kill_actor(counter);
+    std::printf("actor API OK\n");
     std::printf("CPP-CLIENT-OK\n");
     return 0;
   } catch (const std::exception& e) {

@@ -261,149 +261,25 @@ class Unpacker {
 
 // ----------------------------------------------------------------- client
 
-class Client {
+namespace detail {
+
+// Framed msgpack socket shared by the GCS connection and direct actor
+// channels (wire format: uint32-LE length + msgpack payload — see
+// ray_tpu/_private/protocol.py).
+class Socket {
  public:
-  // address: "unix:/path/to/gcs.sock" or "host:port"
-  explicit Client(const std::string& address) {
-    connect_socket(address);
-    // hello handshake (role=driver; random worker id).
-    Packer p;
-    p.pack_map_header(5);
-    p.pack_str("t"); p.pack_str("hello");
-    p.pack_str("role"); p.pack_str("driver");
-    p.pack_str("worker_id"); p.pack_bin(random_bytes(16));
-    p.pack_str("pid"); p.pack_int(static_cast<int64_t>(::getpid()));
-    p.pack_str("i"); p.pack_int(next_id());
-    Value reply = request_raw(p.out, last_id_);
-    const Value* session = reply.get("session");
-    if (!session) throw std::runtime_error("hello failed");
-    session_ = session->s;
-  }
+  Socket() = default;
+  ~Socket() { close(); }
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
 
-  ~Client() {
-    if (fd_ >= 0) ::close(fd_);
-  }
-
-  const std::string& session() const { return session_; }
-
-  void kv_put(const std::string& key, const std::string& value,
-              const std::string& ns = "") {
-    Packer p;
-    p.pack_map_header(5);
-    p.pack_str("t"); p.pack_str("kv_put");
-    p.pack_str("k"); p.pack_str(key);
-    p.pack_str("v"); p.pack_bin(value);
-    p.pack_str("ns"); p.pack_str(ns);
-    p.pack_str("i"); p.pack_int(next_id());
-    request_raw(p.out, last_id_);
-  }
-
-  bool kv_get(const std::string& key, std::string* value,
-              const std::string& ns = "") {
-    Packer p;
-    p.pack_map_header(4);
-    p.pack_str("t"); p.pack_str("kv_get");
-    p.pack_str("k"); p.pack_str(key);
-    p.pack_str("ns"); p.pack_str(ns);
-    p.pack_str("i"); p.pack_int(next_id());
-    Value reply = request_raw(p.out, last_id_);
-    const Value* ok = reply.get("ok");
-    if (!ok || !ok->b) return false;
-    const Value* v = reply.get("v");
-    if (!v || v->is_nil()) return false;
-    *value = v->s;
-    return true;
-  }
-
-  // Invoke a Python function registered with
-  // ray_tpu.cross_language.register_function(name, fn).
-  // `args` is a packed msgpack ARRAY of the positional arguments.
-  // Returns the msgpack-encoded result payload.
-  Value call(const std::string& name, const std::vector<Value>& args,
-             double timeout_s = 60.0) {
-    std::string tid = random_bytes(16);
-    Packer p;
-    p.pack_map_header(5);
-    p.pack_str("t"); p.pack_str("submit");
-    p.pack_str("tid"); p.pack_bin(tid);
-    p.pack_str("fid"); p.pack_str(name);
-    p.pack_str("opts");
-    p.pack_map_header(4);
-    p.pack_str("res");
-    p.pack_map_header(1);
-    p.pack_str("CPU"); p.pack_double(1.0);
-    p.pack_str("name"); p.pack_str(name);
-    p.pack_str("xlang"); p.pack_bool(true);
-    p.pack_str("retries"); p.pack_int(0);
-    p.pack_str("args");
-    {
-      Packer inner;
-      inner.pack_array_header(static_cast<uint32_t>(args.size()));
-      for (const auto& a : args) inner.pack_value(a);
-      p.pack_bin(inner.out);
-    }
-    send_frame(p.out);
-    // Wait for the task_done push for our tid.
-    for (;;) {
-      Value msg = read_frame(timeout_s);
-      const Value* t = msg.get("t");
-      if (t && t->s == "task_done") {
-        const Value* got = msg.get("tid");
-        if (got && got->s == tid) {
-          const Value* results = msg.get("results");
-          if (!results || results->arr.empty())
-            throw std::runtime_error("task_done without results");
-          const Value* data = results->arr[0].get("data");
-          if (!data) throw std::runtime_error("non-inline xlang result");
-          Unpacker u(data->s.data(), data->s.size());
-          Value out = u.unpack();
-          const Value* err = out.get("__xlang_error__");
-          if (out.type == Value::MAP && err)
-            throw std::runtime_error("remote error: " + err->s);
-          return out;
-        }
-      }
-      // Unrelated pushes (metrics acks etc.) are skipped.
-    }
-  }
-
-  static Value make_int(int64_t v) {
-    Value x; x.type = Value::INT; x.i = v; return x;
-  }
-  static Value make_str(const std::string& s) {
-    Value x; x.type = Value::STR; x.s = s; return x;
-  }
-  static Value make_double(double d) {
-    Value x; x.type = Value::FLOAT; x.f = d; return x;
-  }
-
- private:
-  int fd_ = -1;
-  int64_t last_id_ = 0;
-  int64_t id_counter_ = 0;
-  std::string session_;
-
-  int64_t next_id() {
-    last_id_ = ++id_counter_;
-    return last_id_;
-  }
-
-  static std::string random_bytes(size_t n) {
-    static std::mt19937_64 rng(std::random_device{}());
-    std::string out(n, '\0');
-    for (size_t k = 0; k < n; ++k)
-      out[k] = static_cast<char>(rng() & 0xff);
-    return out;
-  }
-
-  void connect_socket(const std::string& address) {
+  void connect_to(const std::string& address) {
     if (address.rfind("unix:", 0) == 0) {
       fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
       sockaddr_un addr{};
       addr.sun_family = AF_UNIX;
       std::string path = address.substr(5);
-      std::strncpy(addr.sun_path, path.c_str(),
-                   sizeof(addr.sun_path) - 1);
+      std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
       if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
                     sizeof(addr)) != 0)
         throw std::runtime_error("connect failed: " + address);
@@ -425,6 +301,12 @@ class Client {
     ::freeaddrinfo(res);
     if (rc != 0) throw std::runtime_error("connect failed: " + address);
   }
+
+  void close() {
+    if (fd_ >= 0) { ::close(fd_); fd_ = -1; }
+  }
+
+  bool connected() const { return fd_ >= 0; }
 
   void send_frame(const std::string& payload) {
     uint32_t len = static_cast<uint32_t>(payload.size());
@@ -451,15 +333,19 @@ class Client {
     return u.unpack();
   }
 
-  Value request_raw(const std::string& payload, int64_t want_id) {
+  Value request(const std::string& payload, int64_t want_id,
+                double timeout_s = 30.0) {
     send_frame(payload);
     for (;;) {
-      Value msg = read_frame(30.0);
+      Value msg = read_frame(timeout_s);
       const Value* rid = msg.get("i");
       const Value* is_reply = msg.get("r");
       if (rid && is_reply && rid->i == want_id) return msg;
     }
   }
+
+ private:
+  int fd_ = -1;
 
   void set_timeout(double seconds) {
     timeval tv{};
@@ -484,6 +370,230 @@ class Client {
       data += r;
       n -= static_cast<size_t>(r);
     }
+  }
+};
+
+inline std::string random_bytes(size_t n) {
+  static std::mt19937_64 rng(std::random_device{}());
+  std::string out(n, '\0');
+  for (size_t k = 0; k < n; ++k)
+    out[k] = static_cast<char>(rng() & 0xff);
+  return out;
+}
+
+inline Value unpack_xlang_result(const Value& reply) {
+  const Value* results = reply.get("results");
+  if (!results || results->arr.empty())
+    throw std::runtime_error("reply without results");
+  const Value* data = results->arr[0].get("data");
+  if (!data) throw std::runtime_error("non-inline xlang result");
+  Unpacker u(data->s.data(), data->s.size());
+  Value out = u.unpack();
+  const Value* err = out.get("__xlang_error__");
+  if (out.type == Value::MAP && err)
+    throw std::runtime_error("remote error: " + err->s);
+  return out;
+}
+
+inline std::string pack_xlang_args(const std::vector<Value>& args) {
+  Packer inner;
+  inner.pack_array_header(static_cast<uint32_t>(args.size()));
+  for (const auto& a : args) inner.pack_value(a);
+  return inner.out;
+}
+
+}  // namespace detail
+
+class Client;
+
+// A handle to a Python actor created from C++ (reference: the C++ user
+// API actor surface, cpp/include/ray/api/actor_handle.h). Method calls
+// ride the actor\'s DIRECT channel — the same socket Python callers use —
+// with msgpack (xlang) argument/result encoding.
+class Actor {
+ public:
+  // Call a method with msgpack args; blocks for the msgpack result.
+  Value call(const std::string& method, const std::vector<Value>& args,
+             double timeout_s = 60.0) {
+    Packer p;
+    p.pack_map_header(8);
+    p.pack_str("t"); p.pack_str("actor_call");
+    p.pack_str("aid"); p.pack_bin(aid_);
+    p.pack_str("tid"); p.pack_bin(detail::random_bytes(16));
+    p.pack_str("m"); p.pack_str(method);
+    p.pack_str("nret"); p.pack_int(1);
+    p.pack_str("opts");
+    p.pack_map_header(1);
+    p.pack_str("xlang"); p.pack_bool(true);
+    p.pack_str("args"); p.pack_bin(detail::pack_xlang_args(args));
+    p.pack_str("i"); p.pack_int(++id_counter_);
+    Value reply = sock_.request(p.out, id_counter_, timeout_s);
+    return detail::unpack_xlang_result(reply);
+  }
+
+  const std::string& id() const { return aid_; }
+
+ private:
+  friend class Client;
+  Actor(const std::string& aid, const std::string& addr) : aid_(aid) {
+    sock_.connect_to(addr);
+  }
+
+  std::string aid_;
+  detail::Socket sock_;
+  int64_t id_counter_ = 1000;
+};
+
+class Client {
+ public:
+  // address: "unix:/path/to/gcs.sock" or "host:port"
+  explicit Client(const std::string& address) {
+    sock_.connect_to(address);
+    // hello handshake (role=driver; random worker id).
+    Packer p;
+    p.pack_map_header(5);
+    p.pack_str("t"); p.pack_str("hello");
+    p.pack_str("role"); p.pack_str("driver");
+    p.pack_str("worker_id"); p.pack_bin(detail::random_bytes(16));
+    p.pack_str("pid"); p.pack_int(static_cast<int64_t>(::getpid()));
+    p.pack_str("i"); p.pack_int(next_id());
+    Value reply = sock_.request(p.out, last_id_);
+    const Value* session = reply.get("session");
+    if (!session) throw std::runtime_error("hello failed");
+    session_ = session->s;
+  }
+
+  const std::string& session() const { return session_; }
+
+  void kv_put(const std::string& key, const std::string& value,
+              const std::string& ns = "") {
+    Packer p;
+    p.pack_map_header(5);
+    p.pack_str("t"); p.pack_str("kv_put");
+    p.pack_str("k"); p.pack_str(key);
+    p.pack_str("v"); p.pack_bin(value);
+    p.pack_str("ns"); p.pack_str(ns);
+    p.pack_str("i"); p.pack_int(next_id());
+    sock_.request(p.out, last_id_);
+  }
+
+  bool kv_get(const std::string& key, std::string* value,
+              const std::string& ns = "") {
+    Packer p;
+    p.pack_map_header(4);
+    p.pack_str("t"); p.pack_str("kv_get");
+    p.pack_str("k"); p.pack_str(key);
+    p.pack_str("ns"); p.pack_str(ns);
+    p.pack_str("i"); p.pack_int(next_id());
+    Value reply = sock_.request(p.out, last_id_);
+    const Value* ok = reply.get("ok");
+    if (!ok || !ok->b) return false;
+    const Value* v = reply.get("v");
+    if (!v || v->is_nil()) return false;
+    *value = v->s;
+    return true;
+  }
+
+  // Invoke a Python function registered with
+  // ray_tpu.cross_language.register_function(name, fn).
+  // `args` is a packed msgpack ARRAY of the positional arguments.
+  // Returns the msgpack-encoded result payload.
+  Value call(const std::string& name, const std::vector<Value>& args,
+             double timeout_s = 60.0) {
+    std::string tid = detail::random_bytes(16);
+    Packer p;
+    p.pack_map_header(5);
+    p.pack_str("t"); p.pack_str("submit");
+    p.pack_str("tid"); p.pack_bin(tid);
+    p.pack_str("fid"); p.pack_str(name);
+    p.pack_str("opts");
+    p.pack_map_header(4);
+    p.pack_str("res");
+    p.pack_map_header(1);
+    p.pack_str("CPU"); p.pack_double(1.0);
+    p.pack_str("name"); p.pack_str(name);
+    p.pack_str("xlang"); p.pack_bool(true);
+    p.pack_str("retries"); p.pack_int(0);
+    p.pack_str("args"); p.pack_bin(detail::pack_xlang_args(args));
+    sock_.send_frame(p.out);
+    // Wait for the task_done push for our tid.
+    for (;;) {
+      Value msg = sock_.read_frame(timeout_s);
+      const Value* t = msg.get("t");
+      if (t && t->s == "task_done") {
+        const Value* got = msg.get("tid");
+        if (got && got->s == tid) return detail::unpack_xlang_result(msg);
+      }
+      // Unrelated pushes (metrics acks etc.) are skipped.
+    }
+  }
+
+  // Create a Python actor from a class registered with
+  // ray_tpu.cross_language.register_function(name, cls) and return a
+  // direct-channel handle (reference: cpp/include/ray/api/ actor
+  // creation + handle surface).
+  Actor create_actor(const std::string& registered_class,
+                     const std::vector<Value>& init_args,
+                     double timeout_s = 60.0) {
+    std::string aid = detail::random_bytes(16);
+    Packer p;
+    p.pack_map_header(6);
+    p.pack_str("t"); p.pack_str("actor_create");
+    p.pack_str("aid"); p.pack_bin(aid);
+    p.pack_str("fid"); p.pack_str(registered_class);
+    p.pack_str("opts");
+    p.pack_map_header(2);
+    p.pack_str("xlang"); p.pack_bool(true);
+    p.pack_str("res");
+    p.pack_map_header(1);
+    p.pack_str("CPU"); p.pack_double(0.0);
+    p.pack_str("args"); p.pack_bin(detail::pack_xlang_args(init_args));
+    p.pack_str("i"); p.pack_int(next_id());
+    Value reply = sock_.request(p.out, last_id_, timeout_s);
+    const Value* ok = reply.get("ok");
+    if (!ok || !ok->b) throw std::runtime_error("actor_create failed");
+    // Resolve the direct-channel address (GCS waits while pending).
+    Packer g;
+    g.pack_map_header(3);
+    g.pack_str("t"); g.pack_str("actor_get");
+    g.pack_str("aid"); g.pack_bin(aid);
+    g.pack_str("i"); g.pack_int(next_id());
+    Value got = sock_.request(g.out, last_id_, timeout_s);
+    const Value* gok = got.get("ok");
+    const Value* addr = got.get("addr");
+    if (!gok || !gok->b || !addr)
+      throw std::runtime_error("actor did not become ready");
+    return Actor(aid, addr->s);
+  }
+
+  void kill_actor(const Actor& actor) {
+    Packer p;
+    p.pack_map_header(3);
+    p.pack_str("t"); p.pack_str("actor_kill");
+    p.pack_str("aid"); p.pack_bin(actor.id());
+    p.pack_str("no_restart"); p.pack_bool(true);
+    sock_.send_frame(p.out);
+  }
+
+  static Value make_int(int64_t v) {
+    Value x; x.type = Value::INT; x.i = v; return x;
+  }
+  static Value make_str(const std::string& s) {
+    Value x; x.type = Value::STR; x.s = s; return x;
+  }
+  static Value make_double(double d) {
+    Value x; x.type = Value::FLOAT; x.f = d; return x;
+  }
+
+ private:
+  detail::Socket sock_;
+  int64_t last_id_ = 0;
+  int64_t id_counter_ = 0;
+  std::string session_;
+
+  int64_t next_id() {
+    last_id_ = ++id_counter_;
+    return last_id_;
   }
 };
 

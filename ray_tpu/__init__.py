@@ -60,7 +60,8 @@ def init(address: Optional[str] = None, *,
          object_store_memory: Optional[int] = None,
          port: int = 0,
          host: str = "",
-         log_to_driver: bool = True):
+         log_to_driver: bool = True,
+         _system_config: Optional[Dict[str, Any]] = None):
     """Start (or connect to) a ray_tpu cluster.
 
     With no ``address``, spawns a head process (GCS + node agent + worker
@@ -73,6 +74,13 @@ def init(address: Optional[str] = None, *,
             return
         raise RuntimeError("ray_tpu.init() called twice; use "
                            "ignore_reinit_error=True to allow this.")
+    if _system_config:
+        # Central typed flags (reference: RayConfig _system_config,
+        # ray_config_def.h:21): installed BEFORE any session process
+        # spawns so the whole tree shares one table.
+        from ._private.config import set_system_config
+
+        set_system_config(_system_config)
     if address is None:
         # Submitted jobs / joined drivers auto-connect to their cluster
         # (reference: RAY_ADDRESS, python/ray/_private/worker.py:1262).

@@ -1,0 +1,131 @@
+"""Central typed flag registry.
+
+Analog of the reference's ``RayConfig`` macro file
+(``src/ray/common/ray_config_def.h:21`` — 219 typed flags, each settable
+via a ``RAY_*`` env var or ``_system_config`` at init, propagated to every
+process through the GCS). Here: one dataclass of typed fields; precedence
+is ``_system_config`` (explicit, via GCS KV) > ``RAY_TPU_<NAME>`` env var >
+default. Every process reads the same table; workers receive overrides in
+their session bootstrap (env) or from the GCS KV at connect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclasses.dataclass
+class RayTpuConfig:
+    # ---- scheduling / task submission
+    lease_window: int = 8           # in-flight pushes per leased worker
+    max_leases_per_class: int = 64
+    lease_idle_return_s: float = 0.25
+    task_pool_threads: int = 8      # concurrent plain tasks per worker
+    # ---- object store
+    store_capacity: int = 2 << 30   # logical capacity before evict/spill
+    arena_bytes: int = 4 << 30      # shm arena size (sparse)
+    pull_chunk_bytes: int = 4 << 20  # p2p transfer chunk
+    pull_window: int = 4            # outstanding chunks per pull
+    inline_threshold: int = 100 * 1024
+    # ---- fault tolerance
+    reconnect_attempts: int = 75    # GCS reconnect budget (x delay ~15s)
+    reconnect_delay_s: float = 0.2
+    driver_exit_grace_s: float = 3.0
+    actor_adoption_grace_s: float = 5.0
+    gcs_wal_compact_every: int = 50_000
+    # ---- observability
+    max_done_tasks: int = 10_000
+    max_task_events: int = 50_000
+    event_flush_interval_s: float = 0.5
+    # ---- data
+    data_memory_limit: int = 0      # 0 = auto (store capacity / 4)
+
+    @classmethod
+    def field_names(cls):
+        return [f.name for f in dataclasses.fields(cls)]
+
+    def apply_env(self) -> "RayTpuConfig":
+        """Overlay ``RAY_TPU_<NAME>`` env vars (typed parse)."""
+        for f in dataclasses.fields(self):
+            raw = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if raw is None:
+                continue
+            try:
+                if f.type in ("int", int):
+                    setattr(self, f.name, int(float(raw)))
+                elif f.type in ("float", float):
+                    setattr(self, f.name, float(raw))
+                elif f.type in ("bool", bool):
+                    setattr(self, f.name,
+                            raw.lower() in ("1", "true", "yes"))
+                else:
+                    setattr(self, f.name, raw)
+            except ValueError:
+                pass
+        return self
+
+    def apply_overrides(self, overrides: Dict[str, Any]) -> "RayTpuConfig":
+        """Overlay explicit ``_system_config`` entries (highest priority).
+        Unknown keys raise — typos in config must fail loudly."""
+        for k, v in (overrides or {}).items():
+            if k not in self.field_names():
+                raise ValueError(
+                    f"unknown _system_config key {k!r}; known: "
+                    f"{sorted(self.field_names())}")
+            setattr(self, k, v)
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+_lock = threading.Lock()
+_config: Optional[RayTpuConfig] = None
+_overrides: Dict[str, Any] = {}
+
+
+def config() -> RayTpuConfig:
+    """The process-wide flag table (env applied once, lazily)."""
+    global _config
+    with _lock:
+        if _config is None:
+            overrides = _overrides
+            if not overrides:
+                blob = os.environ.get("RAY_TPU_SYSTEM_CONFIG")
+                if blob:
+                    try:
+                        overrides = json.loads(blob)
+                    except ValueError:
+                        overrides = {}
+            _config = RayTpuConfig().apply_env().apply_overrides(overrides)
+        return _config
+
+
+def set_system_config(overrides: Dict[str, Any]):
+    """Install explicit overrides (driver: from ``init(_system_config=)``).
+
+    Also exported through the environment so every spawned session process
+    (head, agents, workers) sees the same table — the propagation role the
+    reference fills with GCS ``GetInternalConfig``."""
+    global _config, _overrides
+    with _lock:
+        _overrides = dict(overrides or {})
+        if _overrides:
+            os.environ["RAY_TPU_SYSTEM_CONFIG"] = json.dumps(_overrides)
+        else:
+            os.environ.pop("RAY_TPU_SYSTEM_CONFIG", None)
+        _config = None  # rebuilt with the new overlay on next read
+
+
+def reset_config():
+    """Test hook: drop the cached table so env changes take effect."""
+    global _config, _overrides
+    with _lock:
+        _config = None
+        _overrides = {}

@@ -390,9 +390,11 @@ class GcsServer:
         self._client_by_wid: Dict[bytes, ClientConn] = {}
         # Observability stores (reference: GcsTaskManager task-event store
         # gcs_task_manager.h:86; metrics agent metrics_agent.py). Both bounded.
+        from .config import config as _cfg
+
         self._done_tasks: deque = deque()  # TaskID, GC'd beyond max
-        self.max_done_tasks = 10_000
-        self.task_events: deque = deque(maxlen=50_000)
+        self.max_done_tasks = _cfg().max_done_tasks
+        self.task_events: deque = deque(maxlen=_cfg().max_task_events)
         # (sender_key, name, tags_tuple) -> metric dict
         self.metrics: Dict[tuple, dict] = {}
         self.counters: Dict[str, float] = {
@@ -414,12 +416,14 @@ class GcsServer:
         if persist:
             from .gcs_persistence import GcsLog
 
-            self.log = GcsLog(session_dir)
+            self.log = GcsLog(session_dir,
+                              compact_every=_cfg().gcs_wal_compact_every)
             self._replay_persisted()
         if self.resumed:
             # Adoption grace: actors not re-claimed by surviving workers
             # within the window get restarted (or declared dead).
-            self._adoption_deadline = time.time() + 5.0
+            self._adoption_deadline = (
+                time.time() + _cfg().actor_adoption_grace_s)
         else:
             self._adoption_deadline = 0.0
 
@@ -783,9 +787,12 @@ class GcsServer:
                 old = self._driver_exit_graces.pop(wid_b, None)
                 if old is not None:
                     old.cancel()
+                from .config import config as _cfg2
+
                 self._driver_exit_graces[wid_b] = \
                     asyncio.get_running_loop().call_later(
-                        3.0, self._driver_exit_after_grace, wid_b, client)
+                        _cfg2().driver_exit_grace_s,
+                        self._driver_exit_after_grace, wid_b, client)
             else:
                 self._on_driver_exit(client)
         elif client.role == "agent" and client.node_id is not None:

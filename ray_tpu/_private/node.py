@@ -25,7 +25,9 @@ from typing import Dict, List, Optional
 from . import protocol
 from .ids import NodeID
 
-DEFAULT_STORE_CAPACITY = 2 * 1024**3
+from .config import config as _cfg
+
+DEFAULT_STORE_CAPACITY = _cfg().store_capacity
 
 
 def default_session_root() -> str:

@@ -267,11 +267,16 @@ class Connection:
 
 
 async def reconnect_with_retry(attempt, *, should_stop=None,
-                               attempts: int = 75, delay: float = 0.2) -> bool:
+                               attempts: int = 0, delay: float = 0.0) -> bool:
     """Shared reconnect policy for every GCS client (driver, worker, node
     agent): retry ``attempt`` (an async callable performing connect +
     re-hello) for ~``attempts*delay`` seconds, returning True on success.
     One place to tune the retry budget for all peers."""
+    if not attempts or not delay:
+        from .config import config as _cfg
+
+        attempts = attempts or _cfg().reconnect_attempts
+        delay = delay or _cfg().reconnect_delay_s
     for _ in range(attempts):
         if should_stop is not None and should_stop():
             return False

@@ -133,7 +133,11 @@ def deserialize(data: memoryview, pin=None) -> Any:
     return pickle.loads(header["p"], buffers=buffers)
 
 
-INLINE_THRESHOLD = 100 * 1024  # match the reference's 100KB inline-return limit
+from .config import config as _cfg
+
+# Match the reference's 100KB inline-return limit (flag:
+# RAY_TPU_INLINE_THRESHOLD).
+INLINE_THRESHOLD = _cfg().inline_threshold
 
 
 class TaskError(Exception):

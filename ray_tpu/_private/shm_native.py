@@ -25,7 +25,10 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _lib = None
 _lib_lock = threading.Lock()
 
-DEFAULT_CAPACITY = 4 * 1024**3  # sparse mapping; pages commit on write
+from .config import config as _cfg
+
+# Sparse mapping; pages commit on write (flag: RAY_TPU_ARENA_BYTES).
+DEFAULT_CAPACITY = _cfg().arena_bytes
 
 
 def _build_lib() -> str:

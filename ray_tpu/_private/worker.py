@@ -162,10 +162,13 @@ class _TaskItem:
 
 
 # In-flight pipeline depth per leased worker: >1 overlaps the push/reply
-# hop with execution; the worker executes serially regardless.
-_LEASE_WINDOW = 8
-_MAX_LEASES_PER_CLASS = 64
-_LEASE_IDLE_RETURN_S = 0.25
+# hop with execution (flags in _private/config.py: RAY_TPU_LEASE_WINDOW,
+# RAY_TPU_MAX_LEASES_PER_CLASS, RAY_TPU_LEASE_IDLE_RETURN_S).
+from .config import config as _cfg
+
+_LEASE_WINDOW = _cfg().lease_window
+_MAX_LEASES_PER_CLASS = _cfg().max_leases_per_class
+_LEASE_IDLE_RETURN_S = _cfg().lease_idle_return_s
 
 
 class _ActorChannel:
@@ -578,8 +581,8 @@ class Worker:
             pass
         return data
 
-    _PULL_CHUNK = 4 << 20  # bytes per fetch (reference default: 5 MiB)
-    _PULL_WINDOW = 4  # outstanding chunk requests
+    _PULL_CHUNK = _cfg().pull_chunk_bytes  # per-fetch bytes (ref: 5 MiB)
+    _PULL_WINDOW = _cfg().pull_window  # outstanding chunk requests
 
     def _pull_from_peer(self, addr: str, object_id: ObjectID, nbytes: int):
         """Chunked direct pull from a holder node's agent into the local

@@ -55,8 +55,10 @@ class Executor:
         # (collective rendezvous, sleep, IO) must not wedge the ones queued
         # behind it — the thread pool gives queued tasks their own stack
         # while the GIL keeps CPU-bound work effectively serial.
-        self.task_pool = ThreadPoolExecutor(max_workers=8,
-                                            thread_name_prefix="task")
+        from .config import config as _cfg
+
+        self.task_pool = ThreadPoolExecutor(
+            max_workers=_cfg().task_pool_threads, thread_name_prefix="task")
         self.async_sem: Optional[asyncio.Semaphore] = None
         self.running_tasks: Dict[bytes, int] = {}  # tid -> thread ident
         self.cancelled: set = set()
@@ -513,7 +515,15 @@ class Executor:
     def _init_actor_sync(self, msg: dict):
         self._apply_runtime_env(msg.get("opts") or {})
         cls = self._get_function(msg["fid"])
-        args, kwargs = self._load_args(msg)
+        if (msg.get("opts") or {}).get("xlang"):
+            # Non-Python owner (C++ client): args are a msgpack array.
+            import msgpack
+
+            args = tuple(msgpack.unpackb(bytes(msg.get("args") or b"\x90"),
+                                         raw=False))
+            kwargs = {}
+        else:
+            args, kwargs = self._load_args(msg)
         self.actor_instance = cls(*args, **kwargs)
 
     async def _run_actor_call(self, conn: protocol.Connection, msg: dict):
@@ -539,7 +549,17 @@ class Executor:
                     self.pool, self._execute_method_sync, method, msg, tid,
                     nret)
         except BaseException as e:  # noqa: BLE001
-            results = self._error_results(tid, nret, method_name, e)
+            if (msg.get("opts") or {}).get("xlang"):
+                import msgpack
+
+                data = msgpack.packb(
+                    {"__xlang_error__": f"{type(e).__name__}: {e}"},
+                    use_bin_type=True)
+                results = [{"oid": ObjectID.for_task_return(
+                    TaskID(tid), 1).binary(), "nbytes": len(data),
+                    "data": data}]
+            else:
+                results = self._error_results(tid, nret, method_name, e)
             ok = False
         for r in results:
             r.pop("_err", None)
@@ -607,6 +627,18 @@ class Executor:
                              nret: int) -> List[dict]:
         self.running_tasks[tid] = threading.get_ident()
         try:
+            if (msg.get("opts") or {}).get("xlang"):
+                # msgpack in / msgpack out so a non-Python caller reads
+                # the result bytes directly (cross-language actor calls).
+                import msgpack
+
+                args = tuple(msgpack.unpackb(
+                    bytes(msg.get("args") or b"\x90"), raw=False))
+                value = method(*args)
+                data = msgpack.packb(value, use_bin_type=True)
+                return [{"oid": ObjectID.for_task_return(
+                    TaskID(tid), 1).binary(), "nbytes": len(data),
+                    "data": data}]
             args, kwargs = self._load_args(msg)
             value = method(*args, **kwargs)
             values = self._split_returns(value, nret)

@@ -269,11 +269,11 @@ class Dataset:
         """Bytes of object store this stream may keep in flight
         (reference: backpressure policies bounding streaming execution by
         store usage, ``execution/backpressure_policy/``)."""
-        import os
+        from ray_tpu._private.config import config as _cfg
 
-        env = os.environ.get("RAY_TPU_DATA_MEMORY_LIMIT")
-        if env:
-            return int(env)
+        limit = _cfg().data_memory_limit
+        if limit:
+            return int(limit)
         try:
             cap = int(ray_tpu.cluster_resources().get(
                 "object_store_memory", 0))

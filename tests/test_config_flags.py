@@ -1,0 +1,63 @@
+"""Central typed flag registry (reference: ``RayConfig``,
+``src/ray/common/ray_config_def.h:21`` — typed flags settable via env or
+``_system_config`` at init, shared by every session process)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private.config import RayTpuConfig, config, reset_config, set_system_config
+
+
+def test_defaults_and_env_overlay(monkeypatch):
+    reset_config()
+    try:
+        assert config().lease_window == 8
+        monkeypatch.setenv("RAY_TPU_LEASE_WINDOW", "3")
+        monkeypatch.setenv("RAY_TPU_LEASE_IDLE_RETURN_S", "1.5")
+        reset_config()
+        assert config().lease_window == 3
+        assert config().lease_idle_return_s == 1.5
+    finally:
+        reset_config()
+
+
+def test_system_config_wins_and_validates(monkeypatch):
+    reset_config()
+    try:
+        monkeypatch.setenv("RAY_TPU_PULL_WINDOW", "2")
+        set_system_config({"pull_window": 9})
+        assert config().pull_window == 9  # explicit beats env
+        with pytest.raises(ValueError, match="unknown _system_config"):
+            set_system_config({"not_a_flag": 1})
+            config()
+    finally:
+        reset_config()
+        monkeypatch.delenv("RAY_TPU_SYSTEM_CONFIG", raising=False)
+
+
+def test_system_config_propagates_to_child_processes(monkeypatch):
+    """The whole session tree shares the table (reference: GCS
+    GetInternalConfig propagation)."""
+    reset_config()
+    try:
+        set_system_config({"lease_window": 5})
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, 'ray_tpu/..');"
+             "from ray_tpu._private.config import config;"
+             "print(config().lease_window)"],
+            capture_output=True, text=True, check=True,
+            cwd=__import__('os').path.dirname(
+                __import__('os').path.dirname(__file__)))
+        assert out.stdout.strip() == "5"
+    finally:
+        reset_config()
+        monkeypatch.delenv("RAY_TPU_SYSTEM_CONFIG", raising=False)
+
+
+def test_every_flag_has_a_typed_default():
+    cfg = RayTpuConfig()
+    for name in cfg.field_names():
+        assert isinstance(getattr(cfg, name), (int, float, str, bool))

@@ -40,8 +40,22 @@ def test_cpp_client_end_to_end(demo_binary, ray_cluster):
 
     cross_language.register_function("cpp_fails", boom)
 
+    class Counter:
+        def __init__(self, start):
+            self.x = start
+
+        def add(self, n):
+            self.x += n
+            return self.x
+
+        def explode(self):
+            raise RuntimeError("actor boom")
+
+    cross_language.register_function("cpp_counter_cls", Counter)
+
     address = global_worker().gcs_address
     proc = subprocess.run([demo_binary, address], capture_output=True,
                           text=True, timeout=120)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "CPP-CLIENT-OK" in proc.stdout
+    assert "actor API OK" in proc.stdout
