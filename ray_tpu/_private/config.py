@@ -67,7 +67,11 @@ class RayTpuConfig:
                 else:
                     setattr(self, f.name, raw)
             except ValueError:
-                pass
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring unparseable %s%s=%r (expected %s)",
+                    _ENV_PREFIX, f.name.upper(), raw, f.type)
         return self
 
     def apply_overrides(self, overrides: Dict[str, Any]) -> "RayTpuConfig":
@@ -88,6 +92,16 @@ class RayTpuConfig:
 _lock = threading.Lock()
 _config: Optional[RayTpuConfig] = None
 _overrides: Dict[str, Any] = {}
+_refresh_hooks = []
+
+
+def on_config_change(fn):
+    """Register a callback run after ``set_system_config`` rebuilds the
+    table. Modules that snapshot flags into constants at import time
+    (hot-path reads) use this to re-snapshot, so driver-side
+    ``_system_config`` overrides land even though the package was already
+    imported when ``init()`` ran."""
+    _refresh_hooks.append(fn)
 
 
 def config() -> RayTpuConfig:
@@ -102,6 +116,11 @@ def config() -> RayTpuConfig:
                     try:
                         overrides = json.loads(blob)
                     except ValueError:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "malformed RAY_TPU_SYSTEM_CONFIG blob ignored; "
+                            "this process runs with env/default flags only")
                         overrides = {}
             _config = RayTpuConfig().apply_env().apply_overrides(overrides)
         return _config
@@ -114,6 +133,13 @@ def set_system_config(overrides: Dict[str, Any]):
     (head, agents, workers) sees the same table — the propagation role the
     reference fills with GCS ``GetInternalConfig``."""
     global _config, _overrides
+    # Validate BEFORE exporting to the environment: a typo'd key must fail
+    # loudly here in the driver, not crash every spawned child at import.
+    known = RayTpuConfig.field_names()
+    for k in (overrides or {}):
+        if k not in known:
+            raise ValueError(
+                f"unknown _system_config key {k!r}; known: {sorted(known)}")
     with _lock:
         _overrides = dict(overrides or {})
         if _overrides:
@@ -121,6 +147,8 @@ def set_system_config(overrides: Dict[str, Any]):
         else:
             os.environ.pop("RAY_TPU_SYSTEM_CONFIG", None)
         _config = None  # rebuilt with the new overlay on next read
+    for fn in _refresh_hooks:  # outside the lock: hooks call config()
+        fn()
 
 
 def reset_config():
@@ -129,3 +157,5 @@ def reset_config():
     with _lock:
         _config = None
         _overrides = {}
+    for fn in _refresh_hooks:  # keep import-time snapshots in sync
+        fn()

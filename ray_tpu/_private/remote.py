@@ -15,8 +15,9 @@ from typing import Any, Dict, List, Optional, Union
 
 import cloudpickle
 
+from . import serialization
 from .ids import ActorID
-from .serialization import INLINE_THRESHOLD, serialize
+from .serialization import serialize
 from .worker import ObjectRef, global_worker
 
 _DEFAULT_TASK_OPTS = dict(
@@ -123,7 +124,7 @@ def _prepare_args(args: tuple, kwargs: dict,
         if deps:
             out["deps"] = deps
     sobj = serialize((args, kwargs))
-    if sobj.total_size <= INLINE_THRESHOLD:
+    if sobj.total_size <= serialization.INLINE_THRESHOLD:
         out["args"] = sobj.to_bytes()
         return out
     oid = w.put_serialized(sobj)

@@ -133,11 +133,22 @@ def deserialize(data: memoryview, pin=None) -> Any:
     return pickle.loads(header["p"], buffers=buffers)
 
 
-from .config import config as _cfg
+from .config import config as _cfg, on_config_change as _on_cfg_change
 
 # Match the reference's 100KB inline-return limit (flag:
-# RAY_TPU_INLINE_THRESHOLD).
+# RAY_TPU_INLINE_THRESHOLD). Read via ``serialization.INLINE_THRESHOLD``
+# (module attribute), not by-value import — the refresh hook below
+# re-snapshots it when ``init(_system_config=...)`` overrides flags after
+# this module was imported.
 INLINE_THRESHOLD = _cfg().inline_threshold
+
+
+def _refresh_flags():
+    global INLINE_THRESHOLD
+    INLINE_THRESHOLD = _cfg().inline_threshold
+
+
+_on_cfg_change(_refresh_flags)
 
 
 class TaskError(Exception):

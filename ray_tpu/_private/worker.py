@@ -26,7 +26,6 @@ from .object_store import make_store
 from .serialization import (
     ActorDiedError,
     GetTimeoutError,
-    INLINE_THRESHOLD,
     TaskError,
     deserialize,
     serialize,
@@ -163,12 +162,26 @@ class _TaskItem:
 
 # In-flight pipeline depth per leased worker: >1 overlaps the push/reply
 # hop with execution (flags in _private/config.py: RAY_TPU_LEASE_WINDOW,
-# RAY_TPU_MAX_LEASES_PER_CLASS, RAY_TPU_LEASE_IDLE_RETURN_S).
-from .config import config as _cfg
+# RAY_TPU_MAX_LEASES_PER_CLASS, RAY_TPU_LEASE_IDLE_RETURN_S). Snapshotted
+# into constants for the hot loops; the refresh hook re-snapshots when
+# ``init(_system_config=...)`` overrides flags post-import.
+from .config import config as _cfg, on_config_change as _on_cfg_change
 
 _LEASE_WINDOW = _cfg().lease_window
 _MAX_LEASES_PER_CLASS = _cfg().max_leases_per_class
 _LEASE_IDLE_RETURN_S = _cfg().lease_idle_return_s
+
+
+def _refresh_flags():
+    global _LEASE_WINDOW, _MAX_LEASES_PER_CLASS, _LEASE_IDLE_RETURN_S
+    _LEASE_WINDOW = _cfg().lease_window
+    _MAX_LEASES_PER_CLASS = _cfg().max_leases_per_class
+    _LEASE_IDLE_RETURN_S = _cfg().lease_idle_return_s
+    Worker._PULL_CHUNK = _cfg().pull_chunk_bytes
+    Worker._PULL_WINDOW = _cfg().pull_window
+
+
+_on_cfg_change(_refresh_flags)
 
 
 class _ActorChannel:
@@ -703,7 +716,7 @@ class Worker:
         """
         oid = ObjectID.for_put(self._put_counter.next())
         sobj = serialize(value)
-        if sobj.total_size <= INLINE_THRESHOLD:
+        if sobj.total_size <= serialization.INLINE_THRESHOLD:
             data = sobj.to_bytes()
             self._memory_store[oid] = data
             self.send_gcs_threadsafe({

@@ -1,4 +1,6 @@
 from .algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
+from .appo import APPO, APPOConfig
+from .cql import CQL
 from .connectors import (ClipRewards, ConnectorPipeline, FlattenObs,
                          GAEConnector, NormalizeObs, default_env_to_module,
                          default_learner_pipeline)
@@ -10,6 +12,7 @@ from .multi_agent import MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO
 from .offline import BC, MARWIL, episodes_to_rows
 from .replay import ReplayBuffer
 from .rl_module import MLPModuleConfig
+from .sac import SAC, SACConfig
 from .vtrace import vtrace
 
 __all__ = [
@@ -18,6 +21,7 @@ __all__ = [
     "LearnerGroup", "gae", "vtrace", "MLPModuleConfig", "ReplayBuffer",
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
     "BC", "MARWIL", "episodes_to_rows",
+    "SAC", "SACConfig", "APPO", "APPOConfig", "CQL",
     "ConnectorPipeline", "FlattenObs", "NormalizeObs", "ClipRewards",
     "GAEConnector", "default_env_to_module", "default_learner_pipeline",
 ]

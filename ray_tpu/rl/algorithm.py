@@ -132,13 +132,7 @@ class Algorithm:
         self.iteration = 0
         self._total_env_steps = 0
         probe = self._probe_env_spaces()
-        self.module_cfg = MLPModuleConfig(
-            obs_dim=probe["obs_dim"], num_actions=probe["num_actions"],
-            hidden=config.hidden)
-        self.env_runner_group = EnvRunnerGroup(
-            config.env, config.num_env_runners,
-            config.num_envs_per_env_runner, self.module_cfg,
-            env_fn=config.env_fn, seed=config.seed)
+        self._build_module_and_runners(probe)
         if self._uses_learner_group:
             self.learner_group = LearnerGroup(
                 self.module_cfg, config.hparams(),
@@ -155,6 +149,19 @@ class Algorithm:
         num_actions = int(env.action_space.n)
         env.close()
         return {"obs_dim": obs_dim, "num_actions": num_actions}
+
+    def _build_module_and_runners(self, probe: dict):
+        """Build ``self.module_cfg`` + ``self.env_runner_group`` from the
+        probed spaces. Continuous-control subclasses (SAC) override both
+        this and ``_probe_env_spaces``."""
+        config = self.config
+        self.module_cfg = MLPModuleConfig(
+            obs_dim=probe["obs_dim"], num_actions=probe["num_actions"],
+            hidden=config.hidden)
+        self.env_runner_group = EnvRunnerGroup(
+            config.env, config.num_env_runners,
+            config.num_envs_per_env_runner, self.module_cfg,
+            env_fn=config.env_fn, seed=config.seed)
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError

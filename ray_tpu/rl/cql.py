@@ -1,0 +1,228 @@
+"""CQL: conservative Q-learning for offline RL (continuous actions).
+
+Reference: ``rllib/algorithms/cql/`` (``cql.py``, ``torch/cql_torch_
+learner.py``) — SAC machinery plus the CQL(H) conservative penalty:
+``alpha_prime * (logsumexp_a Q(s,a) - Q(s, a_data))`` pushes Q down on
+out-of-distribution actions so the learned policy stays inside the
+dataset's support. Trains from a ``ray_tpu.data.Dataset`` of logged
+transitions the way BC/MARWIL do (``ray_tpu/rl/offline.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+class CQL:
+    """Offline SAC + conservative penalty, driven from a dataset of rows
+    with ``obs``, ``action`` (list[float]), ``reward``, ``next_obs``,
+    ``done`` columns."""
+
+    def __init__(self, obs_dim: int, act_dim: int, hidden=(256, 256),
+                 action_low: float = -1.0, action_high: float = 1.0,
+                 actor_lr: float = 3e-4, critic_lr: float = 3e-4,
+                 alpha_lr: float = 3e-4, gamma: float = 0.99,
+                 tau: float = 0.005, cql_alpha: float = 1.0,
+                 num_cql_actions: int = 4, bc_warmup_steps: int = 0,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .continuous import ContinuousModuleConfig, init_sac
+
+        self.cfg = ContinuousModuleConfig(
+            obs_dim=obs_dim, act_dim=act_dim, hidden=tuple(hidden),
+            action_low=action_low, action_high=action_high)
+        params = init_sac(self.cfg, jax.random.PRNGKey(seed))
+        self.actor_opt = optax.adam(actor_lr)
+        self.critic_opt = optax.adam(critic_lr)
+        self.alpha_opt = optax.adam(alpha_lr)
+        self.state = {
+            "params": params,
+            "target_q": {"q1": params["q1"], "q2": params["q2"]},
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+            "actor_opt": self.actor_opt.init(params["actor"]),
+            "critic_opt": self.critic_opt.init(
+                {"q1": params["q1"], "q2": params["q2"]}),
+            "alpha_opt": self.alpha_opt.init(jnp.asarray(0.0, jnp.float32)),
+        }
+        self.gamma = gamma
+        self.tau = tau
+        self.cql_alpha = cql_alpha
+        self.num_cql_actions = num_cql_actions
+        self.bc_warmup_steps = bc_warmup_steps
+        self.key = jax.random.PRNGKey(seed + 1)
+        self.iteration = 0
+        self._step = self._make_step()
+
+    def _make_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from . import continuous as C
+
+        cfg = self.cfg
+        gamma, tau = self.gamma, self.tau
+        cql_alpha = self.cql_alpha
+        n_act = self.num_cql_actions
+        target_entropy = -float(cfg.act_dim)
+        actor_opt, critic_opt, alpha_opt = (
+            self.actor_opt, self.critic_opt, self.alpha_opt)
+
+        def q_both(qp, obs, act):
+            return (C.q_forward(qp["q1"], obs, act),
+                    C.q_forward(qp["q2"], obs, act))
+
+        def critic_loss_fn(q_params, params, target_q, log_alpha, batch,
+                           key):
+            B = batch["obs"].shape[0]
+            k_next, k_rand, k_cur, k_nxtpi = jax.random.split(key, 4)
+            # --- SAC TD target ---
+            a2, logp2 = C.sample_squashed(params["actor"],
+                                          batch["next_obs"], k_next, cfg)
+            q1t = C.q_forward(target_q["q1"], batch["next_obs"], a2)
+            q2t = C.q_forward(target_q["q2"], batch["next_obs"], a2)
+            alpha = jnp.exp(log_alpha)
+            soft = jnp.minimum(q1t, q2t) - alpha * logp2
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+                jax.lax.stop_gradient(soft)
+            q1d, q2d = q_both(q_params, batch["obs"], batch["actions"])
+            td = 0.5 * (jnp.mean(jnp.square(q1d - target))
+                        + jnp.mean(jnp.square(q2d - target)))
+
+            # --- CQL(H) penalty: logsumexp over sampled actions ---
+            def tile(obs):
+                return jnp.repeat(obs, n_act, axis=0)  # [B*n, obs]
+
+            rand_a = jax.random.uniform(
+                k_rand, (B * n_act, cfg.act_dim),
+                minval=cfg.action_low, maxval=cfg.action_high)
+            cur_a, cur_lp = C.sample_squashed(
+                params["actor"], tile(batch["obs"]), k_cur, cfg)
+            nxt_a, nxt_lp = C.sample_squashed(
+                params["actor"], tile(batch["next_obs"]), k_nxtpi, cfg)
+            span = cfg.action_high - cfg.action_low
+            rand_lp = -cfg.act_dim * jnp.log(span)  # uniform density
+
+            def cat_q(qp_one):
+                qs = []
+                for a, lp in ((rand_a, rand_lp), (cur_a, cur_lp),
+                              (nxt_a, nxt_lp)):
+                    q = C.q_forward(qp_one, tile(batch["obs"]), a)
+                    # importance-weighted as in the CQL paper appendix F
+                    qs.append((q - jax.lax.stop_gradient(lp))
+                              .reshape(B, n_act))
+                return jnp.concatenate(qs, axis=1)  # [B, 3n]
+
+            gap1 = jnp.mean(jax.nn.logsumexp(cat_q(q_params["q1"]), axis=1)
+                            - q1d)
+            gap2 = jnp.mean(jax.nn.logsumexp(cat_q(q_params["q2"]), axis=1)
+                            - q2d)
+            penalty = cql_alpha * (gap1 + gap2)
+            loss = td + penalty
+            return loss, {"critic_loss": td, "cql_penalty": penalty,
+                          "q_data_mean": jnp.mean(q1d)}
+
+        def actor_loss_fn(actor_params, params, log_alpha, batch, key,
+                          bc_weight):
+            a, logp = C.sample_squashed(actor_params, batch["obs"], key, cfg)
+            q = jnp.minimum(C.q_forward(params["q1"], batch["obs"], a),
+                            C.q_forward(params["q2"], batch["obs"], a))
+            alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+            sac_loss = jnp.mean(alpha * logp - q)
+            # BC warmup (reference ``bc_iters``): regress toward data
+            # actions before trusting Q.
+            bc_loss = jnp.mean(jnp.square(a - batch["actions"]))
+            loss = jnp.where(bc_weight > 0.5, bc_loss, sac_loss)
+            return loss, {"actor_loss": loss, "entropy": -jnp.mean(logp),
+                          "_logp": jax.lax.stop_gradient(jnp.mean(logp))}
+
+        @jax.jit
+        def step(state, batch, key, bc_weight):
+            params, target_q, log_alpha = (
+                state["params"], state["target_q"], state["log_alpha"])
+            k1, k2 = jax.random.split(key)
+            q_params = {"q1": params["q1"], "q2": params["q2"]}
+            (_, cstats), q_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(
+                    q_params, params, target_q, log_alpha, batch, k1)
+            q_updates, state["critic_opt"] = critic_opt.update(
+                q_grads, state["critic_opt"], q_params)
+            q_params = optax.apply_updates(q_params, q_updates)
+            params = params | q_params
+
+            (_, astats), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True)(
+                    params["actor"], params, log_alpha, batch, k2,
+                    bc_weight)
+            a_updates, state["actor_opt"] = actor_opt.update(
+                a_grads, state["actor_opt"], params["actor"])
+            params = params | {"actor": optax.apply_updates(
+                params["actor"], a_updates)}
+
+            mean_logp = astats.pop("_logp")
+            al_grad = jax.grad(
+                lambda la: -la * (mean_logp + target_entropy))(log_alpha)
+            al_update, state["alpha_opt"] = alpha_opt.update(
+                al_grad, state["alpha_opt"], log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, al_update)
+
+            target_q = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                    target_q, q_params)
+            state = state | {"params": params, "target_q": target_q,
+                             "log_alpha": log_alpha}
+            return state, cstats | astats | {"alpha": jnp.exp(log_alpha)}
+
+        return step
+
+    @staticmethod
+    def _batch_from_rows(rows: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {
+            "obs": np.asarray([np.asarray(o, np.float32)
+                               for o in rows["obs"]]),
+            "actions": np.asarray([np.asarray(a, np.float32)
+                                   for a in rows["action"]]),
+            "rewards": np.asarray(rows["reward"], np.float32),
+            "next_obs": np.asarray([np.asarray(o, np.float32)
+                                    for o in rows["next_obs"]]),
+            "dones": np.asarray(rows["done"], np.float32),
+        }
+
+    def train_on_dataset(self, ds, *, epochs: int = 1,
+                         batch_size: int = 256) -> Dict[str, float]:
+        import jax
+
+        stats: Dict[str, Any] = {}
+        for _ in range(epochs):
+            for rows in ds.iter_batches(batch_size=batch_size,
+                                        batch_format="numpy"):
+                batch = self._batch_from_rows(rows)
+                self.key, sub = jax.random.split(self.key)
+                bc_w = np.float32(
+                    1.0 if self.iteration < self.bc_warmup_steps else 0.0)
+                self.state, stats = self._step(self.state, batch, sub, bc_w)
+                self.iteration += 1
+        return {k: float(v) for k, v in stats.items()}
+
+    def train_on_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self.key, sub = jax.random.split(self.key)
+        bc_w = np.float32(
+            1.0 if self.iteration < self.bc_warmup_steps else 0.0)
+        self.state, stats = self._step(self.state, batch, sub, bc_w)
+        self.iteration += 1
+        return {k: float(v) for k, v in stats.items()}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from .continuous import deterministic_action
+
+        return np.asarray(deterministic_action(
+            self.state["params"]["actor"], jnp.asarray(obs, jnp.float32),
+            self.cfg))

@@ -163,12 +163,13 @@ class EnvRunnerGroup:
     """Fault-aware group of sampling actors (EnvRunnerGroup analog)."""
 
     def __init__(self, env_id: str, num_runners: int, num_envs_per_runner: int,
-                 module_cfg, env_fn=None, seed: int = 0):
+                 module_cfg, env_fn=None, seed: int = 0, runner_cls=None):
         import cloudpickle
 
+        runner_cls = runner_cls or EnvRunner
         self.env_id = env_id
         self.num_envs_per_runner = num_envs_per_runner
-        self._make = lambda i: EnvRunner.options(max_restarts=2).remote(
+        self._make = lambda i: runner_cls.options(max_restarts=2).remote(
             env_id, num_envs_per_runner, cloudpickle.dumps(module_cfg),
             seed + i,
             cloudpickle.dumps(env_fn) if env_fn is not None else None)

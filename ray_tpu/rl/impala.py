@@ -67,6 +67,44 @@ class IMPALA(Algorithm):
                                   cfg.rollout_fragment_length)
             self._inflight[ref] = i
 
+    def _vtrace_train_batch(self, batch):
+        """V-trace-corrected train batch from a behaviour-policy rollout
+        batch. IMPALA corrects against the CURRENT policy (ratio 1 in the
+        downstream surrogate => pure vtrace policy gradient); APPO
+        overrides to keep the behaviour logp for its clipped surrogate and
+        to target-network the values."""
+        import jax
+        import jax.numpy as jnp
+
+        from . import rl_module
+
+        cfg = self.config
+        cur = ray_tpu.get(self.learner_group.get_weights_ref())
+        T, N = batch["rewards"].shape
+        flat_obs = batch["obs"].reshape(T * N, -1).astype(np.float32)
+        logits, values = rl_module.forward_jit(cur, jnp.asarray(flat_obs))
+        logp_all = np.asarray(jax.nn.log_softmax(logits))
+        tgt_logp = logp_all[
+            np.arange(T * N), batch["actions"].reshape(-1).astype(np.int64)
+        ].reshape(T, N)
+        tgt_values = np.asarray(values).reshape(T, N)
+        vs, pg_adv = vtrace(
+            batch["logp"], tgt_logp, batch["rewards"], tgt_values,
+            batch["dones"], batch["bootstrap_value"], cfg.gamma,
+            cfg.vtrace_clip_rho, cfg.vtrace_clip_c)
+        flat = lambda x: x.reshape(T * N, *x.shape[2:])  # noqa: E731
+        keep = flat(batch["mask"]) if "mask" in batch else \
+            np.ones(T * N, bool)
+        train_batch = {
+            "obs": flat_obs[keep],
+            "actions": flat(batch["actions"])[keep],
+            "logp": flat(tgt_logp).astype(np.float32)[keep],
+            "advantages": flat(pg_adv)[keep],
+            "returns": flat(vs)[keep],
+            "values": flat(tgt_values)[keep],
+        }
+        return train_batch, T, N
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         self._refill()
@@ -110,37 +148,7 @@ class IMPALA(Algorithm):
             return {"learner": {}, "num_env_steps_sampled": 0}
         self._refill()  # keep samplers busy while we update
 
-        # V-trace against the CURRENT policy's logp on the behaviour batch.
-        import jax.numpy as jnp
-
-        from . import rl_module
-
-        cur = ray_tpu.get(self.learner_group.get_weights_ref())
-        T, N = batch["rewards"].shape
-        flat_obs = batch["obs"].reshape(T * N, -1).astype(np.float32)
-        logits, values = rl_module.forward_jit(cur, jnp.asarray(flat_obs))
-        import jax
-
-        logp_all = np.asarray(jax.nn.log_softmax(logits))
-        tgt_logp = logp_all[
-            np.arange(T * N), batch["actions"].reshape(-1).astype(np.int64)
-        ].reshape(T, N)
-        tgt_values = np.asarray(values).reshape(T, N)
-        vs, pg_adv = vtrace(
-            batch["logp"], tgt_logp, batch["rewards"], tgt_values,
-            batch["dones"], batch["bootstrap_value"], cfg.gamma,
-            cfg.vtrace_clip_rho, cfg.vtrace_clip_c)
-        flat = lambda x: x.reshape(T * N, *x.shape[2:])  # noqa: E731
-        keep = flat(batch["mask"]) if "mask" in batch else \
-            np.ones(T * N, bool)
-        train_batch = {
-            "obs": flat_obs[keep],
-            "actions": flat(batch["actions"])[keep],
-            "logp": flat(tgt_logp).astype(np.float32)[keep],
-            "advantages": flat(pg_adv)[keep],
-            "returns": flat(vs)[keep],
-            "values": flat(tgt_values)[keep],
-        }
+        train_batch, T, N = self._vtrace_train_batch(batch)
         self._total_env_steps += T * N
         stats = self.learner_group.update(train_batch)
         self._updates_since_broadcast += 1

@@ -1,0 +1,276 @@
+"""SAC: soft actor-critic with twin Q, polyak targets, entropy autotune.
+
+Reference: ``rllib/algorithms/sac/`` (``sac.py`` config surface,
+``torch/sac_torch_learner.py`` losses — critic TD toward the entropy-
+regularized soft target, reparameterized actor loss against min(Q1,Q2),
+and temperature autotuning toward ``-act_dim`` target entropy). The
+update is one fused jitted step (critics + actor + alpha + polyak) so the
+whole thing is a single XLA program on the learner's device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunnerGroup
+from .replay import ReplayBuffer
+
+
+def make_sac_update(cfg, actor_opt, critic_opt, alpha_opt, hparams: dict):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from . import continuous as C
+
+    gamma = hparams.get("gamma", 0.99)
+    tau = hparams.get("tau", 0.005)
+    target_entropy = hparams.get("target_entropy", -float(cfg.act_dim))
+
+    def critic_loss_fn(q_params, params, target_q, log_alpha, batch, key):
+        a2, logp2 = C.sample_squashed(params["actor"], batch["next_obs"],
+                                      key, cfg)
+        q1t = C.q_forward(target_q["q1"], batch["next_obs"], a2)
+        q2t = C.q_forward(target_q["q2"], batch["next_obs"], a2)
+        alpha = jnp.exp(log_alpha)
+        soft = jnp.minimum(q1t, q2t) - alpha * logp2
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(soft)
+        q1 = C.q_forward(q_params["q1"], batch["obs"], batch["actions"])
+        q2 = C.q_forward(q_params["q2"], batch["obs"], batch["actions"])
+        loss = 0.5 * (jnp.mean(jnp.square(q1 - target))
+                      + jnp.mean(jnp.square(q2 - target)))
+        return loss, {"critic_loss": loss, "q_mean": jnp.mean(q1)}
+
+    def actor_loss_fn(actor_params, params, log_alpha, batch, key):
+        a, logp = C.sample_squashed(actor_params, batch["obs"], key, cfg)
+        q = jnp.minimum(C.q_forward(params["q1"], batch["obs"], a),
+                        C.q_forward(params["q2"], batch["obs"], a))
+        alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+        loss = jnp.mean(alpha * logp - q)
+        return loss, {"actor_loss": loss, "entropy": -jnp.mean(logp),
+                      "_logp": jax.lax.stop_gradient(jnp.mean(logp))}
+
+    def alpha_loss_fn(log_alpha, mean_logp):
+        return -log_alpha * (mean_logp + target_entropy)
+
+    @jax.jit
+    def step(state, batch, key):
+        params, target_q, log_alpha = (
+            state["params"], state["target_q"], state["log_alpha"])
+        k1, k2 = jax.random.split(key)
+        q_params = {"q1": params["q1"], "q2": params["q2"]}
+        (_, cstats), q_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True)(
+                q_params, params, target_q, log_alpha, batch, k1)
+        q_updates, state["critic_opt"] = critic_opt.update(
+            q_grads, state["critic_opt"], q_params)
+        q_params = optax.apply_updates(q_params, q_updates)
+        params = params | q_params
+
+        (_, astats), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True)(
+                params["actor"], params, log_alpha, batch, k2)
+        a_updates, state["actor_opt"] = actor_opt.update(
+            a_grads, state["actor_opt"], params["actor"])
+        params = params | {"actor": optax.apply_updates(params["actor"],
+                                                        a_updates)}
+
+        mean_logp = astats.pop("_logp")
+        al_grad = jax.grad(alpha_loss_fn)(log_alpha, mean_logp)
+        al_update, state["alpha_opt"] = alpha_opt.update(
+            al_grad, state["alpha_opt"], log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, al_update)
+
+        target_q = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                target_q, q_params)
+        state = state | {"params": params, "target_q": target_q,
+                         "log_alpha": log_alpha}
+        stats = cstats | astats | {"alpha": jnp.exp(log_alpha)}
+        return state, stats
+
+    return step
+
+
+@ray_tpu.remote
+class _SACLearner:
+    def __init__(self, module_cfg_blob: bytes, hparams: dict, seed: int = 0):
+        import cloudpickle
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from . import continuous as C
+
+        self.cfg = cloudpickle.loads(module_cfg_blob)
+        self.hparams = hparams
+        key = jax.random.PRNGKey(seed)
+        params = C.init_sac(self.cfg, key)
+        self.actor_opt = optax.adam(hparams.get("actor_lr", 3e-4))
+        self.critic_opt = optax.adam(hparams.get("critic_lr", 3e-4))
+        self.alpha_opt = optax.adam(hparams.get("alpha_lr", 3e-4))
+        self.state = {
+            "params": params,
+            "target_q": {"q1": params["q1"], "q2": params["q2"]},
+            "log_alpha": jnp.asarray(
+                np.log(hparams.get("initial_alpha", 1.0)), jnp.float32),
+            "actor_opt": self.actor_opt.init(params["actor"]),
+            "critic_opt": self.critic_opt.init(
+                {"q1": params["q1"], "q2": params["q2"]}),
+            "alpha_opt": self.alpha_opt.init(
+                jnp.asarray(0.0, jnp.float32)),
+        }
+        self.update_fn = make_sac_update(
+            self.cfg, self.actor_opt, self.critic_opt, self.alpha_opt,
+            hparams)
+        self.key = jax.random.PRNGKey(seed + 1)
+        self.updates_done = 0
+
+    def get_weights(self):
+        return self.state["params"]
+
+    def get_state(self) -> dict:
+        return {"state": self.state, "updates_done": self.updates_done}
+
+    def set_state(self, st: dict) -> bool:
+        self.state = st["state"]
+        self.updates_done = st.get("updates_done", 0)
+        return True
+
+    def train_on(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self.key, sub = jax.random.split(self.key)
+        jb = {k: v for k, v in batch.items() if k != "_indices"}
+        self.state, stats = self.update_fn(self.state, jb, sub)
+        self.updates_done += 1
+        return {k: float(v) for k, v in stats.items()}
+
+
+class SAC(Algorithm):
+    """training_step (reference ``sac.py``): sample stochastic transitions
+    → replay → ``num_updates`` fused soft-update steps."""
+
+    _uses_learner_group = False
+
+    def __init__(self, config: "SACConfig"):
+        super().__init__(config)
+        import cloudpickle
+
+        self.learner = _SACLearner.remote(
+            cloudpickle.dumps(self.module_cfg),
+            config.hparams() | {
+                "gamma": config.gamma, "tau": config.tau,
+                "actor_lr": config.lr, "critic_lr": config.critic_lr,
+                "alpha_lr": config.alpha_lr,
+                "initial_alpha": config.initial_alpha,
+                "target_entropy": config.target_entropy
+                if config.target_entropy is not None
+                else -float(self.module_cfg.act_dim)},
+            seed=config.seed)
+        self.replay = ReplayBuffer.remote(
+            capacity=config.replay_capacity, seed=config.seed)
+
+    def _probe_env_spaces(self) -> dict:
+        import gymnasium as gym
+
+        env = (self.config.env_fn() if self.config.env_fn is not None
+               else gym.make(self.config.env))
+        space = env.action_space
+        out = {
+            "obs_dim": int(np.prod(env.observation_space.shape)),
+            "act_dim": int(np.prod(space.shape)),
+            "action_low": float(np.min(space.low)),
+            "action_high": float(np.max(space.high)),
+        }
+        env.close()
+        return out
+
+    def _build_module_and_runners(self, probe: dict):
+        from .continuous import ContinuousEnvRunner, ContinuousModuleConfig
+
+        cfg = self.config
+        self.module_cfg = ContinuousModuleConfig(
+            obs_dim=probe["obs_dim"], act_dim=probe["act_dim"],
+            hidden=cfg.hidden, action_low=probe["action_low"],
+            action_high=probe["action_high"])
+        self.env_runner_group = EnvRunnerGroup(
+            cfg.env, cfg.num_env_runners, cfg.num_envs_per_env_runner,
+            self.module_cfg, env_fn=cfg.env_fn, seed=cfg.seed,
+            runner_cls=ContinuousEnvRunner)
+
+    def get_state(self) -> dict:
+        return {"learner": ray_tpu.get(self.learner.get_state.remote()),
+                "iteration": self.iteration}
+
+    def set_state(self, state: dict):
+        ray_tpu.get(self.learner.set_state.remote(state["learner"]))
+        self.iteration = state.get("iteration", 0)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        w = self.learner.get_weights.remote()
+        warmup = self._total_env_steps < cfg.learning_starts
+        rollouts = self.env_runner_group._fanout(
+            "sample_transitions", w, cfg.rollout_fragment_length, warmup)
+        batch = {k: np.concatenate([r[k] for r in rollouts])
+                 for k in rollouts[0]}
+        self._total_env_steps += len(batch["obs"])
+        size = ray_tpu.get(self.replay.add_batch.remote(batch))
+        stats: Dict[str, Any] = {}
+        if size >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                mb = ray_tpu.get(self.replay.sample.remote(
+                    cfg.train_batch_size))
+                if mb is None:
+                    break
+                mb.pop("_indices", None)
+                stats = ray_tpu.get(self.learner.train_on.remote(mb))
+        return {"learner": stats, "replay_size": size,
+                "num_env_steps_sampled": len(batch["obs"])}
+
+    def stop(self):
+        self.env_runner_group.shutdown()
+        for a in (self.learner, self.replay):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self.hidden = (256, 256)
+        self.lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.target_entropy = None  # default: -act_dim
+        self.replay_capacity = 100_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 32
+        self.rollout_fragment_length = 32
+
+    def training(self, *, tau=None, critic_lr=None, alpha_lr=None,
+                 initial_alpha=None, target_entropy=None,
+                 replay_capacity=None, learning_starts=None,
+                 num_updates_per_iter=None, **kw):
+        super().training(**kw)
+        for name, val in [
+                ("tau", tau), ("critic_lr", critic_lr),
+                ("alpha_lr", alpha_lr), ("initial_alpha", initial_alpha),
+                ("target_entropy", target_entropy),
+                ("replay_capacity", replay_capacity),
+                ("learning_starts", learning_starts),
+                ("num_updates_per_iter", num_updates_per_iter)]:
+            if val is not None:
+                setattr(self, name, val)
+        return self

@@ -213,6 +213,24 @@ def cmd_job(args):
             print(f"{j['job_id']}  {j['status']:10}  {j['entrypoint'][:60]}")
 
 
+def cmd_up(args):
+    """Cluster launcher (reference: ``ray up``, ``autoscaler/_private/
+    commands.py create_or_update_cluster``)."""
+    from ray_tpu.autoscaler import launcher
+
+    out = launcher.up(args.config, no_start=args.no_start)
+    print(f"head {out['head_instance']} at {out['head_ip']} "
+          f"({out['num_hosts']} host(s))")
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler import launcher
+
+    killed = launcher.down(args.config)
+    print(f"terminated {len(killed)} instance(s): {', '.join(killed)}"
+          if killed else "nothing to terminate")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -231,6 +249,16 @@ def main(argv=None):
     p = sub.add_parser("stop", help="stop the cluster")
     p.add_argument("--address", default="")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up", help="launch a cloud TPU cluster from YAML")
+    p.add_argument("config", help="cluster YAML (see autoscaler/launcher.py)")
+    p.add_argument("--no-start", action="store_true",
+                   help="provision + setup only, don't start the runtime")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a cloud TPU cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("status", help="cluster resource summary")
     p.add_argument("--address", default="")

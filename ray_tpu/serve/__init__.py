@@ -25,6 +25,7 @@ from .proxy import ProxyActor, Request
 
 _proxy = None
 _proxy_port: Optional[int] = None
+_proxy_rpc_port: Optional[int] = None
 
 
 def _collect_graph(app: Application, out: Dict[str, Application],
@@ -198,18 +199,27 @@ def run(target: Application, *, name: str = "default",
 
 
 def _ensure_proxy(port: int = 0):
-    global _proxy, _proxy_port
+    global _proxy, _proxy_port, _proxy_rpc_port
     if _proxy is not None:
         return
     _proxy = ProxyActor.options(name="SERVE_PROXY",
                                 lifetime="detached").remote()
     _proxy_port = ray_tpu.get(_proxy.start.remote(port=port))
+    # Binary RPC ingress rides the same proxy actor (reference: the gRPC
+    # proxy lives alongside the HTTP proxy in ProxyActor).
+    _proxy_rpc_port = ray_tpu.get(_proxy.start_rpc.remote())
 
 
 def get_proxy_port() -> Optional[int]:
     if _proxy is None:
         return None
     return _proxy_port
+
+
+def get_rpc_port() -> Optional[int]:
+    if _proxy is None:
+        return None
+    return _proxy_rpc_port
 
 
 def get_deployment_handle(deployment_name: str,
@@ -237,7 +247,8 @@ def status() -> dict:
 
 
 def shutdown():
-    global _proxy, _proxy_port
+    global _proxy, _proxy_port, _proxy_rpc_port
+    _proxy_rpc_port = None
     try:
         ctl = get_controller()
         for app in list(ray_tpu.get(ctl.list_deployments.remote())):
@@ -259,5 +270,5 @@ __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "Request", "run", "delete", "status", "shutdown",
     "batch", "get_deployment_handle", "get_app_handle", "get_proxy_port",
-    "multiplexed", "get_multiplexed_model_id",
+    "get_rpc_port", "multiplexed", "get_multiplexed_model_id",
 ]

@@ -56,6 +56,14 @@ class ProxyActor:
         self.handles.pop(route_prefix, None)
         return True
 
+    def _find_route(self, path: str):
+        """Longest-prefix route match, shared by HTTP and RPC ingress."""
+        for prefix in sorted(self.handles, key=len, reverse=True):
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                return prefix
+        return None
+
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         from aiohttp import web
 
@@ -72,12 +80,7 @@ class ProxyActor:
 
         async def handler(request: "web.Request"):
             path = request.path
-            match = None
-            for prefix in sorted(self.handles, key=len, reverse=True):
-                if path == prefix or path.startswith(
-                        prefix.rstrip("/") + "/") or prefix == "/":
-                    match = prefix
-                    break
+            match = self._find_route(path)
             if match is None:
                 return web.Response(status=404, text="no app for route")
             body = await request.read()
@@ -137,3 +140,108 @@ class ProxyActor:
 
     async def get_port(self):
         return self.port
+
+    # ----------------------------------------------------- RPC ingress
+
+    async def start_rpc(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Binary RPC ingress (the reference's gRPC proxy analog,
+        ``serve/_private/proxy.py:1129`` gRPCProxy).
+
+        grpcio is not a framework dependency, so the wire format is the
+        framework's own length-prefixed msgpack frames
+        (``_private/protocol.py``) — same capability surface as the
+        reference's gRPC ingress: unary calls, server streaming, route
+        listing, health checks. Clients use
+        ``ray_tpu.serve.rpc_client.ServeRpcClient``.
+        """
+        import asyncio
+
+        from ray_tpu._private import protocol
+
+        async def handle_call(writer, msg):
+            corr = msg.get("i")
+            route = self._find_route(msg.get("route", "/"))
+            if route is None:
+                writer.write(protocol.pack(
+                    {"i": corr, "ok": False,
+                     "error": f"no app for route {msg.get('route')!r}"}))
+                return
+            payload = msg.get("payload")
+            body = payload if isinstance(payload, bytes) else \
+                json.dumps(payload).encode()
+            req = Request("RPC", msg.get("route", route), {}, body,
+                          msg.get("meta") or {})
+            handle = self.handles[route]
+            gen = handle.stream(req)
+            if msg.get("stream"):
+                try:
+                    async for item in gen:
+                        writer.write(protocol.pack(
+                            {"i": corr, "chunk": _rpc_safe(item)}))
+                        await writer.drain()
+                    writer.write(protocol.pack({"i": corr, "eos": True}))
+                except Exception as e:  # noqa: BLE001
+                    writer.write(protocol.pack(
+                        {"i": corr, "ok": False, "error": str(e)}))
+                return
+            try:
+                result = None
+                async for item in gen:
+                    result = item  # unary: last chunk wins
+                writer.write(protocol.pack(
+                    {"i": corr, "ok": True, "result": _rpc_safe(result)}))
+            except Exception as e:  # noqa: BLE001
+                writer.write(protocol.pack(
+                    {"i": corr, "ok": False, "error": str(e)}))
+
+        async def on_client(reader, writer):
+            try:
+                while True:
+                    msg = await protocol.read_frame(reader)
+                    if msg is None:
+                        break
+                    t = msg.get("t")
+                    if t == "serve_call":
+                        await handle_call(writer, msg)
+                    elif t == "serve_routes":
+                        writer.write(protocol.pack(
+                            {"i": msg.get("i"), "ok": True,
+                             "result": sorted(self.handles)}))
+                    elif t == "serve_healthz":
+                        writer.write(protocol.pack(
+                            {"i": msg.get("i"), "ok": True,
+                             "result": "ok"}))
+                    else:
+                        writer.write(protocol.pack(
+                            {"i": msg.get("i"), "ok": False,
+                             "error": f"unknown rpc {t!r}"}))
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        server = await asyncio.start_server(on_client, host, port)
+        self._rpc_server = server
+        self.rpc_port = server.sockets[0].getsockname()[1]
+        return self.rpc_port
+
+    async def get_rpc_port(self):
+        return getattr(self, "rpc_port", None)
+
+
+def _rpc_safe(item):
+    """Coerce a handler return into something msgpack can carry.
+
+    Recursive (not a json round-trip) so nested ``bytes`` survive — the
+    wire format is msgpack, which carries binary natively."""
+    if isinstance(item, (bytes, str, int, float, bool, type(None))):
+        return item
+    if isinstance(item, dict):
+        return {str(k): _rpc_safe(v) for k, v in item.items()}
+    if isinstance(item, (list, tuple)):
+        return [_rpc_safe(v) for v in item]
+    return str(item)

@@ -61,3 +61,25 @@ def test_every_flag_has_a_typed_default():
     cfg = RayTpuConfig()
     for name in cfg.field_names():
         assert isinstance(getattr(cfg, name), (int, float, str, bool))
+
+
+def test_system_config_refreshes_import_time_snapshots():
+    """Driver-side hot-path constants are snapshotted at import; the
+    on_config_change hook must re-snapshot them so init(_system_config=)
+    applies to the driver too, not just spawned children."""
+    from ray_tpu._private import serialization, worker
+    from ray_tpu._private.config import reset_config, set_system_config
+
+    orig_inline = serialization.INLINE_THRESHOLD
+    orig_lease = worker._LEASE_WINDOW
+    try:
+        set_system_config({"inline_threshold": 7, "lease_window": 3,
+                           "pull_window": 2})
+        assert serialization.INLINE_THRESHOLD == 7
+        assert worker._LEASE_WINDOW == 3
+        assert worker.Worker._PULL_WINDOW == 2
+    finally:
+        set_system_config({})
+        reset_config()
+    assert serialization.INLINE_THRESHOLD == orig_inline
+    assert worker._LEASE_WINDOW == orig_lease

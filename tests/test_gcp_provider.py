@@ -1,0 +1,158 @@
+"""GCP TPU provider + command runners + cluster launcher tests.
+
+Reference model: ``python/ray/tests/test_autoscaler.py`` runs launcher
+logic against mocked providers/process runners. Here a fake ``exec_fn``
+records every gcloud/ssh invocation and scripts the JSON replies, so the
+whole up/down flow runs without a cloud.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.command_runner import (LocalCommandRunner,
+                                               SSHCommandRunner,
+                                               TPUCommandRunner)
+from ray_tpu.autoscaler.gcp import GCPTPUNodeProvider, _hosts_of
+from ray_tpu.autoscaler import launcher
+
+
+class FakeCloud:
+    """Scripted gcloud/ssh executor: records argv, plays back state."""
+
+    def __init__(self):
+        self.calls = []
+        self.nodes = {}  # name -> state dict
+
+    def __call__(self, argv, timeout=None):
+        self.calls.append(list(argv))
+        if argv[0] == "gcloud":
+            op = argv[4]
+            if op == "create":
+                name = argv[5]
+                self.nodes[name] = {
+                    "name": name, "state": "READY",
+                    "acceleratorType": next(
+                        (a.split("=", 1)[1] for a in argv
+                         if a.startswith("--accelerator-type=")), "v5p-8"),
+                    "networkEndpoints": [
+                        {"ipAddress": "10.0.0.1",
+                         "accessConfig": {"externalIp": "34.1.2.3"}},
+                        {"ipAddress": "10.0.0.2"},
+                    ],
+                }
+                return json.dumps(self.nodes[name])
+            if op == "delete":
+                self.nodes.pop(argv[5], None)
+                return "{}"
+            if op == "list":
+                return json.dumps(list(self.nodes.values()))
+            if op == "describe":
+                return json.dumps(self.nodes.get(argv[5], {}))
+            raise AssertionError(f"unexpected gcloud op {op}")
+        # ssh/scp/cp land here
+        return "ok\n"
+
+
+def test_hosts_of_accelerator_type():
+    assert _hosts_of("v5p-8") == 2      # 8 chips / 4 per host
+    assert _hosts_of("v5p-4") == 1
+    assert _hosts_of("v4-32") == 8
+    assert _hosts_of("v5litepod-16") == 2
+
+
+def test_provider_create_list_terminate():
+    fake = FakeCloud()
+    prov = GCPTPUNodeProvider(project="p", zone="z",
+                              accelerator_type="v5p-8",
+                              name_prefix="t", exec_fn=fake)
+    inst = prov.create_node("tpu_worker", {})
+    assert inst.instance_id.startswith("t-")
+    assert inst.resources["TPU"] == 4.0
+    assert f"TPU-v5p-8-head" in inst.resources
+
+    live = prov.non_terminated_nodes()
+    assert [n.instance_id for n in live] == [inst.instance_id]
+
+    addrs = prov.worker_addresses(inst.instance_id)
+    assert addrs == ["10.0.0.1", "10.0.0.2"]
+    ext = prov.worker_addresses(inst.instance_id, internal=False)
+    assert ext == ["34.1.2.3", "10.0.0.2"]
+
+    assert prov.wait_ready(inst.instance_id, timeout=1)
+
+    prov.terminate_node(inst.instance_id)
+    assert prov.non_terminated_nodes() == []
+    # every call was project/zone-scoped json
+    assert all(f"--project=p" in c and f"--zone=z" in c
+               for c in fake.calls if c[0] == "gcloud")
+
+
+def test_tpu_command_runner_fans_out():
+    fake = FakeCloud()
+    runner = TPUCommandRunner(["10.0.0.1", "10.0.0.2"], ssh_user="u",
+                              exec_fn=fake)
+    runner.run("echo hi")
+    ssh_calls = [c for c in fake.calls if c[0] == "ssh"]
+    assert len(ssh_calls) == 2
+    assert any("u@10.0.0.1" in c for c in ssh_calls)
+    assert any("u@10.0.0.2" in c for c in ssh_calls)
+    runner.run_on_worker(1, "only me")
+    assert fake.calls[-1][-1] == "only me"
+
+
+def test_ssh_runner_uses_key():
+    fake = FakeCloud()
+    r = SSHCommandRunner("1.2.3.4", ssh_user="ray", ssh_key="/k",
+                         exec_fn=fake)
+    r.run("ls")
+    assert "-i" in fake.calls[-1] and "/k" in fake.calls[-1]
+    r.run_rsync_up("/src", "/dst")
+    assert fake.calls[-1][0] == "scp"
+
+
+def test_local_command_runner_real_exec(tmp_path):
+    r = LocalCommandRunner()
+    out = r.run(f"echo hello > {tmp_path}/x && cat {tmp_path}/x")
+    assert out.strip() == "hello"
+
+
+def test_launcher_up_down():
+    fake = FakeCloud()
+    cfg = {
+        "cluster_name": "myclus",
+        "provider": {"type": "gcp_tpu", "project": "p", "zone": "z",
+                     "accelerator_type": "v5p-8"},
+        "auth": {"ssh_user": "ray"},
+        "file_mounts": {"/app": "/tmp"},
+        "head_setup_commands": ["pip install -e /app"],
+    }
+    out = launcher.up(cfg, exec_fn=fake)
+    assert out["head_ip"] == "10.0.0.1"
+    assert out["num_hosts"] == 2
+    joined = [" ".join(c) for c in fake.calls]
+    # setup command ran on both slice hosts
+    assert sum("pip install -e /app" in j for j in joined) == 2
+    # head start on worker 0 only; join on worker 1
+    heads = [j for j in joined if "--head" in j]
+    assert len(heads) == 1 and "ray@10.0.0.1" in heads[0]
+    joins = [j for j in joined if "--address" in j]
+    assert len(joins) == 1 and "ray@10.0.0.2" in joins[0]
+    assert "RAY_TPU_HEAD_IP=10.0.0.1" in joins[0]
+
+    killed = launcher.down(cfg, exec_fn=fake)
+    assert killed == [out["head_instance"]]
+    assert fake.nodes == {}
+
+
+def test_launcher_rejects_unknown_provider():
+    with pytest.raises(ValueError, match="not supported"):
+        launcher.up({"provider": {"type": "aws"}}, exec_fn=FakeCloud())
+
+
+def test_provider_requires_gcloud_without_exec(monkeypatch):
+    import shutil
+
+    monkeypatch.setattr(shutil, "which", lambda _: None)
+    with pytest.raises(RuntimeError, match="gcloud CLI not found"):
+        GCPTPUNodeProvider(project="p", zone="z")

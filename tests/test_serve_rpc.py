@@ -1,0 +1,71 @@
+"""Serve binary RPC ingress (the gRPC-proxy capability).
+
+Reference: Serve's gRPC proxy (``serve/_private/proxy.py`` gRPCProxy):
+unary calls, server streaming, route listing, health. grpcio is not a
+dependency here, so the ingress speaks the framework's length-prefixed
+msgpack frames; the capability surface is the same.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.rpc_client import ServeRpcClient, ServeRpcError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_rpc_unary_and_routes(cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, req):
+            data = req.json()
+            return {"echo": data, "n": data.get("x", 0) + 1}
+
+    serve.run(Echo.bind(), name="echo_app", route_prefix="/echo")
+    port = serve.get_rpc_port()
+    assert port
+
+    with ServeRpcClient(port=port) as c:
+        assert c.healthz()
+        assert "/echo" in c.routes()
+        out = c.call("/echo", {"x": 41})
+        assert out == {"echo": {"x": 41}, "n": 42}
+        # several calls on one connection (connection reuse)
+        for i in range(5):
+            assert c.call("/echo", {"x": i})["n"] == i + 1
+
+
+def test_rpc_streaming(cluster):
+    @serve.deployment
+    class Gen:
+        def __call__(self, req):
+            for i in range(int(req.json()["n"])):
+                yield {"tok": i}
+
+    serve.run(Gen.bind(), name="gen_app", route_prefix="/gen")
+    with ServeRpcClient(port=serve.get_rpc_port()) as c:
+        chunks = list(c.stream("/gen", {"n": 4}))
+        assert chunks == [{"tok": i} for i in range(4)]
+
+
+def test_rpc_errors(cluster):
+    @serve.deployment
+    class Boom:
+        def __call__(self, req):
+            raise ValueError("kaboom")
+
+    serve.run(Boom.bind(), name="boom_app", route_prefix="/boom")
+    with ServeRpcClient(port=serve.get_rpc_port()) as c:
+        with pytest.raises(ServeRpcError, match="kaboom"):
+            c.call("/boom", {})
+        with pytest.raises(ServeRpcError, match="no app"):
+            c.call("/nonexistent-route-xyz", {})
+        # the connection survives handler errors
+        assert c.healthz()
