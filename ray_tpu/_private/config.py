@@ -157,5 +157,6 @@ def reset_config():
     with _lock:
         _config = None
         _overrides = {}
+        os.environ.pop("RAY_TPU_SYSTEM_CONFIG", None)
     for fn in _refresh_hooks:  # keep import-time snapshots in sync
         fn()
