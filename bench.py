@@ -52,9 +52,22 @@ def _kill_stale_chip_holders():
     chip access, so reap them first.
     """
     me = os.getpid()
+    # Never kill our own ancestors: the invoking shell's cmdline can
+    # contain the match string textually (e.g. a `pkill -f ray_tpu...`
+    # in the same command line that launched this bench).
+    ancestors = set()
+    pid = me
+    while pid > 1:
+        ancestors.add(pid)
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                pid = next(int(line.split()[1]) for line in f
+                           if line.startswith("PPid:"))
+        except (OSError, StopIteration):
+            break
     killed = []
     for pid_s in os.listdir("/proc"):
-        if not pid_s.isdigit() or int(pid_s) == me:
+        if not pid_s.isdigit() or int(pid_s) in ancestors:
             continue
         try:
             with open(f"/proc/{pid_s}/cmdline", "rb") as f:

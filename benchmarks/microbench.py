@@ -145,6 +145,21 @@ def main():
     timeit("placement_group_create/removal", pg_cycle, int(100 * scale),
            results)
 
+    # Host context: BASELINE.md numbers come from an m4.16xlarge-class
+    # machine (64 vCPU); absolute throughput scales with cores and memory
+    # bandwidth, so record this host's ceilings next to the results.
+    buf = bytearray(64 << 20)
+    # Non-zero source (calloc zero pages would alias one cached physical
+    # page) + one untimed warmup so the timed pass measures a real stream.
+    src = os.urandom(1 << 20) * 64
+    memoryview(buf)[:] = src
+    t0 = time.perf_counter()
+    memoryview(buf)[:] = src
+    results["host"] = {
+        "cores": os.cpu_count(),
+        "memcpy_gbps": round(len(src) / (time.perf_counter() - t0) / 1e9, 2),
+    }
+
     print(json.dumps(results))
     ray_tpu.shutdown()
 

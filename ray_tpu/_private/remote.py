@@ -75,6 +75,9 @@ def _strategy_opts(opts: dict) -> dict:
 # a session are not re-uploaded for an identical runtime_env value.
 _RENV_WIRE_CACHE: Dict[tuple, dict] = {}
 
+# Cached wire form of an empty (args, kwargs) tuple (see _prepare_args).
+_EMPTY_ARGS_BYTES: Optional[bytes] = None
+
 
 def _prepared_runtime_env(opts: dict):
     renv = opts.get("runtime_env")
@@ -113,6 +116,12 @@ def _prepare_args(args: tuple, kwargs: dict,
     it (the reference resolves dependencies BEFORE taking a lease,
     ``transport/dependency_resolver.h``).
     """
+    global _EMPTY_ARGS_BYTES
+    if not args and not kwargs:
+        # No-arg calls are the hottest microbench shape; skip the pickle.
+        if _EMPTY_ARGS_BYTES is None:
+            _EMPTY_ARGS_BYTES = serialize(((), {})).to_bytes()
+        return {"args": _EMPTY_ARGS_BYTES}
     w = global_worker()
     out: dict = {}
     if collect_deps:
