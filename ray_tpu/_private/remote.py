@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import inspect
+import os
 from typing import Any, Dict, List, Optional, Union
 
 import cloudpickle
@@ -19,6 +20,7 @@ from . import serialization
 from .ids import ActorID
 from .serialization import serialize
 from .worker import ObjectRef, global_worker
+from ..util import tracing
 
 _DEFAULT_TASK_OPTS = dict(
     num_cpus=1, num_tpus=0, resources=None, num_returns=1, max_retries=3,
@@ -208,6 +210,11 @@ class RemoteFunction:
             self._wire_opts = wire_opts
         nret = opts.get("num_returns", 1)
         msg_args = _prepare_args(args, kwargs, collect_deps=True)
+        if tracing.enabled():
+            # Per-call span: copy the cached wire opts (the hot path when
+            # tracing is off never pays for the copy).
+            wire_opts = dict(wire_opts)
+            tracing.inject_task_opts(wire_opts, wire_opts["name"])
         refs = w.submit_task(fid, msg_args, nret, wire_opts)
         return refs[0] if nret == 1 else refs
 
@@ -267,6 +274,8 @@ class ActorHandle:
         msg_args = _prepare_args(args, kwargs)
         opts = {"retries": self._max_task_retries}
         opts.update(extra_opts)
+        if tracing.enabled():
+            tracing.inject_task_opts(opts, method)
         refs = w.submit_actor_task_msg(self._actor_id, method, msg_args,
                                        num_returns, opts)
         return refs[0] if num_returns == 1 else refs

@@ -80,6 +80,14 @@ class Executor:
                                 1 if ok else 0))
 
     def flush_events(self):
+        # Piggyback tracing spans (one-shot die_after_task workers exit
+        # right after this — the 0.5s flush loop won't get another tick).
+        tracing = sys.modules.get("ray_tpu.util.tracing")
+        if tracing is not None and tracing.pending_spans():
+            try:
+                tracing.flush_to_kv(self.worker)
+            except Exception:
+                pass
         if self.events and self.worker.gcs and not self.worker.gcs.closed:
             batch, self.events = self.events, []
             try:
@@ -460,7 +468,15 @@ class Executor:
                     tid_obj, 1).binary(), "nbytes": len(data),
                     "data": data}]
             args, kwargs = self._load_args(msg)
-            value = fn(*args, **kwargs)
+            if opts.get("tp"):
+                # Tracing enabled: adopt the caller's span context so
+                # nested .remote() calls chain (util/tracing.py).
+                from ray_tpu.util import tracing
+
+                with tracing.adopt_and_span(opts["tp"], f"run:{fn_name}"):
+                    value = fn(*args, **kwargs)
+            else:
+                value = fn(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = asyncio.run(value)
             values = self._split_returns(value, nret)
@@ -541,7 +557,15 @@ class Executor:
                 async with self.async_sem:
                     args, kwargs = await loop.run_in_executor(
                         None, self._load_args, msg)
-                    value = await method(*args, **kwargs)
+                    tp = (msg.get("opts") or {}).get("tp")
+                    if tp:
+                        from ray_tpu.util import tracing
+
+                        with tracing.adopt_and_span(
+                                tp, f"run:{method_name}"):
+                            value = await method(*args, **kwargs)
+                    else:
+                        value = await method(*args, **kwargs)
                     values = self._split_returns(value, nret)
                     results = self._pack_results(tid, values, True)
             else:
@@ -640,7 +664,14 @@ class Executor:
                     TaskID(tid), 1).binary(), "nbytes": len(data),
                     "data": data}]
             args, kwargs = self._load_args(msg)
-            value = method(*args, **kwargs)
+            tp = (msg.get("opts") or {}).get("tp")
+            if tp:
+                from ray_tpu.util import tracing
+
+                with tracing.adopt_and_span(tp, f"run:{msg['m']}"):
+                    value = method(*args, **kwargs)
+            else:
+                value = method(*args, **kwargs)
             values = self._split_returns(value, nret)
             return self._pack_results(tid, values, register_shm=True)
         finally:
@@ -688,6 +719,9 @@ async def amain(args):
     async def flush_events_loop():
         while not stop.is_set():
             await asyncio.sleep(0.5)
+            # flush_events also drains tracing spans (gated on the module
+            # having been imported by a traced call, not this process's
+            # env var — the driver may enable tracing after worker spawn).
             executor.flush_events()
 
     worker.gcs_address = args.gcs

@@ -180,7 +180,55 @@ class DashboardActor:
                 lines = f.readlines()
             return {"name": name, "lines": lines[-tail:]}
 
+        def profile(request):
+            """On-demand CPU profiling of a cluster process (reference:
+            ``dashboard/modules/reporter/profile_manager.py`` py-spy
+            drivers). Gated on py-spy being installed; returns a clear
+            501-style payload otherwise."""
+            import shutil
+            import subprocess
+
+            pid = request.query.get("pid")
+            if not pid or not pid.isdigit():
+                return {"error": "pass ?pid=<process id>"}
+            # Only cluster-owned processes may be profiled (the reference
+            # profiles known worker PIDs only) — otherwise this endpoint
+            # would dump stacks of arbitrary same-user processes.
+            from ray_tpu.util import state
+
+            cluster_pids = {w.get("pid") for w in state.list_workers()}
+            cluster_pids.add(os.getpid())
+            if int(pid) not in cluster_pids:
+                return {"error": f"pid {pid} is not a cluster process",
+                        "cluster_pids": sorted(p for p in cluster_pids
+                                               if p is not None)}
+            duration = min(float(request.query.get("duration", "5")), 60.0)
+            fmt = request.query.get("format", "speedscope")
+            pyspy = shutil.which("py-spy")
+            if pyspy is None:
+                return {"error": "py-spy is not installed on this host",
+                        "install": "pip install py-spy", "supported": False}
+            out = subprocess.run(
+                [pyspy, "dump", "--pid", pid] if fmt == "dump" else
+                [pyspy, "record", "--pid", pid, "-d", str(int(duration)),
+                 "-f", fmt, "-o", "/dev/stdout"],
+                capture_output=True, text=True, timeout=duration + 30)
+            if out.returncode != 0:
+                return {"error": out.stderr.strip()[:1000]}
+            return {"pid": int(pid), "format": fmt, "profile": out.stdout}
+
+        def trace_api(request):
+            """Spans of one trace id (util/tracing.py)."""
+            from ray_tpu.util import tracing
+
+            tid = request.query.get("trace_id", "")
+            if not tid:
+                return {"error": "pass ?trace_id=<32-hex id>"}
+            return tracing.get_trace(tid)
+
         app.router.add_get("/", index)
+        app.router.add_get("/api/profile", json_api(profile))
+        app.router.add_get("/api/trace", json_api(trace_api))
         app.router.add_get("/healthz", healthz)
         app.router.add_get("/api/cluster", json_api(cluster))
         for kind in ("nodes", "workers", "actors", "tasks", "objects",
