@@ -378,6 +378,11 @@ class GcsServer:
         self.kv: Dict[Tuple[str, str], bytes] = {}
         self.clients: List[ClientConn] = []
         self.drivers: List[ClientConn] = []
+        # Generalized pubsub (reference: src/ray/pubsub/publisher.h) —
+        # actor-state / node-event / error / job channels + user channels.
+        from .pubsub import Publisher
+
+        self.publisher = Publisher()
         self._spread_rr = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._shutdown_event = asyncio.Event()
@@ -645,6 +650,10 @@ class GcsServer:
                     elif w.acquired:
                         _res_sub(node.avail, w.acquired)
             logger.info("node %s joined: %s", node_id.hex()[:8], msg["resources"])
+            self._pub("node_events", {"event": "node_joined",
+                                      "node_id": node_id.hex(),
+                                      "resources": msg["resources"],
+                                      "hostname": msg.get("hostname", "")})
             self._wake_scheduler()
         elif role == "worker":
             worker_id = WorkerID(msg["worker_id"])
@@ -746,6 +755,7 @@ class GcsServer:
             return
         if client in self.clients:
             self.clients.remove(client)
+        self.publisher.drop_conn(client.conn)
         if (client.worker_id is not None
                 and self._client_by_wid.get(client.worker_id.binary())
                 is client):
@@ -799,6 +809,44 @@ class GcsServer:
             self._on_node_death(client.node_id)
 
     # ------------------------------------------------------------- KV store
+
+    # ------------------------------------------------------------ pubsub
+
+    def _pub(self, channel: str, message: dict):
+        """Publish a GCS-internal event (best-effort, never raises)."""
+        try:
+            self.publisher.publish(channel, message)
+        except Exception:
+            logger.exception("publish on %r failed", channel)
+
+    def _pub_actor(self, record, event: str):
+        self._pub("actor_state", {
+            "event": event, "actor_id": record.actor_id.hex(),
+            "state": record.state, "name": record.name,
+            "node_id": record.node_id.hex() if record.node_id else None,
+            "death_cause": getattr(record, "death_cause", None),
+        })
+
+    async def _h_sub(self, client, msg):
+        """Open a subscription stream (no reply frame: the stream stays
+        open; published messages arrive as chunk frames)."""
+        self.publisher.subscribe(msg["ch"], client.conn, msg["i"])
+
+    async def _h_unsub(self, client, msg):
+        n = self.publisher.unsubscribe(msg["ch"], client.conn,
+                                       msg.get("sid"))
+        client.conn.reply(msg, {"ok": True, "closed": n})
+
+    async def _h_pub(self, client, msg):
+        n = self._publish_user(msg["ch"], msg.get("m"))
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True, "delivered": n})
+
+    def _publish_user(self, channel: str, message) -> int:
+        return self.publisher.publish(channel, message)
+
+    async def _h_pubsub_stats(self, client, msg):
+        client.conn.reply(msg, {"ok": True, "stats": self.publisher.stats()})
 
     async def _h_kv_put(self, client, msg):
         ns = msg.get("ns", "")
@@ -1608,6 +1656,9 @@ class GcsServer:
         if node is None:
             return
         node.alive = False
+        self._pub("node_events", {"event": "node_died",
+                                  "node_id": node_id.hex(),
+                                  "hostname": node.hostname})
         for wid in list(node.workers):
             asyncio.get_running_loop().create_task(self._on_worker_death(wid))
 
@@ -1699,6 +1750,7 @@ class GcsServer:
         worker = self.workers.get(record.worker_id)
         record.state = A_ALIVE
         record.addr = worker.addr if worker else ""
+        self._pub_actor(record, "alive")
         for conn, req in record.addr_waiters:
             if not conn.closed:
                 conn.reply(req, {"ok": True, "state": A_ALIVE,
@@ -1797,6 +1849,7 @@ class GcsServer:
 
     def _cleanup_dead_actor(self, record: ActorRecord):
         self._log_append("actord", record.actor_id.binary())
+        self._pub_actor(record, "dead")
         for conn, req in record.addr_waiters:
             if not conn.closed:
                 conn.reply(req, {"ok": False, "state": A_DEAD,

@@ -196,6 +196,17 @@ class Connection:
     def closed(self) -> bool:
         return self._closed
 
+    def outstanding_bytes(self) -> int:
+        """Unsent bytes queued on this connection (coalescing buffer +
+        transport write buffer) — the pubsub slow-subscriber backpressure
+        signal (``_private/pubsub.py``)."""
+        n = sum(len(b) for b in self._wbuf) if self._wbuf else 0
+        try:
+            n += self.writer.transport.get_write_buffer_size()
+        except Exception:
+            pass
+        return n
+
     def send(self, msg: dict):
         """Fire-and-forget send."""
         if self._closed:

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import hashlib
 import inspect
-import os
 from typing import Any, Dict, List, Optional, Union
 
 import cloudpickle
@@ -210,7 +209,7 @@ class RemoteFunction:
             self._wire_opts = wire_opts
         nret = opts.get("num_returns", 1)
         msg_args = _prepare_args(args, kwargs, collect_deps=True)
-        if tracing.enabled():
+        if tracing.active():
             # Per-call span: copy the cached wire opts (the hot path when
             # tracing is off never pays for the copy).
             wire_opts = dict(wire_opts)
@@ -274,7 +273,7 @@ class ActorHandle:
         msg_args = _prepare_args(args, kwargs)
         opts = {"retries": self._max_task_retries}
         opts.update(extra_opts)
-        if tracing.enabled():
+        if tracing.active():
             tracing.inject_task_opts(opts, method)
         refs = w.submit_actor_task_msg(self._actor_id, method, msg_args,
                                        num_returns, opts)

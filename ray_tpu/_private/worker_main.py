@@ -470,15 +470,19 @@ class Executor:
             args, kwargs = self._load_args(msg)
             if opts.get("tp"):
                 # Tracing enabled: adopt the caller's span context so
-                # nested .remote() calls chain (util/tracing.py).
+                # nested .remote() calls chain (util/tracing.py). The
+                # span must also cover asyncio.run for async remote fns —
+                # fn(...) alone just returns the unstarted coroutine.
                 from ray_tpu.util import tracing
 
                 with tracing.adopt_and_span(opts["tp"], f"run:{fn_name}"):
                     value = fn(*args, **kwargs)
+                    if asyncio.iscoroutine(value):
+                        value = asyncio.run(value)
             else:
                 value = fn(*args, **kwargs)
-            if asyncio.iscoroutine(value):
-                value = asyncio.run(value)
+                if asyncio.iscoroutine(value):
+                    value = asyncio.run(value)
             values = self._split_returns(value, nret)
             return self._pack_results(tid, values, register_shm=False)
         except BaseException as e:  # noqa: BLE001

@@ -1,0 +1,113 @@
+"""Client-side pubsub API over the GCS publisher.
+
+Reference: ``src/ray/pubsub/subscriber.h:329`` (``SubscriberChannel``) and
+the Python surfaces built on it. The GCS publishes built-in channels —
+``actor_state``, ``node_events``, ``errors``, ``jobs`` — and any process
+can publish/subscribe on arbitrary user channels. Subscriptions are
+server-push streams on the persistent GCS connection (no long-poll; see
+``_private/pubsub.py``), surfaced here as a thread-safe iterator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue as _queue
+import threading
+from typing import Any, Iterator, Optional
+
+CH_ACTOR_STATE = "actor_state"
+CH_NODE_EVENTS = "node_events"
+CH_ERRORS = "errors"
+CH_JOBS = "jobs"
+
+
+def publish(channel: str, message: Any, *, wait: bool = True) -> int:
+    """Publish on a channel; returns the number of live subscribers
+    delivered to (0 when ``wait`` is False)."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    if wait:
+        reply = w.run_async(w.gcs.request(
+            {"t": "pub", "ch": channel, "m": message}), timeout=30)
+        return int(reply.get("delivered", 0))
+    w.loop.call_soon_threadsafe(
+        w.gcs.send, {"t": "pub", "ch": channel, "m": message})
+    return 0
+
+
+class Subscriber:
+    """A live subscription; iterate or ``poll`` for messages.
+
+    Each received item is a dict: ``{"message": ..., "seq": int,
+    "ts": float, "channel": str}``. ``seq`` gaps mean the publisher
+    dropped frames for this subscriber (slow-reader backpressure)."""
+
+    def __init__(self, channel: str):
+        from ray_tpu._private.worker import global_worker
+
+        self.channel = channel
+        self._w = global_worker()
+        self._out: _queue.Queue = _queue.Queue()
+        self._closed = threading.Event()
+        self._sid: Optional[int] = None
+        self._w.run_async(self._start(), timeout=30)
+
+    async def _start(self):
+        msg = {"t": "sub", "ch": self.channel}
+        q = self._w.gcs.request_stream(msg)
+        self._sid = msg["i"]  # request_stream stamps the stream id
+
+        async def pump():
+            while True:
+                kind, msg = await q.get()
+                if kind == "end":
+                    self._closed.set()
+                    self._out.put(None)
+                    return
+                self._out.put({
+                    "channel": msg.get("ch", self.channel),
+                    "seq": msg.get("seq"),
+                    "ts": msg.get("ts"),
+                    "dropped": msg.get("dropped", 0),
+                    "message": msg.get("pub"),
+                })
+
+        asyncio.ensure_future(pump())
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Next message, or None on timeout/closed stream."""
+        if self._closed.is_set() and self._out.empty():
+            return None
+        try:
+            return self._out.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            item = self.poll()
+            if item is None:
+                return
+            yield item
+
+    def close(self):
+        if self._closed.is_set():
+            return
+        try:
+            self._w.run_async(self._w.gcs.request(
+                {"t": "unsub", "ch": self.channel, "sid": self._sid}),
+                timeout=10)
+        except Exception:
+            pass
+        self._closed.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def subscribe(channel: str) -> Subscriber:
+    return Subscriber(channel)

@@ -37,6 +37,15 @@ def enabled() -> bool:
     return os.environ.get(_ENV_FLAG, "") == "1"
 
 
+def active() -> bool:
+    """Should spans be recorded here? True when tracing is enabled in
+    this process OR an adopted remote context is live (a worker executing
+    a traced call) — adoption is per-call, never a process-wide flag flip,
+    so one traced job cannot virally enable tracing for later jobs on a
+    shared cluster."""
+    return enabled() or _ctx.get() is not None
+
+
 def enable_tracing():
     """Turn on tracing for this process and every worker spawned after
     (propagates via the environment, like the reference's
@@ -143,7 +152,7 @@ def _maybe_export_otel(span: dict):
 def span(name: str, kind: str = "internal",
          attrs: Optional[Dict[str, Any]] = None):
     """Open a span under the current context (user-facing API)."""
-    if not enabled():
+    if not active():
         yield None
         return
     parent = _ctx.get()
@@ -170,7 +179,7 @@ def span(name: str, kind: str = "internal",
 def inject_task_opts(opts: dict, name: str):
     """Submission-side hook: record a submit span and stamp the message
     with the traceparent (reference: ``_inject_tracing_into_function``)."""
-    if not enabled():
+    if not active():
         return
     parent = _ctx.get()
     trace_id = parent[0] if parent else secrets.token_hex(16)
@@ -192,11 +201,12 @@ def adopt_and_span(tp: Optional[str], name: str, kind: str = "consumer"):
 
     The arriving ``tp`` itself proves the submitting driver enabled
     tracing — don't gate on this process's own env var (workers of an
-    already-running cluster were spawned before ``enable_tracing``)."""
+    already-running cluster were spawned before ``enable_tracing``).
+    Adoption is scoped to this call via the contextvar (``active()``), so
+    it does not flip tracing on for unrelated later work."""
     if not tp:
         yield
         return
-    os.environ[_ENV_FLAG] = "1"  # adopt enablement for nested submits
     parsed = parse_traceparent(tp)
     if parsed is None:
         yield

@@ -1,0 +1,129 @@
+"""Pubsub channel tests (generalized publisher/subscriber).
+
+Reference model: ``src/ray/pubsub`` unit tests + the Python subscriber
+surfaces. Covers user channels, built-in actor/node event channels,
+cross-process publish, unsubscribe semantics, and disconnect cleanup.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import pubsub
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_user_channel_pub_sub(cluster):
+    with pubsub.subscribe("my_channel") as sub:
+        n = pubsub.publish("my_channel", {"hello": 1})
+        assert n == 1
+        item = sub.poll(timeout=10)
+        assert item["message"] == {"hello": 1}
+        assert item["seq"] >= 1
+        assert item["channel"] == "my_channel"
+
+
+def test_publish_without_subscribers(cluster):
+    assert pubsub.publish("lonely", "msg") == 0
+
+
+def test_unsubscribe_ends_stream(cluster):
+    sub = pubsub.subscribe("chan2")
+    pubsub.publish("chan2", "a")
+    assert sub.poll(timeout=10)["message"] == "a"
+    sub.close()
+    # after close, publishes don't reach it and iteration terminates
+    assert pubsub.publish("chan2", "b") == 0
+    assert sub.poll(timeout=1) is None
+
+
+def test_multiple_subscribers_fanout(cluster):
+    s1 = pubsub.subscribe("fan")
+    s2 = pubsub.subscribe("fan")
+    assert pubsub.publish("fan", 42) == 2
+    assert s1.poll(timeout=10)["message"] == 42
+    assert s2.poll(timeout=10)["message"] == 42
+    s1.close()
+    s2.close()
+
+
+def test_worker_can_publish_driver_receives(cluster):
+    @ray_tpu.remote
+    def announce():
+        from ray_tpu.util import pubsub as ps
+
+        return ps.publish("from_worker", {"who": "task"})
+
+    with pubsub.subscribe("from_worker") as sub:
+        delivered = ray_tpu.get(announce.remote())
+        assert delivered == 1
+        assert sub.poll(timeout=10)["message"] == {"who": "task"}
+
+
+def test_actor_state_channel(cluster):
+    with pubsub.subscribe(pubsub.CH_ACTOR_STATE) as sub:
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.remote()
+        ray_tpu.get(a.ping.remote())
+        evt = sub.poll(timeout=15)
+        assert evt is not None
+        assert evt["message"]["event"] == "alive"
+        aid = evt["message"]["actor_id"]
+
+        ray_tpu.kill(a)
+        deadline = time.time() + 15
+        dead = None
+        while time.time() < deadline:
+            e = sub.poll(timeout=5)
+            if e and e["message"]["event"] == "dead" \
+                    and e["message"]["actor_id"] == aid:
+                dead = e
+                break
+        assert dead is not None
+
+
+def test_node_events_channel():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, connect=True)
+    try:
+        _assert_node_events(cluster)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def _assert_node_events(cluster):
+    with pubsub.subscribe(pubsub.CH_NODE_EVENTS) as sub:
+        node = cluster.add_node(num_cpus=1)
+        evt = sub.poll(timeout=20)
+        assert evt["message"]["event"] == "node_joined"
+        cluster.remove_node(node)
+        deadline = time.time() + 20
+        saw_death = False
+        while time.time() < deadline:
+            e = sub.poll(timeout=5)
+            if e and e["message"]["event"] == "node_died":
+                saw_death = True
+                break
+        assert saw_death
+
+
+def test_seq_numbers_monotonic(cluster):
+    with pubsub.subscribe("seqchan") as sub:
+        for i in range(5):
+            pubsub.publish("seqchan", i)
+        seqs = [sub.poll(timeout=10)["seq"] for _ in range(5)]
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == 5
