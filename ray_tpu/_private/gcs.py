@@ -135,8 +135,7 @@ class TaskRecord:
         self.env_key = ""
         self.env_spec = None
         renv = opts.get("runtime_env")
-        if renv and (renv.get("pip") is not None
-                     or renv.get("uv") is not None):
+        if renv:
             from ray_tpu.runtime_env.pip_env import env_key as _ek
             from ray_tpu.runtime_env.pip_env import spawn_spec_from_renv
 
@@ -204,8 +203,7 @@ class ActorRecord:
         self.env_key = ""
         self.env_spec = None
         renv = opts.get("runtime_env")
-        if renv and (renv.get("pip") is not None
-                     or renv.get("uv") is not None):
+        if renv:
             from ray_tpu.runtime_env.pip_env import env_key as _ek
             from ray_tpu.runtime_env.pip_env import spawn_spec_from_renv
 

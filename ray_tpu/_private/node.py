@@ -323,10 +323,20 @@ class NodeAgent:
         self._spawn(sys.executable, worker_sys_path(), "")
 
     def _spawn_env_worker(self, env_spec: dict, env_key: str):
-        """Build (or reuse) the spec's venv, then launch the worker under
-        the venv interpreter (reference: dedicated runtime-env workers
-        launched by the runtime-env agent, ``runtime_env/pip.py``)."""
+        """Build (or reuse) the spec's venv — or wrap the spawn in a
+        container — then launch the worker (reference: dedicated
+        runtime-env workers launched by the runtime-env agent,
+        ``runtime_env/pip.py`` / ``image_uri.py``)."""
         try:
+            if env_spec.get("tool") == "container":
+                from ray_tpu.runtime_env.container import wrap_spawn
+
+                paths = worker_sys_path()
+                self._spawn(
+                    sys.executable, paths, env_key,
+                    wrap=lambda argv, env: wrap_spawn(
+                        env_spec, argv, env, self.session_dir, paths))
+                return
             from ray_tpu.runtime_env.pip_env import ensure_venv
 
             venv = ensure_venv(env_spec)
@@ -350,7 +360,7 @@ class NodeAgent:
             except ConnectionError:
                 pass
 
-    def _spawn(self, python: str, sys_path: str, env_key: str):
+    def _spawn(self, python: str, sys_path: str, env_key: str, wrap=None):
         env = dict(os.environ)
         env.update(self.env_overrides)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
@@ -361,11 +371,16 @@ class NodeAgent:
             env.pop("RAY_TPU_ENV_KEY", None)
         # ``-S`` skips site processing (~2s in large venvs); the bootstrap
         # restores the parent's sys.path so imports resolve identically.
+        argv = [python, "-S", "-c", _WORKER_BOOTSTRAP,
+                "--gcs", self.gcs_address,
+                "--node-id", self.node_id.hex(),
+                "--session-dir", self.session_dir]
+        if wrap is not None:
+            # Container runtime env: the whole command runs inside
+            # `podman/docker run` (runtime_env/container.py).
+            argv, env = wrap(argv, env)
         proc = subprocess.Popen(
-            [python, "-S", "-c", _WORKER_BOOTSTRAP,
-             "--gcs", self.gcs_address,
-             "--node-id", self.node_id.hex(),
-             "--session-dir", self.session_dir],
+            argv,
             env=env,
             stdout=open(os.path.join(
                 self.session_dir, f"worker-{len(self.procs)}.out"), "ab"),

@@ -890,8 +890,7 @@ class Worker:
             # worker SPAWN (dedicated venv workers), so the env is part of
             # the scheduling class: leases of different envs never mix.
             renv = opts.get("runtime_env")
-            if renv and (renv.get("pip") is not None
-                         or renv.get("uv") is not None):
+            if renv:
                 from ray_tpu.runtime_env.pip_env import (env_key,
                                                          spawn_spec_from_renv)
 

@@ -154,8 +154,19 @@ def spawn_spec_from_renv(renv: Optional[Dict[str, Any]]
     that must be satisfied at worker SPAWN, not in-process)."""
     if not renv:
         return None
+    if renv.get("image_uri") is not None:
+        from .container import normalize_value
+
+        return normalize_value(renv["image_uri"])
     if renv.get("uv") is not None:
         return normalize_spec(renv["uv"], "uv")
     if renv.get("pip") is not None:
         return normalize_spec(renv["pip"], "pip")
     return None
+
+
+def needs_env_worker(renv: Optional[Dict[str, Any]]) -> bool:
+    """Does this runtime_env need a DEDICATED worker (venv/container)?
+    Single source of truth for scheduler routing — new interpreter-level
+    env types only need a branch in ``spawn_spec_from_renv``."""
+    return spawn_spec_from_renv(renv) is not None

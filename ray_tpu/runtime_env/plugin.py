@@ -213,6 +213,42 @@ class UvPlugin(PipPlugin):
     tool = "uv"
 
 
+class ImageUriPlugin(RuntimeEnvPlugin):
+    """Container image for a task/actor's worker (reference:
+    ``runtime_env/image_uri.py``). Interpreter-level like pip/uv: the
+    scheduler routes to a per-image worker pool and the node agent wraps
+    the spawn in ``podman run``/``docker run``
+    (``runtime_env/container.py``); this plugin validates the spec and
+    sanity-checks the routing on the worker side."""
+
+    name = "image_uri"
+    priority = 3
+
+    def validate(self, value):
+        from .container import normalize_value
+
+        normalize_value(value)
+
+    def prepare(self, value, upload):
+        from .container import normalize_value
+
+        # Wire form is the normalized spec so the scheduler's env key and
+        # the worker-side check hash identical inputs.
+        return normalize_value(value)
+
+    def create(self, value, ctx, fetch):
+        from .container import normalize_value
+        from .pip_env import env_key
+
+        want = env_key(normalize_value(value))
+        have = os.environ.get("RAY_TPU_ENV_KEY", "")
+        if have != want:
+            raise RuntimeError(
+                f"task with image_uri runtime_env (env {want}) was "
+                f"dispatched to a worker in env {have or '<base>'} — "
+                f"scheduler env-pool routing failed")
+
+
 class CondaPlugin(RuntimeEnvPlugin):
     """Named conda env activation is not supported in this build (workers
     share one interpreter); fail loudly instead of silently ignoring."""
@@ -228,5 +264,5 @@ class CondaPlugin(RuntimeEnvPlugin):
 
 
 for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-           PipPlugin(), UvPlugin(), CondaPlugin()):
+           PipPlugin(), UvPlugin(), ImageUriPlugin(), CondaPlugin()):
     register_plugin(_p)

@@ -28,6 +28,17 @@ class RuntimeEnv(dict):
 
 
 def validate_runtime_env(renv: Dict[str, Any]) -> None:
+    # Interpreter-level env types are mutually exclusive: a worker runs in
+    # ONE venv or ONE container — combining them would silently satisfy
+    # only the first in spawn_spec_from_renv's dispatch order.
+    exclusive = [k for k in ("image_uri", "uv", "pip") if renv.get(k)
+                 is not None]
+    if len(exclusive) > 1:
+        raise ValueError(
+            f"runtime_env fields {exclusive} cannot be combined: each "
+            "selects the worker's interpreter environment. Bake pip "
+            "packages into the image, or use py_modules alongside one "
+            "of them.")
     for key, value in renv.items():
         if key in _PASSTHROUGH_KEYS:
             continue

@@ -41,7 +41,10 @@ class Subscriber:
 
     Each received item is a dict: ``{"message": ..., "seq": int,
     "ts": float, "channel": str}``. ``seq`` gaps mean the publisher
-    dropped frames for this subscriber (slow-reader backpressure)."""
+    dropped frames for this subscriber (slow-reader backpressure). After
+    a control-plane restart the subscription re-establishes itself and
+    delivers one ``{"resubscribed": True, "message": None}`` gap marker —
+    frames published during the outage are lost."""
 
     def __init__(self, channel: str):
         from ray_tpu._private.worker import global_worker
@@ -60,18 +63,47 @@ class Subscriber:
 
         async def pump():
             while True:
-                kind, msg = await q.get()
+                kind, end_msg = await q.get()
                 if kind == "end":
-                    self._closed.set()
-                    self._out.put(None)
+                    await on_end(end_msg)
                     return
                 self._out.put({
-                    "channel": msg.get("ch", self.channel),
-                    "seq": msg.get("seq"),
-                    "ts": msg.get("ts"),
-                    "dropped": msg.get("dropped", 0),
-                    "message": msg.get("pub"),
+                    "channel": end_msg.get("ch", self.channel),
+                    "seq": end_msg.get("seq"),
+                    "ts": end_msg.get("ts"),
+                    "dropped": end_msg.get("dropped", 0),
+                    "message": end_msg.get("pub"),
                 })
+
+        async def on_end(end_msg):
+            if self._closed.is_set() or end_msg.get("closed"):
+                # Clean unsubscribe (server confirms with closed=True).
+                self._closed.set()
+                self._out.put(None)
+                return
+            # Abnormal end: the GCS connection dropped (control-plane
+            # restart). The rest of the cluster transparently resyncs
+            # (worker reconnect path), so long-lived subscriptions must
+            # too — resubscribe on the fresh connection with backoff,
+            # surfacing a gap marker so readers know frames may be lost.
+            deadline = asyncio.get_running_loop().time() + 60.0
+            while not self._closed.is_set():
+                await asyncio.sleep(0.5)
+                conn = self._w.gcs
+                if conn is None or conn.closed:
+                    if asyncio.get_running_loop().time() > deadline:
+                        break
+                    continue
+                try:
+                    await self._start()
+                except ConnectionError:
+                    continue
+                self._out.put({"channel": self.channel, "seq": None,
+                               "ts": None, "dropped": 0, "message": None,
+                               "resubscribed": True})
+                return
+            self._closed.set()
+            self._out.put(None)
 
         asyncio.ensure_future(pump())
 
