@@ -551,6 +551,11 @@ class GcsServer:
         self._server = await protocol.serve(address, self._on_client)
         self._extra_servers = [await protocol.serve(a, self._on_client)
                                for a in extra_addresses]
+        # Loop-lag instrumentation (reference: event_stats.h) — surfaces
+        # "something blocked the control-plane loop" in loop_stats.
+        from .thread_check import LoopMonitor
+
+        self.loop_monitor = LoopMonitor(name="gcs").start()
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         if self.resumed:
             asyncio.get_running_loop().call_later(
@@ -2192,7 +2197,11 @@ class GcsServer:
                   "hostname": n.hostname, "total": n.total, "avail": n.avail,
                   "workers": len(n.workers)}
                  for n in self.nodes.values()]
-        client.conn.reply(msg, {"ok": True, "nodes": nodes})
+        reply = {"ok": True, "nodes": nodes}
+        monitor = getattr(self, "loop_monitor", None)
+        if monitor is not None:
+            reply["loop_stats"] = monitor.stats()
+        client.conn.reply(msg, reply)
 
     async def _h_task_list(self, client, msg):
         out = [{"tid": t.task_id.binary(), "state": t.state,

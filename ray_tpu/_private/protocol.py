@@ -123,11 +123,25 @@ class Connection:
         # syscalls, the dominant cost of the control plane.
         self._wbuf: list = []
         self._flush_scheduled = False
+        self._affinity_check = None  # set in start() when checks enabled
 
     def start(self):
-        self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+        loop = asyncio.get_running_loop()
+        self._owner_loop = loop
+        # Affinity invariant (reference: thread_checker.h): a Connection
+        # is owned by ONE loop — off-loop writes are the race class this
+        # design forbids. Resolved once here so the per-frame hot path
+        # pays a single attribute test when checks are off.
+        from .thread_check import assert_on_loop, checks_enabled
+
+        self._affinity_check = (
+            (lambda: assert_on_loop(loop, "Connection._write_frame"))
+            if checks_enabled() else None)
+        self._read_task = loop.create_task(self._read_loop())
 
     def _write_frame(self, data: bytes):
+        if self._affinity_check is not None:
+            self._affinity_check()
         if self._flush_scheduled:
             # A frame already went out this loop tick: buffer the rest of
             # the burst for one combined write at the end of the tick.

@@ -5,6 +5,7 @@ from .connectors import (ClipRewards, ConnectorPipeline, FlattenObs,
                          GAEConnector, NormalizeObs, default_env_to_module,
                          default_learner_pipeline)
 from .dqn import DQN, DQNConfig
+from .dreamerv3 import DreamerV3, DreamerV3Algo
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import IMPALA, IMPALAConfig
 from .learner import Learner, LearnerGroup, gae
@@ -22,6 +23,7 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
     "BC", "MARWIL", "episodes_to_rows",
     "SAC", "SACConfig", "APPO", "APPOConfig", "CQL",
+    "DreamerV3", "DreamerV3Algo",
     "ConnectorPipeline", "FlattenObs", "NormalizeObs", "ClipRewards",
     "GAEConnector", "default_env_to_module", "default_learner_pipeline",
 ]

@@ -1,0 +1,632 @@
+"""DreamerV3: model-based RL — RSSM world model + imagination actor-critic.
+
+Reference: ``rllib/algorithms/dreamerv3/`` (Hafner et al. 2023,
+"Mastering Diverse Domains through World Models"): a recurrent state-space
+model (deterministic GRU path + categorical stochastic latents) learns to
+predict embeddings/rewards/continues from replayed sequences; the actor
+and critic train purely in imagination rollouts of that model. Key
+DreamerV3 robustness tricks kept here: symlog squashing of targets,
+twohot-encoded reward/value distributions, free-bits KL, the dyn/rep KL
+split, and percentile return normalization for the actor.
+
+Everything is a functional JAX pytree; the whole world-model update and
+the whole imagination update are each one jitted step (single XLA program
+per update on the learner's device, ``lax.scan`` over time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DreamerConfig:
+    obs_dim: int
+    num_actions: int
+    deter: int = 128          # GRU (deterministic) state
+    stoch: int = 8            # categorical latent groups
+    classes: int = 8          # classes per group
+    units: int = 128          # MLP widths
+    horizon: int = 15         # imagination length
+    gamma: float = 0.997
+    lam: float = 0.95
+    free_bits: float = 1.0
+    dyn_scale: float = 0.5
+    rep_scale: float = 0.1
+    entropy_coeff: float = 3e-4
+    num_bins: int = 41        # twohot bins over symlog space
+
+    @property
+    def stoch_dim(self) -> int:
+        return self.stoch * self.classes
+
+
+# ------------------------------------------------------------ math utils
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def bin_centers(cfg: DreamerConfig):
+    import jax.numpy as jnp
+
+    return jnp.linspace(-20.0, 20.0, cfg.num_bins)
+
+
+def twohot(x, cfg: DreamerConfig):
+    """Twohot encoding of symlog(x) over the fixed bins: [..., num_bins]."""
+    import jax.numpy as jnp
+
+    centers = bin_centers(cfg)
+    x = jnp.clip(symlog(x), centers[0], centers[-1])
+    idx = jnp.sum((centers[None, ...] <= x[..., None]).astype(jnp.int32),
+                  axis=-1) - 1
+    idx = jnp.clip(idx, 0, cfg.num_bins - 2)
+    lo, hi = centers[idx], centers[idx + 1]
+    w_hi = (x - lo) / jnp.maximum(hi - lo, 1e-8)
+    one = jnp.eye(cfg.num_bins)
+    return one[idx] * (1.0 - w_hi)[..., None] + one[idx + 1] * w_hi[..., None]
+
+
+def twohot_mean(logits, cfg: DreamerConfig):
+    """Expected value of a twohot distribution, decoded through symexp."""
+    import jax
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    return symexp((probs * bin_centers(cfg)).sum(-1))
+
+
+# ----------------------------------------------------------- init helpers
+
+
+def _mlp_init(key, sizes, out_scale=1.0):
+    import jax
+    import jax.numpy as jnp
+
+    keys = jax.random.split(key, len(sizes) - 1)
+    layers = []
+    for i in range(len(sizes) - 1):
+        scale = out_scale if i == len(sizes) - 2 else \
+            np.sqrt(2.0 / sizes[i])
+        layers.append({
+            "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1]),
+                                   jnp.float32) * scale,
+            "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
+    return layers
+
+
+def _mlp(layers, x):
+    import jax
+
+    for i, l in enumerate(layers):
+        x = x @ l["w"] + l["b"]
+        if i < len(layers) - 1:
+            x = jax.nn.silu(x)
+    return x
+
+
+def init_world_model(cfg: DreamerConfig, key) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(key, 8)
+    D, S, U = cfg.deter, cfg.stoch_dim, cfg.units
+    in_dim = S + cfg.num_actions
+    return {
+        "encoder": _mlp_init(ks[0], (cfg.obs_dim, U, U)),
+        # GRU over [stoch+action] -> deter
+        "gru": {"wx": jax.random.normal(ks[1], (in_dim, 3 * D)) * 0.02,
+                "wh": jax.random.normal(ks[2], (D, 3 * D)) * 0.02,
+                "b": jnp.zeros((3 * D,))},
+        "prior": _mlp_init(ks[3], (D, U, S), out_scale=0.02),
+        "post": _mlp_init(ks[4], (D + U, U, S), out_scale=0.02),
+        "decoder": _mlp_init(ks[5], (D + S, U, cfg.obs_dim)),
+        "reward": _mlp_init(ks[6], (D + S, U, cfg.num_bins),
+                            out_scale=0.0),
+        "cont": _mlp_init(ks[7], (D + S, U, 1)),
+    }
+
+
+def init_actor_critic(cfg: DreamerConfig, key) -> Dict[str, Any]:
+    import jax
+
+    k1, k2 = jax.random.split(key)
+    feat = cfg.deter + cfg.stoch_dim
+    return {
+        "actor": _mlp_init(k1, (feat, cfg.units, cfg.num_actions),
+                           out_scale=0.02),
+        "critic": _mlp_init(k2, (feat, cfg.units, cfg.num_bins),
+                            out_scale=0.0),
+    }
+
+
+# ------------------------------------------------------------------ RSSM
+
+
+def _gru(params, h, x):
+    import jax
+    import jax.numpy as jnp
+
+    gates = x @ params["wx"] + h @ params["wh"] + params["b"]
+    r, z, n = jnp.split(gates, 3, axis=-1)
+    r, z = jax.nn.sigmoid(r), jax.nn.sigmoid(z)
+    n = jnp.tanh(r * n)
+    return (1 - z) * n + z * h
+
+
+def _sample_stoch(logits, cfg: DreamerConfig, key):
+    """Straight-through categorical sample per group: [..., stoch*classes]."""
+    import jax
+    import jax.numpy as jnp
+
+    shaped = logits.reshape(logits.shape[:-1] + (cfg.stoch, cfg.classes))
+    # unimix: 1% uniform smoothing (DreamerV3 trick for stable KL)
+    probs = 0.99 * jax.nn.softmax(shaped, -1) + 0.01 / cfg.classes
+    sample = jax.random.categorical(key, jnp.log(probs))
+    onehot = jax.nn.one_hot(sample, cfg.classes)
+    st = onehot + probs - jax.lax.stop_gradient(probs)  # straight-through
+    return st.reshape(logits.shape[:-1] + (cfg.stoch_dim,))
+
+
+def _kl(lhs_logits, rhs_logits, cfg: DreamerConfig):
+    """KL(lhs || rhs) summed over groups, with unimix smoothing."""
+    import jax
+    import jax.numpy as jnp
+
+    def dist(logits):
+        shaped = logits.reshape(logits.shape[:-1]
+                                + (cfg.stoch, cfg.classes))
+        probs = 0.99 * jax.nn.softmax(shaped, -1) + 0.01 / cfg.classes
+        return probs, jnp.log(probs)
+
+    pl, pll = dist(lhs_logits)
+    _, qll = dist(rhs_logits)
+    return (pl * (pll - qll)).sum((-2, -1))
+
+
+def observe(wm, cfg: DreamerConfig, obs_seq, action_seq, first_seq, key):
+    """Posterior rollout over a [T, B, ...] sequence batch.
+
+    Returns (deters, posts_logits, priors_logits, stochs) each [T, B, ...].
+    ``first_seq`` marks episode starts: the recurrent state resets.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T, B = obs_seq.shape[:2]
+    embed = _mlp(wm["encoder"], symlog(obs_seq))
+    keys = jax.random.split(key, T)
+
+    def step(carry, inp):
+        h, z = carry
+        emb_t, act_t, first_t, k = inp
+        mask = (1.0 - first_t)[:, None]
+        h, z = h * mask, z * mask
+        h = _gru(wm["gru"], h, jnp.concatenate([z, act_t], -1))
+        prior_logits = _mlp(wm["prior"], h)
+        post_logits = _mlp(wm["post"], jnp.concatenate([h, emb_t], -1))
+        z = _sample_stoch(post_logits, cfg, k)
+        return (h, z), (h, post_logits, prior_logits, z)
+
+    h0 = jnp.zeros((B, cfg.deter))
+    z0 = jnp.zeros((B, cfg.stoch_dim))
+    _, (hs, posts, priors, zs) = jax.lax.scan(
+        step, (h0, z0), (embed, action_seq, first_seq, keys))
+    return hs, posts, priors, zs
+
+
+def imagine(wm, ac, cfg: DreamerConfig, start_h, start_z, key):
+    """Actor-driven imagination from flattened start states: [H+1, N, ...]."""
+    import jax
+    import jax.numpy as jnp
+
+    keys = jax.random.split(key, cfg.horizon)
+
+    def step(carry, k):
+        h, z = carry
+        feat = jnp.concatenate([h, z], -1)
+        logits = _mlp(ac["actor"], jax.lax.stop_gradient(feat))
+        k1, k2 = jax.random.split(k)
+        a = jax.nn.one_hot(jax.random.categorical(k1, logits),
+                           cfg.num_actions)
+        h = _gru(wm["gru"], h, jnp.concatenate([z, a], -1))
+        z = _sample_stoch(_mlp(wm["prior"], h), cfg, k2)
+        return (h, z), (h, z, a, logits)
+
+    (_, _), (hs, zs, acts, logits) = jax.lax.scan(
+        step, (start_h, start_z), keys)
+    hs = jnp.concatenate([start_h[None], hs], 0)
+    zs = jnp.concatenate([start_z[None], zs], 0)
+    return hs, zs, acts, logits
+
+
+# ------------------------------------------------------------------ loss
+
+
+def world_model_loss(wm, cfg: DreamerConfig, batch, key):
+    import jax.numpy as jnp
+
+    obs, acts = batch["obs"], batch["actions_onehot"]
+    hs, posts, priors, zs = observe(
+        wm, cfg, obs, acts, batch["first"], key)
+    feat = jnp.concatenate([hs, zs], -1)
+    recon = _mlp(wm["decoder"], feat)
+    pred_loss = jnp.square(recon - symlog(obs)).sum(-1)
+    import jax
+
+    rew_logits = _mlp(wm["reward"], feat)
+    rew_target = twohot(batch["rewards"], cfg)
+    rew_loss = -(rew_target
+                 * jax.nn.log_softmax(rew_logits, axis=-1)).sum(-1)
+    cont_logit = _mlp(wm["cont"], feat)[..., 0]
+    cont_target = 1.0 - batch["dones"]
+    cont_loss = -(cont_target * jax.nn.log_sigmoid(cont_logit)
+                  + (1 - cont_target) * jax.nn.log_sigmoid(-cont_logit))
+    dyn = jnp.maximum(_kl(jax.lax.stop_gradient(posts), priors, cfg),
+                      cfg.free_bits)
+    rep = jnp.maximum(_kl(posts, jax.lax.stop_gradient(priors), cfg),
+                      cfg.free_bits)
+    loss = (pred_loss + rew_loss + cont_loss
+            + cfg.dyn_scale * dyn + cfg.rep_scale * rep).mean()
+    stats = {"wm_loss": loss, "recon": pred_loss.mean(),
+             "reward_loss": rew_loss.mean(), "kl_dyn": dyn.mean()}
+    return loss, (stats, hs, zs)
+
+
+def lambda_returns(rewards, conts, values, cfg: DreamerConfig):
+    """TD(lambda) over imagined [H, N] rewards/continues + [H+1, N] values."""
+    import jax.numpy as jnp
+
+    H = rewards.shape[0]
+    out = [None] * H
+    last = values[-1]
+    for t in range(H - 1, -1, -1):
+        disc = conts[t] * cfg.gamma
+        last = rewards[t] + disc * (
+            (1 - cfg.lam) * values[t + 1] + cfg.lam * last)
+        out[t] = last
+    return jnp.stack(out)
+
+
+def actor_critic_loss(ac, wm, cfg: DreamerConfig, start_h, start_z, key,
+                      ret_ema):
+    import jax
+    import jax.numpy as jnp
+
+    hs, zs, acts, logits = imagine(wm, ac, cfg, start_h, start_z, key)
+    feat = jnp.concatenate([hs, zs], -1)
+    sg_feat = jax.lax.stop_gradient(feat)
+    rew = twohot_mean(_mlp(wm["reward"], sg_feat[1:]), cfg)
+    cont = jax.nn.sigmoid(_mlp(wm["cont"], sg_feat[1:])[..., 0])
+    v_logits = _mlp(ac["critic"], sg_feat)
+    values = twohot_mean(v_logits, cfg)
+    rets = lambda_returns(rew, cont, jax.lax.stop_gradient(values), cfg)
+
+    # percentile return normalization (DreamerV3's scale robustness)
+    lo = jnp.percentile(rets, 5)
+    hi = jnp.percentile(rets, 95)
+    scale = jnp.maximum(hi - lo, 1.0)
+    new_ema = 0.99 * ret_ema + 0.01 * scale
+    adv = (rets - values[:-1]) / jax.lax.stop_gradient(new_ema)
+
+    logp_all = jax.nn.log_softmax(logits)
+    logp = (logp_all * acts).sum(-1)
+    entropy = -(jax.nn.softmax(logits) * logp_all).sum(-1)
+    actor_loss = -(logp * jax.lax.stop_gradient(adv)
+                   + cfg.entropy_coeff * entropy).mean()
+
+    # critic: twohot regression toward lambda returns, all imagined steps
+    tgt = jax.lax.stop_gradient(twohot(rets, cfg))
+    v_lp = jax.nn.log_softmax(v_logits[:-1], -1)
+    critic_loss = -(tgt * v_lp).sum(-1).mean()
+
+    loss = actor_loss + critic_loss
+    stats = {"actor_loss": actor_loss, "critic_loss": critic_loss,
+             "entropy": entropy.mean(), "return_mean": rets.mean(),
+             "value_mean": values.mean()}
+    return loss, (stats, new_ema)
+
+
+class DreamerV3:
+    """Single-process DreamerV3 learner (driver-side; env stepping via the
+    discrete EnvRunner's sequence batches).
+
+    API mirrors the offline learners (``rl/offline.py``): feed [T, B]
+    sequence batches, it updates the world model then the actor-critic in
+    imagination. ``policy_logits(obs_context)`` runs the posterior filter
+    for acting.
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int, seed: int = 0,
+                 wm_lr: float = 1e-3, ac_lr: float = 3e-4, **cfg_kwargs):
+        import jax
+        import optax
+
+        self.cfg = DreamerConfig(obs_dim=obs_dim, num_actions=num_actions,
+                                 **cfg_kwargs)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        self.wm = init_world_model(self.cfg, k1)
+        self.ac = init_actor_critic(self.cfg, k2)
+        self.wm_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(wm_lr))
+        self.ac_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(ac_lr))
+        self.wm_state = self.wm_opt.init(self.wm)
+        self.ac_state = self.ac_opt.init(self.ac)
+        self.ret_ema = 1.0
+        self.key = jax.random.PRNGKey(seed + 1)
+        self._step = self._make_step()
+        self.iteration = 0
+
+    def _make_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        wm_opt, ac_opt = self.wm_opt, self.ac_opt
+
+        @jax.jit
+        def step(wm, ac, wm_state, ac_state, ret_ema, batch, key):
+            k1, k2 = jax.random.split(key)
+            (_, (wm_stats, hs, zs)), wm_grads = jax.value_and_grad(
+                world_model_loss, has_aux=True)(wm, cfg, batch, k1)
+            upd, wm_state = wm_opt.update(wm_grads, wm_state, wm)
+            wm = optax.apply_updates(wm, upd)
+
+            # imagination starts from every posterior state (flattened)
+            start_h = jax.lax.stop_gradient(
+                hs.reshape(-1, cfg.deter))
+            start_z = jax.lax.stop_gradient(
+                zs.reshape(-1, cfg.stoch_dim))
+            (_, (ac_stats, new_ema)), ac_grads = jax.value_and_grad(
+                actor_critic_loss, has_aux=True)(
+                    ac, wm, cfg, start_h, start_z, k2, ret_ema)
+            upd, ac_state = ac_opt.update(ac_grads, ac_state, ac)
+            ac = optax.apply_updates(ac, upd)
+            return wm, ac, wm_state, ac_state, new_ema, \
+                {**wm_stats, **ac_stats}
+
+        return step
+
+    def train_on_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """batch: obs [T,B,obs], actions [T,B] int, rewards/dones/first
+        [T,B] float."""
+        import jax
+        import jax.numpy as jnp
+
+        jb = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions_onehot": jax.nn.one_hot(
+                jnp.asarray(batch["actions"], jnp.int32),
+                self.cfg.num_actions),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+            "first": jnp.asarray(batch["first"], jnp.float32),
+        }
+        self.key, sub = jax.random.split(self.key)
+        self.wm, self.ac, self.wm_state, self.ac_state, self.ret_ema, \
+            stats = self._step(self.wm, self.ac, self.wm_state,
+                               self.ac_state, self.ret_ema, jb, sub)
+        self.iteration += 1
+        return {k: float(v) for k, v in stats.items()}
+
+    def policy_logits(self, obs_seq, action_seq, first_seq):
+        """Filtered policy logits for the LAST step of a context window."""
+        import jax
+        import jax.numpy as jnp
+
+        self.key, sub = jax.random.split(self.key)
+        hs, _, _, zs = observe(
+            self.wm, self.cfg, jnp.asarray(obs_seq, jnp.float32),
+            jax.nn.one_hot(jnp.asarray(action_seq, jnp.int32),
+                           self.cfg.num_actions),
+            jnp.asarray(first_seq, jnp.float32), sub)
+        feat = jnp.concatenate([hs[-1], zs[-1]], -1)
+        return np.asarray(_mlp(self.ac["actor"], feat))
+
+
+import ray_tpu  # noqa: E402  (actor decorator needs the package root)
+
+
+@ray_tpu.remote
+class DreamerEnvRunner:
+    """Sampling actor with the filtered RSSM policy.
+
+    Unlike the feedforward ``EnvRunner``, acting is recurrent: each env
+    keeps its (deter, stoch) belief state, updated with the posterior at
+    every step (reference: DreamerV3's EnvRunner keeps per-env RSSM
+    states)."""
+
+    def __init__(self, env_id: str, num_envs: int, cfg_blob: bytes,
+                 seed: int = 0, env_fn_blob=None):
+        import cloudpickle
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        if env_fn_blob is not None:
+            env_fn = cloudpickle.loads(env_fn_blob)
+            self.env = gym.vector.SyncVectorEnv(
+                [lambda i=i: env_fn() for i in range(num_envs)])
+        else:
+            self.env = gym.make_vec(env_id, num_envs=num_envs,
+                                    vectorization_mode="sync")
+        self.cfg: DreamerConfig = cloudpickle.loads(cfg_blob)
+        self.key = jax.random.PRNGKey(seed)
+        self.num_envs = num_envs
+        self.obs, _ = self.env.reset(seed=seed)
+        self.h = jnp.zeros((num_envs, self.cfg.deter))
+        self.z = jnp.zeros((num_envs, self.cfg.stoch_dim))
+        self.prev_action = np.zeros(num_envs, np.int64)
+        self.first = np.ones(num_envs, np.float32)
+        self._ep_ret = np.zeros(num_envs)
+        self.completed_returns = []
+        self._act = None
+
+    def _make_act(self):
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+
+        @jax.jit
+        def act(wm, ac, h, z, obs, prev_a_onehot, first, key):
+            mask = (1.0 - first)[:, None]
+            h, z = h * mask, z * mask
+            embed = _mlp(wm["encoder"], symlog(obs))
+            h = _gru(wm["gru"], h,
+                     jnp.concatenate([z, prev_a_onehot * mask], -1))
+            post = _mlp(wm["post"], jnp.concatenate([h, embed], -1))
+            k1, k2 = jax.random.split(key)
+            z = _sample_stoch(post, cfg, k1)
+            logits = _mlp(ac["actor"], jnp.concatenate([h, z], -1))
+            a = jax.random.categorical(k2, logits)
+            return h, z, a
+
+        return act
+
+    def sample(self, weights_ref, num_steps: int):
+        """[T, N] sequence batch with episode-start flags."""
+        import jax
+        import jax.numpy as jnp
+
+        wm, ac = weights_ref["wm"], weights_ref["ac"]
+        if self._act is None:
+            self._act = self._make_act()
+        obs_b, act_b, rew_b, done_b, first_b = [], [], [], [], []
+        for _ in range(num_steps):
+            self.key, sub = jax.random.split(self.key)
+            onehot = np.eye(self.cfg.num_actions,
+                            dtype=np.float32)[self.prev_action]
+            self.h, self.z, a = self._act(
+                wm, ac, self.h, self.z,
+                jnp.asarray(self.obs, jnp.float32), jnp.asarray(onehot),
+                jnp.asarray(self.first), sub)
+            actions = np.asarray(a)
+            nxt, rew, term, trunc, _ = self.env.step(actions)
+            done = np.logical_or(term, trunc)
+            obs_b.append(self.obs.copy())
+            act_b.append(actions)
+            rew_b.append(rew)
+            done_b.append(term.astype(np.float32))
+            first_b.append(self.first.copy())
+            self._ep_ret += rew
+            for i in np.nonzero(done)[0]:
+                self.completed_returns.append(float(self._ep_ret[i]))
+                self._ep_ret[i] = 0.0
+            self.first = done.astype(np.float32)
+            self.prev_action = actions
+            self.obs = nxt
+        return {
+            "obs": np.stack(obs_b).astype(np.float32),
+            "actions": np.stack(act_b),
+            "rewards": np.stack(rew_b).astype(np.float32),
+            "dones": np.stack(done_b),
+            "first": np.stack(first_b),
+        }
+
+    def episode_stats(self, clear: bool = True):
+        out = {"returns": list(self.completed_returns)}
+        if clear:
+            self.completed_returns = []
+        return out
+
+    def ping(self):
+        return True
+
+
+class DreamerV3Algo:
+    """Driver-side DreamerV3 training loop (reference:
+    ``rllib/algorithms/dreamerv3/dreamerv3.py`` training_step — sample
+    with the filtered policy, append to the sequence replay, update the
+    world model + imagination actor-critic, broadcast weights).
+    """
+
+    def __init__(self, env: str = None, env_fn=None, num_env_runners: int = 1,
+                 num_envs_per_runner: int = 4, seq_len: int = 48,
+                 batch_size: int = 8, replay_capacity: int = 2000,
+                 updates_per_iter: int = 4, seed: int = 0, **cfg_kwargs):
+        import cloudpickle
+        import gymnasium as gym
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        probe = env_fn() if env_fn is not None else gym.make(env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self.learner = DreamerV3(obs_dim, num_actions, seed=seed,
+                                 **cfg_kwargs)
+        blob = cloudpickle.dumps(self.learner.cfg)
+        self.runners = [
+            DreamerEnvRunner.options(max_restarts=2).remote(
+                env, num_envs_per_runner, blob, seed + i,
+                cloudpickle.dumps(env_fn) if env_fn else None)
+            for i in range(num_env_runners)]
+        ray_tpu.get([r.ping.remote() for r in self.runners])
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.updates_per_iter = updates_per_iter
+        self._segments: list = []  # each: dict of [T, ...] arrays
+        self._capacity = replay_capacity
+        self._rng = np.random.RandomState(seed)
+        self.iteration = 0
+        self._total_env_steps = 0
+
+    def _weights(self):
+        return {"wm": self.learner.wm, "ac": self.learner.ac}
+
+    def training_step(self) -> Dict[str, Any]:
+        w = ray_tpu.put(self._weights())
+        rollouts = ray_tpu.get(
+            [r.sample.remote(w, self.seq_len) for r in self.runners],
+            timeout=600)
+        for ro in rollouts:
+            N = ro["obs"].shape[1]
+            self._total_env_steps += ro["obs"].shape[0] * N
+            for n in range(N):
+                # copy: rollouts arrive as read-only zero-copy views
+                seg = {k: v[:, n].copy() for k, v in ro.items()}
+                seg["first"][0] = 1.0  # each segment starts a context
+                self._segments.append(seg)
+        if len(self._segments) > self._capacity:
+            self._segments = self._segments[-self._capacity:]
+        stats: Dict[str, float] = {}
+        if len(self._segments) >= self.batch_size:
+            for _ in range(self.updates_per_iter):
+                idx = self._rng.choice(len(self._segments),
+                                       self.batch_size, replace=False)
+                batch = {
+                    k: np.stack([self._segments[i][k] for i in idx], 1)
+                    for k in self._segments[0]}
+                stats = self.learner.train_on_batch(batch)
+        self.iteration += 1
+        return {"learner": stats,
+                "num_env_steps_sampled": self._total_env_steps,
+                "replay_segments": len(self._segments)}
+
+    def episode_stats(self):
+        stats = ray_tpu.get([r.episode_stats.remote()
+                             for r in self.runners])
+        return [x for s in stats for x in s["returns"]]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -72,8 +72,9 @@ def wrap_spawn(spec: Dict[str, Any], argv: List[str],
             "runtime_env['image_uri'] requires podman or docker on the "
             "worker host (or RAY_TPU_CONTAINER_RUNTIME pointing at one); "
             "neither was found")
-    mounts = {session_dir, "/dev/shm",
-              os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu")}
+    cache_dir = os.path.join(os.environ.get("TMPDIR", "/tmp"), "ray_tpu")
+    os.makedirs(cache_dir, exist_ok=True)  # podman refuses missing sources
+    mounts = {session_dir, "/dev/shm", cache_dir}
     for p in sys_paths.split(os.pathsep):
         if p and os.path.exists(p):
             mounts.add(p)

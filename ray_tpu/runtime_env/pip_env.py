@@ -164,9 +164,3 @@ def spawn_spec_from_renv(renv: Optional[Dict[str, Any]]
         return normalize_spec(renv["pip"], "pip")
     return None
 
-
-def needs_env_worker(renv: Optional[Dict[str, Any]]) -> bool:
-    """Does this runtime_env need a DEDICATED worker (venv/container)?
-    Single source of truth for scheduler routing — new interpreter-level
-    env types only need a branch in ``spawn_spec_from_renv``."""
-    return spawn_spec_from_renv(renv) is not None

@@ -52,10 +52,25 @@ class ServeController:
                 ray_tpu.get([r.reconfigure.remote(spec["user_config"])
                              for r in replicas])
             app[spec["name"]] = {"spec": spec, "replicas": replicas}
+            self._notify(app_name, spec["name"])
         # Block until all replicas respond (deployment is ready).
         for dep in app.values():
             ray_tpu.get([r.health_check.remote() for r in dep["replicas"]])
         return True
+
+    def _notify(self, app_name: str, deployment_name: Optional[str] = None):
+        """Config-push (reference: ``serve/_private/long_poll.py`` — the
+        controller notifies routers/handles of replica-set changes instead
+        of making them poll). Rides the GCS pubsub plane; handles watch
+        the channel and refresh their replica cache lazily."""
+        from ray_tpu.util import pubsub
+
+        try:
+            pubsub.publish("serve_config",
+                           {"app": app_name, "deployment": deployment_name},
+                           wait=False)
+        except Exception:
+            pass  # notification is best-effort; handles also self-heal
 
     def get_replicas(self, app_name: str, deployment_name: str):
         app = self.apps.get(app_name, {})
@@ -79,6 +94,7 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+        self._notify(app_name)
         return True
 
     def scale(self, app_name: str, deployment_name: str, num_replicas: int):
@@ -106,6 +122,7 @@ class ServeController:
                 except Exception:
                     pass
             dep["replicas"] = cur[:num_replicas]
+        self._notify(app_name, deployment_name)
         return True
 
     def check_health(self):
@@ -130,6 +147,9 @@ class ServeController:
                         spec["blob"], tuple(spec.get("init_args") or ()),
                         spec.get("init_kwargs") or {}, spec["is_class"]))
                 dep["replicas"] = alive
+        if replaced:
+            for app_name in self.apps:
+                self._notify(app_name)
         return replaced
 
 

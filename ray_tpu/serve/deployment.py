@@ -118,6 +118,92 @@ class Replica:
         return True
 
 
+class _ConfigWatcher:
+    """Process-wide listener on the controller's ``serve_config`` channel
+    (reference: ``serve/_private/long_poll.py`` LongPollClient). Handles
+    compare their watermark against ``version(app, dep)`` and refresh the
+    replica cache only when the controller actually changed something —
+    no per-request polling, no stale routing after scale/redeploy."""
+
+    _instance: Optional["_ConfigWatcher"] = None
+
+    def __init__(self):
+        import threading
+
+        self._versions: Dict[tuple, int] = {}
+        self._global = 0
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get(cls) -> "_ConfigWatcher":
+        if cls._instance is None:
+            cls._instance = _ConfigWatcher()
+        cls._instance._ensure_thread()
+        return cls._instance
+
+    def _ensure_thread(self):
+        import threading
+
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-config-watch")
+        self._thread.start()
+
+    def _run(self):
+        try:
+            from ray_tpu._private import worker as worker_mod
+            from ray_tpu.util.pubsub import Subscriber
+
+            w = worker_mod._global_worker
+            sub = Subscriber("serve_config")
+            while True:
+                item = sub.poll(timeout=1.0)
+                if item is None:
+                    if sub._closed.is_set():
+                        break
+                    # Timed out: exit when this session died so the next
+                    # handle resolve starts a fresh watcher on the new
+                    # session (a blocked-forever thread would read as
+                    # "alive" and wedge notifications permanently).
+                    if worker_mod._global_worker is not w or w.closed:
+                        break
+                    continue
+                # Per-item handling: one malformed message on the public
+                # channel must not kill the watcher.
+                try:
+                    with self._lock:
+                        m = item.get("message")
+                        if item.get("resubscribed") or not isinstance(
+                                m, dict):
+                            # Gap (or junk): events may have been missed.
+                            self._global += 1
+                            continue
+                        key = (m.get("app"), m.get("deployment"))
+                        if key[1] is None:  # app-wide change
+                            self._versions[(key[0], None)] = \
+                                self._versions.get((key[0], None), 0) + 1
+                        else:
+                            self._versions[key] = \
+                                self._versions.get(key, 0) + 1
+                except Exception:
+                    with self._lock:
+                        self._global += 1
+        except Exception:
+            pass  # no cluster yet; a later handle resolve restarts us
+        finally:
+            with self._lock:
+                # Anything published after this thread stops is unseen.
+                self._global += 1
+
+    def version(self, app: str, deployment: str) -> int:
+        with self._lock:
+            return (self._global
+                    + self._versions.get((app, None), 0)
+                    + self._versions.get((app, deployment), 0))
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__",
@@ -129,6 +215,7 @@ class DeploymentHandle:
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
         self._rng = random.Random()
+        self._seen_version = -1  # config-push watermark (_ConfigWatcher)
 
     @staticmethod
     def _on_io_thread() -> bool:
@@ -139,9 +226,17 @@ class DeploymentHandle:
         w = global_worker()
         return threading.current_thread() is w._loop_thread
 
+    def _fresh(self) -> bool:
+        return self._seen_version == _ConfigWatcher.get().version(
+            self.app_name, self.deployment_name)
+
     def _refresh(self):
         from .controller import get_controller
 
+        # Snapshot BEFORE fetching: a change landing mid-fetch triggers
+        # another refresh on the next call instead of being missed.
+        self._seen_version = _ConfigWatcher.get().version(
+            self.app_name, self.deployment_name)
         ctl = get_controller()
         self._replicas = ray_tpu.get(ctl.get_replicas.remote(
             self.app_name, self.deployment_name))
@@ -150,6 +245,8 @@ class DeploymentHandle:
     async def _refresh_async(self):
         from .controller import get_controller_async
 
+        self._seen_version = _ConfigWatcher.get().version(
+            self.app_name, self.deployment_name)
         ctl = await get_controller_async()
         self._replicas = await ctl.get_replicas.remote(
             self.app_name, self.deployment_name)
@@ -164,6 +261,7 @@ class DeploymentHandle:
             multiplexed_model_id if multiplexed_model_id is not None
             else self.multiplexed_model_id)
         h._replicas = self._replicas
+        h._seen_version = self._seen_version
         h._inflight = self._inflight
         return h
 
@@ -191,6 +289,8 @@ class DeploymentHandle:
         return ref, done
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if self._replicas and not self._fresh():
+            self._replicas = []  # config changed: re-resolve below
         if self._replicas:
             ref, done = self._submit(args, kwargs)
             return DeploymentResponse(ref, done)
@@ -263,6 +363,8 @@ class DeploymentHandle:
         from ray_tpu._private import serialization
         from ray_tpu._private.worker import global_worker
 
+        if self._replicas and not self._fresh():
+            self._replicas = []  # config changed: re-resolve
         if not self._replicas:
             await self._refresh_async()
             if not self._replicas:

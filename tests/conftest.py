@@ -19,6 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["RAY_TPU_JAX_PLATFORM"] = "cpu"  # workers inherit this
+# Runtime race detection across the whole suite (the TSAN-config analog,
+# ``.bazelrc:104-116``): loop/thread affinity assertions are live in every
+# test process — an off-loop Connection write fails the test that did it.
+os.environ.setdefault("RAY_TPU_THREAD_CHECKS", "1")
 
 import jax  # noqa: E402
 

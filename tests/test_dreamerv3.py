@@ -1,0 +1,103 @@
+"""DreamerV3 tests: math units + world-model learning signal + the full
+sample-replay-update loop on a toy env.
+
+Model: reference ``rllib/algorithms/dreamerv3/tests`` (unit tests for
+symlog/twohot/RSSM shapes plus short smoke runs; full learning runs live
+in release tests, not CI).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.dreamerv3 import (DreamerConfig, DreamerV3, symexp, symlog,
+                                  twohot, twohot_mean)
+
+
+def test_symlog_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 40.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_twohot_encodes_and_decodes():
+    import jax.numpy as jnp
+
+    cfg = DreamerConfig(obs_dim=1, num_actions=2)
+    x = jnp.asarray([-5.0, -0.3, 0.0, 1.7, 9.0])
+    enc = twohot(x, cfg)
+    assert enc.shape == (5, cfg.num_bins)
+    np.testing.assert_allclose(np.asarray(enc.sum(-1)), 1.0, rtol=1e-5)
+    # exactly two adjacent bins are active (or one on a bin center)
+    assert int((np.asarray(enc) > 1e-6).sum(-1).max()) <= 2
+    # decoding logits that put all mass on the encoding recovers x
+    dec = twohot_mean(jnp.log(jnp.clip(enc, 1e-8)), cfg)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(x), rtol=0.05,
+                               atol=0.05)
+
+
+def test_world_model_learns_dynamics():
+    """On a deterministic synthetic system the WM losses must fall."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    rng = np.random.RandomState(0)
+    learner = DreamerV3(obs_dim=3, num_actions=2, seed=0, deter=32,
+                        stoch=4, classes=4, units=32, horizon=5)
+
+    def make_batch(T=16, B=4):
+        # rotation dynamics: obs rotates; action 1 doubles the reward
+        obs = np.zeros((T, B, 3), np.float32)
+        acts = rng.randint(0, 2, (T, B))
+        theta = rng.rand(B) * 2 * np.pi
+        for t in range(T):
+            obs[t, :, 0] = np.cos(theta)
+            obs[t, :, 1] = np.sin(theta)
+            obs[t, :, 2] = 1.0
+            theta = theta + 0.3
+        rew = obs[..., 0] * (1 + acts)
+        first = np.zeros((T, B), np.float32)
+        first[0] = 1.0
+        return {"obs": obs, "actions": acts, "rewards": rew,
+                "dones": np.zeros((T, B), np.float32), "first": first}
+
+    first_stats = learner.train_on_batch(make_batch())
+    for _ in range(25):
+        stats = learner.train_on_batch(make_batch())
+    assert stats["recon"] < first_stats["recon"] * 0.5, \
+        (first_stats["recon"], stats["recon"])
+    assert stats["reward_loss"] < first_stats["reward_loss"], \
+        (first_stats["reward_loss"], stats["reward_loss"])
+    assert np.isfinite(stats["actor_loss"])
+    assert np.isfinite(stats["value_mean"])
+
+
+@pytest.mark.slow
+def test_dreamer_full_loop_cartpole(ray_cluster):
+    """End-to-end: recurrent-policy sampling actors, sequence replay,
+    fused WM+AC updates. Smoke thresholds (full learning is a release
+    test, as in the reference)."""
+    from ray_tpu.rl.dreamerv3 import DreamerV3Algo
+
+    algo = DreamerV3Algo(env="CartPole-v1", num_env_runners=1,
+                         num_envs_per_runner=4, seq_len=32, batch_size=4,
+                         updates_per_iter=2, seed=0, deter=32, stoch=4,
+                         classes=4, units=32, horizon=5)
+    try:
+        first = None
+        for i in range(8):
+            out = algo.training_step()
+            if out["learner"] and first is None:
+                first = out["learner"]
+        last = out["learner"]
+        assert last, "no updates ran"
+        assert out["replay_segments"] >= 4
+        assert out["num_env_steps_sampled"] >= 8 * 32 * 4
+        # the world model is learning something about CartPole
+        assert last["wm_loss"] < first["wm_loss"], (first, last)
+        returns = algo.episode_stats()
+        assert returns, "no episodes completed"
+        assert all(np.isfinite(r) for r in returns)
+    finally:
+        algo.stop()

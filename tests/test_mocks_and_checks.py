@@ -1,0 +1,174 @@
+"""Mock harness + thread/loop instrumentation tests.
+
+Reference model: ``src/mock/ray`` GMock-mirror unit tests (components
+driven against mocked peers, e.g. ``cluster_task_manager_test.cc``) and
+``thread_checker.h`` / ``event_stats.h`` behavior.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from ray_tpu.testing import MockConnection, gcs_harness
+from ray_tpu._private.thread_check import (LoopMonitor, ThreadChecker,
+                                           assert_on_loop)
+
+
+# ------------------------------------------------------ publisher (unit)
+
+
+def test_publisher_unit_with_mock_conns():
+    from ray_tpu._private.pubsub import Publisher
+
+    pub = Publisher()
+    c1, c2 = MockConnection("a"), MockConnection("b")
+    pub.subscribe("ch", c1, corr=7)
+    pub.subscribe("ch", c2, corr=9)
+    assert pub.publish("ch", {"x": 1}) == 2
+    assert c1.chunks_for(7)[0]["pub"] == {"x": 1}
+    assert c2.chunks_for(9)[0]["seq"] == 1
+
+    # slow subscriber: backpressure drops instead of buffering
+    c2.set_backlog(1 << 30)
+    assert pub.publish("ch", {"x": 2}) == 1
+    assert len(c2.chunks_for(9)) == 1  # nothing new
+    c2.set_backlog(0)
+    pub.publish("ch", {"x": 3})
+    # the next delivered frame reports the drop so readers see the gap
+    assert c2.chunks_for(9)[-1]["dropped"] == 1
+
+    # dead connection pruned on publish
+    c1.mark_closed()
+    assert pub.publish("ch", {"x": 4}) == 1
+    assert pub.stats()["ch"]["subscribers"] == 1
+
+    # clean unsubscribe sends the stream-ending reply
+    pub.unsubscribe("ch", c2, 9)
+    end = c2.replies_to(9)[-1]
+    assert end["closed"] and end["delivered"] >= 2
+    assert pub.stats() == {}
+
+
+# ---------------------------------------------------- GCS harness (unit)
+
+
+def test_gcs_harness_kv_and_pubsub():
+    async def run():
+        async with gcs_harness() as h:
+            driver = h.add_client(role="driver")
+            await h.dispatch(driver, {"t": "kv_put", "ns": "t", "k": "k1",
+                                      "v": b"v1", "i": 1})
+            assert driver.conn.replies_to(1)[0]["ok"]
+            await h.dispatch(driver, {"t": "kv_get", "ns": "t", "k": "k1",
+                                      "i": 2})
+            assert driver.conn.replies_to(2)[0]["v"] == b"v1"
+
+            # pubsub through the real handlers
+            await h.dispatch(driver, {"t": "sub", "ch": "c", "i": 3})
+            other = h.add_client(role="worker")
+            await h.dispatch(other, {"t": "pub", "ch": "c",
+                                     "m": {"n": 5}, "i": 4})
+            assert driver.conn.chunks_for(3)[0]["pub"] == {"n": 5}
+            assert other.conn.replies_to(4)[0]["delivered"] == 1
+
+            # disconnect cleanup: no delivery, no crash
+            h.disconnect(driver)
+            await h.dispatch(other, {"t": "pub", "ch": "c", "m": 1, "i": 5})
+            assert other.conn.replies_to(5)[0]["delivered"] == 0
+
+    asyncio.run(run())
+
+
+def test_gcs_harness_node_lifecycle_events():
+    async def run():
+        async with gcs_harness() as h:
+            from ray_tpu._private.ids import NodeID
+
+            watcher = h.add_client(role="driver")
+            await h.dispatch(watcher, {"t": "sub", "ch": "node_events",
+                                       "i": 1})
+            agent = h.add_client(role="agent")
+            nid = NodeID.from_random()
+            await h.dispatch(agent, {
+                "t": "hello", "role": "agent", "node_id": nid.binary(),
+                "resources": {"CPU": 4.0}, "hostname": "mockhost", "i": 2})
+            events = [c["pub"] for c in watcher.conn.chunks_for(1)]
+            assert any(e["event"] == "node_joined"
+                       and e["hostname"] == "mockhost" for e in events)
+
+            h.disconnect(agent)
+            events = [c["pub"] for c in watcher.conn.chunks_for(1)]
+            assert any(e["event"] == "node_died" for e in events)
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------ thread/loop checks
+
+
+def test_thread_checker_binds_and_detects(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_THREAD_CHECKS", "1")
+    tc = ThreadChecker("unit")
+    tc.check()  # binds to this thread
+    tc.check()  # same thread ok
+
+    failed = []
+
+    def other():
+        try:
+            tc.check()
+        except RuntimeError as e:
+            failed.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert failed and "affinity violated" in str(failed[0])
+
+    # disabled => no-op from any thread
+    monkeypatch.setenv("RAY_TPU_THREAD_CHECKS", "0")
+    t2 = threading.Thread(target=tc.check)
+    t2.start()
+    t2.join()
+
+
+def test_assert_on_loop(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_THREAD_CHECKS", "1")
+
+    async def on_loop():
+        loop = asyncio.get_running_loop()
+        assert_on_loop(loop, "op")  # fine
+        with pytest.raises(RuntimeError, match="owning IO loop"):
+            assert_on_loop(asyncio.new_event_loop(), "op")
+
+    asyncio.run(on_loop())
+
+
+def test_loop_monitor_sees_blocking():
+    async def run():
+        mon = LoopMonitor(interval=0.02, name="t").start()
+        await asyncio.sleep(0.1)  # a few clean ticks
+        time.sleep(0.3)           # synchronously block the loop
+        await asyncio.sleep(0.05)
+        mon.stop()
+        return mon.stats()
+
+    stats = asyncio.run(run())
+    assert stats["samples"] >= 3
+    assert stats["max_lag_ms"] > 200  # the 300ms block was observed
+
+
+def test_cluster_info_exposes_loop_stats():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=1, probe_tpu=False, ignore_reinit_error=True)
+    try:
+        import ray_tpu._private.worker as pw
+
+        info = pw.global_worker().cluster_info()
+        assert "loop_stats" in info
+        assert info["loop_stats"]["samples"] >= 0
+    finally:
+        ray_tpu.shutdown()

@@ -163,3 +163,43 @@ def test_status_and_delete(serve_cluster):
     assert "temp-app" in serve.status()
     serve.delete("temp-app")
     assert "temp-app" not in serve.status()
+
+
+def test_config_push_invalidates_handle_cache(serve_cluster):
+    """Long-poll-equivalent (reference serve/_private/long_poll.py): after
+    the controller scales a deployment, existing handles see the new
+    replica set without manual refresh or per-request polling."""
+    import time
+
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_controller
+
+    @serve.deployment(num_replicas=1)
+    class Who:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, req):
+            return self.pid
+
+    serve.run(Who.bind(), name="who_app", route_prefix=None)
+    h = serve.get_deployment_handle("Who", "who_app")
+    first = {h.remote(None).result() for _ in range(4)}
+    assert len(first) == 1  # one replica
+
+    ctl = get_controller()
+    import ray_tpu as rt
+
+    rt.get(ctl.scale.remote("who_app", "Who", 3))
+    # the push arrives asynchronously; the handle must converge without
+    # any explicit refresh call
+    deadline = time.time() + 20
+    seen = set()
+    while time.time() < deadline:
+        seen.add(h.remote(None).result())
+        if len(seen) >= 2:
+            break
+        time.sleep(0.1)
+    assert len(seen) >= 2, f"handle never saw scaled replicas: {seen}"
