@@ -26,7 +26,12 @@ os.environ.setdefault("RAY_TPU_THREAD_CHECKS", "1")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("RAY_TPU_TPU_SMOKE") != "1":
+    # CPU pin for the regular suite. The opportunistic TPU smoke module
+    # (test_tpu_smoke.py, run alone with RAY_TPU_TPU_SMOKE=1) needs the
+    # real backend — switching platforms after backend init cannot work,
+    # so the pin must not happen at all in that mode.
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
