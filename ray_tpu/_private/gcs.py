@@ -396,6 +396,10 @@ class GcsServer:
         from .config import config as _cfg
 
         self._done_tasks: deque = deque()  # TaskID, GC'd beyond max
+        # Structured export events (reference: util/event.h RayEvent):
+        # bounded ring served by the state API + JSONL in the session dir.
+        self.cluster_events: deque = deque(maxlen=10_000)
+        self._event_file = None
         self.max_done_tasks = _cfg().max_done_tasks
         self.task_events: deque = deque(maxlen=_cfg().max_task_events)
         # (sender_key, name, tags_tuple) -> metric dict
@@ -816,11 +820,29 @@ class GcsServer:
     # ------------------------------------------------------------ pubsub
 
     def _pub(self, channel: str, message: dict):
-        """Publish a GCS-internal event (best-effort, never raises)."""
+        """Publish a GCS-internal event (best-effort, never raises).
+
+        Every internal publish is also a structured export event
+        (reference: ``src/ray/util/event.h:246`` EventManager/RayEvent —
+        JSONL files external collectors tail, plus an in-memory ring the
+        state API serves)."""
         try:
             self.publisher.publish(channel, message)
         except Exception:
             logger.exception("publish on %r failed", channel)
+        evt = {"ts": time.time(), "channel": channel, **message}
+        self.cluster_events.append(evt)
+        try:
+            if self._event_file is None:
+                import os as _os
+
+                path = _os.path.join(self.session_dir, "events.jsonl")
+                self._event_file = open(path, "a", buffering=1)
+            import json as _json
+
+            self._event_file.write(_json.dumps(evt, default=str) + "\n")
+        except OSError:
+            self._event_file = None
 
     def _pub_actor(self, record, event: str):
         self._pub("actor_state", {
@@ -850,6 +872,7 @@ class GcsServer:
 
     async def _h_pubsub_stats(self, client, msg):
         client.conn.reply(msg, {"ok": True, "stats": self.publisher.stats()})
+
 
     async def _h_kv_put(self, client, msg):
         ns = msg.get("ns", "")
@@ -2132,6 +2155,13 @@ class GcsServer:
         kind = msg["kind"]
         limit = msg.get("limit", 1000)
         out: List[dict] = []
+        if kind == "cluster_events":
+            # newest are the interesting ones: serve the ring's tail
+            n = max(0, int(limit))
+            out = list(self.cluster_events)[-n:] if n else []
+            client.conn.reply(msg, {"ok": True, "items": out,
+                                    "total": len(self.cluster_events)})
+            return
         if kind == "nodes":
             for n in self.nodes.values():
                 out.append({"node_id": n.node_id.hex(), "alive": n.alive,

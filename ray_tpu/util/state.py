@@ -50,6 +50,15 @@ def list_task_events(limit: int = 50000) -> List[dict]:
     return _list("task_events", limit)
 
 
+def list_cluster_events(limit: int = 1000) -> List[dict]:
+    """Structured export events (node/actor lifecycle transitions) — the
+    reference's RayEvent export stream (``util/event.h:246``); also
+    written as ``events.jsonl`` in the session dir for external
+    collectors. User pubsub channels are NOT exported (publish rates are
+    unbounded); lifecycle channels are."""
+    return _list("cluster_events", limit)
+
+
 def list_metrics() -> List[dict]:
     w = _worker_mod.global_worker()
     reply = w.request_gcs({"t": "metrics_get"})

@@ -36,6 +36,19 @@ if os.environ.get("RAY_TPU_TPU_SMOKE") != "1":
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """RAY_TPU_TPU_SMOKE=1 disables the CPU pin for the WHOLE session, so
+    it is only valid when running the smoke module alone — fail loudly if
+    the regular suite is mixed in (it would silently run on the chip)."""
+    if os.environ.get("RAY_TPU_TPU_SMOKE") == "1":
+        offenders = {i.fspath.basename for i in items
+                     if i.fspath.basename != "test_tpu_smoke.py"}
+        if offenders:
+            raise pytest.UsageError(
+                "RAY_TPU_TPU_SMOKE=1 must run tests/test_tpu_smoke.py "
+                f"ALONE (collected: {sorted(offenders)[:5]}...)")
+
+
 @pytest.fixture(scope="module")
 def ray_cluster():
     """A started ray_tpu cluster shared by a test module."""

@@ -155,3 +155,44 @@ def test_list_objects_and_pgs(ray_cluster):
     objs = state.list_objects(limit=10000)
     assert any(o["object_id"] == ref.hex() for o in objs)
     del ref
+
+
+def test_cluster_export_events(ray_cluster):
+    """Structured export events (reference: util/event.h RayEvent): actor
+    lifecycle lands in the queryable ring AND the session-dir JSONL."""
+    import json
+    import os
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class E:
+        def ping(self):
+            return 1
+
+    a = E.remote()
+    ray_tpu.get(a.ping.remote())
+    ray_tpu.kill(a)
+
+    deadline = time.time() + 15
+    events = []
+    while time.time() < deadline:
+        events = state.list_cluster_events()
+        kinds = {(e["channel"], e.get("event")) for e in events}
+        if ("actor_state", "alive") in kinds and \
+                ("actor_state", "dead") in kinds:
+            break
+        time.sleep(0.3)
+    kinds = {(e["channel"], e.get("event")) for e in events}
+    assert ("actor_state", "alive") in kinds, kinds
+    assert ("actor_state", "dead") in kinds, kinds
+    assert all("ts" in e for e in events)
+
+    import ray_tpu._private.worker as pw
+
+    path = os.path.join(pw.global_worker().session_dir, "events.jsonl")
+    assert os.path.exists(path)
+    lines = [json.loads(l) for l in open(path)]
+    assert any(l.get("event") == "dead" for l in lines)
