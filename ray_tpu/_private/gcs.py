@@ -832,17 +832,39 @@ class GcsServer:
             logger.exception("publish on %r failed", channel)
         evt = {"ts": time.time(), "channel": channel, **message}
         self.cluster_events.append(evt)
+        self._export_event(evt)
+
+    # 64 MiB cap, one rotation (events.jsonl -> events.jsonl.1): bounded
+    # like every other observability store here; the reference rotates its
+    # export event files the same way.
+    _EVENT_FILE_MAX = 64 << 20
+
+    def _export_event(self, evt: dict):
+        if self._event_file is False:
+            return  # disabled after an unrecoverable write error
         try:
-            if self._event_file is None:
-                import os as _os
-
-                path = _os.path.join(self.session_dir, "events.jsonl")
-                self._event_file = open(path, "a", buffering=1)
             import json as _json
+            import os as _os
 
+            path = _os.path.join(self.session_dir, "events.jsonl")
+            if self._event_file is None:
+                self._event_file = open(path, "a", buffering=1)
             self._event_file.write(_json.dumps(evt, default=str) + "\n")
+            if self._event_file.tell() > self._EVENT_FILE_MAX:
+                self._event_file.close()
+                self._event_file = None
+                _os.replace(path, path + ".1")
         except OSError:
-            self._event_file = None
+            # Close (don't leak the fd) and disable: an observability
+            # side-channel must never exhaust fds / take down the GCS.
+            try:
+                if self._event_file:
+                    self._event_file.close()
+            except OSError:
+                pass
+            self._event_file = False
+            logger.warning("event export disabled (events.jsonl write "
+                           "failed)")
 
     def _pub_actor(self, record, event: str):
         self._pub("actor_state", {
@@ -2290,3 +2312,9 @@ class GcsServer:
                 pass
         if self.log is not None:
             self.log.close()
+        if self._event_file:
+            try:
+                self._event_file.close()
+            except OSError:
+                pass
+            self._event_file = None

@@ -230,13 +230,8 @@ class DashboardActor:
         app.router.add_get("/api/profile", json_api(profile))
         app.router.add_get("/api/trace", json_api(trace_api))
 
-        def events_api(request):
-            from ray_tpu.util import state
-
-            limit = int(request.query.get("limit", "1000"))
-            return state.list_cluster_events(limit)
-
-        app.router.add_get("/api/events", json_api(events_api))
+        app.router.add_get("/api/events",
+                           json_api(state_ep("cluster_events")))
         app.router.add_get("/healthz", healthz)
         app.router.add_get("/api/cluster", json_api(cluster))
         for kind in ("nodes", "workers", "actors", "tasks", "objects",

@@ -11,7 +11,7 @@ from .llama import (
     loss_fn,
 )
 
-from . import mixtral
+from . import mixtral, vit
 from .mixtral import (
     MIXTRAL_8X7B,
     MIXTRAL_DEBUG,
