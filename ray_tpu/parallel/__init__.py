@@ -10,6 +10,7 @@ from .mesh import (
 )
 from .sharding import (
     LLAMA_RULES,
+    VIT_RULES,
     apply_shardings,
     constrain,
     shardings_for_tree,
@@ -37,7 +38,7 @@ from .ulysses import make_ulysses_attention, ulysses_attention
 __all__ = [
     "AXES", "MeshSpec", "make_mesh", "mesh_spec_from_string",
     "batch_sharding", "replicated", "data_axes", "local_batch_size",
-    "LLAMA_RULES", "spec_for", "shardings_for_tree", "apply_shardings",
+    "LLAMA_RULES", "VIT_RULES", "spec_for", "shardings_for_tree", "apply_shardings",
     "constrain", "collectives", "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention",
     "spmd_pipeline", "make_stage_fn", "stack_layers", "unstack_layers",
