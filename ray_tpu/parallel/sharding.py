@@ -40,14 +40,15 @@ LLAMA_RULES: Tuple[Tuple[str, P], ...] = (
 # ViT family (models/vit.py): same megatron convention — qkv/up
 # col-parallel on tp, out/down row-parallel; patch embed col-parallel;
 # pos/cls/norms replicated; classifier head col-parallel.
+# NOTE: tree paths are '/'-joined (see _tree_paths), not '.'-joined.
 VIT_RULES: Tuple[Tuple[str, P], ...] = (
-    (r".*patch_embed\.w$", P("fsdp", "tp")),
+    (r".*patch_embed/w$", P("fsdp", "tp")),
     (r".*(wq|wk|wv)$", P("fsdp", "tp")),
     (r".*wo$", P("tp", "fsdp")),
     (r".*w_up$", P("fsdp", "tp")),
     (r".*w_down$", P("tp", "fsdp")),
-    (r".*head\.w$", P("fsdp", "tp")),
-    (r".*(pos_embed|cls_token|norm|scale|bias|\.b)$", P()),
+    (r".*head/w$", P("fsdp", "tp")),
+    (r".*(pos_embed|cls_token|norm|scale|bias|/b)$", P()),
     (r".*", P()),
 )
 

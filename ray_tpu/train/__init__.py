@@ -7,9 +7,11 @@ from .session import (
     report,
 )
 from .trainer import JaxTrainer, Result
+from . import torch  # ray_tpu.train.torch.prepare_model etc.
+from .torch_trainer import TorchTrainer
 
 __all__ = [
-    "JaxTrainer", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
+    "JaxTrainer", "TorchTrainer", "torch", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "report", "get_context",
     "get_checkpoint", "get_dataset_shard", "save_pytree", "load_pytree",
 ]

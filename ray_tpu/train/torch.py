@@ -1,0 +1,11 @@
+"""``ray_tpu.train.torch`` — reference-shaped import surface
+(``ray.train.torch``): TorchTrainer + worker-side helpers. Implementation
+lives in ``torch_trainer.py``; this module exists so user code can
+``import ray_tpu.train.torch`` as a real module path.
+"""
+
+from .torch_trainer import (TorchTrainer, backward, get_device,
+                            prepare_data_loader, prepare_model)
+
+__all__ = ["TorchTrainer", "prepare_model", "prepare_data_loader",
+           "get_device", "backward"]

@@ -1,0 +1,97 @@
+"""TorchTrainer: the reference's flagship trainer surface, on this gang.
+
+Reference: ``python/ray/train/torch/torch_trainer.py`` +
+``train/torch/config.py`` (``_TorchBackend`` sets up a
+``torch.distributed`` process group, workers DDP-wrap their models) and
+the ``ray.train.torch`` helpers (``prepare_model``,
+``prepare_data_loader``). On this framework torch runs the CPU/host tier
+(gloo) — the TPU compute path is JAX — but reference users bringing
+torch training loops get the same API: the same ``WorkerGroup`` gang,
+the same ``report``/checkpoint session, a real collective process group.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .trainer import JaxTrainer
+
+
+class TorchTrainer(JaxTrainer):
+    """``JaxTrainer`` with a torch.distributed (gloo) backend rendezvous
+    instead of ``jax.distributed``.
+
+    Usage matches the reference::
+
+        def train_loop(config):
+            import ray_tpu.train.torch as rtt
+            model = rtt.prepare_model(Net())      # DDP-wrapped
+            for epoch in ...:
+                ...
+                ray_tpu.train.report({"loss": loss})
+
+        TorchTrainer(train_loop,
+                     scaling_config=ScalingConfig(num_workers=4)).fit()
+    """
+
+    def __init__(self, *args, torch_backend: str = "gloo", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.torch_backend = torch_backend
+
+    def _setup_backend(self, group):
+        group.setup_torch(backend=self.torch_backend)
+
+
+# ----------------------------------------------------- worker-side utils
+
+
+def prepare_model(model, *, find_unused_parameters: bool = False):
+    """DDP-wrap when a >1-rank process group is live (reference:
+    ``ray.train.torch.prepare_model``, ``train/torch/train_loop_utils``)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(
+            model, find_unused_parameters=find_unused_parameters)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Re-build a DataLoader with a DistributedSampler so every rank sees
+    a disjoint shard (reference: ``prepare_data_loader``). The original
+    loader's configuration is preserved: shuffle intent (detected from
+    its sampler), batch size, workers, pin_memory, collate/drop_last.
+    Call ``loader.sampler.set_epoch(epoch)`` per epoch for fresh
+    shuffles (same contract as the reference)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return loader
+    if loader.batch_size is None:
+        raise ValueError(
+            "prepare_data_loader cannot re-shard a DataLoader built with "
+            "a custom batch_sampler; pass batch_size/shuffle instead")
+    shuffle = isinstance(loader.sampler, RandomSampler)
+    sampler = DistributedSampler(loader.dataset, shuffle=shuffle)
+    return DataLoader(loader.dataset, batch_size=loader.batch_size,
+                      sampler=sampler, num_workers=loader.num_workers,
+                      pin_memory=loader.pin_memory,
+                      collate_fn=loader.collate_fn,
+                      drop_last=loader.drop_last)
+
+
+def get_device():
+    """Device for this worker (CPU on host tier; TPU compute is JAX)."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def backward(loss):
+    loss.backward()

@@ -33,6 +33,9 @@ class Result:
     path: str
     error: Optional[Exception] = None
     metrics_dataframe: Any = None
+    # rank -> that worker's last reported metrics (reference exposes
+    # per-worker results through the session; handy for DDP assertions)
+    metrics_all_workers: Optional[Dict[int, dict]] = None
 
     @property
     def best_checkpoints(self) -> List[Checkpoint]:
@@ -66,7 +69,8 @@ class _ResultCollector:
 
     def state(self):
         return {"history": list(self.history),
-                "latest_checkpoint": self.latest_checkpoint}
+                "latest_checkpoint": self.latest_checkpoint,
+                "last_per_rank": dict(self._pending)}
 
 
 class JaxTrainer:
@@ -124,6 +128,15 @@ class JaxTrainer:
             if result.checkpoint is not None:
                 restore_path = result.checkpoint.path
 
+    def _setup_backend(self, group: "WorkerGroup"):
+        """Framework rendezvous hook (reference: ``Backend.on_start``,
+        ``train/torch/config.py:153``). Jax: the mesh worker group
+        primitive (SURVEY §7 hard part 2) — co-scheduled host actors
+        enter one jax.distributed rendezvous so a single pjit program
+        spans the group. TorchTrainer overrides with a gloo group."""
+        if self.scaling_config.should_init_jax_distributed():
+            group.setup_distributed()
+
     def _run_attempt(self, run_name: str, storage: str,
                      restore_path: Optional[str]) -> Result:
         sc = self.scaling_config
@@ -133,11 +146,7 @@ class JaxTrainer:
         try:
             group = WorkerGroup(sc.num_workers, sc.worker_resources(),
                                 sc.placement_strategy)
-            if sc.should_init_jax_distributed():
-                # The mesh worker group primitive (SURVEY §7 hard part 2):
-                # co-scheduled host actors enter one jax.distributed
-                # rendezvous so a single pjit program spans the group.
-                group.setup_distributed()
+            self._setup_backend(group)
         except Exception as e:  # noqa: BLE001 — e.g. infeasible resources
             try:
                 ray_tpu.kill(collector)
@@ -181,7 +190,8 @@ class JaxTrainer:
             ckpt = (Checkpoint(state["latest_checkpoint"])
                     if state["latest_checkpoint"] else None)
             return Result(metrics=metrics, checkpoint=ckpt, path=run_path,
-                          error=err)
+                          error=err,
+                          metrics_all_workers=state.get("last_per_rank"))
         except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError,
                 ConnectionError) as e:
             try:

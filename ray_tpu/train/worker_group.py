@@ -61,6 +61,26 @@ class TrainWorker:
                 process_id=self.rank)
         return True
 
+    def setup_torch_distributed(self, master_addr: str, master_port: int,
+                                backend: str = "gloo",
+                                timeout_s: float = 120.0):
+        """torch.distributed process group over the gang (reference:
+        ``train/torch/config.py:66`` ``_setup_torch_process_group`` —
+        rank-0 address broadcast then a collective init). gloo on CPU
+        hosts; the TPU compute path stays JAX, this exists for parity
+        with the reference's Torch training surface."""
+        import datetime
+
+        import torch.distributed as dist
+
+        if self.world_size > 1 and not dist.is_initialized():
+            dist.init_process_group(
+                backend,
+                init_method=f"tcp://{master_addr}:{master_port}",
+                rank=self.rank, world_size=self.world_size,
+                timeout=datetime.timedelta(seconds=timeout_s))
+        return True
+
     def run(self, fn_blob: bytes, config: Optional[dict], session_kwargs: dict,
             result_actor, dataset_shards: Optional[dict] = None):
         import cloudpickle
@@ -135,6 +155,17 @@ class WorkerGroup:
         coordinator = ray_tpu.get(
             self.workers[0].coordinator_endpoint.remote())
         ray_tpu.get([w.setup_jax_distributed.remote(coordinator)
+                     for w in self.workers], timeout=timeout)
+
+    def setup_torch(self, backend: str = "gloo", timeout: float = 120.0):
+        """Collective torch.distributed rendezvous (gloo) across ranks."""
+        if self.num_workers <= 1:
+            return
+        endpoint = ray_tpu.get(
+            self.workers[0].coordinator_endpoint.remote())
+        addr, _, port = endpoint.rpartition(":")
+        ray_tpu.get([w.setup_torch_distributed.remote(addr, int(port),
+                                                      backend)
                      for w in self.workers], timeout=timeout)
 
     def run_async(self, method: str, *args, **kwargs):

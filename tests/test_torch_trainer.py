@@ -1,0 +1,87 @@
+"""TorchTrainer: real gloo process group + DDP over the worker gang.
+
+Reference model: ``python/ray/train/tests/test_torch_trainer.py`` — a
+multi-worker DDP training run with gradient sync, report/checkpoint
+through the same session as JaxTrainer.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_torch_ddp_trains_and_syncs(cluster, tmp_path_factory):
+    """2 gloo ranks: DDP gradients sync (both ranks converge to the SAME
+    weights) and a fit() produces reported metrics."""
+    storage = str(tmp_path_factory.mktemp("torch_runs"))
+
+    def train_loop(config):
+        import torch
+        import torch.distributed as dist
+        from torch.utils.data import DataLoader, TensorDataset
+
+        import ray_tpu.train as train
+        import ray_tpu.train.torch as rtt
+
+        assert dist.is_initialized() and dist.get_world_size() == 2
+        rank = dist.get_rank()
+        torch.manual_seed(0)  # same init on every rank (DDP requirement)
+        model = rtt.prepare_model(torch.nn.Linear(4, 1))
+        # rank-dependent data: only gradient averaging can make the
+        # final weights identical across ranks
+        g = torch.Generator().manual_seed(100 + rank)
+        X = torch.randn(64, 4, generator=g)
+        w_true = torch.tensor([[1.0, -2.0, 3.0, 0.5]]).T
+        y = X @ w_true
+        loader = rtt.prepare_data_loader(
+            DataLoader(TensorDataset(X, y), batch_size=16))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        loss_val = None
+        for epoch in range(30):
+            for xb, yb in loader:
+                opt.zero_grad()
+                loss = torch.nn.functional.mse_loss(model(xb), yb)
+                loss.backward()  # DDP allreduces grads here
+                opt.step()
+                loss_val = float(loss)
+        flat = torch.cat([p.detach().reshape(-1)
+                          for p in model.parameters()])
+        train.report({"loss": loss_val, "rank": rank,
+                      "weights": flat.tolist()})
+
+    result = TorchTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=storage),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["loss"] < 0.5
+
+    # both ranks' reports carried identical weights => grads were synced
+    per_rank = result.metrics_all_workers
+    assert len(per_rank) == 2
+    w0 = np.asarray(per_rank[0]["weights"])
+    w1 = np.asarray(per_rank[1]["weights"])
+    np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-6)
+
+
+def test_prepare_helpers_noop_without_group(cluster):
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    import ray_tpu.train.torch as rtt
+
+    m = torch.nn.Linear(2, 1)
+    assert rtt.prepare_model(m) is m  # no process group: passthrough
+    loader = DataLoader(TensorDataset(torch.zeros(8, 2)), batch_size=4)
+    assert rtt.prepare_data_loader(loader) is loader
+    assert rtt.get_device().type == "cpu"
