@@ -692,16 +692,25 @@ class Worker:
         """store.create with backpressure: on allocator exhaustion, ask the
         GCS to evict/spill (reference: plasma ``CreateRequestQueue``
         backpressure, ``plasma/create_request_queue.h``) and retry."""
-        for attempt in range(6):
+        for attempt in range(12):
             try:
                 return self.store.create(oid, nbytes)
             except MemoryError:
+                # Our own queued deref deltas may be what's blocking
+                # eviction — push them out before asking the GCS to free.
+                try:
+                    self.loop.call_soon_threadsafe(self._flush_refs)
+                except RuntimeError:
+                    pass
                 try:
                     self.request_gcs({"t": "store_pressure",
                                       "nbytes": nbytes}, timeout=30)
                 except Exception:
                     pass
-                time.sleep(0.02 * (attempt + 1))
+                # Consumers flush derefs every 0.1s: the window must span
+                # several flush cycles or a streaming producer races the
+                # eviction of just-consumed blocks.
+                time.sleep(min(0.02 * (attempt + 1), 0.1))
         return self.store.create(oid, nbytes)
 
     def put(self, value: Any) -> ObjectRef:

@@ -30,6 +30,15 @@ from .block import BlockAccessor, to_block
 # ------------------------------------------------------------------ plan ops
 
 
+def _tensorable(v) -> np.ndarray:
+    """Column -> dense ndarray: list-valued (object-dtype) columns are
+    stacked so framework tensors can ingest them."""
+    arr = np.asarray(v)
+    if arr.dtype == object:
+        arr = np.stack([np.asarray(e) for e in arr])
+    return arr
+
+
 class _Op:
     """A per-block transform (fusable)."""
 
@@ -498,6 +507,51 @@ class Dataset:
             drop_last=drop_last,
             local_shuffle_buffer_size=local_shuffle_buffer_size,
             local_shuffle_seed=local_shuffle_seed)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes: Optional[dict] = None,
+                           drop_last: bool = False,
+                           local_shuffle_buffer_size: Optional[int] = None,
+                           local_shuffle_seed: Optional[int] = None):
+        """Batches as torch tensors (reference: ``Dataset.
+        iter_torch_batches``, ``data/dataset.py:3908`` /
+        ``data/iterator.py:232``) — the ingest path for ``TorchTrainer``
+        loops. ``dtypes`` maps column -> torch dtype."""
+        import torch
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+                local_shuffle_seed=local_shuffle_seed):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(_tensorable(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         device=None, drop_last: bool = False,
+                         local_shuffle_buffer_size: Optional[int] = None,
+                         local_shuffle_seed: Optional[int] = None):
+        """Batches as jax arrays (device_put when ``device`` is given) —
+        the TPU-native sibling of ``iter_torch_batches``; zero-copy host
+        views feed ``jax.device_put`` directly."""
+        import jax
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+                local_shuffle_seed=local_shuffle_seed):
+            if device is not None:
+                yield {k: jax.device_put(_tensorable(v), device)
+                       for k, v in batch.items()}
+            else:
+                yield {k: jax.numpy.asarray(_tensorable(v))
+                       for k, v in batch.items()}
 
     def iter_rows(self) -> Iterator[dict]:
         for ref in self._stream_refs():

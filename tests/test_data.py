@@ -181,3 +181,26 @@ def test_dataset_to_train_ingest(ray_cluster, tmp_path):
     result = trainer.fit()
     assert result.error is None, result.error
     assert result.metrics["rows"] == 64  # half of 128 per worker
+
+
+def test_iter_torch_and_jax_batches(ray_cluster):
+    """Framework-tensor ingest (reference iter_torch_batches /
+    data/iterator.py:232) for TorchTrainer / JaxTrainer loops."""
+    import numpy as np
+    import torch
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_items([{"x": [float(i), float(i + 1)], "y": i}
+                           for i in range(10)])
+    tb = list(ds.iter_torch_batches(batch_size=4,
+                                    dtypes={"y": torch.float32}))
+    assert len(tb) == 3
+    assert isinstance(tb[0]["x"], torch.Tensor)
+    assert tb[0]["x"].shape == (4, 2)
+    assert tb[0]["y"].dtype == torch.float32
+
+    jb = list(ds.iter_jax_batches(batch_size=5))
+    assert len(jb) == 2
+    assert jb[0]["x"].shape == (5, 2)
+    np.testing.assert_allclose(np.asarray(jb[0]["y"]), np.arange(5))
