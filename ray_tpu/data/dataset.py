@@ -526,7 +526,14 @@ class Dataset:
                 local_shuffle_seed=local_shuffle_seed):
             out = {}
             for k, v in batch.items():
-                t = torch.as_tensor(_tensorable(v))
+                arr = _tensorable(v)
+                if not arr.flags.writeable:
+                    # torch tensors must be writable; zero-copy store
+                    # views are read-only, so this path pays one copy
+                    # (iter_jax_batches keeps zero-copy — jax arrays are
+                    # immutable).
+                    arr = arr.copy()
+                t = torch.as_tensor(arr)
                 if dtypes and k in dtypes:
                     t = t.to(dtypes[k])
                 out[k] = t
