@@ -1179,6 +1179,38 @@ class GcsServer:
         if self.shm_bytes > target_bytes:
             self._spill_until_under(target_bytes)
 
+    async def _h_oom_candidates(self, client, msg):
+        """Kill candidates on the asking agent's node for its memory
+        monitor (reference: the raylet's worker-killing policies act on
+        local knowledge; here task state lives in the GCS, so the agent
+        asks). Returns (pid, started_ts, retriable) triples."""
+        nid = NodeID(bytes(msg["node_id"]))
+        out = []
+        now = time.time()
+        for w in self.workers.values():
+            if w.node_id != nid or w.pid <= 0:
+                continue
+            if w.state == W_BUSY and w.current_task is not None:
+                rec = self.tasks.get(w.current_task)
+                out.append([w.pid, rec.ts_running if rec else now,
+                            bool(rec and rec.retries_left > 0)])
+            elif w.leased_to is not None:
+                # Leased workers run direct-pushed plain tasks (default
+                # retries 3): retriable, start time unknown -> newest.
+                out.append([w.pid, now, True])
+        client.conn.reply(msg, {"ok": True, "candidates": out})
+
+    async def _h_oom_kill_report(self, client, msg):
+        """Agent reports an OOM kill: surface WHY the worker died."""
+        self._pub("node_events", {
+            "event": "oom_kill",
+            "node_id": client.node_id.hex() if client.node_id else None,
+            "pid": msg.get("pid"), "usage": msg.get("usage"),
+            "rss_bytes": msg.get("rss")})
+        logger.warning("OOM kill on node %s: pid=%s usage=%.2f",
+                       client.node_id.hex()[:8] if client.node_id else "?",
+                       msg.get("pid"), msg.get("usage", 0.0))
+
     async def _h_store_pressure(self, client, msg):
         """A client's store.create hit allocator exhaustion: free space.
 

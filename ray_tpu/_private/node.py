@@ -187,6 +187,65 @@ class NodeAgent:
         if self.probe_tpu and "TPU" not in self.resources:
             asyncio.get_running_loop().create_task(self._probe_tpu())
         asyncio.get_running_loop().create_task(self._reap_loop())
+        if _cfg().memory_monitor_threshold > 0:
+            asyncio.get_running_loop().create_task(
+                self._memory_monitor_loop())
+
+    async def _memory_monitor_loop(self):
+        """Host-memory OOM protection (reference: ``memory_monitor.h:52``
+        + retriable-FIFO worker killing): above the threshold, SIGKILL the
+        newest retriable task worker so the retry path absorbs the kill;
+        report the reason to the GCS as an ``oom_kill`` node event."""
+        from .memory_monitor import (host_memory_usage_fraction,
+                                     pick_victim, proc_rss_bytes)
+
+        threshold = _cfg().memory_monitor_threshold
+        interval = _cfg().memory_monitor_interval_s
+        recently_killed: dict = {}  # pid -> kill ts (cooldown tracking)
+        cooldown = max(2.0 * interval, 2.0)
+        while not self.stopped.is_set():
+            await asyncio.sleep(interval)
+            usage = host_memory_usage_fraction()
+            if usage < threshold:
+                continue
+            now = time.time()
+            if any(now - ts < cooldown for ts in recently_killed.values()):
+                # A kill is still settling (teardown + GCS catching up):
+                # don't cascade onto healthy workers.
+                continue
+            if self.conn is None or self.conn.closed:
+                continue
+            try:
+                reply = await self.conn.request(
+                    {"t": "oom_candidates",
+                     "node_id": self.node_id.binary()}, timeout=10)
+            except (ConnectionError, asyncio.TimeoutError):
+                continue
+            # Only OUR direct children are killable: container-pool
+            # workers report namespace-local pids (killing that number on
+            # the host would hit an unrelated process), and GCS lag can
+            # list already-dead workers.
+            own_pids = {p.pid for p in self.procs if p.poll() is None}
+            candidates = [tuple(c) for c in reply.get("candidates", [])
+                          if c[0] in own_pids
+                          and c[0] not in recently_killed]
+            victim = pick_victim(candidates)
+            if victim is None:
+                continue
+            rss = proc_rss_bytes(victim)
+            try:
+                os.kill(victim, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                continue
+            recently_killed[victim] = time.time()
+            if len(recently_killed) > 100:
+                recently_killed = {p: t for p, t in recently_killed.items()
+                                   if time.time() - t < 60}
+            try:
+                self.conn.send({"t": "oom_kill_report", "pid": victim,
+                                "usage": usage, "rss": rss})
+            except ConnectionError:
+                pass
 
     async def _connect_and_register(self):
         reader, writer = await protocol.connect(self.gcs_address)

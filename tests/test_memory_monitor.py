@@ -1,0 +1,84 @@
+"""OOM memory monitor + worker-killing policy tests.
+
+Reference model: ``src/ray/common/memory_monitor.h`` tests +
+``worker_killing_policy_retriable_fifo`` semantics; integration follows
+``python/ray/tests/test_memory_pressure.py`` (task killed under
+pressure, retried when pressure clears, reason surfaced).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import (host_memory_usage_fraction,
+                                             pick_victim)
+
+
+def test_usage_fraction_reads_meminfo():
+    u = host_memory_usage_fraction()
+    assert 0.0 < u < 1.0
+
+
+def test_usage_fraction_test_hook(tmp_path, monkeypatch):
+    p = tmp_path / "usage"
+    p.write_text("0.87")
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_PATH", str(p))
+    assert host_memory_usage_fraction() == pytest.approx(0.87)
+    p.write_text("junk")
+    assert host_memory_usage_fraction() == 0.0
+
+
+def test_retriable_fifo_policy():
+    # prefer retriable, newest first
+    assert pick_victim([(1, 10.0, False), (2, 20.0, True),
+                        (3, 30.0, True)]) == 3
+    # nothing retriable -> newest overall
+    assert pick_victim([(1, 10.0, False), (2, 20.0, False)]) == 2
+    assert pick_victim([]) is None
+
+
+def test_oom_kill_and_retry(tmp_path):
+    """A long task's worker is OOM-killed under (simulated) pressure;
+    when pressure clears, the retry completes and the kill reason is in
+    the cluster events."""
+    usage = tmp_path / "usage"
+    usage.write_text("0.10")
+    os.environ["RAY_TPU_MEMORY_USAGE_PATH"] = str(usage)
+    os.environ["RAY_TPU_MEMORY_MONITOR_INTERVAL_S"] = "0.2"
+    try:
+        ray_tpu.init(num_cpus=2, probe_tpu=False, ignore_reinit_error=True)
+        from ray_tpu.util import pubsub, state
+
+        with pubsub.subscribe(pubsub.CH_NODE_EVENTS) as sub:
+            @ray_tpu.remote(max_retries=5)
+            def long_task():
+                time.sleep(1.5)
+                return "done"
+
+            ref = long_task.remote()
+            time.sleep(0.4)  # task is running
+            usage.write_text("0.99")  # simulate pressure
+
+            # wait for the oom_kill event
+            deadline = time.time() + 20
+            killed = None
+            while time.time() < deadline:
+                e = sub.poll(timeout=5)
+                if e and e["message"].get("event") == "oom_kill":
+                    killed = e["message"]
+                    break
+            assert killed is not None, "monitor never fired"
+            assert killed["pid"] > 0
+            assert killed["usage"] >= 0.99
+
+            usage.write_text("0.10")  # pressure clears
+            assert ray_tpu.get(ref, timeout=60) == "done"  # retry wins
+
+        events = state.list_cluster_events()
+        assert any(e.get("event") == "oom_kill" for e in events)
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_MEMORY_USAGE_PATH", None)
+        os.environ.pop("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", None)
