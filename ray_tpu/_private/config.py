@@ -39,6 +39,8 @@ class RayTpuConfig:
     driver_exit_grace_s: float = 3.0
     actor_adoption_grace_s: float = 5.0
     gcs_wal_compact_every: int = 50_000
+    health_check_interval_s: float = 5.0   # GCS->agent active pings
+    health_check_failures: int = 3         # misses before node is dead
     # ---- memory monitor (0 disables; reference: memory_monitor.h)
     memory_monitor_threshold: float = 0.95
     memory_monitor_interval_s: float = 1.0

@@ -561,6 +561,7 @@ class GcsServer:
 
         self.loop_monitor = LoopMonitor(name="gcs").start()
         asyncio.get_running_loop().create_task(self._scheduler_loop())
+        asyncio.get_running_loop().create_task(self._health_check_loop())
         if self.resumed:
             asyncio.get_running_loop().call_later(
                 max(0.0, self._adoption_deadline - time.time()),
@@ -1178,6 +1179,42 @@ class GcsServer:
             del self.objects[oid]
         if self.shm_bytes > target_bytes:
             self._spill_until_under(target_bytes)
+
+    async def _health_check_loop(self):
+        """Active node health checks (reference: ``GcsHealthCheckManager``,
+        ``gcs_health_check_manager.h:39`` — the GCS pings every raylet;
+        N consecutive misses marks the node dead). TCP disconnects catch
+        clean deaths instantly; this loop catches half-open links
+        (network partitions, frozen hosts) that never FIN."""
+        from .config import config as _cfg2
+
+        interval = _cfg2().health_check_interval_s
+        failure_threshold = _cfg2().health_check_failures
+        misses: Dict[bytes, int] = {}
+
+        async def ping(node):
+            nid_b = node.node_id.binary()
+            try:
+                await node.agent_conn.request({"t": "health_check"},
+                                              timeout=interval)
+                misses.pop(nid_b, None)
+            except (ConnectionError, asyncio.TimeoutError):
+                misses[nid_b] = misses.get(nid_b, 0) + 1
+                if misses[nid_b] >= failure_threshold:
+                    logger.warning(
+                        "node %s failed %d health checks: marking dead",
+                        node.node_id.hex()[:8], misses.pop(nid_b))
+                    self._on_node_death(node.node_id)
+
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(interval)
+            targets = [n for n in self.nodes.values()
+                       if n.alive and n.agent_conn is not None
+                       and not n.agent_conn.closed]
+            if targets:
+                # Concurrent fan-out: one unresponsive node's timeout must
+                # not delay (or compound into) the others' checks.
+                await asyncio.gather(*(ping(n) for n in targets))
 
     async def _h_oom_candidates(self, client, msg):
         """Kill candidates on the asking agent's node for its memory

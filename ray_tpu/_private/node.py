@@ -451,6 +451,9 @@ class NodeAgent:
         t = msg.get("t")
         if t == "spawn_worker":
             self.spawn_worker(msg.get("env_spec"), msg.get("env_key", ""))
+        elif t == "health_check":
+            # Active GCS liveness probe (GcsHealthCheckManager analog).
+            self.conn.reply(msg, {"ok": True})
         elif t == "exit":
             self.stopped.set()
 

@@ -82,3 +82,46 @@ def test_oom_kill_and_retry(tmp_path):
         ray_tpu.shutdown()
         os.environ.pop("RAY_TPU_MEMORY_USAGE_PATH", None)
         os.environ.pop("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", None)
+
+
+def test_active_health_check_detects_frozen_node(tmp_path):
+    """A SIGSTOPped (frozen, half-open) node agent is detected by the
+    GCS's active health checks and marked dead (reference:
+    GcsHealthCheckManager — passive disconnects can't see this)."""
+    import signal
+    import subprocess
+
+    os.environ["RAY_TPU_HEALTH_CHECK_INTERVAL_S"] = "0.5"
+    try:
+        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.util import pubsub
+
+        cluster = Cluster(initialize_head=True, connect=True)
+        try:
+            with pubsub.subscribe(pubsub.CH_NODE_EVENTS) as sub:
+                node = cluster.add_node(num_cpus=1)
+                evt = sub.poll(timeout=20)
+                assert evt["message"]["event"] == "node_joined"
+                nid = evt["message"]["node_id"]
+
+                # Freeze the agent: the TCP link stays open (no FIN), so
+                # only the active ping can notice.
+                os.kill(node.proc.pid, signal.SIGSTOP)
+                try:
+                    deadline = time.time() + 30
+                    died = None
+                    while time.time() < deadline:
+                        e = sub.poll(timeout=5)
+                        if e and e["message"].get("event") == "node_died" \
+                                and e["message"].get("node_id") == nid:
+                            died = e
+                            break
+                    assert died is not None, \
+                        "frozen node never detected as dead"
+                finally:
+                    os.kill(node.proc.pid, signal.SIGCONT)
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_HEALTH_CHECK_INTERVAL_S", None)
