@@ -36,6 +36,9 @@ class Result:
     # rank -> that worker's last reported metrics (reference exposes
     # per-worker results through the session; handy for DDP assertions)
     metrics_all_workers: Optional[Dict[int, dict]] = None
+    # the trial's hyperparameter config (tune results; reference
+    # air.Result.config)
+    config: Optional[Dict[str, Any]] = None
 
     @property
     def best_checkpoints(self) -> List[Checkpoint]:

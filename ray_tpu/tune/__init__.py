@@ -1,6 +1,7 @@
 from ..train.session import get_checkpoint, get_context, report
 from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
-                         MedianStoppingRule, PopulationBasedTraining)
+                         MedianStoppingRule, PB2,
+                         PopulationBasedTraining)
 from .search import (
     BasicVariantGenerator,
     BayesOptSearcher,
@@ -36,7 +37,7 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "run", "report", "get_context",
     "get_checkpoint", "choice", "uniform", "loguniform", "randint",
     "quniform", "sample_from", "grid_search", "FIFOScheduler",
-    "ASHAScheduler", "PopulationBasedTraining", "HyperBandScheduler",
+    "ASHAScheduler", "PopulationBasedTraining", "PB2", "HyperBandScheduler",
     "MedianStoppingRule", "Searcher", "BasicVariantGenerator",
     "TPESearcher", "BayesOptSearcher", "ConcurrencyLimiter",
 ]

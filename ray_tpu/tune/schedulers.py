@@ -209,3 +209,96 @@ class HyperBandScheduler(FIFOScheduler):
 
     def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
         return self._bracket(trial_id).on_result(trial_id, result)
+
+
+class PB2(PopulationBasedTraining):
+    """Population-based bandits: PBT where explore steps are selected by a
+    GP-UCB model over (hyperparams -> score improvement) instead of
+    random perturbation (reference: ``tune/schedulers/pb2.py``, Parker-
+    Holder et al. 2020). Continuous bounds only, like the reference.
+    """
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 kappa: float = 2.0, seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds: "
+                             "{name: [low, high]}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = kappa
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._prev_score: Dict[str, float] = {}
+        # observations: (normalized hyperparam vector, score delta)
+        self._data: List[tuple] = []
+
+    # tuner hook: called with the trial's live config before on_result
+    def record_config(self, trial_id: str, config: Dict[str, Any]):
+        self._configs[trial_id] = config
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        metric = result.get(self.metric)
+        if metric is not None:
+            score = metric if self.mode == "max" else -metric
+            prev = self._prev_score.get(trial_id)
+            cfg = self._configs.get(trial_id)
+            if prev is not None and cfg is not None:
+                x = self._vec(cfg)
+                if x is not None:
+                    self._data.append((x, score - prev))
+                    if len(self._data) > 500:
+                        self._data = self._data[-500:]
+            self._prev_score[trial_id] = score
+        return super().on_result(trial_id, result)
+
+    def _vec(self, config) -> Optional[List[float]]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = config.get(k)
+            if v is None:
+                return None
+            out.append((float(v) - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """GP-UCB selection over the bounds (numpy RBF GP; falls back to
+        uniform sampling until enough observations exist)."""
+        import numpy as np
+
+        out = dict(config)
+        d = len(self.bounds)
+        cand = np.asarray([[self.rng.random() for _ in range(d)]
+                           for _ in range(256)])
+        if len(self._data) >= 4:
+            X = np.asarray([x for x, _ in self._data])
+            y = np.asarray([dy for _, dy in self._data], dtype=float)
+            y_std = y.std() or 1.0
+            y = (y - y.mean()) / y_std
+            ls, noise = 0.2, 1e-3
+
+            def k(a, b):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ls * ls))
+
+            K = k(X, X) + noise * np.eye(len(X))
+            Kinv = np.linalg.inv(K)
+            Ks = k(cand, X)
+            mu = Ks @ Kinv @ y
+            var = np.clip(1.0 - (Ks * (Ks @ Kinv)).sum(-1), 1e-9, None)
+            ucb = mu + self.kappa * np.sqrt(var)
+            best = cand[int(np.argmax(ucb))]
+        else:
+            best = cand[0]
+        for i, (key, (lo, hi)) in enumerate(self.bounds.items()):
+            v = lo + float(best[i]) * (hi - lo)
+            if isinstance(config.get(key), int):
+                v = int(round(v))
+            out[key] = v
+        return out

@@ -245,7 +245,8 @@ class Tuner:
                 metrics=hist[-1] if hist else None,
                 checkpoint=Checkpoint(ckpt) if ckpt else None,
                 path=os.path.join(storage, exp_name, t.id),
-                error=RuntimeError(t.error) if t.error else None))
+                error=RuntimeError(t.error) if t.error else None,
+                config=dict(t.config)))
         try:
             ray_tpu.kill(collector)
         except Exception:
@@ -308,6 +309,9 @@ class Tuner:
                     searcher.on_trial_result(tid, result)
                 if trial.state != "RUNNING":
                     continue
+                record = getattr(scheduler, "record_config", None)
+                if record is not None:  # PB2 models (config -> delta)
+                    record(tid, dict(trial.config))
                 decision = scheduler.on_result(tid, result)
                 if decision == STOP:
                     trial.killed_by_scheduler = True
