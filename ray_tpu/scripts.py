@@ -213,6 +213,28 @@ def cmd_job(args):
             print(f"{j['job_id']}  {j['status']:10}  {j['entrypoint'][:60]}")
 
 
+def cmd_serve(args):
+    """Serve CLI (reference: ``python/ray/serve/scripts.py``)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=args.address or None, ignore_reinit_error=True)
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.config_file import deploy_config
+
+        names = deploy_config(args.config)
+        print(f"deployed {len(names)} app(s): {', '.join(names)}")
+        print(f"HTTP ingress: port {serve.get_proxy_port()}, "
+              f"RPC ingress: port {serve.get_rpc_port()}")
+    elif args.serve_cmd == "status":
+        for app, deps in serve.status().items():
+            for name, d in deps.items():
+                print(f"{app}/{name}: {d['num_replicas']} replica(s)")
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_up(args):
     """Cluster launcher (reference: ``ray up``, ``autoscaler/_private/
     commands.py create_or_update_cluster``)."""
@@ -249,6 +271,17 @@ def main(argv=None):
     p = sub.add_parser("stop", help="stop the cluster")
     p.add_argument("--address", default="")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("serve", help="model serving (deploy/status/shutdown)")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sp = ssub.add_parser("deploy", help="deploy apps from a config YAML")
+    sp.add_argument("config")
+    sp.add_argument("--address", default="")
+    sp = ssub.add_parser("status")
+    sp.add_argument("--address", default="")
+    sp = ssub.add_parser("shutdown")
+    sp.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("up", help="launch a cloud TPU cluster from YAML")
     p.add_argument("config", help="cluster YAML (see autoscaler/launcher.py)")

@@ -1,0 +1,94 @@
+"""Declarative Serve deployment (config-file / CLI surface).
+
+Reference model: ``python/ray/serve/tests/test_cli.py`` — deploy apps
+from a YAML of import_path targets, hit them over the ingress.
+"""
+
+import json
+import sys
+import textwrap
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.config_file import (_import_target, deploy_config,
+                                       load_config)
+
+APP_MODULE = textwrap.dedent("""\
+    from ray_tpu import serve
+
+
+    @serve.deployment
+    class Doubler:
+        def __init__(self, factor: int = 2):
+            self.factor = factor
+
+        def __call__(self, req):
+            return {"out": req.json()["x"] * self.factor}
+
+
+    app = Doubler.bind()
+
+
+    def build(factor: int = 2):
+        return Doubler.bind(factor)
+""")
+
+
+@pytest.fixture()
+def app_module(tmp_path, monkeypatch):
+    pkg = tmp_path / "cfgtest_pkg.py"
+    pkg.write_text(APP_MODULE)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    sys.modules.pop("cfgtest_pkg", None)
+    yield "cfgtest_pkg"
+    sys.modules.pop("cfgtest_pkg", None)
+
+
+def test_import_target_forms(app_module):
+    assert _import_target(f"{app_module}:app") is not None
+    assert _import_target(f"{app_module}.app") is not None
+    with pytest.raises(ValueError, match="no attribute"):
+        _import_target(f"{app_module}:nope")
+
+
+def test_load_config_validates(tmp_path):
+    with pytest.raises(ValueError, match="applications"):
+        load_config({})
+    with pytest.raises(ValueError, match="import_path"):
+        load_config({"applications": [{"name": "x"}]})
+
+
+def test_deploy_config_end_to_end(app_module, tmp_path):
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    try:
+        cfg = tmp_path / "serve.yaml"
+        cfg.write_text(textwrap.dedent(f"""\
+            applications:
+              - name: doubles
+                route_prefix: /double
+                import_path: {app_module}:app
+              - name: triples
+                route_prefix: /triple
+                import_path: {app_module}:build
+                args: {{factor: 3}}
+        """))
+        names = deploy_config(str(cfg))
+        assert names == ["doubles", "triples"]
+
+        port = serve.get_proxy_port()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/double", data=json.dumps(
+                {"x": 5}).encode(), headers={"Content-Type":
+                                             "application/json"})
+        assert json.load(urllib.request.urlopen(req)) == {"out": 10}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/triple", data=json.dumps(
+                {"x": 5}).encode(), headers={"Content-Type":
+                                             "application/json"})
+        assert json.load(urllib.request.urlopen(req)) == {"out": 15}
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
