@@ -237,6 +237,39 @@ def cancel(workflow_id: str):
     _write_status(workflow_id, CANCELED)
 
 
+def wait_for_event(channel: str, *, timeout: Optional[float] = None):
+    """A workflow step that blocks until a message arrives on a pubsub
+    channel (reference: ``workflow.wait_for_event`` + EventListener,
+    ``python/ray/workflow/api.py`` / ``event_listener.py``). Returns the
+    event's message payload into the DAG.
+
+    Checkpointing comes from ordinary step persistence: once the event
+    arrives the step result is durable, so ``resume`` never re-waits.
+    Delivery is subscribe-then-publish — producers should publish until
+    the workflow acknowledges (out-of-band) or use a durable trigger,
+    same at-least-once contract as the reference's event system.
+    """
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _wait_for_event(ch, to):
+        from ray_tpu.util import pubsub
+
+        with pubsub.subscribe(ch) as sub:
+            deadline = None if to is None else time.time() + to
+            while True:
+                step = None if deadline is None else                     max(0.1, deadline - time.time())
+                item = sub.poll(timeout=step)
+                if item is not None and item.get("message") is not None:
+                    return item["message"]
+                if deadline is not None and time.time() >= deadline:
+                    raise TimeoutError(
+                        f"no event on channel {ch!r} within {to}s")
+
+    node = _wait_for_event.bind(channel, timeout)
+    return node
+
+
 def delete(workflow_id: str):
     import shutil
 
@@ -246,6 +279,6 @@ def delete(workflow_id: str):
 __all__ = [
     "init", "run", "run_async", "resume", "resume_all", "get_status",
     "get_output", "get_metadata", "list_all", "cancel", "delete",
-    "InputNode", "MultiOutputNode",
+    "InputNode", "MultiOutputNode", "wait_for_event",
     "RUNNING", "SUCCESSFUL", "FAILED", "CANCELED", "RESUMABLE",
 ]

@@ -124,3 +124,42 @@ def test_workflow_run_async(ray_cluster):
     fut = workflow.run_async(add.bind(20, 22), workflow_id="w_async")
     assert fut.result(timeout=60) == 42
     assert workflow.get_status("w_async") == workflow.SUCCESSFUL
+
+
+def test_wait_for_event_and_resume(ray_cluster, tmp_path):
+    """workflow.wait_for_event: a DAG blocks on a pubsub message, the
+    event payload flows into downstream steps, and resume() replays the
+    persisted event without waiting again."""
+    import threading
+    import time as _time
+
+    from ray_tpu import workflow
+    from ray_tpu.util import pubsub
+
+    workflow.init(str(tmp_path / "wf"))
+
+    @ray_tpu.remote
+    def combine(evt, tag):
+        return {"got": evt["order_id"], "tag": tag}
+
+    evt_node = workflow.wait_for_event("orders", timeout=60)
+    dag = combine.bind(evt_node, "done")
+
+    def publish_soon():
+        # publish repeatedly until the waiter (subscribe-then-poll) has
+        # definitely subscribed — at-least-once producer contract
+        for _ in range(50):
+            if pubsub.publish("orders", {"order_id": 42}) > 0:
+                return
+            _time.sleep(0.2)
+
+    t = threading.Thread(target=publish_soon, daemon=True)
+    t.start()
+    out = workflow.run(dag, workflow_id="evt_wf")
+    t.join()
+    assert out == {"got": 42, "tag": "done"}
+
+    # resume must NOT wait for a new event: the step is checkpointed
+    t0 = _time.time()
+    assert workflow.resume("evt_wf") == {"got": 42, "tag": "done"}
+    assert _time.time() - t0 < 10
