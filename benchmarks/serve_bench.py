@@ -1,0 +1,108 @@
+"""Serve ingress throughput/latency microbench.
+
+Mirrors the reference's serve release tests
+(``release/serve_tests/workloads/``): requests/s and p50/p99 latency
+through (a) the direct DeploymentHandle path, (b) the HTTP ingress, and
+(c) the binary RPC ingress, single client. Prints one JSON object.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+from ray_tpu import serve  # noqa: E402
+
+
+def percentile(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+def main():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    results = {}
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, req):
+            return {"ok": True}
+
+    serve.run(Echo.bind(), name="bench", route_prefix="/bench")
+    handle = serve.get_deployment_handle("Echo", "bench")
+
+    # -------------------------------------------------- handle path
+    class _Req:
+        def json(self):
+            return {}
+
+        def __reduce__(self):
+            return (_Req, ())
+
+    handle.remote(_Req()).result()  # warm
+    lats = []
+    t0 = time.perf_counter()
+    N = 500
+    for _ in range(N):
+        s = time.perf_counter()
+        handle.remote(_Req()).result()
+        lats.append(time.perf_counter() - s)
+    dt = time.perf_counter() - t0
+    results["handle_rps"] = round(N / dt, 1)
+    results["handle_p50_ms"] = round(percentile(lats, 0.5) * 1000, 2)
+    results["handle_p99_ms"] = round(percentile(lats, 0.99) * 1000, 2)
+
+    # ---------------------------------------------------- HTTP path
+    import urllib.request
+
+    port = serve.get_proxy_port()
+    url = f"http://127.0.0.1:{port}/bench"
+
+    def http_call():
+        req = urllib.request.Request(url, data=b"{}", headers={
+            "Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            r.read()
+
+    http_call()
+    lats = []
+    t0 = time.perf_counter()
+    N = 300
+    for _ in range(N):
+        s = time.perf_counter()
+        http_call()
+        lats.append(time.perf_counter() - s)
+    dt = time.perf_counter() - t0
+    results["http_rps"] = round(N / dt, 1)
+    results["http_p50_ms"] = round(percentile(lats, 0.5) * 1000, 2)
+    results["http_p99_ms"] = round(percentile(lats, 0.99) * 1000, 2)
+
+    # ----------------------------------------------------- RPC path
+    from ray_tpu.serve.rpc_client import ServeRpcClient
+
+    with ServeRpcClient(port=serve.get_rpc_port()) as c:
+        c.call("/bench", {})
+        lats = []
+        t0 = time.perf_counter()
+        N = 500
+        for _ in range(N):
+            s = time.perf_counter()
+            c.call("/bench", {})
+            lats.append(time.perf_counter() - s)
+        dt = time.perf_counter() - t0
+    results["rpc_rps"] = round(N / dt, 1)
+    results["rpc_p50_ms"] = round(percentile(lats, 0.5) * 1000, 2)
+    results["rpc_p99_ms"] = round(percentile(lats, 0.99) * 1000, 2)
+
+    print(json.dumps(results))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -232,6 +232,13 @@ class DashboardActor:
 
         app.router.add_get("/api/events",
                            json_api(state_ep("cluster_events")))
+
+        def usage_api(request):
+            from ray_tpu._private.usage import usage_report
+
+            return usage_report()
+
+        app.router.add_get("/api/usage", json_api(usage_api))
         app.router.add_get("/healthz", healthz)
         app.router.add_get("/api/cluster", json_api(cluster))
         for kind in ("nodes", "workers", "actors", "tasks", "objects",

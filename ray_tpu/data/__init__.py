@@ -20,3 +20,7 @@ __all__ = [
     "range", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('data')
+del _rlu

@@ -27,3 +27,7 @@ __all__ = [
     "ConnectorPipeline", "FlattenObs", "NormalizeObs", "ClipRewards",
     "GAEConnector", "default_env_to_module", "default_learner_pipeline",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('rl')
+del _rlu

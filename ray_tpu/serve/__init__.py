@@ -272,3 +272,7 @@ __all__ = [
     "batch", "get_deployment_handle", "get_app_handle", "get_proxy_port",
     "get_rpc_port", "multiplexed", "get_multiplexed_model_id",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('serve')
+del _rlu

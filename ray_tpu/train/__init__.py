@@ -15,3 +15,7 @@ __all__ = [
     "FailureConfig", "CheckpointConfig", "report", "get_context",
     "get_checkpoint", "get_dataset_shard", "save_pytree", "load_pytree",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('train')
+del _rlu

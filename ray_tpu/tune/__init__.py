@@ -41,3 +41,7 @@ __all__ = [
     "MedianStoppingRule", "Searcher", "BasicVariantGenerator",
     "TPESearcher", "BayesOptSearcher", "ConcurrencyLimiter",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('tune')
+del _rlu

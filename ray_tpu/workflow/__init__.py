@@ -282,3 +282,7 @@ __all__ = [
     "InputNode", "MultiOutputNode", "wait_for_event",
     "RUNNING", "SUCCESSFUL", "FAILED", "CANCELED", "RESUMABLE",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu('workflow')
+del _rlu

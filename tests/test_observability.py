@@ -196,3 +196,26 @@ def test_cluster_export_events(ray_cluster):
     assert os.path.exists(path)
     lines = [json.loads(l) for l in open(path)]
     assert any(l.get("event") == "dead" for l in lines)
+
+
+def test_usage_report(ray_cluster):
+    """Local usage recording (reference usage_lib — zero-egress here)."""
+    import json
+    import os
+
+    import ray_tpu.data  # noqa: F401 — records library usage
+    import ray_tpu.serve  # noqa: F401
+    from ray_tpu._private.usage import (record_feature, usage_report,
+                                        write_usage_file)
+
+    record_feature("unit_test")
+    rep = usage_report()
+    assert rep["ray_tpu_version"]
+    assert "data" in rep["libraries_used"]
+    assert "serve" in rep["libraries_used"]
+    assert rep["features"]["unit_test"] >= 1
+    assert rep["num_nodes"] >= 1
+
+    path = write_usage_file()
+    assert os.path.basename(path) == "usage.json"
+    assert json.load(open(path))["ray_tpu_version"] == rep["ray_tpu_version"]
