@@ -8,9 +8,12 @@ the pod-head marker resource that lets multi-host slices gang-schedule.
 """
 
 from .accelerator import AcceleratorManager
+from .gpu import GPUAcceleratorManager, NeuronAcceleratorManager
 from .tpu import TPUAcceleratorManager
 
-_MANAGERS = {"TPU": TPUAcceleratorManager()}
+_MANAGERS = {"TPU": TPUAcceleratorManager(),
+             "GPU": GPUAcceleratorManager(),
+             "neuron_cores": NeuronAcceleratorManager()}
 
 
 def get_accelerator_manager(resource_name: str = "TPU") -> AcceleratorManager:
@@ -35,6 +38,8 @@ def detect_accelerator_resources() -> dict:
 __all__ = [
     "AcceleratorManager",
     "TPUAcceleratorManager",
+    "GPUAcceleratorManager",
+    "NeuronAcceleratorManager",
     "get_accelerator_manager",
     "get_all_accelerator_managers",
     "detect_accelerator_resources",

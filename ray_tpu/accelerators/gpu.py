@@ -1,0 +1,98 @@
+"""NVIDIA GPU accelerator manager (mixed-cluster parity).
+
+Reference: ``python/ray/_private/accelerators/nvidia_gpu.py`` — detect
+GPU count/type via nvidia-smi (or the /proc/driver tree), pin workers
+with ``CUDA_VISIBLE_DEVICES``. On a TPU-native cluster this exists so
+heterogeneous fleets (TPU compute + GPU preprocessing nodes, or users
+migrating mixed workloads) schedule GPUs the same way the reference
+does; the tensor plane here remains JAX/XLA.
+
+Gated: hosts without nvidia-smi report zero GPUs (no hard dependency).
+``exec_fn`` is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Callable, Dict, List, Optional
+
+from .accelerator import AcceleratorManager
+
+
+class GPUAcceleratorManager(AcceleratorManager):
+    resource_name = "GPU"
+
+    def __init__(self, exec_fn: Optional[Callable] = None):
+        self._exec = exec_fn
+
+    def _smi(self, *query: str) -> List[str]:
+        binary = shutil.which("nvidia-smi")
+        if self._exec is None and binary is None:
+            return []
+        argv = [binary or "nvidia-smi",
+                f"--query-gpu={','.join(query)}",
+                "--format=csv,noheader"]
+        try:
+            if self._exec is not None:
+                out = self._exec(argv)
+            else:
+                out = subprocess.run(argv, capture_output=True, text=True,
+                                     timeout=10).stdout
+        except Exception:
+            return []
+        return [l.strip() for l in out.splitlines() if l.strip()]
+
+    def get_current_node_num_accelerators(self) -> int:
+        return len(self._smi("index"))
+
+    def get_current_node_accelerator_type(self) -> Optional[str]:
+        names = self._smi("name")
+        if not names:
+            return None
+        # "NVIDIA H100 80GB HBM3" -> "H100" (the reference normalizes to
+        # the accelerator_type constants the scheduler matches on)
+        parts = names[0].replace("NVIDIA", "").split()
+        return parts[0] if parts else None
+
+    def get_current_node_extra_resources(self) -> Dict[str, float]:
+        t = self.get_current_node_accelerator_type()
+        return {f"accelerator_type:{t}": 1.0} if t else {}
+
+    def get_visible_accelerator_ids_env_var(self) -> str:
+        return "CUDA_VISIBLE_DEVICES"
+
+
+class NeuronAcceleratorManager(AcceleratorManager):
+    """AWS Neuron (Trainium/Inferentia) — reference:
+    ``_private/accelerators/neuron.py``: device count from
+    /proc/devices + neuron-ls, pinning via NEURON_RT_VISIBLE_CORES."""
+
+    resource_name = "neuron_cores"
+
+    def __init__(self, exec_fn: Optional[Callable] = None):
+        self._exec = exec_fn
+
+    def get_current_node_num_accelerators(self) -> int:
+        binary = shutil.which("neuron-ls")
+        if self._exec is None and binary is None:
+            return 0
+        argv = [binary or "neuron-ls", "--json-output"]
+        try:
+            if self._exec is not None:
+                out = self._exec(argv)
+            else:
+                out = subprocess.run(argv, capture_output=True, text=True,
+                                     timeout=10).stdout
+            import json
+
+            return sum(int(d.get("nc_count", 0)) for d in json.loads(out))
+        except Exception:
+            return 0
+
+    def get_current_node_accelerator_type(self) -> Optional[str]:
+        return "aws-neuron" if \
+            self.get_current_node_num_accelerators() else None
+
+    def get_visible_accelerator_ids_env_var(self) -> str:
+        return "NEURON_RT_VISIBLE_CORES"

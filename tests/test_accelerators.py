@@ -1,0 +1,54 @@
+"""Accelerator manager tests (TPU + GPU/Neuron plugin breadth).
+
+Reference model: ``python/ray/tests/accelerators/`` — managers detect
+counts/types via faked tool output, pin via env vars.
+"""
+
+from ray_tpu.accelerators import (GPUAcceleratorManager,
+                                  NeuronAcceleratorManager,
+                                  detect_accelerator_resources,
+                                  get_accelerator_manager)
+
+
+def test_gpu_manager_with_fake_smi():
+    def fake(argv):
+        assert argv[0].endswith("nvidia-smi")
+        if "--query-gpu=index" in argv[1]:
+            return "0\n1\n"
+        return "NVIDIA H100 80GB HBM3\nNVIDIA H100 80GB HBM3\n"
+
+    m = GPUAcceleratorManager(exec_fn=fake)
+    assert m.get_current_node_num_accelerators() == 2
+    assert m.get_current_node_accelerator_type() == "H100"
+    assert m.get_current_node_extra_resources() == {
+        "accelerator_type:H100": 1.0}
+    env = {}
+    m.set_visible_accelerators(env, ["0"])
+    assert env == {"CUDA_VISIBLE_DEVICES": "0"}
+
+
+def test_gpu_manager_gated_without_smi():
+    m = GPUAcceleratorManager()  # no nvidia-smi on this host
+    assert m.get_current_node_num_accelerators() == 0
+    assert m.get_current_node_accelerator_type() is None
+
+
+def test_neuron_manager_with_fake_ls():
+    import json
+
+    def fake(argv):
+        return json.dumps([{"nc_count": 2}, {"nc_count": 2}])
+
+    m = NeuronAcceleratorManager(exec_fn=fake)
+    assert m.get_current_node_num_accelerators() == 4
+    assert m.get_current_node_accelerator_type() == "aws-neuron"
+    env = {}
+    m.set_visible_accelerators(env, ["0", "1"])
+    assert env == {"NEURON_RT_VISIBLE_CORES": "0,1"}
+
+
+def test_registry_and_detection():
+    assert get_accelerator_manager("GPU") is not None
+    assert get_accelerator_manager("TPU") is not None
+    res = detect_accelerator_resources()  # no GPUs/TPUs here: no crash
+    assert isinstance(res, dict)
