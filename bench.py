@@ -176,8 +176,15 @@ def main():
         cfg = LlamaConfig(vocab_size=32768, d_model=2048, n_layers=16,
                           n_heads=16, n_kv_heads=8, d_ff=8192,
                           max_seq_len=2048, dtype=jnp.bfloat16)
-        batch, seq = 4, 2048
-        remat = False
+        # Sweep knobs (defaults = the measured champion config):
+        # BENCH_BATCH / BENCH_REMAT / BENCH_CHUNKED_VOCAB. The chunked
+        # vocab softmax (ops/chunked_xent.py) skips the ~1 GiB fp32
+        # logits materialization — candidates like batch 8 + chunked CE
+        # become feasible where dense logits OOM.
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq = 2048
+        remat = os.environ.get("BENCH_REMAT", "0") == "1"
+        chunked_vocab = int(os.environ.get("BENCH_CHUNKED_VOCAB", "0"))
     else:
         cfg = LlamaConfig(vocab_size=1024, d_model=128, n_layers=2,
                           n_heads=4, n_kv_heads=2, d_ff=256,
@@ -185,6 +192,7 @@ def main():
         batch, seq = 2, 128
         steps = min(steps, 3)
         remat = True
+        chunked_vocab = 0
 
     params = init_params(cfg, jax.random.PRNGKey(0))
     opt = optax.adamw(3e-4, weight_decay=0.1)
@@ -195,7 +203,8 @@ def main():
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, {"tokens": tokens}, cfg, remat=remat))(params)
+            lambda p: loss_fn(p, {"tokens": tokens}, cfg, remat=remat,
+                              chunked_vocab=chunked_vocab))(params)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -220,6 +229,7 @@ def main():
         "device": str(dev),
         "params_b": round(cfg.param_count() / 1e9, 3),
         "batch": batch, "seq": seq, "steps": steps,
+        "remat": remat, "chunked_vocab": chunked_vocab,
         "step_time_s": round(dt / steps, 4),
     }
     if not on_tpu:

@@ -169,13 +169,52 @@ def next_token_targets(tokens: jax.Array) -> jax.Array:
         [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
 
 
+def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                   cfg: LlamaConfig, attn_impl=None,
+                   remat: bool = True) -> jax.Array:
+    """Final-norm hidden states [B, L, D] (no lm_head projection)."""
+    if attn_impl is None:
+        attn_impl = flash_attention
+    cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
+    x = params["embedding"][tokens].astype(cfg.dtype)
+
+    def layer_fn(x, layer):
+        a, _ = _attention_block(layer, x, cos, sin, cfg, attn_impl)
+        x = x + a
+        x = x + _mlp_block(layer, x, cfg)
+        return x
+
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        x = layer_fn(x, layer)
+    return rms_norm(x, params["norm"], cfg.norm_eps)
+
+
 def loss_fn(params, batch, cfg: LlamaConfig, attn_impl=None,
-            remat: bool = True):
-    """Next-token loss. batch: {"tokens": [B, L]} or {"tokens", "targets"}."""
+            remat: bool = True, chunked_vocab: int = 0):
+    """Next-token loss. batch: {"tokens": [B, L]} or {"tokens", "targets"}.
+
+    ``chunked_vocab > 0`` streams the vocab softmax in chunks of that
+    size (``ops/chunked_xent.py``): the full [B, L, V] fp32 logits are
+    never materialized — the HBM win that enables larger batches on
+    memory-bound chips.
+    """
     tokens = batch["tokens"]
     targets = batch.get("targets")
     if targets is None:
         targets = next_token_targets(tokens)
+    if chunked_vocab > 0:
+        from ..ops.chunked_xent import chunked_cross_entropy
+
+        x = forward_hidden(params, tokens, cfg, attn_impl=attn_impl,
+                           remat=remat)
+        head = (params["embedding"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        B, L, D = x.shape
+        return chunked_cross_entropy(
+            x.reshape(B * L, D), head, targets.reshape(B * L),
+            chunked_vocab)
     logits = forward(params, tokens, cfg, attn_impl=attn_impl, remat=remat)
     loss, n = cross_entropy_loss(logits, targets)
     return loss

@@ -1,0 +1,128 @@
+"""Memory-efficient chunked-vocab cross entropy.
+
+The standard LLM loss materializes fp32 logits ``[B, S, V]`` — at
+B=4, S=2048, V=32768 that is ~1 GiB of HBM plus its backward residuals,
+which is what forces rematerialization (or small batches) on 16 GiB
+chips. This op never materializes more than ``[N, chunk]`` logits:
+
+  forward:  scan vocab chunks, online logsumexp + gather of the target
+            logit (flash-attention's trick applied to the softmax over
+            the vocabulary).
+  backward: recompute each chunk's logits and emit
+            ``(softmax - onehot)`` contributions to ``d_hidden`` and
+            ``d_head`` chunk by chunk (custom_vjp; no saved logits).
+
+All matmuls stay MXU-shaped ([N, D] x [D, chunk]). Used by
+``models/llama.py`` ``loss_fn(chunked_vocab=...)``; equivalence with the
+dense path is tested to fp32 tolerance (value and gradients).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_head(head, chunk):
+    """Zero-pad the vocab axis to a chunk multiple; padded columns are
+    masked to -inf in the streamed softmax."""
+    V = head.shape[1]
+    n_chunks = -(-V // chunk)
+    pad = n_chunks * chunk - V
+    if pad:
+        head = jnp.pad(head, ((0, 0), (0, pad)))
+    return head, n_chunks
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_cross_entropy(hidden: jax.Array, head: jax.Array,
+                          labels: jax.Array, chunk: int = 8192):
+    """Mean next-token NLL without materializing full logits.
+
+    hidden: [N, D] (flattened activations, any float dtype)
+    head:   [D, V]
+    labels: [N] int (-100 = ignore)
+    """
+    loss, _ = _forward(hidden, head, labels, chunk)
+    return loss
+
+
+def _forward(hidden, head, labels, chunk):
+    N, _ = hidden.shape
+    V = head.shape[1]
+    padded, n_chunks = _pad_head(head, chunk)
+    h32 = hidden.astype(jnp.float32)
+    valid = labels != -100
+    clipped = jnp.clip(labels, 0, V - 1)
+    col = jnp.arange(chunk)
+
+    def body(carry, i):
+        m, s, tl = carry  # running max, sumexp, target logit
+        w = jax.lax.dynamic_slice_in_dim(padded, i * chunk, chunk, axis=1)
+        logits = h32 @ w.astype(jnp.float32)  # [N, chunk]
+        col_ok = (i * chunk + col) < V
+        logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
+        cm = jnp.maximum(m, logits.max(-1))
+        s = s * jnp.exp(m - cm) + jnp.exp(logits - cm[:, None]).sum(-1)
+        m = cm
+        # gather the target logit if it falls in this chunk
+        local = clipped - i * chunk
+        in_chunk = (local >= 0) & (local < chunk)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        tl = jnp.where(in_chunk, got, tl)
+        return (m, s, tl), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, s, tl), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    nll = jnp.where(valid, lse - tl, 0.0)
+    n = jnp.maximum(valid.sum(), 1)
+    loss = nll.sum() / n
+    return loss, (lse, n)
+
+
+def _fwd(hidden, head, labels, chunk):
+    loss, (lse, n) = _forward(hidden, head, labels, chunk)
+    return loss, (hidden, head, labels, lse, n)
+
+
+def _bwd(chunk, res, g):
+    hidden, head, labels, lse, n = res
+    N, D = hidden.shape
+    V = head.shape[1]
+    padded, n_chunks = _pad_head(head, chunk)
+    h32 = hidden.astype(jnp.float32)
+    valid = labels != -100
+    clipped = jnp.clip(labels, 0, V - 1)
+    scale = (g / n) * valid.astype(jnp.float32)  # [N] per-token weight
+    col = jnp.arange(chunk)
+
+    def body(dh, i):
+        w32 = jax.lax.dynamic_slice_in_dim(
+            padded, i * chunk, chunk, axis=1).astype(jnp.float32)
+        logits = h32 @ w32
+        col_ok = (i * chunk + col) < V
+        # softmax over the FULL vocab via the saved lse; padded cols -> 0
+        p = jnp.where(col_ok[None, :],
+                      jnp.exp(logits - lse[:, None]), 0.0)
+        local = clipped - i * chunk
+        in_chunk = (local >= 0) & (local < chunk)
+        p = p - (jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk,
+                                dtype=p.dtype) * in_chunk[:, None])
+        p = p * scale[:, None]  # [N, chunk] = d_logits
+        dh = dh + p @ w32.T
+        dw = h32.T @ p  # [D, chunk]
+        return dh, dw
+
+    dh, dws = jax.lax.scan(body, jnp.zeros((N, D), jnp.float32),
+                           jnp.arange(n_chunks))
+    dhead = dws.transpose(1, 0, 2).reshape(D, n_chunks * chunk)[:, :V]
+    return (dh.astype(hidden.dtype), dhead.astype(head.dtype), None)
+
+
+chunked_cross_entropy.defvjp(_fwd, _bwd)
