@@ -102,6 +102,21 @@ def detect_node_resources(num_cpus: Optional[int] = None,
 
         out["TPU"] = chips
         out.update(get_accelerator_manager("TPU").get_pod_slice_markers(chips))
+    # Non-TPU accelerators (GPU/Neuron) advertise through their managers
+    # (gated on their tools; zero on hosts without them) so mixed fleets
+    # schedule them like the reference does.
+    from ray_tpu.accelerators import get_all_accelerator_managers
+
+    for name, mgr in get_all_accelerator_managers().items():
+        if name == "TPU" or mgr.resource_name in out:
+            continue
+        try:
+            n = mgr.get_current_node_num_accelerators()
+        except Exception:
+            n = 0
+        if n > 0:
+            out[mgr.resource_name] = float(n)
+            out.update(mgr.get_current_node_extra_resources())
     if resources:
         out.update(resources)
     return out

@@ -23,37 +23,49 @@ from .accelerator import AcceleratorManager
 class GPUAcceleratorManager(AcceleratorManager):
     resource_name = "GPU"
 
+    _PROBE_TTL_S = 30.0
+
     def __init__(self, exec_fn: Optional[Callable] = None):
         self._exec = exec_fn
+        self._probe_cache: Optional[tuple] = None  # (ts, rows)
 
-    def _smi(self, *query: str) -> List[str]:
+    def _probe(self) -> List[str]:
+        """One nvidia-smi call answers count AND type; cached briefly so
+        a detection cycle doesn't spawn two 10s-timeout subprocesses."""
+        import time
+
+        if self._probe_cache is not None and \
+                time.monotonic() - self._probe_cache[0] < self._PROBE_TTL_S:
+            return self._probe_cache[1]
         binary = shutil.which("nvidia-smi")
-        if self._exec is None and binary is None:
-            return []
-        argv = [binary or "nvidia-smi",
-                f"--query-gpu={','.join(query)}",
-                "--format=csv,noheader"]
-        try:
-            if self._exec is not None:
-                out = self._exec(argv)
-            else:
-                out = subprocess.run(argv, capture_output=True, text=True,
-                                     timeout=10).stdout
-        except Exception:
-            return []
-        return [l.strip() for l in out.splitlines() if l.strip()]
+        rows: List[str] = []
+        if self._exec is not None or binary is not None:
+            argv = [binary or "nvidia-smi",
+                    "--query-gpu=index,name",
+                    "--format=csv,noheader"]
+            try:
+                if self._exec is not None:
+                    out = self._exec(argv)
+                else:
+                    out = subprocess.run(argv, capture_output=True,
+                                         text=True, timeout=10).stdout
+                rows = [l.strip() for l in out.splitlines() if l.strip()]
+            except Exception:
+                rows = []
+        self._probe_cache = (time.monotonic(), rows)
+        return rows
 
     def get_current_node_num_accelerators(self) -> int:
-        return len(self._smi("index"))
+        return len(self._probe())
 
     def get_current_node_accelerator_type(self) -> Optional[str]:
-        names = self._smi("name")
-        if not names:
+        rows = self._probe()
+        if not rows:
             return None
-        # "NVIDIA H100 80GB HBM3" -> "H100" (the reference normalizes to
-        # the accelerator_type constants the scheduler matches on)
-        parts = names[0].replace("NVIDIA", "").split()
-        return parts[0] if parts else None
+        # "0, NVIDIA H100 80GB HBM3" -> "H100" (the reference normalizes
+        # to the accelerator_type constants the scheduler matches on)
+        name = rows[0].partition(",")[2].replace("NVIDIA", "").split()
+        return name[0] if name else None
 
     def get_current_node_extra_resources(self) -> Dict[str, float]:
         t = self.get_current_node_accelerator_type()

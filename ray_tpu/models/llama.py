@@ -139,36 +139,6 @@ def _mlp_block(layer, x, cfg: LlamaConfig):
     return jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
-            attn_impl=None, remat: bool = True) -> jax.Array:
-    """Logits for a token batch. tokens: [B, L] int32 -> [B, L, V]."""
-    if attn_impl is None:
-        attn_impl = flash_attention
-    cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta)
-    x = params["embedding"][tokens].astype(cfg.dtype)
-
-    def layer_fn(x, layer):
-        a, _ = _attention_block(layer, x, cos, sin, cfg, attn_impl)
-        x = x + a
-        x = x + _mlp_block(layer, x, cfg)
-        return x
-
-    if remat:
-        layer_fn = jax.checkpoint(layer_fn)  # trade FLOPs for HBM
-    for layer in params["layers"]:
-        x = layer_fn(x, layer)
-    x = rms_norm(x, params["norm"], cfg.norm_eps)
-    head = (params["embedding"].T if cfg.tie_embeddings
-            else params["lm_head"])
-    return jnp.dot(x, head.astype(x.dtype))
-
-
-def next_token_targets(tokens: jax.Array) -> jax.Array:
-    """Shifted targets with -100 (ignore) padding the final position."""
-    return jnp.concatenate(
-        [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
-
-
 def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
                    cfg: LlamaConfig, attn_impl=None,
                    remat: bool = True) -> jax.Array:
@@ -189,6 +159,22 @@ def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
     for layer in params["layers"]:
         x = layer_fn(x, layer)
     return rms_norm(x, params["norm"], cfg.norm_eps)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            attn_impl=None, remat: bool = True) -> jax.Array:
+    """Logits for a token batch. tokens: [B, L] int32 -> [B, L, V]."""
+    x = forward_hidden(params, tokens, cfg, attn_impl=attn_impl,
+                       remat=remat)
+    head = (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    return jnp.dot(x, head.astype(x.dtype))
+
+
+def next_token_targets(tokens: jax.Array) -> jax.Array:
+    """Shifted targets with -100 (ignore) padding the final position."""
+    return jnp.concatenate(
+        [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, attn_impl=None,

@@ -73,6 +73,6 @@ def deploy_config(path_or_dict) -> List[str]:
             target = target(**args) if args else target()
         name = app_cfg.get("name", "default")
         serve.run(target, name=name,
-                  route_prefix=app_cfg.get("route_prefix"))
+                  route_prefix=app_cfg.get("route_prefix", "/"))
         deployed.append(name)
     return deployed

@@ -258,13 +258,23 @@ def wait_for_event(channel: str, *, timeout: Optional[float] = None):
         with pubsub.subscribe(ch) as sub:
             deadline = None if to is None else time.time() + to
             while True:
-                step = None if deadline is None else                     max(0.1, deadline - time.time())
+                # Bounded poll steps so a closed subscription is noticed
+                # (poll returns None both on timeout and on close).
+                step = 1.0 if deadline is None else \
+                    min(1.0, max(0.05, deadline - time.time()))
                 item = sub.poll(timeout=step)
-                if item is not None and item.get("message") is not None:
-                    return item["message"]
-                if deadline is not None and time.time() >= deadline:
-                    raise TimeoutError(
-                        f"no event on channel {ch!r} within {to}s")
+                if item is None:
+                    if sub._closed.is_set():
+                        raise RuntimeError(
+                            f"subscription to {ch!r} closed while "
+                            "waiting for the event")
+                    if deadline is not None and time.time() >= deadline:
+                        raise TimeoutError(
+                            f"no event on channel {ch!r} within {to}s")
+                    continue
+                if item.get("resubscribed"):
+                    continue  # gap marker, not an event
+                return item["message"]  # any payload, including None
 
     node = _wait_for_event.bind(channel, timeout)
     return node

@@ -13,9 +13,9 @@ from ray_tpu.accelerators import (GPUAcceleratorManager,
 def test_gpu_manager_with_fake_smi():
     def fake(argv):
         assert argv[0].endswith("nvidia-smi")
-        if "--query-gpu=index" in argv[1]:
-            return "0\n1\n"
-        return "NVIDIA H100 80GB HBM3\nNVIDIA H100 80GB HBM3\n"
+        assert argv[1] == "--query-gpu=index,name"  # ONE combined probe
+        return ("0, NVIDIA H100 80GB HBM3\n"
+                "1, NVIDIA H100 80GB HBM3\n")
 
     m = GPUAcceleratorManager(exec_fn=fake)
     assert m.get_current_node_num_accelerators() == 2
