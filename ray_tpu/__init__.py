@@ -37,7 +37,7 @@ from ._private.serialization import (
     TaskError,
     WorkerCrashedError,
 )
-from ._private.worker import ObjectRef
+from ._private.worker import ObjectRef, ObjectRefGenerator
 
 __version__ = "0.1.0"
 

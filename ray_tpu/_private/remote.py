@@ -208,6 +208,8 @@ class RemoteFunction:
             wire_opts.update(_strategy_opts(opts))
             self._wire_opts = wire_opts
         nret = opts.get("num_returns", 1)
+        if nret == "streaming":
+            nret = "dynamic"  # alias: both resolve to an ObjectRefGenerator
         msg_args = _prepare_args(args, kwargs, collect_deps=True)
         if tracing.active():
             # Per-call span: copy the cached wire opts (the hot path when
@@ -215,7 +217,7 @@ class RemoteFunction:
             wire_opts = dict(wire_opts)
             tracing.inject_task_opts(wire_opts, wire_opts["name"])
         refs = w.submit_task(fid, msg_args, nret, wire_opts)
-        return refs[0] if nret == 1 else refs
+        return refs[0] if nret in (1, "dynamic") else refs
 
 
 class ActorMethod:

@@ -151,6 +151,22 @@ def _refresh_flags():
 _on_cfg_change(_refresh_flags)
 
 
+class DynamicReturns:
+    """Descriptor value of a ``num_returns="dynamic"`` task's primary
+    return: the ordered return-object ids the generator produced
+    (reference: ObjectRefGenerator for dynamic generator tasks,
+    ``_raylet.pyx:281``). The driver resolves this into an
+    ``ObjectRefGenerator``."""
+
+    __slots__ = ("oids",)
+
+    def __init__(self, oids):
+        self.oids = list(oids)
+
+    def __reduce__(self):
+        return (DynamicReturns, (self.oids,))
+
+
 class TaskError(Exception):
     """An exception raised inside a task, re-raised at ``get`` on the caller.
 

@@ -112,6 +112,25 @@ def _deserialize_object_ref(id_bytes: bytes) -> ObjectRef:
     return ObjectRef(ObjectID(id_bytes), borrowed=True)
 
 
+class ObjectRefGenerator:
+    """Iterable of a dynamic-returns task's per-item refs (reference:
+    ``ObjectRefGenerator``, ``_raylet.pyx:281`` — ``num_returns="dynamic"``
+    tasks resolve to one of these; iterate and ``get`` each ref)."""
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+
+
 class _Lease:
     """A worker leased to this process for one scheduling class."""
 
@@ -534,6 +553,11 @@ class Worker:
                 # Zero-copy read: the arena pin transfers to the value's
                 # buffers and drops when they are garbage-collected.
                 value = deserialize(view.data, pin=view.transfer())
+        if isinstance(value, serialization.DynamicReturns):
+            # Dynamic generator task: primary return resolves to the
+            # per-item ref generator (descriptor may be inline or shm).
+            return ObjectRefGenerator(
+                [ObjectRef(ObjectID(b), self) for b in value.oids])
         if isinstance(value, TaskError):
             raise value.cause if isinstance(value.cause, Exception) else value
         if isinstance(value, Exception):
@@ -859,12 +883,18 @@ class Worker:
     async def handle_control(self, msg: dict):  # overridden in worker_main
         pass
 
-    def submit_task(self, fid: str, msg_args: dict, num_returns: int,
+    def submit_task(self, fid: str, msg_args: dict, num_returns,
                     opts: dict) -> List[ObjectRef]:
         tid = TaskID.from_random()
         refs = []
         oids = []
         deps = msg_args.pop("deps", None)
+        if num_returns == "dynamic":
+            # One primary return: the DynamicReturns descriptor
+            # (resolved to an ObjectRefGenerator at get).
+            num_returns = 1
+            opts = dict(opts)
+            opts["nret_dyn"] = True
         for i in range(num_returns):
             oid = ObjectID.for_task_return(tid, i + 1)
             fut = SyncFuture()
@@ -878,14 +908,16 @@ class Worker:
             # spread semantics, which lease reuse would defeat (every task
             # of the class would ride the first granted worker).
             msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
-                   "nret": num_returns, "opts": opts, **msg_args}
+                   "nret": "dyn" if opts.get("nret_dyn") else num_returns,
+                   "opts": opts, **msg_args}
             self.send_gcs_threadsafe(msg)
             return refs
         # Direct path: lease workers for this scheduling class and push
         # the task straight to one (reference hot path, §3.2: lease reuse
         # + PushTask, normal_task_submitter.h:108).
         msg = {"t": "exec", "tid": tid.binary(), "fid": fid,
-               "nret": num_returns, "opts": opts,
+               "nret": "dyn" if opts.get("nret_dyn") else num_returns,
+               "opts": opts,
                "owner": self.worker_id.binary(), **msg_args}
         # Scheduling class key + lease_req fields: invariant per opts dict
         # (shared wire_opts cached on the RemoteFunction) — compute once.

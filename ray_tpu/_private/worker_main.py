@@ -414,7 +414,8 @@ class Executor:
             results = self._execute_sync(msg, tid, nret, opts)
             err = any([r.pop("_err", False) for r in results])
         except Exception as e:  # noqa: BLE001
-            results = self._error_results(tid, nret, fn_name, e)
+            results = self._error_results(
+                tid, 1 if nret == "dyn" else nret, fn_name, e)
             for r in results:
                 r.pop("_err", None)
             err = True
@@ -438,7 +439,8 @@ class Executor:
                 self.pool, self._execute_sync, msg, tid, nret, opts)
             err = any([r.pop("_err", False) for r in results])
         except Exception as e:  # noqa: BLE001
-            results = self._error_results(tid, nret, fn_name, e)
+            results = self._error_results(
+                tid, 1 if nret == "dyn" else nret, fn_name, e)
             err = True
         self.record_event(tid, fn_name, "task", t0, time.time(), not err)
         self.worker.gcs.send({"t": "task_done", "tid": tid,
@@ -479,11 +481,27 @@ class Executor:
                     value = fn(*args, **kwargs)
                     if asyncio.iscoroutine(value):
                         value = asyncio.run(value)
+                    if nret == "dyn":
+                        value = list(value)
             else:
                 value = fn(*args, **kwargs)
                 if asyncio.iscoroutine(value):
                     value = asyncio.run(value)
-            values = self._split_returns(value, nret)
+                if nret == "dyn":
+                    value = list(value)
+            if nret == "dyn":
+                # Dynamic generator returns (reference: num_returns=
+                # "dynamic"): each yielded item is its own return object
+                # (indices 2..n+1); the primary return (index 1) is the
+                # descriptor the driver turns into an ObjectRefGenerator.
+                from .serialization import DynamicReturns
+
+                tid_obj = TaskID(tid)
+                oids = [ObjectID.for_task_return(tid_obj, i + 2).binary()
+                        for i in range(len(value))]
+                values = [DynamicReturns(oids)] + value
+            else:
+                values = self._split_returns(value, nret)
             return self._pack_results(tid, values, register_shm=False)
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
@@ -497,7 +515,8 @@ class Executor:
                 return [{"oid": ObjectID.for_task_return(
                     TaskID(tid), 1).binary(), "nbytes": len(data),
                     "data": data, "_err": True}]
-            return self._error_results(tid, nret, fn_name, e)
+            return self._error_results(
+                tid, 1 if nret == "dyn" else nret, fn_name, e)
         finally:
             self.running_tasks.pop(tid, None)
 

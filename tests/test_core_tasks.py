@@ -172,3 +172,45 @@ def test_direct_call_rejected(ray_cluster):
 
     with pytest.raises(TypeError):
         f()
+
+
+def test_dynamic_generator_returns(ray_cluster):
+    """num_returns='dynamic': a generator task's items become individual
+    return objects; the primary ref resolves to an ObjectRefGenerator
+    (reference: _raylet.pyx ObjectRefGenerator)."""
+    import numpy as np
+
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def produce(n):
+        for i in range(n):
+            yield {"i": i, "arr": np.full(4, i)}
+
+    gen_ref = produce.options(num_returns="dynamic").remote(3)
+    gen = ray_tpu.get(gen_ref)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    assert len(gen) == 3
+    items = [ray_tpu.get(r) for r in gen]
+    assert [it["i"] for it in items] == [0, 1, 2]
+    np.testing.assert_array_equal(items[2]["arr"], np.full(4, 2))
+
+    # refs are individually consumable in any order / by other tasks
+    @ray_tpu.remote
+    def double(d):
+        return d["i"] * 2
+
+    assert ray_tpu.get(double.remote(gen[1])) == 2
+
+    # errors inside the generator surface at get of the primary ref
+    @ray_tpu.remote
+    def boom():
+        yield 1
+        raise ValueError("gen-fail")
+
+    with pytest.raises(ValueError, match="gen-fail"):
+        ray_tpu.get(boom.options(num_returns="dynamic").remote())
+
+    # "streaming" aliases to dynamic
+    g2 = ray_tpu.get(produce.options(num_returns="streaming").remote(2))
+    assert len(g2) == 2
