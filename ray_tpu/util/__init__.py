@@ -11,6 +11,25 @@ from .scheduling_strategies import (
 
 from . import metrics, pubsub, state, tracing
 
+
+def __getattr__(name):
+    # queue/ActorPool define actors at import (need ray_tpu.remote), so
+    # they must load lazily — ray_tpu/__init__ imports util before the
+    # public API exists.
+    if name == "queue":
+        from . import queue as _q
+
+        return _q
+    if name == "actor_pool":
+        from . import actor_pool as _ap
+
+        return _ap
+    if name == "ActorPool":
+        from .actor_pool import ActorPool as _AP
+
+        return _AP
+    raise AttributeError(f"module 'ray_tpu.util' has no attribute {name!r}")
+
 __all__ = [
     "PlacementGroup", "placement_group", "remove_placement_group",
     "placement_group_table", "NodeAffinitySchedulingStrategy",

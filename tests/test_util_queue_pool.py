@@ -1,0 +1,74 @@
+"""ray_tpu.util Queue + ActorPool tests (reference: util/queue.py,
+util/actor_pool.py test suites)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_queue_fifo_and_batch(ray_cluster):
+    q = Queue(maxsize=5)
+    for i in range(5):
+        q.put(i)
+    assert q.full() and q.qsize() == 5
+    with pytest.raises(Full):
+        q.put(99, block=False)
+    assert q.get() == 0
+    assert q.get_nowait_batch(10) == [1, 2, 3, 4]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_cross_process(ray_cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i * 11)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 4)
+    out = ray_tpu.get(consumer.remote(q, 4), timeout=60)
+    assert ray_tpu.get(p)
+    assert out == [0, 11, 22, 33]
+    q.shutdown()
+
+
+def test_actor_pool_ordered_and_unordered(ray_cluster):
+    @ray_tpu.remote
+    class Sq:
+        def compute(self, x):
+            import time
+
+            time.sleep(0.01 * (x % 3))  # jitter completion order
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(3)])
+    assert list(pool.map(lambda a, v: a.compute.remote(v),
+                         range(8))) == [i * i for i in range(8)]
+
+    out = sorted(pool.map_unordered(lambda a, v: a.compute.remote(v),
+                                    range(8)))
+    assert out == sorted(i * i for i in range(8))
+
+    # more work than actors: pending queue + dispatch on free
+    pool.submit(lambda a, v: a.compute.remote(v), 10)
+    pool.submit(lambda a, v: a.compute.remote(v), 11)
+    pool.submit(lambda a, v: a.compute.remote(v), 12)
+    pool.submit(lambda a, v: a.compute.remote(v), 13)
+    got = [pool.get_next() for _ in range(4)]
+    assert got == [100, 121, 144, 169]
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
