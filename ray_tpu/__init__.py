@@ -28,7 +28,8 @@ _install_jax_hook()
 
 from ._private import worker as _worker_mod
 from ._private.ids import ActorID, NodeID, ObjectID, TaskID
-from ._private.remote import ActorClass, ActorHandle, ActorMethod, RemoteFunction, remote
+from ._private.remote import (ActorClass, ActorHandle, ActorMethod,
+                              RemoteFunction, method, remote)
 from ._private.serialization import (
     ActorDiedError,
     GetTimeoutError,

@@ -29,9 +29,26 @@ _DEFAULT_TASK_OPTS = dict(
 _DEFAULT_ACTOR_OPTS = dict(
     num_cpus=0, num_tpus=0, resources=None, max_restarts=0,
     max_task_retries=0, name=None, namespace=None, lifetime=None,
-    max_concurrency=None, scheduling_strategy=None, runtime_env=None,
+    max_concurrency=None, concurrency_groups=None,
+    scheduling_strategy=None, runtime_env=None,
     placement_group=None, placement_group_bundle_index=None,
 )
+
+
+def method(*, concurrency_group: Optional[str] = None,
+           num_returns: Optional[int] = None):
+    """Per-method options decorator (reference: ``ray.method`` —
+    ``actor.py:116`` ActorMethod options; concurrency groups per
+    ``ConcurrencyGroupManager``)."""
+
+    def wrap(fn):
+        if concurrency_group is not None:
+            fn._concurrency_group = concurrency_group
+        if num_returns is not None:
+            fn._num_returns = num_returns
+        return fn
+
+    return wrap
 
 
 def _build_resources(opts: dict) -> Dict[str, float]:
@@ -351,6 +368,7 @@ class ActorClass:
             "namespace": opts.get("namespace") or w.namespace,
             "lifetime": opts.get("lifetime"),
             "max_concurrency": opts.get("max_concurrency"),
+            "concurrency_groups": opts.get("concurrency_groups"),
         }
         renv = _prepared_runtime_env(opts)
         if renv:

@@ -541,6 +541,15 @@ class Executor:
             self.pool = ThreadPoolExecutor(max_workers=max_c,
                                            thread_name_prefix="exec")
         self.async_sem = asyncio.Semaphore(max_c or 1000)
+        # Concurrency groups (reference: ConcurrencyGroupManager,
+        # core_worker/transport/concurrency_group_manager.h): named
+        # per-group limits for async actor methods; methods tagged with
+        # @ray_tpu.method(concurrency_group=...) draw from their group's
+        # semaphore instead of the default.
+        self.group_sems = {
+            name: asyncio.Semaphore(int(limit))
+            for name, limit in
+            (self.actor_opts.get("concurrency_groups") or {}).items()}
         try:
             await loop.run_in_executor(self.pool, self._init_actor_sync, msg)
             self.worker.gcs.send({"t": "actor_ready",
@@ -577,7 +586,10 @@ class Executor:
                 raise serialization.ActorDiedError("actor not initialized")
             method = getattr(self.actor_instance, method_name)
             if asyncio.iscoroutinefunction(method):
-                async with self.async_sem:
+                group = getattr(method, "_concurrency_group", None)
+                sem = self.group_sems.get(group, self.async_sem) \
+                    if getattr(self, "group_sems", None) else self.async_sem
+                async with sem:
                     args, kwargs = await loop.run_in_executor(
                         None, self._load_args, msg)
                     tp = (msg.get("opts") or {}).get("tp")

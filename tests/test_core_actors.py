@@ -208,3 +208,52 @@ def test_detached_actor_listed(ray_cluster):
     d = D.options(name="detached-one", lifetime="detached").remote()
     assert ray_tpu.get(d.ping.remote()) == 1
     ray_tpu.kill(d)
+
+
+def test_concurrency_groups(ray_cluster):
+    """@ray_tpu.method(concurrency_group=...): named per-group limits for
+    async actor methods (reference: ConcurrencyGroupManager,
+    core_worker/transport/concurrency_group_manager.h)."""
+    import time
+
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote(max_concurrency=8,
+                    concurrency_groups={"io": 1, "compute": 4})
+    class Svc:
+        def __init__(self):
+            self.active = {"io": 0, "compute": 0}
+            self.peak = {"io": 0, "compute": 0}
+
+        @ray_tpu.method(concurrency_group="io")
+        async def io_call(self):
+            import asyncio
+
+            self.active["io"] += 1
+            self.peak["io"] = max(self.peak["io"], self.active["io"])
+            await asyncio.sleep(0.1)
+            self.active["io"] -= 1
+            return "io"
+
+        @ray_tpu.method(concurrency_group="compute")
+        async def compute_call(self):
+            import asyncio
+
+            self.active["compute"] += 1
+            self.peak["compute"] = max(self.peak["compute"],
+                                       self.active["compute"])
+            await asyncio.sleep(0.1)
+            self.active["compute"] -= 1
+            return "c"
+
+        async def peaks(self):
+            return self.peak
+
+    s = Svc.remote()
+    refs = [s.io_call.remote() for _ in range(4)] + \
+        [s.compute_call.remote() for _ in range(4)]
+    out = ray_tpu.get(refs, timeout=60)
+    assert out == ["io"] * 4 + ["c"] * 4
+    peaks = ray_tpu.get(s.peaks.remote())
+    assert peaks["io"] == 1        # serialized by its group limit
+    assert peaks["compute"] >= 2   # its group allows real concurrency
