@@ -159,7 +159,8 @@ class TaskRecord:
         self.error = False
         self.returns: List[ObjectID] = [
             ObjectID.for_task_return(task_id, i + 1)
-            for i in range(msg.get("nret", 1))
+            for i in range(1 if msg.get("nret") == "dyn"
+                           else msg.get("nret", 1))
         ]
 
 

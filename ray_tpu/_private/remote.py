@@ -268,10 +268,12 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: List[str],
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0,
+                 method_num_returns: Optional[Dict[str, int]] = None):
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._max_task_retries = max_task_retries
+        self._method_num_returns = dict(method_num_returns or {})
 
     @property
     def _id(self) -> ActorID:
@@ -284,7 +286,8 @@ class ActorHandle:
             raise AttributeError(
                 f"actor has no method {name!r}; available: "
                 f"{sorted(self._method_names)}")
-        return ActorMethod(self, name)
+        return ActorMethod(self, name,
+                           self._method_num_returns.get(name, 1))
 
     def _call(self, method: str, args: tuple, kwargs: dict,
               num_returns: int, extra_opts: dict):
@@ -301,14 +304,16 @@ class ActorHandle:
     def __reduce__(self):
         return (_rebuild_actor_handle,
                 (self._actor_id.binary(), self._method_names,
-                 self._max_task_retries))
+                 self._max_task_retries, self._method_num_returns))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:16]})"
 
 
-def _rebuild_actor_handle(aid_bytes, method_names, max_task_retries):
-    return ActorHandle(ActorID(aid_bytes), method_names, max_task_retries)
+def _rebuild_actor_handle(aid_bytes, method_names, max_task_retries,
+                          method_num_returns=None):
+    return ActorHandle(ActorID(aid_bytes), method_names, max_task_retries,
+                       method_num_returns)
 
 
 class ActorClass:
@@ -339,6 +344,25 @@ class ActorClass:
     def _method_names(self) -> List[str]:
         return [n for n, m in inspect.getmembers(self._cls)
                 if callable(m) and not n.startswith("__")]
+
+    def _method_num_returns(self) -> Dict[str, int]:
+        """Per-method @ray_tpu.method(num_returns=...) declarations."""
+        out = {}
+        for n, m in inspect.getmembers(self._cls):
+            nr = getattr(m, "_num_returns", None)
+            if nr is not None:
+                out[n] = nr
+        return out
+
+    def _validate_concurrency_groups(self):
+        declared = set((self._opts.get("concurrency_groups") or {}))
+        for n, m in inspect.getmembers(self._cls):
+            g = getattr(m, "_concurrency_group", None)
+            if g is not None and g not in declared:
+                raise ValueError(
+                    f"method {n!r} uses concurrency_group {g!r} but the "
+                    f"actor declares only {sorted(declared)} — add it to "
+                    "@remote(concurrency_groups={...})")
 
     def _ensure_registered(self) -> str:
         w = global_worker()
@@ -375,9 +399,11 @@ class ActorClass:
             wire_opts["runtime_env"] = renv
         wire_opts.update(_strategy_opts(opts))
         msg_args = _prepare_args(args, kwargs)
+        self._validate_concurrency_groups()
         aid = w.create_actor_msg(fid, msg_args, wire_opts)
         return ActorHandle(aid, self._method_names(),
-                           opts.get("max_task_retries", 0))
+                           opts.get("max_task_retries", 0),
+                           self._method_num_returns())
 
 
 def remote(*args, **kwargs):

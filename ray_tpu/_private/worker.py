@@ -889,12 +889,12 @@ class Worker:
         refs = []
         oids = []
         deps = msg_args.pop("deps", None)
-        if num_returns == "dynamic":
+        dynamic = num_returns == "dynamic"
+        if dynamic:
             # One primary return: the DynamicReturns descriptor
-            # (resolved to an ObjectRefGenerator at get).
+            # (resolved to an ObjectRefGenerator at get). No opts copy:
+            # the per-opts scheduling-class cache must keep working.
             num_returns = 1
-            opts = dict(opts)
-            opts["nret_dyn"] = True
         for i in range(num_returns):
             oid = ObjectID.for_task_return(tid, i + 1)
             fut = SyncFuture()
@@ -908,7 +908,7 @@ class Worker:
             # spread semantics, which lease reuse would defeat (every task
             # of the class would ride the first granted worker).
             msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
-                   "nret": "dyn" if opts.get("nret_dyn") else num_returns,
+                   "nret": "dyn" if dynamic else num_returns,
                    "opts": opts, **msg_args}
             self.send_gcs_threadsafe(msg)
             return refs
@@ -916,7 +916,7 @@ class Worker:
         # the task straight to one (reference hot path, §3.2: lease reuse
         # + PushTask, normal_task_submitter.h:108).
         msg = {"t": "exec", "tid": tid.binary(), "fid": fid,
-               "nret": "dyn" if opts.get("nret_dyn") else num_returns,
+               "nret": "dyn" if dynamic else num_returns,
                "opts": opts,
                "owner": self.worker_id.binary(), **msg_args}
         # Scheduling class key + lease_req fields: invariant per opts dict

@@ -550,6 +550,12 @@ class Executor:
             name: asyncio.Semaphore(int(limit))
             for name, limit in
             (self.actor_opts.get("concurrency_groups") or {}).items()}
+        # Sync methods run on the thread pool: their groups enforce via
+        # threading semaphores (same limits).
+        self.group_thread_sems = {
+            name: threading.Semaphore(int(limit))
+            for name, limit in
+            (self.actor_opts.get("concurrency_groups") or {}).items()}
         try:
             await loop.run_in_executor(self.pool, self._init_actor_sync, msg)
             self.worker.gcs.send({"t": "actor_ready",
@@ -699,14 +705,22 @@ class Executor:
                     TaskID(tid), 1).binary(), "nbytes": len(data),
                     "data": data}]
             args, kwargs = self._load_args(msg)
-            tp = (msg.get("opts") or {}).get("tp")
-            if tp:
-                from ray_tpu.util import tracing
+            group = getattr(method, "_concurrency_group", None)
+            gsem = getattr(self, "group_thread_sems", {}).get(group)
+            if gsem is not None:
+                gsem.acquire()
+            try:
+                tp = (msg.get("opts") or {}).get("tp")
+                if tp:
+                    from ray_tpu.util import tracing
 
-                with tracing.adopt_and_span(tp, f"run:{msg['m']}"):
+                    with tracing.adopt_and_span(tp, f"run:{msg['m']}"):
+                        value = method(*args, **kwargs)
+                else:
                     value = method(*args, **kwargs)
-            else:
-                value = method(*args, **kwargs)
+            finally:
+                if gsem is not None:
+                    gsem.release()
             values = self._split_returns(value, nret)
             return self._pack_results(tid, values, register_shm=True)
         finally:

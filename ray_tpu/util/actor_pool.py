@@ -21,6 +21,7 @@ class ActorPool:
         self._ready = {}               # index -> completed ref
         self._next_task = 0
         self._next_return = 0
+        self._mode = None  # "ordered" | "unordered" (mixing is an error)
 
     # ------------------------------------------------------- submission
 
@@ -55,18 +56,32 @@ class ActorPool:
 
     def get_next_unordered(self, timeout=None):
         """Next COMPLETED result (any order)."""
+        if self._mode == "ordered":
+            raise ValueError(
+                "cannot mix get_next() and get_next_unordered() on one "
+                "ActorPool (the ordered cursor would skip consumed "
+                "results)")
+        self._mode = "unordered"
         if self._ready:
             idx = next(iter(self._ready))
             self._next_return += 1
+            self._maybe_reset_mode()
             return ray_tpu.get(self._ready.pop(idx))
         if not self.has_next():
             raise StopIteration("no pending work")
         ref, _ = self._complete_one(timeout)
         self._next_return += 1
+        self._maybe_reset_mode()
         return ray_tpu.get(ref)
 
     def get_next(self, timeout=None):
         """Next result in SUBMISSION order."""
+        if self._mode == "unordered":
+            raise ValueError(
+                "cannot mix get_next() and get_next_unordered() on one "
+                "ActorPool (the ordered cursor would skip consumed "
+                "results)")
+        self._mode = "ordered"
         if not self.has_next():
             raise StopIteration("no pending work")
         want = self._next_return
@@ -74,7 +89,13 @@ class ActorPool:
             ref, idx = self._complete_one(timeout)
             self._ready[idx] = ref
         self._next_return += 1
+        self._maybe_reset_mode()
         return ray_tpu.get(self._ready.pop(want))
+
+    def _maybe_reset_mode(self):
+        # A drained pool may switch between ordered/unordered consumption.
+        if not self.has_next():
+            self._mode = None
 
     def map(self, fn: Callable, values: Iterable[Any]):
         """Ordered results iterator (reference ``ActorPool.map``)."""

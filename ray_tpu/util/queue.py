@@ -150,7 +150,10 @@ class Queue:
             pass
 
     def __reduce__(self):
-        return (Queue, (0,), {"actor": self.actor})
+        return (_rebuild_queue, (self.actor,))
 
-    def __setstate__(self, state):
-        self.actor = state["actor"]
+
+def _rebuild_queue(actor):
+    """Unpickle path: wrap the EXISTING actor (constructing Queue() here
+    would spawn an orphan queue actor per deserialization)."""
+    return Queue(_actor=actor)

@@ -257,3 +257,34 @@ def test_concurrency_groups(ray_cluster):
     peaks = ray_tpu.get(s.peaks.remote())
     assert peaks["io"] == 1        # serialized by its group limit
     assert peaks["compute"] >= 2   # its group allows real concurrency
+
+
+def test_method_num_returns(ray_cluster):
+    """@ray_tpu.method(num_returns=2) on actor methods (reference
+    ray.method)."""
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self, x):
+            return x, x + 1
+
+    s = Splitter.remote()
+    a, b = s.pair.remote(10)
+    assert ray_tpu.get(a) == 10 and ray_tpu.get(b) == 11
+
+
+def test_undeclared_concurrency_group_rejected(ray_cluster):
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class Bad:
+        @ray_tpu.method(concurrency_group="nope")
+        async def f(self):
+            return 1
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="nope"):
+        Bad.remote()

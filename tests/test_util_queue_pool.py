@@ -72,3 +72,21 @@ def test_actor_pool_ordered_and_unordered(ray_cluster):
     assert not pool.has_next()
     with pytest.raises(StopIteration):
         pool.get_next()
+
+
+def test_actor_pool_mix_guard(ray_cluster):
+    @ray_tpu.remote
+    class Id:
+        def f(self, x):
+            return x
+
+    pool = ActorPool([Id.remote()])
+    pool.submit(lambda a, v: a.f.remote(v), 1)
+    pool.submit(lambda a, v: a.f.remote(v), 2)
+    assert pool.get_next() == 1
+    with pytest.raises(ValueError, match="cannot mix"):
+        pool.get_next_unordered()
+    assert pool.get_next() == 2
+    # drained: mode resets, unordered is allowed again
+    pool.submit(lambda a, v: a.f.remote(v), 3)
+    assert pool.get_next_unordered() == 3
