@@ -105,7 +105,7 @@ class Subscriber:
             self._closed.set()
             self._out.put(None)
 
-        asyncio.ensure_future(pump())
+        self._pump_task = asyncio.ensure_future(pump())
 
     def poll(self, timeout: Optional[float] = None) -> Optional[dict]:
         """Next message, or None on timeout/closed stream."""
@@ -133,6 +133,14 @@ class Subscriber:
         except Exception:
             pass
         self._closed.set()
+        # Cancel the pump so interpreter teardown doesn't warn about a
+        # pending task parked on the stream queue.
+        task = getattr(self, "_pump_task", None)
+        if task is not None and not task.done():
+            try:
+                self._w.loop.call_soon_threadsafe(task.cancel)
+            except Exception:
+                pass
 
     def __enter__(self):
         return self

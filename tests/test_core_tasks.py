@@ -214,3 +214,19 @@ def test_dynamic_generator_returns(ray_cluster):
     # "streaming" aliases to dynamic
     g2 = ray_tpu.get(produce.options(num_returns="streaming").remote(2))
     assert len(g2) == 2
+
+
+def test_dynamic_returns_via_gcs_path(ray_cluster):
+    """Dynamic generator returns through the GCS scheduler path (SPREAD
+    strategy routes there) — regression for nret='dyn' record handling."""
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def produce(n):
+        for i in range(n):
+            yield i + 100
+
+    gen = ray_tpu.get(produce.options(
+        num_returns="dynamic",
+        scheduling_strategy="SPREAD").remote(3), timeout=60)
+    assert [ray_tpu.get(r) for r in gen] == [100, 101, 102]
