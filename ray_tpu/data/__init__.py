@@ -3,6 +3,7 @@ from .dataset import Dataset, MaterializedDataset
 from .iterator import DataIterator
 from .read_api import (
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
@@ -17,6 +18,7 @@ from .read_api import (
 __all__ = [
     "Dataset", "MaterializedDataset", "DataIterator", "BlockAccessor",
     "to_block", "from_items", "from_numpy", "from_pandas", "from_arrow",
+    "from_huggingface",
     "range", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy",
 ]

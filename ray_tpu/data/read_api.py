@@ -86,6 +86,29 @@ def from_arrow(table) -> Dataset:
     return Dataset([table])
 
 
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    """A HuggingFace ``datasets.Dataset`` as a distributed dataset
+    (reference: ``ray.data.from_huggingface``). Zero-copy: HF datasets
+    are arrow-backed, so the underlying table is taken directly and
+    split into blocks."""
+    table = getattr(getattr(hf_dataset, "data", None), "table", None)
+    if table is None:
+        # IterableDataset / non-arrow-backed: materialize via pandas.
+        return from_pandas(hf_dataset.to_pandas())
+    n = len(table)
+    if parallelism <= 0:
+        parallelism = max(1, min(8, n // 10_000 or 1))
+    if parallelism == 1 or n == 0:
+        return Dataset([table.combine_chunks()])
+    import builtins
+
+    per = -(-n // parallelism)
+    # NB: this module's ``range`` is the data API (ray.data.range).
+    blocks = [table.slice(i * per, per).combine_chunks()
+              for i in builtins.range(parallelism) if i * per < n]
+    return Dataset(blocks)
+
+
 def _read_parquet_file(path: str, columns):
     import pyarrow.parquet as pq
 

@@ -24,11 +24,12 @@ class DeploymentResponse:
 
     def __init__(self, ref: Optional[ray_tpu.ObjectRef],
                  on_done: Callable[[], None],
-                 async_coro=None):
+                 async_coro=None, retry: Optional[Callable] = None):
         self._ref = ref
         self._on_done = on_done
         self._coro = async_coro
         self._done = False
+        self._retry = retry
 
     def _finish(self):
         if not self._done:
@@ -40,6 +41,15 @@ class DeploymentResponse:
             raise RuntimeError(
                 "this response was created on the event loop; use `await`")
         try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
+            # Replica died under this request: re-resolve the replica set
+            # and retry once on a live one (reference: the router
+            # reschedules failed requests, replica_scheduler/pow_2).
+            if self._retry is None:
+                raise
+            retry, self._retry = self._retry, None
+            self._ref = retry()
             return ray_tpu.get(self._ref, timeout=timeout)
         finally:
             self._finish()
@@ -288,12 +298,27 @@ class DeploymentHandle:
 
         return ref, done
 
+    def _retry_closure(self, args, kwargs):
+        def retry():
+            self._replicas = []  # force re-resolve (dead replica pruned
+            self._refresh()      # by the controller health loop)
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no live "
+                    "replicas")
+            ref, done = self._submit(args, kwargs)
+            done()
+            return ref
+        return retry
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         if self._replicas and not self._fresh():
             self._replicas = []  # config changed: re-resolve below
         if self._replicas:
             ref, done = self._submit(args, kwargs)
-            return DeploymentResponse(ref, done)
+            return DeploymentResponse(ref, done,
+                                      retry=self._retry_closure(args,
+                                                                kwargs))
         if self._on_io_thread():
             # Inside an async replica: replica discovery must not block the
             # event loop — resolve it as part of the awaited chain.
@@ -316,7 +341,8 @@ class DeploymentHandle:
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no replicas")
         ref, done = self._submit(args, kwargs)
-        return DeploymentResponse(ref, done)
+        return DeploymentResponse(ref, done,
+                                  retry=self._retry_closure(args, kwargs))
 
     async def stream(self, *args, **kwargs):
         """Async generator over the replica method's yielded values.

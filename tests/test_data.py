@@ -204,3 +204,25 @@ def test_iter_torch_and_jax_batches(ray_cluster):
     assert len(jb) == 2
     assert jb[0]["x"].shape == (5, 2)
     np.testing.assert_allclose(np.asarray(jb[0]["y"]), np.arange(5))
+
+
+def test_from_huggingface(ray_cluster):
+    """HF datasets ingest (reference ray.data.from_huggingface) —
+    arrow-backed zero copy, blocks split for parallelism."""
+    import datasets as hf
+
+    from ray_tpu import data as rdata
+
+    ds_hf = hf.Dataset.from_dict(
+        {"text": [f"doc {i}" for i in range(100)],
+         "label": list(range(100))})
+    ds = rdata.from_huggingface(ds_hf, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    rows = ds.filter(lambda r: r["label"] < 3).take_all()
+    assert [r["text"] for r in rows] == ["doc 0", "doc 1", "doc 2"]
+    # transforms compose on top
+    out = ds.map_batches(
+        lambda b: {"n": [len(t) for t in b["text"]]},
+        batch_size=50).take(2)
+    assert out[0]["n"] == len("doc 0")

@@ -203,3 +203,34 @@ def test_config_push_invalidates_handle_cache(serve_cluster):
             break
         time.sleep(0.1)
     assert len(seen) >= 2, f"handle never saw scaled replicas: {seen}"
+
+
+def test_handle_retries_on_dead_replica(serve_cluster):
+    """A request landing on a killed replica retries on a live one
+    (reference: router failure rescheduling, pow_2_scheduler)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_controller
+
+    @serve.deployment(num_replicas=2)
+    class Who:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, req):
+            return self.pid
+
+    serve.run(Who.bind(), name="retry_app", route_prefix=None)
+    h = serve.get_deployment_handle("Who", "retry_app")
+    h.remote(None).result()  # resolve replicas
+
+    # Kill one replica out from under the handle's cache, then hammer:
+    # every request must still succeed (dead-replica hits retry).
+    ctl = get_controller()
+    import ray_tpu as rt
+
+    replicas = rt.get(ctl.get_replicas.remote("retry_app", "Who"))
+    rt.kill(replicas[0])
+    results = [h.remote(None).result(timeout=30) for _ in range(10)]
+    assert all(isinstance(r, int) for r in results)
