@@ -249,6 +249,9 @@ def status() -> dict:
 def shutdown():
     global _proxy, _proxy_port, _proxy_rpc_port
     _proxy_rpc_port = None
+    from .deployment import _ConfigWatcher
+
+    _ConfigWatcher.stop()
     try:
         ctl = get_controller()
         for app in list(ray_tpu.get(ctl.list_deployments.remote())):

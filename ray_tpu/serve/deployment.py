@@ -167,7 +167,7 @@ class _ConfigWatcher:
             from ray_tpu.util.pubsub import Subscriber
 
             w = worker_mod._global_worker
-            sub = Subscriber("serve_config")
+            sub = self._sub = Subscriber("serve_config")
             while True:
                 item = sub.poll(timeout=1.0)
                 if item is None:
@@ -206,6 +206,21 @@ class _ConfigWatcher:
             with self._lock:
                 # Anything published after this thread stops is unseen.
                 self._global += 1
+
+    @classmethod
+    def stop(cls):
+        """serve.shutdown hook: close the channel subscription so its
+        pump task doesn't linger into interpreter teardown."""
+        inst = cls._instance
+        if inst is None:
+            return
+        sub = getattr(inst, "_sub", None)
+        if sub is not None:
+            try:
+                sub.close()
+            except Exception:
+                pass
+        cls._instance = None
 
     def version(self, app: str, deployment: str) -> int:
         with self._lock:
