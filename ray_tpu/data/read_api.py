@@ -91,9 +91,17 @@ def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
     (reference: ``ray.data.from_huggingface``). Zero-copy: HF datasets
     are arrow-backed, so the underlying table is taken directly and
     split into blocks."""
-    table = getattr(getattr(hf_dataset, "data", None), "table", None)
+    if not hasattr(hf_dataset, "data"):
+        raise ValueError(
+            "from_huggingface needs a materialized datasets.Dataset; "
+            "for streaming IterableDataset, iterate and use from_items "
+            "(or load without streaming=True)")
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # select()/shuffle()/filter() leave an indices mapping over the
+        # base table; flatten so the arrow data matches the logical rows.
+        hf_dataset = hf_dataset.flatten_indices()
+    table = getattr(hf_dataset.data, "table", None)
     if table is None:
-        # IterableDataset / non-arrow-backed: materialize via pandas.
         return from_pandas(hf_dataset.to_pandas())
     n = len(table)
     if parallelism <= 0:

@@ -22,9 +22,25 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 @ray_tpu.remote
 class ServeController:
-    def __init__(self):
+    def __init__(self, health_check_period_s: float = 10.0):
+        import threading
+
         # app -> dep name -> {"deployment": blob..., "replicas": [handles]}
         self.apps: Dict[str, Dict[str, dict]] = {}
+        # The reconciliation loop (reference: DeploymentState health loop,
+        # deployment_state.py:1245) — replaces dead replicas on a period.
+        self._stop_health = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, args=(health_check_period_s,),
+            daemon=True, name="serve-health")
+        self._health_thread.start()
+
+    def _health_loop(self, period: float):
+        while not self._stop_health.wait(period):
+            try:
+                self.check_health()
+            except Exception:
+                pass  # transient cluster churn; next period retries
 
     def deploy(self, app_name: str, deployments: List[dict]):
         """deployments: [{name, blob, init_args, init_kwargs, is_class,

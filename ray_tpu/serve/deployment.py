@@ -24,16 +24,19 @@ class DeploymentResponse:
 
     def __init__(self, ref: Optional[ray_tpu.ObjectRef],
                  on_done: Callable[[], None],
-                 async_coro=None, retry: Optional[Callable] = None):
+                 async_coro=None, retry_ctx: Optional[tuple] = None):
         self._ref = ref
         self._on_done = on_done
         self._coro = async_coro
         self._done = False
-        self._retry = retry
+        # (handle, args, kwargs, replica_actor_id) for dead-replica
+        # failover; released in _finish so request payloads don't pin.
+        self._retry_ctx = retry_ctx
 
     def _finish(self):
         if not self._done:
             self._done = True
+            self._retry_ctx = None
             self._on_done()
 
     def result(self, timeout: Optional[float] = None):
@@ -41,16 +44,18 @@ class DeploymentResponse:
             raise RuntimeError(
                 "this response was created on the event loop; use `await`")
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
-        except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
-            # Replica died under this request: re-resolve the replica set
-            # and retry once on a live one (reference: the router
-            # reschedules failed requests, replica_scheduler/pow_2).
-            if self._retry is None:
-                raise
-            retry, self._retry = self._retry, None
-            self._ref = retry()
-            return ray_tpu.get(self._ref, timeout=timeout)
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout)
+            except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
+                # Replica died under this request: re-resolve, excluding
+                # the dead replica, and retry once on a live one
+                # (reference: router failure rescheduling, pow_2).
+                if self._retry_ctx is None:
+                    raise
+                handle, args, kwargs, dead = self._retry_ctx
+                self._retry_ctx = None
+                self._ref = handle._retry_submit(args, kwargs, dead)
+                return ray_tpu.get(self._ref, timeout=timeout)
         finally:
             self._finish()
 
@@ -59,7 +64,17 @@ class DeploymentResponse:
             try:
                 if self._coro is not None:
                     return await self._coro
-                return await self._ref
+                try:
+                    return await self._ref
+                except (ray_tpu.ActorDiedError,
+                        ray_tpu.WorkerCrashedError):
+                    if self._retry_ctx is None:
+                        raise
+                    handle, args, kwargs, dead = self._retry_ctx
+                    self._retry_ctx = None
+                    self._ref = await handle._retry_submit_async(
+                        args, kwargs, dead)
+                    return await self._ref
             finally:
                 self._finish()
 
@@ -144,6 +159,7 @@ class _ConfigWatcher:
         self._global = 0
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        self._stop_requested = False
 
     @classmethod
     def get(cls) -> "_ConfigWatcher":
@@ -169,6 +185,9 @@ class _ConfigWatcher:
             w = worker_mod._global_worker
             sub = self._sub = Subscriber("serve_config")
             while True:
+                if self._stop_requested:
+                    sub.close()
+                    break
                 item = sub.poll(timeout=1.0)
                 if item is None:
                     if sub._closed.is_set():
@@ -214,6 +233,7 @@ class _ConfigWatcher:
         inst = cls._instance
         if inst is None:
             return
+        inst._stop_requested = True  # covers a thread still starting up
         sub = getattr(inst, "_sub", None)
         if sub is not None:
             try:
@@ -298,9 +318,12 @@ class DeploymentHandle:
         a, b = self._rng.sample(range(n), 2)
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
 
+    _last_picked_actor_id = None
+
     def _submit(self, args, kwargs):
         idx = self._pick()
         replica = self._replicas[idx]
+        self._last_picked_actor_id = replica._actor_id.binary()
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
         if self.multiplexed_model_id:
             kwargs = {**kwargs,
@@ -313,27 +336,47 @@ class DeploymentHandle:
 
         return ref, done
 
-    def _retry_closure(self, args, kwargs):
-        def retry():
-            self._replicas = []  # force re-resolve (dead replica pruned
-            self._refresh()      # by the controller health loop)
-            if not self._replicas:
-                raise RuntimeError(
-                    f"deployment {self.deployment_name!r} has no live "
-                    "replicas")
-            ref, done = self._submit(args, kwargs)
-            done()
-            return ref
-        return retry
+    def _exclude_dead(self, dead_actor_id):
+        if dead_actor_id is None:
+            return
+        live = [r for r in self._replicas
+                if r._actor_id.binary() != dead_actor_id]
+        if live:  # never filter down to nothing
+            self._replicas = live
+            self._inflight = {i: 0 for i in range(len(live))}
+
+    def _retry_submit(self, args, kwargs, dead_actor_id):
+        self._replicas = []
+        self._refresh()  # re-resolve from the controller
+        self._exclude_dead(dead_actor_id)
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no live "
+                "replicas")
+        ref, done = self._submit(args, kwargs)
+        done()
+        return ref
+
+    async def _retry_submit_async(self, args, kwargs, dead_actor_id):
+        self._replicas = []
+        await self._refresh_async()
+        self._exclude_dead(dead_actor_id)
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no live "
+                "replicas")
+        ref, done = self._submit(args, kwargs)
+        done()
+        return ref
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         if self._replicas and not self._fresh():
             self._replicas = []  # config changed: re-resolve below
         if self._replicas:
             ref, done = self._submit(args, kwargs)
-            return DeploymentResponse(ref, done,
-                                      retry=self._retry_closure(args,
-                                                                kwargs))
+            dead_id = self._last_picked_actor_id
+            return DeploymentResponse(
+                ref, done, retry_ctx=(self, args, kwargs, dead_id))
         if self._on_io_thread():
             # Inside an async replica: replica discovery must not block the
             # event loop — resolve it as part of the awaited chain.
@@ -356,8 +399,9 @@ class DeploymentHandle:
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no replicas")
         ref, done = self._submit(args, kwargs)
-        return DeploymentResponse(ref, done,
-                                  retry=self._retry_closure(args, kwargs))
+        return DeploymentResponse(
+            ref, done,
+            retry_ctx=(self, args, kwargs, self._last_picked_actor_id))
 
     async def stream(self, *args, **kwargs):
         """Async generator over the replica method's yielded values.

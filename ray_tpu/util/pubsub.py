@@ -133,6 +133,7 @@ class Subscriber:
         except Exception:
             pass
         self._closed.set()
+        self._out.put(None)  # wake any consumer blocked in poll()
         # Cancel the pump so interpreter teardown doesn't warn about a
         # pending task parked on the stream queue.
         task = getattr(self, "_pump_task", None)

@@ -226,3 +226,14 @@ def test_from_huggingface(ray_cluster):
         lambda b: {"n": [len(t) for t in b["text"]]},
         batch_size=50).take(2)
     assert out[0]["n"] == len("doc 0")
+
+
+def test_from_huggingface_respects_indices(ray_cluster):
+    import datasets as hf
+
+    from ray_tpu import data as rdata
+
+    base = hf.Dataset.from_dict({"x": list(range(100))})
+    picked = base.select(range(5, 10))
+    ds = rdata.from_huggingface(picked)
+    assert [r["x"] for r in ds.take_all()] == [5, 6, 7, 8, 9]
