@@ -483,6 +483,44 @@ class Dataset:
         return [Dataset(s, list(self._ops), self._remote_args)
                 for s in shards]
 
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> "tuple[Dataset, Dataset]":
+        """(train, test) row split (reference: ``Dataset.
+        train_test_split``). ``test_size`` is a fraction in (0, 1)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        n_test = max(1, int(n * test_size))
+        return ds.split_at_indices([n - n_test])
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split by global row indices (reference: ``split_at_indices``).
+
+        Materializes block boundaries (row-accurate splits cannot be
+        lazy over unknown block sizes)."""
+        blocks = self._all_blocks()
+        rows = []
+        for b in blocks:
+            acc = BlockAccessor(b)
+            rows.append(acc.num_rows())
+        bounds = [0] + sorted(indices) + [sum(rows)]
+        out: List[Dataset] = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            picked = []
+            pos = 0
+            for b, r in zip(blocks, rows):
+                b_lo, b_hi = pos, pos + r
+                pos = b_hi
+                s = max(lo, b_lo)
+                e = min(hi, b_hi)
+                if e > s:
+                    picked.append(b.slice(s - b_lo, e - s))
+            out.append(Dataset(picked if picked
+                               else [blocks[0].slice(0, 0)], []))
+        return out
+
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List["DataIterator"]:
         """Per-worker streaming shards (reference: ``dataset.py:1390``)."""

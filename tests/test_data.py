@@ -237,3 +237,22 @@ def test_from_huggingface_respects_indices(ray_cluster):
     picked = base.select(range(5, 10))
     ds = rdata.from_huggingface(picked)
     assert [r["x"] for r in ds.take_all()] == [5, 6, 7, 8, 9]
+
+
+def test_split_at_indices_and_train_test_split(ray_cluster):
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_items([{"id": i} for i in range(20)])
+    a, b, c = ds.split_at_indices([5, 12])
+    assert [r["id"] for r in a.take_all()] == list(range(5))
+    assert [r["id"] for r in b.take_all()] == list(range(5, 12))
+    assert [r["id"] for r in c.take_all()] == list(range(12, 20))
+
+    train, test = ds.train_test_split(0.25)
+    assert train.count() == 15 and test.count() == 5
+    assert [r["id"] for r in test.take_all()] == list(range(15, 20))
+
+    tr_s, te_s = ds.train_test_split(0.2, shuffle=True, seed=3)
+    ids = sorted(r["id"] for r in tr_s.take_all()) + \
+        sorted(r["id"] for r in te_s.take_all())
+    assert sorted(ids) == list(range(20))
