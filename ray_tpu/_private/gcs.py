@@ -2228,6 +2228,18 @@ class GcsServer:
         for p in self.pgs.values():
             if p.state == "pending":
                 demands.extend(p.bundles)
+        # Explicit capacity requests (reference: autoscaler
+        # sdk.request_resources — app-level demand hints that persist
+        # until replaced). Stored as a KV entry by the client API.
+        req = self.kv.get(("_autoscaler", "requested"))
+        if req:
+            try:
+                import json as _json
+
+                for bundle in _json.loads(req):
+                    demands.append({k: float(v) for k, v in bundle.items()})
+            except (ValueError, AttributeError):
+                pass
         nodes = []
         for n in self.nodes.values():
             busy = any(

@@ -10,8 +10,27 @@ from .node_provider import (
 from .scheduler import ResourceDemandScheduler
 from .testing import AutoscalingCluster
 
+
+def request_resources(*, num_cpus: int = 0, bundles=None):
+    """App-level capacity request (reference: ``ray.autoscaler.sdk.
+    request_resources``): the autoscaler treats these bundles as standing
+    demand until replaced by a later call (empty call clears)."""
+    import json
+
+    from ray_tpu._private.worker import global_worker
+
+    out = []
+    if num_cpus:
+        out.append({"CPU": float(num_cpus)})
+    for b in (bundles or []):
+        out.append({k: float(v) for k, v in b.items()})
+    global_worker().kv_put("requested", json.dumps(out).encode(),
+                           ns="_autoscaler")
+    return len(out)
+
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "NodeTypeConfig", "NodeProvider",
+    "request_resources",
     "LocalNodeProvider", "TPUSliceNodeProvider", "ResourceDemandScheduler",
     "AutoscalingCluster",
 ]

@@ -174,3 +174,26 @@ def test_tpu_slice_provider_markers():
         assert ray_tpu.get(on_slice_head.remote(), timeout=60) == "ok"
     finally:
         cluster.shutdown()
+
+
+def test_request_resources_creates_demand():
+    """sdk.request_resources parity: standing demand launches nodes even
+    with no pending tasks; clearing removes it."""
+    import ray_tpu
+    from ray_tpu.autoscaler import request_resources
+
+    ray_tpu.init(num_cpus=1, probe_tpu=False, ignore_reinit_error=True)
+    try:
+        import ray_tpu._private.worker as pw
+
+        request_resources(bundles=[{"CPU": 4}, {"CPU": 4}])
+        w = pw.global_worker()
+        state = w.request_gcs({"t": "autoscaler_state"})
+        demands = state["demands"]
+        assert demands.count({"CPU": 4.0}) == 2
+
+        request_resources()  # clear
+        state = w.request_gcs({"t": "autoscaler_state"})
+        assert {"CPU": 4.0} not in state["demands"]
+    finally:
+        ray_tpu.shutdown()
