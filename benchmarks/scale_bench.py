@@ -1,0 +1,93 @@
+"""Scalability envelope microbench: many tasks / many actors / many PGs.
+
+Mirrors the reference's distributed scalability suite
+(``release/benchmarks/distributed/test_many_tasks.py``,
+``test_many_actors.py``, ``test_many_pgs.py``) at single-host scale:
+sustained task throughput with a large backlog, actor launch rate with
+many alive, and PG create/remove churn. Prints one JSON object.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+
+
+def main():
+    ray_tpu.init(num_cpus=8, probe_tpu=False, ignore_reinit_error=True)
+    results = {}
+
+    # ---------------- many tasks: big backlog, sustained completion
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    N_TASKS = int(os.environ.get("SCALE_TASKS", "5000"))
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(N_TASKS)]
+    submit_dt = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=600)
+    total_dt = time.perf_counter() - t0
+    assert len(out) == N_TASKS
+    results["many_tasks"] = {
+        "n": N_TASKS,
+        "submit_rate_per_s": round(N_TASKS / submit_dt, 1),
+        "sustained_per_s": round(N_TASKS / total_dt, 1),
+    }
+
+    # ---------------- many PGs: churn
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    N_PGS = int(os.environ.get("SCALE_PGS", "200"))
+    t0 = time.perf_counter()
+    pgs = []
+    for _ in range(N_PGS):
+        pg = placement_group([{"CPU": 0.01}])
+        pg.wait(30)
+        pgs.append(pg)
+    create_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for pg in pgs:
+        remove_placement_group(pg)
+    remove_dt = time.perf_counter() - t0
+    results["many_pgs"] = {
+        "n": N_PGS,
+        "create_per_s": round(N_PGS / create_dt, 1),
+        "remove_per_s": round(N_PGS / remove_dt, 1),
+    }
+
+    # ---------------- many actors: launch rate, all alive at once
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    N_ACTORS = int(os.environ.get("SCALE_ACTORS", "200"))
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(N_ACTORS)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    dt = time.perf_counter() - t0
+    results["many_actors"] = {
+        "n": N_ACTORS,
+        "launch_to_ready_per_s": round(N_ACTORS / dt, 1),
+    }
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    results["many_actors"]["calls_all_alive_per_s"] = round(
+        N_ACTORS / (time.perf_counter() - t0), 1)
+    for a in actors:
+        ray_tpu.kill(a)
+
+    results["host_cores"] = os.cpu_count()
+    print(json.dumps(results))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
