@@ -39,6 +39,7 @@ from ._private.serialization import (
     WorkerCrashedError,
 )
 from ._private.worker import ObjectRef, ObjectRefGenerator
+from ._private.runtime_context import get_runtime_context
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,85 @@
+"""Runtime context: who/where am I, inside tasks and actors.
+
+Reference: ``python/ray/runtime_context.py`` (``ray.get_runtime_context()``
+→ node id, worker id, task id, actor id, assigned resources). Execution
+identity is tracked in a thread-local set by the executor around user
+code (sync paths run on pool threads; async actor methods set it per
+call on the loop via the same helper).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_ctx = threading.local()
+
+
+def _set_execution(task_id: Optional[bytes] = None,
+                   actor_id: Optional[bytes] = None,
+                   resources: Optional[dict] = None):
+    _ctx.task_id = task_id
+    _ctx.actor_id = actor_id
+    _ctx.resources = resources or {}
+
+
+def _clear_execution():
+    _ctx.task_id = None
+    _ctx.actor_id = None
+    _ctx.resources = {}
+
+
+class RuntimeContext:
+    """Answers identity/topology questions from any process."""
+
+    def _worker(self):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker()
+
+    def get_node_id(self) -> str:
+        w = self._worker()
+        return w.node_id.hex() if isinstance(w.node_id, (bytes, bytearray)) \
+            else (w.node_id or b"").hex() if w.node_id else ""
+
+    def get_worker_id(self) -> str:
+        return self._worker().worker_id.hex()
+
+    def get_job_id(self) -> str:
+        """The session name (this runtime scopes work per session; the
+        job-submission subsystem layers real job ids on top)."""
+        return self._worker().session_name or ""
+
+    def get_task_id(self) -> Optional[str]:
+        tid = getattr(_ctx, "task_id", None)
+        return tid.hex() if tid else None
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = getattr(_ctx, "actor_id", None)
+        return aid.hex() if aid else None
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        return dict(getattr(_ctx, "resources", {}) or {})
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        import os
+
+        return os.environ.get("RAY_TPU_ACTOR_RESTARTED") == "1"
+
+    def get(self) -> dict:
+        """Legacy dict form (reference ``RuntimeContext.get``)."""
+        return {
+            "node_id": self.get_node_id(),
+            "worker_id": self.get_worker_id(),
+            "job_id": self.get_job_id(),
+            "task_id": self.get_task_id(),
+            "actor_id": self.get_actor_id(),
+        }
+
+
+_context = RuntimeContext()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return _context

@@ -454,6 +454,9 @@ class Executor:
                       opts: dict) -> List[dict]:
         self.running_tasks[tid] = threading.get_ident()
         fn_name = opts.get("name", "unknown")
+        from .runtime_context import _clear_execution, _set_execution
+
+        _set_execution(task_id=bytes(tid), resources=opts.get("res"))
         try:
             self._apply_runtime_env(opts)
             fn = self._get_function(msg["fid"])
@@ -518,6 +521,7 @@ class Executor:
             return self._error_results(
                 tid, 1 if nret == "dyn" else nret, fn_name, e)
         finally:
+            _clear_execution()
             self.running_tasks.pop(tid, None)
 
     @staticmethod
@@ -595,6 +599,12 @@ class Executor:
                 group = getattr(method, "_concurrency_group", None)
                 sem = self.group_sems.get(group, self.async_sem) \
                     if getattr(self, "group_sems", None) else self.async_sem
+                from .runtime_context import _set_execution
+
+                _set_execution(task_id=bytes(tid),
+                               actor_id=(self.actor_id.binary()
+                                         if self.actor_id else None),
+                               resources=(self.actor_opts or {}).get("res"))
                 async with sem:
                     args, kwargs = await loop.run_in_executor(
                         None, self._load_args, msg)
@@ -691,6 +701,12 @@ class Executor:
     def _execute_method_sync(self, method, msg: dict, tid: bytes,
                              nret: int) -> List[dict]:
         self.running_tasks[tid] = threading.get_ident()
+        from .runtime_context import _clear_execution, _set_execution
+
+        _set_execution(task_id=bytes(tid),
+                       actor_id=(self.actor_id.binary()
+                                 if self.actor_id else None),
+                       resources=(self.actor_opts or {}).get("res"))
         try:
             if (msg.get("opts") or {}).get("xlang"):
                 # msgpack in / msgpack out so a non-Python caller reads
@@ -724,6 +740,7 @@ class Executor:
             values = self._split_returns(value, nret)
             return self._pack_results(tid, values, register_shm=True)
         finally:
+            _clear_execution()
             self.running_tasks.pop(tid, None)
 
     # ---------------------------------------------------------------- misc

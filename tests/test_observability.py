@@ -219,3 +219,42 @@ def test_usage_report(ray_cluster):
     path = write_usage_file()
     assert os.path.basename(path) == "usage.json"
     assert json.load(open(path))["ray_tpu_version"] == rep["ray_tpu_version"]
+
+
+def test_runtime_context(ray_cluster):
+    """ray_tpu.get_runtime_context(): identity inside tasks and actors
+    (reference: ray.runtime_context)."""
+    import ray_tpu
+
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_worker_id()
+    assert ctx.get_job_id()
+    assert ctx.get_task_id() is None  # driver: not inside a task
+
+    @ray_tpu.remote(num_cpus=1)
+    def who():
+        c = ray_tpu.get_runtime_context()
+        return {"task_id": c.get_task_id(), "actor_id": c.get_actor_id(),
+                "node_id": c.get_node_id(), "worker_id": c.get_worker_id(),
+                "resources": c.get_assigned_resources()}
+
+    info = ray_tpu.get(who.remote())
+    assert info["task_id"] and info["actor_id"] is None
+    assert info["worker_id"] and info["node_id"]
+    assert info["resources"].get("CPU") == 1.0
+
+    @ray_tpu.remote
+    class A:
+        def who(self):
+            c = ray_tpu.get_runtime_context()
+            return {"task_id": c.get_task_id(),
+                    "actor_id": c.get_actor_id()}
+
+        async def awho(self):
+            c = ray_tpu.get_runtime_context()
+            return c.get_actor_id()
+
+    a = A.remote()
+    info = ray_tpu.get(a.who.remote())
+    assert info["actor_id"] and info["task_id"]
+    assert ray_tpu.get(a.awho.remote()) == info["actor_id"]
