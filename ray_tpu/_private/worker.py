@@ -918,7 +918,8 @@ class Worker:
             # of the class would ride the first granted worker).
             msg = {"t": "submit", "tid": tid.binary(), "fid": fid,
                    "nret": "dyn" if dynamic else num_returns,
-                   "opts": opts, **msg_args}
+                   "opts": ({k: v for k, v in opts.items() if k != "_cls"}
+                            if "_cls" in opts else opts), **msg_args}
             self.send_gcs_threadsafe(msg)
             return refs
         # Direct path: lease workers for this scheduling class and push
@@ -951,8 +952,13 @@ class Worker:
             key = repr((sorted(wire["res"].items()), wire.get("pg"),
                         wire.get("bix"), wire.get("sched"),
                         wire.get("env_key")))
-            cached = opts["_cls"] = (key, wire)
-        key, wire = cached
+            # Clean wire opts (no cache tuple): what actually ships in
+            # every exec/submit frame — packing the cache itself would
+            # add bytes + msgpack time per task.
+            clean = {k: v for k, v in opts.items() if k != "_cls"}
+            cached = opts["_cls"] = (key, wire, clean)
+        key, wire, clean_opts = cached
+        msg["opts"] = clean_opts
         item = _TaskItem(msg, oids, opts.get("retries", 0),
                          opts.get("name", ""))
         # Dependency resolution BEFORE dispatch (reference:
