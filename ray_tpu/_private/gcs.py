@@ -2228,18 +2228,6 @@ class GcsServer:
         for p in self.pgs.values():
             if p.state == "pending":
                 demands.extend(p.bundles)
-        # Explicit capacity requests (reference: autoscaler
-        # sdk.request_resources — app-level demand hints that persist
-        # until replaced). Stored as a KV entry by the client API.
-        req = self.kv.get(("_autoscaler", "requested"))
-        if req:
-            try:
-                import json as _json
-
-                for bundle in _json.loads(req):
-                    demands.append({k: float(v) for k, v in bundle.items()})
-            except (ValueError, AttributeError):
-                pass
         nodes = []
         for n in self.nodes.values():
             busy = any(
@@ -2250,6 +2238,20 @@ class GcsServer:
             nodes.append({"node_id": n.node_id.hex(), "alive": n.alive,
                           "total": n.total, "avail": n.avail,
                           "idle_s": 0.0 if busy else now - n.last_active})
+        # Explicit capacity requests (reference: autoscaler
+        # sdk.request_resources — app-level hints that persist until
+        # replaced). Appended AFTER the idle computation: a satisfied
+        # standing request must not refresh node activity, or idle
+        # scale-down would be disabled while any request is outstanding.
+        req = self.kv.get(("_autoscaler", "requested"))
+        if req:
+            try:
+                import json as _json
+
+                for bundle in _json.loads(req):
+                    demands.append({k: float(v) for k, v in bundle.items()})
+            except (ValueError, AttributeError):
+                pass
         client.conn.reply(msg, {"ok": True, "demands": demands,
                                 "nodes": nodes})
 

@@ -289,7 +289,11 @@ class NodeAgent:
             # the host would hit an unrelated process), and GCS lag can
             # list already-dead workers.
             own_pids = {p.pid for p in self.procs if p.poll() is None}
-            own_pids |= self.zygote_pids  # fork children: real host pids
+            # Fork children are killable too — but verified LIVE against
+            # the zygote's parent link, never via the historical pid set
+            # (recycled pids would hit unrelated processes).
+            own_pids |= {p for p in self.zygote_pids
+                         if self._is_zygote_child(p)}
             candidates = [tuple(c) for c in reply.get("candidates", [])
                           if c[0] in own_pids
                           and c[0] not in recently_killed]
@@ -483,6 +487,22 @@ class NodeAgent:
             except ConnectionError:
                 pass
 
+    def _is_zygote_child(self, pid: int) -> bool:
+        """Is this pid CURRENTLY a child of our zygote? Guards against
+        pid recycling (zygote_pids is historical; the kernel's parent
+        link is live truth)."""
+        z = self._zygote
+        if z is None or z.poll() is not None:
+            return False
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                for line in f:
+                    if line.startswith("PPid:"):
+                        return int(line.split()[1]) == z.pid
+        except (OSError, ValueError):
+            pass
+        return False
+
     def _zygote_available(self, python: str, wrap) -> bool:
         return (wrap is None and python == sys.executable
                 and sys.platform.startswith("linux")
@@ -601,6 +621,16 @@ class NodeAgent:
         for p in self.procs:
             if p.poll() is None:
                 p.terminate()
+        # Zygote-forked workers (own sessions, not in self.procs): same
+        # terminate-then-kill guarantee, validated as LIVE children of
+        # the zygote before signalling (pid recycling safety).
+        live_forks = [p for p in self.zygote_pids
+                      if self._is_zygote_child(p)]
+        for pid in live_forks:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
         deadline = time.time() + 3
         for p in self.procs:
             if p.poll() is None:
@@ -608,6 +638,17 @@ class NodeAgent:
                     p.wait(max(0.0, deadline - time.time()))
                 except subprocess.TimeoutExpired:
                     p.kill()
+        for pid in live_forks:
+            if self._is_zygote_child(pid):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        z = self._zygote
+        if z is not None and z.poll() is None:
+            z.kill()
+        self._zygote = None
+        self.zygote_pids.clear()
 
 
 async def _orphan_watch(get_gcs):

@@ -2,31 +2,32 @@
 
 Reference: ``python/ray/runtime_context.py`` (``ray.get_runtime_context()``
 → node id, worker id, task id, actor id, assigned resources). Execution
-identity is tracked in a thread-local set by the executor around user
-code (sync paths run on pool threads; async actor methods set it per
-call on the loop via the same helper).
+identity is tracked in a contextvar set by the executor around user
+code: pool threads behave like locals, and each async actor call's
+asyncio.Task gets an isolated context (concurrent calls on one loop
+thread never see each other's identity).
 """
 
 from __future__ import annotations
 
-import threading
+import contextvars
 from typing import Dict, Optional
 
-_ctx = threading.local()
+# contextvars (not threading.local): async actor calls share the loop
+# thread but each asyncio.Task gets its own context, so concurrent calls
+# never read each other's identity; pool threads behave like locals.
+_exec_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_exec", default=None)
 
 
 def _set_execution(task_id: Optional[bytes] = None,
                    actor_id: Optional[bytes] = None,
                    resources: Optional[dict] = None):
-    _ctx.task_id = task_id
-    _ctx.actor_id = actor_id
-    _ctx.resources = resources or {}
+    _exec_ctx.set((task_id, actor_id, resources or {}))
 
 
 def _clear_execution():
-    _ctx.task_id = None
-    _ctx.actor_id = None
-    _ctx.resources = {}
+    _exec_ctx.set(None)
 
 
 class RuntimeContext:
@@ -51,15 +52,16 @@ class RuntimeContext:
         return self._worker().session_name or ""
 
     def get_task_id(self) -> Optional[str]:
-        tid = getattr(_ctx, "task_id", None)
-        return tid.hex() if tid else None
+        ctx = _exec_ctx.get()
+        return ctx[0].hex() if ctx and ctx[0] else None
 
     def get_actor_id(self) -> Optional[str]:
-        aid = getattr(_ctx, "actor_id", None)
-        return aid.hex() if aid else None
+        ctx = _exec_ctx.get()
+        return ctx[1].hex() if ctx and ctx[1] else None
 
     def get_assigned_resources(self) -> Dict[str, float]:
-        return dict(getattr(_ctx, "resources", {}) or {})
+        ctx = _exec_ctx.get()
+        return dict(ctx[2]) if ctx else {}
 
     @property
     def was_current_actor_reconstructed(self) -> bool:

@@ -552,16 +552,27 @@ class Worker:
             else:
                 # Zero-copy read: the arena pin transfers to the value's
                 # buffers and drops when they are garbage-collected.
+                pin_cb = view.transfer()
                 try:
-                    value = deserialize(view.data, pin=view.transfer())
+                    value = deserialize(view.data, pin=pin_cb)
                 except ValueError:
                     # Lost the race with eviction/spill: the index entry
                     # matched but the block was recycled before the pin
-                    # landed. The GCS relay restores from spill (or a
-                    # holder node) — the reference's object-recovery
-                    # retry path (object_recovery_manager.h:41).
+                    # landed (corrupt header => deserialize raised BEFORE
+                    # consuming the pin, so release it here). The GCS
+                    # relay restores from spill or a holder node — the
+                    # object-recovery retry path
+                    # (object_recovery_manager.h:41).
+                    try:
+                        pin_cb()
+                    except Exception:
+                        pass
                     view = self._pull_object(object_id)
-                    value = deserialize(memoryview(view))
+                    if isinstance(view, (bytes, bytearray, memoryview)):
+                        value = deserialize(memoryview(view))
+                    else:
+                        value = deserialize(view.data,
+                                            pin=view.transfer())
         if isinstance(value, serialization.DynamicReturns):
             # Dynamic generator task: primary return resolves to the
             # per-item ref generator (descriptor may be inline or shm).

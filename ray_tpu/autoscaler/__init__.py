@@ -21,7 +21,10 @@ def request_resources(*, num_cpus: int = 0, bundles=None):
 
     out = []
     if num_cpus:
-        out.append({"CPU": float(num_cpus)})
+        # Reference semantics: num_cpus means TOTAL CPUs (N one-CPU
+        # bundles), not one N-CPU slot — a single big bundle would be
+        # silently infeasible on smaller node types.
+        out.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
     for b in (bundles or []):
         out.append({k: float(v) for k, v in b.items()})
     global_worker().kv_put("requested", json.dumps(out).encode(),

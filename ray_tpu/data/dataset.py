@@ -492,6 +492,8 @@ class Dataset:
             raise ValueError("test_size must be in (0, 1)")
         ds = self.random_shuffle(seed=seed) if shuffle else self
         n = ds.count()
+        if n == 0:
+            raise ValueError("cannot train_test_split an empty dataset")
         n_test = max(1, int(n * test_size))
         return ds.split_at_indices([n - n_test])
 
@@ -501,11 +503,16 @@ class Dataset:
         Materializes block boundaries (row-accurate splits cannot be
         lazy over unknown block sizes)."""
         blocks = self._all_blocks()
-        rows = []
-        for b in blocks:
-            acc = BlockAccessor(b)
-            rows.append(acc.num_rows())
-        bounds = [0] + sorted(indices) + [sum(rows)]
+        rows = [BlockAccessor(b).num_rows() for b in blocks]
+        total = sum(rows)
+        if any(i < 0 or i > total for i in indices):
+            raise ValueError(
+                f"split indices {indices} out of range for {total} rows")
+        if not blocks or total == 0:
+            empty = to_block([])
+            return [Dataset([empty], [], self._remote_args)
+                    for _ in range(len(indices) + 1)]
+        bounds = [0] + sorted(indices) + [total]
         out: List[Dataset] = []
         for lo, hi in zip(bounds[:-1], bounds[1:]):
             picked = []
@@ -518,7 +525,8 @@ class Dataset:
                 if e > s:
                     picked.append(b.slice(s - b_lo, e - s))
             out.append(Dataset(picked if picked
-                               else [blocks[0].slice(0, 0)], []))
+                               else [blocks[0].slice(0, 0)], [],
+                               self._remote_args))
         return out
 
     def streaming_split(self, n: int, *, equal: bool = False,

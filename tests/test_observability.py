@@ -258,3 +258,26 @@ def test_runtime_context(ray_cluster):
     info = ray_tpu.get(a.who.remote())
     assert info["actor_id"] and info["task_id"]
     assert ray_tpu.get(a.awho.remote()) == info["actor_id"]
+
+
+def test_runtime_context_concurrent_async_isolation(ray_cluster):
+    """Concurrent async actor calls must each see their OWN task id
+    (contextvars, not thread-locals on the shared loop thread)."""
+    import ray_tpu
+
+    @ray_tpu.remote(max_concurrency=4)
+    class A:
+        async def slow_who(self):
+            import asyncio
+
+            c = ray_tpu.get_runtime_context()
+            before = c.get_task_id()
+            await asyncio.sleep(0.2)  # other calls interleave here
+            after = c.get_task_id()
+            return before, after
+
+    a = A.remote()
+    outs = ray_tpu.get([a.slow_who.remote() for _ in range(4)], timeout=60)
+    for before, after in outs:
+        assert before == after  # identity stable across awaits
+    assert len({b for b, _ in outs}) == 4  # all distinct task ids
