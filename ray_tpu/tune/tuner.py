@@ -307,12 +307,15 @@ class Tuner:
                 trial.last_result = result
                 if searcher is not None:
                     searcher.on_trial_result(tid, result)
-                if trial.state != "RUNNING":
-                    continue
                 record = getattr(scheduler, "record_config", None)
                 if record is not None:  # PB2 models (config -> delta)
                     record(tid, dict(trial.config))
                 decision = scheduler.on_result(tid, result)
+                if trial.state != "RUNNING":
+                    # Schedulers observe every report (fast trials can
+                    # finish before their reports drain), but decisions
+                    # only apply to live trials.
+                    continue
                 if decision == STOP:
                     trial.killed_by_scheduler = True
                     ray_tpu.kill(trial.actor)
