@@ -563,6 +563,13 @@ class GcsServer:
         self.loop_monitor = LoopMonitor(name="gcs").start()
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         asyncio.get_running_loop().create_task(self._health_check_loop())
+        # WAL-restored placement groups re-place once agents re-register:
+        # without this kick nothing ever schedules them and every
+        # PG-targeted task/actor would pend forever after a GCS restart.
+        for record in self.pgs.values():
+            if record.state == "pending":
+                asyncio.get_running_loop().call_later(
+                    0.2, self._retry_pg, record)
         if self.resumed:
             asyncio.get_running_loop().call_later(
                 max(0.0, self._adoption_deadline - time.time()),
@@ -736,6 +743,15 @@ class GcsServer:
                 if record.owner_wid == wid_b or (
                         prev is not None and prev.worker_id == worker_id):
                     record.owner = client
+            # Re-link leases the same way: lease return / driver-exit
+            # cleanup compare ClientConn identity, so leases bound to the
+            # pre-blip connection would otherwise leak their workers (and
+            # node resources) forever.
+            for w in self.workers.values():
+                lt = w.leased_to
+                if lt is not None and lt.worker_id == worker_id \
+                        and lt is not client:
+                    w.leased_to = client
         if client.worker_id is not None:
             self._client_by_wid[client.worker_id.binary()] = client
         client.conn.reply(msg, {
@@ -815,7 +831,12 @@ class GcsServer:
             else:
                 self._on_driver_exit(client)
         elif client.role == "agent" and client.node_id is not None:
-            self._on_node_death(client.node_id)
+            # Stale-socket guard (same as the worker path): a half-open
+            # old agent link closing AFTER the agent re-registered must
+            # not kill the live node.
+            node = self.nodes.get(client.node_id)
+            if node is None or node.agent_conn is client.conn:
+                self._on_node_death(client.node_id)
 
     # ------------------------------------------------------------- KV store
 
@@ -939,6 +960,18 @@ class GcsServer:
 
     def _mark_ready(self, entry: ObjectEntry, nbytes: int,
                     inline: Optional[bytes], on_shm: bool):
+        if entry.ready:
+            # Idempotence: lineage reconstruction re-marks every return of
+            # a resubmitted task, and the worker-death error path can race
+            # an already-registered result. Re-counting would inflate
+            # shm_bytes (triggering spurious eviction); overwriting a live
+            # shm entry with inline error bytes would strand its arena
+            # accounting. Keep the first registration.
+            for conn, req in entry.waiters:
+                if not conn.closed:
+                    conn.reply(req, self._obj_reply(entry))
+            entry.waiters.clear()
+            return
         entry.nbytes = nbytes
         entry.inline = inline
         entry.on_shm = on_shm
@@ -1216,6 +1249,29 @@ class GcsServer:
                 # Concurrent fan-out: one unresponsive node's timeout must
                 # not delay (or compound into) the others' checks.
                 await asyncio.gather(*(ping(n) for n in targets))
+
+    async def _h_lease_claim(self, client, msg):
+        """A resyncing driver re-claims leases it held across a GCS
+        restart: mark those workers leased (removing them from idle) and
+        charge their resources, restoring pre-restart accounting."""
+        for wid_b, res in msg.get("leases", []):
+            w = self.workers.get(WorkerID(bytes(wid_b)))
+            if w is None or w.conn.closed:
+                continue
+            if w.leased_to is not None and w.leased_to is not client:
+                continue  # already granted elsewhere: claimer loses
+            w.leased_to = client
+            node = self.nodes.get(w.node_id)
+            if node is not None:
+                try:
+                    node.idle_workers.remove(w.worker_id)
+                except ValueError:
+                    pass
+                if not w.acquired:
+                    w.acquired = {k: float(v) for k, v in
+                                  (res or {}).items()}
+                    _res_sub(node.avail, w.acquired)
+        self._wake_scheduler()
 
     async def _h_oom_candidates(self, client, msg):
         """Kill candidates on the asking agent's node for its memory
@@ -2122,9 +2178,56 @@ class GcsServer:
                     # Return only unconsumed capacity; consumed capacity is
                     # returned by the releasing tasks as they finish.
                     _res_add(node.avail, bundle)
+        # Pending work targeting the removed PG can never place: fail it
+        # now (the reference errors such tasks on PG removal) instead of
+        # leaving the owner's get() hanging forever.
+        pgid_b = pg_id.binary()
+        for sig, q in list(self.pending.qs.items()):
+            doomed = [r for r in q if getattr(r, "pg", None) is not None
+                      and (r.pg.binary() if hasattr(r.pg, "binary")
+                           else bytes(r.pg)) == pgid_b]
+            for r in doomed:
+                try:
+                    q.remove(r)
+                    self.pending.count -= 1
+                except ValueError:
+                    continue
+                self._fail_pending_for_removed_pg(r)
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
         self._wake_scheduler()
+
+    def _fail_pending_for_removed_pg(self, record):
+        from . import serialization
+
+        if isinstance(record, TaskRecord):
+            err = serialization.serialize(ValueError(
+                "task's placement group was removed")).to_bytes()
+            results = [{"oid": oid.binary(), "nbytes": len(err),
+                        "data": err} for oid in record.returns]
+            for r in results:
+                self._mark_ready(self._obj(ObjectID(r["oid"])),
+                                 r["nbytes"], r["data"], False)
+            record.state = "done"
+            record.ts_done = time.time()
+            record.error = True
+            self.counters["tasks_failed"] += 1
+            self._gc_done_task(record)
+            if not record.owner.conn.closed:
+                record.owner.conn.send(
+                    {"t": "task_done", "tid": record.task_id.binary(),
+                     "results": results})
+        elif isinstance(record, LeaseDemand):
+            # Void the demand so the lessee's queued tasks fail rather
+            # than waiting forever for a grant that can never come.
+            record.cancelled = True
+            if record.client is not None and not record.client.conn.closed:
+                try:
+                    record.client.conn.send(
+                        {"t": "lease_void", "key": record.key,
+                         "err": "placement group was removed"})
+                except ConnectionError:
+                    pass
 
     async def _h_pg_list(self, client, msg):
         out = [{"pgid": p.pg_id.binary(), "state": p.state, "name": p.name,

@@ -79,9 +79,15 @@ class ObjectRef:
 
     def __reduce__(self):
         # A serialized ref must be resolvable by the receiver: values held
-        # only in this process's memory store are promoted to the GCS first.
+        # only in this process's memory store are promoted to the GCS
+        # first. The borrow incref happens HERE on the sender (sent
+        # immediately, ahead of any message carrying the ref) — a
+        # receiver-side incref would leave a window where the owner drops
+        # its last ref and the object is evicted in transit. The
+        # receiver's wrapper queues the matching -1 when it dies.
         if self._worker is not None:
             self._worker.promote_on_serialize(self.id)
+            self._worker.send_ref_incref_now(self.id)
         return (_deserialize_object_ref, (self.id.binary(),))
 
     def __del__(self):
@@ -109,7 +115,9 @@ class ObjectRef:
 
 
 def _deserialize_object_ref(id_bytes: bytes) -> ObjectRef:
-    return ObjectRef(ObjectID(id_bytes), borrowed=True)
+    # borrowed=False: the SENDER already sent this copy's +1 at pickle
+    # time (ObjectRef.__reduce__); this wrapper's __del__ sends the -1.
+    return ObjectRef(ObjectID(id_bytes), borrowed=False)
 
 
 class ObjectRefGenerator:
@@ -404,6 +412,20 @@ class Worker:
             if not fut.done() and oid not in self._memory_store:
                 asyncio.run_coroutine_threadsafe(
                     self._wait_remote(oid, fut), self.loop)
+        if gcs_restarted:
+            # Re-claim leases this driver still holds: the fresh GCS
+            # re-registered resyncing workers as IDLE (their hello has no
+            # lease state — only the lessee knows), so without this claim
+            # it would double-book them under other drivers while we keep
+            # pushing work over the surviving direct connections.
+            claims = []
+            for cls in self._task_classes.values():
+                for lease in cls.leases.values():
+                    if not lease.dead:
+                        claims.append([lease.wid, cls.wire.get("res")
+                                       or {"CPU": 1.0}])
+            if claims:
+                self._send_gcs({"t": "lease_claim", "leases": claims})
         for cls in self._task_classes.values():
             cls.demand = 0
             self._pump_class(cls)
@@ -534,9 +556,18 @@ class Worker:
                 fut.set_result(("inline", reply["data"]))
             else:
                 fut.set_result(("shm", reply["nbytes"]))
-        except (ConnectionError, asyncio.CancelledError) as e:
+        except asyncio.CancelledError:
             if not fut.done():
-                fut.set_exception(ConnectionError(str(e)))
+                fut.set_exception(ConnectionError("wait cancelled"))
+        except ConnectionError:
+            # GCS link blip: leave the future PENDING — the reconnect
+            # resync re-subscribes every unresolved future on the fresh
+            # connection, and _reconnect_gcs fails them only after the
+            # whole retry window is exhausted. Failing here would turn a
+            # seconds-long control-plane restart into user-visible
+            # ConnectionErrors (and poison the cached future for later
+            # gets of the same ref).
+            pass
 
     def _resolve_value(self, object_id: ObjectID, where: str, payload) -> Any:
         if where == "inline":
@@ -576,8 +607,12 @@ class Worker:
         if isinstance(value, serialization.DynamicReturns):
             # Dynamic generator task: primary return resolves to the
             # per-item ref generator (descriptor may be inline or shm).
+            # borrowed=True: each wrapper queues -1 at GC, so each
+            # construction must queue its matching +1 (re-resolving the
+            # descriptor would otherwise underflow the GCS refcount).
             return ObjectRefGenerator(
-                [ObjectRef(ObjectID(b), self) for b in value.oids])
+                [ObjectRef(ObjectID(b), self, borrowed=True)
+                 for b in value.oids])
         if isinstance(value, TaskError):
             raise value.cause if isinstance(value.cause, Exception) else value
         if isinstance(value, Exception):
@@ -833,6 +868,17 @@ class Worker:
 
     # ---------------------------------------------------------------- tasks
 
+    def send_ref_incref_now(self, object_id: ObjectID):
+        """Immediate +1 for a pickled ref copy (see ObjectRef.__reduce__):
+        bypasses the 0.1s delta flush so it cannot lose the race with the
+        owner's decref while the message is in flight. The receiving
+        process's wrapper owns (and eventually decrefs) this count, so
+        local live-ref tracking here is untouched."""
+        if self.gcs is not None and not self.gcs.closed:
+            self.loop.call_soon_threadsafe(
+                self._send_gcs,
+                {"t": "ref", "d": [(object_id.binary(), 1)]})
+
     def promote_on_serialize(self, object_id: ObjectID):
         """Register a locally-held inline value with the GCS so a borrower
         can resolve the ref (lazy ownership promotion)."""
@@ -877,6 +923,18 @@ class Worker:
             self._on_lease_grant(msg)
         elif t == "lease_dead":
             self._on_lease_dead(msg)
+        elif t == "lease_void":
+            # The GCS voided our demand (e.g. the targeted placement
+            # group was removed): queued tasks of this class can never
+            # dispatch — fail them now instead of hanging.
+            cls = self._task_classes.get(msg.get("key"))
+            if cls is not None:
+                cls.demand = 0
+                while cls.queue:
+                    self._finish_item_error(
+                        cls.queue.popleft(),
+                        ValueError(msg.get("err",
+                                           "lease demand voided")))
         elif t == "obj_upload":
             # Serve our host store's bytes to the GCS object-transfer relay
             # (reference: object manager Push, object_manager.h:206).
@@ -1048,7 +1106,8 @@ class Worker:
             if lease.conn is None or lease.conn.closed:
                 continue
             while cls.queue and lease.busy < _LEASE_WINDOW:
-                self._send_exec(cls, lease, cls.queue.popleft())
+                if not self._send_exec(cls, lease, cls.queue.popleft()):
+                    break  # lease broke mid-pump: stop dispatching to it
             if not cls.queue and lease.busy == 0 and lease.idle_handle is None:
                 lease.idle_handle = self.loop.call_later(
                     _LEASE_IDLE_RETURN_S, self._return_lease, cls, lease)
@@ -1064,11 +1123,13 @@ class Worker:
                 self._send_gcs({"t": "lease_req", "key": cls.key,
                                 "n": want, **cls.wire})
 
-    def _send_exec(self, cls: _TaskClass, lease: _Lease, item: _TaskItem):
+    def _send_exec(self, cls: _TaskClass, lease: _Lease,
+                   item: _TaskItem) -> bool:
+        """Returns False when the lease broke (caller must stop using it)."""
         if item.cancelled:
             self._finish_item_error(
                 item, serialization.TaskCancelledError("cancelled"))
-            return
+            return True
         if lease.idle_handle is not None:
             lease.idle_handle.cancel()
             lease.idle_handle = None
@@ -1077,12 +1138,13 @@ class Worker:
         except ConnectionError:
             cls.queue.appendleft(item)
             self._on_lease_broken(cls, lease)
-            return
+            return False
         lease.busy += 1
         self._inflight[item.msg["tid"]] = ("inflight", cls, lease, item)
         fut.add_done_callback(
             lambda f, c=cls, l=lease, it=item: self._on_exec_reply(f, c, l,
                                                                    it))
+        return True
 
     def _on_exec_reply(self, fut: asyncio.Future, cls: _TaskClass,
                        lease: _Lease, item: _TaskItem):

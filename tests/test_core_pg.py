@@ -64,3 +64,30 @@ def test_pg_table(ray_cluster):
     table = placement_group_table()
     assert any(v["name"] == "table-test" for v in table.values())
     remove_placement_group(pg)
+
+
+def test_removed_pg_fails_pending_tasks(ray_cluster):
+    """Tasks targeting a PG that gets removed must FAIL, not hang
+    (reference: Ray errors such tasks on PG removal)."""
+    import pytest as _pytest
+
+    import ray_tpu
+    from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                              placement_group, remove_placement_group)
+
+    # an infeasible PG: stays pending; tasks targeting it queue forever
+    pg = placement_group([{"CPU": 64.0}])
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ref = f.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg,
+        placement_group_bundle_index=0)).remote()
+    import time
+
+    time.sleep(0.5)  # let it reach the pending queue
+    remove_placement_group(pg)
+    with _pytest.raises(Exception, match="placement group|voided"):
+        ray_tpu.get(ref, timeout=30)
