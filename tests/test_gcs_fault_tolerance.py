@@ -116,3 +116,38 @@ def test_placement_group_records_survive(cluster):
     names = [p.get("name") for p in reply.get("pgs", [])]
     assert "ft_pg" in names
     remove_placement_group(pg)
+
+
+def test_restored_pg_reschedules_and_is_usable(cluster):
+    """A PG restored from the WAL must be RE-PLACED after the restart
+    (not stuck 'pending' forever) so tasks targeting it still run."""
+    from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                              placement_group, remove_placement_group)
+
+    pg = placement_group([{"CPU": 1.0}], name="resched_pg")
+    assert pg.wait(10)
+    _restart_gcs()
+
+    # the restored record must become ready again once agents resync
+    w = global_worker()
+    deadline = time.time() + 30
+    state = None
+    while time.time() < deadline:
+        reply = w.request_gcs({"t": "pg_list"})
+        state = {p.get("name"): p.get("state")
+                 for p in reply.get("pgs", [])}.get("resched_pg")
+        if state == "ready":
+            break
+        time.sleep(0.3)
+    assert state == "ready", f"restored PG stuck in {state!r}"
+
+    @ray_tpu.remote
+    def inside():
+        return "placed"
+
+    out = ray_tpu.get(inside.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=0)).remote(), timeout=60)
+    assert out == "placed"
+    remove_placement_group(pg)
