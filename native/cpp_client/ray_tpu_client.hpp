@@ -575,6 +575,99 @@ class Client {
     sock_.send_frame(p.out);
   }
 
+  // ------------------------------------------------------------- objects
+  // Standalone object put/get (reference: cpp/include/ray/api/object_ref.h
+  // Put/Get). Values are stored in the LANGUAGE-NEUTRAL object framing:
+  //   u32 header_len | msgpack {"x": <msgpack payload>, "o": [], "l": []}
+  // — the same container Python's serializer uses, with the pickle field
+  // ("p") replaced by a msgpack field ("x") both sides can read
+  // (Python: serialization.deserialize; Python puts for C++ readers via
+  // ray_tpu.cross_language.put_xlang).
+
+  // Store a value; returns the 20-byte object id (TaskID(16)+index(4)).
+  std::string put(const Value& v) {
+    Packer payload;
+    payload.pack_value(v);
+    Packer header;
+    header.pack_map_header(3);
+    header.pack_str("x"); header.pack_bin(payload.out);
+    header.pack_str("o"); header.pack_array_header(0);
+    header.pack_str("l"); header.pack_array_header(0);
+    std::string blob(4, '\0');
+    uint32_t hlen = static_cast<uint32_t>(header.out.size());
+    blob[0] = static_cast<char>(hlen & 0xff);
+    blob[1] = static_cast<char>((hlen >> 8) & 0xff);
+    blob[2] = static_cast<char>((hlen >> 16) & 0xff);
+    blob[3] = static_cast<char>((hlen >> 24) & 0xff);
+    blob += header.out;
+
+    std::string oid = detail::random_bytes(16) + std::string(4, '\0');
+    Packer p;
+    p.pack_map_header(5);
+    p.pack_str("t"); p.pack_str("obj_put");
+    p.pack_str("oid"); p.pack_bin(oid);
+    p.pack_str("nbytes"); p.pack_int(static_cast<int64_t>(blob.size()));
+    p.pack_str("data"); p.pack_bin(blob);
+    p.pack_str("i"); p.pack_int(next_id());
+    Value reply = sock_.request(p.out, last_id_);
+    const Value* ok = reply.get("ok");
+    if (!ok || !ok->b) throw std::runtime_error("obj_put failed");
+    return oid;
+  }
+
+  // Fetch an object by id. Reads the xlang framing; objects written by
+  // Python's cloudpickle path (no "x" field) raise — use
+  // cross_language.put_xlang on the Python side for C++-readable values.
+  Value get(const std::string& oid, double timeout_s = 60.0) {
+    Packer p;
+    p.pack_map_header(3);
+    p.pack_str("t"); p.pack_str("obj_wait");
+    p.pack_str("oid"); p.pack_bin(oid);
+    p.pack_str("i"); p.pack_int(next_id());
+    Value reply = sock_.request(p.out, last_id_, timeout_s);
+    const Value* data = reply.get("data");
+    std::string blob;
+    if (data && !data->is_nil()) {
+      blob = data->s;
+    } else {
+      // Shared-memory object: relay the raw bytes through the GCS
+      // (obj_pull — the Ray-Client remote-driver path).
+      Packer q;
+      q.pack_map_header(3);
+      q.pack_str("t"); q.pack_str("obj_pull");
+      q.pack_str("oid"); q.pack_bin(oid);
+      q.pack_str("i"); q.pack_int(next_id());
+      Value pulled = sock_.request(q.out, last_id_, timeout_s);
+      const Value* ok = pulled.get("ok");
+      const Value* pdata = pulled.get("data");
+      if (!ok || !ok->b || !pdata)
+        throw std::runtime_error("obj_pull failed");
+      blob = pdata->s;
+    }
+    return decode_object_blob(blob);
+  }
+
+  static Value decode_object_blob(const std::string& blob) {
+    if (blob.size() < 4) throw std::runtime_error("short object blob");
+    uint32_t hlen = static_cast<uint8_t>(blob[0]) |
+                    (static_cast<uint8_t>(blob[1]) << 8) |
+                    (static_cast<uint8_t>(blob[2]) << 16) |
+                    (static_cast<uint8_t>(blob[3]) << 24);
+    // Subtract, don't add: `4 + hlen` wraps for hlen >= 2^32-4 and a
+    // corrupt header would pass the guard into an OOB read.
+    if (static_cast<size_t>(hlen) > blob.size() - 4)
+      throw std::runtime_error("corrupt object blob");
+    Unpacker u(blob.data() + 4, hlen);
+    Value header = u.unpack();
+    const Value* x = header.get("x");
+    if (!x)
+      throw std::runtime_error(
+          "object is python-pickled; store it with "
+          "ray_tpu.cross_language.put_xlang for C++ readers");
+    Unpacker pu(x->s.data(), x->s.size());
+    return pu.unpack();
+  }
+
   static Value make_int(int64_t v) {
     Value x; x.type = Value::INT; x.i = v; return x;
   }
@@ -594,6 +687,204 @@ class Client {
   int64_t next_id() {
     last_id_ = ++id_counter_;
     return last_id_;
+  }
+};
+
+// --------------------------------------------------------------- executor
+// C++ task EXECUTION (reference: the C++ worker runtime,
+// cpp/src/ray/runtime/task/task_executor.cc): register C++ functions,
+// serve a direct channel, and answer Python drivers' xlang calls —
+// Python's ray_tpu.cross_language.cpp_function(name) resolves this
+// worker's address from the KV store and calls straight into it.
+class Worker {
+ public:
+  using Fn = Value (*)(const std::vector<Value>&);
+
+  Worker(const std::string& gcs_address, const std::string& name)
+      : client_(gcs_address), name_(name) {}
+
+  void register_function(const std::string& fn_name, Fn fn) {
+    fns_[fn_name] = fn;
+  }
+
+  // Bind the direct-channel socket and advertise it in the KV store
+  // (namespace "cppw"), then serve calls until the process is killed or
+  // `max_calls` calls were handled (handy for tests; -1 = forever).
+  // select()-multiplexed: many Python callers may hold connections open
+  // concurrently (each CppFunction proxy keeps its own).
+  void serve(const std::string& socket_path, int max_calls = -1) {
+    listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    ::unlink(socket_path.c_str());
+    std::strncpy(addr.sun_path, socket_path.c_str(),
+                 sizeof(addr.sun_path) - 1);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 16) != 0)
+      throw std::runtime_error("bind/listen failed: " + socket_path);
+    client_.kv_put(name_, "unix:" + socket_path, "cppw");
+
+    std::vector<int> clients;
+    int handled = 0;
+    while (max_calls < 0 || handled < max_calls) {
+      fd_set rfds;
+      FD_ZERO(&rfds);
+      FD_SET(listen_fd_, &rfds);
+      int maxfd = listen_fd_;
+      for (int fd : clients) {
+        FD_SET(fd, &rfds);
+        if (fd > maxfd) maxfd = fd;
+      }
+      if (::select(maxfd + 1, &rfds, nullptr, nullptr, nullptr) <= 0)
+        break;
+      if (FD_ISSET(listen_fd_, &rfds)) {
+        int cfd = ::accept(listen_fd_, nullptr, nullptr);
+        if (cfd >= 0) clients.push_back(cfd);
+      }
+      for (size_t k = 0; k < clients.size();) {
+        int fd = clients[k];
+        if (!FD_ISSET(fd, &rfds)) {
+          ++k;
+          continue;
+        }
+        try {
+          Value msg = read_frame_fd(fd);
+          handled += handle_call(fd, msg);
+          ++k;
+        } catch (const std::exception&) {
+          ::close(fd);
+          clients.erase(clients.begin() + static_cast<long>(k));
+        }
+        if (max_calls >= 0 && handled >= max_calls) break;
+      }
+    }
+    for (int fd : clients) ::close(fd);
+    ::close(listen_fd_);
+  }
+
+ private:
+  Client client_;
+  std::string name_;
+  std::map<std::string, Fn> fns_;
+  int listen_fd_ = -1;
+
+  static Value read_frame_fd(int fd) {
+    char hdr[4];
+    read_all_fd(fd, hdr, 4);
+    uint32_t len = static_cast<uint8_t>(hdr[0]) |
+                   (static_cast<uint8_t>(hdr[1]) << 8) |
+                   (static_cast<uint8_t>(hdr[2]) << 16) |
+                   (static_cast<uint8_t>(hdr[3]) << 24);
+    std::string payload(len, '\0');
+    read_all_fd(fd, payload.data(), len);
+    Unpacker u(payload.data(), payload.size());
+    return u.unpack();
+  }
+
+  static void read_all_fd(int fd, char* data, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::read(fd, data, n);
+      if (r <= 0) throw std::runtime_error("peer closed");
+      data += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  static void write_frame_fd(int fd, const std::string& payload) {
+    uint32_t len = static_cast<uint32_t>(payload.size());
+    char hdr[4];
+    hdr[0] = static_cast<char>(len & 0xff);
+    hdr[1] = static_cast<char>((len >> 8) & 0xff);
+    hdr[2] = static_cast<char>((len >> 16) & 0xff);
+    hdr[3] = static_cast<char>((len >> 24) & 0xff);
+    std::string out(hdr, 4);
+    out += payload;
+    const char* p = out.data();
+    size_t left = out.size();
+    while (left > 0) {
+      ssize_t w = ::write(fd, p, left);
+      if (w <= 0) throw std::runtime_error("peer write failed");
+      p += w;
+      left -= static_cast<size_t>(w);
+    }
+  }
+
+  int handle_call(int fd, const Value& msg) {
+    const Value* t = msg.get("t");
+    if (t && t->s == "ping") {
+      reply_map(fd, msg, {{"ok", true_val()}});
+      return 0;
+    }
+    if (!t || t->s != "actor_call") return 0;
+    const Value* m = msg.get("m");
+    const Value* args = msg.get("args");
+    Value result;
+    bool failed = false;
+    std::string err;
+    auto it = m ? fns_.find(m->s) : fns_.end();
+    if (it == fns_.end()) {
+      failed = true;
+      err = "no such C++ function: " + (m ? m->s : std::string("?"));
+    } else {
+      try {
+        std::vector<Value> argv;
+        if (args && !args->s.empty()) {
+          Unpacker u(args->s.data(), args->s.size());
+          Value arr = u.unpack();
+          argv = arr.arr;
+        }
+        result = it->second(argv);
+      } catch (const std::exception& e) {
+        failed = true;
+        err = e.what();
+      }
+    }
+    Packer inner;
+    if (failed) {
+      inner.pack_map_header(1);
+      inner.pack_str("__xlang_error__");
+      inner.pack_str(err);
+    } else {
+      inner.pack_value(result);
+    }
+    // Reply in the task_done/results shape callers already parse.
+    Packer p;
+    p.pack_map_header(3);
+    p.pack_str("i");
+    const Value* rid = msg.get("i");
+    p.pack_int(rid ? rid->i : 0);
+    p.pack_str("r"); p.pack_int(1);
+    p.pack_str("results");
+    p.pack_array_header(1);
+    p.pack_map_header(3);
+    p.pack_str("oid");
+    const Value* tid = msg.get("tid");
+    p.pack_bin((tid ? tid->s : detail::random_bytes(16)) +
+               std::string(4, '\0'));
+    p.pack_str("nbytes"); p.pack_int(static_cast<int64_t>(inner.out.size()));
+    p.pack_str("data"); p.pack_bin(inner.out);
+    write_frame_fd(fd, p.out);
+    return 1;
+  }
+
+  static Value true_val() {
+    Value v; v.type = Value::BOOL; v.b = true; return v;
+  }
+
+  void reply_map(int fd, const Value& req,
+                 std::map<std::string, Value> fields) {
+    Packer p;
+    p.pack_map_header(static_cast<uint32_t>(fields.size() + 2));
+    p.pack_str("i");
+    const Value* rid = req.get("i");
+    p.pack_int(rid ? rid->i : 0);
+    p.pack_str("r"); p.pack_int(1);
+    for (const auto& kv : fields) {
+      p.pack_str(kv.first);
+      p.pack_value(kv.second);
+    }
+    write_frame_fd(fd, p.out);
   }
 };
 

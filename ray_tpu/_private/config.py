@@ -27,6 +27,7 @@ class RayTpuConfig:
     max_leases_per_class: int = 64
     lease_idle_return_s: float = 0.25
     task_pool_threads: int = 8      # concurrent plain tasks per worker
+    max_inflight_spawns: int = 16   # concurrent worker spawns per node
     # ---- object store
     store_capacity: int = 2 << 30   # logical capacity before evict/spill
     arena_bytes: int = 4 << 30      # shm arena size (sparse)

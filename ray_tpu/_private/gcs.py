@@ -33,6 +33,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import protocol
+from .config import config as _cfg
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .object_store import make_store
 
@@ -368,6 +369,12 @@ class GcsServer:
         self.workers: Dict[WorkerID, WorkerInfo] = {}
         self.tasks: Dict[TaskID, TaskRecord] = {}
         self.pending = PendingQueues()
+        # Actors awaiting an idle worker (insertion-ordered). Placement is
+        # event-driven: worker hellos wake the scheduler, which drains this
+        # map first — no per-actor poll timers, and worker-spawn requests
+        # are batched by the aggregate waiting demand (reference:
+        # prestart-by-demand, worker_pool.h:174).
+        self._actor_pending_place: Dict[ActorID, ActorRecord] = {}
         self.objects: Dict[ObjectID, ObjectEntry] = {}
         self.zero_ref_lru: "OrderedDict[ObjectID, int]" = OrderedDict()
         self.shm_bytes = 0
@@ -394,7 +401,6 @@ class GcsServer:
         self._client_by_wid: Dict[bytes, ClientConn] = {}
         # Observability stores (reference: GcsTaskManager task-event store
         # gcs_task_manager.h:86; metrics agent metrics_agent.py). Both bounded.
-        from .config import config as _cfg
 
         self._done_tasks: deque = deque()  # TaskID, GC'd beyond max
         # Structured export events (reference: util/event.h RayEvent):
@@ -1453,13 +1459,17 @@ class GcsServer:
 
     async def _h_spawn_failed(self, client, msg):
         """Agent could not spawn a worker (e.g. venv build failure):
-        release the spawning slot so the pool doesn't wedge."""
+        release the spawning slot so the pool doesn't wedge, and re-run a
+        scheduling pass — parked actors / queued work re-request their
+        worker through the freed slot (the event-driven replacement for
+        the old 0.05s per-actor retry poll)."""
         node = self.nodes.get(NodeID(msg["node_id"]))
         if node is not None:
             node.spawning = max(0, node.spawning - 1)
         logger.warning("worker spawn failed on %s: %s",
                        msg.get("node_id", b"").hex()[:8] if msg.get("node_id")
                        else "?", msg.get("err"))
+        self._wake_scheduler()
 
     async def _h_lease_ret(self, client, msg):
         """A driver returns a leased worker; it becomes schedulable again."""
@@ -1596,6 +1606,10 @@ class GcsServer:
         node, or no idle worker) is skipped wholesale for the rest of the
         pass — its per-task state never needs re-examination.
         """
+        # Parked actors first: dedicated workers, and idle workers freed
+        # by finished tasks should prefer waiting actors (FIFO by park
+        # order) before new task dispatch claims them.
+        self._place_parked_actors()
         deficit: Dict[tuple, tuple] = {}  # (node, env) -> (count, spec)
         qs = self.pending.qs
         active = list(qs.keys())
@@ -1684,27 +1698,32 @@ class GcsServer:
         return None
 
     def _request_worker(self, node: NodeInfo, demand: int = 1,
-                        env_key: str = "", env_spec=None):
+                        env_key: str = "", env_spec=None,
+                        dedicated: int = 0):
         """Ask the node agent to spawn workers to cover ``demand`` waiting
         consumers.
 
         Pool-size policy (reference: ``raylet/worker_pool.h:174`` prestart +
         on-demand growth): actor workers are dedicated and don't count
         against the pool cap; the cap bounds task workers at CPU total plus
-        headroom. ``node.spawning`` tracks in-flight spawns so repeated
+        headroom, while ``dedicated`` (actors waiting for a worker of this
+        class) raises it — an actor launch storm must not be throttled to
+        the CPU count. ``node.spawning`` tracks in-flight spawns so repeated
         scheduling passes never stampede the host with interpreter startups.
         """
         actor_workers = sum(
             1 for wid in node.workers
             if (w := self.workers.get(wid)) is not None and w.state == W_ACTOR)
-        cap = max(int(node.total.get("CPU", 1)), 1) + 2 + actor_workers
+        cap = (max(int(node.total.get("CPU", 1)), 1) + 2 + actor_workers
+               + dedicated)
         if node.agent_conn is None or node.agent_conn.closed:
             return
         spawn_msg: Dict[str, Any] = {"t": "spawn_worker"}
         if env_spec is not None:
             spawn_msg["env_spec"] = env_spec
             spawn_msg["env_key"] = env_key
-        while (node.spawning < min(demand, 4)
+        inflight_cap = _cfg().max_inflight_spawns
+        while (node.spawning < min(demand, inflight_cap)
                and len(node.workers) + node.spawning < cap):
             node.spawning += 1
             node.agent_conn.send(spawn_msg)
@@ -1884,24 +1903,39 @@ class GcsServer:
         client.conn.reply(msg, {"ok": True})
         self._try_place_actor(record)
 
-    def _try_place_actor(self, record: ActorRecord):
+    def _actor_pick_node(self, record: ActorRecord) -> Optional[NodeInfo]:
         fake_task = type("T", (), {})()
         fake_task.pg = record.pg
         fake_task.bundle = record.bundle
         fake_task.resources = record.resources
         fake_task.strategy = (record.msg.get("opts") or {}).get("sched") or "DEFAULT"
-        node = self._pick_node(fake_task)
+        return self._pick_node(fake_task)
+
+    def _try_place_actor(self, record: ActorRecord):
+        self._actor_pending_place.pop(record.actor_id, None)
+        node = self._actor_pick_node(record)
         if node is None:
+            # Infeasible right now (node down / PG not ready): poll until a
+            # node qualifies — feasibility changes aren't all worker events.
             asyncio.get_running_loop().call_later(
                 0.05, self._retry_place_actor, record)
             return
         worker = self._grab_idle_worker(node, record.env_key)
         if worker is None:
-            self._request_worker(node, env_key=record.env_key,
-                                 env_spec=record.env_spec)
-            asyncio.get_running_loop().call_later(
-                0.05, self._retry_place_actor, record)
+            # Feasible but no idle worker: park — the worker-hello wake
+            # drains parked actors, and the scheduler pass batches one
+            # spawn request for the aggregate parked demand. The picked
+            # node is remembered so later passes with zero idle workers
+            # can aggregate demand without re-running placement per
+            # parked actor per wake (O(parked^2) across a launch storm).
+            record.park_node = node.node_id
+            self._actor_pending_place[record.actor_id] = record
+            self._wake_scheduler()
             return
+        self._bind_actor_worker(record, node, worker)
+
+    def _bind_actor_worker(self, record: ActorRecord, node: NodeInfo,
+                           worker: WorkerInfo):
         worker.state = W_ACTOR
         worker.actor_id = record.actor_id
         worker.acquired = self._acquire(node, record)
@@ -1913,8 +1947,59 @@ class GcsServer:
         worker.conn.send(fwd)
 
     def _retry_place_actor(self, record: ActorRecord):
-        if record.state in (A_PENDING, A_RESTARTING):
+        if (record.state in (A_PENDING, A_RESTARTING)
+                and record.actor_id not in self._actor_pending_place):
             self._try_place_actor(record)
+
+    def _place_parked_actors(self):
+        """Drain actors parked for an idle worker; batch spawn requests for
+        whatever stays parked (one request per (node, env) with the full
+        waiting count, not one per actor per retry tick).
+
+        Placement (``_actor_pick_node``) only runs while idle workers
+        remain claimable; once the pool is dry the rest of the queue is
+        aggregated by its remembered park node — a launch storm of N
+        actors costs O(N) per pass, not O(N) placements per wake."""
+        if not self._actor_pending_place:
+            return
+        demand: Dict[tuple, tuple] = {}  # (node_id, env_key) -> (n, spec)
+        idle_left = sum(len(n.idle_workers) for n in self.nodes.values()
+                        if n.alive)
+        for record in list(self._actor_pending_place.values()):
+            if record.state not in (A_PENDING, A_RESTARTING):
+                self._actor_pending_place.pop(record.actor_id, None)
+                continue
+            if idle_left <= 0:
+                park_id = getattr(record, "park_node", None)
+                node = self.nodes.get(park_id) if park_id else None
+                if node is not None and node.alive:
+                    key = (node.node_id, record.env_key)
+                    cnt, _ = demand.get(key, (0, None))
+                    demand[key] = (cnt + 1, record.env_spec)
+                    continue
+                # Park node gone: fall through to a real placement pass.
+            node = self._actor_pick_node(record)
+            if node is None:
+                # Became infeasible while parked: fall back to the poll.
+                self._actor_pending_place.pop(record.actor_id, None)
+                asyncio.get_running_loop().call_later(
+                    0.05, self._retry_place_actor, record)
+                continue
+            record.park_node = node.node_id
+            worker = self._grab_idle_worker(node, record.env_key)
+            if worker is None:
+                key = (node.node_id, record.env_key)
+                cnt, _ = demand.get(key, (0, None))
+                demand[key] = (cnt + 1, record.env_spec)
+                continue
+            idle_left -= 1
+            self._actor_pending_place.pop(record.actor_id, None)
+            self._bind_actor_worker(record, node, worker)
+        for (node_id, env_key), (n, env_spec) in demand.items():
+            node = self.nodes.get(node_id)
+            if node is not None:
+                self._request_worker(node, demand=n, env_key=env_key,
+                                     env_spec=env_spec, dedicated=n)
 
     async def _h_actor_ready(self, client, msg):
         aid = ActorID(msg["aid"])
@@ -2022,6 +2107,7 @@ class GcsServer:
             self._cleanup_dead_actor(record)
 
     def _cleanup_dead_actor(self, record: ActorRecord):
+        self._actor_pending_place.pop(record.actor_id, None)
         self._log_append("actord", record.actor_id.binary())
         self._pub_actor(record, "dead")
         for conn, req in record.addr_waiters:
@@ -2480,23 +2566,52 @@ class GcsServer:
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
         self.restart_requested = True
-        await asyncio.sleep(0.02)  # let the reply flush
-        self._shutdown_event.set()
+
+        async def _teardown():
+            # Tear connections down BEFORE signalling the supervisor:
+            # after the restart reply, no request may be served by the
+            # dying instance (a client that got a reply in the gap would
+            # believe it had reconnected to the fresh one). Runs as its
+            # own task — this handler lives inside the requesting
+            # connection's read loop, and stop_serving closes that very
+            # connection (cancelling the loop, and the handler with it).
+            await asyncio.sleep(0.02)  # let the reply flush
+            await self.stop_serving()
+            self._shutdown_event.set()
+
+        asyncio.get_running_loop().create_task(_teardown())
 
     async def stop_serving(self):
-        """Close listeners and all client connections (restart path)."""
-        for srv in [self._server, *getattr(self, "_extra_servers", [])]:
+        """Close listeners and all client connections (restart path).
+
+        Order matters on Python >= 3.12.1: ``Server.wait_closed()`` waits
+        for every ACCEPTED TRANSPORT to close, not just the listener — so
+        client connections must be torn down first or the supervisor
+        deadlocks here and the fresh instance never starts (found via
+        test_gcs_fault_tolerance hanging after a chaos restart).
+
+        Idempotent: the restart teardown task and the supervisor both call
+        it."""
+        if getattr(self, "_stopped_serving", False):
+            return
+        self._stopped_serving = True
+        servers = [self._server, *getattr(self, "_extra_servers", [])]
+        for srv in servers:
             if srv is not None:
-                srv.close()
-                try:
-                    await srv.wait_closed()
-                except Exception:
-                    pass
+                srv.close()  # stop accepting; don't await yet
         for client in list(self.clients):
             try:
                 await client.conn.close()
             except Exception:
                 pass
+        for srv in servers:
+            if srv is not None:
+                try:
+                    # Bounded: a transport wedged in close must not stall
+                    # the restart (the listener socket is already closed).
+                    await asyncio.wait_for(srv.wait_closed(), timeout=5.0)
+                except Exception:
+                    pass
         if self.log is not None:
             self.log.close()
         if self._event_file:

@@ -153,31 +153,28 @@ _HEAD_BOOTSTRAP = (
 # taken further): ONE process pays the interpreter+import cost, then
 # forks ~10ms children on demand — the actor/worker launch floor drops
 # ~20x. Request protocol: one JSON line per spawn on stdin, child pid
-# replied on stdout. Fork safety: the template runs no event loop and no
-# threads; children setsid, redirect stdio to their log, and enter the
-# normal worker main.
+# replied on stdout; requests PIPELINE (the agent writes a whole burst,
+# then collects the pids), so a 200-actor launch storm isn't serialized
+# on one handshake round-trip per fork. Fork safety: the template runs no
+# event loop and no threads; SIGCHLD=SIG_IGN auto-reaps exited children
+# (children restore SIG_DFL before entering worker main so user
+# subprocesses still wait()); children setsid, redirect stdio to their
+# log, and enter the normal worker main.
 _ZYGOTE_BOOTSTRAP = """
-import json, os, select, sys
+import json, os, signal, sys
 sys.path[:0] = os.environ['RAY_TPU_SYS_PATH'].split(os.pathsep)
 import ray_tpu._private.worker_main as wm
+import ray_tpu._private.node         # noqa: F401 (pre-import for forks)
+import ray_tpu._private.jax_platform  # noqa: F401
+signal.signal(signal.SIGCHLD, signal.SIG_IGN)
 sys.stdout.write("READY\\n"); sys.stdout.flush()
-while True:
-    r, _, _ = select.select([sys.stdin], [], [], 1.0)
-    try:
-        while True:
-            pid, _ = os.waitpid(-1, os.WNOHANG)
-            if pid == 0:
-                break
-    except ChildProcessError:
-        pass
-    if not r:
+for line in sys.stdin:
+    if not line.strip():
         continue
-    line = sys.stdin.readline()
-    if not line:
-        break
     req = json.loads(line)
     pid = os.fork()
     if pid == 0:
+        signal.signal(signal.SIGCHLD, signal.SIG_DFL)
         os.setsid()
         for k, v in req.get("env", {}).items():
             os.environ[k] = v
@@ -186,10 +183,7 @@ while True:
         log = open(req["log"], "ab", 0)
         os.dup2(log.fileno(), 1)
         os.dup2(log.fileno(), 2)
-        sys.argv = ["worker", "--gcs", req["gcs"],
-                    "--node-id", req["node_id"],
-                    "--session-dir", req["session_dir"]]
-        wm.main()
+        wm.main_from_req(req)
         os._exit(0)
     sys.stdout.write(str(pid) + "\\n"); sys.stdout.flush()
 """
@@ -238,7 +232,9 @@ class NodeAgent:
         self.obj_addr: Optional[str] = None
         self._store = None
         self._zygote: Optional[subprocess.Popen] = None
-        self._zygote_lock = None  # threading.Lock, created lazily
+        self._zygote_rbuf = b""   # raw pid-line read buffer (spawner thread)
+        self._spawn_q = None      # queue.SimpleQueue, created lazily
+        self._spawner = None      # spawner thread owning the zygote pipe
         self.zygote_pids: set = set()
 
     async def start(self):
@@ -272,6 +268,10 @@ class NodeAgent:
             if usage < threshold:
                 continue
             now = time.time()
+            # Exclusion lasts one cooldown window, not forever: a recycled
+            # pid must become a candidate again once its kill has settled.
+            recently_killed = {p: t for p, t in recently_killed.items()
+                               if now - t < cooldown}
             if any(now - ts < cooldown for ts in recently_killed.values()):
                 # A kill is still settling (teardown + GCS catching up):
                 # don't cascade onto healthy workers.
@@ -306,9 +306,6 @@ class NodeAgent:
             except (ProcessLookupError, PermissionError):
                 continue
             recently_killed[victim] = time.time()
-            if len(recently_killed) > 100:
-                recently_killed = {p: t for p, t in recently_killed.items()
-                                   if time.time() - t < 60}
             try:
                 self.conn.send({"t": "oom_kill_report", "pid": victim,
                                 "usage": usage, "rss": rss})
@@ -508,68 +505,169 @@ class NodeAgent:
                 and sys.platform.startswith("linux")
                 and os.environ.get("RAY_TPU_ZYGOTE", "1") != "0")
 
+    def _pipe_read_line(self, timeout: float) -> str:
+        """Read one line from the zygote's stdout with a deadline.
+
+        Raw ``os.read`` + own buffer — a buffered file object would hide
+        already-read lines from ``select`` and a healthy template could be
+        declared wedged. Spawner thread only."""
+        import select
+
+        z = self._zygote
+        fd = z.stdout.fileno()
+        deadline = time.time() + timeout
+        while b"\n" not in self._zygote_rbuf:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError("zygote pipe read timed out")
+            r, _, _ = select.select([fd], [], [], remaining)
+            if not r:
+                raise TimeoutError("zygote pipe read timed out")
+            chunk = os.read(fd, 4096)
+            if not chunk:
+                raise OSError("zygote pipe EOF")
+            self._zygote_rbuf += chunk
+        line, self._zygote_rbuf = self._zygote_rbuf.split(b"\n", 1)
+        return line.decode()
+
     def _ensure_zygote(self) -> Optional[subprocess.Popen]:
-        import threading
-
-        if self._zygote_lock is None:
-            self._zygote_lock = threading.Lock()
-        with self._zygote_lock:
-            z = self._zygote
-            if z is not None and z.poll() is None:
-                return z
-            env = dict(os.environ)
-            env.update(self.env_overrides)
-            env["RAY_TPU_SYS_PATH"] = worker_sys_path()
-            try:
-                z = subprocess.Popen(
-                    [sys.executable, "-S", "-c", _ZYGOTE_BOOTSTRAP],
-                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                    stderr=open(os.path.join(self.session_dir,
-                                             "zygote.out"), "ab"),
-                    env=env, text=True, bufsize=1)
-                ready = z.stdout.readline()
-                if ready.strip() != "READY":
-                    raise RuntimeError(f"zygote bootstrap said {ready!r}")
-            except Exception:
-                self._zygote = None
-                return None
-            self._zygote = z
+        """Start (or return) the zygote template. Runs ONLY on the spawner
+        thread — the agent's event loop never touches the zygote pipe, so a
+        stalled bootstrap can't wedge health-check replies (the GCS would
+        declare the whole node dead)."""
+        z = self._zygote
+        if z is not None and z.poll() is None:
             return z
+        env = dict(os.environ)
+        env.update(self.env_overrides)
+        env["RAY_TPU_SYS_PATH"] = worker_sys_path()
+        try:
+            z = subprocess.Popen(
+                [sys.executable, "-S", "-c", _ZYGOTE_BOOTSTRAP],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=open(os.path.join(self.session_dir,
+                                         "zygote.out"), "ab"),
+                env=env, bufsize=0)
+            self._zygote = z
+            self._zygote_rbuf = b""
+            ready = self._pipe_read_line(30.0)
+            if ready.strip() != "READY":
+                raise RuntimeError(f"zygote bootstrap said {ready!r}")
+        except Exception:
+            if z is not None and z.poll() is None:
+                z.kill()
+            self._zygote = None
+            return None
+        return z
 
-    def _spawn_via_zygote(self, env_key: str) -> bool:
-        """Fork a worker from the pre-imported template (~10ms vs ~300ms
-        cold start). Returns False to fall back to a cold spawn."""
+    def _kill_zygote(self):
+        z = self._zygote
+        if z is not None and z.poll() is None:
+            z.kill()
+        self._zygote = None
+        self._zygote_rbuf = b""
+
+    def _spawn_batch_via_zygote(self, env_keys: List[str]) -> int:
+        """Fork a burst of workers from the pre-imported template.
+
+        Pipelined: all requests are written first, then the pids are
+        collected — the per-fork handshake round-trip (tens of ms on a
+        loaded host) is paid once per BURST, not once per worker. Returns
+        how many spawns succeeded; the caller cold-spawns the rest.
+        Spawner thread only."""
         z = self._ensure_zygote()
         if z is None:
-            return False
-        req = {
-            "env": {**self.env_overrides,
-                    "RAY_TPU_NODE_ID": self.node_id.hex()},
-            "unset": [] if env_key else ["RAY_TPU_ENV_KEY"],
-            "gcs": self.gcs_address,
-            "node_id": self.node_id.hex(),
-            "session_dir": self.session_dir,
-            "log": os.path.join(self.session_dir,
-                                f"worker-z{len(self.zygote_pids)}.out"),
-        }
-        if env_key:
-            req["env"]["RAY_TPU_ENV_KEY"] = env_key
+            return 0
+        lines = []
+        for env_key in env_keys:
+            req = {
+                "env": {**self.env_overrides,
+                        "RAY_TPU_NODE_ID": self.node_id.hex()},
+                "unset": [] if env_key else ["RAY_TPU_ENV_KEY"],
+                "gcs": self.gcs_address,
+                "node_id": self.node_id.hex(),
+                "session_dir": self.session_dir,
+                "log": os.path.join(
+                    self.session_dir,
+                    f"worker-z{len(self.zygote_pids) + len(lines)}.out"),
+            }
+            if env_key:
+                req["env"]["RAY_TPU_ENV_KEY"] = env_key
+            lines.append(json.dumps(req) + "\n")
         try:
-            with self._zygote_lock:
-                z.stdin.write(json.dumps(req) + "\n")
-                z.stdin.flush()
-                pid_line = z.stdout.readline()
-            pid = int(pid_line.strip())
-        except (OSError, ValueError, AttributeError):
-            self._zygote = None  # template died; cold path takes over
-            return False
-        self.zygote_pids.add(pid)
-        return True
+            z.stdin.write("".join(lines).encode())
+            z.stdin.flush()
+        except (OSError, AttributeError):
+            self._kill_zygote()
+            return 0
+        done = 0
+        try:
+            for _ in env_keys:
+                pid = int(self._pipe_read_line(15.0).strip())
+                self.zygote_pids.add(pid)
+                done += 1
+        except (OSError, ValueError, TimeoutError):
+            # Template wedged or died mid-burst: kill it so the pipe
+            # state can't go out of sync; the cold path covers the rest.
+            self._kill_zygote()
+        return done
+
+    def _spawner_thread_main(self):
+        import queue as _queue
+
+        while True:
+            item = self._spawn_q.get()
+            if item is None:
+                return
+            batch = [item]
+            # Coalesce the burst: everything already queued forks as one
+            # pipelined batch.
+            while True:
+                try:
+                    nxt = self._spawn_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    return
+                batch.append(nxt)
+            ok = 0
+            try:
+                ok = self._spawn_batch_via_zygote(batch)
+                for env_key in batch[ok:]:
+                    self._spawn_cold(sys.executable, worker_sys_path(),
+                                     env_key)
+                    ok += 1
+            except Exception as e:  # noqa: BLE001 — keep the spawner alive
+                import logging
+
+                logging.getLogger(__name__).exception("worker spawn failed")
+                # Report every spawn that will never produce a worker:
+                # the GCS frees its `spawning` slots (they are otherwise
+                # only released by a worker hello) and re-runs scheduling.
+                err = str(e)
+                for _ in batch[ok:]:
+                    self._loop.call_soon_threadsafe(
+                        self._send_spawn_failed, err)
 
     def _spawn(self, python: str, sys_path: str, env_key: str, wrap=None):
-        if self._zygote_available(python, wrap) and \
-                self._spawn_via_zygote(env_key):
+        if self._zygote_available(python, wrap):
+            # Queue for the spawner thread: the agent loop never blocks on
+            # the zygote handshake (ADVICE r2: a stalled template must not
+            # stop health-check replies and get the node declared dead).
+            import queue as _queue
+            import threading
+
+            if self._spawn_q is None:
+                self._spawn_q = _queue.SimpleQueue()
+                self._spawner = threading.Thread(
+                    target=self._spawner_thread_main, daemon=True)
+                self._spawner.start()
+            self._spawn_q.put(env_key)
             return
+        self._spawn_cold(python, sys_path, env_key, wrap)
+
+    def _spawn_cold(self, python: str, sys_path: str, env_key: str,
+                    wrap=None):
         env = dict(os.environ)
         env.update(self.env_overrides)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
@@ -618,13 +716,15 @@ class NodeAgent:
         self.shutdown_workers()
 
     def shutdown_workers(self):
+        if self._spawn_q is not None:
+            self._spawn_q.put(None)  # retire the spawner thread
         for p in self.procs:
             if p.poll() is None:
                 p.terminate()
         # Zygote-forked workers (own sessions, not in self.procs): same
         # terminate-then-kill guarantee, validated as LIVE children of
         # the zygote before signalling (pid recycling safety).
-        live_forks = [p for p in self.zygote_pids
+        live_forks = [p for p in set(self.zygote_pids)
                       if self._is_zygote_child(p)]
         for pid in live_forks:
             try:

@@ -118,6 +118,13 @@ def deserialize(data: memoryview, pin=None) -> Any:
     data = memoryview(data)
     (header_len,) = _U32.unpack(data[:4])
     header = msgpack.unpackb(data[4 : 4 + header_len], raw=False)
+    if "x" in header:
+        # Language-neutral payload (C++ Client::put / cross_language.
+        # put_xlang): the value is msgpack, not pickle — readable from
+        # any worker language.
+        if pin is not None:
+            pin()
+        return msgpack.unpackb(header["x"], raw=False)
     if pin is not None and header["o"]:
         holder = _Pin(pin)
         buffers = [

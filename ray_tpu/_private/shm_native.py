@@ -169,9 +169,13 @@ class NativeStore:
             # takes a ~1us shared-memory minor fault per 4K page on first
             # touch. A deprioritized background walk of the committed
             # region populates THIS process's page tables so steady-state
-            # creates/reads run fault-free.
-            threading.Thread(target=self._walk_committed, daemon=True,
-                             name="arena-walk").start()
+            # creates/reads run fault-free. The walk starts LAZILY on the
+            # first actual store use: a 200-worker launch storm would
+            # otherwise spend most of the host's CPU on 200 parallel
+            # ~1 GiB page-table walks for workers that never touch the
+            # arena (measured: ~270k minor faults / ~60 ms CPU per worker,
+            # the dominant cost of the many-actors bench on a small host).
+            self._walk_started = False
 
     def _madvise(self, off: int, length: int, advice: int = 23) -> bool:
         """madvise via libc (releases the GIL). 23 = MADV_POPULATE_WRITE
@@ -183,16 +187,29 @@ class NativeStore:
             ctypes.c_size_t(length), ctypes.c_int(advice))
         return rc == 0
 
+    def _ensure_walk(self):
+        """Start the committed-region walk on first store use (see
+        __init__: never-touching workers must not pay for it)."""
+        if self._walk_started:
+            return
+        self._walk_started = True
+        threading.Thread(target=self._walk_committed, daemon=True,
+                         name="arena-walk").start()
+
     def _walk_committed(self, window: int = 16 << 20):
         """Client-side page-table walk over the head-committed region
         (tracked by the arena's populated watermark). ~0.5 ms of kernel
         work per 16 MiB window on present pages; paced to stay out of the
         workload's way."""
+        import random
+
         try:
             os.nice(19)
         except OSError:
             pass
-        time.sleep(1.0)  # let this process's startup win the CPU first
+        # Jittered head start: concurrent walkers (worker fleets spawn in
+        # bursts) must not all hit the kernel in the same window.
+        time.sleep(1.0 + random.random() * 2.0)
         off = 0
         idle_rounds = 0
         while idle_rounds < 50:  # stop once the watermark stops moving
@@ -244,6 +261,8 @@ class NativeStore:
         return object_id.binary()
 
     def create(self, object_id: ObjectID, nbytes: int) -> memoryview:
+        if not getattr(self, "_walk_started", True):
+            self._ensure_walk()
         nbytes = max(nbytes, 1)
         off = self.lib.rtpu_store_create(self.handle, self._key(object_id),
                                          nbytes)
@@ -273,6 +292,8 @@ class NativeStore:
         recycled until ``view.close()`` — or, for zero-copy reads, until
         the deserialized value's buffers are garbage-collected (the pin is
         handed to them via ``serialization.deserialize(..., pin=...)``)."""
+        if not getattr(self, "_walk_started", True):
+            self._ensure_walk()
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         rc = self.lib.rtpu_store_acquire(self.handle, self._key(object_id),

@@ -242,7 +242,8 @@ class Worker:
         self.session_dir: Optional[str] = None
         self.node_id: Optional[bytes] = None
         self.gcs: Optional[protocol.Connection] = None
-        self.store = None
+        self._store_obj = None
+        self._store_factory = None  # lazy open (see `store` property)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self._put_counter = _Counter()
@@ -272,6 +273,26 @@ class Worker:
         self._registered_inline: set = set()
         self._promote_pending: set = set()
         self._flusher_handle = None
+
+    @property
+    def store(self):
+        """Host shm store, opened on FIRST USE. Worker boot sets only a
+        factory: actors that never touch the object plane (the common
+        launch-storm case) skip the arena open + mmap (~5 ms CPU each,
+        material when hundreds of workers start on a small host).
+        Lock-guarded: first use can race between executor pool threads,
+        and a double-open would leak an arena mapping."""
+        s = self._store_obj
+        if s is None and self._store_factory is not None:
+            with self._ref_lock:
+                s = self._store_obj
+                if s is None:
+                    s = self._store_obj = self._store_factory()
+        return s
+
+    @store.setter
+    def store(self, value):
+        self._store_obj = value
 
     # ------------------------------------------------------------ lifecycle
 
@@ -443,8 +464,8 @@ class Worker:
         if self._loop_thread is not None:
             self.loop.call_soon_threadsafe(self.loop.stop)
             self._loop_thread.join(timeout=5)
-        if self.store is not None:
-            self.store.close()
+        if self._store_obj is not None:
+            self._store_obj.close()
 
     async def _disconnect_async(self):
         self._flush_refs()
@@ -878,6 +899,14 @@ class Worker:
             self.loop.call_soon_threadsafe(
                 self._send_gcs,
                 {"t": "ref", "d": [(object_id.binary(), 1)]})
+        else:
+            # Link down (reconnect in progress): the receiver's wrapper
+            # will still deliver its -1, so dropping this +1 would
+            # underflow the count on a surviving GCS. Queue it through
+            # the delta path — flushed on reconnect; cleared (correctly)
+            # on a true GCS restart, where the receiver replays its own
+            # live count in the snapshot resync.
+            self.queue_ref_delta(object_id, +1)
 
     def promote_on_serialize(self, object_id: ObjectID):
         """Register a locally-held inline value with the GCS so a borrower

@@ -32,11 +32,24 @@ import cloudpickle
 
 from . import protocol, serialization
 from .ids import ActorID, ObjectID, TaskID, WorkerID
-from .serialization import INLINE_THRESHOLD, deserialize, pack_error, serialize
+from .serialization import deserialize, pack_error, serialize
 from .worker import ObjectRef, Worker, set_global_worker
 
 
 _MISSING = object()
+
+
+def _boot_ts(label: str):
+    """Env-gated boot diagnostics (RAY_TPU_BOOT_TS=1): prints this
+    process's cumulative CPU at each boot phase to the worker log — the
+    tool that found the 87 ms/actor launch-storm costs (arena walk,
+    per-child module imports)."""
+    if os.environ.get("RAY_TPU_BOOT_TS"):
+        import resource
+
+        r = resource.getrusage(resource.RUSAGE_SELF)
+        print(f"BOOT {label} cpu={r.ru_utime + r.ru_stime:.3f} "
+              f"flt={r.ru_minflt}", file=sys.stderr, flush=True)
 
 
 class Executor:
@@ -340,7 +353,7 @@ class Executor:
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(tid, i + 1)
             sobj = serialize(value)
-            if sobj.total_size <= INLINE_THRESHOLD:
+            if sobj.total_size <= serialization.INLINE_THRESHOLD:
                 out.append({"oid": oid.binary(), "nbytes": sobj.total_size,
                             "data": sobj.to_bytes()})
             else:
@@ -562,6 +575,7 @@ class Executor:
             (self.actor_opts.get("concurrency_groups") or {}).items()}
         try:
             await loop.run_in_executor(self.pool, self._init_actor_sync, msg)
+            _boot_ts("actor_ready")
             self.worker.gcs.send({"t": "actor_ready",
                                   "aid": msg["aid"]})
         except Exception as e:  # noqa: BLE001
@@ -758,6 +772,7 @@ class Executor:
 
 
 async def amain(args):
+    _boot_ts("amain")
     worker = Worker(role="worker")
     worker.loop = asyncio.get_running_loop()
     worker._loop_thread = threading.main_thread()
@@ -826,11 +841,16 @@ async def amain(args):
             stop.set()
 
     reply = await connect_gcs()
+    _boot_ts("connected")
     worker.session_name = reply["session"]
     worker.session_dir = reply["session_dir"]
     from .object_store import make_store
 
-    worker.store = make_store(worker.session_name)
+    # Lazy factory: the arena opens on first object-plane use, not at
+    # boot (launch storms of store-less actors skip it entirely).
+    worker._store_factory = (
+        lambda s=worker.session_name: make_store(s))
+    _boot_ts("store")
     set_global_worker(worker)
     worker._flusher_handle = worker.loop.call_later(0.1, worker._flush_refs_cb)
     asyncio.get_running_loop().create_task(flush_events_loop())
@@ -852,6 +872,23 @@ async def amain(args):
     except Exception:
         pass
     os._exit(0)
+
+
+def main_from_req(req: dict):
+    """Zygote fork entry: args ride the fork request — no argparse
+    (building an ArgumentParser costs ~4 ms CPU per child, measured on
+    the many-actors launch path)."""
+    import types
+
+    from .jax_platform import install_hook
+    from .node import _run_with_optional_profile
+
+    _boot_ts("pre-hook")
+    install_hook()
+    args = types.SimpleNamespace(gcs=req["gcs"], node_id=req["node_id"],
+                                 session_dir=req["session_dir"])
+    _boot_ts("pre-run")
+    _run_with_optional_profile(lambda: amain(args), "worker")
 
 
 def main():

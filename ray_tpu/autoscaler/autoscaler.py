@@ -16,7 +16,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from .node_provider import NodeInstance, NodeProvider
+from .instance_manager import RAY_RUNNING, Instance, InstanceManager
+from .node_provider import NodeProvider
 from .scheduler import ResourceDemandScheduler
 
 logger = logging.getLogger(__name__)
@@ -51,11 +52,16 @@ class Autoscaler:
         self.provider = provider
         self.gcs_address = gcs_address
         self.scheduler = ResourceDemandScheduler(config.scheduler_types())
+        # Explicit per-instance lifecycle (reference: v2 InstanceManager,
+        # instance_manager.py:29) — launches, ray-up detection, and
+        # preemption detection all flow through this ledger.
+        self.im = InstanceManager(provider)
         self._client = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.launched_total = 0
         self.terminated_total = 0
+        self.preempted_total = 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -75,36 +81,50 @@ class Autoscaler:
     def update(self) -> dict:
         """One reconcile round; returns a summary for tests/logging."""
         state = self._state()
-        instances = self.provider.non_terminated_nodes()
-        by_node_id = {i.node_id_hex: i for i in instances}
-        counts: Dict[str, int] = {}
-        for inst in instances:
-            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
-
         alive_nodes = [n for n in state["nodes"] if n["alive"]]
         demands = list(state["demands"])
-        # Capacity the scheduler may pack onto: live node availability.
+
+        # 1. Reconcile the instance ledger against provider + GCS reality:
+        #    QUEUED instances launch, ALLOCATED ones become RAY_RUNNING as
+        #    their node registers, vanished cloud instances (preempted TPU
+        #    slices) transition to TERMINATED and free their type's count.
+        events = self.im.reconcile([n["node_id"] for n in alive_nodes])
+
+        # 2. Plan launches against the LEDGER's live counts (not the raw
+        #    provider listing): in-flight launches count, preempted ones
+        #    don't — so a preempted slice is replaced on this very round.
+        counts = self.im.live_counts()
         avail = [dict(n["avail"]) for n in alive_nodes]
         plan = self.scheduler.get_nodes_to_launch(demands, avail, counts)
 
-        launched: List[NodeInstance] = []
+        launched: List[Instance] = []
         budget = self.config.max_launches_per_round
         for name, count in plan.items():
             cfg = self.config.node_types[name]
-            for _ in range(min(count, budget)):
-                launched.append(self.provider.create_node(
-                    name, dict(cfg.resources)))
-                budget -= 1
+            n = min(count, budget)
+            if n > 0:
+                launched.extend(self.im.launch(name, dict(cfg.resources), n))
+                budget -= n
+        if launched:
+            # Move QUEUED -> ALLOCATED now (provider create), so capacity
+            # is requested this round, not next.
+            events += self.im.reconcile([n["node_id"] for n in alive_nodes])
         self.launched_total += len(launched)
+        # Preemption accounting covers BOTH reconcile calls this round.
+        preempted = [e for e in events if e["event"] == "preempted"]
+        if preempted:
+            self.preempted_total += len(preempted)
+            logger.warning("detected %d preempted instance(s): %s",
+                           len(preempted), preempted)
 
-        # Idle termination: only provider-managed nodes, never below
-        # min_workers, never while demand is pending.
+        # 3. Idle termination: only ledger-managed RAY_RUNNING nodes,
+        #    never below min_workers, never while demand is pending.
         terminated = []
         if not demands:
             for n in alive_nodes:
-                inst = by_node_id.get(n["node_id"])
-                if inst is None:
-                    continue  # head / externally-managed node
+                inst = self.im.find_by_node_id(n["node_id"])
+                if inst is None or inst.state != RAY_RUNNING:
+                    continue  # head / externally-managed / not up yet
                 cfg = self.config.node_types.get(inst.node_type)
                 min_w = cfg.min_workers if cfg else 0
                 live = counts.get(inst.node_type, 0)
@@ -112,12 +132,14 @@ class Autoscaler:
                         and live - len([t for t in terminated
                                         if t.node_type == inst.node_type])
                         > min_w):
-                    self.provider.terminate_node(inst.instance_id)
+                    self.im.terminate(inst.im_id, "idle")
                     terminated.append(inst)
         self.terminated_total += len(terminated)
         return {"demands": len(demands),
                 "launched": [i.node_type for i in launched],
-                "terminated": [i.node_type for i in terminated]}
+                "terminated": [i.node_type for i in terminated],
+                "events": events,
+                "instances": self.im.summary()}
 
     # ------------------------------------------------------------- driving
 

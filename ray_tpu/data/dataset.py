@@ -19,6 +19,7 @@ from __future__ import annotations
 import builtins
 import itertools
 import math
+import time
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
 
 import numpy as np
@@ -90,8 +91,11 @@ class _Op:
         raise ValueError(f"unknown op {self.kind}")
 
 
-def _run_pipeline(source, ops: List[_Op]):
-    """The fused per-block task body (executes on a worker)."""
+def _run_pipeline(source, ops: List[_Op], apply=None):
+    """The fused per-block task body (executes on a worker).
+
+    ``apply(op, block, i)`` overrides op application — the stats task
+    injects per-op timing without duplicating this loop."""
     block = source() if callable(source) else source
     if not isinstance(block, (list, tuple)):
         blocks = [block]
@@ -100,15 +104,60 @@ def _run_pipeline(source, ops: List[_Op]):
     outs = []
     for b in blocks:
         b = to_block(b)
-        for op in ops:
-            b = op.apply(b)
+        for i, op in enumerate(ops):
+            b = op.apply(b) if apply is None else apply(op, b, i)
         outs.append(b)
     return BlockAccessor.concat(outs) if len(outs) > 1 else outs[0]
 
 
-@ray_tpu.remote
-def _pipeline_task(source, ops):
-    return _run_pipeline(source, ops)
+@ray_tpu.remote(num_returns=2)
+def _pipeline_task_stats(source, ops):
+    """Fused per-block task that also returns per-op timings: the block
+    rides return 0 (consumers are unchanged), the small stats dict rides
+    return 1 (reference: per-operator stats, ``_internal/stats.py``)."""
+    import time as _time
+
+    per_op = [0.0] * len(ops)
+
+    def timed_apply(op, b, i):
+        t1 = _time.perf_counter()
+        out = op.apply(b)
+        per_op[i] += _time.perf_counter() - t1
+        return out
+
+    t0 = _time.perf_counter()
+    out = _run_pipeline(source, ops, apply=timed_apply)
+    total_s = _time.perf_counter() - t0
+    acc = BlockAccessor(out)
+    return out, {"read_s": max(total_s - sum(per_op), 0.0), "op_s": per_op,
+                 "rows": acc.num_rows(), "bytes": acc.size_bytes()}
+
+
+class _ExecStats:
+    """Driver-side record of one streaming execution (one entry per
+    block task + the op chain it ran)."""
+
+    def __init__(self, op_kinds: List[str]):
+        self.op_kinds = op_kinds
+        self.stat_refs: List[ray_tpu.ObjectRef] = []
+        self.wall_s = 0.0
+
+    def summary(self) -> str:
+        try:
+            rows = ray_tpu.get(list(self.stat_refs), timeout=60)
+        except Exception:
+            return f"Dataset stats unavailable ({len(self.stat_refs)} blocks)"
+        n = len(rows)
+        lines = [f"Execution: {n} blocks, wall {self.wall_s:.3f}s"]
+        read_s = sum(r["read_s"] for r in rows)
+        total_rows = sum(r["rows"] for r in rows)
+        total_bytes = sum(r["bytes"] for r in rows)
+        lines.append(f"  Read: {read_s:.3f}s task-time")
+        for i, kind in enumerate(self.op_kinds):
+            op_s = sum(r["op_s"][i] for r in rows)
+            lines.append(f"  Op {i} {kind}: {op_s:.3f}s task-time")
+        lines.append(f"  Output: {total_rows} rows, {total_bytes} bytes")
+        return "\n".join(lines)
 
 
 @ray_tpu.remote
@@ -190,6 +239,137 @@ def _exchange_reduce(how, seed, key, descending, *parts):
 
 
 @ray_tpu.remote
+def _rows_of(block):
+    """Row count of one resolved block (tiny reply; the block itself
+    never travels to the driver)."""
+    return BlockAccessor(to_block(block)).num_rows()
+
+
+@ray_tpu.remote
+def _unique_of(source, ops, column):
+    """Per-block distinct values; the driver unions the (small) sets."""
+    import pyarrow.compute as pc
+
+    block = _run_pipeline(source, ops)
+    return pc.unique(BlockAccessor(block).to_arrow().column(column)).to_pylist()
+
+
+@ray_tpu.remote
+def _zip_part(spec, left, *rights):
+    """Zip one left block with the row-aligned slices of right blocks.
+
+    ``spec`` is [(right_idx, start, length), ...] covering exactly the
+    left block's row range — each task holds one left block plus the two
+    or three right blocks that overlap it, never the whole dataset.
+    """
+    left = to_block(left)
+    pieces = [to_block(rights[ridx]).slice(start, length)
+              for ridx, start, length in spec]
+    right = BlockAccessor.concat(pieces) if len(pieces) != 1 else pieces[0]
+    out = left
+    for name in right.column_names:
+        col = right.column(name)
+        new_name, k = name, 0
+        while new_name in out.column_names:
+            k += 1
+            new_name = f"{name}_{k}"
+        out = out.append_column(new_name, col)
+    return out
+
+
+def _stable_hash_assign(col: np.ndarray, n: int) -> np.ndarray:
+    """Deterministic cross-process partition assignment for hash
+    exchanges (Python's ``hash`` is salted per process; numeric dtypes
+    get a cheap vectorized mix instead of per-row crc32)."""
+    import zlib
+
+    if col.dtype.kind in "iuf":
+        f = col.astype(np.float64)
+        f = f + 0.0  # canonicalize -0.0 -> +0.0 (equal keys, equal hash)
+        iv = f.view(np.uint64)
+        iv = (iv ^ (iv >> 33)) * np.uint64(0xFF51AFD7ED558CCD)
+        iv = iv ^ (iv >> 33)
+        return (iv % np.uint64(n)).astype(np.int64)
+    return np.fromiter(
+        (zlib.crc32(repr(v).encode()) % n for v in col.tolist()),
+        dtype=np.int64, count=len(col))
+
+
+@ray_tpu.remote
+def _hash_part(source, ops, n, key):
+    """Partition one (piped) block by key hash — the split stage of
+    joins and grouped aggregations (reference: hash-shuffle exchange,
+    ``planner/exchange/hash_shuffle``)."""
+    block = _run_pipeline(source, ops)
+    rows = BlockAccessor(block).num_rows()
+    if rows == 0:
+        return [block.slice(0, 0)] * n if n > 1 else block.slice(0, 0)
+    col = BlockAccessor(block).to_numpy()[key]
+    assign = _stable_hash_assign(np.asarray(col), n)
+    parts = [block.take(np.nonzero(assign == i)[0]) for i in range(n)]
+    return parts if n > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _join_reduce(key, how, n_left, *parts):
+    """Join one co-partitioned (left, right) pair via pandas merge."""
+    import pandas as pd
+
+    left = BlockAccessor.concat([to_block(p) for p in parts[:n_left]])
+    right = BlockAccessor.concat([to_block(p) for p in parts[n_left:]])
+    lp = BlockAccessor(left).to_pandas()
+    rp = BlockAccessor(right).to_pandas()
+    out = lp.merge(rp, on=key, how=how, suffixes=("", "_1"))
+    return to_block(out)
+
+
+@ray_tpu.remote
+def _groupby_reduce(key, aggs, *parts):
+    """Aggregate one hash partition with arrow's group_by kernels.
+
+    All rows of a key live in one partition (hash co-partitioning), so
+    per-partition aggregation IS the global aggregation for its keys.
+    ``aggs`` is "count" or [(column, fn), ...]; the arrow spec builds
+    here (arrow option objects don't need to cross the wire)."""
+    import pyarrow.compute as pc
+
+    block = BlockAccessor.concat([to_block(p) for p in parts])
+    if aggs == "count":
+        out = block.group_by(key).aggregate([([], "count_all")])
+        return out.rename_columns(
+            ["count()" if c == "count_all" else c
+             for c in out.column_names])
+    arrow_fns = {"sum": "sum", "mean": "mean", "min": "min",
+                 "max": "max", "count": "count", "std": "stddev",
+                 "stddev": "stddev"}
+    # Sample stddev (ddof=1), consistent with Dataset.std and the
+    # reference's GroupedData.std default; arrow's kernel defaults to
+    # population stddev.
+    spec = [(col, arrow_fns[fn], pc.VarianceOptions(ddof=1))
+            if arrow_fns[fn] == "stddev" else (col, arrow_fns[fn])
+            for col, fn in aggs]
+    out = block.group_by(key).aggregate(spec)
+    renames = {f"{col}_{s[1]}": f"{fn}({col})"
+               for (col, fn), s in zip(aggs, spec)}
+    return out.rename_columns(
+        [renames.get(c, c) for c in out.column_names])
+
+
+@ray_tpu.remote
+def _map_groups_part(key, fn, *parts):
+    """Run a per-group UDF over every group in one hash partition."""
+    import pyarrow.compute as pc
+
+    block = BlockAccessor.concat([to_block(p) for p in parts])
+    keys = pc.unique(block.column(key)).to_pylist()
+    outs = [_apply_group_fn(fn, block.filter(pc.equal(block.column(key), kv)))
+            for kv in keys]
+    if not outs:
+        return block.slice(0, 0)
+    return BlockAccessor.concat(outs) if len(outs) > 1 else outs[0]
+
+
+@ray_tpu.remote
 def _sample_keys(source, ops, key, k):
     """Sample up to k key values from one block (sort range-partitioning)."""
     block = _run_pipeline(source, ops)
@@ -216,6 +396,8 @@ class Dataset:
         self._remote_args = ray_remote_args or {}
         # Set when an op carries a callable-class UDF (actor-pool compute).
         self._actor_pool_size: Optional[int] = None
+        # Stats of the most recent streaming execution (``stats()``).
+        self._exec_stats: Optional[_ExecStats] = None
 
     # --------------------------------------------------------- transforms
 
@@ -305,13 +487,15 @@ class Dataset:
         cpu_window = max(2, cpus * 2)
         budget = self._memory_budget()
         est_block = 0  # rolling estimate of produced block bytes
-        task = _pipeline_task
+        task = _pipeline_task_stats
         if self._remote_args:
             opts = {k: v for k, v in self._remote_args.items()
                     if k in ("num_cpus", "num_tpus", "resources",
                              "max_retries")}
             if opts:
-                task = _pipeline_task.options(**opts)
+                task = _pipeline_task_stats.options(**opts)
+        stats = self._exec_stats = _ExecStats([o.kind for o in self._ops])
+        t_exec = time.perf_counter()
         pending: List[ray_tpu.ObjectRef] = []
         it = iter(sources)
         exhausted = False
@@ -325,7 +509,9 @@ class Dataset:
                 except StopIteration:
                     exhausted = True
                     break
-                pending.append(task.remote(src, self._ops))
+                bref, sref = task.remote(src, self._ops)
+                pending.append(bref)
+                stats.stat_refs.append(sref)
             if not pending:
                 break
             # Submission order preserved (deterministic block order, like the
@@ -336,6 +522,7 @@ class Dataset:
             nbytes = _resolved_nbytes(ref)
             if nbytes:
                 est_block = (est_block + nbytes) // 2 if est_block else nbytes
+            stats.wall_s = time.perf_counter() - t_exec
             yield ref
 
     def _stream_refs_actor_pool(self, sources) -> Iterator[ray_tpu.ObjectRef]:
@@ -378,12 +565,10 @@ class Dataset:
             [to_block(b) for b in blocks], [], self._remote_args)
 
     def _all_blocks(self) -> List[Any]:
+        """Driver-side block fetch — reachable ONLY from explicitly
+        materializing APIs (``materialize``, ``union`` op-normalization,
+        ``split_at_indices``); every streaming op works on refs."""
         return ray_tpu.get(list(self._stream_refs()))
-
-    def _concat_all(self):
-        """Materialize the whole dataset as one arrow table."""
-        return BlockAccessor.concat(
-            [to_block(b) for b in self._all_blocks()])
 
     # ---------------------------------------------------- all-to-all ops
     # Two-stage distributed exchange (split per input block, reduce per
@@ -466,11 +651,12 @@ class Dataset:
         sources = list(self._sources)
         ops = list(self._ops)
         if any(o._ops for o in others) or ops:
-            # Materialize to normalize op chains.
-            blocks = self._all_blocks()
+            # Normalize op chains by executing each side to block REFS
+            # (refs are valid sources; rows stay in the object store).
+            refs = list(self._stream_refs())
             for o in others:
-                blocks.extend(o._all_blocks())
-            return Dataset(blocks, [], self._remote_args)
+                refs.extend(o._stream_refs())
+            return Dataset(refs, [], self._remote_args)
         for o in others:
             sources.extend(o._sources)
         return Dataset(sources, [], self._remote_args)
@@ -623,7 +809,10 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        return sum(BlockAccessor(b).num_rows() for b in self._all_blocks())
+        # Row counts come back as tiny ints; blocks stay in the store.
+        refs = list(self._stream_refs())
+        return sum(ray_tpu.get([_rows_of.remote(r) for r in refs],
+                               timeout=600))
 
     def schema(self):
         for ref in self._stream_refs():
@@ -646,28 +835,53 @@ class Dataset:
             print(row)
 
     def stats(self) -> str:
-        return (f"Dataset(num_blocks={self.num_blocks()}, "
-                f"ops={[o.kind for o in self._ops]})")
+        """Execution stats of the LAST run of this dataset: per-operator
+        wall time / rows / bytes out (reference: ``Dataset.stats()``,
+        ``data/_internal/stats.py``). Before any execution, describes the
+        plan."""
+        rec = self._exec_stats
+        if rec is None:
+            return (f"Dataset(num_blocks={self.num_blocks()}, "
+                    f"ops={[o.kind for o in self._ops]})")
+        return rec.summary()
 
     def zip(self, other: "Dataset") -> "Dataset":
         """Column-wise zip of two equal-length datasets (reference:
         ``Dataset.zip``). Right-hand duplicate columns get a ``_1``
-        suffix."""
-        left = self._concat_all()
-        right = other._concat_all()
-        if left.num_rows != right.num_rows:
+        suffix.
+
+        Distributed: both sides execute to block REFS; per left block, a
+        task fetches only the row-aligned right slices — no process ever
+        holds either whole dataset (the round-1/2 driver concat is gone).
+        """
+        lrefs = list(self._stream_refs())
+        rrefs = list(other._stream_refs())
+        lrows = ray_tpu.get([_rows_of.remote(r) for r in lrefs], timeout=600)
+        rrows = ray_tpu.get([_rows_of.remote(r) for r in rrefs], timeout=600)
+        if sum(lrows) != sum(rrows):
             raise ValueError(
-                f"zip requires equal row counts: {left.num_rows} vs "
-                f"{right.num_rows}")
-        out = left
-        for name in right.column_names:
-            col = right.column(name)
-            new_name, k = name, 0
-            while new_name in out.column_names:
-                k += 1
-                new_name = f"{name}_{k}"
-            out = out.append_column(new_name, col)
-        return Dataset([out], [], self._remote_args)
+                f"zip requires equal row counts: {sum(lrows)} vs "
+                f"{sum(rrows)}")
+        # Right-block global offsets.
+        roff = [0]
+        for r in rrows:
+            roff.append(roff[-1] + r)
+        out = []
+        lo = 0
+        for lref, lr in zip(lrefs, lrows):
+            hi = lo + lr
+            spec, needed = [], []
+            for j, rr in enumerate(rrows):
+                b_lo, b_hi = roff[j], roff[j + 1]
+                s, e = max(lo, b_lo), min(hi, b_hi)
+                if e > s:
+                    if j not in needed:
+                        needed.append(j)
+                    spec.append((needed.index(j), s - b_lo, e - s))
+            out.append(_zip_part.remote(
+                spec, lref, *[rrefs[j] for j in needed]))
+            lo = hi
+        return Dataset(out, [], self._remote_args)
 
     def groupby(self, key: str) -> "GroupedData":
         """Group rows by a key column (reference: ``Dataset.groupby`` →
@@ -675,12 +889,59 @@ class Dataset:
         return GroupedData(self, key)
 
     def unique(self, column: str) -> List[Any]:
-        import pyarrow.compute as pc
+        """Distinct values of a column. Per-block distinct runs remotely;
+        only the (small) per-block result sets reach the driver."""
+        sources, ops = self._exchange_inputs()
+        sets = ray_tpu.get([_unique_of.remote(src, ops, column)
+                            for src in sources], timeout=600)
+        seen, out = set(), []
+        for vals in sets:
+            for v in vals:
+                if v not in seen:
+                    seen.add(v)
+                    out.append(v)
+        return out
 
-        return pc.unique(self._concat_all().column(column)).to_pylist()
+    def join(self, other: "Dataset", on: str, how: str = "inner", *,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Hash join (reference: ``Dataset.join``). Both sides hash-
+        partition on the key; each output partition joins one
+        co-partitioned (left, right) pair — memory per task is bounded by
+        the partition, not the dataset."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        k = num_partitions or max(len(self._sources),
+                                  len(other._sources), 1)
+        ls, lops = self._exchange_inputs()
+        rs, rops = other._exchange_inputs()
+        lsplit = _hash_part.options(num_returns=k)
+        lsub = [lsplit.remote(src, lops, k, on) for src in ls]
+        rsub = [lsplit.remote(src, rops, k, on) for src in rs]
+        if k == 1:
+            lsub = [[r] for r in lsub]
+            rsub = [[r] for r in rsub]
+        out = [
+            _join_reduce.remote(on, how, len(lsub),
+                                *[refs[i] for refs in lsub],
+                                *[refs[i] for refs in rsub])
+            for i in range(k)
+        ]
+        return Dataset(out, [], self._remote_args)
 
     def to_pandas(self):
-        return self._concat_all().to_pandas()
+        """Whole dataset as one driver-resident DataFrame (inherently a
+        materializing API — the reference's ``to_pandas`` also pulls all
+        rows to the caller). Blocks convert and append one at a time;
+        the full arrow table is never double-buffered."""
+        import pandas as pd
+
+        frames = []
+        for ref in self._stream_refs():
+            frames.append(BlockAccessor(
+                to_block(ray_tpu.get(ref))).to_pandas())
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
 
     # aggregations — streamed block-at-a-time (constant driver memory)
 
@@ -768,38 +1029,31 @@ class GroupedData:
         self._ds = dataset
         self._key = key
 
-    def _big(self):
-        return self._ds._concat_all()
+    def _partitions(self) -> List[List[ray_tpu.ObjectRef]]:
+        """Hash co-partition the dataset by key: [partition][input_block]
+        sub-block refs. Rows of one key always share a partition, so every
+        grouped op reduces partition-locally — no process ever sees the
+        whole dataset (the round-2 ``_big()`` driver concat is gone)."""
+        ds = self._ds
+        sources, ops = ds._exchange_inputs()
+        k = max(len(sources), 1)
+        split = _hash_part.options(num_returns=k)
+        sub = [split.remote(src, ops, k, self._key) for src in sources]
+        if k == 1:
+            sub = [[r] for r in sub]
+        return [[refs[i] for refs in sub] for i in range(k)]
 
     def aggregate(self, *aggs: tuple) -> Dataset:
         """``aggs`` are (column, fn) pairs with fn in
         {sum, mean, min, max, count, stddev}."""
-        import pyarrow.compute as pc
-
-        arrow_fns = {"sum": "sum", "mean": "mean", "min": "min",
-                     "max": "max", "count": "count", "std": "stddev",
-                     "stddev": "stddev"}
-        # Sample stddev (ddof=1), consistent with Dataset.std and the
-        # reference's GroupedData.std default; arrow's kernel defaults to
-        # population stddev.
-        spec = [(col, arrow_fns[fn], pc.VarianceOptions(ddof=1))
-                if arrow_fns[fn] == "stddev" else (col, arrow_fns[fn])
-                for col, fn in aggs]
-        out = self._big().group_by(self._key).aggregate(spec)
-        # Arrow names results "<col>_<fn>"; match the reference's
-        # "<fn>(<col>)" naming.
-        renames = {f"{col}_{s[1]}": f"{fn}({col})"
-                   for (col, fn), s in zip(aggs, spec)}
-        out = out.rename_columns(
-            [renames.get(c, c) for c in out.column_names])
-        return Dataset([out], [], self._ds._remote_args)
+        out = [_groupby_reduce.remote(self._key, list(aggs), *parts)
+               for parts in self._partitions()]
+        return Dataset(out, [], self._ds._remote_args)
 
     def count(self) -> Dataset:
-        out = self._big().group_by(self._key).aggregate([([], "count_all")])
-        out = out.rename_columns(
-            ["count()" if c == "count_all" else c
-             for c in out.column_names])
-        return Dataset([out], [], self._ds._remote_args)
+        out = [_groupby_reduce.remote(self._key, "count", *parts)
+               for parts in self._partitions()]
+        return Dataset(out, [], self._ds._remote_args)
 
     def sum(self, on: str) -> Dataset:
         return self.aggregate((on, "sum"))
@@ -818,17 +1072,8 @@ class GroupedData:
 
     def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Any]
                    ) -> Dataset:
-        """Run ``fn(group_batch) -> batch`` once per group, in parallel
-        tasks; results union into a new Dataset."""
-        import functools
-
-        import pyarrow.compute as pc
-
-        big = self._big()
-        keys = pc.unique(big.column(self._key)).to_pylist()
-        sources = []
-        for k in keys:
-            mask = pc.equal(big.column(self._key), k)
-            sources.append(functools.partial(
-                _apply_group_fn, fn, big.filter(mask)))
-        return Dataset(sources, [], self._ds._remote_args)
+        """Run ``fn(group_batch) -> batch`` once per group; one task per
+        hash partition handles all of its groups."""
+        out = [_map_groups_part.remote(self._key, fn, *parts)
+               for parts in self._partitions()]
+        return Dataset(out, [], self._ds._remote_args)

@@ -318,12 +318,13 @@ class DeploymentHandle:
         a, b = self._rng.sample(range(n), 2)
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
 
-    _last_picked_actor_id = None
-
     def _submit(self, args, kwargs):
+        """Returns (ref, done, picked_actor_id). The picked id rides the
+        return value — not handle state — so two concurrent ``remote()``
+        calls can't cross-wire each other's failover exclusion."""
         idx = self._pick()
         replica = self._replicas[idx]
-        self._last_picked_actor_id = replica._actor_id.binary()
+        picked = replica._actor_id.binary()
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
         if self.multiplexed_model_id:
             kwargs = {**kwargs,
@@ -334,7 +335,7 @@ class DeploymentHandle:
         def done():
             self._inflight[idx] = max(0, self._inflight.get(idx, 1) - 1)
 
-        return ref, done
+        return ref, done, picked
 
     def _exclude_dead(self, dead_actor_id):
         if dead_actor_id is None:
@@ -353,7 +354,7 @@ class DeploymentHandle:
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no live "
                 "replicas")
-        ref, done = self._submit(args, kwargs)
+        ref, done, _ = self._submit(args, kwargs)
         done()
         return ref
 
@@ -365,7 +366,7 @@ class DeploymentHandle:
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no live "
                 "replicas")
-        ref, done = self._submit(args, kwargs)
+        ref, done, _ = self._submit(args, kwargs)
         done()
         return ref
 
@@ -373,10 +374,9 @@ class DeploymentHandle:
         if self._replicas and not self._fresh():
             self._replicas = []  # config changed: re-resolve below
         if self._replicas:
-            ref, done = self._submit(args, kwargs)
-            dead_id = self._last_picked_actor_id
+            ref, done, picked = self._submit(args, kwargs)
             return DeploymentResponse(
-                ref, done, retry_ctx=(self, args, kwargs, dead_id))
+                ref, done, retry_ctx=(self, args, kwargs, picked))
         if self._on_io_thread():
             # Inside an async replica: replica discovery must not block the
             # event loop — resolve it as part of the awaited chain.
@@ -386,7 +386,7 @@ class DeploymentHandle:
                     raise RuntimeError(
                         f"deployment {self.deployment_name!r} has no "
                         f"replicas")
-                ref, done = self._submit(args, kwargs)
+                ref, done, _ = self._submit(args, kwargs)
                 try:
                     return await ref
                 finally:
@@ -398,10 +398,9 @@ class DeploymentHandle:
         if not self._replicas:
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no replicas")
-        ref, done = self._submit(args, kwargs)
+        ref, done, picked = self._submit(args, kwargs)
         return DeploymentResponse(
-            ref, done,
-            retry_ctx=(self, args, kwargs, self._last_picked_actor_id))
+            ref, done, retry_ctx=(self, args, kwargs, picked))
 
     async def stream(self, *args, **kwargs):
         """Async generator over the replica method's yielded values.

@@ -34,11 +34,20 @@ class ScalingConfig:
     # for CPU groups unless requested (reference analog: Train always
     # builds the torch process group for num_workers > 1).
     jax_distributed: Optional[bool] = None
+    # Elastic restart floor (SURVEY §7 hard part 3): when a restart
+    # attempt follows a worker death, the group may re-form SMALLER (down
+    # to this floor) instead of failing — the training loop sees the new
+    # world size, builds a reshaped mesh, and the orbax restore reshards
+    # the checkpoint onto it. None = fixed-size restarts (the reference's
+    # Train semantics: worker groups are fixed-size per restart).
+    elastic_min_workers: Optional[int] = None
 
-    def should_init_jax_distributed(self) -> bool:
+    def should_init_jax_distributed(self, num_workers: Optional[int] = None
+                                    ) -> bool:
+        n = num_workers if num_workers is not None else self.num_workers
         if self.jax_distributed is not None:
-            return self.jax_distributed and self.num_workers > 1
-        return self.use_tpu and self.num_workers > 1
+            return self.jax_distributed and n > 1
+        return self.use_tpu and n > 1
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

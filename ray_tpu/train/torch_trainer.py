@@ -38,7 +38,7 @@ class TorchTrainer(JaxTrainer):
         super().__init__(*args, **kwargs)
         self.torch_backend = torch_backend
 
-    def _setup_backend(self, group):
+    def _setup_backend(self, group, num_workers):
         group.setup_torch(backend=self.torch_backend)
 
 

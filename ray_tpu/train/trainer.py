@@ -119,8 +119,10 @@ class JaxTrainer:
         restore_path = (self.resume_from_checkpoint.path
                         if self.resume_from_checkpoint else None)
         attempt = 0
+        workers = self.scaling_config.num_workers
         while True:
-            result = self._run_attempt(run_name, storage, restore_path)
+            result = self._run_attempt(run_name, storage, restore_path,
+                                       num_workers=workers)
             if result.error is None:
                 return result
             attempt += 1
@@ -130,26 +132,36 @@ class JaxTrainer:
             # ``TuneController._schedule_trial_restore`` tune_controller.py:1791)
             if result.checkpoint is not None:
                 restore_path = result.checkpoint.path
+            # Elastic restart (SURVEY §7 hard part 3): after a worker
+            # death, assume the lost capacity is gone and re-form the
+            # group one smaller (never below the floor). The loop sees a
+            # smaller world, builds a reshaped mesh, and the checkpoint
+            # restore reshards onto it.
+            floor = self.scaling_config.elastic_min_workers
+            if floor is not None and workers > max(floor, 1):
+                workers -= 1
 
-    def _setup_backend(self, group: "WorkerGroup"):
+    def _setup_backend(self, group: "WorkerGroup", num_workers: int):
         """Framework rendezvous hook (reference: ``Backend.on_start``,
         ``train/torch/config.py:153``). Jax: the mesh worker group
         primitive (SURVEY §7 hard part 2) — co-scheduled host actors
         enter one jax.distributed rendezvous so a single pjit program
         spans the group. TorchTrainer overrides with a gloo group."""
-        if self.scaling_config.should_init_jax_distributed():
+        if self.scaling_config.should_init_jax_distributed(num_workers):
             group.setup_distributed()
 
     def _run_attempt(self, run_name: str, storage: str,
-                     restore_path: Optional[str]) -> Result:
+                     restore_path: Optional[str],
+                     num_workers: Optional[int] = None) -> Result:
         sc = self.scaling_config
+        n_workers = num_workers if num_workers is not None else sc.num_workers
         run_path = os.path.join(storage, run_name)
-        collector = _ResultCollector.remote(sc.num_workers)
+        collector = _ResultCollector.remote(n_workers)
         group = None
         try:
-            group = WorkerGroup(sc.num_workers, sc.worker_resources(),
+            group = WorkerGroup(n_workers, sc.worker_resources(),
                                 sc.placement_strategy)
-            self._setup_backend(group)
+            self._setup_backend(group, n_workers)
         except Exception as e:  # noqa: BLE001 — e.g. infeasible resources
             try:
                 ray_tpu.kill(collector)
@@ -163,19 +175,19 @@ class JaxTrainer:
             fn_blob = cloudpickle.dumps(self.train_loop)
             # Pre-split datasets into per-worker shards
             shard_refs: List[Dict[str, Any]] = [
-                {} for _ in range(sc.num_workers)]
+                {} for _ in range(n_workers)]
             for name, ds in self.datasets.items():
                 if hasattr(ds, "streaming_split"):
-                    shards = ds.streaming_split(sc.num_workers)
+                    shards = ds.streaming_split(n_workers)
                     for i, sh in enumerate(shards):
                         shard_refs[i][name] = sh
                 else:
-                    for i in range(sc.num_workers):
+                    for i in range(n_workers):
                         shard_refs[i][name] = ds
             futs = []
             for rank, w in enumerate(group.workers):
                 session_kwargs = dict(
-                    world_rank=rank, world_size=sc.num_workers,
+                    world_rank=rank, world_size=n_workers,
                     local_rank=0, run_name=run_name, storage_path=storage,
                     restore_path=restore_path)
                 futs.append(w.run.remote(fn_blob, self.train_loop_config,

