@@ -93,6 +93,31 @@ class PlacementGroupID(BaseID):
 class TaskID(BaseID):
     _LENGTH = _TASK_LEN
 
+    # Fast unique ids for the hot submit path: one urandom prefix per
+    # process + a counter, instead of a 16-byte urandom syscall per task
+    # (~80us/call of driver CPU at high call rates). Fork-safe: the
+    # prefix regenerates when the pid changes (zygote-forked workers
+    # would otherwise mint identical id streams).
+    _fast_prefix: bytes = b""
+    _fast_pid: int = -1
+    _fast_counter = None
+    _fast_lock = threading.Lock()
+
+    @classmethod
+    def fast_unique(cls) -> "TaskID":
+        pid = os.getpid()
+        if pid != cls._fast_pid:
+            with cls._fast_lock:
+                if pid != cls._fast_pid:  # double-checked: one init wins
+                    import itertools
+
+                    cls._fast_prefix = os.urandom(_TASK_LEN - 8)
+                    cls._fast_counter = itertools.count()
+                    cls._fast_pid = pid
+        # next() on an itertools.count is atomic under the GIL.
+        return cls(cls._fast_prefix
+                   + next(cls._fast_counter).to_bytes(8, "little"))
+
 
 class ObjectID(BaseID):
     """TaskID (16B) + big-endian return index (4B)."""

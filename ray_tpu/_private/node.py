@@ -866,13 +866,25 @@ def _run_with_optional_profile(coro_factory, tag: str):
     import cProfile
 
     prof = cProfile.Profile()
+
+    def _dump():
+        prof.disable()
+        os.makedirs(prof_dir, exist_ok=True)
+        prof.dump_stats(os.path.join(prof_dir, f"{tag}_{os.getpid()}.pstats"))
+
+    # Workers hard-exit (os._exit skips finally/atexit): expose the dump
+    # so worker_main can flush the profile right before exiting.
+    global _profile_dump
+    _profile_dump = _dump
     prof.enable()
     try:
         asyncio.run(coro_factory())
     finally:
-        prof.disable()
-        os.makedirs(prof_dir, exist_ok=True)
-        prof.dump_stats(os.path.join(prof_dir, f"{tag}_{os.getpid()}.pstats"))
+        _profile_dump = None
+        _dump()
+
+
+_profile_dump = None
 
 
 def head_main():

@@ -992,7 +992,7 @@ class Worker:
 
     def submit_task(self, fid: str, msg_args: dict, num_returns,
                     opts: dict) -> List[ObjectRef]:
-        tid = TaskID.from_random()
+        tid = TaskID.fast_unique()
         refs = []
         oids = []
         deps = msg_args.pop("deps", None)
@@ -1343,7 +1343,7 @@ class Worker:
     def submit_actor_task_msg(self, actor_id: ActorID, method: str,
                               msg_args: dict, num_returns: int,
                               opts: dict) -> List[ObjectRef]:
-        tid = TaskID.from_random()
+        tid = TaskID.fast_unique()
         refs = []
         oids = []
         for i in range(num_returns):

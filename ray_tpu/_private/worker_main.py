@@ -78,6 +78,14 @@ class Executor:
         self.die_after_task = False
         self._server: Optional[asyncio.AbstractServer] = None
         self._direct_q: deque = deque()  # (conn, msg) leased exec pushes
+        # Batched sync actor-call pump (see _drain_sync_calls).
+        self._sync_calls: deque = deque()
+        self._sync_pump_running = False
+        self._batch_sync = False
+        # Batched task-completion delivery (see _flush_exec_replies).
+        self._exec_done: deque = deque()
+        self._exec_wake_scheduled = False
+        self._exec_wake_lock = threading.Lock()
         self._draining = False
         self.dags: Dict[str, dict] = {}  # compiled-DAG stage plans
         # TaskEventBuffer (reference: task_event_buffer.h:220): bounded local
@@ -124,8 +132,22 @@ class Executor:
     async def _on_direct_msg(self, conn: protocol.Connection, msg: dict):
         t = msg.get("t")
         if t == "actor_call":
-            # Submission order == arrival order: the executor pool is FIFO
-            # and we enqueue before any await.
+            # Fast path for plain sync methods on a max_concurrency=1
+            # actor: calls batch through ONE executor-thread hop per
+            # burst (see _drain_sync_calls) — the per-call thread
+            # round-trip (queue + loop self-wakeup + future) dominated
+            # worker CPU in the n:n async benchmark. Async methods and
+            # concurrency-group actors keep the general path.
+            if self._batch_sync and self.actor_instance is not None:
+                method = getattr(self.actor_instance, msg["m"], None)
+                if method is not None and \
+                        not asyncio.iscoroutinefunction(method):
+                    self._sync_calls.append((conn, msg, method))
+                    if not self._sync_pump_running:
+                        self._sync_pump_running = True
+                        asyncio.get_running_loop().run_in_executor(
+                            self.pool, self._drain_sync_calls)
+                    return
             asyncio.get_running_loop().create_task(
                 self._run_actor_call(conn, msg))
         elif t == "exec":
@@ -434,9 +456,28 @@ class Executor:
             err = True
         t1 = time.time()
         self.record_event(tid, fn_name, "task", t0, t1, not err)
-        loop.call_soon_threadsafe(
-            self._send_exec_reply, conn, msg,
-            {"results": results, "err": err, "t0": t0, "t1": t1})
+        # Completions from all pool threads funnel through ONE loop
+        # wakeup per burst (the per-task self-pipe write was a visible
+        # syscall cost at benchmark rates); replies then leave in one
+        # coalesced socket write per connection.
+        self._exec_done.append(
+            (conn, msg, {"results": results, "err": err,
+                         "t0": t0, "t1": t1}))
+        with self._exec_wake_lock:
+            if self._exec_wake_scheduled:
+                return
+            self._exec_wake_scheduled = True
+        loop.call_soon_threadsafe(self._flush_exec_replies)
+
+    def _flush_exec_replies(self):
+        # Clear the flag BEFORE draining: an append landing mid-drain
+        # either gets drained here or schedules its own wakeup — never
+        # strands.
+        with self._exec_wake_lock:
+            self._exec_wake_scheduled = False
+        while self._exec_done:
+            conn, msg, reply = self._exec_done.popleft()
+            self._send_exec_reply(conn, msg, reply)
 
     async def run_task(self, msg: dict):
         """GCS-dispatched execution (client-mode drivers and relays)."""
@@ -573,6 +614,10 @@ class Executor:
             name: threading.Semaphore(int(limit))
             for name, limit in
             (self.actor_opts.get("concurrency_groups") or {}).items()}
+        # Sync-call batching only where it cannot reduce concurrency: a
+        # single-threaded actor with no concurrency groups.
+        self._batch_sync = (not max_c or max_c <= 1) \
+            and not self.group_thread_sems
         try:
             await loop.run_in_executor(self.pool, self._init_actor_sync, msg)
             _boot_ts("actor_ready")
@@ -638,17 +683,7 @@ class Executor:
                     self.pool, self._execute_method_sync, method, msg, tid,
                     nret)
         except BaseException as e:  # noqa: BLE001
-            if (msg.get("opts") or {}).get("xlang"):
-                import msgpack
-
-                data = msgpack.packb(
-                    {"__xlang_error__": f"{type(e).__name__}: {e}"},
-                    use_bin_type=True)
-                results = [{"oid": ObjectID.for_task_return(
-                    TaskID(tid), 1).binary(), "nbytes": len(data),
-                    "data": data}]
-            else:
-                results = self._error_results(tid, nret, method_name, e)
+            results = self._actor_error_results(msg, tid, nret, e)
             ok = False
         for r in results:
             r.pop("_err", None)
@@ -711,6 +746,78 @@ class Executor:
             finish()
         except BaseException as e:  # noqa: BLE001
             finish(f"{type(e).__name__}: {e}")
+
+    def _actor_error_results(self, msg: dict, tid: bytes, nret: int,
+                             e: BaseException) -> List[dict]:
+        """Error reply for a failed actor call — xlang callers get a
+        msgpack ``__xlang_error__`` map (the shape the C++ client
+        parses); Python callers get a packed exception. Shared by the
+        per-call path and the batched sync pump."""
+        if (msg.get("opts") or {}).get("xlang"):
+            import msgpack
+
+            data = msgpack.packb(
+                {"__xlang_error__": f"{type(e).__name__}: {e}"},
+                use_bin_type=True)
+            return [{"oid": ObjectID.for_task_return(
+                TaskID(tid), 1).binary(), "nbytes": len(data),
+                "data": data}]
+        return self._error_results(tid, nret, msg["m"], e)
+
+    def _drain_sync_calls(self):
+        """Executor-thread pump: run every queued sync actor call, then
+        deliver all replies in one loop wakeup (write coalescing folds
+        them into one socket send per connection). FIFO: appends happen
+        only on the loop thread; the pump only pops; the running flag is
+        cleared back on the loop thread so no call can strand between
+        "pump saw empty" and "new call queued". The delivery wakeup is
+        in a ``finally``: NOTHING may leave the pump flag stuck True, or
+        every later sync call on this actor would hang."""
+        out = []
+        try:
+            while self._sync_calls:
+                conn, msg, method = self._sync_calls.popleft()
+                tid = msg["tid"]
+                nret = msg.get("nret", 1)
+                t0 = time.time()
+                ok = True
+                try:
+                    results = self._execute_method_sync(
+                        method, msg, tid, nret)
+                except BaseException as e:  # noqa: BLE001
+                    ok = False
+                    try:
+                        results = self._actor_error_results(
+                            msg, tid, nret, e)
+                    except BaseException:  # even error FORMATTING failed
+                        results = self._error_results(
+                            tid, 1, str(msg.get("m", "?")),
+                            RuntimeError("error formatting failed"))
+                out.append((conn, msg, results, ok, t0, time.time()))
+        finally:
+            try:
+                self.worker.loop.call_soon_threadsafe(
+                    self._deliver_sync_batch, out)
+            except RuntimeError:
+                pass  # loop closed (shutdown)
+
+    def _deliver_sync_batch(self, batch):
+        for conn, msg, results, ok, t0, t1 in batch:
+            for r in results:
+                r.pop("_err", None)
+            self.record_event(msg["tid"], msg["m"], "actor_call", t0, t1, ok)
+            if not conn.closed:
+                try:
+                    conn.reply(msg, {"results": results})
+                except ConnectionError:
+                    pass
+        # Cleared HERE (loop thread): a call that arrived while the pump
+        # was finishing restarts it rather than stranding.
+        self._sync_pump_running = False
+        if self._sync_calls:
+            self._sync_pump_running = True
+            self.worker.loop.run_in_executor(self.pool,
+                                             self._drain_sync_calls)
 
     def _execute_method_sync(self, method, msg: dict, tid: bytes,
                              nret: int) -> List[dict]:
@@ -871,6 +978,13 @@ async def amain(args):
         sys.stderr.flush()
     except Exception:
         pass
+    from . import node as _node
+
+    if _node._profile_dump is not None:
+        try:
+            _node._profile_dump()  # os._exit skips finally: flush now
+        except Exception:
+            pass
     os._exit(0)
 
 

@@ -878,6 +878,11 @@ class Dataset:
                     if j not in needed:
                         needed.append(j)
                     spec.append((needed.index(j), s - b_lo, e - s))
+            if not spec:
+                # Zero-row left block: ship one zero-row right slice so
+                # the task still has the right-hand SCHEMA to append.
+                needed = [0]
+                spec = [(0, 0, 0)]
             out.append(_zip_part.remote(
                 spec, lref, *[rrefs[j] for j in needed]))
             lo = hi

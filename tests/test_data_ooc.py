@@ -30,6 +30,17 @@ def test_zip_duplicate_columns_suffixed(ray_cluster):
     assert all(r["a_1"] == -r["a"] for r in rows)
 
 
+def test_zip_with_empty_left_block(ray_cluster):
+    """A filter can leave a zero-row block; zip must still work (the
+    empty left block pairs with a zero-row right slice)."""
+    left = rdata.from_items([{"a": i} for i in range(30)],
+                            parallelism=3).filter(lambda r: r["a"] >= 10)
+    right = rdata.from_items([{"b": i} for i in range(20)], parallelism=2)
+    rows = left.zip(right).take_all()
+    assert len(rows) == 20
+    assert [r["a"] for r in rows] == list(range(10, 30))
+
+
 def test_zip_length_mismatch_raises(ray_cluster):
     a = rdata.range(10)
     b = rdata.range(11)
