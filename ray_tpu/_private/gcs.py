@@ -934,6 +934,29 @@ class GcsServer:
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
 
+    async def _h_worker_memdump(self, client, msg):
+        """Relay a memory-introspection request to a worker by pid
+        (reference: on-demand memray/py-spy through the dashboard's
+        reporter — here the worker self-reports, no ptrace needed)."""
+        pid = msg.get("pid")
+        target = None
+        for w in self.workers.values():
+            if w.pid == pid and not w.conn.closed:
+                target = w
+                break
+        if target is None:
+            client.conn.reply(msg, {"ok": False,
+                                    "err": f"no live worker with pid {pid}"})
+            return
+        try:
+            reply = await target.conn.request({"t": "memdump"}, timeout=30)
+        except (ConnectionError, asyncio.TimeoutError) as e:
+            client.conn.reply(msg, {"ok": False, "err": str(e)})
+            return
+        reply.pop("i", None)
+        reply.pop("r", None)
+        client.conn.reply(msg, reply)
+
     async def _h_kv_get(self, client, msg):
         v = self.kv.get((msg.get("ns", ""), msg["k"]))
         client.conn.reply(msg, {"ok": v is not None, "v": v})

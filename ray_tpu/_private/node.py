@@ -461,9 +461,14 @@ class NodeAgent:
                     wrap=lambda argv, env: wrap_spawn(
                         env_spec, argv, env, self.session_dir, paths))
                 return
-            from ray_tpu.runtime_env.pip_env import ensure_venv
+            if env_spec.get("tool") == "conda":
+                from ray_tpu.runtime_env.conda_env import ensure_conda_env
 
-            venv = ensure_venv(env_spec)
+                venv = ensure_conda_env(env_spec)
+            else:
+                from ray_tpu.runtime_env.pip_env import ensure_venv
+
+                venv = ensure_venv(env_spec)
             # venv site-packages FIRST so requested packages override the
             # parent environment's copies; parent paths follow so the
             # framework and its deps stay importable.

@@ -983,7 +983,7 @@ class Worker:
             ch = self._actor_chans.pop(aid, None)
             if ch is not None and ch.conn is not None:
                 await ch.conn.close()
-        elif t == "exec" or t == "actor_init" or t == "cancel" or t == "exit":
+        elif t in ("exec", "actor_init", "cancel", "exit", "memdump"):
             # Only worker processes receive these; the executor overrides.
             await self.handle_control(msg)
 

@@ -898,8 +898,42 @@ async def amain(args):
             asyncio.get_running_loop().create_task(executor.init_actor(msg))
         elif t == "cancel":
             executor.cancel(msg["tid"], msg.get("force", False))
+        elif t == "memdump":
+            # On-demand memory introspection (reference: memray drivers in
+            # dashboard/modules/reporter/profile_manager.py): RSS + gc
+            # stats + top tracemalloc sites when tracing is on.
+            worker.gcs.reply(msg, _memdump())
         elif t == "exit":
             stop.set()
+
+    def _memdump() -> dict:
+        import gc
+        import resource
+        import tracemalloc
+
+        try:  # CURRENT rss (ru_maxrss is the lifetime peak — useless
+              # for watching memory recover or trend)
+            with open("/proc/self/statm") as f:
+                rss_kb = int(f.read().split()[1]) * (
+                    os.sysconf("SC_PAGE_SIZE") // 1024)
+        except (OSError, ValueError, IndexError):
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        out: Dict[str, Any] = {
+            "ok": True, "pid": os.getpid(),
+            "rss_kb": rss_kb,
+            "peak_rss_kb": resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss,
+            "gc_objects": len(gc.get_objects()),
+            "gc_counts": gc.get_count(),
+            "tracemalloc": tracemalloc.is_tracing(),
+        }
+        if tracemalloc.is_tracing():
+            snap = tracemalloc.take_snapshot()
+            out["top"] = [
+                {"site": str(s.traceback[0]), "kb": s.size // 1024,
+                 "count": s.count}
+                for s in snap.statistics("lineno")[:20]]
+        return out
 
     worker.handle_control = handle_control
     await executor.start()

@@ -226,8 +226,57 @@ class DashboardActor:
                 return {"error": "pass ?trace_id=<32-hex id>"}
             return tracing.get_trace(tid)
 
+        def memory_profile(request):
+            """Per-worker memory introspection (reference: memray drivers
+            in ``dashboard/modules/reporter/profile_manager.py``).
+            Default path needs NO tooling: the worker self-reports RSS,
+            gc stats and (when tracing) top tracemalloc sites over its
+            control connection. ``?engine=memray`` attaches memray when
+            it is installed (gated)."""
+            from ray_tpu._private.worker import global_worker
+            from ray_tpu.util import state
+
+            pid = request.query.get("pid")
+            if not pid or not pid.isdigit():
+                return {"error": "pass ?pid=<worker pid>"}
+            # Same gate as /api/profile: only cluster-owned pids — attach
+            # injects code, strictly more invasive than a stack dump.
+            cluster_pids = {w.get("pid") for w in state.list_workers()}
+            if int(pid) not in cluster_pids:
+                return {"error": f"pid {pid} is not a cluster worker",
+                        "cluster_pids": sorted(p for p in cluster_pids
+                                               if p is not None)}
+            if request.query.get("engine") == "memray":
+                import shutil
+                import subprocess
+
+                memray = shutil.which("memray")
+                if memray is None:
+                    return {"error": "memray is not installed on this host",
+                            "install": "pip install memray",
+                            "supported": False}
+                out = subprocess.run(
+                    [memray, "attach", pid, "--duration", "5"],
+                    capture_output=True, text=True, timeout=60)
+                return {"pid": int(pid), "engine": "memray",
+                        "output": out.stdout or out.stderr}
+            w = global_worker()
+            return w.run_async(w.gcs.request(
+                {"t": "worker_memdump", "pid": int(pid)}), timeout=35)
+
+        def grafana_dashboard(request):
+            """Generated Grafana dashboard JSON for this cluster's
+            Prometheus metrics (reference:
+            ``modules/metrics/grafana_dashboard_factory.py``)."""
+            from .grafana import generate_dashboard
+
+            return generate_dashboard()
+
         app.router.add_get("/", index)
         app.router.add_get("/api/profile", json_api(profile))
+        app.router.add_get("/api/memory", json_api(memory_profile))
+        app.router.add_get("/api/grafana_dashboard",
+                           json_api(grafana_dashboard))
         app.router.add_get("/api/trace", json_api(trace_api))
 
         app.router.add_get("/api/events",

@@ -2,17 +2,22 @@ from .block import BlockAccessor, to_block
 from .dataset import Dataset, MaterializedDataset
 from .iterator import DataIterator
 from .read_api import (
+    Datasource,
     from_arrow,
     from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
+    read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_webdataset,
 )
 
 __all__ = [
@@ -20,7 +25,8 @@ __all__ = [
     "to_block", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "from_huggingface",
     "range", "read_parquet", "read_csv", "read_json", "read_text",
-    "read_numpy",
+    "read_numpy", "read_binary_files", "read_images", "read_webdataset",
+    "Datasource", "read_datasource",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -40,10 +40,18 @@ def to_block(data: Batch) -> "pa.Table":
         cols = {}
         for k, v in data.items():
             v = np.asarray(v)
-            if v.ndim > 1:
+            if v.dtype == object and len(v) and \
+                    isinstance(v.flat[0], np.ndarray) and \
+                    v.flat[0].ndim >= 2:
+                # Ragged/tensor column (e.g. decoded images): arrow
+                # columns are 1-D, so each cell rides as
+                # {bytes, shape, dtype} — the accessor rebuilds the
+                # ndarray (reference: ArrowTensorArray extension type).
+                cols[k] = _encode_tensor_column(v)
+            elif v.ndim > 1:
                 cols[k] = pa.FixedSizeListArray.from_arrays(
                     pa.array(v.reshape(-1)), v.shape[-1]) \
-                    if v.ndim == 2 else pa.array(list(v))
+                    if v.ndim == 2 else _encode_tensor_column(v)
             else:
                 cols[k] = pa.array(v)
         return pa.table(cols)
@@ -54,6 +62,34 @@ def to_block(data: Batch) -> "pa.Table":
     if isinstance(data, np.ndarray):
         return to_block({"data": data})
     raise TypeError(f"cannot convert {type(data)} to a block")
+
+
+_TENSOR_FIELDS = ("__tb__", "__ts__", "__td__")
+
+
+def _encode_tensor_column(v: np.ndarray) -> "pa.Array":
+    """ndarray cells -> struct<__tb__: binary, __ts__: list<int>,
+    __td__: str> (a poor man's tensor extension array)."""
+    cells = list(v) if v.dtype == object else [v[i] for i in range(len(v))]
+    return pa.StructArray.from_arrays(
+        [pa.array([np.ascontiguousarray(c).tobytes() for c in cells],
+                  type=pa.binary()),
+         pa.array([list(c.shape) for c in cells],
+                  type=pa.list_(pa.int64())),
+         pa.array([str(c.dtype) for c in cells])],
+        names=list(_TENSOR_FIELDS))
+
+
+def _is_tensor_type(t) -> bool:
+    return (pa.types.is_struct(t) and t.num_fields == 3
+            and {t.field(i).name for i in range(3)} == set(_TENSOR_FIELDS))
+
+
+def _decode_tensor_cell(d: dict) -> np.ndarray:
+    # copy(): frombuffer views are read-only; UDFs mutate images in place.
+    return np.frombuffer(
+        d["__tb__"], dtype=np.dtype(d["__td__"])).reshape(
+        d["__ts__"]).copy()
 
 
 class BlockAccessor:
@@ -88,6 +124,14 @@ class BlockAccessor:
                 flat = col.combine_chunks().flatten().to_numpy(
                     zero_copy_only=False)
                 out[name] = flat.reshape(-1, width)
+            elif _is_tensor_type(col.type):
+                cells = [_decode_tensor_cell(d) for d in col.to_pylist()]
+                try:
+                    out[name] = np.stack(cells) if cells else np.array([])
+                except ValueError:  # ragged shapes stay object-dtype
+                    arr = np.empty(len(cells), dtype=object)
+                    arr[:] = cells
+                    out[name] = arr
             else:
                 out[name] = col.to_numpy(zero_copy_only=False)
         return out
@@ -105,7 +149,13 @@ class BlockAccessor:
         return self.block.slice(start, end - start)
 
     def rows(self) -> Iterable[dict]:
-        return self.block.to_pylist()
+        tensor_cols = [name for name in self.block.column_names
+                       if _is_tensor_type(self.block.column(name).type)]
+        rows = self.block.to_pylist()
+        for name in tensor_cols:
+            for r in rows:
+                r[name] = _decode_tensor_cell(r[name])
+        return rows
 
     @staticmethod
     def concat(blocks: List["pa.Table"]) -> "pa.Table":

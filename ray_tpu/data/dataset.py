@@ -1008,6 +1008,55 @@ class Dataset:
             block = ray_tpu.get(ref)
             pcsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
 
+    def write_json(self, path: str):
+        """One JSONL file per block (reference: ``Dataset.write_json``)."""
+        import json as jsonlib
+        import os
+
+        import base64
+
+        def enc(v):
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if isinstance(v, (bytes, bytearray)):
+                # bytes cells (read_binary_files / read_webdataset)
+                # round-trip as base64 strings.
+                return base64.b64encode(bytes(v)).decode("ascii")
+            return v
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            block = to_block(ray_tpu.get(ref))
+            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
+                for row in BlockAccessor(block).rows():
+                    f.write(jsonlib.dumps(
+                        {k: enc(v) for k, v in row.items()}) + "\n")
+
+    def write_numpy(self, path: str, column: str):
+        """One ``.npy`` per block of a single column (reference:
+        ``Dataset.write_numpy``)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            block = to_block(ray_tpu.get(ref))
+            arr = BlockAccessor(block).to_numpy()[column]
+            np.save(os.path.join(path, f"part-{i:05d}.npy"),
+                    np.asarray(arr))
+
+    def write_datasink(self, sink) -> None:
+        """Stream every block through a custom sink (reference:
+        ``ray.data.Datasink``): ``sink.write(block, block_index)`` per
+        block, with ``on_write_start/on_write_complete`` hooks."""
+        start = getattr(sink, "on_write_start", None)
+        if start is not None:
+            start()
+        for i, ref in enumerate(self._stream_refs()):
+            sink.write(to_block(ray_tpu.get(ref)), i)
+        done = getattr(sink, "on_write_complete", None)
+        if done is not None:
+            done()
+
     def __repr__(self):
         return self.stats()
 

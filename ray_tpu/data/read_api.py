@@ -170,3 +170,113 @@ def _read_numpy_file(path: str):
 def read_numpy(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
     return Dataset([functools.partial(_read_numpy_file, f) for f in files])
+
+
+def _read_binary_file(path: str, include_paths: bool):
+    with open(path, "rb") as f:
+        data = f.read()
+    out: Dict[str, Any] = {"bytes": np.array([data], dtype=object)}
+    if include_paths:
+        out["path"] = np.array([path])
+    return out
+
+
+def read_binary_files(paths: Union[str, List[str]], *,
+                      include_paths: bool = False, **kw) -> Dataset:
+    """One row per file with a ``bytes`` column (reference:
+    ``ray.data.read_binary_files``)."""
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_binary_file, f, include_paths)
+                    for f in files])
+
+
+def _read_image_file(path: str, size, mode, include_paths: bool):
+    from PIL import Image
+
+    img = Image.open(path)
+    if mode is not None:
+        img = img.convert(mode)
+    if size is not None:
+        img = img.resize((size[1], size[0]))
+    arr = np.asarray(img)
+    # One object-dtype cell per row: arrow columns are 1-D, image tensors
+    # are not (batch consumers re-stack via the block accessor).
+    col = np.empty(1, dtype=object)
+    col[0] = arr
+    out: Dict[str, Any] = {"image": col}
+    if include_paths:
+        out["path"] = np.array([path])
+    return out
+
+
+def read_images(paths: Union[str, List[str]], *,
+                size: Optional[tuple] = None, mode: Optional[str] = None,
+                include_paths: bool = False, **kw) -> Dataset:
+    """Decoded images as an ``image`` tensor column (reference:
+    ``ray.data.read_images``, ``read_api.py:598+``). ``size`` is
+    (height, width); ``mode`` a PIL mode like "RGB"."""
+    files = _expand_paths(paths)
+    return Dataset([
+        functools.partial(_read_image_file, f, size, mode, include_paths)
+        for f in files])
+
+
+def _read_webdataset_shard(path: str):
+    """One tar shard -> rows keyed by sample basename, one column per
+    extension (the webdataset convention: ``sample001.jpg`` +
+    ``sample001.cls`` + ... group into one row)."""
+    import tarfile
+
+    samples: Dict[str, Dict[str, bytes]] = {}
+    order: List[str] = []
+    with tarfile.open(path) as tar:
+        for member in tar:
+            if not member.isfile():
+                continue
+            # WebDataset convention: the extension starts at the FIRST
+            # dot of the BASENAME (directories may contain dots).
+            dirname, _, fname = member.name.rpartition("/")
+            stem, dot, ext = fname.partition(".")
+            base = f"{dirname}/{stem}" if dirname else stem
+            if base not in samples:
+                samples[base] = {}
+                order.append(base)
+            f = tar.extractfile(member)
+            samples[base][ext or "bin"] = f.read() if f else b""
+    cols = sorted({ext for s in samples.values() for ext in s})
+    out: Dict[str, Any] = {
+        "__key__": np.array(order, dtype=object)}
+    for ext in cols:
+        out[ext] = np.array([samples[k].get(ext, b"") for k in order],
+                            dtype=object)
+    return out
+
+
+def read_webdataset(paths: Union[str, List[str]], **kw) -> Dataset:
+    """WebDataset tar shards, one task per shard (reference:
+    ``ray.data.read_webdataset``)."""
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_webdataset_shard, f)
+                    for f in files])
+
+
+# ------------------------------------------------------- datasource plugin
+
+
+class Datasource:
+    """Custom connector API (reference: ``ray.data.Datasource``): return
+    per-task thunks, each producing one block of rows."""
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable[[], Any]]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1,
+                    **kw) -> Dataset:
+    tasks = datasource.get_read_tasks(max(parallelism, 1))
+    if not tasks:
+        return Dataset([to_block([])])
+    return Dataset(list(tasks))

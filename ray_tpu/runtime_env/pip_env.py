@@ -158,6 +158,10 @@ def spawn_spec_from_renv(renv: Optional[Dict[str, Any]]
         from .container import normalize_value
 
         return normalize_value(renv["image_uri"])
+    if renv.get("conda") is not None:
+        from .conda_env import normalize_conda
+
+        return normalize_conda(renv["conda"])
     if renv.get("uv") is not None:
         return normalize_spec(renv["uv"], "uv")
     if renv.get("pip") is not None:

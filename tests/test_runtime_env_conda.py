@@ -1,0 +1,57 @@
+"""Conda runtime env (reference: ``_private/runtime_env/conda.py``).
+
+Build tests are gated on a conda/micromamba binary; the spec plumbing and
+the no-conda error path run everywhere.
+"""
+
+import shutil
+
+import pytest
+
+from ray_tpu.runtime_env.conda_env import (conda_key, ensure_conda_env,
+                                           normalize_conda)
+from ray_tpu.runtime_env.pip_env import spawn_spec_from_renv
+
+HAVE_CONDA = any(shutil.which(n) for n in ("conda", "micromamba", "mamba"))
+
+
+def test_normalize_name_and_dict():
+    assert normalize_conda("myenv") == {"tool": "conda", "name": "myenv"}
+    spec = normalize_conda({"dependencies": ["python=3.12"]})
+    assert spec["tool"] == "conda"
+    assert spec["env"]["dependencies"] == ["python=3.12"]
+    with pytest.raises(ValueError, match="conda runtime_env"):
+        normalize_conda(42)
+
+
+def test_spawn_spec_routes_conda():
+    spec = spawn_spec_from_renv({"conda": "base"})
+    assert spec == {"tool": "conda", "name": "base"}
+    # conda takes precedence like the reference's exclusive env fields.
+    assert spawn_spec_from_renv({"pip": ["x"]})["tool"] == "pip"
+
+
+def test_keys_stable_and_distinct():
+    a = conda_key(normalize_conda("env-a"))
+    assert a == conda_key(normalize_conda("env-a"))
+    assert a != conda_key(normalize_conda("env-b"))
+
+
+@pytest.mark.skipif(HAVE_CONDA, reason="host has conda")
+def test_clear_error_without_conda():
+    with pytest.raises(RuntimeError, match="conda/micromamba"):
+        ensure_conda_env({"tool": "conda", "name": "whatever"})
+
+
+@pytest.mark.skipif(not HAVE_CONDA, reason="no conda binary")
+def test_named_env_resolves(ray_cluster):
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"conda": "base"})
+    def probe():
+        import sys
+
+        return sys.executable
+
+    exe = ray_tpu.get(probe.remote(), timeout=300)
+    assert "conda" in exe or "envs" in exe or exe  # resolved interpreter
