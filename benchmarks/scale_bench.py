@@ -89,5 +89,45 @@ def main():
     ray_tpu.shutdown()
 
 
+def many_nodes():
+    """Node-scale envelope (reference: ``test_many_nodes.py`` /
+    ``benchmarks/many_nodes.json`` — 349 tasks/s at 250 nodes): join N
+    in-process nodes, then sustain SPREAD tasks across all of them.
+    Run: ``python benchmarks/scale_bench.py --nodes [N]``."""
+    from ray_tpu.cluster_utils import Cluster
+
+    n_nodes = int(os.environ.get("SCALE_NODES", "30"))
+    c = Cluster(connect=True)
+    t0 = time.perf_counter()
+    for _ in range(n_nodes):
+        c.add_node(num_cpus=1, num_initial_workers=1)
+    assert c.wait_for_nodes(n_nodes + 1, timeout=600)
+    join_dt = time.perf_counter() - t0
+    assert c.wait_for_workers(timeout=600)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def whereami():
+        return os.environ.get("RAY_TPU_NODE_ID", "?")[:8]
+
+    import ray_tpu as rt
+
+    warm = rt.get([whereami.remote() for _ in range(n_nodes * 2)],
+                  timeout=600)
+    t0 = time.perf_counter()
+    N_TASKS = int(os.environ.get("SCALE_NODE_TASKS", "2000"))
+    out = rt.get([whereami.remote() for _ in range(N_TASKS)], timeout=600)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"many_nodes": {
+        "nodes": n_nodes + 1,
+        "join_per_s": round(n_nodes / join_dt, 1),
+        "distinct_nodes_hit": len(set(out) | set(warm)),
+        "sustained_tasks_per_s": round(N_TASKS / dt, 1),
+    }, "host_cores": os.cpu_count()}))
+    c.shutdown()
+
+
 if __name__ == "__main__":
-    main()
+    if "--nodes" in sys.argv:
+        many_nodes()
+    else:
+        main()
