@@ -428,6 +428,9 @@ class GcsServer:
         # blipped against a live GCS, replay nothing".
         self.epoch = os.urandom(8).hex()
         self._driver_exit_graces: Dict[bytes, Any] = {}
+        # Consecutive worker-spawn failures per runtime-env key (reset on
+        # a successful spawn); >= 3 fails that env's consumers fast.
+        self._env_failures: Dict[str, int] = {}
         self.log = None
         if persist:
             from .gcs_persistence import GcsLog
@@ -687,6 +690,8 @@ class GcsServer:
             info = WorkerInfo(worker_id, node_id, client.conn,
                               msg.get("addr", ""), msg.get("pid", 0))
             info.env_key = msg.get("env_key", "")
+            if info.env_key:
+                self._env_failures.pop(info.env_key, None)  # env builds now
             self.workers[worker_id] = info
             node = self.nodes.get(node_id)
             if node is not None:
@@ -1487,14 +1492,51 @@ class GcsServer:
         release the spawning slot so the pool doesn't wedge, and re-run a
         scheduling pass — parked actors / queued work re-request their
         worker through the freed slot (the event-driven replacement for
-        the old 0.05s per-actor retry poll)."""
+        the old 0.05s per-actor retry poll).
+
+        Per-env failure cap: an environment that repeatedly fails to
+        build can never produce a worker — after 3 consecutive failures
+        every consumer of that env fails fast with the build error
+        (reference: RuntimeEnvSetupError failing the creation) instead of
+        rebuilding forever."""
         node = self.nodes.get(NodeID(msg["node_id"]))
         if node is not None:
             node.spawning = max(0, node.spawning - 1)
+        err = str(msg.get("err", "worker spawn failed"))
         logger.warning("worker spawn failed on %s: %s",
                        msg.get("node_id", b"").hex()[:8] if msg.get("node_id")
-                       else "?", msg.get("err"))
+                       else "?", err)
+        env_key = msg.get("env_key", "")
+        if env_key:
+            count = self._env_failures.get(env_key, 0) + 1
+            self._env_failures[env_key] = count
+            if count >= 3:
+                self._fail_env_consumers(env_key, err)
         self._wake_scheduler()
+
+    def _fail_env_consumers(self, env_key: str, err: str):
+        """Fail every parked actor / pending lease demand waiting on an
+        environment that cannot build."""
+        cause = f"runtime env setup failed: {err}"
+        for record in list(self._actor_pending_place.values()):
+            if record.env_key == env_key:
+                self._actor_pending_place.pop(record.actor_id, None)
+                record.state = A_DEAD
+                record.death_cause = cause
+                self._cleanup_dead_actor(record)
+        for sig, q in list(self.pending.qs.items()):
+            for record in list(q):
+                if getattr(record, "env_key", "") != env_key:
+                    continue
+                if isinstance(record, LeaseDemand):
+                    record.cancelled = True
+                    if not record.client.conn.closed:
+                        try:
+                            record.client.conn.send(
+                                {"t": "lease_void", "key": record.key,
+                                 "err": cause})
+                        except ConnectionError:
+                            pass
 
     async def _h_lease_ret(self, client, msg):
         """A driver returns a leased worker; it becomes schedulable again."""

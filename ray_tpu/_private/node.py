@@ -478,13 +478,15 @@ class NodeAgent:
             # Runs on a builder thread: transport writes must be
             # marshalled onto the agent's event loop.
             err = str(e)
-            self._loop.call_soon_threadsafe(self._send_spawn_failed, err)
+            self._loop.call_soon_threadsafe(self._send_spawn_failed, err,
+                                            env_key)
 
-    def _send_spawn_failed(self, err: str):
+    def _send_spawn_failed(self, err: str, env_key: str = ""):
         if self.conn is not None and not self.conn.closed:
             try:
                 self.conn.send({"t": "spawn_failed",
                                 "node_id": self.node_id.binary(),
+                                "env_key": env_key,
                                 "err": err})
             except ConnectionError:
                 pass

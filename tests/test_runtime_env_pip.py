@@ -109,3 +109,24 @@ def test_framework_still_importable_in_env_worker(cluster, local_pkg):
         return (mod.MAGIC, int(np.arange(5).sum()))
 
     assert ray_tpu.get(both.remote(), timeout=120) == ("isolated-424242", 10)
+
+
+def test_unbuildable_env_fails_actor_fast(ray_cluster):
+    """An environment that can never build must FAIL its consumers with
+    the build error (reference: RuntimeEnvSetupError), not rebuild
+    forever while the creation hangs. The GCS caps consecutive spawn
+    failures per env key at 3."""
+    import pytest
+
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"pip": {"packages": ["/nonexistent/x.whl"],
+                                         "no_index": True}})
+    class Doomed:
+        def ping(self):
+            return 1
+
+    a = Doomed.remote()
+    with pytest.raises(ray_tpu.ActorDiedError,
+                       match="runtime env setup failed"):
+        ray_tpu.get(a.ping.remote(), timeout=120)
