@@ -1,0 +1,62 @@
+"""Pool API parity (reference: ``ray.util.multiprocessing.Pool``)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_map_and_starmap(ray_cluster):
+    with Pool(processes=4) as p:
+        assert p.map(_sq, range(20)) == [i * i for i in range(20)]
+        assert p.starmap(_add, [(i, i) for i in range(10)]) == \
+            [2 * i for i in range(10)]
+
+
+def test_apply_and_async(ray_cluster):
+    with Pool() as p:
+        assert p.apply(_add, (2, 3)) == 5
+        r = p.apply_async(_sq, (7,))
+        assert r.get(timeout=60) == 49
+        assert r.ready() and r.successful()
+        hits = []
+        m = p.map_async(_sq, range(5), callback=hits.append)
+        assert m.get(timeout=60) == [0, 1, 4, 9, 16]
+        assert hits == [[0, 1, 4, 9, 16]]
+
+
+def test_imap_orders(ray_cluster):
+    with Pool(processes=2) as p:
+        assert list(p.imap(_sq, range(12), chunksize=3)) == \
+            [i * i for i in range(12)]
+        assert sorted(p.imap_unordered(_sq, range(12), chunksize=3)) == \
+            sorted(i * i for i in range(12))
+
+
+def test_async_error_path(ray_cluster):
+    def boom(x):
+        raise ValueError("nope")
+
+    errs = []
+    with Pool() as p:
+        r = p.apply_async(boom, (1,), error_callback=errs.append)
+        with pytest.raises(ValueError, match="nope"):
+            r.get(timeout=60)
+        assert r.ready() and not r.successful()
+        assert errs and isinstance(errs[0], ValueError)
+
+
+def test_closed_pool_rejects(ray_cluster):
+    p = Pool()
+    p.close()
+    with pytest.raises(ValueError, match="not running"):
+        p.map(_sq, [1])
+    p.join()
