@@ -1,0 +1,30 @@
+"""joblib backend parity (reference: ``ray.util.joblib``)."""
+
+import pytest
+
+joblib = pytest.importorskip("joblib")
+
+import ray_tpu
+from ray_tpu.util.joblib import register_ray
+
+
+def _cube(x):
+    return x ** 3
+
+
+def test_parallel_over_cluster(ray_cluster):
+    register_ray()
+    from joblib import Parallel, delayed, parallel_backend
+
+    with parallel_backend("ray"):
+        out = Parallel(n_jobs=4)(delayed(_cube)(i) for i in range(20))
+    assert out == [i ** 3 for i in range(20)]
+
+
+def test_backend_name_and_njobs(ray_cluster):
+    register_ray()
+    from joblib import Parallel, delayed, parallel_backend
+
+    with parallel_backend("ray", n_jobs=-1):
+        out = Parallel()(delayed(_cube)(i) for i in range(5))
+    assert out == [0, 1, 8, 27, 64]
