@@ -77,6 +77,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    # Experiment callbacks (reference: ``ray.tune.Callback`` /
+    # ``air.RunConfig.callbacks``), invoked by the Tune loop.
+    callbacks: Optional[list] = None
 
     def resolved_storage_path(self) -> str:
         return os.path.expanduser(
