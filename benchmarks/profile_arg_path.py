@@ -1,0 +1,143 @@
+"""Profile the with-arg actor-call path (VERDICT r3 #2).
+
+Reproduces the microbench `n_n_actor_calls_with_arg_async` shape (4 actors,
+100KB numpy arg, async batches) and attributes per-call CPU across the
+driver / GCS / agent / worker processes via /proc stat deltas, plus an
+optional driver-side cProfile.
+
+Run: python benchmarks/profile_arg_path.py [--profile]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("RAY_TPU_JAX_PLATFORM", "cpu")
+
+import numpy as np
+
+import ray_tpu
+
+_CLK = os.sysconf("SC_CLK_TCK")
+
+
+def proc_cpu(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            parts = f.read().rsplit(b") ", 1)[1].split()
+        return (int(parts[11]) + int(parts[12])) / _CLK  # utime+stime
+    except Exception:
+        return 0.0
+
+
+def children_of(pid: int) -> dict:
+    """pid -> short cmdline for every descendant of pid."""
+    out = {}
+    by_ppid: dict = {}
+    for d in os.listdir("/proc"):
+        if not d.isdigit():
+            continue
+        try:
+            with open(f"/proc/{d}/stat", "rb") as f:
+                parts = f.read().rsplit(b") ", 1)
+            ppid = int(parts[1].split()[1])
+            name = parts[0].split(b"(", 1)[1].decode()
+        except Exception:
+            continue
+        by_ppid.setdefault(ppid, []).append((int(d), name))
+    frontier = [pid]
+    while frontier:
+        p = frontier.pop()
+        for (c, name) in by_ppid.get(p, []):
+            try:
+                with open(f"/proc/{c}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode()[:120]
+            except Exception:
+                cmd = name
+            out[c] = cmd
+            frontier.append(c)
+    return out
+
+
+def label(cmd: str) -> str:
+    if "gcs" in cmd or "head" in cmd:
+        return "gcs"
+    if "agent" in cmd or "node" in cmd:
+        return "agent"
+    if "worker" in cmd or "-c" in cmd:
+        return "worker"
+    return "other"
+
+
+def main():
+    do_profile = "--profile" in sys.argv
+    n = int(os.environ.get("N", "2000"))
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False)
+
+    @ray_tpu.remote
+    class Actor:
+        def with_arg(self, arr):
+            return arr.nbytes
+
+    actors = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([a.with_arg.remote(np.zeros(8)) for a in actors])
+
+    arr = np.zeros(100 * 1024, dtype=np.uint8)
+
+    # warmup
+    ray_tpu.get([actors[i % 4].with_arg.remote(arr) for i in range(100)])
+    time.sleep(1.0)
+
+    procs = children_of(os.getpid())
+    me = os.getpid()
+    before = {p: proc_cpu(p) for p in procs}
+    before[me] = proc_cpu(me)
+
+    prof = None
+    if do_profile:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+    t0 = time.perf_counter()
+    refs = [actors[i % 4].with_arg.remote(arr) for i in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    if prof is not None:
+        prof.disable()
+
+    after = {p: proc_cpu(p) for p in before}
+    rate = n / dt
+    print(f"\nrate: {rate:.1f} calls/s  ({dt/n*1e6:.0f} us/call wall)")
+    agg: dict = {}
+    for p, b in before.items():
+        d = after[p] - b
+        if d <= 0:
+            continue
+        lbl = "driver" if p == me else label(procs.get(p, ""))
+        agg[lbl] = agg.get(lbl, 0.0) + d
+        if d > 0.05:
+            print(f"  pid {p} [{lbl}] {d:.2f}s cpu "
+                  f"({d/n*1e6:.0f} us/call)  {procs.get(p,'driver')[:80]}")
+    print("\nper-call CPU by role:")
+    for lbl, d in sorted(agg.items(), key=lambda kv: -kv[1]):
+        print(f"  {lbl:8s} {d:.2f}s  = {d/n*1e6:.0f} us/call")
+    print(f"  TOTAL    {sum(agg.values()):.2f}s  = "
+          f"{sum(agg.values())/n*1e6:.0f} us/call  (wall {dt/n*1e6:.0f})")
+
+    if prof is not None:
+        import pstats
+
+        st = pstats.Stats(prof)
+        st.sort_stats("cumulative")
+        st.print_stats(25)
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -166,6 +166,10 @@ sys.path[:0] = os.environ['RAY_TPU_SYS_PATH'].split(os.pathsep)
 import ray_tpu._private.worker_main as wm
 import ray_tpu._private.node         # noqa: F401 (pre-import for forks)
 import ray_tpu._private.jax_platform  # noqa: F401
+# numpy rides nearly every arg/result bundle (zero-copy array views);
+# importing it lazily at a forked worker's FIRST array deserialize costs
+# ~1s of single-core time per worker — pay it once in the template.
+import numpy                          # noqa: F401
 signal.signal(signal.SIGCHLD, signal.SIG_IGN)
 sys.stdout.write("READY\\n"); sys.stdout.flush()
 for line in sys.stdin:

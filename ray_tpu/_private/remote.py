@@ -156,7 +156,12 @@ def _prepare_args(args: tuple, kwargs: dict,
         return out
     oid = w.put_serialized(sobj)
     # Hold a reference until the consuming task is done: register then let
-    # the GCS-side refcount keep it; the executing worker borrows it.
+    # the GCS-side refcount keep it; the executing worker borrows it. The
+    # matching -1 is queued by Worker.release_task_args when the task (and
+    # any lineage spec pinning it) reaches a terminal state; the liveness
+    # note keeps a control-plane-restart resync honest about the in-flight
+    # count.
+    w.note_ref_live(oid, +1)
     out["argsref"] = oid.binary()
     out["argsn"] = sobj.total_size
     return out

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 import traceback
 from typing import Any, List, Optional, Tuple
 
@@ -26,6 +27,20 @@ import msgpack
 
 _U32 = struct.Struct("<I")
 _ALIGN = 64
+
+# Side-effect ledger for the two-attempt serialize below: pickling an
+# ObjectRef sends the borrower's +1 IMMEDIATELY (worker.ObjectRef.__reduce__
+# — sender-side incref, see its docstring). If the stdlib attempt pickles
+# some refs and then fails on a later object, the cloudpickle retry re-fires
+# those increfs; the undo callbacks recorded here balance the first
+# attempt's, or a ref copy that never reaches a receiver leaks its count.
+_REDUCE_LEDGER = threading.local()
+
+
+def note_reduce_undo(undo) -> None:
+    lst = getattr(_REDUCE_LEDGER, "lst", None)
+    if lst is not None:
+        lst.append(undo)
 
 
 def _align(n: int) -> int:
@@ -74,7 +89,30 @@ class SerializedObject:
 
 
 def serialize(value: Any) -> SerializedObject:
+    # Fast path: the stdlib C pickler (3x cheaper than cloudpickle for the
+    # hot arg/result shapes — tuples of arrays/scalars). It must not be
+    # allowed to pickle ``__main__``-defined functions/classes BY REFERENCE
+    # (the executing worker's ``__main__`` is the worker bootstrap, not the
+    # driver script — the reference always routes through cloudpickle for
+    # this reason, ``_private/serialization.py:122``): any by-ref global
+    # record names its module, so a ``__main__`` marker in the bytes means
+    # the value needs cloudpickle's by-value treatment. False positives
+    # (the literal string in user data) just take the slow path.
     buffers: List[pickle.PickleBuffer] = []
+    prev = getattr(_REDUCE_LEDGER, "lst", None)
+    _REDUCE_LEDGER.lst = undo = []
+    try:
+        pickled = pickle.dumps(value, protocol=5,
+                               buffer_callback=buffers.append)
+        if b"__main__" not in pickled:
+            return SerializedObject(pickled, buffers)
+    except Exception:
+        pass
+    finally:
+        _REDUCE_LEDGER.lst = prev
+    for cb in undo:
+        cb()
+    buffers = []
     pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
     return SerializedObject(pickled, buffers)
 

@@ -88,6 +88,11 @@ class ObjectRef:
         if self._worker is not None:
             self._worker.promote_on_serialize(self.id)
             self._worker.send_ref_incref_now(self.id)
+            # Balance this +1 if serialize() retries with cloudpickle
+            # after a failed stdlib attempt (serialization._REDUCE_LEDGER).
+            serialization.note_reduce_undo(
+                lambda w=self._worker, oid=self.id:
+                    w.send_ref_decref_now(oid))
         return (_deserialize_object_ref, (self.id.binary(),))
 
     def __del__(self):
@@ -174,7 +179,7 @@ class _TaskClass:
 
 class _TaskItem:
     __slots__ = ("msg", "oids", "retries", "cancelled", "name", "created",
-                 "deps_left")
+                 "deps_left", "args_pins")
 
     def __init__(self, msg: dict, oids: List[ObjectID], retries: int,
                  name: str):
@@ -185,6 +190,12 @@ class _TaskItem:
         self.name = name
         self.created = time.time()
         self.deps_left = 0
+        # Reasons the task's arg bundle must stay alive: one pin for the
+        # in-flight execution (held through retries/resubmissions until a
+        # terminal disposition) plus one per retained lineage spec. The
+        # bundle releases when the count reaches zero — never while a
+        # reconstruction resubmission is in flight or any spec remains.
+        self.args_pins = 1
 
 
 # In-flight pipeline depth per leased worker: >1 overlaps the push/reply
@@ -251,9 +262,17 @@ class Worker:
         self._object_futures: Dict[ObjectID, SyncFuture] = {}
         self._memory_store: Dict[ObjectID, bytes] = {}
         self._ref_deltas: Dict[ObjectID, int] = {}
+        # Count-only corrections (failed-serialize incref undos queued
+        # while the GCS link was down): flushed with _ref_deltas but NEVER
+        # treated as local ref releases (no lineage-spec drop).
+        self._pure_deltas: Dict[ObjectID, int] = {}
         # Net live local refs per object — the resync payload that rebuilds
         # GCS refcounts after a control-plane restart.
         self._live_refs: Dict[ObjectID, int] = {}
+        # Actor id -> ctor arg-bundle ObjectID (>INLINE_THRESHOLD ctor
+        # args); released when the actor is PERMANENTLY dead (restarts
+        # resend the same creation msg, so the bundle must outlive them).
+        self._actor_ctor_args: Dict[ActorID, ObjectID] = {}
         self._ref_lock = threading.Lock()
         self._actor_chans: Dict[ActorID, _ActorChannel] = {}
         self._dead_actors: Dict[ActorID, str] = {}
@@ -423,8 +442,11 @@ class Worker:
         if gcs_restarted:
             with self._ref_lock:
                 # Queued deltas are already folded into _live_refs; the
-                # fresh instance gets the snapshot, not the stream.
+                # fresh instance gets the snapshot, not the stream. Pure
+                # corrections balance increfs the dead GCS already saw —
+                # meaningless to a fresh instance.
                 self._ref_deltas.clear()
+                self._pure_deltas.clear()
                 live = [(oid.binary(), n)
                         for oid, n in self._live_refs.items()]
             if live:
@@ -500,6 +522,28 @@ class Worker:
         with self._ref_lock:
             self._ref_deltas[object_id] = self._ref_deltas.get(object_id, 0) + delta
 
+    def release_task_args(self, msg: dict):
+        """Drop the owner's reference on a task's shm-resident argument
+        bundle once the task reached a terminal state (the executing worker
+        only borrows it — reference: ``DependencyResolver`` releases inlined
+        dependencies after dispatch, ``transport/dependency_resolver.h``).
+        Without this, every >100KB-arg call leaks an arena block for the
+        driver's lifetime. Idempotent per task via a flag on the retained
+        msg dict (retries re-use the same dict; the flag is only set once
+        no resend can happen)."""
+        ab = msg.get("argsref")
+        if ab is None or msg.get("_args_rel"):
+            return
+        msg["_args_rel"] = True
+        self._release_arg_ref(ObjectID(bytes(ab)))
+
+    def _release_arg_ref(self, oid: ObjectID):
+        """Drop one owner reference on an argument bundle: the liveness
+        note (resync honesty) and the batched GCS decrement, together —
+        every arg-release site must use this pair."""
+        self.note_ref_live(oid, -1)
+        self.queue_ref_delta(oid, -1)
+
     def _flush_refs_cb(self):
         self._flush_refs()
         if not self.closed:
@@ -515,21 +559,33 @@ class Worker:
         with self._ref_lock:
             deltas = [(oid.binary(), d) for oid, d in self._ref_deltas.items()
                       if d != 0]
+            pure = [(oid.binary(), d) for oid, d in self._pure_deltas.items()
+                    if d != 0]
             self._ref_deltas.clear()
-        if deltas:
+            self._pure_deltas.clear()
+        if deltas or pure:
             try:
-                self.gcs.send({"t": "ref", "d": deltas})
+                self.gcs.send({"t": "ref", "d": deltas + pure})
             except ConnectionError:
                 with self._ref_lock:
                     for oid_b, d in deltas:
                         oid = ObjectID(oid_b)
                         self._ref_deltas[oid] = \
                             self._ref_deltas.get(oid, 0) + d
+                    for oid_b, d in pure:
+                        oid = ObjectID(oid_b)
+                        self._pure_deltas[oid] = \
+                            self._pure_deltas.get(oid, 0) + d
                 return
             for oid_b, d in deltas:
                 if d < 0:
-                    # Released refs no longer need lineage specs.
-                    self._task_specs.pop(oid_b, None)
+                    # Released refs no longer need lineage specs — and a
+                    # dropped spec un-pins its task's argument bundle.
+                    # (pure deltas are count corrections, not releases —
+                    # they must not drop specs.)
+                    spec = self._task_specs.pop(oid_b, None)
+                    if spec is not None:
+                        self._args_unpin(spec[2])
         self._flush_notes()
 
     def _queue_task_note(self, note: tuple):
@@ -908,6 +964,22 @@ class Worker:
             # live count in the snapshot resync.
             self.queue_ref_delta(object_id, +1)
 
+    def send_ref_decref_now(self, object_id: ObjectID):
+        """Balance a ``send_ref_incref_now`` whose pickled ref copy never
+        left this process (serialize()'s stdlib attempt fired the incref,
+        then fell back to cloudpickle which re-fires it). Must NOT go
+        through ``queue_ref_delta``: ``_flush_refs`` reads queued -1s as
+        local ref releases and drops the object's lineage spec — this
+        decrement is pure count correction, the local ref is still alive."""
+        if self.gcs is not None and not self.gcs.closed:
+            self.loop.call_soon_threadsafe(
+                self._send_gcs,
+                {"t": "ref", "d": [(object_id.binary(), -1)]})
+        else:
+            with self._ref_lock:
+                self._pure_deltas[object_id] = \
+                    self._pure_deltas.get(object_id, 0) - 1
+
     def promote_on_serialize(self, object_id: ObjectID):
         """Register a locally-held inline value with the GCS so a borrower
         can resolve the ref (lazy ownership promotion)."""
@@ -981,6 +1053,12 @@ class Worker:
             aid = ActorID(msg["aid"])
             self._dead_actors[aid] = msg.get("cause", "actor died")
             ch = self._actor_chans.pop(aid, None)
+            # Permanent death (the GCS only broadcasts actor_dead from
+            # _cleanup_dead_actor): no restart will re-read the ctor arg
+            # bundle — drop our pin.
+            ctor_oid = self._actor_ctor_args.pop(aid, None)
+            if ctor_oid is not None:
+                self._release_arg_ref(ctor_oid)
             if ch is not None and ch.conn is not None:
                 await ch.conn.close()
         elif t in ("exec", "actor_init", "cancel", "exit", "memdump"):
@@ -1204,12 +1282,26 @@ class Worker:
             reply.get("t0", 0.0), reply.get("t1", 0.0), lease.wid))
         # Keep the spec for owner-side lineage reconstruction
         # (reference: ObjectRecoveryManager, object_recovery_manager.h:41)
-        # while the object may still be lost; dropped on ref release.
+        # while the object may still be lost; dropped on ref release. A
+        # retained spec pins the task's args too — a reconstruction resubmit
+        # resends the same msg — so args release when the spec drops.
         if not reply.get("err") and item.retries != 0:
             for r in results:
-                if r.get("shm"):
-                    self._task_specs[bytes(r["oid"])] = (cls.key, cls.wire,
-                                                         item)
+                if not r.get("shm"):
+                    continue
+                # Only retain a spec while this process still holds a live
+                # local ref to the result: a ref dropped BEFORE completion
+                # already flushed its -1 (the spec-drop trigger), so a spec
+                # retained now would never be un-pinned — leaking the spec
+                # and the task's arg bundle.
+                oid = ObjectID(bytes(r["oid"]))
+                with self._ref_lock:
+                    live = self._live_refs.get(oid, 0) > 0
+                if live:
+                    self._retain_spec(oid.binary(), cls.key, cls.wire,
+                                      item)
+        # Terminal disposition of this execution: drop its args pin.
+        self._args_unpin(item)
         self._pump_class(cls)
 
     def _finish_item_error(self, item: _TaskItem, exc: Exception):
@@ -1220,6 +1312,9 @@ class Worker:
             for oid in item.oids])
         self._queue_task_note((
             item.msg["tid"], item.name, 1, item.created, 0.0, 0.0, None))
+        # Terminal disposition: drop the execution's args pin (other
+        # outputs' retained specs may still hold their own pins).
+        self._args_unpin(item)
 
     def _on_lease_broken(self, cls: _TaskClass, lease: _Lease):
         if lease.dead:
@@ -1279,6 +1374,20 @@ class Worker:
         # In-flight replies fail via the closing conn; just refresh demand.
         self._pump_class(cls)
 
+    def _retain_spec(self, oid_b: bytes, key: str, wire: dict,
+                     item: _TaskItem):
+        old = self._task_specs.get(oid_b)
+        if old is not None and old[2] is not item:
+            self._args_unpin(old[2])
+        if old is None or old[2] is not item:
+            item.args_pins += 1
+        self._task_specs[oid_b] = (key, wire, item)
+
+    def _args_unpin(self, item: _TaskItem):
+        item.args_pins -= 1
+        if item.args_pins <= 0:
+            self.release_task_args(item.msg)
+
     def maybe_reconstruct(self, object_id: ObjectID) -> bool:
         """Owner-side lineage reconstruction: resubmit the producing task
         for a lost object (reference: object_recovery_manager.h:41)."""
@@ -1286,6 +1395,9 @@ class Worker:
         if spec is None:
             return False
         key, wire, item = spec
+        # args_pins unchanged: the popped spec's pin transfers to the
+        # resubmission now entering flight (its terminal disposition in
+        # _on_exec_reply/_finish_item_error decrements it).
         for oid in item.oids:
             self._object_futures.pop(oid, None)
             fut = SyncFuture()
@@ -1337,7 +1449,15 @@ class Worker:
             "t": "actor_create", "aid": aid.binary(), "fid": fid,
             "opts": opts, **msg_args}))
         if not reply.get("ok"):
+            # The bundle will never be consumed — release it now.
+            if msg_args.get("argsref") is not None:
+                self._release_arg_ref(ObjectID(bytes(msg_args["argsref"])))
             raise ValueError(reply.get("err", "actor creation failed"))
+        # A shm ctor-arg bundle must survive actor RESTARTS (the GCS
+        # resends the same creation msg); release it only on permanent
+        # death (the actor_dead push in _on_gcs_push).
+        if msg_args.get("argsref") is not None:
+            self._actor_ctor_args[aid] = ObjectID(bytes(msg_args["argsref"]))
         return aid
 
     def submit_actor_task_msg(self, actor_id: ActorID, method: str,
@@ -1522,6 +1642,7 @@ class Worker:
                 self._send_gcs({"t": "obj_put", "oid": r["oid"],
                                 "nbytes": r["nbytes"], "shm": True})
         self.push_result(call["tid"], results)
+        self.release_task_args(call)
 
     def _actor_call_failed(self, actor_id: ActorID, call: dict,
                            oids: List[ObjectID], retries: int,
@@ -1540,6 +1661,7 @@ class Worker:
         self.push_result(call["tid"], [
             {"oid": oid.binary(), "nbytes": len(err), "data": err}
             for oid in oids])
+        self.release_task_args(call)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.loop.call_soon_threadsafe(self._send_gcs, {
