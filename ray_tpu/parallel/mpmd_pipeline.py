@@ -10,23 +10,31 @@ pipelines on. Here the pipeline is first-class and TPU-shaped:
   * each STAGE is an actor (one per slice; on a real pod each stage actor
     is the slice's host group and runs its own intra-slice SPMD program),
   * activations flow stage→stage over the object plane (direct
-    actor-to-actor channels / p2p chunk pull — the DCN path),
-  * the backward pass runs through the same compiled-DAG chain: stage 1
-    returns the activation cotangent, stage 0 finishes its VJP,
-  * the microbatch schedule is GPipe: all microbatches stream through the
-    compiled pipeline concurrently (``max_inflight`` covers the whole
-    schedule), gradients accumulate per stage, one optimizer step per
-    global batch.
+    actor-to-actor channels / p2p chunk pull — the DCN path), optionally
+    down-cast to ``bfloat16`` for the wire (halves DCN bytes; the
+    backward cotangents take the same cast),
+  * the backward pass runs through the same compiled-DAG chain in
+    reverse: the last stage emits the activation cotangent, each mid
+    stage consumes it, finishes its saved VJP, and emits the next one,
+    and stage 0 finishes the chain,
+  * the microbatch schedule is 1F1B-style by default: the compiled
+    chain's ``max_inflight`` admits at most ``n_stages`` microbatches
+    into the pipe, so each stage holds at most ``n_stages`` live VJP
+    closures (memory bounded by pipeline DEPTH, not microbatch count —
+    the reference bounds compiled-DAG memory the same way via its
+    execution schedule, ``python/ray/dag/dag_node_operation.py``).
+    ``schedule="gpipe"`` restores the all-at-once window.
 
 Numerical contract: with equal-size microbatches, mean-of-microbatch
 losses and averaged accumulated gradients reproduce the single-program
 ``llama.loss_fn`` exactly (per-row next-token targets make the batch split
 exact) — tested against the single-mesh SPMD pipeline in
-``tests/test_mpmd_pipeline.py``.
+``tests/test_mpmd_pipeline.py`` for 2 AND 3+ stages.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -49,6 +57,11 @@ def split_llama_params(params: Dict[str, Any], n_stages: int
             "embedding, the last stage owns lm_head)")
     layers = params["layers"]
     n = len(layers)
+    if n_stages < 2:
+        raise ValueError("a pipeline needs at least 2 stages")
+    if n < n_stages:
+        raise ValueError(
+            f"{n} layers cannot fill {n_stages} pipeline stages")
     per = [n // n_stages + (1 if i < n % n_stages else 0)
            for i in range(n_stages)]
     out: List[Dict[str, Any]] = []
@@ -118,10 +131,12 @@ def stage_loss(stage_params, act, targets, cfg, *, first: bool = False,
 @ray_tpu.remote
 class PipelineStageActor:
     """One pipeline stage (one slice). Holds its param shard, per-
-    microbatch VJP closures, and a local optimizer."""
+    microbatch VJP closures, a local optimizer, and a busy-time clock
+    (per-stage utilization → the driver's bubble-fraction report)."""
 
     def __init__(self, stage_idx: int, n_stages: int, cfg_blob: bytes,
-                 params_blob: bytes, lr: float, n_microbatches: int):
+                 params_blob: bytes, lr: float, n_microbatches: int,
+                 transport_dtype: Optional[str] = None):
         import cloudpickle
         import jax
         import optax
@@ -133,11 +148,13 @@ class PipelineStageActor:
         params = cloudpickle.loads(params_blob)
         self.params = jax.tree.map(jax.numpy.asarray, params)
         self.n_microbatches = n_microbatches
+        self.transport_dtype = transport_dtype
         self.opt = optax.adamw(lr)
         self.opt_state = self.opt.init(self.params)
         self._vjps: Dict[int, Any] = {}
         self._accum = None
         self._step_losses: List[float] = []
+        self._busy = 0.0
 
     def _accumulate(self, grads):
         if self._accum is None:
@@ -146,10 +163,27 @@ class PipelineStageActor:
             self._accum = self.jax.tree.map(
                 lambda a, g: a + g, self._accum, grads)
 
+    def _cast_wire(self, arr):
+        """Down-cast an activation/cotangent for the DCN hop."""
+        a = np.asarray(arr)
+        if self.transport_dtype is not None:
+            import ml_dtypes
+
+            a = a.astype(np.dtype(getattr(ml_dtypes, self.transport_dtype,
+                                          self.transport_dtype)))
+        return a
+
+    def _cast_compute(self, arr, like=None):
+        """Up-cast a received wire array back to the compute dtype."""
+        jnp = self.jax.numpy
+        dt = like if like is not None else self.cfg.dtype
+        return jnp.asarray(np.asarray(arr)).astype(dt)
+
     # ------------------------------------------------------ pipeline hops
 
     def fwd(self, packet):
         """First stage: tokens -> activation (VJP saved per microbatch)."""
+        t0 = time.perf_counter()
         jnp = self.jax.numpy
         mb, tokens, targets = packet
         tokens = jnp.asarray(tokens)
@@ -157,15 +191,34 @@ class PipelineStageActor:
         out, vjp = self.jax.vjp(
             lambda p: stage_forward(p, tokens, self.cfg, first=True),
             self.params)
-        self._vjps[mb] = vjp
-        return (mb, np.asarray(out), targets)
+        self._vjps[mb] = (vjp, out.dtype)
+        out = self._cast_wire(out)
+        self._busy += time.perf_counter() - t0
+        return (mb, out, targets)
+
+    def mid_fwd(self, packet):
+        """Mid stage: activation -> activation (VJP over params AND the
+        incoming activation, so backward can emit the upstream
+        cotangent)."""
+        t0 = time.perf_counter()
+        mb, act, targets = packet
+        act = self._cast_compute(act)
+
+        out, vjp = self.jax.vjp(
+            lambda p, a: stage_forward(p, a, self.cfg, first=False),
+            self.params, act)
+        self._vjps[mb] = (vjp, out.dtype)
+        out = self._cast_wire(out)
+        self._busy += time.perf_counter() - t0
+        return (mb, out, targets)
 
     def loss_bwd(self, packet):
         """Last stage: activation -> loss; returns the activation
         cotangent for the upstream stage's backward."""
+        t0 = time.perf_counter()
         jnp = self.jax.numpy
         mb, act, targets = packet
-        act = jnp.asarray(act)
+        act = self._cast_compute(act)
         targets = jnp.asarray(targets)
 
         loss, vjp = self.jax.vjp(
@@ -175,16 +228,32 @@ class PipelineStageActor:
         self._accumulate(gp)
         loss = float(loss)
         self._step_losses.append(loss)
-        return (mb, np.asarray(gact), loss)
+        gact = self._cast_wire(gact)
+        self._busy += time.perf_counter() - t0
+        return (mb, gact, loss)
+
+    def mid_bwd(self, packet):
+        """Mid stage backward: finish the saved VJP with the downstream
+        cotangent; accumulate the param grad; emit the upstream
+        cotangent."""
+        t0 = time.perf_counter()
+        mb, gact, loss = packet
+        vjp, out_dtype = self._vjps.pop(mb)
+        gp, gact_up = vjp(self._cast_compute(gact, like=out_dtype))
+        self._accumulate(gp)
+        gact_up = self._cast_wire(gact_up)
+        self._busy += time.perf_counter() - t0
+        return (mb, gact_up, loss)
 
     def bwd(self, packet):
         """First stage: finish the saved VJP with the cotangent from the
         next slice; passes the microbatch loss through to the driver."""
-        jnp = self.jax.numpy
+        t0 = time.perf_counter()
         mb, gact, loss = packet
-        vjp = self._vjps.pop(mb)
-        (gp,) = vjp(jnp.asarray(gact))
+        vjp, out_dtype = self._vjps.pop(mb)
+        (gp,) = vjp(self._cast_compute(gact, like=out_dtype))
         self._accumulate(gp)
+        self._busy += time.perf_counter() - t0
         return loss
 
     # -------------------------------------------------------- step control
@@ -213,45 +282,104 @@ class PipelineStageActor:
 
         return float(optax.global_norm(self._accum)) / self.n_microbatches
 
+    def take_busy(self) -> float:
+        """Return and reset this stage's busy-seconds accumulator."""
+        b, self._busy = self._busy, 0.0
+        return b
+
+    def live_vjp_count(self) -> int:
+        return len(self._vjps)
+
     def get_params(self):
         return self.jax.tree.map(np.asarray, self.params)
 
 
 class MPMDPipeline:
-    """Driver handle: a 2+-stage cross-slice pipeline-parallel trainer.
+    """Driver handle: an N-stage cross-slice pipeline-parallel trainer.
 
-    ``step(tokens)`` runs one GPipe step: microbatches stream through the
-    compiled actor chain (fwd hops forward, cotangent hop backward), each
-    stage accumulates grads, then both stages apply their optimizer.
+    ``step(tokens)`` runs one pipelined step: microbatches stream through
+    the compiled actor chain (fwd hops forward, cotangent hops backward),
+    each stage accumulates grads, then every stage applies its optimizer.
+
+    ``schedule``:
+      * ``"1f1b"`` (default) — at most ``n_stages`` microbatches in
+        flight; per-stage live VJPs are bounded by pipeline depth.
+      * ``"gpipe"`` — all microbatches stream at once (max overlap, peak
+        memory ∝ microbatch count).
+
+    ``transport_dtype="bfloat16"`` down-casts activations AND cotangents
+    for the inter-stage hop (half the DCN bytes; compute stays in
+    ``cfg.dtype``).
+
+    After each ``step()``/``grad_check_step()``, ``last_step_stats`` holds
+    ``{"wall_s", "stage_busy_s", "bubble_fraction"}`` where
+    bubble_fraction = 1 − mean(stage busy)/wall — the pipeline-bubble
+    measure the schedule is trying to minimize.
     """
 
     def __init__(self, cfg, params: Dict[str, Any], *, n_stages: int = 2,
                  n_microbatches: int = 2, lr: float = 1e-3,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 schedule: str = "1f1b",
+                 transport_dtype: Optional[str] = None):
         import cloudpickle
 
-        if n_stages != 2:
-            raise NotImplementedError(
-                "compiled-chain schedule currently covers 2 stages "
-                "(first + last); deeper pipelines insert mid stages")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule {schedule!r}")
         self.cfg = cfg
+        self.n_stages = n_stages
         self.n_microbatches = n_microbatches
+        self.schedule = schedule
+        self.last_step_stats: Optional[dict] = None
         stage_params = split_llama_params(
             jax_tree_to_numpy(params), n_stages)
         cfg_blob = cloudpickle.dumps(cfg)
         self.stages = [
             PipelineStageActor.remote(
                 i, n_stages, cfg_blob, cloudpickle.dumps(stage_params[i]),
-                lr, n_microbatches)
+                lr, n_microbatches, transport_dtype)
             for i in range(n_stages)
         ]
-        s0, s1 = self.stages
         from ray_tpu.dag import InputNode
 
         with InputNode() as inp:
-            dag = s0.bwd.bind(s1.loss_bwd.bind(s0.fwd.bind(inp)))
-        self._dag = dag.experimental_compile(
-            max_inflight=max_inflight or (n_microbatches + 2))
+            node = self.stages[0].fwd.bind(inp)
+            for s in self.stages[1:-1]:
+                node = s.mid_fwd.bind(node)
+            node = self.stages[-1].loss_bwd.bind(node)
+            for s in reversed(self.stages[1:-1]):
+                node = s.mid_bwd.bind(node)
+            dag = self.stages[0].bwd.bind(node)
+        if max_inflight is None:
+            # 1F1B: admit at most `depth` microbatches — a new forward
+            # enters only when a backward completes, so each stage holds
+            # ≤ n_stages live VJPs. GPipe: the whole schedule at once.
+            max_inflight = (n_stages if schedule == "1f1b"
+                            else n_microbatches + 2)
+        self._dag = dag.experimental_compile(max_inflight=max_inflight)
+
+    def _run_microbatches(self, tokens: np.ndarray,
+                          targets: np.ndarray) -> List[float]:
+        m = self.n_microbatches
+        if tokens.shape[0] % m != 0:
+            raise ValueError(
+                f"batch {tokens.shape[0]} not divisible by "
+                f"{m} microbatches")
+        tok_mb = np.split(np.asarray(tokens), m)
+        tgt_mb = np.split(np.asarray(targets), m)
+        t0 = time.perf_counter()
+        refs = [self._dag.execute((i, tok_mb[i], tgt_mb[i]))
+                for i in range(m)]
+        losses = [r.get(timeout=300) for r in refs]
+        wall = time.perf_counter() - t0
+        busy = ray_tpu.get([s.take_busy.remote() for s in self.stages],
+                           timeout=300)
+        self.last_step_stats = {
+            "wall_s": wall, "stage_busy_s": busy,
+            "bubble_fraction": max(0.0, 1.0 - (sum(busy) / len(busy))
+                                   / max(wall, 1e-9)),
+        }
+        return losses
 
     def step(self, tokens: np.ndarray, targets: Optional[np.ndarray] = None
              ) -> float:
@@ -261,16 +389,7 @@ class MPMDPipeline:
             import jax.numpy as jnp
 
             targets = np.asarray(next_token_targets(jnp.asarray(tokens)))
-        m = self.n_microbatches
-        if tokens.shape[0] % m != 0:
-            raise ValueError(
-                f"batch {tokens.shape[0]} not divisible by "
-                f"{m} microbatches")
-        tok_mb = np.split(np.asarray(tokens), m)
-        tgt_mb = np.split(np.asarray(targets), m)
-        refs = [self._dag.execute((i, tok_mb[i], tgt_mb[i]))
-                for i in range(m)]
-        losses = [r.get(timeout=300) for r in refs]
+        losses = self._run_microbatches(tokens, targets)
         ray_tpu.get([s.apply_gradients.remote() for s in self.stages],
                     timeout=300)
         return float(np.mean(losses))
@@ -283,16 +402,15 @@ class MPMDPipeline:
         import jax.numpy as jnp
 
         targets = np.asarray(next_token_targets(jnp.asarray(tokens)))
-        m = self.n_microbatches
-        tok_mb = np.split(np.asarray(tokens), m)
-        tgt_mb = np.split(np.asarray(targets), m)
-        refs = [self._dag.execute((i, tok_mb[i], tgt_mb[i]))
-                for i in range(m)]
-        return float(np.mean([r.get(timeout=300) for r in refs]))
+        return float(np.mean(self._run_microbatches(tokens, targets)))
 
     def grad_norms(self) -> List[float]:
         return ray_tpu.get(
             [s.grad_norm.remote() for s in self.stages], timeout=300)
+
+    def live_vjp_counts(self) -> List[int]:
+        return ray_tpu.get(
+            [s.live_vjp_count.remote() for s in self.stages], timeout=300)
 
     def get_params(self) -> List[Dict[str, Any]]:
         return ray_tpu.get(

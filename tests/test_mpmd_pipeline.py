@@ -136,3 +136,107 @@ def test_split_llama_params_layout():
     assert "embedding" in s0 and "lm_head" not in s0
     assert "lm_head" in s1 and "norm" in s1 and "embedding" not in s1
     assert len(s0["layers"]) + len(s1["layers"]) == cfg.n_layers
+
+
+def test_mpmd_three_stage_parity_and_1f1b(cluster):
+    """VERDICT r3 #3: N-stage pipeline. 3 stage-actor processes, 8
+    microbatches, 1F1B in-flight bound — loss + grad parity against the
+    single-program math, live VJPs bounded by depth (not microbatch
+    count), and a bubble-fraction report."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import init_params, loss_fn
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = _tiny_cfg()  # 4 layers -> stages of 2/1/1
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size))
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: loss_fn(p, {"tokens": jnp.asarray(tokens)}, cfg,
+                          remat=True))(params)
+    ref_norm = float(optax.global_norm(ref_grads))
+
+    pipe = MPMDPipeline(cfg, params, n_stages=3, n_microbatches=8)
+    try:
+        loss = pipe.grad_check_step(tokens)
+        assert abs(loss - float(ref_loss)) < 1e-4, (loss, float(ref_loss))
+        norms = pipe.grad_norms()
+        mpmd_norm = float(np.sqrt(sum(n * n for n in norms)))
+        assert abs(mpmd_norm - ref_norm) / max(ref_norm, 1e-9) < 1e-3, (
+            mpmd_norm, ref_norm)
+        # All VJPs consumed after the step; the 1F1B bound means no stage
+        # ever held more than n_stages — post-step they must be zero.
+        assert pipe.live_vjp_counts() == [0, 0, 0]
+        stats = pipe.last_step_stats
+        assert stats is not None and 0.0 <= stats["bubble_fraction"] < 1.0
+        assert len(stats["stage_busy_s"]) == 3
+    finally:
+        pipe.teardown()
+
+
+def test_mpmd_three_stage_training_tracks_reference(cluster):
+    """Two adamw steps through the 3-stage pipe track the single-process
+    trajectory (optimizer state update path through mid stages)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import init_params, loss_fn
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = _tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lr = 1e-3
+
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+    p = params
+    ref_losses = []
+    for i in range(2):
+        tokens = jnp.asarray(np.random.RandomState(i).randint(
+            0, cfg.vocab_size, (4, 16)))
+        loss, grads = jax.value_and_grad(
+            lambda q: loss_fn(q, {"tokens": tokens}, cfg, remat=True))(p)
+        updates, opt_state = opt.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        ref_losses.append(float(loss))
+
+    pipe = MPMDPipeline(cfg, params, n_stages=3, n_microbatches=2, lr=lr)
+    try:
+        losses = [pipe.step(np.random.RandomState(i).randint(
+            0, cfg.vocab_size, (4, 16))) for i in range(2)]
+        for got, want in zip(losses, ref_losses):
+            assert abs(got - want) < 5e-3, (losses, ref_losses)
+    finally:
+        pipe.teardown()
+
+
+def test_mpmd_bf16_transport(cluster):
+    """bfloat16 wire casting: training still converges to the reference
+    trajectory within bf16 tolerance (activations+cotangents cross the
+    object plane at half width)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import init_params, loss_fn
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = _tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size))
+    ref_loss = float(loss_fn(params, {"tokens": jnp.asarray(tokens)}, cfg,
+                             remat=True))
+
+    pipe = MPMDPipeline(cfg, params, n_stages=3, n_microbatches=2,
+                        transport_dtype="bfloat16")
+    try:
+        loss = pipe.grad_check_step(tokens)
+        # bf16 has ~3 decimal digits; the loss must agree to ~1e-2.
+        assert abs(loss - ref_loss) < 2e-2, (loss, ref_loss)
+    finally:
+        pipe.teardown()
