@@ -199,6 +199,67 @@ _AGENT_BOOTSTRAP = (
     "agent_main()\n"
 )
 
+# Agent zygote: fork node agents from one pre-imported template instead of
+# cold-starting an interpreter + import tree per node (~350ms of single-core
+# CPU each — the 2.9 joins/s ceiling the round-3 many-nodes bench hit).
+# Same shape as the worker zygote above; cluster_utils drives it for
+# many-node simulations and the autoscaler's local provider.
+_AGENT_ZYGOTE_BOOTSTRAP = """
+import json, os, signal, sys
+sys.path[:0] = os.environ['RAY_TPU_SYS_PATH'].split(os.pathsep)
+from ray_tpu._private.node import agent_main_from_req
+signal.signal(signal.SIGCHLD, signal.SIG_IGN)
+sys.stdout.write("READY\\n"); sys.stdout.flush()
+for line in sys.stdin:
+    if not line.strip():
+        continue
+    try:
+        req = json.loads(line)
+        pid = os.fork()
+    except Exception as e:  # fork EAGAIN/ENOMEM must reach the caller
+        sys.stdout.write("ERR " + repr(e) + "\\n"); sys.stdout.flush()
+        continue
+    if pid == 0:
+        signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+        # Own process group IMMEDIATELY (both sides race-free setpgid —
+        # setsid would fail once the parent's setpgid lands, and killpg
+        # from the driver must never hit the zygote's group).
+        try:
+            os.setpgid(0, 0)
+        except OSError:
+            pass
+        os.environ.clear()
+        os.environ.update(req["env"])
+        log = open(req["log"], "ab", 0)
+        os.dup2(log.fileno(), 1)
+        os.dup2(log.fileno(), 2)
+        agent_main_from_req(req)
+        os._exit(0)
+    try:
+        os.setpgid(pid, pid)
+    except OSError:
+        pass
+    sys.stdout.write(str(pid) + "\\n"); sys.stdout.flush()
+"""
+
+
+def agent_main_from_req(req: dict):
+    """Agent-zygote fork entry: args ride the fork request; the child's
+    environment was replaced wholesale, so the lazily-cached flag table
+    must be rebuilt from the new env before anything reads it."""
+    import types
+
+    from .config import reset_config
+
+    reset_config()
+    args = types.SimpleNamespace(
+        gcs=req["gcs"], session_dir=req["session_dir"],
+        resources=req["resources"],
+        num_initial_workers=req.get("num_initial_workers", 1),
+        env=req.get("task_env", "{}"))
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+    _run_with_optional_profile(lambda: agent_amain(args), "agent")
+
 
 def worker_sys_path() -> str:
     """The parent's import path, for ``python -S`` worker bootstrap."""
