@@ -1132,7 +1132,22 @@ class GcsServer:
                     addrs.append(node.obj_addr)
         client.conn.reply(msg, {"ok": True, "nbytes": entry.nbytes,
                                 "addrs": addrs,
+                                # Holder NODE ids too: locality-aware
+                                # consumers (ray_tpu.data) schedule the
+                                # reading task onto a holding node.
+                                "nids": [nid for nid in entry.holders],
                                 "spilled": entry.spilled is not None})
+
+    async def _h_obj_holders(self, client, msg):
+        """Batch holder-node lookup: oids -> [[node_id, ...], ...].
+        One round trip for a whole dataset's block refs (locality-aware
+        consumers; a per-ref obj_locate sweep serializes driver startup)."""
+        out = []
+        for oid_b in msg["oids"]:
+            entry = self.objects.get(ObjectID(oid_b))
+            out.append(list(entry.holders)
+                       if entry is not None and entry.ready else [])
+        client.conn.reply(msg, {"ok": True, "holders": out})
 
     async def _h_obj_pull(self, client, msg):
         """Serve the raw bytes of an object to a host that doesn't share a

@@ -134,13 +134,26 @@ class Cluster:
         deadline = time.time() + timeout
         while True:
             remaining = deadline - time.time()
-            if remaining <= 0 or z.poll() is not None:
-                raise RuntimeError(
-                    f"agent zygote {'died' if z.poll() is not None else 'timed out'}"
-                    f" (see {self.head.session_dir}/agent-zygote.err)")
-            r, _, _ = select.select([z.stdout], [], [], min(remaining, 1.0))
+            # Drain-before-raise: a reply written just before the zygote
+            # died must still be consumed (the forked agent it names is
+            # alive and must be tracked).
+            r, _, _ = select.select([z.stdout], [], [],
+                                    max(0.0, min(remaining, 1.0)))
             if r:
-                return z.stdout.readline()
+                line = z.stdout.readline()
+                if line:
+                    return line
+                raise RuntimeError(
+                    "agent zygote died (EOF) — see "
+                    f"{self.head.session_dir}/agent-zygote.err")
+            if z.poll() is not None:
+                raise RuntimeError(
+                    "agent zygote died — see "
+                    f"{self.head.session_dir}/agent-zygote.err")
+            if remaining <= 0:
+                raise RuntimeError(
+                    "agent zygote timed out — see "
+                    f"{self.head.session_dir}/agent-zygote.err")
 
     def add_node(self, num_cpus: int = 1, num_tpus: int = 0,
                  resources: Optional[Dict[str, float]] = None,

@@ -1,4 +1,6 @@
 from .block import BlockAccessor, to_block
+from .context import (BackpressurePolicy, ConcurrencyCapPolicy, DataContext,
+                      MemoryBudgetPolicy)
 from .dataset import Dataset, MaterializedDataset
 from .iterator import DataIterator
 from .read_api import (
@@ -27,6 +29,8 @@ __all__ = [
     "range", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy", "read_binary_files", "read_images", "read_webdataset",
     "Datasource", "read_datasource",
+    "DataContext", "BackpressurePolicy", "ConcurrencyCapPolicy",
+    "MemoryBudgetPolicy",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

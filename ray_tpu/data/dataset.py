@@ -88,6 +88,13 @@ class _Op:
             mapping = self.kw["mapping"]
             return block.rename_columns(
                 [mapping.get(c, c) for c in block.column_names])
+        if self.kind == "limit":
+            # Per-block cap: the global quota is an upper bound for any
+            # one block; the streaming executor enforces the exact
+            # cross-block cutoff (reference: LimitPushdownRule + the
+            # executor's limit operator).
+            n = self.kw["n"]
+            return block if acc.num_rows() <= n else block.slice(0, n)
         raise ValueError(f"unknown op {self.kind}")
 
 
@@ -114,15 +121,23 @@ def _run_pipeline(source, ops: List[_Op], apply=None):
 def _pipeline_task_stats(source, ops):
     """Fused per-block task that also returns per-op timings: the block
     rides return 0 (consumers are unchanged), the small stats dict rides
-    return 1 (reference: per-operator stats, ``_internal/stats.py``)."""
+    return 1 (reference: per-operator stats, ``_internal/stats.py``).
+    ``limit_rows`` reports this block's row count at the chain's first
+    ``limit`` op — the streaming executor's exact cross-block cutoff
+    reads it (per-block truncation alone over-delivers)."""
     import time as _time
 
     per_op = [0.0] * len(ops)
+    first_limit = next((i for i, o in enumerate(ops)
+                        if o.kind == "limit"), None)
+    limit_rows = [0]
 
     def timed_apply(op, b, i):
         t1 = _time.perf_counter()
         out = op.apply(b)
         per_op[i] += _time.perf_counter() - t1
+        if i == first_limit:
+            limit_rows[0] += BlockAccessor(out).num_rows()
         return out
 
     t0 = _time.perf_counter()
@@ -130,7 +145,9 @@ def _pipeline_task_stats(source, ops):
     total_s = _time.perf_counter() - t0
     acc = BlockAccessor(out)
     return out, {"read_s": max(total_s - sum(per_op), 0.0), "op_s": per_op,
-                 "rows": acc.num_rows(), "bytes": acc.size_bytes()}
+                 "rows": acc.num_rows(), "bytes": acc.size_bytes(),
+                 "limit_rows": (limit_rows[0] if first_limit is not None
+                                else None)}
 
 
 class _ExecStats:
@@ -141,6 +158,10 @@ class _ExecStats:
         self.op_kinds = op_kinds
         self.stat_refs: List[ray_tpu.ObjectRef] = []
         self.wall_s = 0.0
+        # Highest concurrent in-flight task count this execution reached —
+        # what the backpressure policies actually admitted (tests assert
+        # on it when swapping policies).
+        self.peak_inflight = 0
 
     def summary(self) -> str:
         try:
@@ -383,6 +404,38 @@ def _sample_keys(source, ops, key, k):
 # ---------------------------------------------------------------- dataset
 
 
+class _LazyExchange:
+    """A deferred all-to-all stage recorded by ``repartition`` /
+    ``random_shuffle`` / ``sort``.
+
+    Deferral is what the optimizer exploits: ``plan.hoist_across_exchange``
+    moves row-pruning ops that were chained AFTER the exchange into
+    ``parent_ops``, so they run BEFORE rows cross the shuffle (the
+    reference applies its rule set to the logical plan before the planner
+    builds exchange stages). Expansion (``Dataset._expand_exchange``)
+    launches the split/reduce tasks — including sort's cut sampling, which
+    thereby samples the already-filtered rows."""
+
+    def __init__(self, parent_sources, parent_ops, n, how, seed=None,
+                 key=None, descending=False):
+        self.parent_sources = parent_sources
+        self.parent_ops = parent_ops
+        self.n = n
+        self.how = how
+        self.seed = seed
+        self.key = key
+        self.descending = descending
+        # Expansion memo: the split/reduce stages run ONCE per node even
+        # when the dataset is consumed repeatedly (count() then iterate —
+        # the old eager exchange had run-once semantics too).
+        self.expanded: Optional[List[Any]] = None
+
+    def with_extra_parent_op(self, op) -> "_LazyExchange":
+        return _LazyExchange(self.parent_sources, self.parent_ops + [op],
+                             self.n, self.how, self.seed, self.key,
+                             self.descending)
+
+
 class Dataset:
     """Lazy dataset: input sources + fused transform chain.
 
@@ -398,6 +451,8 @@ class Dataset:
         self._actor_pool_size: Optional[int] = None
         # Stats of the most recent streaming execution (``stats()``).
         self._exec_stats: Optional[_ExecStats] = None
+        # Rewrite-rule trace of the most recent planning (``explain()``).
+        self._plan_trace: List[str] = []
 
     # --------------------------------------------------------- transforms
 
@@ -472,20 +527,128 @@ class Dataset:
             cap = 0
         return max(64 << 20, cap // 4)
 
+    def _planned(self, sources=None, ops=None):
+        """Optimized ``(sources, ops)`` with deferred exchanges expanded
+        to real block refs (the logical→physical step; reference:
+        ``LogicalOptimizer`` rules then the planner,
+        ``data/_internal/logical/optimizers.py``). The applied-rewrite
+        trace lands in ``self._plan_trace`` for ``explain()``."""
+        from . import plan as _plan
+        from .context import DataContext
+
+        sources = list(self._sources) if sources is None else list(sources)
+        ops = list(self._ops) if ops is None else list(ops)
+        if DataContext.get_current().optimizer_enabled:
+            sources, ops, trace = _plan.optimize(sources, ops)
+            self._plan_trace = trace
+        else:
+            self._plan_trace = []
+        out_sources: List[Any] = []
+        for s in sources:
+            if isinstance(s, _LazyExchange):
+                out_sources.extend(self._expand_exchange(s))
+            else:
+                out_sources.append(s)
+        return out_sources, ops
+
+    def explain(self) -> str:
+        """The optimized plan + which rewrite rules fired (reference:
+        ``Dataset.explain()``-style plan introspection)."""
+        from . import plan as _plan
+
+        sources, ops, trace = _plan.optimize(
+            list(self._sources), list(self._ops))
+        lines = [f"Plan: {self._describe_sources(sources)} -> "
+                 f"{[o.kind for o in ops]}"]
+        for s in sources:
+            if isinstance(s, _LazyExchange):
+                lines.append(
+                    f"  exchange[{s.how} n={s.n}] parents="
+                    f"{len(s.parent_sources)} blocks, parent_ops="
+                    f"{[o.kind for o in s.parent_ops]}")
+        lines += [f"  rewrite: {t}" for t in trace] or ["  rewrite: (none)"]
+        return "\n".join(lines)
+
+    @staticmethod
+    def _describe_sources(sources) -> str:
+        kinds = []
+        for s in sources:
+            kinds.append(f"exchange:{s.how}" if isinstance(s, _LazyExchange)
+                         else ("ref" if isinstance(s, ray_tpu.ObjectRef)
+                               else "read"))
+        return f"{len(sources)} sources ({', '.join(sorted(set(kinds)))})"
+
+    def _locality_targets(self, sources) -> Dict[int, bytes]:
+        """source index -> holder node id, for block-ref sources on a
+        multi-node cluster (reference: locality-aware bundle scheduling
+        in the streaming executor). Best-effort: lookup failures just
+        lose the affinity hint."""
+        idx_refs = [(i, s) for i, s in enumerate(sources)
+                    if isinstance(s, ray_tpu.ObjectRef)]
+        if not idx_refs:
+            return {}
+        try:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) < 2:
+                return {}
+            from ray_tpu._private.worker import global_worker
+
+            # One batch round trip for the whole ref set (a per-ref
+            # obj_locate sweep would serialize stream startup).
+            reply = global_worker().request_gcs(
+                {"t": "obj_holders",
+                 "oids": [r.id.binary() for _, r in idx_refs]},
+                timeout=5)
+            holders = reply.get("holders") or []
+            return {i: bytes(h[0])
+                    for (i, _), h in zip(idx_refs, holders) if h}
+        except Exception:
+            return {}
+
     def _stream_refs(self, sources=None) -> Iterator[ray_tpu.ObjectRef]:
         """Streaming executor: bounded in-flight fused tasks, yielded in
-        submission order. Backpressure is the min of a CPU window and a
-        store-memory budget (in-flight blocks × observed block size)."""
-        sources = self._sources if sources is None else sources
+        submission order. Admission control is pluggable
+        (``context.BackpressurePolicy``); defaults reproduce the CPU
+        window + store-memory budget. A ``limit`` op gets an exact
+        cross-block cutoff (per-block truncation over-delivers); block-ref
+        inputs get soft node affinity toward a holder node."""
+        from .context import (ConcurrencyCapPolicy, DataContext,
+                              MemoryBudgetPolicy)
+
+        if sources is None:
+            sources, ops = self._planned()
+        else:
+            sources, ops = list(sources), list(self._ops)
         if self._actor_pool_size:
-            yield from self._stream_refs_actor_pool(sources)
+            li = None
+            for i, o in enumerate(ops):
+                if o.kind == "limit":
+                    li = i
+            if li is not None:
+                # The pool path has no cross-block cutoff: run the chain
+                # up to the limit through the task executor (exact), then
+                # stream the already-limited blocks through the pool.
+                refs = list(self._stream_refs_tasks(sources, ops[:li + 1]))
+                yield from self._stream_refs_actor_pool(refs, ops[li + 1:])
+            else:
+                yield from self._stream_refs_actor_pool(sources, ops)
             return
+        yield from self._stream_refs_tasks(sources, ops)
+
+    def _stream_refs_tasks(self, sources,
+                           ops) -> Iterator[ray_tpu.ObjectRef]:
+        from .context import (ConcurrencyCapPolicy, DataContext,
+                              MemoryBudgetPolicy)
+
+        ctx = DataContext.get_current()
         try:
             cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
         except Exception:
             cpus = 4
-        cpu_window = max(2, cpus * 2)
-        budget = self._memory_budget()
+        policies = ctx.backpressure_policies
+        if policies is None:
+            policies = [ConcurrencyCapPolicy(max(2, cpus * 2)),
+                        MemoryBudgetPolicy(self._memory_budget())]
         est_block = 0  # rolling estimate of produced block bytes
         task = _pipeline_task_stats
         if self._remote_args:
@@ -494,44 +657,78 @@ class Dataset:
                              "max_retries")}
             if opts:
                 task = _pipeline_task_stats.options(**opts)
-        stats = self._exec_stats = _ExecStats([o.kind for o in self._ops])
+        limit_n = next((o.kw["n"] for o in ops if o.kind == "limit"), None)
+        locality = (self._locality_targets(sources)
+                    if ctx.locality_aware_scheduling else {})
+        stats = self._exec_stats = _ExecStats([o.kind for o in ops])
         t_exec = time.perf_counter()
-        pending: List[ray_tpu.ObjectRef] = []
-        it = iter(sources)
+        pending: List[tuple] = []  # (block_ref, stats_ref, source)
+        it = iter(enumerate(sources))
         exhausted = False
+        consumed = 0  # rows delivered at the limit point, in block order
         while pending or not exhausted:
-            window = cpu_window
-            if est_block > 0:
-                window = max(2, min(cpu_window, budget // est_block))
-            while not exhausted and len(pending) < window:
+            while not exhausted and all(
+                    p.can_admit(len(pending), est_block * len(pending))
+                    for p in policies):
                 try:
-                    src = next(it)
+                    i, src = next(it)
                 except StopIteration:
                     exhausted = True
                     break
-                bref, sref = task.remote(src, self._ops)
-                pending.append(bref)
+                t = task
+                nid = locality.get(i)
+                if nid is not None:
+                    from ray_tpu.util.scheduling_strategies import \
+                        NodeAffinitySchedulingStrategy
+
+                    t = t.options(
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            nid, soft=True))
+                bref, sref = t.remote(src, ops)
+                pending.append((bref, sref, src))
                 stats.stat_refs.append(sref)
+                stats.peak_inflight = max(stats.peak_inflight, len(pending))
             if not pending:
                 break
             # Submission order preserved (deterministic block order, like the
             # reference's ordered output bundles); the window still keeps
             # `window` tasks in flight, so pipelining is unaffected.
-            ray_tpu.wait(pending[:1], num_returns=1, timeout=None)
-            ref = pending.pop(0)
-            nbytes = _resolved_nbytes(ref)
+            ray_tpu.wait([pending[0][0]], num_returns=1, timeout=None)
+            bref, sref, src = pending.pop(0)
+            nbytes = _resolved_nbytes(bref)
             if nbytes:
                 est_block = (est_block + nbytes) // 2 if est_block else nbytes
             stats.wall_s = time.perf_counter() - t_exec
-            yield ref
+            if limit_n is None:
+                yield bref
+                continue
+            # Exact limit cutoff: rows measured AT the limit op.
+            lrows = ray_tpu.get(sref, timeout=600)["limit_rows"] or 0
+            if consumed + lrows > limit_n:
+                # Boundary block: re-run its source with the remaining
+                # quota substituted into the limit op (rows past the
+                # quota inside this block must not flow downstream).
+                quota = limit_n - consumed
+                ops2 = [(_Op("limit", n=quota) if o.kind == "limit" else o)
+                        for o in ops]
+                b2, s2 = task.remote(src, ops2)
+                stats.stat_refs.append(s2)
+                consumed = limit_n
+                yield b2
+            else:
+                consumed += lrows
+                yield bref
+            if consumed >= limit_n:
+                return  # drop remaining pending blocks (past the limit)
 
-    def _stream_refs_actor_pool(self, sources) -> Iterator[ray_tpu.ObjectRef]:
+    def _stream_refs_actor_pool(self, sources,
+                                ops) -> Iterator[ray_tpu.ObjectRef]:
         """Actor-pool compute: blocks stream through N stateful actors,
         bounded in-flight per actor (reference: ActorPoolMapOperator)."""
         n = self._actor_pool_size or 2
         opts = {k: v for k, v in self._remote_args.items()
                 if k in ("num_cpus", "num_tpus", "resources")}
-        pool = [_PoolWorker.options(**opts).remote(self._ops)
+        pool = [_PoolWorker.options(**opts).remote(ops)
                 for _ in range(n)]
         try:
             per_actor = 2
@@ -577,19 +774,65 @@ class Dataset:
     # process's memory stream through workers block by block.
 
     def _exchange_inputs(self):
-        """(sources, ops) for exchange stages. Class-UDF ops only exist
-        inside pool actors — run the pipeline through the pool first and
-        exchange the materialized block refs."""
+        """Concrete (sources, ops) for a stage that ships sources into
+        remote tasks: deferred exchanges expanded, optimizer applied.
+        Class-UDF ops only exist inside pool actors — run the pipeline
+        through the pool first and exchange the materialized block refs."""
         if self._actor_pool_size:
             return list(self._stream_refs()), []
-        return self._sources, self._ops
+        sources, ops = self._planned()
+        if any(o.kind == "limit" for o in ops):
+            # Exchange/join/unique split tasks apply ops with only the
+            # per-block cap — materialize through the executor's exact
+            # cross-block cutoff instead of shipping the limit op.
+            return list(self._stream_refs_tasks(sources, ops)), []
+        return sources, ops
 
     def _exchange(self, n: int, how: str, seed: Optional[int] = None,
-                  cuts=None, key: Optional[str] = None,
-                  descending: bool = False, inputs=None) -> "Dataset":
+                  key: Optional[str] = None,
+                  descending: bool = False) -> "Dataset":
+        """Record (not run) an all-to-all stage. Deferral lets the
+        optimizer hoist later row-pruning ops across the shuffle
+        (``plan.hoist_across_exchange``); ``_expand_exchange`` launches
+        the split/reduce tasks at execution."""
         n = max(int(n), 1)
-        sources, ops = inputs if inputs is not None \
-            else self._exchange_inputs()
+        sources, ops = self._exchange_inputs()
+        node = _LazyExchange(sources, ops, n, how, seed, key, descending)
+        return Dataset([node], [], self._remote_args)
+
+    def _expand_exchange(self, node: _LazyExchange
+                         ) -> List[ray_tpu.ObjectRef]:
+        """Launch a deferred exchange's split/reduce stages; returns the
+        reduce-output block refs (in partition order, descending-sort
+        partitions reversed). Memoized on the node: repeated consumption
+        reuses the produced partitions."""
+        from . import plan as _plan
+
+        if node.expanded is not None:
+            return node.expanded
+        sources, ops, _ = _plan.optimize(node.parent_sources,
+                                         node.parent_ops)
+        if len(sources) == 1 and isinstance(sources[0], _LazyExchange):
+            sources = self._expand_exchange(sources[0])
+        n, how, seed, key = node.n, node.how, node.seed, node.key
+        cuts = None
+        if how == "sort":
+            cuts = []
+            if n > 1:
+                # Sample-based range partitioning: per-block key samples
+                # pick k-1 cutpoints; only the (tiny) samples reach the
+                # driver. Sampling runs AFTER hoisted filters, so cuts
+                # reflect the rows that will actually be shuffled.
+                samples = ray_tpu.get([
+                    _sample_keys.remote(src, ops, key, 64)
+                    for src in sources])
+                allk = np.sort(np.concatenate(
+                    [np.asarray(s) for s in samples]))
+                if len(allk) == 0:
+                    n = 1
+                else:
+                    idx = (np.arange(1, n) * len(allk)) // n
+                    cuts = allk[idx].tolist()
         split = _exchange_split.options(num_returns=n)
         sub_refs: List[List[ray_tpu.ObjectRef]] = []
         for b_idx, src in enumerate(sources):
@@ -607,45 +850,26 @@ class Dataset:
             if not parts:
                 continue
             out.append(_exchange_reduce.remote(
-                how, None if seed is None else seed + i, key, descending,
-                *parts))
-        return Dataset(out, [], self._remote_args)
+                how, None if seed is None else seed + i, key,
+                node.descending, *parts))
+        if how == "sort" and node.descending:
+            out = list(reversed(out))
+        node.expanded = out
+        return out
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._exchange(num_blocks, "repartition")
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        k = max(len(self._sources), 1)
+        k = max(self.num_blocks(), 1)
         return self._exchange(
             k, "shuffle",
             seed=int(seed) if seed is not None
             else int(np.random.randint(0, 2**31)))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        k = max(len(self._sources), 1)
-        if k == 1:
-            return self._exchange(1, "sort", key=key, descending=descending,
-                                  cuts=[])
-        # Sample-based range partitioning: per-block key samples pick k-1
-        # cutpoints; only the (tiny) samples ever reach the driver.
-        # Inputs computed ONCE so an actor-pool pipeline is not re-run for
-        # the sampling pass.
-        inputs = self._exchange_inputs()
-        s_sources, s_ops = inputs
-        samples = ray_tpu.get([
-            _sample_keys.remote(src, s_ops, key, 64)
-            for src in s_sources])
-        allk = np.sort(np.concatenate([np.asarray(s) for s in samples]))
-        if len(allk) == 0:
-            return self._exchange(1, "sort", key=key, descending=descending,
-                                  cuts=[], inputs=inputs)
-        idx = (np.arange(1, k) * len(allk)) // k
-        cuts = allk[idx].tolist()
-        ds = self._exchange(k, "sort", key=key, descending=descending,
-                            cuts=cuts, inputs=inputs)
-        if descending:
-            ds._sources = list(reversed(ds._sources))
-        return ds
+        k = max(self.num_blocks(), 1)
+        return self._exchange(k, "sort", key=key, descending=descending)
 
     def union(self, *others: "Dataset") -> "Dataset":
         sources = list(self._sources)
@@ -663,10 +887,14 @@ class Dataset:
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by round-robin over source blocks."""
+        if any(isinstance(s, _LazyExchange) for s in self._sources):
+            sources, ops = self._planned()  # expand to real blocks first
+        else:
+            sources, ops = list(self._sources), list(self._ops)
         shards: List[List[Any]] = [[] for _ in range(n)]
-        for i, src in enumerate(self._sources):
+        for i, src in enumerate(sources):
             shards[i % n].append(src)
-        return [Dataset(s, list(self._ops), self._remote_args)
+        return [Dataset(s, list(ops), self._remote_args)
                 for s in shards]
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False,
@@ -824,11 +1052,25 @@ class Dataset:
         return list(s.names) if s is not None else []
 
     def num_blocks(self) -> int:
-        return len(self._sources)
+        return sum(s.n if isinstance(s, _LazyExchange) else 1
+                   for s in self._sources)
 
     def limit(self, n: int) -> "Dataset":
-        rows = self.take(n)
-        return Dataset([to_block(rows)], [], self._remote_args)
+        """First ``n`` rows, lazily: a ``limit`` op truncates per block in
+        the fused task (and the optimizer pushes it before row-preserving
+        ops — reference: LimitPushdownRule); the streaming executor
+        enforces the exact cross-block cutoff and stops submitting block
+        tasks once ``n`` rows are covered.
+
+        Degenerate shapes fall back to eager truncation: a second limit
+        in one chain, or an actor-pool compute stage (the pool path has
+        no per-block limit-point stats channel)."""
+        n = int(n)
+        if self._actor_pool_size or any(o.kind == "limit"
+                                        for o in self._ops):
+            rows = self.take(n)
+            return Dataset([to_block(rows)], [], self._remote_args)
+        return self._with_op(_Op("limit", n=n))
 
     def show(self, limit: int = 20):
         for row in self.take(limit):
@@ -915,8 +1157,8 @@ class Dataset:
         the partition, not the dataset."""
         if how not in ("inner", "left", "right", "outer"):
             raise ValueError(f"unsupported join type {how!r}")
-        k = num_partitions or max(len(self._sources),
-                                  len(other._sources), 1)
+        k = num_partitions or max(self.num_blocks(),
+                                  other.num_blocks(), 1)
         ls, lops = self._exchange_inputs()
         rs, rops = other._exchange_inputs()
         lsplit = _hash_part.options(num_returns=k)

@@ -1,0 +1,146 @@
+"""Logical-plan optimizer for ray_tpu.data.
+
+Analog of the reference's logical optimizer rules
+(``python/ray/data/_internal/logical/optimizers.py`` — LogicalOptimizer's
+rule list: projection merging, limit pushdown, operator fusion). Our plan
+is the ``(sources, ops)`` pair a ``Dataset`` carries — sources may include
+``_LazyExchange`` nodes (deferred all-to-all stages), ops are the fused
+per-block transform chain — so rules are list rewrites plus hoists across
+the exchange boundary:
+
+  * ``merge_projections`` — select∘select → the final select;
+    drop∘drop → one combined drop (fewer per-block arrow calls);
+  * ``push_limit_early`` — move a ``limit`` before row-count-preserving
+    ops (map / add_column / select / drop / rename) so those ops run on
+    at most ``n`` rows per block (reference: LimitPushdownRule);
+  * ``hoist_across_exchange`` — move leading filters (always safe: row
+    predicates commute with partitioning) and projections (safe when the
+    exchange's key survives the projection) from AFTER an exchange into
+    its parent pipeline, shrinking the bytes that cross the shuffle
+    (reference: the planner applies map fusion/pushdown before building
+    exchange stages).
+
+``optimize(sources, ops)`` returns ``(sources, ops, trace)`` where trace
+is a human-readable list of the rewrites applied — ``Dataset.explain()``
+surfaces it and the unit tests assert on it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+# Ops that preserve row count AND row order 1:1 (limit may move before
+# them). filter / flat_map / map_batches can change the count; exchange
+# boundaries reorder.
+_ROW_PRESERVING = {"map", "add_column", "select_columns", "drop_columns",
+                   "rename_columns"}
+
+
+def _is_projection(op) -> bool:
+    return op.kind in ("select_columns", "drop_columns")
+
+
+def merge_projections(ops: List[Any], trace: List[str]) -> List[Any]:
+    out: List[Any] = []
+    for op in ops:
+        if out and _is_projection(op) and _is_projection(out[-1]):
+            prev = out[-1]
+            if prev.kind == "select_columns" and op.kind == "select_columns":
+                # Merge only when provably valid (B ⊆ A): otherwise the
+                # unoptimized chain raises on the missing column and the
+                # merged form would silently mask that user bug.
+                if set(op.kw["cols"]) <= set(prev.kw["cols"]):
+                    out[-1] = op
+                    trace.append(
+                        "merge_projections: select∘select -> select")
+                    continue
+            if prev.kind == "drop_columns" and op.kind == "drop_columns":
+                # Overlapping drops raise unmerged (second drop names an
+                # already-dropped column) — keep that error.
+                if not (set(prev.kw["cols"]) & set(op.kw["cols"])):
+                    merged = list(prev.kw["cols"]) + list(op.kw["cols"])
+                    out[-1] = type(op)("drop_columns", cols=merged)
+                    trace.append("merge_projections: drop∘drop -> drop")
+                    continue
+            if prev.kind == "select_columns" and op.kind == "drop_columns":
+                if set(op.kw["cols"]) <= set(prev.kw["cols"]):
+                    kept = [c for c in prev.kw["cols"]
+                            if c not in set(op.kw["cols"])]
+                    out[-1] = type(op)("select_columns", cols=kept)
+                    trace.append(
+                        "merge_projections: select∘drop -> select")
+                    continue
+        out.append(op)
+    return out
+
+
+def push_limit_early(ops: List[Any], trace: List[str]) -> List[Any]:
+    ops = list(ops)
+    moved = True
+    while moved:
+        moved = False
+        for i in range(1, len(ops)):
+            if (ops[i].kind == "limit"
+                    and ops[i - 1].kind in _ROW_PRESERVING):
+                ops[i - 1], ops[i] = ops[i], ops[i - 1]
+                trace.append(
+                    f"push_limit_early: limit before {ops[i].kind}")
+                moved = True
+    return ops
+
+
+def _exchange_key(node) -> Any:
+    return getattr(node, "key", None)
+
+
+def _projection_keeps(op, key) -> bool:
+    if key is None:
+        return True
+    if op.kind == "select_columns":
+        return key in set(op.kw["cols"])
+    if op.kind == "drop_columns":
+        return key not in set(op.kw["cols"])
+    return False
+
+
+def hoist_across_exchange(sources: List[Any], ops: List[Any],
+                          trace: List[str]) -> Tuple[List[Any], List[Any]]:
+    """Move leading filter/projection ops into a sole upstream exchange's
+    parent pipeline. Applies only when the dataset's sources are exactly
+    one deferred exchange (the shape ``repartition/shuffle/sort`` (lazy)
+    produce); the exchange itself re-optimizes its parents at expansion,
+    so hoists chain through stacked exchanges."""
+    from .dataset import _LazyExchange
+
+    if len(sources) != 1 or not isinstance(sources[0], _LazyExchange):
+        return sources, ops
+    node = sources[0]
+    hoisted = 0
+    while ops:
+        op = ops[0]
+        if op.kind == "filter":
+            ok = True
+        elif _is_projection(op):
+            ok = _projection_keeps(op, _exchange_key(node))
+        else:
+            ok = False
+        if not ok:
+            break
+        node = node.with_extra_parent_op(op)
+        ops = ops[1:]
+        hoisted += 1
+        trace.append(
+            f"hoist_across_exchange: {op.kind} moved before "
+            f"{node.how} exchange")
+    if hoisted:
+        sources = [node]
+    return sources, ops
+
+
+def optimize(sources: List[Any], ops: List[Any]
+             ) -> Tuple[List[Any], List[Any], List[str]]:
+    trace: List[str] = []
+    ops = merge_projections(ops, trace)
+    ops = push_limit_early(ops, trace)
+    sources, ops = hoist_across_exchange(sources, ops, trace)
+    return sources, ops, trace

@@ -1,0 +1,58 @@
+"""Locality-aware block consumption (VERDICT r3 #7, locality part).
+
+Own module: needs a multi-node ``cluster_utils.Cluster``, which must not
+share a session with the single-node ``ray_cluster`` fixture."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+
+
+def test_locality_aware_block_consumption():
+    """Blocks produced on distinct nodes are consumed co-located: the
+    fused task lands on a node holding its input block (soft affinity)."""
+    import os
+
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(connect=True)
+    try:
+        for _ in range(2):
+            c.add_node(num_cpus=2, num_initial_workers=1)
+        assert c.wait_for_nodes(3, timeout=120)
+        assert c.wait_for_workers(timeout=120)
+
+        @ray_tpu.remote(scheduling_strategy="SPREAD")
+        def produce(i):
+            import numpy as _np
+
+            # >INLINE_THRESHOLD so the block lands in the producing
+            # node's shm arena (inline results live driver-side and have
+            # no holder node to be local to).
+            return {"node": [os.environ.get("RAY_TPU_NODE_ID", "")] * 64,
+                    "x": _np.arange(64) + i * 64,
+                    "pad": _np.zeros((64, 512))}
+
+        refs = [produce.remote(i) for i in range(6)]
+        ray_tpu.get(refs)
+
+        ds = rd.Dataset(refs, []).map_batches(
+            lambda b: {"produced_on": b["node"],
+                       "consumed_on": np.asarray(
+                           [os.environ.get("RAY_TPU_NODE_ID", "")]
+                           * len(b["node"])),
+                       "x": b["x"]})
+        rows = ds.take_all()
+        assert len(rows) == 6 * 64
+        produced = {r["produced_on"] for r in rows}
+        assert len(produced) >= 2, "SPREAD produced on one node only"
+        co = sum(1 for r in rows if r["consumed_on"] == r["produced_on"])
+        # Soft affinity on an idle cluster: the consuming task runs where
+        # the block lives for (at least) the clear majority of blocks.
+        assert co / len(rows) >= 0.5, (
+            f"only {co}/{len(rows)} rows consumed co-located")
+    finally:
+        c.shutdown()

@@ -1,0 +1,190 @@
+"""Data engine internals (VERDICT r3 #7): logical-plan optimizer rules,
+pluggable backpressure policies, locality-aware block scheduling.
+
+Reference model: ``python/ray/data/_internal/logical/optimizers.py``
+(rule-based plan rewrites), ``execution/backpressure_policy/`` (pluggable
+admission control), and the streaming executor's locality-aware bundle
+scheduling."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    rd.DataContext.reset()
+    yield
+    rd.DataContext.reset()
+
+
+# ------------------------------------------------------- optimizer rules
+
+
+def test_merge_projections_rule(ray_cluster):
+    ds = (rd.from_items([{"a": 1, "b": 2, "c": 3}] * 4)
+          .select_columns(["a", "b", "c"])
+          .select_columns(["a", "b"])
+          .drop_columns(["b"]))
+    from ray_tpu.data.plan import optimize
+
+    _, ops, trace = optimize(list(ds._sources), list(ds._ops))
+    # select∘select∘drop collapses to ONE select.
+    assert [o.kind for o in ops] == ["select_columns"]
+    assert ops[0].kw["cols"] == ["a"]
+    assert any("merge_projections" in t for t in trace)
+    assert ds.take_all() == [{"a": 1}] * 4
+
+
+def test_limit_pushdown_rule(ray_cluster):
+    calls = []
+
+    def record(r):
+        calls.append(1)
+        return {"x": r["x"] * 2}
+
+    ds = rd.from_items([{"x": i} for i in range(100)]).map(record).limit(5)
+    from ray_tpu.data.plan import optimize
+
+    _, ops, trace = optimize(list(ds._sources), list(ds._ops))
+    # limit moved BEFORE the row-preserving map.
+    assert [o.kind for o in ops] == ["limit", "map"]
+    assert any("push_limit_early" in t for t in trace)
+    rows = ds.take_all()
+    assert rows == [{"x": i * 2} for i in range(5)]
+
+
+def test_limit_exact_across_blocks(ray_cluster):
+    # 10 blocks of 8 rows; limit(20) must deliver exactly rows 0..19 in
+    # block order (per-block truncation alone would over-deliver).
+    ds = rd.from_items([{"i": i} for i in range(80)],
+                       parallelism=10).limit(20)
+    rows = [r["i"] for r in ds.take_all()]
+    assert rows == list(range(20))
+    assert ds.count() == 20
+
+
+def test_limit_not_pushed_past_filter(ray_cluster):
+    ds = (rd.from_items([{"x": i} for i in range(50)])
+          .filter(lambda r: r["x"] % 2 == 0)
+          .limit(5))
+    from ray_tpu.data.plan import optimize
+
+    _, ops, _ = optimize(list(ds._sources), list(ds._ops))
+    # filter changes row counts — limit must stay after it.
+    assert [o.kind for o in ops] == ["filter", "limit"]
+    assert [r["x"] for r in ds.take_all()] == [0, 2, 4, 6, 8]
+
+
+def test_filter_hoisted_across_shuffle(ray_cluster):
+    ds = (rd.from_items([{"x": i} for i in range(64)], parallelism=4)
+          .random_shuffle(seed=7)
+          .filter(lambda r: r["x"] < 8))
+    assert ds.explain  # plan introspection exists
+    from ray_tpu.data.dataset import _LazyExchange
+    from ray_tpu.data.plan import optimize
+
+    sources, ops, trace = optimize(list(ds._sources), list(ds._ops))
+    # The filter moved inside the exchange's parent pipeline.
+    assert any("hoist_across_exchange" in t for t in trace)
+    assert ops == []
+    assert isinstance(sources[0], _LazyExchange)
+    assert [o.kind for o in sources[0].parent_ops] == ["filter"]
+    got = sorted(r["x"] for r in ds.take_all())
+    assert got == list(range(8))
+
+
+def test_projection_hoist_respects_sort_key(ray_cluster):
+    ds_ok = (rd.from_items([{"a": i, "b": -i} for i in range(16)],
+                           parallelism=2)
+             .sort("a").select_columns(["a"]))
+    ds_blocked = (rd.from_items([{"a": i, "b": -i} for i in range(16)],
+                                parallelism=2)
+                  .sort("a").select_columns(["b"]))
+    from ray_tpu.data.plan import optimize
+
+    _, ops_ok, trace_ok = optimize(list(ds_ok._sources), list(ds_ok._ops))
+    assert ops_ok == [] and any("hoist" in t for t in trace_ok)
+    _, ops_blocked, _ = optimize(list(ds_blocked._sources),
+                                 list(ds_blocked._ops))
+    # Dropping the sort key cannot cross the exchange.
+    assert [o.kind for o in ops_blocked] == ["select_columns"]
+    assert [r["a"] for r in ds_ok.take_all()] == list(range(16))
+    assert [r["b"] for r in ds_blocked.take_all()] \
+        == [-i for i in range(16)]
+
+
+def test_optimizer_can_be_disabled(ray_cluster):
+    ctx = rd.DataContext.get_current()
+    ctx.optimizer_enabled = False
+    ds = rd.from_items([{"x": i} for i in range(10)]).map(
+        lambda r: r).limit(3)
+    assert [r["x"] for r in ds.take_all()] == [0, 1, 2]
+
+
+# ------------------------------------------------- backpressure policies
+
+
+def test_policy_swap_concurrency_cap(ray_cluster):
+    ctx = rd.DataContext.get_current()
+    ctx.backpressure_policies = [rd.ConcurrencyCapPolicy(1)]
+    ds = rd.from_items([{"x": i} for i in range(40)], parallelism=8).map(
+        lambda r: {"x": r["x"] + 1})
+    assert ds.count() == 40
+    assert ds._exec_stats.peak_inflight == 1
+
+    ctx.backpressure_policies = [rd.ConcurrencyCapPolicy(6)]
+    ds2 = rd.from_items([{"x": i} for i in range(40)], parallelism=8).map(
+        lambda r: {"x": r["x"] + 1})
+    assert ds2.count() == 40
+    assert 1 < ds2._exec_stats.peak_inflight <= 6
+
+
+def test_memory_budget_policy_admits_minimum(ray_cluster):
+    p = rd.MemoryBudgetPolicy(budget_bytes=100)
+    # Even a budget smaller than one block admits 2 tasks (no deadlock).
+    assert p.can_admit(0, 10_000)
+    assert p.can_admit(1, 10_000)
+    assert not p.can_admit(2, 10_000)
+    assert rd.ConcurrencyCapPolicy(3).describe().startswith(
+        "ConcurrencyCapPolicy")
+
+
+def test_limit_exact_through_exchange(ray_cluster):
+    # The exchange path must not bypass the cross-block cutoff.
+    ds = (rd.from_items([{"x": i} for i in range(100)], parallelism=10)
+          .limit(5).repartition(2))
+    assert sorted(r["x"] for r in ds.take_all()) == [0, 1, 2, 3, 4]
+    assert ds.count() == 5
+
+
+def test_limit_exact_through_actor_pool(ray_cluster):
+    class AddOne:
+        def __call__(self, batch):
+            return {"x": batch["x"] + 1}
+
+    ds = (rd.from_items([{"x": i} for i in range(100)], parallelism=10)
+          .limit(5).map_batches(AddOne, concurrency=2))
+    assert sorted(r["x"] for r in ds.take_all()) == [1, 2, 3, 4, 5]
+
+
+def test_unsafe_projection_merge_not_applied(ray_cluster):
+    # select(['a']).select(['b']) must still raise (b was projected away)
+    # — the optimizer may not silently "fix" it.
+    ds = (rd.from_items([{"a": 1, "b": 2}] * 3)
+          .select_columns(["a"]).select_columns(["b"]))
+    with pytest.raises(Exception):
+        ds.take_all()
+
+
+def test_exchange_runs_once_per_node(ray_cluster):
+    ds = rd.from_items([{"x": i} for i in range(32)],
+                       parallelism=4).random_shuffle(seed=3)
+    assert ds.count() == 32
+    node = ds._sources[0]
+    first = node.expanded
+    assert first is not None
+    assert ds.count() == 32  # second consumption
+    assert ds._sources[0].expanded is first  # same partitions, not re-run
