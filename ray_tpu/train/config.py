@@ -40,7 +40,18 @@ class ScalingConfig:
     # world size, builds a reshaped mesh, and the orbax restore reshards
     # the checkpoint onto it. None = fixed-size restarts (the reference's
     # Train semantics: worker groups are fixed-size per restart).
+    #
+    # The floor also arms elastic scale-UP (the reverse path, which the
+    # reference cannot do at all): while a run is degraded below
+    # ``num_workers``, a capacity monitor watches the cluster; when the
+    # missing capacity returns, workers are signalled at their next
+    # ``report()`` (a checkpoint boundary), the group re-forms LARGER,
+    # and the orbax restore reshards onto the bigger mesh.
     elastic_min_workers: Optional[int] = None
+    # Placement-group formation wait before an attempt is declared
+    # infeasible. With an elastic floor set, an infeasible TARGET size
+    # degrades to what fits instead of failing the run.
+    formation_timeout_s: float = 120.0
 
     def should_init_jax_distributed(self, num_workers: Optional[int] = None
                                     ) -> bool:

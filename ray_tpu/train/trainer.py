@@ -39,6 +39,9 @@ class Result:
     # the trial's hyperparameter config (tune results; reference
     # air.Result.config)
     config: Optional[Dict[str, Any]] = None
+    # Set when the attempt ended in a cooperative rescale exit (elastic
+    # scale-up): the size the next attempt should form at.
+    rescaled_to: Optional[int] = None
 
     @property
     def best_checkpoints(self) -> List[Checkpoint]:
@@ -54,20 +57,45 @@ class Result:
 @ray_tpu.remote
 class _ResultCollector:
     """Aggregates per-worker reports (the reference's results queue →
-    ``TrainingIterator``, ``train/trainer.py:36``)."""
+    ``TrainingIterator``, ``train/trainer.py:36``); also the rescale
+    mailbox — the capacity monitor posts a target world size here and
+    every worker's next report carries it back (the checkpoint-boundary
+    delivery point for elastic scale-up)."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self.history: List[dict] = []
         self.latest_checkpoint: Optional[str] = None
         self._pending: Dict[int, dict] = {}
+        self._push_counts: Dict[int, int] = {}
+        self._rescale_to: Optional[int] = None
+        self._rescale_round: Optional[int] = None
 
     def push(self, rank: int, metrics: dict, checkpoint_path):
+        self._push_counts[rank] = self._push_counts.get(rank, 0) + 1
         if checkpoint_path:
             self.latest_checkpoint = checkpoint_path
         self._pending[rank] = metrics
         if rank == 0:
             self.history.append(metrics)
+        deliver = None
+        if (self._rescale_to is not None
+                and len(self._push_counts) >= self.world_size):
+            # Round-synchronized delivery: arm the signal for the NEXT
+            # full report round, so every rank raises at the same step
+            # boundary — a mid-round delivery would strand the ranks that
+            # already reported inside the next collective. If some rank
+            # never reports (rank-0-only reporting), the signal is simply
+            # never delivered: skipping a rescale is safe, a wedged
+            # collective is not.
+            if self._rescale_round is None:
+                self._rescale_round = max(self._push_counts.values()) + 1
+            if self._push_counts[rank] >= self._rescale_round:
+                deliver = self._rescale_to
+        return {"rescale_to": deliver}
+
+    def request_rescale(self, target_world_size: int):
+        self._rescale_to = int(target_world_size)
         return True
 
     def state(self):
@@ -119,12 +147,44 @@ class JaxTrainer:
         restore_path = (self.resume_from_checkpoint.path
                         if self.resume_from_checkpoint else None)
         attempt = 0
-        workers = self.scaling_config.num_workers
+        target = self.scaling_config.num_workers
+        floor = self.scaling_config.elastic_min_workers
+        workers = target
+        last_rescale_result: Optional[Result] = None
+        from .worker_group import WorkerGroupFormationError
+
         while True:
             result = self._run_attempt(run_name, storage, restore_path,
                                        num_workers=workers)
             if result.error is None:
+                if result.rescaled_to is not None:
+                    # Cooperative rescale exit: capacity returned — grow
+                    # back toward the target at this checkpoint boundary
+                    # (not a failure; attempt counter untouched).
+                    workers = min(target, max(result.rescaled_to, 1))
+                    if result.checkpoint is not None:
+                        restore_path = result.checkpoint.path
+                    last_rescale_result = result
+                    continue
+                # A rescale on the run's FINAL report leaves the follow-up
+                # attempt with zero steps to train: it reports nothing.
+                # The pre-rescale attempt's metrics/checkpoint ARE the
+                # run's outcome — backfill them.
+                if last_rescale_result is not None:
+                    if result.metrics is None:
+                        result.metrics = last_rescale_result.metrics
+                    if result.checkpoint is None:
+                        result.checkpoint = last_rescale_result.checkpoint
                 return result
+            if (floor is not None
+                    and isinstance(result.error, WorkerGroupFormationError)
+                    and workers > max(floor, 1)):
+                # Formation infeasible at this size: degrade toward the
+                # floor WITHOUT burning a failure budget slot — nothing
+                # trained, nothing was lost (the scale-up monitor grows
+                # the run back once the capacity exists).
+                workers -= 1
+                continue
             attempt += 1
             if max_failures >= 0 and attempt > max_failures:
                 return result
@@ -137,9 +197,39 @@ class JaxTrainer:
             # group one smaller (never below the floor). The loop sees a
             # smaller world, builds a reshaped mesh, and the checkpoint
             # restore reshards onto it.
-            floor = self.scaling_config.elastic_min_workers
             if floor is not None and workers > max(floor, 1):
                 workers -= 1
+
+    def _start_capacity_monitor(self, collector, current: int, target: int):
+        """While a run is degraded, watch for the missing capacity to
+        return; when it does, post a rescale request that every worker's
+        next ``report()`` observes (reference semantics being extended:
+        ``storage.py:514`` restores at fixed size — growth mid-run is the
+        TPU-native preemptible-fleet addition)."""
+        import threading
+
+        stop = threading.Event()
+        need = {k: v * (target - current)
+                for k, v in self.scaling_config.worker_resources().items()}
+
+        def watch():
+            while not stop.is_set():
+                time.sleep(0.5)
+                try:
+                    avail = ray_tpu.available_resources()
+                except Exception:
+                    continue
+                if all(avail.get(k, 0.0) >= v for k, v in need.items()):
+                    try:
+                        ray_tpu.get(collector.request_rescale.remote(target))
+                    except Exception:
+                        pass
+                    return
+
+        t = threading.Thread(target=watch, daemon=True,
+                             name="elastic-capacity-monitor")
+        t.start()
+        return stop
 
     def _setup_backend(self, group: "WorkerGroup", num_workers: int):
         """Framework rendezvous hook (reference: ``Backend.on_start``,
@@ -158,9 +248,11 @@ class JaxTrainer:
         run_path = os.path.join(storage, run_name)
         collector = _ResultCollector.remote(n_workers)
         group = None
+        monitor_stop = None
         try:
             group = WorkerGroup(n_workers, sc.worker_resources(),
-                                sc.placement_strategy)
+                                sc.placement_strategy,
+                                formation_timeout_s=sc.formation_timeout_s)
             self._setup_backend(group, n_workers)
         except Exception as e:  # noqa: BLE001 — e.g. infeasible resources
             try:
@@ -171,6 +263,10 @@ class JaxTrainer:
                 group.shutdown()
             return Result(metrics=None, checkpoint=None, path=run_path,
                           error=e)
+        if (sc.elastic_min_workers is not None
+                and n_workers < sc.num_workers):
+            monitor_stop = self._start_capacity_monitor(
+                collector, n_workers, sc.num_workers)
         try:
             fn_blob = cloudpickle.dumps(self.train_loop)
             # Pre-split datasets into per-worker shards
@@ -196,17 +292,21 @@ class JaxTrainer:
             outs = ray_tpu.get(futs)
             state = ray_tpu.get(collector.state.remote())
             err: Optional[Exception] = None
+            rescaled_to = None
             for rank, o in enumerate(outs):
                 if not o.get("ok"):
                     err = RuntimeError(
                         f"worker {rank} failed:\n{o.get('tb')}")
                     break
+                if o.get("rescaled_to"):
+                    rescaled_to = int(o["rescaled_to"])
             metrics = state["history"][-1] if state["history"] else None
             ckpt = (Checkpoint(state["latest_checkpoint"])
                     if state["latest_checkpoint"] else None)
             return Result(metrics=metrics, checkpoint=ckpt, path=run_path,
                           error=err,
-                          metrics_all_workers=state.get("last_per_rank"))
+                          metrics_all_workers=state.get("last_per_rank"),
+                          rescaled_to=None if err else rescaled_to)
         except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError,
                 ConnectionError) as e:
             try:
@@ -218,6 +318,8 @@ class JaxTrainer:
             return Result(metrics=None, checkpoint=ckpt, path=run_path,
                           error=e)
         finally:
+            if monitor_stop is not None:
+                monitor_stop.set()
             group.shutdown()
             try:
                 ray_tpu.kill(collector)

@@ -104,6 +104,11 @@ class TrainWorker:
             else:
                 out = fn()
             return {"ok": True, "out": out}
+        except session_mod.RescaleSignal as s:
+            # Clean cooperative exit at a report boundary: the trainer
+            # re-forms the group at the new size and resumes from the
+            # latest checkpoint.
+            return {"ok": True, "rescaled_to": s.target_world_size}
         except Exception as e:  # noqa: BLE001
             return {"ok": False, "err": f"{e}",
                     "tb": traceback.format_exc()}
@@ -114,19 +119,27 @@ class TrainWorker:
         return True
 
 
+class WorkerGroupFormationError(TimeoutError):
+    """Placement-group reservation for the gang timed out — the cluster
+    lacks the capacity right now. Distinct from other timeouts (e.g. a
+    rendezvous GetTimeoutError) so elastic trainers can degrade on THIS
+    and only this."""
+
+
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
                  placement_strategy: str = "PACK",
-                 env_per_worker: Optional[List[Dict[str, str]]] = None):
+                 env_per_worker: Optional[List[Dict[str, str]]] = None,
+                 formation_timeout_s: float = 120.0):
         self.num_workers = num_workers
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         for b in bundles:
             if not b:
                 b["CPU"] = 1.0
         self.pg = placement_group(bundles, strategy=placement_strategy)
-        if not self.pg.wait(120):
+        if not self.pg.wait(formation_timeout_s):
             remove_placement_group(self.pg)
-            raise TimeoutError(
+            raise WorkerGroupFormationError(
                 f"could not reserve {num_workers} x {resources_per_worker} "
                 f"(cluster resources: {ray_tpu.cluster_resources()})")
         env_per_worker = env_per_worker or [{} for _ in range(num_workers)]

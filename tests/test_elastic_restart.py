@@ -1,14 +1,17 @@
-"""Elastic restart across a mesh reshape (SURVEY §7 hard part 3).
+"""Elastic restart + scale-up across mesh reshapes (SURVEY §7 hard part 3).
 
-VERDICT r2 #6: kill a mesh worker mid-train; the WorkerGroup re-forms with
-fewer hosts (``elastic_min_workers``), orbax restores the checkpoint
-RESHARDED onto the smaller mesh, and the loss continues from where it
-left off. Reference semantics being extended: Train restarts trials from
-checkpoints (``tune_controller.py:1791``) but only at fixed group size;
-the mesh reshape + resharded restore is the TPU-native addition.
+VERDICT r2 #6 / r3 #5: kill a mesh worker mid-train → the WorkerGroup
+re-forms SMALLER (``elastic_min_workers``), orbax restores the checkpoint
+RESHARDED onto the smaller mesh — and when the lost capacity returns, the
+capacity monitor signals the run at a ``report()`` boundary, the group
+re-forms LARGER, and training continues on the re-grown mesh with loss
+continuity. Reference semantics being extended: Train restarts trials
+from checkpoints (``tune_controller.py:1791``) but only at fixed group
+size; the reshape in BOTH directions is the TPU-native addition.
 """
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -17,7 +20,7 @@ import ray_tpu
 from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
 from ray_tpu.train.config import FailureConfig
 
-TOTAL_STEPS = 6
+TOTAL_STEPS = 10
 CRASH_STEP = 3
 
 
@@ -45,9 +48,10 @@ def _train_loop(config):
     world = ctx.get_world_size()
     rank = ctx.get_world_rank()
     run_dir = config["run_dir"]
+    step_sleep = config.get("step_sleep", 0.0)
 
     # One mesh device per PROCESS (host counts of virtual devices vary by
-    # env; the reshape under test is the 2-host -> 1-host transition).
+    # env; the reshape under test is the 2-host <-> 1-host transition).
     per_proc = {}
     for d in jax.devices():
         per_proc.setdefault(d.process_index, d)
@@ -69,7 +73,7 @@ def _train_loop(config):
     y = dp_sharded(y_full[rank * rows:(rank + 1) * rows], P("dp", None))
 
     # The trained weight is SHARDED over dp — a 2-device mesh holds half
-    # each; after the reshape to 1 device the restore must reassemble it.
+    # each; after a reshape the restore must redistribute it.
     w_sharding = NamedSharding(mesh, P("dp", None))
     w = jax.device_put(jnp.zeros((8, 8), jnp.float32), w_sharding)
     opt = optax.sgd(0.1)
@@ -98,9 +102,14 @@ def _train_loop(config):
         updates, opt_state = opt.update(g, opt_state)
         return optax.apply_updates(w, updates), opt_state, loss
 
+    crash_marker = os.path.join(run_dir, "crashed_once")
     for step in range(start_step, TOTAL_STEPS):
-        if world == 2 and rank == 1 and step == CRASH_STEP:
-            os._exit(1)  # simulated host loss mid-train
+        if (config.get("crash", True) and world == 2 and rank == 1
+                and step == CRASH_STEP and not os.path.exists(crash_marker)):
+            open(crash_marker, "w").close()
+            os._exit(1)  # simulated host loss mid-train (once)
+        if step_sleep:
+            time.sleep(step_sleep)
         w, opt_state, loss = step_fn(w, opt_state, x, y)
         ckpt_dir = os.path.join(run_dir, f"step_{step}")
         save_pytree({"w": w}, ckpt_dir)  # all ranks participate (orbax)
@@ -114,30 +123,31 @@ def _train_loop(config):
             train.report(metrics)
 
 
-def test_elastic_restart_reshapes_mesh_and_resumes(cluster, tmp_path):
+def test_elastic_dip_and_recover_2_1_2(cluster, tmp_path):
+    """Full cycle: crash at world 2 -> re-form at 1 (resharded restore)
+    -> capacity monitor notices the freed CPU -> re-form at 2 -> finish
+    at world 2 with loss continuity."""
     run_dir = str(tmp_path / "ckpts")
     os.makedirs(run_dir, exist_ok=True)
     trainer = JaxTrainer(
         _train_loop,
-        train_loop_config={"run_dir": run_dir},
+        train_loop_config={"run_dir": run_dir, "step_sleep": 0.4},
         scaling_config=ScalingConfig(num_workers=2, jax_distributed=True,
                                      elastic_min_workers=1),
         run_config=RunConfig(storage_path=str(tmp_path), name="elastic",
                              failure_config=FailureConfig(max_failures=2)))
     res = trainer.fit()
     assert res.error is None, res.error
-    # Finished all steps on the RESHAPED (1-worker) mesh, resuming from
-    # the post-crash checkpoint rather than step 0.
+    # Finished all steps, RE-GROWN to the 2-worker mesh after the dip.
     assert res.metrics["step"] == TOTAL_STEPS - 1
-    assert res.metrics["world"] == 1
-    # Ranks only synchronize at collectives, so rank 0 may have reported
-    # its last complete checkpoint one step behind the crash point — any
-    # genuine resume (not step 0) proves the restore path.
-    assert 1 <= res.metrics["resumed_from"] <= CRASH_STEP
+    assert res.metrics["world"] == 2, (
+        f"run never re-grew: final world={res.metrics['world']}")
+    # The final attempt resumed from a checkpoint, not from step 0.
+    assert res.metrics["resumed_from"] >= 1
 
     # Loss continuity: the elastic run's final loss matches a single-
     # process uninterrupted reference to float tolerance (same data, same
-    # schedule — the reshape + resharded restore changed nothing
+    # schedule — the reshapes + resharded restores changed nothing
     # numerically).
     import jax
     import jax.numpy as jnp
@@ -155,3 +165,55 @@ def test_elastic_restart_reshapes_mesh_and_resumes(cluster, tmp_path):
         up, st = opt.update(g, st)
         w = optax.apply_updates(w, up)
     assert abs(res.metrics["loss"] - float(loss)) < 1e-5
+
+
+def test_elastic_scale_up_from_constrained_start(tmp_path):
+    """1 -> 2: the target size is infeasible at launch (one 'trainslot'
+    in the cluster), the run degrades to 1 WITHOUT burning the failure
+    budget, and when a node with the missing capacity joins, the run
+    re-forms at 2 mid-flight."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 4,
+                                "resources": {"trainslot": 1}})
+    try:
+        run_dir = str(tmp_path / "ckpts")
+        os.makedirs(run_dir, exist_ok=True)
+        trainer = JaxTrainer(
+            _train_loop,
+            train_loop_config={"run_dir": run_dir, "step_sleep": 0.4,
+                               "crash": False},
+            scaling_config=ScalingConfig(
+                num_workers=2, jax_distributed=True, elastic_min_workers=1,
+                resources_per_worker={"CPU": 1, "trainslot": 1},
+                formation_timeout_s=3),
+            run_config=RunConfig(storage_path=str(tmp_path), name="growup",
+                                 failure_config=FailureConfig(
+                                     max_failures=0)))
+
+        import threading
+
+        def add_capacity():
+            # Gate on observed progress, not wall time (this host's
+            # timing swings 2.5x): the degraded run has written its
+            # second checkpoint => >= 8 steps (~3s+) still ahead of it.
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if os.path.isdir(os.path.join(run_dir, "step_1")):
+                    break
+                time.sleep(0.2)
+            c.add_node(num_cpus=4, resources={"trainslot": 1},
+                       num_initial_workers=1)
+
+        t = threading.Thread(target=add_capacity, daemon=True)
+        t.start()
+        res = trainer.fit()
+        t.join()
+        assert res.error is None, res.error
+        assert res.metrics["step"] == TOTAL_STEPS - 1
+        assert res.metrics["world"] == 2, (
+            f"run never grew to 2: final world={res.metrics['world']}")
+        assert res.metrics["resumed_from"] >= 1  # grew from a checkpoint
+    finally:
+        c.shutdown()
