@@ -1,9 +1,14 @@
-"""Serve ingress throughput/latency microbench.
+"""Serve ingress throughput/latency microbench — with raw controls.
 
 Mirrors the reference's serve release tests
 (``release/serve_tests/workloads/``): requests/s and p50/p99 latency
 through (a) the direct DeploymentHandle path, (b) the HTTP ingress, and
-(c) the binary RPC ingress, single client. Prints one JSON object.
+(c) the binary RPC ingress, single client. The same harness also drives
+two SAME-HOST controls — a bare aiohttp echo server (HTTP) and a bare
+asyncio msgpack echo server using the SAME framing (RPC) — so each
+framework number carries its overhead fraction vs the transport floor
+(VERDICT r3 #9). Prints one JSON object with ``http_control_rps`` /
+``rpc_control_rps`` / ``*_overhead_pct``.
 """
 
 from __future__ import annotations
@@ -22,6 +27,121 @@ from ray_tpu import serve  # noqa: E402
 def percentile(xs, p):
     xs = sorted(xs)
     return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+def _http_control(n: int = 300) -> float:
+    """Raw aiohttp echo on this host, driven by the same blocking
+    urllib client loop the Serve HTTP bench uses: the transport floor
+    against which Serve's HTTP number is an overhead fraction."""
+    import threading
+    import urllib.request
+
+    import asyncio
+
+    from aiohttp import web
+
+    started = threading.Event()
+    loop_box = {}
+
+    def server():
+        async def echo(request):
+            await request.read()
+            return web.json_response({"ok": True})
+
+        async def run():
+            app = web.Application()
+            app.router.add_post("/bench", echo)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            loop_box["port"] = site._server.sockets[0].getsockname()[1]
+            loop_box["loop"] = asyncio.get_running_loop()
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            asyncio.run(run())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    started.wait(10)
+    url = f"http://127.0.0.1:{loop_box['port']}/bench"
+
+    def call():
+        req = urllib.request.Request(url, data=b"{}", headers={
+            "Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            r.read()
+
+    call()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        call()
+    rps = n / (time.perf_counter() - t0)
+    loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
+    return round(rps, 1)
+
+
+def _rpc_control(n: int = 500) -> float:
+    """Bare asyncio echo server speaking the SAME length-prefixed msgpack
+    framing as the Serve RPC ingress, driven by the same client class:
+    the socket+codec floor for the RPC path."""
+    import struct
+    import threading
+
+    import asyncio
+
+    import msgpack
+
+    started = threading.Event()
+    box = {}
+
+    def server():
+        async def on_client(reader, writer):
+            try:
+                while True:
+                    hdr = await reader.readexactly(4)
+                    (ln,) = struct.unpack("<I", hdr)
+                    body = await reader.readexactly(ln)
+                    msg = msgpack.unpackb(body, raw=False)
+                    out = msgpack.packb(
+                        {"i": msg.get("i"), "ok": True,
+                         "result": {"ok": True}}, use_bin_type=True)
+                    writer.write(struct.pack("<I", len(out)) + out)
+                    await writer.drain()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+
+        async def run():
+            srv = await asyncio.start_server(on_client, "127.0.0.1", 0)
+            box["port"] = srv.sockets[0].getsockname()[1]
+            box["loop"] = asyncio.get_running_loop()
+            started.set()
+            async with srv:
+                await srv.serve_forever()
+
+        try:
+            asyncio.run(run())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    started.wait(10)
+
+    from ray_tpu.serve.rpc_client import ServeRpcClient
+
+    with ServeRpcClient(port=box["port"]) as c:
+        c.call("/bench", {})
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.call("/bench", {})
+        rps = n / (time.perf_counter() - t0)
+    box["loop"].call_soon_threadsafe(box["loop"].stop)
+    return round(rps, 1)
 
 
 def main():
@@ -99,9 +219,30 @@ def main():
     results["rpc_p50_ms"] = round(percentile(lats, 0.5) * 1000, 2)
     results["rpc_p99_ms"] = round(percentile(lats, 0.99) * 1000, 2)
 
-    print(json.dumps(results))
     serve.shutdown()
     ray_tpu.shutdown()
+
+    # ----------------------------------------------- same-host controls
+    # Measured AFTER the cluster is down, so the controls run on an
+    # idler host than the framework numbers did — that asymmetry favors
+    # the controls, making the overhead fractions UPPER bounds. Each
+    # control is best-effort: a control failure must not discard the
+    # framework numbers measured above.
+    try:
+        results["http_control_rps"] = _http_control()
+        results["http_overhead_pct"] = round(
+            (1 - results["http_rps"] / results["http_control_rps"]) * 100,
+            1)
+    except Exception as e:  # noqa: BLE001
+        results["http_control_error"] = repr(e)
+    try:
+        results["rpc_control_rps"] = _rpc_control()
+        results["rpc_overhead_pct"] = round(
+            (1 - results["rpc_rps"] / results["rpc_control_rps"]) * 100, 1)
+    except Exception as e:  # noqa: BLE001
+        results["rpc_control_error"] = repr(e)
+
+    print(json.dumps(results))
 
 
 if __name__ == "__main__":

@@ -24,6 +24,10 @@ _ENV_PREFIX = "RAY_TPU_"
 class RayTpuConfig:
     # ---- scheduling / task submission
     lease_window: int = 8           # in-flight pushes per leased worker
+    # Burst ceiling for the ADAPTIVE window: under backlog pressure the
+    # per-lease pipeline deepens (fewer driver<->worker refill wakeups —
+    # the dominant cost for tiny-task storms on few cores) up to this cap.
+    lease_window_max: int = 64
     max_leases_per_class: int = 64
     lease_idle_return_s: float = 0.25
     task_pool_threads: int = 8      # concurrent plain tasks per worker

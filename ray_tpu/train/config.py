@@ -48,6 +48,10 @@ class ScalingConfig:
     # ``report()`` (a checkpoint boundary), the group re-forms LARGER,
     # and the orbax restore reshards onto the bigger mesh.
     elastic_min_workers: Optional[int] = None
+    # Arm the capacity monitor / mid-run regrowth when degraded below
+    # num_workers (only meaningful with elastic_min_workers set). False =
+    # shrink-only elasticity: a degraded run stays at its reduced size.
+    elastic_scale_up: bool = True
     # Placement-group formation wait before an attempt is declared
     # infeasible. With an elastic floor set, an infeasible TARGET size
     # degrades to what fits instead of failing the run.

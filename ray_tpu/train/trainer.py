@@ -182,8 +182,11 @@ class JaxTrainer:
                 # Formation infeasible at this size: degrade toward the
                 # floor WITHOUT burning a failure budget slot — nothing
                 # trained, nothing was lost (the scale-up monitor grows
-                # the run back once the capacity exists).
-                workers -= 1
+                # the run back once the capacity exists). Jump straight
+                # to what the cluster reports it can fit rather than
+                # paying a formation timeout per single decrement.
+                workers = max(max(floor, 1),
+                              min(workers - 1, self._feasible_workers()))
                 continue
             attempt += 1
             if max_failures >= 0 and attempt > max_failures:
@@ -199,6 +202,17 @@ class JaxTrainer:
             # restore reshards onto it.
             if floor is not None and workers > max(floor, 1):
                 workers -= 1
+
+    def _feasible_workers(self) -> int:
+        """How many workers the cluster's AVAILABLE resources fit now —
+        the first-retry size after an infeasible formation."""
+        res = self.scaling_config.worker_resources()
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:
+            return 1
+        fits = [int(avail.get(k, 0.0) // v) for k, v in res.items() if v > 0]
+        return max(1, min(fits) if fits else 1)
 
     def _start_capacity_monitor(self, collector, current: int, target: int):
         """While a run is degraded, watch for the missing capacity to
@@ -263,7 +277,7 @@ class JaxTrainer:
                 group.shutdown()
             return Result(metrics=None, checkpoint=None, path=run_path,
                           error=e)
-        if (sc.elastic_min_workers is not None
+        if (sc.elastic_min_workers is not None and sc.elastic_scale_up
                 and n_workers < sc.num_workers):
             monitor_stop = self._start_capacity_monitor(
                 collector, n_workers, sc.num_workers)

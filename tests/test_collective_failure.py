@@ -1,0 +1,133 @@
+"""Death INSIDE a collective (VERDICT r3 #8).
+
+The hard TPU failure mode: a host dies while the other ranks are blocked
+in a cross-process collective. The survivors cannot observe the death
+from within the collective — detection must come from the control
+plane's health channel (actor-death propagation), which aborts the
+wedged program (kill of the surviving actors unwedges them: the exit
+control message is handled on the worker's event loop, not the blocked
+executor thread) and re-forms the group from the last checkpoint.
+
+Reference failure model: ``gcs_health_check_manager.h:39`` node health
+probes + Train fault tolerance (``tune_controller.py:1791``) — but the
+reference never SIGKILLs a rank mid-allreduce in its test suite either;
+this simulates it with a real ``jax.distributed`` barrier wedge.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.config import FailureConfig
+
+TOTAL_STEPS = 4
+KILL_STEP = 2
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _train_loop(config):
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from ray_tpu import train
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    ctx = train.get_context()
+    world = ctx.get_world_size()
+    rank = ctx.get_world_rank()
+    run_dir = config["run_dir"]
+
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start_step = int(ckpt.get_metadata()["step"]) + 1
+
+    acc = float(np.float32(config.get("acc0", 0.0)))
+    for step in range(start_step, TOTAL_STEPS):
+        if world == 2 and step == KILL_STEP:
+            if rank == 1:
+                # Advertise the pid, then stall OUTSIDE the barrier: the
+                # killer SIGKILLs this process while rank 0 is already
+                # blocked INSIDE sync_global_devices waiting for it.
+                with open(os.path.join(run_dir, "victim_pid"), "w") as f:
+                    f.write(str(os.getpid()))
+                time.sleep(300)  # killed long before this returns
+        if world > 1:
+            # A REAL cross-process collective: every live rank blocks
+            # here until all ranks arrive.
+            multihost_utils.sync_global_devices(f"step_{step}")
+        acc += float(jax.numpy.float32(step))
+        ckpt_dir = os.path.join(run_dir, f"step_{step}")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        metrics = {"step": step, "acc": acc, "world": world}
+        if rank == 0:
+            c = Checkpoint.from_directory(ckpt_dir)
+            c.set_metadata({"step": step})
+            train.report(metrics, checkpoint=c)
+        else:
+            train.report(metrics)
+
+
+def test_sigkill_inside_collective_detected_and_reformed(cluster, tmp_path):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir, exist_ok=True)
+
+    import threading
+
+    def killer():
+        pid_file = os.path.join(run_dir, "victim_pid")
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.exists(pid_file):
+                time.sleep(0.5)  # rank 0 is in (or entering) the barrier
+                os.kill(int(open(pid_file).read()), signal.SIGKILL)
+                return
+            time.sleep(0.1)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+
+    trainer = JaxTrainer(
+        _train_loop,
+        train_loop_config={"run_dir": run_dir},
+        scaling_config=ScalingConfig(num_workers=2, jax_distributed=True,
+                                     elastic_min_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="collkill",
+                             failure_config=FailureConfig(max_failures=2)))
+    # The directive's bar: a 60s hang is a FAIL, not a longer wait — run
+    # fit() on a bounded thread so a wedged collective surfaces as a test
+    # failure instead of an indefinite hang.
+    box = {}
+
+    def run_fit():
+        box["res"] = trainer.fit()
+
+    ft = threading.Thread(target=run_fit, daemon=True)
+    t0 = time.time()
+    ft.start()
+    ft.join(timeout=60)
+    wall = time.time() - t0
+    if ft.is_alive():
+        pytest.fail(
+            "collective-death recovery exceeded 60s — survivors wedged "
+            "in the barrier were never aborted")
+    res = box["res"]
+    t.join(timeout=5)
+    assert wall < 60, f"recovery took {wall:.0f}s"
+    assert res.error is None, res.error
+    assert res.metrics["step"] == TOTAL_STEPS - 1
+    # The final attempt ran reshaped (the dead host's capacity was
+    # presumed gone at restart; the scale-up monitor may or may not have
+    # re-grown it within the short tail — either end state is healthy).
+    assert res.metrics["world"] in (1, 2)

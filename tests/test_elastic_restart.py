@@ -217,3 +217,23 @@ def test_elastic_scale_up_from_constrained_start(tmp_path):
         assert res.metrics["resumed_from"] >= 1  # grew from a checkpoint
     finally:
         c.shutdown()
+
+
+def test_elastic_downscale_only_when_scale_up_disabled(cluster, tmp_path):
+    """The original shrink-only contract: capacity presumed gone, the run
+    FINISHES on the reshaped 1-worker mesh (no regrowth attempted)."""
+    run_dir = str(tmp_path / "ckpts")
+    os.makedirs(run_dir, exist_ok=True)
+    trainer = JaxTrainer(
+        _train_loop,
+        train_loop_config={"run_dir": run_dir},
+        scaling_config=ScalingConfig(num_workers=2, jax_distributed=True,
+                                     elastic_min_workers=1,
+                                     elastic_scale_up=False),
+        run_config=RunConfig(storage_path=str(tmp_path), name="downonly",
+                             failure_config=FailureConfig(max_failures=2)))
+    res = trainer.fit()
+    assert res.error is None, res.error
+    assert res.metrics["step"] == TOTAL_STEPS - 1
+    assert res.metrics["world"] == 1  # stayed shrunk
+    assert 1 <= res.metrics["resumed_from"] <= CRASH_STEP
