@@ -1226,10 +1226,15 @@ class Worker:
         it. Scale-out demand is computed from the PRE-drain backlog
         against base-window capacity — deep pipelining never reduces the
         number of workers requested vs the fixed-window behavior."""
-        n_leases = sum(1 for l in cls.leases.values()
-                       if not l.dead and (l.conn is None
-                                          or not l.conn.closed))
+        live = [l for l in cls.leases.values()
+                if not l.dead and (l.conn is None or not l.conn.closed)]
+        n_leases = len(live)
         backlog0 = len(cls.queue)
+        # Free capacity at the BASE window, measured before the drain:
+        # scale-out fires whenever the backlog would not have fit in the
+        # fixed-window regime, regardless of how deep the adaptive drain
+        # below goes.
+        free_base = sum(max(0, _LEASE_WINDOW - l.busy) for l in live)
         fast = cls.avg_s is not None and cls.avg_s < 0.005
         window = _LEASE_WINDOW
         if fast:
@@ -1250,7 +1255,7 @@ class Worker:
         if backlog0:
             want = min(backlog0, _MAX_LEASES_PER_CLASS) - len(cls.leases) \
                 - cls.demand
-            if want > 0 and backlog0 > n_leases * _LEASE_WINDOW:
+            if want > 0 and backlog0 > free_base:
                 cls.demand += want
                 self._send_gcs({"t": "lease_req", "key": cls.key,
                                 "n": want, **cls.wire})

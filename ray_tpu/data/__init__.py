@@ -18,7 +18,9 @@ from .read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
     read_webdataset,
 )
 
@@ -28,7 +30,7 @@ __all__ = [
     "from_huggingface",
     "range", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy", "read_binary_files", "read_images", "read_webdataset",
-    "Datasource", "read_datasource",
+    "Datasource", "read_datasource", "read_sql", "read_tfrecords",
     "DataContext", "BackpressurePolicy", "ConcurrencyCapPolicy",
     "MemoryBudgetPolicy",
 ]

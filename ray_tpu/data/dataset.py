@@ -1250,6 +1250,23 @@ class Dataset:
             block = ray_tpu.get(ref)
             pcsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
 
+    def write_tfrecords(self, path: str):
+        """One TFRecord file of ``tf.train.Example`` records per block
+        (reference: ``Dataset.write_tfrecords`` — implemented without
+        tensorflow via ``data/tfrecords.py``; readable by TF and by
+        ``read_tfrecords``)."""
+        import os
+
+        from .tfrecords import encode_example, write_tfrecord_frames
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._stream_refs()):
+            block = to_block(ray_tpu.get(ref))
+            rows = BlockAccessor(block).rows()
+            write_tfrecord_frames(
+                os.path.join(path, f"part-{i:05d}.tfrecord"),
+                (encode_example(dict(r)) for r in rows))
+
     def write_json(self, path: str):
         """One JSONL file per block (reference: ``Dataset.write_json``)."""
         import json as jsonlib

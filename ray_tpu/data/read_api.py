@@ -11,6 +11,7 @@ import functools
 import glob as globlib
 import math
 import os
+from builtins import range as builtins_range
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -170,6 +171,69 @@ def _read_numpy_file(path: str):
 def read_numpy(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
     return Dataset([functools.partial(_read_numpy_file, f) for f in files])
+
+
+def _read_tfrecords_file(path: str, raw: bool, verify: bool):
+    from .tfrecords import parse_example, read_tfrecord_frames
+
+    if raw:
+        return {"bytes": np.array(
+            list(read_tfrecord_frames(path, verify=verify)), dtype=object)}
+    rows = [parse_example(p)
+            for p in read_tfrecord_frames(path, verify=verify)]
+    if not rows:
+        # Zero-row, zero-column block: a phantom column here would
+        # pollute the dataset schema next to non-empty sibling files.
+        import pyarrow as pa
+
+        return pa.table({})
+    return to_block(rows)
+
+
+def read_tfrecords(paths: Union[str, List[str]], *, raw: bool = False,
+                   verify_crc: bool = False, **kw) -> Dataset:
+    """TFRecord files of ``tf.train.Example`` records, one row per
+    record (reference: ``ray.data.read_tfrecords`` — implemented here
+    without tensorflow: dependency-free framing + Example wire parsing,
+    ``data/tfrecords.py``). ``raw=True`` yields the undecoded payload
+    bytes instead; ``verify_crc`` checks the CRC32C frame checksums."""
+    files = _expand_paths(paths)
+    return Dataset([functools.partial(_read_tfrecords_file, f, raw,
+                                      verify_crc) for f in files])
+
+
+def _read_sql_shard(connection_factory, sql: str, shard, n_shards):
+    # DB-API has no portable row-range pushdown, so each task runs the
+    # query and keeps its slice (the reference's read_sql carries the
+    # same caveat and defaults to one read task; shard in SQL for large
+    # results).
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        cur.execute(sql)
+        cols = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        conn.close()
+    lo = (len(rows) * shard) // n_shards
+    hi = (len(rows) * (shard + 1)) // n_shards
+    part = rows[lo:hi]
+    return to_block([dict(zip(cols, r)) for r in part]) if part \
+        else {c: np.array([]) for c in cols}
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
+             **kw) -> Dataset:
+    """Rows of a SQL query via any DB-API connection factory
+    (reference: ``ray.data.read_sql`` — connection factories, not
+    connections, cross the wire so each read task opens its own).
+    ``parallelism > 1`` splits the result set across tasks (each task
+    runs the query; use a single task or shard in SQL for large
+    results)."""
+    parallelism = max(1, int(parallelism))
+    return Dataset([functools.partial(_read_sql_shard, connection_factory,
+                                      sql, i, parallelism)
+                    for i in builtins_range(parallelism)])
 
 
 def _read_binary_file(path: str, include_paths: bool):

@@ -1,7 +1,9 @@
 from ..train.session import get_checkpoint, get_context, report
 from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
                          MedianStoppingRule, PB2,
-                         PopulationBasedTraining)
+                         PopulationBasedTraining,
+                         ResourceChangingScheduler,
+                         evenly_distribute_cpus)
 from .search import (
     BasicVariantGenerator,
     BayesOptSearcher,
@@ -34,6 +36,7 @@ def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
 
 
 __all__ = [
+    "ResourceChangingScheduler", "evenly_distribute_cpus",
     "Tuner", "TuneConfig", "ResultGrid", "run", "report", "get_context",
     "get_checkpoint", "choice", "uniform", "loguniform", "randint",
     "quniform", "sample_from", "grid_search", "FIFOScheduler",

@@ -15,6 +15,8 @@ CONTINUE = "continue"
 STOP = "stop"
 # PBT: stop current run; restart with new config from a donor checkpoint.
 EXPLOIT = "exploit"
+# ResourceChangingScheduler: checkpoint, kill, relaunch with new resources.
+REALLOCATE = "reallocate"
 
 
 class FIFOScheduler:
@@ -302,3 +304,68 @@ class PB2(PopulationBasedTraining):
                 v = int(round(v))
             out[key] = v
         return out
+
+
+class ResourceChangingScheduler(FIFOScheduler):
+    """Reallocate a live trial's resources mid-tune.
+
+    Reference: ``tune/schedulers/resource_changing_scheduler.py`` — wraps
+    a base scheduler; after any report the
+    ``resources_allocation_function(trial_id, result, current_resources)``
+    may return a NEW resource dict for that trial. The controller then
+    checkpoints (implicitly: the trial's latest pushed checkpoint), kills
+    the trial actor, and relaunches it with the new resources, resuming
+    from its own checkpoint. The base scheduler's early-stopping decisions
+    take precedence; a PBT base's exploit mechanics do not compose through
+    this wrapper (matching the reference's documented restriction).
+    """
+
+    def __init__(self, base_scheduler=None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc = resources_allocation_function
+        self._current: Dict[str, Dict[str, float]] = {}
+        # trial_id -> resources for its next incarnation (the controller
+        # pops this when it processes the REALLOCATE decision).
+        self.pending_resources: Dict[str, Dict[str, float]] = {}
+
+    def set_trial_resources(self, trial_id: str,
+                            resources: Optional[Dict[str, float]]):
+        self._current[trial_id] = dict(resources or {})
+
+    def trial_resources(self, trial_id: str) -> Dict[str, float]:
+        return dict(self._current.get(trial_id) or {})
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        decision = self.base.on_result(trial_id, result)
+        if decision != CONTINUE or self.alloc is None:
+            return decision
+        cur = self.trial_resources(trial_id)
+        new = self.alloc(trial_id, result, dict(cur))
+        if new and dict(new) != cur:
+            self.pending_resources[trial_id] = dict(new)
+            self._current[trial_id] = dict(new)
+            return REALLOCATE
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        self.base.on_trial_complete(trial_id)
+
+
+def evenly_distribute_cpus(max_total_cpus: float):
+    """A stock allocation function (reference: ``DistributeResources``):
+    grow each reporting trial's CPU share toward an even split of
+    ``max_total_cpus`` over the trials seen so far."""
+    seen = set()
+
+    def alloc(trial_id, result, current):
+        # Reallocated incarnations keep the controller's `<id>r...`
+        # naming — count the LOGICAL trial, or each reallocation would
+        # shrink its own share and thrash.
+        seen.add(trial_id.rstrip("r"))
+        share = max(1.0, max_total_cpus // max(len(seen), 1))
+        if current.get("CPU") != share:
+            return {**current, "CPU": share}
+        return None
+
+    return alloc

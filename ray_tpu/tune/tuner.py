@@ -23,7 +23,8 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig
 from ray_tpu.train.trainer import JaxTrainer, Result
 
-from .schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler, PopulationBasedTraining
+from .schedulers import (CONTINUE, EXPLOIT, REALLOCATE, STOP,
+                         FIFOScheduler, PopulationBasedTraining)
 from .search import generate_variants
 
 
@@ -122,13 +123,17 @@ class _TrialActor:
 
 
 class Trial:
-    def __init__(self, trial_id: str, config: dict):
+    def __init__(self, trial_id: str, config: dict,
+                 resources: Optional[dict] = None):
         self.id = trial_id
         self.config = config
         self.state = "PENDING"
         self.actor = None
         self.run_ref = None
         self.restore_path: Optional[str] = None
+        # Per-trial actor resources; ResourceChangingScheduler rewrites
+        # this between incarnations.
+        self.resources: Optional[dict] = resources
         self.killed_by_scheduler = False
         self.error: Optional[str] = None
         self.last_result: Optional[dict] = None
@@ -281,11 +286,22 @@ class Tuner:
             return t
 
         def launch(trial: Trial):
-            trial.actor = _TrialActor.remote()
+            cls = _TrialActor
+            if trial.resources:
+                res = dict(trial.resources)
+                opts = {"num_cpus": res.pop("CPU", 0) or 0,
+                        "num_tpus": res.pop("TPU", 0) or 0}
+                if res:
+                    opts["resources"] = res
+                cls = _TrialActor.options(**opts)
+            trial.actor = cls.remote()
             trial.run_ref = trial.actor.run.remote(
                 fn_blob, trial.config, trial.id, storage, exp_name,
                 collector, trial.restore_path)
             trial.state = "RUNNING"
+            set_res = getattr(scheduler, "set_trial_resources", None)
+            if set_res is not None:
+                set_res(trial.id, trial.resources)
             running.append(trial)
 
         while True:
@@ -319,6 +335,26 @@ class Tuner:
                 if decision == STOP:
                     trial.killed_by_scheduler = True
                     ray_tpu.kill(trial.actor)
+                elif decision == REALLOCATE:
+                    # ResourceChangingScheduler: checkpoint (the trial's
+                    # latest pushed one), kill, relaunch the SAME config
+                    # with the new resources, resuming from itself. State
+                    # flips off RUNNING immediately so a second report of
+                    # the same trial in this drain batch cannot spawn a
+                    # duplicate clone.
+                    new_res = getattr(scheduler, "pending_resources",
+                                      {}).pop(tid, None)
+                    state = ray_tpu.get(collector.state.remote())
+                    own_ckpt = state["checkpoints"].get(tid)
+                    trial.killed_by_scheduler = True
+                    trial.state = "PAUSED"
+                    ray_tpu.kill(trial.actor)
+                    clone = Trial(tid + "r", dict(trial.config),
+                                  resources=new_res)
+                    clone.restore_path = own_ckpt
+                    trial_by_id[clone.id] = clone
+                    trials.append(clone)
+                    pending.append(clone)
                 elif decision == EXPLOIT and isinstance(
                         scheduler, PopulationBasedTraining):
                     donor_id = scheduler.exploit_target(tid)

@@ -230,3 +230,29 @@ def test_dynamic_returns_via_gcs_path(ray_cluster):
         num_returns="dynamic",
         scheduling_strategy="SPREAD").remote(3), timeout=60)
     assert [ray_tpu.get(r) for r in gen] == [100, 101, 102]
+
+
+def test_slow_task_backlog_scales_out(ray_cluster):
+    """A backlog of slow tasks queued behind one busy lease must request
+    more workers (the adaptive-window change briefly gated scale-out on
+    backlog exceeding n_leases*window, which never fires when the queue
+    arrives after one worker's window is already full)."""
+    import os as _os
+    import time as _time
+
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def slow():
+        _time.sleep(0.6)
+        return _os.getpid()
+
+    # Fill one worker's base window with slow tasks...
+    first = [slow.remote() for _ in range(8)]
+    _time.sleep(0.15)
+    # ...then queue a second backlog while it is busy.
+    second = [slow.remote() for _ in range(8)]
+    pids = set(ray_tpu.get(first + second, timeout=120))
+    assert len(pids) >= 2, (
+        f"16 x 0.6s tasks all ran in one worker ({pids}) — backlog "
+        f"behind a busy lease did not scale out")
