@@ -376,6 +376,13 @@ class GcsServer:
         # prestart-by-demand, worker_pool.h:174).
         self._actor_pending_place: Dict[ActorID, ActorRecord] = {}
         self.objects: Dict[ObjectID, ObjectEntry] = {}
+        # Ref deltas that arrived before their object's directory entry
+        # exists (a fire-and-forget driver can drop its result ref — and
+        # flush the -1 — before the worker's obj_put lands). Deltas
+        # commute, so they park here and apply at entry creation (_obj).
+        # Capped: a delta for an object that never materializes must not
+        # grow this forever.
+        self._early_ref_deltas: Dict[ObjectID, int] = {}
         self.zero_ref_lru: "OrderedDict[ObjectID, int]" = OrderedDict()
         self.shm_bytes = 0
         self.actors: Dict[ActorID, ActorRecord] = {}
@@ -991,6 +998,9 @@ class GcsServer:
         entry = self.objects.get(object_id)
         if entry is None:
             entry = ObjectEntry(object_id)
+            early = self._early_ref_deltas.pop(object_id, 0)
+            if early:
+                entry.refcount += early
             self.objects[object_id] = entry
         return entry
 
@@ -1045,12 +1055,17 @@ class GcsServer:
         owner_wid = msg.get("owner_wid")
         if owner_wid is not None:
             owner = self._client_by_wid.get(bytes(owner_wid), client)
-        entry.refcount += 1  # the owner's initial reference
-        entry.owner = owner
+        if entry.owner is None:
+            # First sight of this object (put()/actor results): pin the
+            # owner's initial reference. Task returns submitted through
+            # _h_submit were already pinned there — pinning again here
+            # double-counted and stranded the result forever.
+            entry.refcount += 1
+            entry.owner = owner
+            self._owned_objects.setdefault(self._owner_key(owner),
+                                           set()).add(oid)
         if client.node_id is not None and msg.get("shm"):
             entry.holders.add(client.node_id.binary())
-        self._owned_objects.setdefault(self._owner_key(owner),
-                                       set()).add(oid)
         self._mark_ready(entry, msg["nbytes"], msg.get("data"),
                          msg.get("shm", False))
         if msg.get("data") is not None:
@@ -1217,6 +1232,14 @@ class GcsServer:
             oid = ObjectID(oid_bytes)
             entry = self.objects.get(oid)
             if entry is None:
+                # Early delta: the ref release/borrow outran the object's
+                # registration. Park it; _obj() applies it at creation.
+                if delta:
+                    self._early_ref_deltas[oid] = \
+                        self._early_ref_deltas.get(oid, 0) + delta
+                    while len(self._early_ref_deltas) > 65536:
+                        self._early_ref_deltas.pop(
+                            next(iter(self._early_ref_deltas)))
                 continue
             entry.refcount += delta
             if entry.refcount <= 0 and entry.ready:
@@ -1470,9 +1493,15 @@ class GcsServer:
         self.tasks[tid] = record
         for oid in record.returns:
             entry = self._obj(oid)
-            entry.refcount += 1
-            self._owned_objects.setdefault(self._owner_key(client),
-                                           set()).add(oid)
+            # The owner's initial reference, pinned ONCE here — the
+            # worker's later obj_put registration sees entry.owner set and
+            # must NOT pin again (a submit+put double count permanently
+            # leaked every >inline task result).
+            if entry.owner is None:
+                entry.refcount += 1
+                entry.owner = client
+                self._owned_objects.setdefault(self._owner_key(client),
+                                               set()).add(oid)
             if record.retries_left > 0:
                 entry.producing_task = {"msg": msg, "owner": client}
         self.pending.append(record)
