@@ -14,8 +14,11 @@ from .read_api import (
     read_binary_files,
     read_csv,
     read_datasource,
+    read_delta,
+    read_iceberg,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
     read_sql,
@@ -31,6 +34,7 @@ __all__ = [
     "range", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy", "read_binary_files", "read_images", "read_webdataset",
     "Datasource", "read_datasource", "read_sql", "read_tfrecords",
+    "read_delta", "read_iceberg", "read_mongo",
     "DataContext", "BackpressurePolicy", "ConcurrencyCapPolicy",
     "MemoryBudgetPolicy",
 ]

@@ -79,16 +79,19 @@ def read_tfrecord_frames(path: str, *, verify: bool = False
             yield data
 
 
+def frame_tfrecord(data: bytes) -> bytes:
+    """One TFRecord frame (length/CRC header + payload + payload CRC)."""
+    hdr = struct.pack("<Q", len(data))
+    return b"".join((hdr, struct.pack("<I", _masked_crc(hdr)), data,
+                     struct.pack("<I", _masked_crc(data))))
+
+
 def write_tfrecord_frames(path: str, payloads) -> int:
     """Write raw payloads as a TFRecord file; returns record count."""
     n = 0
     with open(path, "wb") as f:
         for data in payloads:
-            hdr = struct.pack("<Q", len(data))
-            f.write(hdr)
-            f.write(struct.pack("<I", _masked_crc(hdr)))
-            f.write(data)
-            f.write(struct.pack("<I", _masked_crc(data)))
+            f.write(frame_tfrecord(data))
             n += 1
     return n
 

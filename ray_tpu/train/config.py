@@ -95,6 +95,10 @@ class RunConfig:
     # Experiment callbacks (reference: ``ray.tune.Callback`` /
     # ``air.RunConfig.callbacks``), invoked by the Tune loop.
     callbacks: Optional[list] = None
+    # Stop criterion: dict ({"training_iteration": 10}), callable
+    # (trial_id, result) -> bool, or a ``ray_tpu.tune.Stopper``
+    # (reference: ``air.RunConfig.stop``).
+    stop: Optional[object] = None
 
     def resolved_storage_path(self) -> str:
         return os.path.expanduser(

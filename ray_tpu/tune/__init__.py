@@ -18,11 +18,40 @@ from .search import (
     sample_from,
     uniform,
 )
+from .external import (
+    AxSearch,
+    BOHBSearcher,
+    HEBOSearch,
+    HyperOptSearch,
+    NevergradSearch,
+    OptunaSearch,
+    SkoptSearch,
+)
+from .callback import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    LoggerCallback,
+    TBXLoggerCallback,
+)
+from .integrations import MLflowLoggerCallback, WandbLoggerCallback
+from .stopper import (
+    CombinedStopper,
+    DictStopper,
+    ExperimentPlateauStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    NoopStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
 from .tuner import ResultGrid, TuneConfig, Tuner
 
 
 def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
-        scheduler=None, name=None, storage_path=None, **kw):
+        scheduler=None, search_alg=None, name=None, storage_path=None,
+        stop=None, callbacks=None, **kw):
     """``tune.run`` compatibility wrapper around ``Tuner`` (reference:
     ``python/ray/tune/tune.py:267``)."""
     from ..train.config import RunConfig
@@ -30,8 +59,10 @@ def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
     tuner = Tuner(
         trainable, param_space=config or {},
         tune_config=TuneConfig(metric=metric, mode=mode,
-                               num_samples=num_samples, scheduler=scheduler),
-        run_config=RunConfig(name=name, storage_path=storage_path))
+                               num_samples=num_samples, scheduler=scheduler,
+                               search_alg=search_alg),
+        run_config=RunConfig(name=name, storage_path=storage_path,
+                             stop=stop, callbacks=callbacks))
     return tuner.fit()
 
 
@@ -43,6 +74,14 @@ __all__ = [
     "ASHAScheduler", "PopulationBasedTraining", "PB2", "HyperBandScheduler",
     "MedianStoppingRule", "Searcher", "BasicVariantGenerator",
     "TPESearcher", "BayesOptSearcher", "ConcurrencyLimiter",
+    "OptunaSearch", "HyperOptSearch", "AxSearch", "NevergradSearch",
+    "HEBOSearch", "SkoptSearch", "BOHBSearcher",
+    "Callback", "LoggerCallback", "JsonLoggerCallback",
+    "CSVLoggerCallback", "TBXLoggerCallback",
+    "WandbLoggerCallback", "MLflowLoggerCallback",
+    "Stopper", "NoopStopper", "FunctionStopper", "DictStopper",
+    "MaximumIterationStopper", "TimeoutStopper", "TrialPlateauStopper",
+    "ExperimentPlateauStopper", "CombinedStopper",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

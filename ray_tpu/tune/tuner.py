@@ -137,6 +137,7 @@ class Trial:
         self.killed_by_scheduler = False
         self.error: Optional[str] = None
         self.last_result: Optional[dict] = None
+        self.logdir: Optional[str] = None  # set at launch
 
 
 class ResultGrid:
@@ -181,10 +182,71 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
 
+    # --------------------------------------------------- experiment resume
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        """True if ``path`` holds a restorable experiment (reference:
+        ``Tuner.can_restore``)."""
+        return os.path.isfile(os.path.join(path, "tuner.pkl")) and \
+            os.path.isfile(os.path.join(path, "trials_state.pkl"))
+
+    @classmethod
+    def restore(cls, path: str, trainable=None, *,
+                restart_errored: bool = False) -> "Tuner":
+        """Resume an interrupted experiment from its directory (reference:
+        ``python/ray/tune/tuner.py:Tuner.restore``).
+
+        Finished trials keep their recorded results and are NOT re-run;
+        unfinished (interrupted) trials re-launch with their saved configs,
+        restoring from their latest persisted checkpoint; errored trials
+        re-launch only with ``restart_errored=True``. The resumed run
+        executes exactly the recorded trial set — no new variants are
+        generated. Pass ``trainable`` to supply fresh code; otherwise the
+        persisted trainable is reused.
+        """
+        if not cls.can_restore(path):
+            raise ValueError(f"no restorable experiment at {path}")
+        with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+            meta = cloudpickle.load(f)
+        with open(os.path.join(path, "trials_state.pkl"), "rb") as f:
+            tstate = cloudpickle.load(f)
+        path = os.path.abspath(path.rstrip(os.sep))
+        self = cls(trainable,
+                   tune_config=TuneConfig(metric=meta["metric"],
+                                          mode=meta["mode"]),
+                   run_config=RunConfig(name=os.path.basename(path),
+                                        storage_path=os.path.dirname(path)))
+        self._resume = {"meta": meta, "trials": tstate,
+                        "restart_errored": restart_errored}
+        return self
+
+    @staticmethod
+    def _latest_checkpoint(trial_dir: str) -> Optional[str]:
+        import glob as _glob
+
+        cks = sorted(_glob.glob(os.path.join(trial_dir, "checkpoint_*")))
+        return cks[-1] if cks else None
+
+    def _persist_trials(self, storage: str, exp_name: str, trials) -> None:
+        # A resumed run re-launches only the unfinished trials; the
+        # finished ones' records must survive into the rewritten state
+        # file or a second restore would lose them entirely.
+        state = dict(getattr(self, "_preserved_state", {}))
+        state.update({t.id: {"config": t.config, "state": t.state,
+                             "error": t.error,
+                             "last_result": t.last_result}
+                      for t in trials})
+        tmp = os.path.join(storage, exp_name, ".trials_state.tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, os.path.join(storage, exp_name, "trials_state.pkl"))
+
     def fit(self) -> ResultGrid:
         if not ray_tpu.is_initialized():
             ray_tpu.init(ignore_reinit_error=True)
         tc = self.tune_config
+        resume = getattr(self, "_resume", None)
         exp_name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
         storage = self.run_config.resolved_storage_path()
         os.makedirs(os.path.join(storage, exp_name), exist_ok=True)
@@ -194,7 +256,52 @@ class Tuner:
             scheduler.metric = tc.metric
         # Trainable normalization: JaxTrainer -> run its loop via fit()
         wrap_key = None
-        if isinstance(self.trainable, JaxTrainer):
+        pre_results: List[Result] = []
+        initial_pending: List[Trial] = []
+        if resume is not None:
+            meta = resume["meta"]
+            wrap_key = meta["wrap_key"]
+            search_space = cloudpickle.loads(meta["search_space"])
+            if self.trainable is None:
+                fn_blob = meta["fn_blob"]
+            elif isinstance(self.trainable, JaxTrainer):
+                # Same normalization as a fresh fit(): a JaxTrainer is not
+                # itself callable — wrap its train loop.
+                trainer = self.trainable
+
+                def fn(config):
+                    loop_cfg = dict(trainer.train_loop_config or {})
+                    loop_cfg.update(config.get("train_loop_config", config))
+                    trainer.train_loop(loop_cfg)
+
+                fn_blob = cloudpickle.dumps(fn)
+            else:
+                fn_blob = cloudpickle.dumps(self.trainable)
+            self._preserved_state = {}
+            for tid in sorted(resume["trials"]):
+                st = resume["trials"][tid]
+                trial_dir = os.path.join(storage, exp_name, tid)
+                rerun = st["state"] not in ("TERMINATED", "ERROR") or (
+                    st["state"] == "ERROR" and resume["restart_errored"])
+                if rerun:
+                    t = Trial(tid, st["config"])
+                    t.restore_path = self._latest_checkpoint(trial_dir)
+                    initial_pending.append(t)
+                else:
+                    self._preserved_state[tid] = st
+                    ckpt = self._latest_checkpoint(trial_dir)
+                    pre_results.append(Result(
+                        metrics=st["last_result"],
+                        checkpoint=Checkpoint(ckpt) if ckpt else None,
+                        path=trial_dir,
+                        error=(RuntimeError(st["error"]) if st["error"]
+                               else None),
+                        config=dict(st["config"])))
+
+            def next_config(trial_id):
+                return "exhausted"  # resume runs the recorded set only
+            searcher = None
+        elif isinstance(self.trainable, JaxTrainer):
             trainer = self.trainable
             space = dict(self.param_space)
             search_space = space.get("train_loop_config", space)
@@ -211,26 +318,38 @@ class Tuner:
         else:
             fn_blob = cloudpickle.dumps(self.trainable)
             search_space = self.param_space
-        searcher = tc.search_alg
-        if searcher is not None:
-            searcher.set_search_properties(tc.metric, tc.mode, search_space)
-            issued = [0]
+        if resume is None:
+            searcher = tc.search_alg
+            if searcher is not None:
+                searcher.set_search_properties(tc.metric, tc.mode,
+                                               search_space)
+                issued = [0]
 
-            def next_config(trial_id):
-                # A sample slot is consumed only once the searcher actually
-                # yields a config — backpressure polls (ConcurrencyLimiter
-                # returning None) must not burn samples.
-                if issued[0] >= tc.num_samples:
-                    return "exhausted"
-                cfg = searcher.suggest(trial_id)
-                if cfg is not None:
-                    issued[0] += 1
-                return cfg
-        else:
-            queue = generate_variants(search_space, tc.num_samples, tc.seed)
+                def next_config(trial_id):
+                    # A sample slot is consumed only once the searcher
+                    # actually yields a config — backpressure polls
+                    # (ConcurrencyLimiter returning None) must not burn
+                    # samples.
+                    if issued[0] >= tc.num_samples:
+                        return "exhausted"
+                    cfg = searcher.suggest(trial_id)
+                    if cfg is not None:
+                        issued[0] += 1
+                    return cfg
+            else:
+                queue = generate_variants(search_space, tc.num_samples,
+                                          tc.seed)
 
-            def next_config(trial_id):
-                return queue.pop(0) if queue else "exhausted"
+                def next_config(trial_id):
+                    return queue.pop(0) if queue else "exhausted"
+            # Persist experiment metadata the moment the run starts so an
+            # interrupted experiment is restorable (Tuner.restore).
+            with open(os.path.join(storage, exp_name, "tuner.pkl"),
+                      "wb") as f:
+                cloudpickle.dump(
+                    {"fn_blob": fn_blob, "wrap_key": wrap_key,
+                     "search_space": cloudpickle.dumps(search_space),
+                     "metric": tc.metric, "mode": tc.mode}, f)
         trials: List[Trial] = []
         collector = _TuneCollector.remote()
         try:
@@ -238,11 +357,26 @@ class Tuner:
         except Exception:
             cpus = 2
         max_concurrent = tc.max_concurrent_trials or max(1, int(cpus))
+        callbacks = list(self.run_config.callbacks or [])
+        if os.environ.get("RAY_TPU_DISABLE_DEFAULT_LOGGERS") != "1":
+            from .callback import (CSVLoggerCallback, JsonLoggerCallback,
+                                   TBXLoggerCallback)
+
+            callbacks += [JsonLoggerCallback(), CSVLoggerCallback(),
+                          TBXLoggerCallback()]
+        for cb in callbacks:
+            cb.setup(os.path.join(storage, exp_name))
+        from .stopper import coerce_stopper
+
+        stopper = coerce_stopper(self.run_config.stop)
         self._run_loop(trials, next_config, wrap_key, fn_blob, collector,
                        scheduler, searcher, exp_name, storage,
-                       max_concurrent)
+                       max_concurrent, callbacks, initial_pending, stopper)
+        for cb in callbacks:
+            cb.on_experiment_end(trials)
+        self._persist_trials(storage, exp_name, trials)
         state = ray_tpu.get(collector.state.remote())
-        results = []
+        results = list(pre_results)
         for t in trials:
             hist = state["reports"].get(t.id, [])
             ckpt = state["checkpoints"].get(t.id)
@@ -259,11 +393,14 @@ class Tuner:
         return ResultGrid(results, tc.metric, tc.mode)
 
     def _run_loop(self, trials, next_config, wrap_key, fn_blob, collector,
-                  scheduler, searcher, exp_name, storage, max_concurrent):
-        pending: List[Trial] = []
+                  scheduler, searcher, exp_name, storage, max_concurrent,
+                  callbacks=(), initial_pending=(), stopper=None):
+        pending: List[Trial] = list(initial_pending)
         running: List[Trial] = []
-        trial_by_id: Dict[str, Trial] = {}
+        trial_by_id: Dict[str, Trial] = {t.id: t for t in pending}
+        trials.extend(pending)
         exhausted = False
+        stop_all_fired = [False]
         trial_counter = [0]
 
         def make_trial() -> Optional[Trial]:
@@ -302,31 +439,35 @@ class Tuner:
             set_res = getattr(scheduler, "set_trial_resources", None)
             if set_res is not None:
                 set_res(trial.id, trial.resources)
+            if trial.logdir is None:
+                trial.logdir = os.path.join(storage, exp_name, trial.id)
+            for cb in callbacks:
+                cb.on_trial_start(trial)
             running.append(trial)
+            # Keep the on-disk experiment state current so an interrupt at
+            # any point leaves a restorable record (Tuner.restore).
+            self._persist_trials(storage, exp_name, trials)
 
-        while True:
-            while pending and len(running) < max_concurrent:
-                launch(pending.pop(0))
-            while not exhausted and len(running) < max_concurrent:
-                t = make_trial()
-                if t is None:
-                    break  # exhausted, or searcher backpressure
-                launch(t)
-            if not running and not pending:
-                # With nothing in flight a searcher has no backpressure
-                # reason to decline (ConcurrencyLimiter's live set is
-                # empty), so a None here means it is out of suggestions.
-                break
-            # Drain new reports -> scheduler decisions
+        def drain_reports():
+            # New reports -> searcher/callback observation + scheduler
+            # decisions.
             for tid, result in ray_tpu.get(collector.new_reports.remote()):
                 trial = trial_by_id[tid]
                 trial.last_result = result
                 if searcher is not None:
                     searcher.on_trial_result(tid, result)
+                for cb in callbacks:
+                    cb.on_trial_result(trial, result)
                 record = getattr(scheduler, "record_config", None)
                 if record is not None:  # PB2 models (config -> delta)
                     record(tid, dict(trial.config))
                 decision = scheduler.on_result(tid, result)
+                if stopper is not None and stopper(tid, result) \
+                        and trial.state == "RUNNING":
+                    trial.killed_by_scheduler = True
+                    trial.state = "PAUSED"  # off RUNNING: one kill only
+                    ray_tpu.kill(trial.actor)
+                    continue
                 if trial.state != "RUNNING":
                     # Schedulers observe every report (fast trials can
                     # finish before their reports drain), but decisions
@@ -363,6 +504,13 @@ class Tuner:
                         state = ray_tpu.get(collector.state.remote())
                         donor_ckpt = state["checkpoints"].get(donor_id)
                         trial.killed_by_scheduler = True
+                        # Off RUNNING immediately (same reason as
+                        # REALLOCATE above): a second report of this trial
+                        # in the same drain batch must not exploit again —
+                        # that spawned two clones under one id, the second
+                        # stranded PENDING while receiving the first's
+                        # reports.
+                        trial.state = "PAUSED"
                         ray_tpu.kill(trial.actor)
                         # Requeue: donor config mutated + donor checkpoint.
                         clone = Trial(tid + "r", scheduler.mutate(
@@ -371,6 +519,37 @@ class Tuner:
                         trial_by_id[clone.id] = clone
                         trials.append(clone)
                         pending.append(clone)
+
+        while True:
+            while pending and len(running) < max_concurrent:
+                launch(pending.pop(0))
+            while not exhausted and len(running) < max_concurrent:
+                t = make_trial()
+                if t is None:
+                    break  # exhausted, or searcher backpressure
+                launch(t)
+            if not running and not pending:
+                # With nothing in flight a searcher has no backpressure
+                # reason to decline (ConcurrencyLimiter's live set is
+                # empty), so a None here means it is out of suggestions.
+                break
+            drain_reports()
+            if stopper is not None and not stop_all_fired[0] \
+                    and stopper.stop_all():
+                # Experiment-wide stop (TimeoutStopper / plateau): no new
+                # trials, kill what's running; the done-processing below
+                # records them TERMINATED as scheduler-stopped. Own flag —
+                # `exhausted` only means the sample generator is drained,
+                # which must not mask a later stop_all.
+                stop_all_fired[0] = True
+                exhausted = True
+                pending.clear()
+                for t in running:
+                    t.killed_by_scheduler = True
+                    try:
+                        ray_tpu.kill(t.actor)
+                    except Exception:
+                        pass
             if not running:
                 continue
             refs = [t.run_ref for t in running]
@@ -391,10 +570,30 @@ class Tuner:
                     else:
                         trial.state = "ERROR"
                         trial.error = str(e)
+                if trial.state == "TERMINATED" and trial.last_result is None:
+                    # A fast trial can return before its reports drain
+                    # (report.remote and the run result ride different
+                    # channels). Settle briefly so searchers observe the
+                    # final metric and loggers write results BEFORE the
+                    # completion hooks close the trial's files. Bounded:
+                    # a trainable that never reported stalls this 1s.
+                    deadline = time.time() + 1.0
+                    while (trial.last_result is None
+                           and time.time() < deadline):
+                        drain_reports()
+                        if trial.last_result is None:
+                            time.sleep(0.02)
                 if searcher is not None:
                     searcher.on_trial_complete(trial.id, trial.last_result)
+                for cb in callbacks:
+                    if trial.state == "ERROR":
+                        cb.on_trial_error(trial)
+                    else:
+                        cb.on_trial_complete(trial)
                 if trial.actor is not None:
                     try:
                         ray_tpu.kill(trial.actor)
                     except Exception:
                         pass
+                self._persist_trials(storage, exp_name, trials)
+

@@ -102,8 +102,9 @@ def _train_loop(config):
         updates, opt_state = opt.update(g, opt_state)
         return optax.apply_updates(w, updates), opt_state, loss
 
+    total_steps = config.get("total_steps", TOTAL_STEPS)
     crash_marker = os.path.join(run_dir, "crashed_once")
-    for step in range(start_step, TOTAL_STEPS):
+    for step in range(start_step, total_steps):
         if (config.get("crash", True) and world == 2 and rank == 1
                 and step == CRASH_STEP and not os.path.exists(crash_marker)):
             open(crash_marker, "w").close()
@@ -129,9 +130,16 @@ def test_elastic_dip_and_recover_2_1_2(cluster, tmp_path):
     at world 2 with loss continuity."""
     run_dir = str(tmp_path / "ckpts")
     os.makedirs(run_dir, exist_ok=True)
+    # 16 steps (vs the default 10): the world-1 phase needs enough runway
+    # for the capacity monitor to fire AND the group to re-form before the
+    # run ends — with 10 steps on a loaded host the rescale can land on
+    # the final report round and the re-grown group has nothing left to
+    # report, failing the world==2 check spuriously.
+    total = 16
     trainer = JaxTrainer(
         _train_loop,
-        train_loop_config={"run_dir": run_dir, "step_sleep": 0.4},
+        train_loop_config={"run_dir": run_dir, "step_sleep": 0.4,
+                           "total_steps": total},
         scaling_config=ScalingConfig(num_workers=2, jax_distributed=True,
                                      elastic_min_workers=1),
         run_config=RunConfig(storage_path=str(tmp_path), name="elastic",
@@ -139,7 +147,7 @@ def test_elastic_dip_and_recover_2_1_2(cluster, tmp_path):
     res = trainer.fit()
     assert res.error is None, res.error
     # Finished all steps, RE-GROWN to the 2-worker mesh after the dip.
-    assert res.metrics["step"] == TOTAL_STEPS - 1
+    assert res.metrics["step"] == total - 1
     assert res.metrics["world"] == 2, (
         f"run never re-grew: final world={res.metrics['world']}")
     # The final attempt resumed from a checkpoint, not from step 0.
@@ -159,7 +167,7 @@ def test_elastic_dip_and_recover_2_1_2(cluster, tmp_path):
     w = jnp.zeros((8, 8), jnp.float32)
     opt = optax.sgd(0.1)
     st = opt.init(w)
-    for _ in range(TOTAL_STEPS):
+    for _ in range(total):
         loss, g = jax.value_and_grad(
             lambda w: jnp.mean((x @ w - y) ** 2))(w)
         up, st = opt.update(g, st)

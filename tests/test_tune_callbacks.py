@@ -1,0 +1,130 @@
+"""Callback + logger-callback tests (``ray_tpu/tune/callback.py``).
+
+Model: the reference's ``tune/tests/test_logger.py`` (default loggers
+produce params.json / result.json / progress.csv / tfevents per trial)
+and ``test_callbacks.py`` (hook ordering)."""
+
+import glob
+import json
+import os
+
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune.callback import (
+    Callback,
+    decode_scalar_events,
+    encode_file_version_event,
+    encode_scalar_event,
+)
+from ray_tpu.data.tfrecords import frame_tfrecord
+
+
+def _trainable(config):
+    for it in range(1, 4):
+        tune.report({"score": config["x"] * it, "training_iteration": it})
+
+
+def test_default_loggers_write_trial_files(ray_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 2 and all(r.error is None for r in grid)
+
+    trial_dirs = sorted(glob.glob(str(tmp_path / "exp" / "trial_*")))
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        with open(os.path.join(d, "params.json")) as f:
+            params = json.load(f)
+        assert params["x"] in (1.0, 2.0)
+
+        with open(os.path.join(d, "result.json")) as f:
+            rows = [json.loads(line) for line in f]
+        assert [r["training_iteration"] for r in rows] == [1, 2, 3]
+
+        with open(os.path.join(d, "progress.csv")) as f:
+            lines = f.read().strip().splitlines()
+        assert len(lines) == 4  # header + 3 rows
+        assert "score" in lines[0].split(",")
+
+        events = glob.glob(os.path.join(d, "events.out.tfevents.*"))
+        assert len(events) == 1
+        decoded = decode_scalar_events(events[0])
+        assert decoded[0].get("file_version") == "brain.Event:2"
+        scalar_evs = [e for e in decoded if e["scalars"]]
+        assert [e["step"] for e in scalar_evs] == [1, 2, 3]
+        assert scalar_evs[-1]["scalars"]["ray/tune/score"] == \
+            params["x"] * 3
+
+
+class _Recorder(Callback):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, experiment_path):
+        self.events.append(("setup", experiment_path))
+
+    def on_trial_start(self, trial):
+        self.events.append(("start", trial.id))
+
+    def on_trial_result(self, trial, result):
+        self.events.append(("result", trial.id, result["score"]))
+
+    def on_trial_complete(self, trial):
+        self.events.append(("complete", trial.id))
+
+    def on_trial_error(self, trial):
+        self.events.append(("error", trial.id))
+
+    def on_experiment_end(self, trials):
+        self.events.append(("end", len(trials)))
+
+
+def test_custom_callback_hook_sequence(ray_cluster, tmp_path,
+                                       monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    rec = _Recorder()
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path), callbacks=[rec]))
+    tuner.fit()
+    kinds = [e[0] for e in rec.events]
+    assert kinds[0] == "setup" and kinds[1] == "start"
+    assert kinds.count("result") == 3
+    assert kinds[-2:] == ["complete", "end"]
+    assert rec.events[-1] == ("end", 1)
+
+
+def test_callback_sees_trial_errors(ray_cluster, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+
+    def bad(config):
+        raise RuntimeError("boom")
+
+    rec = _Recorder()
+    tuner = tune.Tuner(
+        bad, param_space={"x": tune.grid_search([1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path), callbacks=[rec]))
+    grid = tuner.fit()
+    assert grid[0].error is not None
+    assert ("error", "trial_0000") in rec.events
+    assert not any(e[0] == "complete" for e in rec.events)
+
+
+def test_event_codec_roundtrip(tmp_path):
+    """Pure encoder/decoder round-trip, no cluster needed."""
+    path = str(tmp_path / "events.out.tfevents.test")
+    with open(path, "wb") as f:
+        f.write(frame_tfrecord(encode_file_version_event(123.0)))
+        f.write(frame_tfrecord(encode_scalar_event(
+            124.5, 7, {"loss": 0.25, "acc": -3.5})))
+    evs = decode_scalar_events(path)
+    assert evs[0]["file_version"] == "brain.Event:2"
+    assert evs[1]["step"] == 7
+    assert abs(evs[1]["wall_time"] - 124.5) < 1e-6
+    assert evs[1]["scalars"] == {"loss": 0.25, "acc": -3.5}

@@ -1,0 +1,657 @@
+"""External-searcher adapter tests (``ray_tpu/tune/external.py``).
+
+None of the wrapped libraries (optuna/hyperopt/ax/nevergrad/hebo/skopt)
+exist in this image, so each adapter is exercised against an API-faithful
+fake installed into ``sys.modules`` — the fake implements exactly the
+documented surface the adapter drives (optuna's ask/tell, hyperopt's
+Trials-document protocol, AxClient, ng ask/tell, HEBO suggest/observe,
+skopt ask/tell). What these tests pin down is the adapter's own logic:
+Domain -> library-language translation, bound/type correctness of round-
+tripped configs, nested-path reconstruction, and mode-correct objective
+signs for minimizing libraries. Model: the reference's searcher tests in
+``python/ray/tune/tests/test_searchers.py`` (which run the real libraries).
+"""
+
+import math
+import random
+import sys
+import types
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune.external import (
+    AxSearch,
+    BOHBSearcher,
+    HEBOSearch,
+    HyperOptSearch,
+    NevergradSearch,
+    OptunaSearch,
+    SkoptSearch,
+)
+
+SPACE = {
+    "lr": tune.loguniform(1e-5, 1e-1),
+    "layers": tune.randint(1, 9),
+    "act": tune.choice(["relu", "gelu", "silu"]),
+    "model": {"dropout": tune.uniform(0.0, 0.5)},
+    "const": 42,
+}
+
+
+def _assert_cfg_valid(cfg):
+    assert 1e-5 <= cfg["lr"] <= 1e-1
+    assert 1 <= cfg["layers"] <= 8 and isinstance(cfg["layers"], int)
+    assert cfg["act"] in ("relu", "gelu", "silu")
+    assert 0.0 <= cfg["model"]["dropout"] <= 0.5
+    assert cfg["const"] == 42
+
+
+def _drive(searcher, n=6, metric="score", mode="max"):
+    """Run a manual suggest/complete loop; score = -(dropout-0.2)^2."""
+    searcher.set_search_properties(metric, mode, SPACE)
+    cfgs = []
+    for i in range(n):
+        cfg = searcher.suggest(f"t{i}")
+        assert cfg is not None
+        _assert_cfg_valid(cfg)
+        cfgs.append(cfg)
+        score = -(cfg["model"]["dropout"] - 0.2) ** 2
+        searcher.on_trial_result(
+            f"t{i}", {metric: score, "training_iteration": 1})
+        searcher.on_trial_complete(
+            f"t{i}", {metric: score, "training_iteration": 1})
+    return cfgs
+
+
+# ------------------------------------------------------------ fake optuna
+
+
+class _FakeOptunaTrial:
+    def __init__(self, rng):
+        self.rng = rng
+        self.params = {}
+        self.reports = []
+
+    def suggest_categorical(self, name, cats):
+        v = self.rng.choice(list(cats))
+        self.params[name] = v
+        return v
+
+    def suggest_float(self, name, low, high, log=False, step=None):
+        if log:
+            v = math.exp(self.rng.uniform(math.log(low), math.log(high)))
+        elif step is not None:
+            v = round(self.rng.uniform(low, high) / step) * step
+        else:
+            v = self.rng.uniform(low, high)
+        v = min(max(v, low), high)
+        self.params[name] = v
+        return v
+
+    def suggest_int(self, name, low, high):
+        v = self.rng.randint(low, high)
+        self.params[name] = v
+        return v
+
+    def report(self, value, step):
+        self.reports.append((value, step))
+
+
+class _FakeStudy:
+    def __init__(self, direction, sampler):
+        self.direction = direction
+        self.sampler = sampler
+        self.told = []
+
+    def ask(self):
+        return _FakeOptunaTrial(self.sampler.rng)
+
+    def tell(self, trial, value=None, state=None):
+        self.told.append((trial, value, state))
+
+
+def _install_fake_optuna(monkeypatch):
+    mod = types.ModuleType("optuna")
+
+    class _TPESampler:
+        def __init__(self, seed=None):
+            self.rng = random.Random(seed)
+
+    samplers = types.ModuleType("optuna.samplers")
+    samplers.TPESampler = _TPESampler
+    trial_mod = types.ModuleType("optuna.trial")
+
+    class _TrialState:
+        FAIL = "FAIL"
+
+    trial_mod.TrialState = _TrialState
+    mod.samplers = samplers
+    mod.trial = trial_mod
+    mod.create_study = lambda direction, sampler: _FakeStudy(direction,
+                                                             sampler)
+    for name, m in [("optuna", mod), ("optuna.samplers", samplers),
+                    ("optuna.trial", trial_mod)]:
+        monkeypatch.setitem(sys.modules, name, m)
+    return mod
+
+
+def test_optuna_adapter(monkeypatch):
+    _install_fake_optuna(monkeypatch)
+    s = OptunaSearch(seed=7)
+    _drive(s, n=6)
+    study = s._study
+    assert study.direction == "maximize"
+    # every trial told with its raw (unflipped) objective + reported curve
+    assert len(study.told) == 6
+    for trial, value, state in study.told:
+        assert state is None and value <= 0
+        assert trial.reports and trial.reports[0][1] == 1
+
+
+def test_optuna_failed_trial_told_as_fail(monkeypatch):
+    _install_fake_optuna(monkeypatch)
+    s = OptunaSearch(seed=7)
+    s.set_search_properties("score", "max", SPACE)
+    s.suggest("t0")
+    s.on_trial_complete("t0", None)  # crashed trial: no result
+    assert s._study.told[0][2] == "FAIL"
+
+
+# ---------------------------------------------------------- fake hyperopt
+
+
+def _install_fake_hyperopt(monkeypatch):
+    mod = types.ModuleType("hyperopt")
+    mod.STATUS_OK, mod.STATUS_FAIL = "ok", "fail"
+    mod.JOB_STATE_DONE, mod.JOB_STATE_ERROR = 2, 3
+
+    class _hp:
+        @staticmethod
+        def choice(name, cats):
+            return ("choice", name, list(cats))
+
+        @staticmethod
+        def uniform(name, low, high):
+            return ("uniform", name, low, high)
+
+        @staticmethod
+        def loguniform(name, log_low, log_high):
+            return ("loguniform", name, log_low, log_high)
+
+        @staticmethod
+        def quniform(name, low, high, q):
+            return ("quniform", name, low, high, q)
+
+        @staticmethod
+        def randint(name, low, high):
+            return ("randint", name, low, high)
+
+    class _Domain:
+        def __init__(self, fn, expr):
+            self.expr = expr
+
+    class _Trials:
+        def __init__(self):
+            self._docs = []
+            self._next = 0
+
+        def new_trial_ids(self, n):
+            ids = list(range(self._next, self._next + n))
+            self._next += n
+            return ids
+
+        def insert_trial_docs(self, docs):
+            self._docs.extend(docs)
+
+        def refresh(self):
+            pass
+
+        @property
+        def trials(self):
+            return self._docs
+
+    def _sample(expr, rng):
+        kind = expr[0]
+        if kind == "choice":
+            return rng.randrange(len(expr[2]))  # hyperopt stores the INDEX
+        if kind == "uniform":
+            return rng.uniform(expr[2], expr[3])
+        if kind == "loguniform":
+            return math.exp(rng.uniform(expr[2], expr[3]))
+        if kind == "quniform":
+            _, _, low, high, q = expr
+            return min(max(round(rng.uniform(low, high) / q) * q, low), high)
+        if kind == "randint":
+            return rng.randrange(expr[2], expr[3])
+        raise AssertionError(kind)
+
+    def _tpe_suggest(new_ids, domain, trials, seed):
+        rng = random.Random(seed)
+        vals = {name: [_sample(expr, rng)]
+                for name, expr in domain.expr.items()}
+        return [{"tid": new_ids[0], "state": 0, "result": {},
+                 "misc": {"tid": new_ids[0], "vals": vals}}]
+
+    def _space_eval(expr_dict, assignment):
+        out = {}
+        for name, expr in expr_dict.items():
+            v = assignment[name]
+            if expr[0] == "choice":
+                v = expr[2][v]
+            elif expr[0] == "randint":
+                v = int(v)
+            out[name] = v
+        return out
+
+    tpe = types.ModuleType("hyperopt.tpe")
+    tpe.suggest = _tpe_suggest
+    base = types.ModuleType("hyperopt.base")
+    base.Domain = _Domain
+    mod.hp, mod.tpe, mod.base = _hp, tpe, base
+    mod.Trials, mod.space_eval = _Trials, _space_eval
+    for name, m in [("hyperopt", mod), ("hyperopt.tpe", tpe),
+                    ("hyperopt.base", base)]:
+        monkeypatch.setitem(sys.modules, name, m)
+    return mod
+
+
+def test_hyperopt_adapter(monkeypatch):
+    _install_fake_hyperopt(monkeypatch)
+    s = HyperOptSearch(seed=3)
+    _drive(s, n=6)
+    docs = s._trials_obj.trials
+    assert len(docs) == 6
+    # hyperopt minimizes: mode=max scores must arrive sign-flipped, and
+    # every doc must be marked DONE with STATUS_OK.
+    for doc in docs:
+        assert doc["state"] == 2
+        assert doc["result"]["status"] == "ok"
+        assert doc["result"]["loss"] >= 0  # -score, score <= 0
+
+
+def test_hyperopt_failed_trial_marked_error(monkeypatch):
+    _install_fake_hyperopt(monkeypatch)
+    s = HyperOptSearch(seed=3)
+    s.set_search_properties("score", "max", SPACE)
+    s.suggest("t0")
+    s.on_trial_complete("t0", None)
+    assert s._trials_obj.trials[0]["state"] == 3
+
+
+# ---------------------------------------------------------------- fake ax
+
+
+def _install_fake_ax(monkeypatch):
+    ax = types.ModuleType("ax")
+    service = types.ModuleType("ax.service")
+    client_mod = types.ModuleType("ax.service.ax_client")
+
+    class _AxClient:
+        def __init__(self):
+            self.rng = random.Random(0)
+            self.completed = {}
+            self.failed = []
+            self._n = 0
+
+        def create_experiment(self, parameters, objective_name, minimize):
+            self.parameters = parameters
+            self.objective_name = objective_name
+            self.minimize = minimize
+
+        def get_next_trial(self):
+            flat = {}
+            for p in self.parameters:
+                if p["type"] == "choice":
+                    flat[p["name"]] = self.rng.choice(p["values"])
+                else:
+                    lo, hi = p["bounds"]
+                    v = self.rng.uniform(lo, hi)
+                    if p.get("value_type") == "int":
+                        v = int(round(v))
+                    flat[p["name"]] = v
+            idx = self._n
+            self._n += 1
+            return flat, idx
+
+        def complete_trial(self, trial_index, raw_data):
+            self.completed[trial_index] = raw_data
+
+        def log_trial_failure(self, trial_index):
+            self.failed.append(trial_index)
+
+    client_mod.AxClient = _AxClient
+    ax.service = service
+    service.ax_client = client_mod
+    for name, m in [("ax", ax), ("ax.service", service),
+                    ("ax.service.ax_client", client_mod)]:
+        monkeypatch.setitem(sys.modules, name, m)
+
+
+def test_ax_adapter(monkeypatch):
+    _install_fake_ax(monkeypatch)
+    s = AxSearch()
+    _drive(s, n=5)
+    client = s._client
+    assert client.objective_name == "score" and client.minimize is False
+    assert len(client.completed) == 5
+    # raw (unflipped) objective, (mean, sem) tuple form
+    for raw in client.completed.values():
+        mean, sem = raw["score"]
+        assert mean <= 0 and sem == 0.0
+
+
+def test_ax_failure_logged(monkeypatch):
+    _install_fake_ax(monkeypatch)
+    s = AxSearch()
+    s.set_search_properties("score", "max", SPACE)
+    s.suggest("t0")
+    s.on_trial_complete("t0", None)
+    assert s._client.failed == [0]
+
+
+# --------------------------------------------------------- fake nevergrad
+
+
+def _install_fake_nevergrad(monkeypatch):
+    ng = types.ModuleType("nevergrad")
+
+    class _Param:
+        def sample_value(self, rng):
+            raise NotImplementedError
+
+    class _Choice(_Param):
+        def __init__(self, cats):
+            self.cats = list(cats)
+
+        def sample_value(self, rng):
+            return rng.choice(self.cats)
+
+    class _Scalar(_Param):
+        def __init__(self, lower, upper):
+            self.lower, self.upper = lower, upper
+            self.integer = False
+
+        def set_integer_casting(self):
+            self.integer = True
+            return self
+
+        def sample_value(self, rng):
+            v = rng.uniform(self.lower, self.upper)
+            return int(round(v)) if self.integer else v
+
+    class _Log(_Param):
+        def __init__(self, lower, upper):
+            self.lower, self.upper = lower, upper
+
+        def sample_value(self, rng):
+            return math.exp(rng.uniform(math.log(self.lower),
+                                        math.log(self.upper)))
+
+    class _PDict:
+        def __init__(self, **kw):
+            self.kw = kw
+
+    class _Candidate:
+        def __init__(self, value):
+            self.value = value
+
+    class _NGOpt:
+        def __init__(self, parametrization, budget):
+            self.parametrization = parametrization
+            self.budget = budget
+            self.rng = random.Random(0)
+            self.told = []
+
+        def ask(self):
+            return _Candidate({k: p.sample_value(self.rng)
+                               for k, p in self.parametrization.kw.items()})
+
+        def tell(self, cand, loss):
+            self.told.append((cand, loss))
+
+    p = types.ModuleType("nevergrad.p")
+    p.Choice, p.Scalar, p.Log, p.Dict = _Choice, _Scalar, _Log, _PDict
+    optimizers = types.ModuleType("nevergrad.optimizers")
+    optimizers.NGOpt = _NGOpt
+    ng.p, ng.optimizers = p, optimizers
+    monkeypatch.setitem(sys.modules, "nevergrad", ng)
+
+
+def test_nevergrad_adapter(monkeypatch):
+    _install_fake_nevergrad(monkeypatch)
+    s = NevergradSearch()
+    _drive(s, n=5)
+    assert len(s._opt.told) == 5
+    for _, loss in s._opt.told:
+        assert loss >= 0  # ng minimizes; mode=max scores sign-flipped
+
+
+# -------------------------------------------------------------- fake hebo
+
+
+def _install_fake_hebo(monkeypatch):
+    import pandas as pd
+
+    hebo_pkg = types.ModuleType("hebo")
+    opt_pkg = types.ModuleType("hebo.optimizers")
+    hebo_mod = types.ModuleType("hebo.optimizers.hebo")
+    ds_pkg = types.ModuleType("hebo.design_space")
+    ds_mod = types.ModuleType("hebo.design_space.design_space")
+
+    class _DesignSpace:
+        def parse(self, spec):
+            self.spec = spec
+            return self
+
+    class _HEBO:
+        def __init__(self, space):
+            self.space = space
+            self.rng = random.Random(0)
+            self.observed = []
+
+        def suggest(self, n_suggestions=1):
+            row = {}
+            for p in self.space.spec:
+                if p["type"] == "cat":
+                    row[p["name"]] = self.rng.choice(p["categories"])
+                elif p["type"] == "int":
+                    row[p["name"]] = self.rng.randint(p["lb"], p["ub"])
+                elif p["type"] == "pow":
+                    row[p["name"]] = math.exp(self.rng.uniform(
+                        math.log(p["lb"]), math.log(p["ub"])))
+                else:
+                    row[p["name"]] = self.rng.uniform(p["lb"], p["ub"])
+            return pd.DataFrame([row])
+
+        def observe(self, X, y):
+            self.observed.append((X, y))
+
+    ds_mod.DesignSpace = _DesignSpace
+    hebo_mod.HEBO = _HEBO
+    hebo_pkg.optimizers, hebo_pkg.design_space = opt_pkg, ds_pkg
+    opt_pkg.hebo = hebo_mod
+    ds_pkg.design_space = ds_mod
+    for name, m in [("hebo", hebo_pkg), ("hebo.optimizers", opt_pkg),
+                    ("hebo.optimizers.hebo", hebo_mod),
+                    ("hebo.design_space", ds_pkg),
+                    ("hebo.design_space.design_space", ds_mod)]:
+        monkeypatch.setitem(sys.modules, name, m)
+
+
+def test_hebo_adapter(monkeypatch):
+    _install_fake_hebo(monkeypatch)
+    s = HEBOSearch()
+    _drive(s, n=4)
+    assert len(s._opt.observed) == 4
+    for _, y in s._opt.observed:
+        assert y.shape == (1, 1) and y[0, 0] >= 0  # minimizing, flipped
+
+
+# ------------------------------------------------------------- fake skopt
+
+
+def _install_fake_skopt(monkeypatch):
+    skopt = types.ModuleType("skopt")
+    space_mod = types.ModuleType("skopt.space")
+
+    class _Dim:
+        def __init__(self, *a, **kw):
+            self.args, self.name = a, kw.get("name")
+            self.prior = kw.get("prior")
+
+    class _Real(_Dim):
+        def sample(self, rng):
+            lo, hi = self.args
+            if self.prior == "log-uniform":
+                return math.exp(rng.uniform(math.log(lo), math.log(hi)))
+            return rng.uniform(lo, hi)
+
+    class _Integer(_Dim):
+        def sample(self, rng):
+            return rng.randint(*self.args)
+
+    class _Categorical(_Dim):
+        def sample(self, rng):
+            return rng.choice(self.args[0])
+
+    class _Optimizer:
+        def __init__(self, dimensions, random_state=None):
+            self.dimensions = dimensions
+            self.rng = random.Random(random_state)
+            self.told = []
+
+        def ask(self):
+            return [d.sample(self.rng) for d in self.dimensions]
+
+        def tell(self, x, y):
+            self.told.append((x, y))
+
+    space_mod.Real, space_mod.Integer = _Real, _Integer
+    space_mod.Categorical = _Categorical
+    skopt.space = space_mod
+    skopt.Optimizer = _Optimizer
+    for name, m in [("skopt", skopt), ("skopt.space", space_mod)]:
+        monkeypatch.setitem(sys.modules, name, m)
+
+
+def test_skopt_adapter(monkeypatch):
+    _install_fake_skopt(monkeypatch)
+    s = SkoptSearch(seed=1)
+    _drive(s, n=5)
+    assert len(s._opt.told) == 5
+    for _, loss in s._opt.told:
+        assert loss >= 0
+
+
+# --------------------------------------------------- shared adapter rules
+
+
+def test_missing_package_raises_actionable_importerror():
+    # No fake installed: the real package is absent in this image.
+    for cls in (OptunaSearch, HyperOptSearch, AxSearch, NevergradSearch,
+                HEBOSearch, SkoptSearch):
+        with pytest.raises(ImportError, match="not installed"):
+            cls()
+
+
+def test_grid_and_samplefrom_rejected(monkeypatch):
+    _install_fake_optuna(monkeypatch)
+    s = OptunaSearch()
+    s.set_search_properties("score", "max",
+                            {"g": tune.grid_search([1, 2])})
+    with pytest.raises(ValueError, match="grid_search"):
+        s.suggest("t0")
+    s2 = OptunaSearch()
+    s2.set_search_properties("score", "max",
+                             {"f": tune.sample_from(lambda _: 1)})
+    with pytest.raises(ValueError, match="sample_from"):
+        s2.suggest("t0")
+
+
+def test_min_mode_does_not_flip_for_minimizing_libs(monkeypatch):
+    _install_fake_nevergrad(monkeypatch)
+    s = NevergradSearch(mode="min")
+    s.set_search_properties("loss", "min", {"x": tune.uniform(0, 1)})
+    s.suggest("t0")
+    s.on_trial_complete("t0", {"loss": 0.25})
+    assert s._opt.told[0][1] == 0.25  # already a loss: passed through
+
+
+# ------------------------------------------------------------------- bohb
+
+
+def test_bohb_models_on_highest_sufficient_budget():
+    s = BOHBSearcher(n_initial=3, seed=0)
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s.set_search_properties("score", "max", space)
+    # 6 trials report at budget 1; only 2 survive to budget 3.
+    for i in range(6):
+        cfg = s.suggest(f"t{i}")
+        assert 0.0 <= cfg["x"] <= 1.0
+        s.on_trial_result(f"t{i}", {"score": cfg["x"],
+                                    "training_iteration": 1})
+        if i < 2:
+            s.on_trial_result(f"t{i}", {"score": cfg["x"],
+                                        "training_iteration": 3})
+        s.on_trial_complete(f"t{i}", {"score": cfg["x"],
+                                      "training_iteration": 1 if i >= 2
+                                      else 3})
+    assert len(s._obs_by_budget[1.0]) == 6
+    assert len(s._obs_by_budget[3.0]) == 2
+    s.suggest("t_next")
+    # budget 3 has only 2 < n_initial points -> the model must have used
+    # the budget-1 pool.
+    assert len(s._obs) == 6
+    # now grow budget 3 to sufficiency; the model must switch to it.
+    for i in range(6, 10):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_result(f"t{i}", {"score": cfg["x"],
+                                    "training_iteration": 3})
+        s.on_trial_complete(f"t{i}", {"score": cfg["x"],
+                                      "training_iteration": 3})
+    s.suggest("t_final")
+    assert len(s._obs) == len(s._obs_by_budget[3.0]) >= 3
+
+
+def test_bohb_with_asha_in_tuner(ray_cluster, tmp_path):
+    """End-to-end: BOHB searcher + ASHA rungs through the real Tuner."""
+
+    def trainable(config):
+        for it in range(1, 6):
+            tune.report({"score": -(config["x"] - 3) ** 2 + it * 0.01,
+                         "training_iteration": it})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 6.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=8,
+            search_alg=BOHBSearcher(n_initial=3, seed=0),
+            scheduler=tune.ASHAScheduler(
+                metric="score", mode="max", max_t=5, grace_period=1)),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 8
+    scores = [r.metrics["score"] for r in grid if r.metrics]
+    assert scores and max(scores) > -4.0
+
+
+def test_optuna_through_tuner(monkeypatch, ray_cluster, tmp_path):
+    """The adapter path through the real Tuner loop (fake optuna)."""
+    _install_fake_optuna(monkeypatch)
+
+    def trainable(config):
+        tune.report({"score": -(config["x"] - 3) ** 2})
+
+    searcher = OptunaSearch(seed=11)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0.0, 6.0)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=6, search_alg=searcher),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 6
+    assert len(searcher._study.told) == 6

@@ -1,0 +1,191 @@
+"""W&B / MLflow integration-callback tests (``tune/integrations.py``).
+
+Same pattern as ``test_tune_external.py``: the libraries are absent from
+this image, so API-faithful fakes pin down the adapter logic — one run per
+trial, config-as-params, metric streaming with steps, terminal status."""
+
+import sys
+import types
+
+import pytest
+
+from ray_tpu.tune.integrations import MLflowLoggerCallback, \
+    WandbLoggerCallback
+
+
+class _Trial:
+    def __init__(self, tid):
+        self.id = tid
+        self.config = {"lr": 0.1, "act": "gelu"}
+        self.logdir = "/tmp"
+
+
+# ------------------------------------------------------------- fake wandb
+
+
+def _install_fake_wandb(monkeypatch):
+    wandb = types.ModuleType("wandb")
+
+    class _Run:
+        def __init__(self, kw):
+            self.kw = kw
+            self.logged = []
+            self.finished = None
+
+        def log(self, metrics, step=None):
+            self.logged.append((metrics, step))
+
+        def finish(self, exit_code=0):
+            self.finished = exit_code
+
+    wandb.runs = []
+
+    def init(**kw):
+        run = _Run(kw)
+        wandb.runs.append(run)
+        return run
+
+    wandb.init = init
+    monkeypatch.setitem(sys.modules, "wandb", wandb)
+    return wandb
+
+
+def test_wandb_callback(monkeypatch):
+    wandb = _install_fake_wandb(monkeypatch)
+    cb = WandbLoggerCallback(project="proj")
+    cb.setup("/store/my_exp")
+    assert cb.group == "my_exp"
+    t = _Trial("trial_0000")
+    cb.on_trial_start(t)
+    cb.on_trial_result(t, {"score": 1.5, "training_iteration": 1,
+                           "blob": object()})
+    cb.on_trial_result(t, {"score": 2.5, "training_iteration": 2})
+    cb.on_trial_complete(t)
+
+    (run,) = wandb.runs
+    assert run.kw["project"] == "proj" and run.kw["name"] == "trial_0000"
+    assert run.kw["config"] == t.config
+    # non-scalar fields filtered; steps preserved
+    assert run.logged[0] == ({"score": 1.5, "training_iteration": 1}, 1)
+    assert run.logged[1][1] == 2
+    assert run.finished == 0
+
+
+def test_wandb_failed_trial_exit_code(monkeypatch):
+    wandb = _install_fake_wandb(monkeypatch)
+    cb = WandbLoggerCallback(project="proj")
+    cb.setup("/store/e")
+    t = _Trial("t0")
+    cb.on_trial_start(t)
+    cb.on_trial_error(t)
+    assert wandb.runs[0].finished == 1
+
+
+# ------------------------------------------------------------ fake mlflow
+
+
+def _install_fake_mlflow(monkeypatch):
+    mlflow = types.ModuleType("mlflow")
+    tracking = types.ModuleType("mlflow.tracking")
+
+    class _Experiment:
+        def __init__(self, eid):
+            self.experiment_id = eid
+
+    class _RunInfo:
+        def __init__(self, rid):
+            self.run_id = rid
+
+    class _Run:
+        def __init__(self, rid, tags):
+            self.info = _RunInfo(rid)
+            self.tags = tags
+
+    class MlflowClient:
+        instances = []
+
+        def __init__(self, tracking_uri=None):
+            self.tracking_uri = tracking_uri
+            self.experiments = {}
+            self.runs = {}
+            self.params = {}
+            self.metrics = {}
+            self.terminated = {}
+            self._n = 0
+            MlflowClient.instances.append(self)
+
+        def get_experiment_by_name(self, name):
+            eid = self.experiments.get(name)
+            return _Experiment(eid) if eid is not None else None
+
+        def create_experiment(self, name):
+            eid = f"exp{len(self.experiments)}"
+            self.experiments[name] = eid
+            return eid
+
+        def create_run(self, experiment_id, tags=None):
+            rid = f"run{self._n}"
+            self._n += 1
+            run = _Run(rid, tags or {})
+            self.runs[rid] = (experiment_id, run)
+            return run
+
+        def log_param(self, run_id, k, v):
+            self.params.setdefault(run_id, {})[k] = v
+
+        def log_metric(self, run_id, k, v, step=0):
+            self.metrics.setdefault(run_id, []).append((k, v, step))
+
+        def set_terminated(self, run_id, status):
+            self.terminated[run_id] = status
+
+    tracking.MlflowClient = MlflowClient
+    mlflow.tracking = tracking
+    monkeypatch.setitem(sys.modules, "mlflow", mlflow)
+    monkeypatch.setitem(sys.modules, "mlflow.tracking", tracking)
+    return MlflowClient
+
+
+def test_mlflow_callback(monkeypatch):
+    Client = _install_fake_mlflow(monkeypatch)
+    Client.instances.clear()
+    cb = MLflowLoggerCallback(tracking_uri="file:///tmp/mlruns")
+    cb.setup("/store/my_exp")
+    client = Client.instances[-1]
+    assert client.tracking_uri == "file:///tmp/mlruns"
+    assert "my_exp" in client.experiments
+
+    t = _Trial("trial_0000")
+    cb.on_trial_start(t)
+    cb.on_trial_result(t, {"score": 1.5, "training_iteration": 3,
+                           "note": "skip-me"})
+    cb.on_trial_complete(t)
+
+    (rid,) = client.params
+    assert client.params[rid] == t.config
+    assert ("score", 1.5, 3) in client.metrics[rid]
+    # string fields are not metrics
+    assert not any(k == "note" for k, _, _ in client.metrics[rid])
+    assert client.terminated[rid] == "FINISHED"
+    _, run = client.runs[rid]
+    assert run.tags["trial_id"] == "trial_0000"
+
+
+def test_mlflow_failed_status_and_experiment_reuse(monkeypatch):
+    Client = _install_fake_mlflow(monkeypatch)
+    Client.instances.clear()
+    cb = MLflowLoggerCallback(experiment_name="shared")
+    cb.setup("/store/a")
+    client = Client.instances[0]
+    t = _Trial("t0")
+    cb.on_trial_start(t)
+    cb.on_trial_error(t)
+    (rid,) = client.terminated
+    assert client.terminated[rid] == "FAILED"
+
+
+def test_missing_packages_raise():
+    for cls, kw in ((WandbLoggerCallback, {"project": "p"}),
+                    (MLflowLoggerCallback, {})):
+        with pytest.raises(ImportError, match="not installed"):
+            cls(**kw)

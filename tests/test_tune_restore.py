@@ -1,0 +1,147 @@
+"""Experiment-level resume tests (``Tuner.restore`` / ``can_restore``).
+
+Model: the reference's ``tune/tests/test_tuner_restore.py`` — finished
+trials keep results without re-running, interrupted/errored trials resume
+from their latest persisted checkpoint."""
+
+import json
+import os
+
+import cloudpickle
+
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+
+
+def _checkpointing_trainable(config):
+    """Reports 4 iterations, checkpointing each; crashes at iteration 2
+    on the FIRST run when told to (sentinel file marks attempts). Records
+    the iteration it resumed from so the test can prove checkpoint use."""
+    import tempfile
+
+    marker = (config["marker_dir"]
+              + f"/ran_{config['idx']}_{int(bool(config['crash']))}")
+    with open(marker, "a") as f:
+        f.write("x")
+    attempts = os.path.getsize(marker)
+
+    start = 0
+    ckpt = tune.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "state.json")) as f:
+            start = json.load(f)["it"]
+    for it in range(start + 1, 5):
+        if config["crash"] and attempts == 1 and it == 3:
+            raise RuntimeError("injected crash")
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "state.json"), "w") as f:
+            json.dump({"it": it}, f)
+        tune.report({"score": it, "resumed_from": start,
+                     "training_iteration": it},
+                    checkpoint=Checkpoint(d))
+
+
+def test_can_restore(tmp_path):
+    assert not tune.Tuner.can_restore(str(tmp_path))
+
+
+def test_restore_reruns_errored_from_checkpoint(ray_cluster, tmp_path,
+                                                monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    tuner = tune.Tuner(
+        _checkpointing_trainable,
+        param_space={"idx": tune.grid_search([0, 1]),
+                     "crash": tune.grid_search([True, False]),
+                     "marker_dir": str(marker_dir)},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    # grid axes are cartesian: 4 trials; the crash=True ones error out
+    errors = [r for r in grid if r.error is not None]
+    finished = [r for r in grid if r.error is None]
+    assert len(errors) == 2 and len(finished) == 2
+
+    exp_path = str(tmp_path / "exp")
+    assert tune.Tuner.can_restore(exp_path)
+    grid2 = tune.Tuner.restore(exp_path, restart_errored=True).fit()
+    assert len(grid2) == 4
+    assert all(r.error is None for r in grid2)
+    # The re-run trials resumed from their persisted iteration-2
+    # checkpoint, not from scratch.
+    resumed = [r for r in grid2
+               if r.metrics and r.metrics.get("resumed_from", 0) > 0]
+    assert len(resumed) == 2
+    assert all(r.metrics["resumed_from"] == 2 for r in resumed)
+
+
+def test_restore_does_not_rerun_finished(ray_cluster, tmp_path,
+                                         monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    tuner = tune.Tuner(
+        _checkpointing_trainable,
+        param_space={"idx": tune.grid_search([0, 1]),
+                     "crash": False, "marker_dir": str(marker_dir)},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert all(r.error is None for r in grid)
+
+    grid2 = tune.Tuner.restore(str(tmp_path / "exp")).fit()
+    assert len(grid2) == 2
+    assert all(r.error is None for r in grid2)
+    assert grid2.get_best_result().metrics["score"] == 4
+    # No trial executed again: one attempt recorded per trial.
+    for idx in (0, 1):
+        assert os.path.getsize(marker_dir / f"ran_{idx}_0") == 1
+    # The resumed run's state rewrite must preserve the finished trials'
+    # records — a SECOND restore still returns all of them, un-rerun.
+    grid3 = tune.Tuner.restore(str(tmp_path / "exp")).fit()
+    assert len(grid3) == 2
+    assert all(r.error is None for r in grid3)
+    for idx in (0, 1):
+        assert os.path.getsize(marker_dir / f"ran_{idx}_0") == 1
+
+
+def test_restore_resumes_interrupted_pending(ray_cluster, tmp_path,
+                                             monkeypatch):
+    """A trial recorded mid-flight (RUNNING at interrupt) re-launches on
+    restore with its saved config."""
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    tuner = tune.Tuner(
+        _checkpointing_trainable,
+        param_space={"idx": tune.grid_search([0]), "crash": False,
+                     "marker_dir": str(marker_dir)},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp", storage_path=str(tmp_path)))
+    tuner.fit()
+    # Forge an interrupt: rewrite the state file marking the trial RUNNING
+    # (exactly what a kill -9 mid-run leaves behind).
+    state_path = tmp_path / "exp" / "trials_state.pkl"
+    with open(state_path, "rb") as f:
+        state = cloudpickle.load(f)
+    tid = next(iter(state))
+    state[tid]["state"] = "RUNNING"
+    with open(state_path, "wb") as f:
+        cloudpickle.dump(state, f)
+    # ... and drop the checkpoints past iteration 2, as if the kill landed
+    # mid-run.
+    import shutil
+
+    trial_dir = tmp_path / "exp" / tid
+    for ck in sorted(os.listdir(trial_dir)):
+        if ck.startswith("checkpoint_") and ck > "checkpoint_000001":
+            shutil.rmtree(trial_dir / ck)
+
+    grid = tune.Tuner.restore(str(tmp_path / "exp")).fit()
+    assert len(grid) == 1 and grid[0].error is None
+    # Re-ran (second attempt) and resumed from the surviving checkpoint
+    # (iteration 2), finishing 3..4.
+    assert os.path.getsize(marker_dir / "ran_0_0") == 2
+    assert grid[0].metrics["resumed_from"] == 2
+    assert grid[0].metrics["score"] == 4

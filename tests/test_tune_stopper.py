@@ -1,0 +1,131 @@
+"""Stop-criterion tests (``ray_tpu/tune/stopper.py`` + RunConfig.stop).
+
+Model: the reference's ``tune/tests/test_stopper.py`` and the
+``stop={...}`` dict form threaded through ``air.RunConfig``."""
+
+import time
+
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import (
+    CombinedStopper,
+    ExperimentPlateauStopper,
+    MaximumIterationStopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
+
+
+def _reporter(n=50, plateau_after=None):
+    def trainable(config):
+        for it in range(1, n + 1):
+            v = (config["x"] if plateau_after and it >= plateau_after
+                 else config["x"] * it)
+            tune.report({"score": v, "training_iteration": it})
+            time.sleep(0.05)
+    return trainable
+
+
+def test_dict_stop_criterion(ray_cluster, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    grid = tune.Tuner(
+        _reporter(n=50),
+        param_space={"x": tune.grid_search([1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             stop={"training_iteration": 3})).fit()
+    assert grid[0].error is None
+    assert grid[0].metrics["training_iteration"] <= 5  # stopped early
+
+
+def test_maximum_iteration_stopper(ray_cluster, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    grid = tune.Tuner(
+        _reporter(n=50),
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             stop=MaximumIterationStopper(4))).fit()
+    for r in grid:
+        assert r.error is None
+        # stopped at 4; a few extra reports can land before the kill
+        assert r.metrics["training_iteration"] <= 12
+
+
+def test_trial_plateau_stopper(ray_cluster, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    # plateaus at iteration 5 -> window of 4 equal values by ~8
+    grid = tune.Tuner(
+        _reporter(n=60, plateau_after=5),
+        param_space={"x": tune.grid_search([3.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            stop=TrialPlateauStopper("score", std=1e-6,
+                                     num_results=4))).fit()
+    assert grid[0].error is None
+    it = grid[0].metrics["training_iteration"]
+    assert 8 <= it <= 20, it  # stopped soon after the plateau window fills
+
+
+def test_timeout_stopper_stops_experiment(ray_cluster, tmp_path,
+                                          monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    t0 = time.time()
+    grid = tune.Tuner(
+        _reporter(n=2000),
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             stop=TimeoutStopper(2.0))).fit()
+    assert time.time() - t0 < 15
+    assert len(grid) == 2
+    assert all(r.error is None for r in grid)
+
+
+def test_combined_stopper_no_short_circuit(ray_cluster, tmp_path,
+                                           monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    # Both stoppers are stateful; the combined form must feed results to
+    # BOTH even when the first already voted stop.
+    m1, m2 = MaximumIterationStopper(3), MaximumIterationStopper(5)
+    grid = tune.Tuner(
+        _reporter(n=50),
+        param_space={"x": tune.grid_search([1.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             stop=CombinedStopper(m1, m2))).fit()
+    assert grid[0].metrics["training_iteration"] <= 5
+    assert m2._counts  # second stopper observed results too
+
+
+def test_stop_all_fires_after_sample_exhaustion(ray_cluster, tmp_path,
+                                                monkeypatch):
+    """ExperimentPlateauStopper only votes via stop_all() (its per-trial
+    check always returns False) — the loop must honor stop_all even after
+    the sample generator is exhausted (all trials launched)."""
+    monkeypatch.setenv("RAY_TPU_DISABLE_DEFAULT_LOGGERS", "1")
+    t0 = time.time()
+    grid = tune.Tuner(
+        _reporter(n=400, plateau_after=2),
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            stop=ExperimentPlateauStopper("score", mode="max",
+                                          patience=6))).fit()
+    assert time.time() - t0 < 15  # 400 x 0.05s trials ended early
+    assert len(grid) == 2 and all(r.error is None for r in grid)
+
+
+def test_experiment_plateau_stopper_unit():
+    s = ExperimentPlateauStopper("score", mode="max", patience=3)
+    for i, v in enumerate([1.0, 2.0, 3.0]):
+        assert s(f"t{i}", {"score": v}) is False
+        assert not s.stop_all()
+    # best stops improving: 3 stale results trip the experiment gate
+    for i in range(2):
+        s(f"s{i}", {"score": 2.5})
+        assert not s.stop_all()
+    s("s2", {"score": 2.0})
+    assert s.stop_all()
