@@ -3,14 +3,21 @@ from .context import (BackpressurePolicy, ConcurrencyCapPolicy, DataContext,
                       MemoryBudgetPolicy)
 from .dataset import Dataset, MaterializedDataset
 from .iterator import DataIterator
+from .random_access import RandomAccessDataset
 from .read_api import (
     Datasource,
     from_arrow,
+    from_arrow_refs,
+    from_blocks,
     from_huggingface,
     from_items,
     from_numpy,
+    from_numpy_refs,
     from_pandas,
+    from_pandas_refs,
+    from_torch,
     range,
+    read_avro,
     read_binary_files,
     read_csv,
     read_datasource,
@@ -21,6 +28,7 @@ from .read_api import (
     read_mongo,
     read_numpy,
     read_parquet,
+    read_parquet_bulk,
     read_sql,
     read_text,
     read_tfrecords,
@@ -34,7 +42,10 @@ __all__ = [
     "range", "read_parquet", "read_csv", "read_json", "read_text",
     "read_numpy", "read_binary_files", "read_images", "read_webdataset",
     "Datasource", "read_datasource", "read_sql", "read_tfrecords",
-    "read_delta", "read_iceberg", "read_mongo",
+    "read_delta", "read_iceberg", "read_mongo", "read_avro",
+    "read_parquet_bulk", "from_blocks", "from_arrow_refs",
+    "from_pandas_refs", "from_numpy_refs", "from_torch",
+    "RandomAccessDataset",
     "DataContext", "BackpressurePolicy", "ConcurrencyCapPolicy",
     "MemoryBudgetPolicy",
 ]

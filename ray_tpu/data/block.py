@@ -57,6 +57,18 @@ def to_block(data: Batch) -> "pa.Table":
         return pa.table(cols)
     if isinstance(data, list):
         if data and isinstance(data[0], dict):
+            if any(isinstance(v, np.ndarray) and v.ndim >= 2
+                   for v in data[0].values()):
+                # Tensor-valued rows (e.g. images): from_pylist cannot
+                # encode >=2-D cells — pivot to columns and take the
+                # tensor-column path above.
+                cols: Dict[str, Any] = {}
+                for k in data[0]:
+                    cells = np.empty(len(data), dtype=object)
+                    for i, row in enumerate(data):
+                        cells[i] = row[k]
+                    cols[k] = cells
+                return to_block(cols)
             return pa.Table.from_pylist(data)
         return pa.table({"item": pa.array(data)})
     if isinstance(data, np.ndarray):

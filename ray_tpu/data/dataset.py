@@ -88,6 +88,28 @@ class _Op:
             mapping = self.kw["mapping"]
             return block.rename_columns(
                 [mapping.get(c, c) for c in block.column_names])
+        if self.kind == "random_sample":
+            import zlib
+
+            import pyarrow as pa
+
+            n = acc.num_rows()
+            if n == 0:
+                return block
+            # Stream seeded by (user salt, block content signature):
+            # same seed + same data -> the same sample on every run
+            # (the reproducibility a seed implies), while distinct
+            # blocks draw decorrelated masks (the reference's global
+            # `random.seed` gives same-length blocks identical masks).
+            sig = f"{n}:{block.column_names}".encode()
+            try:
+                sig += repr(block.slice(0, 1).to_pylist()).encode()
+            except Exception:
+                pass
+            rng = np.random.default_rng(
+                (self.kw["salt"], zlib.crc32(sig)))
+            mask = rng.random(n) < self.kw["fraction"]
+            return block.filter(pa.array(mask))
         if self.kind == "limit":
             # Per-block cap: the global quota is an upper bound for any
             # one block; the streaming executor enforces the exact
@@ -264,6 +286,22 @@ def _rows_of(block):
     """Row count of one resolved block (tiny reply; the block itself
     never travels to the driver)."""
     return BlockAccessor(to_block(block)).num_rows()
+
+
+@ray_tpu.remote
+def _nbytes_of(block):
+    """In-memory size of one resolved block (tiny reply)."""
+    return to_block(block).nbytes
+
+
+@ray_tpu.remote
+def _to_pandas_block(block):
+    return BlockAccessor(to_block(block)).to_pandas()
+
+
+@ray_tpu.remote
+def _to_numpy_block(block):
+    return BlockAccessor(to_block(block)).to_numpy()
 
 
 @ray_tpu.remote
@@ -453,12 +491,15 @@ class Dataset:
         self._exec_stats: Optional[_ExecStats] = None
         # Rewrite-rule trace of the most recent planning (``explain()``).
         self._plan_trace: List[str] = []
+        # Source files, when created by a file reader (``input_files()``).
+        self._input_files: List[str] = []
 
     # --------------------------------------------------------- transforms
 
     def _with_op(self, op: _Op) -> "Dataset":
         ds = Dataset(self._sources, self._ops + [op], self._remote_args)
         ds._actor_pool_size = self._actor_pool_size
+        ds._input_files = list(self._input_files)
         return ds
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
@@ -1315,6 +1356,244 @@ class Dataset:
         done = getattr(sink, "on_write_complete", None)
         if done is not None:
             done()
+
+    # ------------------------------------------------ surface completion
+    # (reference: the long tail of ``Dataset`` public methods)
+
+    def take_batch(self, batch_size: int = 20,
+                   *, batch_format: str = "numpy"):
+        """First ``batch_size`` rows as ONE batch (reference:
+        ``Dataset.take_batch``)."""
+        rows = self.take(batch_size)
+        return BlockAccessor(to_block(rows)).to_batch(batch_format)
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: ``Dataset.random_sample``).
+        Fused into the block task like any row filter; a fresh per-call
+        salt keeps two samples of one dataset independent."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        salt = int(np.random.SeedSequence(seed).entropy & 0xFFFFFFFF)
+        return self._with_op(_Op("random_sample", fraction=fraction,
+                                 salt=salt))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        """Shuffle BLOCK order only — the cheap decorrelator for ingest
+        (reference: ``Dataset.randomize_block_order``); rows within a
+        block keep their order, no data moves."""
+        rng = np.random.default_rng(seed)
+        sources = list(self._sources)
+        rng.shuffle(sources)
+        ds = Dataset(sources, list(self._ops), self._remote_args)
+        ds._actor_pool_size = self._actor_pool_size
+        ds._input_files = list(self._input_files)
+        return ds
+
+    def size_bytes(self) -> int:
+        """Total in-memory bytes across blocks (reference:
+        ``Dataset.size_bytes``). Counts come back as tiny ints; blocks
+        stay in the object store."""
+        refs = list(self._stream_refs())
+        return sum(ray_tpu.get([_nbytes_of.remote(r) for r in refs],
+                               timeout=600))
+
+    def input_files(self) -> List[str]:
+        """Source files this dataset was read from (reference:
+        ``Dataset.input_files``); empty for non-file sources."""
+        return list(self._input_files)
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["Dataset"]:
+        """Split by fractions; the remainder forms the final shard
+        (reference: ``Dataset.split_proportionately`` — e.g.
+        [0.7, 0.2] -> three datasets of ~70%/20%/10%)."""
+        if not proportions or any(p <= 0 for p in proportions) \
+                or sum(proportions) >= 1.0:
+            raise ValueError(
+                "proportions must be positive and sum to < 1")
+        n = self.count()
+        cuts, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            # round, not int: float accumulation (0.7+0.2=0.8999...)
+            # must not shave a row off a shard boundary
+            cuts.append(min(round(n * acc), n))
+        return self.split_at_indices(cuts)
+
+    def get_internal_block_refs(self) -> List[Any]:
+        """Refs to the executed blocks (reference:
+        ``Dataset.get_internal_block_refs``)."""
+        return list(self._stream_refs())
+
+    def to_arrow_refs(self) -> List[Any]:
+        """Blocks ARE arrow tables; executed refs come back as-is
+        (reference: ``Dataset.to_arrow_refs``)."""
+        return list(self._stream_refs())
+
+    def to_pandas_refs(self) -> List[Any]:
+        """One DataFrame ref per block, converted worker-side
+        (reference: ``Dataset.to_pandas_refs``)."""
+        return [_to_pandas_block.remote(r) for r in self._stream_refs()]
+
+    def to_numpy_refs(self) -> List[Any]:
+        """One column-dict-of-ndarrays ref per block, converted
+        worker-side (reference: ``Dataset.to_numpy_refs``)."""
+        return [_to_numpy_block.remote(r) for r in self._stream_refs()]
+
+    def to_torch(self, *, label_column: Optional[str] = None,
+                 batch_size: int = 256):
+        """Torch ``IterableDataset`` over this dataset (reference:
+        ``Dataset.to_torch``). Yields (features, label) tensor pairs when
+        ``label_column`` is set, else feature dicts — feeding
+        ``torch.utils.data.DataLoader(..., batch_size=None)`` directly."""
+        import torch
+
+        outer = self
+
+        class _TorchIterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for batch in outer.iter_torch_batches(
+                        batch_size=batch_size):
+                    if label_column is None:
+                        yield batch
+                    else:
+                        label = batch.pop(label_column)
+                        feats = (next(iter(batch.values()))
+                                 if len(batch) == 1 else batch)
+                        yield feats, label
+
+        return _TorchIterable()
+
+    def to_random_access_dataset(self, key: str, *,
+                                 num_workers: int = 2):
+        """Key-indexed actor-served view (reference:
+        ``Dataset.to_random_access_dataset``, ``random_access_dataset.py``)."""
+        from .random_access import RandomAccessDataset
+
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
+    def has_serializable_lineage(self) -> bool:
+        """True when every source is re-executable from its description
+        (reader callables / inline blocks — not cluster-bound object
+        refs), so the PLAN can move between clusters (reference:
+        ``Dataset.has_serializable_lineage``)."""
+        import functools as _ft
+
+        def bound(s) -> bool:
+            if isinstance(s, (ray_tpu.ObjectRef, _LazyExchange)):
+                return True
+            if isinstance(s, _ft.partial):
+                # from_numpy_refs-style sources wrap the ref in a
+                # partial — just as cluster-bound as a bare ref.
+                return any(isinstance(a, ray_tpu.ObjectRef)
+                           for a in s.args + tuple(s.keywords.values()))
+            return False
+
+        return not any(bound(s) for s in self._sources)
+
+    def serialize_lineage(self) -> bytes:
+        """Plan (sources + ops), cloudpickled — rows are NOT serialized;
+        deserializing re-executes the reads (reference:
+        ``Dataset.serialize_lineage``)."""
+        if not self.has_serializable_lineage():
+            raise ValueError(
+                "dataset lineage contains cluster-bound object refs or "
+                "pending exchanges; materialize() first or recreate from "
+                "the original reader")
+        import cloudpickle
+
+        return cloudpickle.dumps(
+            {"sources": self._sources, "ops": self._ops,
+             "remote_args": self._remote_args,
+             "input_files": self._input_files})
+
+    @staticmethod
+    def deserialize_lineage(blob: bytes) -> "Dataset":
+        import cloudpickle
+
+        state = cloudpickle.loads(blob)
+        ds = Dataset(state["sources"], state["ops"], state["remote_args"])
+        ds._input_files = state.get("input_files", [])
+        return ds
+
+    def write_sql(self, sql: str, connection_factory: Callable) -> None:
+        """Stream rows through parameterized INSERTs on a DB-API
+        connection (reference: ``Dataset.write_sql``): ``sql`` uses
+        ``?`` placeholders in column order."""
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            for ref in self._stream_refs():
+                block = to_block(ray_tpu.get(ref))
+                rows = [tuple(r.values())
+                        for r in BlockAccessor(block).rows()]
+                if rows:
+                    cur.executemany(sql, rows)
+            conn.commit()
+        finally:
+            conn.close()
+
+    def write_images(self, path: str, column: str,
+                     file_format: str = "png") -> None:
+        """One image file per row from a [H, W, C] tensor column
+        (reference: ``Dataset.write_images``)."""
+        import os
+
+        from PIL import Image
+
+        os.makedirs(path, exist_ok=True)
+        i = 0
+        for ref in self._stream_refs():
+            block = to_block(ray_tpu.get(ref))
+            for arr in BlockAccessor(block).to_numpy()[column]:
+                img = Image.fromarray(np.asarray(arr).astype(np.uint8))
+                img.save(os.path.join(path,
+                                      f"{i:06d}.{file_format}"))
+                i += 1
+
+    def write_webdataset(self, path: str) -> None:
+        """One WebDataset tar shard per block; bytes-valued columns become
+        ``<key>.<column>`` members (reference: ``Dataset.write_webdataset``;
+        round-trips through ``read_webdataset``)."""
+        import io
+        import json as jsonlib
+        import os
+        import tarfile
+
+        os.makedirs(path, exist_ok=True)
+        row_i = 0
+        for bi, ref in enumerate(self._stream_refs()):
+            block = to_block(ray_tpu.get(ref))
+            with tarfile.open(os.path.join(path, f"part-{bi:05d}.tar"),
+                              "w") as tar:
+                for row in BlockAccessor(block).rows():
+                    key = str(row.get("__key__", f"{row_i:06d}"))
+                    row_i += 1
+                    for col, v in row.items():
+                        if col == "__key__":
+                            continue
+                        if isinstance(v, (bytes, bytearray)):
+                            payload = bytes(v)
+                        elif isinstance(v, str):
+                            payload = v.encode("utf-8")
+                        else:
+                            payload = jsonlib.dumps(
+                                v.tolist() if isinstance(v, np.ndarray)
+                                else v).encode("utf-8")
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(payload)
+                        tar.addfile(info, io.BytesIO(payload))
+
+    def copy(self) -> "Dataset":
+        """Independent handle over the same plan (stats/actor-pool state
+        not shared)."""
+        ds = Dataset(list(self._sources), list(self._ops),
+                     dict(self._remote_args))
+        ds._actor_pool_size = self._actor_pool_size
+        ds._input_files = list(self._input_files)
+        return ds
 
     def __repr__(self):
         return self.stats()

@@ -40,6 +40,15 @@ def _expand_paths(paths: Union[str, List[str]], suffix: str = "") -> List[str]:
     return out
 
 
+def _file_ds(sources: List[Any], files: List[str]) -> Dataset:
+    """Dataset over file-read tasks, remembering the source paths
+    (surfaced by ``Dataset.input_files`` — reference keeps the same
+    metadata on its read tasks)."""
+    ds = Dataset(sources)
+    ds._input_files = list(files)
+    return ds
+
+
 def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
     import builtins
 
@@ -128,8 +137,8 @@ def read_parquet(paths: Union[str, List[str]], *,
                  columns: Optional[List[str]] = None,
                  parallelism: int = -1, **kw) -> Dataset:
     files = _expand_paths(paths, ".parquet")
-    return Dataset([functools.partial(_read_parquet_file, f, columns)
-                    for f in files])
+    return _file_ds([functools.partial(_read_parquet_file, f, columns)
+                     for f in files], files)
 
 
 def _read_csv_file(path: str):
@@ -140,7 +149,8 @@ def _read_csv_file(path: str):
 
 def read_csv(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
-    return Dataset([functools.partial(_read_csv_file, f) for f in files])
+    return _file_ds([functools.partial(_read_csv_file, f)
+                     for f in files], files)
 
 
 def _read_json_file(path: str):
@@ -151,7 +161,8 @@ def _read_json_file(path: str):
 
 def read_json(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
-    return Dataset([functools.partial(_read_json_file, f) for f in files])
+    return _file_ds([functools.partial(_read_json_file, f)
+                     for f in files], files)
 
 
 def _read_text_file(path: str):
@@ -161,7 +172,8 @@ def _read_text_file(path: str):
 
 def read_text(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
-    return Dataset([functools.partial(_read_text_file, f) for f in files])
+    return _file_ds([functools.partial(_read_text_file, f)
+                     for f in files], files)
 
 
 def _read_numpy_file(path: str):
@@ -170,7 +182,8 @@ def _read_numpy_file(path: str):
 
 def read_numpy(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
-    return Dataset([functools.partial(_read_numpy_file, f) for f in files])
+    return _file_ds([functools.partial(_read_numpy_file, f)
+                     for f in files], files)
 
 
 def _read_tfrecords_file(path: str, raw: bool, verify: bool):
@@ -198,8 +211,8 @@ def read_tfrecords(paths: Union[str, List[str]], *, raw: bool = False,
     ``data/tfrecords.py``). ``raw=True`` yields the undecoded payload
     bytes instead; ``verify_crc`` checks the CRC32C frame checksums."""
     files = _expand_paths(paths)
-    return Dataset([functools.partial(_read_tfrecords_file, f, raw,
-                                      verify_crc) for f in files])
+    return _file_ds([functools.partial(_read_tfrecords_file, f, raw,
+                                       verify_crc) for f in files], files)
 
 
 def _read_sql_shard(connection_factory, sql: str, shard, n_shards):
@@ -280,9 +293,9 @@ def read_images(paths: Union[str, List[str]], *,
     ``ray.data.read_images``, ``read_api.py:598+``). ``size`` is
     (height, width); ``mode`` a PIL mode like "RGB"."""
     files = _expand_paths(paths)
-    return Dataset([
+    return _file_ds([
         functools.partial(_read_image_file, f, size, mode, include_paths)
-        for f in files])
+        for f in files], files)
 
 
 def _read_webdataset_shard(path: str):
@@ -320,8 +333,8 @@ def read_webdataset(paths: Union[str, List[str]], **kw) -> Dataset:
     """WebDataset tar shards, one task per shard (reference:
     ``ray.data.read_webdataset``)."""
     files = _expand_paths(paths)
-    return Dataset([functools.partial(_read_webdataset_shard, f)
-                    for f in files])
+    return _file_ds([functools.partial(_read_webdataset_shard, f)
+                     for f in files], files)
 
 
 # ------------------------------------------------------- datasource plugin
@@ -377,29 +390,30 @@ def _delta_live_files(table_path: str, version: Optional[int]):
             raise ValueError(f"version {version} not in Delta log "
                              f"(have {versions})")
     live: Dict[str, dict] = {}
-    ckpt = None
-    ckpts = sorted(globlib.glob(
-        os.path.join(log_dir, "*.checkpoint.parquet")))
-    if ckpts and version is None:
-        ckpt = ckpts[-1]
-    elif ckpts:
-        under = [c for c in ckpts
-                 if int(os.path.basename(c)[:20]) <= version]
-        ckpt = under[-1] if under else None
+    # Checkpoints come in two layouts: single-part
+    # `<v>.checkpoint.parquet` and multi-part
+    # `<v>.checkpoint.<part>.<parts>.parquet`; group files by version so
+    # a multi-part checkpoint replays ALL its parts.
+    by_ver: Dict[int, List[str]] = {}
+    for c in globlib.glob(os.path.join(log_dir, "*.checkpoint*.parquet")):
+        base = os.path.basename(c)
+        if base[:20].isdigit():
+            by_ver.setdefault(int(base[:20]), []).append(c)
+    ckpt_vers = sorted(v for v in by_ver
+                       if version is None or v <= version)
     start_after = -1
-    if ckpt is not None:
+    if ckpt_vers:
         import pyarrow.parquet as pq
 
-        start_after = int(os.path.basename(ckpt)[:20])
-        t = pq.read_table(ckpt)
-        cols = t.to_pylist()
-        for row in cols:
-            add = row.get("add")
-            if add and add.get("path"):
-                live[add["path"]] = add.get("partitionValues") or {}
-            rem = row.get("remove")
-            if rem and rem.get("path"):
-                live.pop(rem["path"], None)
+        start_after = ckpt_vers[-1]
+        for part_file in sorted(by_ver[start_after]):
+            for row in pq.read_table(part_file).to_pylist():
+                add = row.get("add")
+                if add and add.get("path"):
+                    live[add["path"]] = add.get("partitionValues") or {}
+                rem = row.get("remove")
+                if rem and rem.get("path"):
+                    live.pop(rem["path"], None)
     for v in versions:
         if v <= start_after:
             continue
@@ -455,10 +469,12 @@ def read_iceberg(table_identifier: str, *,
                  selected_fields: Optional[tuple] = None,
                  parallelism: int = -1, **kw) -> Dataset:
     """Iceberg table via pyiceberg (reference:
-    ``ray.data.read_iceberg``). Unlike Delta, Iceberg's manifests are
-    avro — no avro decoder ships in this image, so this adapter requires
-    the pyiceberg package and raises an actionable ImportError without
-    it (translation layer tested against an API-faithful fake)."""
+    ``ray.data.read_iceberg``). This adapter requires the pyiceberg
+    package (catalog resolution + scan planning are pyiceberg's job —
+    ``data/avro.py`` can decode the manifests, but snapshot/partition
+    semantics live above the file format) and raises an actionable
+    ImportError without it (translation layer tested against an
+    API-faithful fake)."""
     try:
         from pyiceberg.catalog import load_catalog
     except ImportError as e:
@@ -523,3 +539,90 @@ def read_mongo(uri: str, database: str, collection: str, *,
     return Dataset([functools.partial(_read_mongo_shard, uri, database,
                                       collection, pipeline, i, n)
                     for i in builtins_range(n)])
+
+
+# ----------------------------------------------------- surface completion
+
+
+def from_blocks(blocks: List[Any]) -> Dataset:
+    """Dataset over pre-built blocks (reference: ``ray.data.from_blocks``
+    — arrow tables, pandas frames, column dicts, or row lists)."""
+    return Dataset([to_block(b) for b in blocks])
+
+
+def from_arrow_refs(refs: List[Any]) -> Dataset:
+    """ObjectRefs of arrow tables as a dataset, zero-copy (reference:
+    ``ray.data.from_arrow_refs``); refs are valid block sources."""
+    return Dataset(list(refs))
+
+
+def from_pandas_refs(refs: List[Any]) -> Dataset:
+    """ObjectRefs of DataFrames (reference: ``from_pandas_refs``). The
+    per-block conversion runs worker-side inside the fused task
+    (``to_block`` accepts frames), not on the driver."""
+    return Dataset(list(refs))
+
+
+def from_numpy_refs(refs: List[Any], column: str = "data") -> Dataset:
+    """ObjectRefs of ndarrays (reference: ``from_numpy_refs``)."""
+    return Dataset([functools.partial(_wrap_numpy_ref, r, column)
+                    for r in refs])
+
+
+def _wrap_numpy_ref(ref, column: str):
+    import ray_tpu
+
+    return {column: np.asarray(ray_tpu.get(ref))}
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """A torch map- or iterable-style dataset as a distributed dataset
+    (reference: ``ray.data.from_torch``). Rows become an ``item``
+    column (tuple samples stay tuples, matching the reference)."""
+    if hasattr(torch_dataset, "__len__") and \
+            hasattr(torch_dataset, "__getitem__"):
+        # Map-style: index explicitly — plain iteration would fall back
+        # to the __getitem__ protocol, which loops forever on datasets
+        # that never raise IndexError.
+        items = [torch_dataset[i]
+                 for i in builtins_range(len(torch_dataset))]
+    else:
+        items = list(torch_dataset)
+    return from_items(items, parallelism=parallelism)
+
+
+def read_parquet_bulk(paths: Union[str, List[str]], *,
+                      columns: Optional[List[str]] = None,
+                      **kw) -> Dataset:
+    """One read task per file with NO metadata/partitioning pass up
+    front (reference: ``ray.data.read_parquet_bulk`` — the fast path
+    for many small homogeneous files; skips read_parquet's file-schema
+    inspection entirely)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:  # no directory expansion either — paths are taken as given
+        files.append(os.path.expanduser(p))
+    return _file_ds([functools.partial(_read_parquet_file, f, columns)
+                     for f in files], files)
+
+
+def _read_avro_file(path: str):
+    from .avro import read_avro_file
+
+    rows = read_avro_file(path)
+    if not rows:
+        import pyarrow as pa
+
+        return pa.table({})
+    return to_block(rows)
+
+
+def read_avro(paths: Union[str, List[str]], **kw) -> Dataset:
+    """Avro object container files, one task per file (reference:
+    ``ray.data.read_avro`` — decoded by the dependency-free reader in
+    ``data/avro.py``: zigzag varints, schema-driven records, null and
+    deflate codecs)."""
+    files = _expand_paths(paths)
+    return _file_ds([functools.partial(_read_avro_file, f)
+                     for f in files], files)

@@ -283,6 +283,14 @@ class Tuner:
                 trial_dir = os.path.join(storage, exp_name, tid)
                 rerun = st["state"] not in ("TERMINATED", "ERROR") or (
                     st["state"] == "ERROR" and resume["restart_errored"])
+                if st["state"] == "PAUSED" and \
+                        (tid + "r") in resume["trials"]:
+                    # PAUSED + a persisted successor clone (exploit /
+                    # reallocate id convention: tid + "r") means the
+                    # scheduler superseded this trial; re-running it
+                    # would duplicate work the clone continues. Its
+                    # recorded results still join the grid below.
+                    rerun = False
                 if rerun:
                     t = Trial(tid, st["config"])
                     t.restore_path = self._latest_checkpoint(trial_dir)

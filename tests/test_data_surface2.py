@@ -1,0 +1,276 @@
+"""Data surface completion II: the long tail of Dataset methods and
+readers (reference: ``python/ray/data/dataset.py`` public surface,
+``read_api.py`` readers — random_sample, take_batch, size_bytes,
+split_proportionately, to_*_refs, to_torch, lineage serialization,
+write_sql/images/webdataset, read_avro/read_parquet_bulk/from_torch,
+RandomAccessDataset)."""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_take_batch(ray_cluster):
+    ds = rd.range(100)
+    batch = ds.take_batch(7)
+    assert list(batch["id"]) == list(range(7))
+    pdf = ds.take_batch(3, batch_format="pandas")
+    assert list(pdf["id"]) == [0, 1, 2]
+
+
+def test_random_sample(ray_cluster):
+    n = rd.range(4000).random_sample(0.25, seed=7).count()
+    assert 700 < n < 1300  # ~1000 expected
+    with pytest.raises(ValueError):
+        rd.range(10).random_sample(1.5)
+
+
+def test_randomize_block_order(ray_cluster):
+    ds = rd.range(1000, parallelism=10)
+    shuffled = ds.randomize_block_order(seed=3)
+    rows = [r["id"] for r in shuffled.take_all()]
+    assert sorted(rows) == list(range(1000))
+    assert rows != list(range(1000))  # block order actually moved
+    # Rows inside one block keep their order.
+    first_block_start = rows[0]
+    assert rows[:100] == list(range(first_block_start,
+                                    first_block_start + 100))
+
+
+def test_size_bytes_and_num_rows(ray_cluster):
+    ds = rd.from_numpy(np.zeros((128, 4), np.float64), column="x")
+    assert ds.size_bytes() >= 128 * 4 * 8
+
+
+def test_split_proportionately(ray_cluster):
+    parts = rd.range(100).split_proportionately([0.7, 0.2])
+    counts = [p.count() for p in parts]
+    assert counts == [70, 20, 10]
+    with pytest.raises(ValueError):
+        rd.range(10).split_proportionately([0.9, 0.2])
+
+
+def test_to_refs_conversions(ray_cluster):
+    ds = rd.range(10, parallelism=2)
+    nrefs = ds.to_numpy_refs()
+    cols = ray_tpu.get(nrefs[0])
+    assert isinstance(cols["id"], np.ndarray)
+    prefs = ds.to_pandas_refs()
+    assert ray_tpu.get(prefs[0])["id"].tolist() == cols["id"].tolist()
+    arefs = ds.to_arrow_refs()
+    assert sum(ray_tpu.get(r).num_rows for r in arefs) == 10
+    assert len(ds.get_internal_block_refs()) == len(arefs)
+
+
+def test_input_files(ray_cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in range(2):
+        pq.write_table(pa.table({"a": [i]}), tmp_path / f"f{i}.parquet")
+    ds = rd.read_parquet(str(tmp_path))
+    assert len(ds.input_files()) == 2
+    assert all(f.endswith(".parquet") for f in ds.input_files())
+    # survives transforms
+    assert len(ds.map(lambda r: r).input_files()) == 2
+    assert rd.range(5).input_files() == []
+
+
+def test_to_torch(ray_cluster):
+    import torch
+
+    ds = rd.from_items([{"x": float(i), "y": i % 2} for i in range(50)])
+    it = ds.to_torch(label_column="y", batch_size=25)
+    batches = list(it)
+    assert len(batches) == 2
+    feats, label = batches[0]
+    assert isinstance(feats, torch.Tensor) and len(label) == 25
+
+
+def test_lineage_serialization(ray_cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"a": list(range(8))}),
+                   tmp_path / "x.parquet")
+    ds = rd.read_parquet(str(tmp_path / "x.parquet")).map(
+        lambda r: {"a": r["a"] * 2})
+    assert ds.has_serializable_lineage()
+    blob = ds.serialize_lineage()
+    ds2 = rd.Dataset.deserialize_lineage(blob)
+    assert sorted(r["a"] for r in ds2.take_all()) == \
+        [i * 2 for i in range(8)]
+    # Cluster-bound refs are not serializable lineage.
+    mat = rd.Dataset(ds.get_internal_block_refs())
+    assert not mat.has_serializable_lineage()
+    with pytest.raises(ValueError):
+        mat.serialize_lineage()
+
+
+def test_write_sql_roundtrip(ray_cluster, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+    conn.commit()
+    conn.close()
+    rd.from_items([{"a": i, "b": f"s{i}"} for i in range(5)]).write_sql(
+        "INSERT INTO t VALUES (?, ?)", lambda: sqlite3.connect(db))
+    back = rd.read_sql("SELECT a, b FROM t ORDER BY a",
+                       lambda: sqlite3.connect(db)).take_all()
+    assert back == [{"a": i, "b": f"s{i}"} for i in range(5)]
+
+
+def test_write_images_roundtrip(ray_cluster, tmp_path):
+    imgs = [np.full((4, 5, 3), i * 20, np.uint8) for i in range(3)]
+    rd.from_items([{"image": im} for im in imgs]).write_images(
+        str(tmp_path / "imgs"), column="image")
+    back = rd.read_images(str(tmp_path / "imgs")).take_all()
+    assert len(back) == 3
+    assert {b["image"].shape for b in back} == {(4, 5, 3)}
+    vals = sorted(int(b["image"][0, 0, 0]) for b in back)
+    assert vals == [0, 20, 40]
+
+
+def test_write_webdataset_roundtrip(ray_cluster, tmp_path):
+    rows = [{"__key__": f"s{i:03d}", "jpg": bytes([i]) * 4,
+             "cls": str(i)} for i in range(6)]
+    rd.from_items(rows).write_webdataset(str(tmp_path / "wds"))
+    back = rd.read_webdataset(str(tmp_path / "wds") + "/*.tar").take_all()
+    assert len(back) == 6
+    by_key = {r["__key__"]: r for r in back}
+    assert bytes(by_key["s002"]["jpg"]) == bytes([2]) * 4
+    assert bytes(by_key["s005"]["cls"]) == b"5"
+
+
+def test_read_avro(ray_cluster, tmp_path):
+    from ray_tpu.data.avro import write_avro_file
+
+    schema = {
+        "type": "record", "name": "Rec", "fields": [
+            {"name": "id", "type": "long"},
+            {"name": "name", "type": "string"},
+            {"name": "score", "type": "double"},
+            {"name": "tags", "type": {"type": "array", "items": "string"}},
+            {"name": "opt", "type": ["null", "long"]},
+        ],
+    }
+    rows = [{"id": i, "name": f"n{i}", "score": i / 2,
+             "tags": [f"t{i}", "x"], "opt": None if i % 2 else i}
+            for i in range(10)]
+    for codec in ("null", "deflate"):
+        p = str(tmp_path / f"{codec}.avro")
+        write_avro_file(p, rows, schema, codec=codec)
+        back = rd.read_avro(p).take_all()
+        assert len(back) == 10
+        assert back[4]["name"] == "n4"
+        assert back[4]["opt"] == 4 and back[5]["opt"] is None
+        assert list(back[3]["tags"]) == ["t3", "x"]
+
+
+def test_read_parquet_bulk(ray_cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"b{i}.parquet")
+        pq.write_table(pa.table({"v": [i, i + 10]}), p)
+        paths.append(p)
+    ds = rd.read_parquet_bulk(paths)
+    assert ds.count() == 6
+    assert ds.num_blocks() == 3
+
+
+def test_from_blocks_and_refs(ray_cluster):
+    import pandas as pd
+    import pyarrow as pa
+
+    ds = rd.from_blocks([pa.table({"a": [1]}),
+                         pd.DataFrame({"a": [2, 3]})])
+    assert sorted(r["a"] for r in ds.take_all()) == [1, 2, 3]
+
+    aref = ray_tpu.put(pa.table({"a": [7]}))
+    assert rd.from_arrow_refs([aref]).take_all() == [{"a": 7}]
+    pref = ray_tpu.put(pd.DataFrame({"a": [8]}))
+    assert rd.from_pandas_refs([pref]).take_all() == [{"a": 8}]
+    nref = ray_tpu.put(np.array([9, 10]))
+    got = rd.from_numpy_refs([nref], column="v").take_all()
+    assert [r["v"] for r in got] == [9, 10]
+
+
+def test_from_torch(ray_cluster):
+    import torch
+
+    class DS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return i * i
+
+    ds = rd.from_torch(DS())
+    assert sorted(r["item"] for r in ds.take_all()) == \
+        [0, 1, 4, 9, 16, 25]
+
+
+def test_random_access_dataset(ray_cluster):
+    ds = rd.from_items([{"k": i, "v": i * 10}
+                        for i in range(200)]).random_shuffle(seed=1)
+    rad = ds.to_random_access_dataset("k", num_workers=3)
+    assert ray_tpu.get(rad.get_async(17)) == {"k": 17, "v": 170}
+    got = rad.multiget([0, 5, 199, 1000])
+    assert got[0] == {"k": 0, "v": 0}
+    assert got[1] == {"k": 5, "v": 50}
+    assert got[2] == {"k": 199, "v": 1990}
+    assert got[3] is None
+    assert "workers=3" in rad.stats()
+
+
+def test_dataset_copy(ray_cluster):
+    ds = rd.range(10).map(lambda r: {"id": r["id"] + 1})
+    c = ds.copy()
+    assert c.take_all() == ds.take_all()
+    assert c._ops is not ds._ops
+
+
+def test_random_sample_seed_reproducible(ray_cluster):
+    ds = rd.range(500, parallelism=5)
+    a = [r["id"] for r in ds.random_sample(0.3, seed=11).take_all()]
+    b = [r["id"] for r in ds.random_sample(0.3, seed=11).take_all()]
+    assert a == b  # a seed means the SAME sample every run
+    c = [r["id"] for r in ds.random_sample(0.3, seed=12).take_all()]
+    assert a != c
+
+
+def test_avro_union_branch_order(ray_cluster, tmp_path):
+    from ray_tpu.data.avro import write_avro_file
+
+    # 'null' NOT first in the union; value must type-match the branch.
+    schema = {"type": "record", "name": "R", "fields": [
+        {"name": "v", "type": ["long", "null"]}]}
+    p = str(tmp_path / "u.avro")
+    write_avro_file(p, [{"v": 5}, {"v": None}], schema)
+    back = rd.read_avro(p).take_all()
+    assert [r["v"] for r in back] == [5, None]
+
+    # Branch selection must type-match, not take the first non-null.
+    from ray_tpu.data.avro import read_avro_file
+
+    schema2 = {"type": "record", "name": "S", "fields": [
+        {"name": "v", "type": ["null", "long", "string"]}]}
+    p2 = str(tmp_path / "u2.avro")
+    write_avro_file(p2, [{"v": "x"}, {"v": 3}, {"v": None}], schema2)
+    assert [r["v"] for r in read_avro_file(p2)] == ["x", 3, None]
+
+
+def test_lineage_rejects_partial_wrapped_refs(ray_cluster):
+    ref = ray_tpu.put(np.arange(3))
+    ds = rd.from_numpy_refs([ref])
+    assert not ds.has_serializable_lineage()
+    with pytest.raises(ValueError):
+        ds.serialize_lineage()
