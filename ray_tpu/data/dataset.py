@@ -398,20 +398,64 @@ def _groupby_reduce(key, aggs, *parts):
         return out.rename_columns(
             ["count()" if c == "count_all" else c
              for c in out.column_names])
+    # Exact quantiles have no arrow group_by kernel — compute them with
+    # numpy per group and join onto the kernel-aggregated table
+    # (reference: ``data/aggregate.py`` Quantile merges + interpolates).
+    quantiles = [a for a in aggs if a[1] == "quantile"]
+    kernel_aggs = [a for a in aggs if a[1] != "quantile"]
     arrow_fns = {"sum": "sum", "mean": "mean", "min": "min",
                  "max": "max", "count": "count", "std": "stddev",
-                 "stddev": "stddev"}
+                 "stddev": "stddev", "absmax": "max",
+                 "unique": "distinct"}
+    work = block
+    for col, fn, *_ in kernel_aggs:
+        if fn == "absmax":
+            # no abs-max kernel: max over an |col| shadow column
+            work = work.append_column(f"__abs_{col}",
+                                      pc.abs(work.column(col)))
     # Sample stddev (ddof=1), consistent with Dataset.std and the
     # reference's GroupedData.std default; arrow's kernel defaults to
     # population stddev.
-    spec = [(col, arrow_fns[fn], pc.VarianceOptions(ddof=1))
-            if arrow_fns[fn] == "stddev" else (col, arrow_fns[fn])
-            for col, fn in aggs]
-    out = block.group_by(key).aggregate(spec)
-    renames = {f"{col}_{s[1]}": f"{fn}({col})"
-               for (col, fn), s in zip(aggs, spec)}
-    return out.rename_columns(
-        [renames.get(c, c) for c in out.column_names])
+    spec = []
+    for col, fn, *_ in kernel_aggs:
+        src = f"__abs_{col}" if fn == "absmax" else col
+        if arrow_fns[fn] == "stddev":
+            spec.append((src, "stddev", pc.VarianceOptions(ddof=1)))
+        else:
+            spec.append((src, arrow_fns[fn]))
+    out = work.group_by(key).aggregate(spec) if spec else None
+    if out is not None:
+        renames = {f"{s[0]}_{s[1]}": f"{fn}({col})"
+                   for (col, fn, *_), s in zip(kernel_aggs, spec)}
+        out = out.rename_columns(
+            [renames.get(c, c) for c in out.column_names])
+    if quantiles:
+        keys_np = np.asarray(block.column(key))
+        order = {}
+        for kv in keys_np:
+            order.setdefault(kv.item() if hasattr(kv, "item") else kv,
+                             len(order))
+        qcols: Dict[str, list] = {}
+        group_keys = list(order)
+        for col, _, q in [(a[0], a[1], a[2] if len(a) > 2 else 0.5)
+                          for a in quantiles]:
+            vals = np.asarray(block.column(col), dtype=np.float64)
+            qcols[f"quantile({col})"] = [
+                float(np.quantile(vals[keys_np == gk], q))
+                for gk in group_keys]
+        import pyarrow as pa
+
+        if out is None:
+            return pa.table({key: group_keys, **qcols})
+        # Align manually on the group key: arrow's join rejects list
+        # columns (the `unique` aggregate emits one).
+        pos = {gk: i for i, gk in enumerate(group_keys)}
+        order_idx = [pos[kv.item() if hasattr(kv, "item") else kv]
+                     for kv in np.asarray(out.column(key))]
+        for cname, cvals in qcols.items():
+            out = out.append_column(
+                cname, pa.array([cvals[i] for i in order_idx]))
+    return out
 
 
 @ray_tpu.remote
@@ -1256,6 +1300,42 @@ class Dataset:
             n += len(col)
         return tot / max(n, 1)
 
+    def aggregate(self, *aggs: tuple) -> dict:
+        """Whole-dataset aggregates as one row dict (reference:
+        ``Dataset.aggregate``). ``aggs`` are (column, fn[, q]) with fn in
+        {sum, mean, min, max, count, std, absmax, quantile, unique} —
+        the same spec ``groupby().aggregate`` takes."""
+        out: Dict[str, Any] = {}
+        for col, fn, *rest in aggs:
+            name = f"{fn}({col})"
+            if fn == "sum":
+                out[name] = self.sum(col)
+            elif fn == "mean":
+                out[name] = self.mean(col)
+            elif fn == "min":
+                out[name] = self.min(col)
+            elif fn == "max":
+                out[name] = self.max(col)
+            elif fn == "count":
+                out[name] = self.count()
+            elif fn in ("std", "stddev"):
+                out[name] = self.std(col)
+            elif fn == "absmax":
+                out[name] = builtins.max(
+                    float(np.abs(c).max())
+                    for c in self._iter_columns(col))
+            elif fn == "unique":
+                out[name] = self.unique(col)
+            elif fn == "quantile":
+                q = rest[0] if rest else 0.5
+                vals = np.concatenate([
+                    np.asarray(c, dtype=np.float64)
+                    for c in self._iter_columns(col)])
+                out[name] = float(np.quantile(vals, q))
+            else:
+                raise ValueError(f"unknown aggregate fn {fn!r}")
+        return out
+
     def std(self, on: str, ddof: int = 1):
         # Streaming two-pass-free variance via (n, sum, sumsq) combine.
         n, s, ss = 0, 0.0, 0.0
@@ -1534,6 +1614,25 @@ class Dataset:
             conn.commit()
         finally:
             conn.close()
+
+    def write_mongo(self, uri: str, database: str,
+                    collection: str) -> None:
+        """Stream rows into a MongoDB collection (reference:
+        ``Dataset.write_mongo``). Gated on pymongo like ``read_mongo``;
+        blocks insert one ``insert_many`` at a time."""
+        try:
+            import pymongo
+        except ImportError as e:
+            raise ImportError(
+                "pymongo is not installed in this image; install "
+                "`pymongo` to use write_mongo") from e
+        client = pymongo.MongoClient(uri)
+        coll = client[database][collection]
+        for ref in self._stream_refs():
+            block = to_block(ray_tpu.get(ref))
+            rows = [dict(r) for r in BlockAccessor(block).rows()]
+            if rows:
+                coll.insert_many(rows)
 
     def write_images(self, path: str, column: str,
                      file_format: str = "png") -> None:

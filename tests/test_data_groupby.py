@@ -69,3 +69,30 @@ def test_zip_and_unique_and_std():
     nums = rdata.from_items([{"v": float(v)} for v in [2, 4, 4, 4, 5, 5, 7, 9]])
     assert abs(nums.std("v") - np.std([2, 4, 4, 4, 5, 5, 7, 9], ddof=1)) \
         < 1e-9
+
+
+def test_groupby_quantile_absmax_unique(ray_cluster):
+    rows = []
+    for k in (1, 2):
+        for v in ([1.0, -9.0, 3.0, 5.0] if k == 1 else [2.0, 4.0]):
+            rows.append({"k": k, "v": v})
+    ds = rdata.from_items(rows)
+    got = {r["k"]: r for r in ds.groupby("k").aggregate(
+        ("v", "absmax"), ("v", "quantile", 0.5),
+        ("v", "unique")).take_all()}
+    assert got[1]["absmax(v)"] == 9.0
+    assert got[1]["quantile(v)"] == 2.0  # median of [-9, 1, 3, 5]
+    assert got[2]["quantile(v)"] == 3.0
+    assert sorted(got[2]["unique(v)"]) == [2.0, 4.0]
+
+
+def test_dataset_aggregate(ray_cluster):
+    ds = rdata.from_items([{"v": float(i)} for i in range(1, 101)])
+    got = ds.aggregate(("v", "sum"), ("v", "mean"),
+                       ("v", "quantile", 0.5), ("v", "absmax"),
+                       ("v", "count"))
+    assert got["sum(v)"] == 5050.0
+    assert got["mean(v)"] == 50.5
+    assert got["quantile(v)"] == 50.5
+    assert got["absmax(v)"] == 100.0
+    assert got["count(v)"] == 100

@@ -189,6 +189,9 @@ def _install_fake_pymongo(monkeypatch, docs):
         def find(self):
             return list(self.docs)
 
+        def insert_many(self, rows):
+            self.docs.extend(rows)
+
         def aggregate(self, pipeline):
             self._scramble += 1
             out = list(reversed(self.docs)) if self._scramble % 2 \
@@ -247,3 +250,16 @@ def test_read_mongo_shard_logic(monkeypatch):
 def test_read_mongo_missing_package():
     with pytest.raises(ImportError, match="pymongo"):
         rdata.read_mongo("mongodb://h", "db", "coll")
+
+
+def test_write_mongo(monkeypatch, ray_cluster):
+    docs = []
+    _install_fake_pymongo(monkeypatch, docs)
+    rdata.from_items([{"a": i} for i in range(5)]).write_mongo(
+        "mongodb://h", "db", "coll")
+    assert sorted(d["a"] for d in docs) == list(range(5))
+
+
+def test_write_mongo_missing_package(ray_cluster):
+    with pytest.raises(ImportError, match="pymongo"):
+        rdata.from_items([{"a": 1}]).write_mongo("mongodb://h", "db", "c")
