@@ -63,6 +63,7 @@ def init(address: Optional[str] = None, *,
          port: int = 0,
          host: str = "",
          log_to_driver: bool = True,
+         logging_config: Optional["LoggingConfig"] = None,
          _system_config: Optional[Dict[str, Any]] = None):
     """Start (or connect to) a ray_tpu cluster.
 
@@ -76,6 +77,10 @@ def init(address: Optional[str] = None, *,
             return
         raise RuntimeError("ray_tpu.init() called twice; use "
                            "ignore_reinit_error=True to allow this.")
+    if logging_config is not None:
+        # Before any session process spawns: children inherit the env.
+        os.environ["RAY_TPU_LOG_LEVEL"] = logging_config.log_level
+        os.environ["RAY_TPU_LOG_ENCODING"] = logging_config.encoding
     if _system_config:
         # Central typed flags (reference: RayConfig _system_config,
         # ray_config_def.h:21): installed BEFORE any session process
@@ -247,4 +252,147 @@ __all__ = [
     "available_resources", "timeline", "ObjectRef", "ActorHandle", "ActorClass",
     "RemoteFunction", "TaskError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
+]
+
+
+# ------------------------------------------------- top-level API parity
+# (the long tail of the reference's ``python/ray/__init__.py`` __all__)
+
+import enum as _enum
+from dataclasses import dataclass as _dataclass
+
+
+class Language(_enum.Enum):
+    """Worker language of a remote function/actor (reference:
+    ``ray.Language`` — PYTHON/JAVA/CPP)."""
+
+    PYTHON = 0
+    JAVA = 1
+    CPP = 2
+
+
+# Process-role constants (reference: ray.SCRIPT_MODE etc.). LOCAL_MODE's
+# inline-execution behavior is deliberately NOT implemented — the
+# reference deprecated it; the constant exists for source compatibility.
+SCRIPT_MODE = 0
+WORKER_MODE = 1
+LOCAL_MODE = 2
+
+
+@_dataclass
+class LoggingConfig:
+    """Worker-process logging settings (reference: ``ray.LoggingConfig``).
+
+    Applied by ``init(logging_config=...)``: ``log_level`` propagates to
+    every session process via ``RAY_TPU_LOG_LEVEL``; ``encoding`` "TEXT"
+    or "JSON" selects the session log line format.
+    """
+
+    encoding: str = "TEXT"
+    log_level: str = "INFO"
+
+    def __post_init__(self):
+        if self.encoding not in ("TEXT", "JSON"):
+            raise ValueError(f"unsupported log encoding {self.encoding!r}")
+
+
+def get_gpu_ids() -> List[str]:
+    """GPU ids assigned to this worker (reference: ``ray.get_gpu_ids`` —
+    the worker pool pins assignments via CUDA_VISIBLE_DEVICES)."""
+    vis = os.environ.get("CUDA_VISIBLE_DEVICES")
+    return [] if not vis else [v for v in vis.split(",") if v != ""]
+
+
+def get_tpu_ids() -> List[str]:
+    """TPU chip ids assigned to this worker — the accelerator this
+    framework is native to (pinning: ``accelerators/tpu.py``
+    TPU_VISIBLE_CHIPS; no reference analog, gpu_ids' TPU sibling)."""
+    vis = os.environ.get("TPU_VISIBLE_CHIPS")
+    return [] if not vis else [v for v in vis.split(",") if v != ""]
+
+
+def show_in_dashboard(message: str, key: str = "") -> None:
+    """Attach a free-form status string to this worker, visible in the
+    dashboard's KV namespace (reference: ``ray.show_in_dashboard``)."""
+    w = _worker_mod.global_worker()
+    slot = key or w.worker_id.hex()
+    w.kv_put(f"msg:{slot}", str(message).encode("utf-8"), ns="dashboard")
+
+
+def cpp_function(worker_name: str, fn_name: str):
+    """Handle to a named function served by a registered C++ worker
+    (reference: ``ray.cpp_function``; machinery:
+    ``ray_tpu.cross_language`` + ``native/cpp_client``)."""
+    from ray_tpu import cross_language as _xl
+
+    return _xl.cpp_function(worker_name, fn_name)
+
+
+def java_function(class_name: str, function_name: str):
+    """Unsupported: no JVM ships in this image (reference:
+    ``ray.java_function``). The msgpack cross-language protocol +
+    ``native/cpp_client`` C++ worker are the documented port template."""
+    raise NotImplementedError(
+        "java workers are not supported (no JVM in this image); see "
+        "ray_tpu.cross_language + native/cpp_client for the language-"
+        "neutral protocol a Java client would implement")
+
+
+def java_actor_class(class_name: str):
+    """Unsupported — see ``java_function``."""
+    raise NotImplementedError(
+        "java workers are not supported (no JVM in this image); see "
+        "ray_tpu.cross_language + native/cpp_client for the language-"
+        "neutral protocol a Java client would implement")
+
+
+class ClientContext:
+    """Live ``ray://`` connection (reference: ``ClientContext``)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self.dashboard_url = None
+
+    def disconnect(self):
+        shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disconnect()
+
+
+class ClientBuilder:
+    """``ray_tpu.client("host:port").connect()`` builder (reference:
+    ``ray.client`` / ``python/ray/client_builder.py``). Wraps the same
+    remote-driver join ``init(address="ray://...")`` performs."""
+
+    def __init__(self, address: str):
+        self._address = address
+        self._namespace = "default"
+
+    def namespace(self, ns: str) -> "ClientBuilder":
+        self._namespace = ns
+        return self
+
+    def connect(self) -> ClientContext:
+        addr = self._address
+        if not addr.startswith("ray://"):
+            addr = "ray://" + addr
+        init(address=addr, namespace=self._namespace)
+        return ClientContext(addr)
+
+
+def client(address: str) -> ClientBuilder:
+    return ClientBuilder(address)
+
+
+from ray_tpu import autoscaler  # noqa: E402  (namespace parity)
+
+__all__ += [
+    "Language", "LoggingConfig", "SCRIPT_MODE", "WORKER_MODE",
+    "LOCAL_MODE", "get_gpu_ids", "get_tpu_ids", "show_in_dashboard",
+    "cpp_function", "java_function", "java_actor_class", "client",
+    "ClientBuilder", "ClientContext", "autoscaler",
 ]

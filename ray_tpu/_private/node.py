@@ -959,13 +959,34 @@ def _run_with_optional_profile(coro_factory, tag: str):
 _profile_dump = None
 
 
-def head_main():
-    import argparse
+def _session_logging_config():
+    """Session-process log setup honoring ``ray_tpu.LoggingConfig``:
+    RAY_TPU_LOG_LEVEL picks the level, RAY_TPU_LOG_ENCODING=JSON swaps
+    the line format for one-JSON-object-per-line (reference:
+    ``ray.LoggingConfig`` structured logging)."""
     import logging
 
-    logging.basicConfig(
-        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    level = os.environ.get("RAY_TPU_LOG_LEVEL", "INFO")
+    if os.environ.get("RAY_TPU_LOG_ENCODING") == "JSON":
+        class _J(logging.Formatter):
+            def format(self, rec):
+                return json.dumps({
+                    "ts": self.formatTime(rec), "level": rec.levelname,
+                    "logger": rec.name, "msg": rec.getMessage()})
+
+        h = logging.StreamHandler()
+        h.setFormatter(_J())
+        logging.basicConfig(level=level, handlers=[h])
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+
+def head_main():
+    import argparse
+
+    _session_logging_config()
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--resources", required=True)

@@ -1,0 +1,102 @@
+"""Top-level API parity: the long tail of the reference's
+``python/ray/__init__.py`` __all__ (Language, modes, LoggingConfig,
+get_gpu_ids, show_in_dashboard, client builder, cross-language handles)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_language_and_mode_constants():
+    assert ray_tpu.Language.PYTHON.value == 0
+    assert ray_tpu.Language.JAVA.name == "JAVA"
+    assert ray_tpu.Language.CPP.name == "CPP"
+    assert {ray_tpu.SCRIPT_MODE, ray_tpu.WORKER_MODE,
+            ray_tpu.LOCAL_MODE} == {0, 1, 2}
+
+
+def test_logging_config_validation():
+    ray_tpu.LoggingConfig(encoding="JSON", log_level="DEBUG")
+    with pytest.raises(ValueError):
+        ray_tpu.LoggingConfig(encoding="YAML")
+
+
+def test_json_log_encoding_format():
+    import json
+    import logging
+
+    from ray_tpu._private.node import _session_logging_config
+
+    os.environ["RAY_TPU_LOG_ENCODING"] = "JSON"
+    try:
+        root = logging.getLogger()
+        old_handlers = root.handlers[:]
+        root.handlers.clear()
+        _session_logging_config()
+        try:
+            rec = logging.LogRecord("t", logging.INFO, "f", 1,
+                                    "hello %s", ("x",), None)
+            line = root.handlers[0].formatter.format(rec)
+            parsed = json.loads(line)
+            assert parsed["msg"] == "hello x"
+            assert parsed["level"] == "INFO"
+        finally:
+            root.handlers.clear()
+            root.handlers.extend(old_handlers)
+    finally:
+        del os.environ["RAY_TPU_LOG_ENCODING"]
+
+
+def test_accelerator_ids(monkeypatch):
+    monkeypatch.delenv("CUDA_VISIBLE_DEVICES", raising=False)
+    assert ray_tpu.get_gpu_ids() == []
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "0,2")
+    assert ray_tpu.get_gpu_ids() == ["0", "2"]
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "1")
+    assert ray_tpu.get_tpu_ids() == ["1"]
+
+
+def test_show_in_dashboard(ray_cluster):
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.show_in_dashboard("reticulating splines", key="stage")
+    w = global_worker()
+    assert w.kv_get("msg:stage", ns="dashboard") == b"reticulating splines"
+
+    @ray_tpu.remote
+    def announce():
+        ray_tpu.show_in_dashboard("inside task")
+        from ray_tpu._private.worker import global_worker as gw
+
+        return gw().worker_id.hex()
+
+    wid = ray_tpu.get(announce.remote())
+    assert w.kv_get(f"msg:{wid}", ns="dashboard") == b"inside task"
+
+
+def test_client_builder_shape():
+    b = ray_tpu.client("127.0.0.1:1")
+    assert isinstance(b, ray_tpu.ClientBuilder)
+    assert b.namespace("ns") is b
+    assert b._address == "127.0.0.1:1"
+
+
+def test_java_raises_informative():
+    with pytest.raises(NotImplementedError, match="JVM"):
+        ray_tpu.java_function("com.X", "f")
+    with pytest.raises(NotImplementedError, match="JVM"):
+        ray_tpu.java_actor_class("com.X")
+
+
+def test_cpp_function_reexport():
+    from ray_tpu.cross_language import CppFunction
+
+    # Handle construction needs no live worker registration.
+    h = ray_tpu.cpp_function("w", "f")
+    assert isinstance(h, CppFunction)
+
+
+def test_autoscaler_namespace():
+    assert hasattr(ray_tpu.autoscaler, "__path__")
