@@ -1,0 +1,72 @@
+"""Core API tour: tasks, actors, objects, waiting, named actors.
+
+Reference-Ray equivalent: the "Ray Core walkthrough"
+(``doc/source/ray-core/walkthrough.md``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+
+
+def main():
+    ray_tpu.init(num_cpus=4, probe_tpu=False)
+
+    # --- tasks ---------------------------------------------------------
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    futures = [square.remote(i) for i in range(8)]
+    print("squares:", ray_tpu.get(futures))
+
+    # tasks compose through object refs without materializing on the driver
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    print("sum of squares:", ray_tpu.get(total.remote(*futures)))
+
+    # --- objects -------------------------------------------------------
+    big = ray_tpu.put(list(range(10_000)))  # shared-memory object store
+    print("object len:", len(ray_tpu.get(big)))
+
+    # --- wait: react to whichever finishes first -----------------------
+    import time
+
+    @ray_tpu.remote
+    def sleepy(s):
+        time.sleep(s)
+        return s
+
+    pending = [sleepy.remote(s) for s in (0.3, 0.05, 0.2)]
+    done, rest = ray_tpu.wait(pending, num_returns=1)
+    print("first done slept:", ray_tpu.get(done[0]))
+
+    # --- actors --------------------------------------------------------
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    ray_tpu.get([c.add.remote() for _ in range(5)])
+    print("counter:", ray_tpu.get(c.add.remote(0)))
+
+    # named + detached: discoverable by other drivers in the cluster
+    Counter.options(name="global-counter", lifetime="detached").remote()
+    again = ray_tpu.get_actor("global-counter")
+    print("named actor:", ray_tpu.get(again.add.remote(10)))
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

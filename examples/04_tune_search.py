@@ -1,0 +1,51 @@
+"""Tune: hyperparameter search with ASHA early stopping.
+
+Reference-Ray equivalent: ``doc/source/tune/getting-started``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def objective(config):
+    # A fake "training curve": quality depends on lr/width; ASHA stops
+    # clearly-losing trials at low budget.
+    lr, width = config["lr"], config["width"]
+    for step in range(1, 21):
+        score = (1.0 - abs(lr - 0.03) * 8) * min(1.0, width / 64) \
+            * step / 20
+        tune.report({"score": score, "step": step})
+
+
+def main():
+    ray_tpu.init(num_cpus=4, probe_tpu=False)
+    tuner = tune.Tuner(
+        objective,
+        param_space={
+            "lr": tune.loguniform(1e-4, 1e-1),
+            "width": tune.choice([16, 32, 64, 128]),
+        },
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=12,
+            scheduler=tune.ASHAScheduler(max_t=20, grace_period=4),
+        ),
+        run_config=RunConfig(name="asha-example",
+                             storage_path=tempfile.mkdtemp()),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best score:", best.metrics["score"])
+    print("best config:", best.config)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

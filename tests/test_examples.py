@@ -1,0 +1,74 @@
+"""The examples/ tree stays runnable: each is driven as a user would run
+it (a subprocess from the repo root). The cheap ones run here; the
+heavier ones (tune sweep, PPO, serve) are covered by their subsystem
+suites and marked slow."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name, timeout=240, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_example_tasks_actors():
+    p = _run("01_tasks_actors.py")
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "squares: [0, 1, 4, 9, 16, 25, 36, 49]" in p.stdout
+    assert "named actor: 10" in p.stdout
+
+
+def test_example_data_pipeline():
+    p = _run("02_data_pipeline.py")
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "rows: 33334" in p.stdout
+    assert "join:" in p.stdout
+
+
+def test_example_sharded_training():
+    p = _run("07_sharded_training.py")
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "'tp': 2" in p.stdout and "loss:" in p.stdout
+
+
+def test_example_llama_cpu():
+    p = _run("08_llama_tpu.py", env_extra={"RAY_TPU_JAX_PLATFORM": "cpu"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "generated token ids:" in p.stdout
+
+
+@pytest.mark.slow
+def test_example_train():
+    p = _run("03_train_jax.py", timeout=360)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "final loss:" in p.stdout
+
+
+@pytest.mark.slow
+def test_example_tune():
+    p = _run("04_tune_search.py", timeout=360)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "best config:" in p.stdout
+
+
+@pytest.mark.slow
+def test_example_serve():
+    p = _run("05_serve_deployment.py", timeout=360)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "http:" in p.stdout
+
+
+@pytest.mark.slow
+def test_example_rl():
+    p = _run("06_rl_ppo.py", timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "iter 4" in p.stdout
