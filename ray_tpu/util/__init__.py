@@ -28,6 +28,10 @@ def __getattr__(name):
         from .actor_pool import ActorPool as _AP
 
         return _AP
+    if name == "inspect_serializability":
+        from .check_serialize import inspect_serializability as _is
+
+        return _is
     raise AttributeError(f"module 'ray_tpu.util' has no attribute {name!r}")
 
 __all__ = [
