@@ -11,6 +11,8 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 
+from dataclasses import dataclass as _dataclass
+
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .controller import get_controller, reset_controller_cache
@@ -19,8 +21,11 @@ from .deployment import (
     Deployment,
     DeploymentHandle,
     DeploymentResponse,
+    ReplicaContext,
     deployment,
+    get_replica_context,
 )
+from .ingress import ingress
 from .proxy import ProxyActor, Request
 
 _proxy = None
@@ -198,13 +203,13 @@ def run(target: Application, *, name: str = "default",
     return DeploymentHandle(target.deployment.name, name)
 
 
-def _ensure_proxy(port: int = 0):
+def _ensure_proxy(port: int = 0, host: str = "127.0.0.1"):
     global _proxy, _proxy_port, _proxy_rpc_port
     if _proxy is not None:
         return
     _proxy = ProxyActor.options(name="SERVE_PROXY",
                                 lifetime="detached").remote()
-    _proxy_port = ray_tpu.get(_proxy.start.remote(port=port))
+    _proxy_port = ray_tpu.get(_proxy.start.remote(host=host, port=port))
     # Binary RPC ingress rides the same proxy actor (reference: the gRPC
     # proxy lives alongside the HTTP proxy in ProxyActor).
     _proxy_rpc_port = ray_tpu.get(_proxy.start_rpc.remote())
@@ -269,11 +274,36 @@ def shutdown():
     reset_controller_cache()
 
 
+@_dataclass
+class HTTPOptions:
+    """Proxy settings for ``serve.start`` (reference:
+    ``ray.serve.config.HTTPOptions``)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0           # 0 = pick a free port
+    location: str = "HeadOnly"
+
+
+def start(detached: bool = True, *,
+          http_options: Optional[HTTPOptions] = None, **kw) -> None:
+    """Boot the Serve instance (controller + ingress proxy) without
+    deploying an app yet (reference: ``serve.start``, ``serve/api.py:64``).
+    ``serve.run`` calls this implicitly; explicit start pins the HTTP
+    host/port up front."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    get_controller()  # creates the singleton controller actor
+    opts = http_options or HTTPOptions()
+    _ensure_proxy(port=opts.port, host=opts.host)
+
+
 __all__ = [
     "deployment", "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "Request", "run", "delete", "status", "shutdown",
     "batch", "get_deployment_handle", "get_app_handle", "get_proxy_port",
     "get_rpc_port", "multiplexed", "get_multiplexed_model_id",
+    "start", "HTTPOptions", "ingress", "get_replica_context",
+    "ReplicaContext",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -20,6 +20,23 @@ import ray_tpu
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+
+def _spawn_replica(app_name: str, spec: dict):
+    """One replica actor with its identity wired for
+    ``serve.get_replica_context()``."""
+    import uuid
+
+    from .deployment import Replica
+
+    opts = dict(spec.get("actor_options") or {})
+    opts.setdefault("max_concurrency", 100)
+    return Replica.options(**opts).remote(
+        spec["blob"], tuple(spec.get("init_args") or ()),
+        spec.get("init_kwargs") or {}, spec["is_class"],
+        app_name=app_name, deployment_name=spec["name"],
+        replica_tag=f"{app_name}#{spec['name']}#{uuid.uuid4().hex[:8]}")
+
+
 @ray_tpu.remote
 class ServeController:
     def __init__(self, health_check_period_s: float = 10.0):
@@ -58,12 +75,7 @@ class ServeController:
                         pass
             replicas = []
             for i in range(spec["num_replicas"]):
-                opts = dict(spec.get("actor_options") or {})
-                opts.setdefault("max_concurrency", 100)
-                r = Replica.options(**opts).remote(
-                    spec["blob"], tuple(spec.get("init_args") or ()),
-                    spec.get("init_kwargs") or {}, spec["is_class"])
-                replicas.append(r)
+                replicas.append(_spawn_replica(app_name, spec))
             if spec.get("user_config") is not None:
                 ray_tpu.get([r.reconfigure.remote(spec["user_config"])
                              for r in replicas])
@@ -124,12 +136,7 @@ class ServeController:
         cur = dep["replicas"]
         if num_replicas > len(cur):
             for _ in range(num_replicas - len(cur)):
-                opts = dict(spec.get("actor_options") or {})
-                opts.setdefault("max_concurrency", 100)
-                r = Replica.options(**opts).remote(
-                    spec["blob"], tuple(spec.get("init_args") or ()),
-                    spec.get("init_kwargs") or {}, spec["is_class"])
-                cur.append(r)
+                cur.append(_spawn_replica(app_name, spec))
             ray_tpu.get([r.health_check.remote() for r in cur])
         elif num_replicas < len(cur):
             for r in cur[num_replicas:]:
@@ -146,7 +153,7 @@ class ServeController:
         from .deployment import Replica
 
         replaced = 0
-        for app in self.apps.values():
+        for app_name, app in self.apps.items():
             for dep in app.values():
                 alive = []
                 for r in dep["replicas"]:
@@ -157,11 +164,7 @@ class ServeController:
                         replaced += 1
                 spec = dep["spec"]
                 while len(alive) < spec["num_replicas"]:
-                    opts = dict(spec.get("actor_options") or {})
-                    opts.setdefault("max_concurrency", 100)
-                    alive.append(Replica.options(**opts).remote(
-                        spec["blob"], tuple(spec.get("init_args") or ()),
-                        spec.get("init_kwargs") or {}, spec["is_class"]))
+                    alive.append(_spawn_replica(app_name, spec))
                 dep["replicas"] = alive
         if replaced:
             for app_name in self.apps:

@@ -81,20 +81,71 @@ class DeploymentResponse:
         return _wait().__await__()
 
 
+class ReplicaContext:
+    """Identity of the replica a piece of code runs inside (reference:
+    ``ray.serve.context.ReplicaContext``)."""
+
+    def __init__(self, app_name: str, deployment: str, replica_tag: str,
+                 servable_object: Any):
+        self.app_name = app_name
+        self.deployment = deployment
+        self.replica_tag = replica_tag
+        self.replica_id = replica_tag
+        self.servable_object = servable_object
+
+    def __repr__(self):
+        return (f"ReplicaContext(app={self.app_name!r}, "
+                f"deployment={self.deployment!r}, "
+                f"replica_tag={self.replica_tag!r})")
+
+
+_replica_context: Optional[ReplicaContext] = None
+
+
+def _set_replica_context(ctx: ReplicaContext) -> None:
+    global _replica_context
+    _replica_context = ctx
+
+
+def get_replica_context() -> ReplicaContext:
+    """Inside a replica: who am I (reference:
+    ``serve.get_replica_context``)."""
+    if _replica_context is None:
+        raise RuntimeError(
+            "get_replica_context() can only be called inside a Serve "
+            "replica (no replica is hosted by this process)")
+    return _replica_context
+
+
 @ray_tpu.remote
 class Replica:
     """One deployment replica hosting the user callable."""
 
     def __init__(self, cls_or_fn_blob: bytes, init_args: tuple,
-                 init_kwargs: dict, is_class: bool):
+                 init_kwargs: dict, is_class: bool,
+                 app_name: str = "default", deployment_name: str = "",
+                 replica_tag: str = ""):
+        import importlib
+
         import cloudpickle
 
         target = cloudpickle.loads(cls_or_fn_blob)
+        # The actor class ships to this worker pickled BY VALUE (the
+        # module attribute `Replica` is the ActorClass wrapper, so
+        # cloudpickle cannot pickle the inner class by reference) — a
+        # bare `global` here would write into the copy's detached
+        # namespace. Resolve the REAL module and set the context there,
+        # where get_replica_context() (imported by reference) reads it.
+        dmod = importlib.import_module("ray_tpu.serve.deployment")
+        ctx = dmod.ReplicaContext(app_name, deployment_name, replica_tag,
+                                  None)
+        dmod._set_replica_context(ctx)
         # Re-bind nested deployment handles (model composition).
         if is_class:
             self.callable = target(*init_args, **init_kwargs)
         else:
             self.callable = target
+        ctx.servable_object = self.callable
 
     async def handle_request_async(self, method: str, args: tuple,
                                    kwargs: dict):

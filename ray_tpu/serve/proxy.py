@@ -102,6 +102,13 @@ class ProxyActor:
                 second = await anext(gen)
             except StopAsyncIteration:
                 result = first
+                if isinstance(result, dict) and result.get("__asgi__"):
+                    # serve.ingress ASGI bridge: status/headers preserved
+                    return web.Response(
+                        status=result["status"],
+                        headers={k: v for k, v in result["headers"]
+                                 if k.lower() != "content-length"},
+                        body=result["body"])
                 if isinstance(result, (dict, list)):
                     return web.json_response(result)
                 if isinstance(result, bytes):

@@ -1,0 +1,100 @@
+"""Serve surface completion: start/HTTPOptions, get_replica_context,
+ASGI ingress (reference: ``serve.start`` ``serve/api.py:64``,
+``serve.get_replica_context`` ``api.py:138``, ``serve.ingress``
+``api.py:170``)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_start_then_run(serve_cluster):
+    serve.start(http_options=serve.HTTPOptions(port=0))
+    port = serve.get_proxy_port()
+    assert port and port > 0
+
+    @serve.deployment
+    class Hello:
+        def __call__(self, _):
+            return "hi"
+
+    handle = serve.run(Hello.bind(), name="start-app",
+                       route_prefix="/hello")
+    assert handle.remote(None).result(timeout=30) == "hi"
+    # start() was idempotent: the proxy port did not move under run().
+    assert serve.get_proxy_port() == port
+
+
+def test_get_replica_context(serve_cluster):
+    @serve.deployment
+    class WhoAmI:
+        def __call__(self, _):
+            ctx = serve.get_replica_context()
+            return {"app": ctx.app_name, "dep": ctx.deployment,
+                    "tag": ctx.replica_tag,
+                    "servable": type(ctx.servable_object).__name__}
+
+    handle = serve.run(WhoAmI.bind(), name="ctx-app", route_prefix=None)
+    got = handle.remote(None).result(timeout=30)
+    assert got["app"] == "ctx-app"
+    assert got["dep"] == "WhoAmI"
+    assert got["tag"].startswith("ctx-app#WhoAmI#")
+    assert got["servable"] == "WhoAmI"
+
+
+def test_get_replica_context_outside_replica():
+    with pytest.raises(RuntimeError, match="inside a Serve replica"):
+        serve.get_replica_context()
+
+
+def test_asgi_ingress(serve_cluster):
+    import requests
+
+    async def asgi_app(scope, receive, send):
+        assert scope["type"] == "http"
+        msg = await receive()
+        body = msg.get("body", b"")
+        if scope["path"].endswith("/echo"):
+            payload = {"path": scope["path"],
+                       "method": scope["method"],
+                       "got": body.decode()}
+            await send({"type": "http.response.start", "status": 201,
+                        "headers": [(b"x-served-by", b"ray-tpu")]})
+            await send({"type": "http.response.body",
+                        "body": json.dumps(payload).encode()})
+        else:
+            await send({"type": "http.response.start", "status": 404,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"nope"})
+
+    @serve.ingress(asgi_app)
+    class Api:
+        pass
+
+    serve.run(serve.deployment(Api).bind(), name="asgi-app",
+              route_prefix="/asgi")
+    port = serve.get_proxy_port()
+    r = requests.post(f"http://127.0.0.1:{port}/asgi/echo",
+                      data=b"ping", timeout=30)
+    assert r.status_code == 201
+    assert r.headers["x-served-by"] == "ray-tpu"
+    assert r.json() == {"path": "/asgi/echo", "method": "POST",
+                        "got": "ping"}
+    r2 = requests.get(f"http://127.0.0.1:{port}/asgi/missing", timeout=30)
+    assert r2.status_code == 404
+
+
+def test_ingress_rejects_non_callable():
+    with pytest.raises(TypeError, match="ASGI"):
+        serve.ingress(42)
