@@ -52,6 +52,14 @@ class AlgorithmConfig:
                     **kw) -> "AlgorithmConfig":
         self.env = env
         self.env_fn = env_fn
+        if env_fn is None and isinstance(env, str):
+            # tune.register_env names resolve to creator closures that
+            # ship to env-runner workers like any env_fn.
+            from ray_tpu.tune.registry import get_env_creator
+
+            creator = get_env_creator(env)
+            if creator is not None:
+                self.env_fn = creator
         return self
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,

@@ -1,5 +1,7 @@
 from .checkpoint import Checkpoint, load_pytree, save_pytree
-from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .config import (TRAIN_DATASET_KEY, BackendConfig, CheckpointConfig,
+                     DataConfig, FailureConfig, RunConfig, ScalingConfig,
+                     SyncConfig)
 from .session import (
     get_checkpoint,
     get_context,
@@ -12,7 +14,8 @@ from .torch_trainer import TorchTrainer
 
 __all__ = [
     "JaxTrainer", "TorchTrainer", "torch", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
-    "FailureConfig", "CheckpointConfig", "report", "get_context",
+    "FailureConfig", "CheckpointConfig", "DataConfig", "SyncConfig",
+    "BackendConfig", "TRAIN_DATASET_KEY", "report", "get_context",
     "get_checkpoint", "get_dataset_shard", "save_pytree", "load_pytree",
 ]
 

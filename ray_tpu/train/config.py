@@ -103,3 +103,44 @@ class RunConfig:
     def resolved_storage_path(self) -> str:
         return os.path.expanduser(
             self.storage_path or "~/ray_tpu_results")
+
+
+TRAIN_DATASET_KEY = "train"
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Which ``datasets=`` entries shard across workers vs replicate
+    (reference: ``ray.train.DataConfig``): ``datasets_to_split="all"``
+    streaming-splits every dataset; a list names the subset to split,
+    the rest pass whole to every worker."""
+
+    datasets_to_split: object = "all"  # "all" | list of names
+
+    def should_split(self, name: str) -> bool:
+        if self.datasets_to_split == "all":
+            return True
+        return name in (self.datasets_to_split or [])
+
+
+@dataclasses.dataclass
+class SyncConfig:
+    """Artifact/checkpoint sync cadence (reference: ``train.SyncConfig``).
+    Storage here is a filesystem path written directly by workers, so
+    there is no background sync process — the knobs are accepted for
+    source compatibility and ``sync_artifacts`` still controls whether
+    per-trial working-dir artifacts are copied into storage."""
+
+    sync_period: int = 300
+    sync_timeout: int = 1800
+    sync_artifacts: bool = False
+
+
+class BackendConfig:
+    """Base for worker-group backend setup hooks (reference:
+    ``ray.train.backend.BackendConfig``). Subclasses customize
+    per-worker process setup before the train loop runs."""
+
+    def backend_setup_fn(self):
+        """Optional callable run on every worker before the loop."""
+        return None

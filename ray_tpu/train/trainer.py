@@ -127,12 +127,16 @@ class JaxTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
+                 dataset_config: Optional[Any] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
+        from .config import DataConfig
+
+        self.dataset_config = dataset_config or DataConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
 
     def fit(self) -> Result:
@@ -287,7 +291,8 @@ class JaxTrainer:
             shard_refs: List[Dict[str, Any]] = [
                 {} for _ in range(n_workers)]
             for name, ds in self.datasets.items():
-                if hasattr(ds, "streaming_split"):
+                if hasattr(ds, "streaming_split") and \
+                        self.dataset_config.should_split(name):
                     shards = ds.streaming_split(n_workers)
                     for i, sh in enumerate(shards):
                         shard_refs[i][name] = sh

@@ -59,6 +59,66 @@ class QUniform(Domain):
         return round(rng.uniform(self.low, self.high) / self.q) * self.q
 
 
+class LogRandint(Domain):
+    """Integer drawn log-uniformly from [low, high) (reference:
+    ``tune.lograndint``)."""
+
+    def __init__(self, low: int, high: int):
+        if low < 1:
+            raise ValueError("lograndint requires low >= 1")
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return min(self.high - 1, int(math.exp(
+            rng.uniform(math.log(self.low), math.log(self.high)))))
+
+
+class QRandint(Domain):
+    def __init__(self, low: int, high: int, q: int = 1):
+        self.low, self.high, self.q = int(low), int(high), int(q)
+
+    def sample(self, rng):
+        v = rng.randint(self.low, self.high)
+        return int(round(v / self.q) * self.q)
+
+
+class QLogRandint(Domain):
+    def __init__(self, low: int, high: int, q: int = 1):
+        self.inner = LogRandint(low, high)
+        self.q = int(q)
+
+    def sample(self, rng):
+        return int(round(self.inner.sample(rng) / self.q) * self.q)
+
+
+class Normal(Domain):
+    """Gaussian N(mean, sd) (reference: ``tune.randn``)."""
+
+    def __init__(self, mean: float = 0.0, sd: float = 1.0):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+class QNormal(Domain):
+    def __init__(self, mean: float, sd: float, q: float):
+        self.mean, self.sd, self.q = mean, sd, q
+
+    def sample(self, rng):
+        return round(rng.gauss(self.mean, self.sd) / self.q) * self.q
+
+
+class QLogUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.inner = LogUniform(low, high)
+        self.q = q
+
+    def sample(self, rng):
+        return max(self.inner.low,
+                   round(self.inner.sample(rng) / self.q) * self.q)
+
+
 class SampleFrom(Domain):
     def __init__(self, fn: Callable):
         self.fn = fn
@@ -90,6 +150,30 @@ def randint(low: int, high: int) -> Randint:
 
 def quniform(low: float, high: float, q: float) -> QUniform:
     return QUniform(low, high, q)
+
+
+def lograndint(low: int, high: int) -> LogRandint:
+    return LogRandint(low, high)
+
+
+def qrandint(low: int, high: int, q: int = 1) -> QRandint:
+    return QRandint(low, high, q)
+
+
+def qlograndint(low: int, high: int, q: int = 1) -> QLogRandint:
+    return QLogRandint(low, high, q)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def qrandn(mean: float, sd: float, q: float) -> QNormal:
+    return QNormal(mean, sd, q)
+
+
+def qloguniform(low: float, high: float, q: float) -> QLogUniform:
+    return QLogUniform(low, high, q)
 
 
 def sample_from(fn: Callable) -> SampleFrom:

@@ -135,6 +135,7 @@ class Trial:
         # this between incarnations.
         self.resources: Optional[dict] = resources
         self.killed_by_scheduler = False
+        self.pg = None  # live placement group (PlacementGroupFactory)
         self.error: Optional[str] = None
         self.last_result: Optional[dict] = None
         self.logdir: Optional[str] = None  # set at launch
@@ -242,9 +243,29 @@ class Tuner:
             cloudpickle.dump(state, f)
         os.replace(tmp, os.path.join(storage, exp_name, "trials_state.pkl"))
 
+    def _resolve_trainable(self):
+        """Registry names -> callables; Trainable subclasses -> their
+        function-trainable adapter (class API, reference:
+        ``tune/trainable/trainable.py``)."""
+        t = self.trainable
+        if isinstance(t, str):
+            from .registry import get_trainable
+
+            t = get_trainable(t)
+        from .trainable import Trainable as _TrainableCls
+
+        if isinstance(t, type) and issubclass(t, _TrainableCls):
+            res = getattr(t, "_tune_resources", None)
+            t = t._as_function_trainable()
+            if res is not None:
+                t._tune_resources = res
+        return t
+
     def fit(self) -> ResultGrid:
         if not ray_tpu.is_initialized():
             ray_tpu.init(ignore_reinit_error=True)
+        if self.trainable is not None:
+            self.trainable = self._resolve_trainable()
         tc = self.tune_config
         resume = getattr(self, "_resume", None)
         exp_name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
@@ -411,6 +432,17 @@ class Tuner:
         stop_all_fired = [False]
         trial_counter = [0]
 
+        def resolve_resources(cfg):
+            """with_resources annotation -> per-trial request (dict,
+            PlacementGroupFactory, or config->resources callable)."""
+            from .trainable import PlacementGroupFactory
+
+            req = getattr(self.trainable, "_tune_resources", None)
+            if callable(req) and not isinstance(
+                    req, PlacementGroupFactory):
+                req = req(cfg)
+            return req
+
         def make_trial() -> Optional[Trial]:
             nonlocal exhausted
             if exhausted:
@@ -425,14 +457,36 @@ class Tuner:
             trial_counter[0] += 1
             if wrap_key is not None:
                 cfg = {wrap_key: cfg}
-            t = Trial(tid, cfg)
+            t = Trial(tid, cfg, resources=resolve_resources(cfg))
             trials.append(t)
             trial_by_id[tid] = t
             return t
 
         def launch(trial: Trial):
+            from .trainable import PlacementGroupFactory
+
             cls = _TrialActor
-            if trial.resources:
+            if isinstance(trial.resources, PlacementGroupFactory):
+                from ray_tpu.util.placement_group import placement_group
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy,
+                )
+
+                pgf = trial.resources
+                trial.pg = placement_group(pgf.bundles,
+                                           strategy=pgf.strategy)
+                trial.pg.wait(60)
+                head = dict(pgf.head_resources())
+                opts = {"num_cpus": head.pop("CPU", 0) or 0,
+                        "num_tpus": head.pop("TPU", 0) or 0,
+                        "scheduling_strategy":
+                            PlacementGroupSchedulingStrategy(
+                                trial.pg,
+                                placement_group_bundle_index=0)}
+                if head:
+                    opts["resources"] = head
+                cls = _TrialActor.options(**opts)
+            elif trial.resources:
                 res = dict(trial.resources)
                 opts = {"num_cpus": res.pop("CPU", 0) or 0,
                         "num_tpus": res.pop("TPU", 0) or 0}
@@ -565,6 +619,16 @@ class Tuner:
             for ref in done:
                 trial = next(t for t in running if t.run_ref == ref)
                 running.remove(trial)
+                if getattr(trial, "pg", None) is not None:
+                    from ray_tpu.util.placement_group import (
+                        remove_placement_group,
+                    )
+
+                    try:
+                        remove_placement_group(trial.pg)
+                    except Exception:
+                        pass
+                    trial.pg = None
                 try:
                     out = ray_tpu.get(ref)
                     if not out.get("ok"):

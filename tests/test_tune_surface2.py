@@ -1,0 +1,197 @@
+"""Tune surface completion: class Trainable API, with_parameters /
+with_resources, PlacementGroupFactory trials, registries, reporters,
+sampling long-tail, create_searcher/scheduler, Experiment facade
+(reference: ``python/ray/tune/__init__.py`` __all__)."""
+
+import random
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import DataConfig, RunConfig
+
+
+def test_sampling_long_tail():
+    rng = random.Random(0)
+    for _ in range(50):
+        v = tune.lograndint(1, 100).sample(rng)
+        assert 1 <= v < 100 and isinstance(v, int)
+        q = tune.qrandint(0, 100, 10).sample(rng)
+        assert q % 10 == 0
+        ql = tune.qlograndint(1, 1000, 5).sample(rng)
+        assert ql % 5 == 0
+        n = tune.randn(5.0, 0.1).sample(rng)
+        assert 3.0 < n < 7.0
+        qn = tune.qrandn(0.0, 1.0, 0.5).sample(rng)
+        assert abs(qn / 0.5 - round(qn / 0.5)) < 1e-9
+        qlu = tune.qloguniform(1e-3, 1.0, 1e-3).sample(rng)
+        assert qlu >= 1e-3
+
+
+def test_class_trainable(ray_cluster):
+    class MyTrainable(tune.Trainable):
+        checkpoint_frequency = 2
+
+        def setup(self, config):
+            self.gain = config["gain"]
+            self.total = 0.0
+
+        def step(self):
+            self.total += self.gain
+            return {"score": self.total,
+                    "done": self.training_iteration + 1 >= 5}
+
+        def save_checkpoint(self, d):
+            return {"total": self.total}
+
+        def load_checkpoint(self, saved):
+            self.total = saved["total"]
+
+    results = tune.Tuner(
+        MyTrainable,
+        param_space={"gain": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="cls-trainable",
+                             storage_path=tempfile.mkdtemp()),
+    ).fit()
+    best = results.get_best_result()
+    assert best.metrics["score"] == 10.0  # gain 2 x 5 steps
+    assert best.metrics["training_iteration"] == 5
+
+
+def test_with_parameters(ray_cluster):
+    big = np.arange(10_000)
+
+    def objective(config, data=None):
+        tune.report({"got": float(data.sum()) + config["x"]})
+
+    wrapped = tune.with_parameters(objective, data=big)
+    grid = tune.Tuner(
+        wrapped, param_space={"x": tune.grid_search([1.0])},
+        tune_config=tune.TuneConfig(metric="got", mode="max"),
+        run_config=RunConfig(name="with-params",
+                             storage_path=tempfile.mkdtemp())).fit()
+    assert grid.get_best_result().metrics["got"] == float(big.sum()) + 1.0
+
+
+def test_with_resources_and_pgf(ray_cluster):
+    def objective(config):
+        tune.report({"ok": 1})
+
+    pgf = tune.PlacementGroupFactory([{"CPU": 1}, {"CPU": 1}],
+                                     strategy="PACK")
+    wrapped = tune.with_resources(objective, pgf)
+    grid = tune.Tuner(
+        wrapped, param_space={},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(name="pgf",
+                             storage_path=tempfile.mkdtemp())).fit()
+    assert grid.get_best_result().metrics["ok"] == 1
+    # All trial PGs were torn down with their trials.
+    from ray_tpu.util.placement_group import placement_group_table
+
+    live = [e for e in placement_group_table().values()
+            if e.get("state") not in ("REMOVED",)]
+    assert not live, live
+
+
+def test_register_trainable(ray_cluster):
+    def objective(config):
+        tune.report({"v": config["x"] * 2})
+
+    tune.register_trainable("doubler", objective)
+    grid = tune.Tuner(
+        "doubler", param_space={"x": tune.grid_search([3.0])},
+        tune_config=tune.TuneConfig(metric="v", mode="max"),
+        run_config=RunConfig(name="registry",
+                             storage_path=tempfile.mkdtemp())).fit()
+    assert grid.get_best_result().metrics["v"] == 6.0
+    with pytest.raises(ValueError, match="unknown trainable"):
+        tune.Tuner("nope", param_space={}).fit()
+
+
+def test_register_env():
+    import gymnasium as gym
+
+    tune.register_env("my-cartpole", lambda: gym.make("CartPole-v1"))
+    from ray_tpu.rl import PPOConfig
+
+    cfg = PPOConfig().environment("my-cartpole")
+    assert cfg.env_fn is not None
+    env = cfg.env_fn()
+    assert env.observation_space.shape == (4,)
+
+
+def test_cli_reporter(capsys):
+    rep = tune.CLIReporter(metric_columns=["loss"],
+                           parameter_columns=["lr"],
+                           max_report_frequency=0.0)
+
+    class T:
+        id = "trial_0000"
+        state = "RUNNING"
+        config = {"lr": 0.1}
+        last_result = {"loss": 0.25}
+
+    rep.setup("/tmp/x")
+    rep.on_trial_result(T(), T.last_result)
+    out = capsys.readouterr().out
+    assert "trial_0000" in out and "0.25" in out and "0.1" in out
+    assert "== Status ==" in out
+
+
+def test_create_searcher_scheduler():
+    assert isinstance(tune.create_scheduler("asha"),
+                      tune.ASHAScheduler)
+    assert isinstance(tune.create_searcher("tpe"), tune.TPESearcher)
+    assert tune.create_searcher("random") is None
+    with pytest.raises(ValueError):
+        tune.create_scheduler("wat")
+
+
+def test_experiment_facade(ray_cluster):
+    def objective(config):
+        tune.report({"m": config["x"]})
+
+    exp = tune.Experiment(name="exp-facade", run=objective,
+                          config={"x": tune.grid_search([1, 2])},
+                          storage_path=tempfile.mkdtemp())
+    results = tune.run_experiments(exp, metric="m", mode="max")
+    assert len(results) == 2
+    ana = tune.ExperimentAnalysis(
+        tune.ResultGrid(results, metric="m", mode="max"))
+    assert ana.get_best_config()["x"] == 2
+
+
+def test_data_config_split_control(ray_cluster):
+    from ray_tpu import data as rd
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+    import ray_tpu.train as train
+
+    def loop(config):
+        ctx = train.get_context()
+        shard = train.get_dataset_shard("train")
+        whole = train.get_dataset_shard("eval")
+        n_shard = sum(1 for _ in shard.iter_rows()) \
+            if hasattr(shard, "iter_rows") else len(list(shard))
+        n_whole = sum(1 for _ in whole.iter_rows()) \
+            if hasattr(whole, "iter_rows") else len(list(whole))
+        train.report({"shard_rows": n_shard, "whole_rows": n_whole,
+                      "rank": ctx.get_world_rank()})
+
+    trainer = JaxTrainer(
+        loop,
+        datasets={"train": rd.range(100, parallelism=4),
+                  "eval": rd.range(10, parallelism=2)},
+        dataset_config=DataConfig(datasets_to_split=["train"]),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="dcfg",
+                             storage_path=tempfile.mkdtemp()))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # train split across 2 workers; eval replicated whole
+    assert result.metrics["shard_rows"] in (48, 50, 52)
+    assert result.metrics["whole_rows"] == 10
