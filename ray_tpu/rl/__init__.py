@@ -1,6 +1,6 @@
 from .algorithm import Algorithm, AlgorithmConfig, PPO, PPOConfig
 from .appo import APPO, APPOConfig
-from .cql import CQL
+from .cql import CQL, CQLConfig
 from .connectors import (ClipRewards, ConnectorPipeline, FlattenObs,
                          GAEConnector, NormalizeObs, default_env_to_module,
                          default_learner_pipeline)
@@ -8,9 +8,13 @@ from .dqn import DQN, DQNConfig
 from .dreamerv3 import DreamerV3, DreamerV3Algo
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import IMPALA, IMPALAConfig
+# Reference exports both spellings (rllib/algorithms/__init__.py)
+Impala = IMPALA
+ImpalaConfig = IMPALAConfig
 from .learner import Learner, LearnerGroup, gae
 from .multi_agent import MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO
-from .offline import BC, MARWIL, episodes_to_rows
+from .offline import (BC, BCConfig, MARWIL, MARWILConfig,
+                      episodes_to_rows)
 from .replay import ReplayBuffer
 from .rl_module import MLPModuleConfig
 from .sac import SAC, SACConfig
@@ -22,7 +26,8 @@ __all__ = [
     "LearnerGroup", "gae", "vtrace", "MLPModuleConfig", "ReplayBuffer",
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
     "BC", "MARWIL", "episodes_to_rows",
-    "SAC", "SACConfig", "APPO", "APPOConfig", "CQL",
+    "SAC", "SACConfig", "APPO", "APPOConfig", "CQL", "CQLConfig",
+    "BCConfig", "MARWILConfig", "Impala", "ImpalaConfig",
     "DreamerV3", "DreamerV3Algo",
     "ConnectorPipeline", "FlattenObs", "NormalizeObs", "ClipRewards",
     "GAEConnector", "default_env_to_module", "default_learner_pipeline",

@@ -226,3 +226,26 @@ class CQL:
         return np.asarray(deterministic_action(
             self.state["params"]["actor"], jnp.asarray(obs, jnp.float32),
             self.cfg))
+
+
+class CQLConfig:
+    """Builder-config facade (reference: ``rllib/algorithms/cql``);
+    see ``offline._OfflineConfig`` for the pattern."""
+
+    def __init__(self):
+        self.kwargs = {}
+
+    def training(self, **kw) -> "CQLConfig":
+        self.kwargs.update(kw)
+        return self
+
+    def offline_data(self, **kw) -> "CQLConfig":
+        self.kwargs.update({k: v for k, v in kw.items()
+                            if k not in ("input_",)})
+        return self
+
+    def environment(self, *a, **kw) -> "CQLConfig":
+        return self
+
+    def build(self) -> "CQL":
+        return CQL(**self.kwargs)

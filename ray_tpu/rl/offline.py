@@ -166,3 +166,40 @@ class MARWIL(BC):
     def __init__(self, obs_dim: int, num_actions: int, beta: float = 1.0,
                  **kw):
         super().__init__(obs_dim, num_actions, beta=beta, **kw)
+
+
+class _OfflineConfig:
+    """Builder-config facade for the dataset-driven offline algorithms
+    (reference: ``rllib/algorithms/bc/bc.py`` BCConfig et al. — the
+    reference routes these through the full AlgorithmConfig; here the
+    offline trainers are direct classes, so the config collects ctor
+    kwargs and ``build()`` constructs the trainer)."""
+
+    algo_cls: type = None
+
+    def __init__(self):
+        self.kwargs = {}
+
+    def training(self, **kw) -> "_OfflineConfig":
+        self.kwargs.update(kw)
+        return self
+
+    # accepted for source compatibility with reference config chains
+    def offline_data(self, **kw) -> "_OfflineConfig":
+        self.kwargs.update({k: v for k, v in kw.items()
+                            if k not in ("input_",)})
+        return self
+
+    def environment(self, *a, **kw) -> "_OfflineConfig":
+        return self
+
+    def build(self):
+        return type(self).algo_cls(**self.kwargs)
+
+
+class BCConfig(_OfflineConfig):
+    algo_cls = BC
+
+
+class MARWILConfig(_OfflineConfig):
+    algo_cls = MARWIL

@@ -257,3 +257,19 @@ def test_bc_and_marwil_from_dataset(ray_cluster):
     mw.train_on_dataset(ds, epochs=3, batch_size=256)
     mw_acc = (mw.compute_actions(test_obs) == want).mean()
     assert mw_acc > 0.85, f"MARWIL accuracy {mw_acc}"
+
+
+def test_offline_config_facades():
+    """BCConfig/MARWILConfig/CQLConfig builder facades + the Impala
+    spelling aliases (reference: rllib/algorithms/__init__.py __all__)."""
+    from ray_tpu import rl
+
+    algo = rl.BCConfig().training(obs_dim=4, num_actions=2).build()
+    assert type(algo).__name__ == "BC"
+    m = rl.MARWILConfig().training(obs_dim=4, num_actions=2,
+                                   beta=1.0).build()
+    assert type(m).__name__ == "MARWIL" and m.beta == 1.0
+    c = (rl.CQLConfig().offline_data(input_="ignored")
+         .training(obs_dim=3, act_dim=1, cql_alpha=2.0).build())
+    assert type(c).__name__ == "CQL"
+    assert rl.Impala is rl.IMPALA and rl.ImpalaConfig is rl.IMPALAConfig
