@@ -3,6 +3,16 @@ from .context import (BackpressurePolicy, ConcurrencyCapPolicy, DataContext,
                       MemoryBudgetPolicy)
 from .dataset import Dataset, MaterializedDataset
 from .iterator import DataIterator
+from .interfaces import (
+    ActorPoolStrategy,
+    BlockBasedFileDatasink,
+    Datasink,
+    ExecutionOptions,
+    ExecutionResources,
+    NodeIdStr,
+    ReadTask,
+    RowBasedFileDatasink,
+)
 from .random_access import RandomAccessDataset
 from .read_api import (
     Datasource,
@@ -27,6 +37,7 @@ from .read_api import (
     read_json,
     read_mongo,
     read_numpy,
+    range_tensor,
     read_parquet,
     read_parquet_bulk,
     read_sql,
@@ -48,7 +59,24 @@ __all__ = [
     "RandomAccessDataset",
     "DataContext", "BackpressurePolicy", "ConcurrencyCapPolicy",
     "MemoryBudgetPolicy",
+    "Datasink", "BlockBasedFileDatasink", "RowBasedFileDatasink",
+    "ActorPoolStrategy", "ExecutionOptions", "ExecutionResources",
+    "NodeIdStr", "ReadTask", "range_tensor", "Schema",
+    "DatasetContext", "DatasetIterator", "Preprocessor",
 ]
+
+# Spelling aliases the reference keeps exporting (data/__init__.py):
+DatasetContext = DataContext
+DatasetIterator = DataIterator
+try:
+    import pyarrow as _pa
+
+    # Blocks are arrow tables; the public Schema IS the arrow schema.
+    Schema = _pa.Schema
+except ImportError:  # pragma: no cover
+    Schema = None
+
+from .preprocessors import Preprocessor  # noqa: E402
 
 from ray_tpu._private.usage import record_library_usage as _rlu
 _rlu('data')

@@ -549,6 +549,7 @@ class Dataset:
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
                     concurrency: Optional[int] = None,
+                    compute: Optional[Any] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None,
                     **ray_remote_args) -> "Dataset":
@@ -559,6 +560,10 @@ class Dataset:
         created, the class is constructed once per actor, and blocks
         stream through the pool — the shape for expensive-init UDFs.
         """
+        if compute is not None and concurrency is None and \
+                hasattr(compute, "pool_size"):
+            # ray.data.ActorPoolStrategy compute strategy object
+            concurrency = compute.pool_size()
         if isinstance(fn, type):
             op = _Op("map_batches", None, batch_size, batch_format,
                      udf_cls=fn, fn_args=fn_constructor_args,

@@ -626,3 +626,19 @@ def read_avro(paths: Union[str, List[str]], **kw) -> Dataset:
     files = _expand_paths(paths)
     return _file_ds([functools.partial(_read_avro_file, f)
                      for f in files], files)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: int = -1) -> Dataset:
+    """Rows of ``{"data": full(shape, i)}`` for i in [0, n) (reference:
+    ``ray.data.range_tensor`` — the tensor-column benchmark source)."""
+    shape = tuple(shape)
+
+    def to_tensor(batch):
+        ids = batch["id"]
+        col = np.empty(len(ids), dtype=object)
+        for j, i in enumerate(ids):
+            col[j] = np.full(shape, i)
+        return {"data": col}
+
+    return range(n, parallelism=parallelism).map_batches(to_tensor)

@@ -1,0 +1,186 @@
+"""Preprocessor suite (reference: ``python/ray/data/preprocessors/``):
+scalers, encoders, imputer, hasher, tokenizer, discretizers,
+concatenator, chain — fit on streaming aggregates, transform via
+map_batches."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.preprocessors import (
+    Chain,
+    Concatenator,
+    CustomKBinsDiscretizer,
+    FeatureHasher,
+    LabelEncoder,
+    MaxAbsScaler,
+    MinMaxScaler,
+    MultiHotEncoder,
+    Normalizer,
+    OneHotEncoder,
+    OrdinalEncoder,
+    Preprocessor,
+    PreprocessorNotFittedError,
+    RobustScaler,
+    SimpleImputer,
+    StandardScaler,
+    Tokenizer,
+    UniformKBinsDiscretizer,
+)
+
+
+def _col(ds, c):
+    return np.array([r[c] for r in ds.take_all()])
+
+
+def test_standard_scaler(ray_cluster):
+    ds = rd.from_items([{"x": float(i)} for i in range(1, 8)])
+    out = StandardScaler(["x"]).fit_transform(ds)
+    xs = _col(out, "x")
+    assert abs(xs.mean()) < 1e-9
+    assert abs(xs.std(ddof=1) - 1.0) < 1e-9
+
+
+def test_min_max_and_abs_scalers(ray_cluster):
+    ds = rd.from_items([{"x": v} for v in (-4.0, 0.0, 4.0, 8.0)])
+    mm = _col(MinMaxScaler(["x"]).fit_transform(ds), "x")
+    assert mm.min() == 0.0 and mm.max() == 1.0
+    ma = _col(MaxAbsScaler(["x"]).fit_transform(ds), "x")
+    assert ma.max() == 1.0 and ma.min() == -0.5
+
+
+def test_robust_scaler(ray_cluster):
+    vals = list(range(1, 101)) + [10_000]  # outlier
+    ds = rd.from_items([{"x": float(v)} for v in vals])
+    xs = _col(RobustScaler(["x"]).fit_transform(ds), "x")
+    # median maps to 0; the outlier stays an outlier but finite
+    assert abs(np.median(xs)) < 0.05
+    assert xs.max() > 10
+
+
+def test_normalizer(ray_cluster):
+    ds = rd.from_items([{"a": 3.0, "b": 4.0}])
+    out = Normalizer(["a", "b"], norm="l2").transform(ds).take_all()[0]
+    assert abs(out["a"] - 0.6) < 1e-9 and abs(out["b"] - 0.8) < 1e-9
+    with pytest.raises(ValueError):
+        Normalizer(["a"], norm="l3")
+
+
+def test_ordinal_and_label_encoders(ray_cluster):
+    ds = rd.from_items([{"c": x} for x in "bacab"])
+    enc = OrdinalEncoder(["c"]).fit(ds)
+    assert list(_col(enc.transform(ds), "c")) == [1, 0, 2, 0, 1]
+    # unseen category -> -1
+    assert enc.transform_batch({"c": ["z"]})["c"][0] == -1
+    le = LabelEncoder("c").fit(ds)
+    assert le.label_column == "c"
+
+
+def test_one_hot_encoder(ray_cluster):
+    ds = rd.from_items([{"c": x, "keep": 1} for x in ("a", "b", "a")])
+    out = OneHotEncoder(["c"]).fit_transform(ds).take_all()
+    assert out[0]["c_a"] == 1 and out[0]["c_b"] == 0
+    assert out[1]["c_a"] == 0 and out[1]["c_b"] == 1
+    assert out[2]["keep"] == 1 and "c" not in out[0]
+
+
+def test_multi_hot_encoder(ray_cluster):
+    ds = rd.from_items([{"tags": ["x", "y"]}, {"tags": ["y"]}])
+    enc = MultiHotEncoder(["tags"]).fit(ds)
+    got = enc.transform_batch({"tags": np.array([["y", "x"], ["x"]],
+                                                dtype=object)})
+    assert list(got["tags"][0]) == [1, 1]
+    assert list(got["tags"][1]) == [1, 0]
+
+
+def test_simple_imputer(ray_cluster):
+    ds = rd.from_items([{"x": 1.0}, {"x": float("nan")}, {"x": 3.0}])
+    out = _col(SimpleImputer(["x"], strategy="mean").fit_transform(ds),
+               "x")
+    assert list(out) == [1.0, 2.0, 3.0]
+    out2 = SimpleImputer(["x"], strategy="constant",
+                         fill_value=9.0).fit(ds).transform_batch(
+        {"x": np.array([np.nan, 5.0])})
+    assert list(out2["x"]) == [9.0, 5.0]
+
+
+def test_feature_hasher_and_tokenizer(ray_cluster):
+    tok = Tokenizer(["t"])
+    got = tok.transform_batch({"t": np.array(["hello world hello"])})
+    assert got["t"][0] == ["hello", "world", "hello"]
+
+    fh = FeatureHasher(["t"], num_features=8)
+    vec = fh.transform_batch(
+        {"t": np.array(["a b a"])})["hashed_features"][0]
+    assert vec.shape == (8,) and vec.sum() == 3  # a twice + b once
+
+
+def test_discretizers(ray_cluster):
+    ds = rd.from_items([{"x": float(v)} for v in range(10)])
+    u = UniformKBinsDiscretizer(["x"], bins=5).fit_transform(ds)
+    bins = _col(u, "x")
+    assert bins.min() == 0 and bins.max() == 4
+    c = CustomKBinsDiscretizer(["x"], bins=[0, 3, 6, 10])
+    got = c.transform_batch({"x": np.array([1.0, 4.0, 9.0])})
+    assert list(got["x"]) == [0, 1, 2]
+
+
+def test_concatenator(ray_cluster):
+    ds = rd.from_items([{"a": 1.0, "b": 2.0}])
+    out = Concatenator(["a", "b"], output_column_name="vec") \
+        .transform(ds).take_all()[0]
+    assert list(out["vec"]) == [1.0, 2.0]
+
+
+def test_chain_fit_order(ray_cluster):
+    ds = rd.from_items([{"x": float(i)} for i in range(1, 5)])
+    # MinMax first maps to [0, 1]; the chained StandardScaler must be
+    # fit on THAT distribution, not the raw one.
+    chain = Chain(MinMaxScaler(["x"]), StandardScaler(["x"]))
+    out = _col(chain.fit_transform(ds), "x")
+    assert abs(out.mean()) < 1e-9
+    assert abs(out.std(ddof=1) - 1.0) < 1e-9
+    # one-shot batch path applies both stages
+    b = chain.transform_batch({"x": np.array([1.0, 4.0])})
+    assert abs(b["x"][0] - out[0]) < 1e-9
+
+
+def test_not_fitted_error(ray_cluster):
+    with pytest.raises(PreprocessorNotFittedError):
+        StandardScaler(["x"]).transform(rd.range(3))
+
+
+def test_interfaces_surface(ray_cluster, tmp_path):
+    import pyarrow.parquet as pq
+
+    # compute strategy object drives the actor-pool size
+    strat = rd.ActorPoolStrategy(size=3)
+    assert strat.pool_size() == 3
+
+    class AddOne:
+        def __call__(self, b):
+            return {"id": b["id"] + 1}
+
+    ds = rd.range(10).map_batches(AddOne, compute=rd.ActorPoolStrategy(
+        size=2), batch_size=5)
+    assert ds._actor_pool_size == 2
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 11))
+
+    # file datasinks
+    class PqSink(rd.BlockBasedFileDatasink):
+        def write_block_to_file(self, block, f):
+            pq.write_table(block, f)
+
+    rd.range(6, parallelism=2).write_datasink(
+        PqSink(str(tmp_path / "sink"), file_format="parquet"))
+    back = rd.read_parquet(str(tmp_path / "sink"))
+    assert back.count() == 6
+
+    # aliases + misc
+    assert rd.DatasetContext is rd.DataContext
+    assert rd.Schema is not None
+    rt = rd.range_tensor(4, shape=(2, 2))
+    rows = rt.take_all()
+    assert rows[0]["data"].shape == (2, 2)
+    assert int(rows[3]["data"][0, 0]) == 3
