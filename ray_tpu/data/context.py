@@ -79,6 +79,10 @@ class DataContext:
         # Prefer scheduling a fused task on a node already holding its
         # input block (soft affinity; multi-node clusters only).
         self.locality_aware_scheduling: bool = True
+        # Optional ray.data.ExecutionOptions: resource_limits.
+        # object_store_memory overrides the default memory budget and
+        # locality_with_output forces locality scheduling on.
+        self.execution_options = None
 
     @classmethod
     def get_current(cls) -> "DataContext":

@@ -560,10 +560,18 @@ class Dataset:
         created, the class is constructed once per actor, and blocks
         stream through the pool — the shape for expensive-init UDFs.
         """
-        if compute is not None and concurrency is None and \
-                hasattr(compute, "pool_size"):
+        if compute is not None and hasattr(compute, "pool_size"):
             # ray.data.ActorPoolStrategy compute strategy object
-            concurrency = compute.pool_size()
+            if not isinstance(fn, type):
+                # Same contract as the reference: the actor pool needs a
+                # callable CLASS (constructed once per actor); silently
+                # running a plain function on the task path would fake
+                # a pool that doesn't exist.
+                raise ValueError(
+                    "ActorPoolStrategy requires a callable class UDF; "
+                    "got a plain function")
+            if concurrency is None:
+                concurrency = compute.pool_size()
         if isinstance(fn, type):
             op = _Op("map_batches", None, batch_size, batch_format,
                      udf_cls=fn, fn_args=fn_constructor_args,
@@ -736,9 +744,15 @@ class Dataset:
         except Exception:
             cpus = 4
         policies = ctx.backpressure_policies
+        exec_opts = getattr(ctx, "execution_options", None)
         if policies is None:
+            budget = self._memory_budget()
+            limits = getattr(exec_opts, "resource_limits", None)
+            if limits is not None and \
+                    limits.object_store_memory is not None:
+                budget = int(limits.object_store_memory)
             policies = [ConcurrencyCapPolicy(max(2, cpus * 2)),
-                        MemoryBudgetPolicy(self._memory_budget())]
+                        MemoryBudgetPolicy(budget)]
         est_block = 0  # rolling estimate of produced block bytes
         task = _pipeline_task_stats
         if self._remote_args:
@@ -749,7 +763,9 @@ class Dataset:
                 task = _pipeline_task_stats.options(**opts)
         limit_n = next((o.kw["n"] for o in ops if o.kind == "limit"), None)
         locality = (self._locality_targets(sources)
-                    if ctx.locality_aware_scheduling else {})
+                    if ctx.locality_aware_scheduling
+                    or getattr(exec_opts, "locality_with_output", False)
+                    else {})
         stats = self._exec_stats = _ExecStats([o.kind for o in ops])
         t_exec = time.perf_counter()
         pending: List[tuple] = []  # (block_ref, stats_ref, source)

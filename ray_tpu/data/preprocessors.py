@@ -145,12 +145,13 @@ class RobustScaler(_ColumnStatScaler):
         lo_q, hi_q = self.quantile_range
         out = {}
         for c in self.columns:
-            # One aggregate per quantile: the result key is
-            # quantile(col), so same-column quantiles cannot share a call.
-            lo = ds.aggregate((c, "quantile", lo_q))[f"quantile({c})"]
-            med = ds.aggregate((c, "quantile", 0.5))[f"quantile({c})"]
-            hi = ds.aggregate((c, "quantile", hi_q))[f"quantile({c})"]
-            out[c] = (med, (hi - lo) or 1.0)
+            # One streaming scan per column; all three quantiles come
+            # from the same pull (three aggregate() calls would each
+            # re-execute the whole pipeline).
+            vals = np.concatenate([np.asarray(col, dtype=np.float64)
+                                   for col in ds._iter_columns(c)])
+            lo, med, hi = np.quantile(vals, [lo_q, 0.5, hi_q])
+            out[c] = (float(med), float(hi - lo) or 1.0)
         return out
 
     def _transform_batch(self, batch):
@@ -191,6 +192,21 @@ class Normalizer(Preprocessor):
 # ------------------------------------------------------------ encoders
 
 
+def _distinct_per_column(ds, columns: List[str]) -> Dict[str, list]:
+    """All columns' distinct values in ONE dataset execution (per-column
+    ``ds.unique`` calls would each re-run the whole pipeline)."""
+    import ray_tpu
+
+    from .block import BlockAccessor, to_block
+
+    out: Dict[str, set] = {c: set() for c in columns}
+    for ref in ds._stream_refs():
+        cols = BlockAccessor(to_block(ray_tpu.get(ref))).to_numpy()
+        for c in columns:
+            out[c].update(_scalar(v) for v in cols[c])
+    return {c: sorted(vals) for c, vals in out.items()}
+
+
 class OrdinalEncoder(Preprocessor):
     """Category -> dense int id, sorted order (reference:
     ``OrdinalEncoder``). Unseen categories map to -1."""
@@ -200,8 +216,9 @@ class OrdinalEncoder(Preprocessor):
         self.columns = list(columns)
 
     def _fit(self, ds):
-        return {c: {v: i for i, v in enumerate(sorted(ds.unique(c)))}
-                for c in self.columns}
+        return {c: {v: i for i, v in enumerate(vals)}
+                for c, vals in _distinct_per_column(ds,
+                                                    self.columns).items()}
 
     def _transform_batch(self, batch):
         for c in self.columns:
@@ -229,7 +246,7 @@ class OneHotEncoder(Preprocessor):
         self.columns = list(columns)
 
     def _fit(self, ds):
-        return {c: sorted(ds.unique(c)) for c in self.columns}
+        return _distinct_per_column(ds, self.columns)
 
     def _transform_batch(self, batch):
         for c in self.columns:
@@ -429,7 +446,7 @@ class Concatenator(Preprocessor):
     _is_fittable = False
 
     def __init__(self, columns: List[str],
-                 output_column_name: str = "concat_out"):
+                 output_column_name: str = "concatenated_features"):
         super().__init__()
         self.columns = list(columns)
         self.output_column_name = output_column_name
@@ -451,6 +468,9 @@ class Chain(Preprocessor):
     def __init__(self, *preprocessors: Preprocessor):
         super().__init__()
         self.preprocessors = list(preprocessors)
+        # A chain of only stateless stages is itself stateless and
+        # transforms without fit() (reference: Chain NOT_FITTABLE).
+        self._is_fittable = any(p._is_fittable for p in self.preprocessors)
 
     def fit(self, ds):
         cur = ds

@@ -184,3 +184,28 @@ def test_interfaces_surface(ray_cluster, tmp_path):
     rows = rt.take_all()
     assert rows[0]["data"].shape == (2, 2)
     assert int(rows[3]["data"][0, 0]) == 3
+
+
+def test_stateless_chain_needs_no_fit(ray_cluster):
+    ds = rd.from_items([{"a": 1.0, "b": 2.0}])
+    chain = Chain(Concatenator(["a", "b"]))
+    assert not chain._is_fittable
+    out = chain.transform(ds).take_all()[0]
+    assert list(out["concatenated_features"]) == [1.0, 2.0]
+
+
+def test_actor_pool_strategy_rejects_plain_fn(ray_cluster):
+    with pytest.raises(ValueError, match="callable class"):
+        rd.range(4).map_batches(lambda b: b,
+                                compute=rd.ActorPoolStrategy(size=2))
+
+
+def test_execution_options_wiring(ray_cluster):
+    ctx = rd.DataContext.get_current()
+    ctx.execution_options = rd.ExecutionOptions(
+        resource_limits=rd.ExecutionResources(object_store_memory=12345))
+    try:
+        ds = rd.range(100)
+        assert ds.count() == 100  # executes under the custom budget
+    finally:
+        rd.DataContext.reset()
