@@ -80,6 +80,12 @@ def _step_ids(dag: DAGNode) -> Dict[int, str]:
     """Deterministic step id per node: topo index + name."""
     ids: Dict[int, str] = {}
     for i, node in enumerate(dag.topo_order()):
+        opts = getattr(node, "_wf_options", None)
+        if opts and opts.get("name"):
+            # workflow.options(name=...): the given name IS the step id
+            # (stable across DAG edits, the reference contract).
+            ids[id(node)] = opts["name"]
+            continue
         name = ""
         if isinstance(node, FunctionNode):
             name = getattr(node._fn, "__name__", "fn")
@@ -91,13 +97,76 @@ def _step_path(workflow_id: str, step_id: str) -> str:
     return os.path.join(_wf_dir(workflow_id), "steps", f"{step_id}.pkl")
 
 
-class WorkflowCanceledError(RuntimeError):
+class WorkflowError(RuntimeError):
+    """Base for workflow-level failures (reference:
+    ``workflow.exceptions.WorkflowError``)."""
+
+
+class WorkflowExecutionError(WorkflowError):
+    """A workflow failed mid-execution (reference:
+    ``WorkflowExecutionError``). Step exceptions propagate with their
+    original type; this wraps engine-level failures (e.g. a resume
+    whose persisted DAG is gone)."""
+
+
+class WorkflowCanceledError(WorkflowError):
     pass
 
 
-def _execute(dag: DAGNode, workflow_id: str, input_args: tuple) -> Any:
+# Reference spelling (workflow/exceptions.py)
+WorkflowCancellationError = WorkflowCanceledError
+
+
+class EventListener:
+    """Durable event-source adapter base (reference:
+    ``workflow/event_listener.py``): subclass ``poll_for_event`` to
+    bridge an external system into ``wait_for_event``-style steps."""
+
+    async def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+    async def event_checkpointed(self, event) -> None:
+        pass
+
+
+class _Continuation:
+    """Marker a step returns to extend the workflow (``continuation``)."""
+
+    def __init__(self, dag: DAGNode, args: tuple = ()):
+        self.dag = dag
+        self.args = args
+
+
+def continuation(dag: DAGNode, *, args: tuple = ()) -> "_Continuation":
+    """Return from a step to continue the workflow with another DAG
+    (reference: ``workflow.continuation``): the continuation's steps
+    join the same workflow id and checkpoint under a generation prefix,
+    so resume replays them from storage like any other step."""
+    if not isinstance(dag, DAGNode):
+        raise TypeError("continuation expects a bound DAG node")
+    return _Continuation(dag, args)
+
+
+def options(*, name: Optional[str] = None, checkpoint: bool = True,
+            **metadata):
+    """Per-step options wrapper (reference: ``workflow.options``):
+    ``workflow.options(name="fetch", checkpoint=False)(fn.bind(x))``
+    names the step (stable ids across DAG edits) and can skip its
+    checkpoint."""
+
+    def apply(node: DAGNode) -> DAGNode:
+        node._wf_options = {"name": name, "checkpoint": checkpoint,
+                            "metadata": metadata}
+        return node
+
+    return apply
+
+
+def _execute(dag: DAGNode, workflow_id: str, input_args: tuple,
+             step_prefix: str = "") -> Any:
     """Run the DAG, checkpointing each FunctionNode result; previously
-    checkpointed steps short-circuit (the resume path)."""
+    checkpointed steps short-circuit (the resume path). ``step_prefix``
+    namespaces continuation generations."""
     steps_dir = os.path.join(_wf_dir(workflow_id), "steps")
     os.makedirs(steps_dir, exist_ok=True)
     # Persist the DAG itself so resume() can re-run without the caller
@@ -112,8 +181,10 @@ def _execute(dag: DAGNode, workflow_id: str, input_args: tuple) -> Any:
     for node in dag.topo_order():
         if _cancel_flags.get(workflow_id):
             raise WorkflowCanceledError(workflow_id)
-        step_id = ids[id(node)]
+        step_id = step_prefix + ids[id(node)]
         path = _step_path(workflow_id, step_id)
+        opts = getattr(node, "_wf_options", None) or {}
+        durable = opts.get("checkpoint", True)
         if isinstance(node, FunctionNode) and os.path.exists(path):
             with open(path, "rb") as f:
                 cache[id(node)] = ray_tpu.put(cloudpickle.load(f))
@@ -121,9 +192,10 @@ def _execute(dag: DAGNode, workflow_id: str, input_args: tuple) -> Any:
         out = node._execute_self(cache, input_args, {})
         if isinstance(node, FunctionNode):
             value = ray_tpu.get(out)  # barrier: durability per step
-            with open(path + ".tmp", "wb") as f:
-                cloudpickle.dump(value, f)
-            os.replace(path + ".tmp", path)
+            if durable:
+                with open(path + ".tmp", "wb") as f:
+                    cloudpickle.dump(value, f)
+                os.replace(path + ".tmp", path)
             out = ray_tpu.put(value)
         cache[id(node)] = out
     result = cache[id(dag)]
@@ -141,6 +213,11 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     _write_status(workflow_id, RUNNING)
     try:
         result = _execute(dag, workflow_id, args)
+        gen = 0
+        while isinstance(result, _Continuation):
+            gen += 1
+            result = _execute(result.dag, workflow_id, result.args,
+                              step_prefix=f"g{gen}_")
     except WorkflowCanceledError:
         _write_status(workflow_id, CANCELED)
         raise
@@ -167,6 +244,43 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
     return fut
 
 
+def resume_async(workflow_id: str):
+    """``resume`` on a background thread; returns a Future (reference:
+    ``workflow.resume_async``)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(resume, workflow_id)
+    fut.workflow_id = workflow_id
+    pool.shutdown(wait=False)
+    return fut
+
+
+def get_output_async(workflow_id: str):
+    """``get_output`` as a Future (reference:
+    ``workflow.get_output_async``)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(get_output, workflow_id)
+    pool.shutdown(wait=False)
+    return fut
+
+
+def sleep(duration: float) -> DAGNode:
+    """A durable sleep step (reference: ``workflow.sleep``). Once slept,
+    the checkpoint makes resume skip it; a crash MID-sleep re-sleeps the
+    full duration on resume (the step model checkpoints only completed
+    steps)."""
+
+    @ray_tpu.remote
+    def _wf_sleep(d):
+        time.sleep(d)
+        return None
+
+    return _wf_sleep.bind(duration)
+
+
 def resume(workflow_id: str) -> Any:
     """Re-run a FAILED/CANCELED/RESUMABLE workflow; completed steps load
     from storage (reference: workflow_state_from_storage.py)."""
@@ -174,8 +288,13 @@ def resume(workflow_id: str) -> Any:
     if status["status"] == SUCCESSFUL:
         return get_output(workflow_id)
     dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
-    with open(dag_path, "rb") as f:
-        dag, input_args = cloudpickle.load(f)
+    try:
+        with open(dag_path, "rb") as f:
+            dag, input_args = cloudpickle.load(f)
+    except OSError as e:
+        raise WorkflowExecutionError(
+            f"workflow {workflow_id!r} has no persisted DAG "
+            "to resume from") from e
     with _lock:
         _cancel_flags.pop(workflow_id, None)
     return run(dag, workflow_id=workflow_id, args=input_args)
@@ -287,9 +406,11 @@ def delete(workflow_id: str):
 
 
 __all__ = [
-    "init", "run", "run_async", "resume", "resume_all", "get_status",
-    "get_output", "get_metadata", "list_all", "cancel", "delete",
-    "InputNode", "MultiOutputNode", "wait_for_event",
+    "init", "run", "run_async", "resume", "resume_async", "resume_all",
+    "get_status", "get_output", "get_output_async", "get_metadata",
+    "list_all", "cancel", "delete", "sleep", "options", "continuation",
+    "InputNode", "MultiOutputNode", "wait_for_event", "EventListener",
+    "WorkflowError", "WorkflowExecutionError", "WorkflowCancellationError",
     "RUNNING", "SUCCESSFUL", "FAILED", "CANCELED", "RESUMABLE",
 ]
 

@@ -163,3 +163,74 @@ def test_wait_for_event_and_resume(ray_cluster, tmp_path):
     t0 = _time.time()
     assert workflow.resume("evt_wf") == {"got": 42, "tag": "done"}
     assert _time.time() - t0 < 10
+
+
+def test_workflow_sleep_and_async(ray_cluster, tmp_path):
+    import time as _time
+
+    workflow.init(str(tmp_path / "wf_async"))
+
+    @ray_tpu.remote
+    def val():
+        return 5
+
+    t0 = _time.time()
+    assert workflow.run(workflow.sleep(0.2), workflow_id="w_sleep") is None
+    assert _time.time() - t0 >= 0.2
+    # checkpointed: resume returns instantly without re-sleeping
+    t1 = _time.time()
+    assert workflow.resume("w_sleep") is None
+    assert _time.time() - t1 < 0.15
+
+    fut = workflow.resume_async("w_sleep")
+    assert fut.result(timeout=30) is None
+    assert workflow.get_output_async("w_sleep").result(timeout=30) is None
+
+
+def test_workflow_continuation(ray_cluster, tmp_path):
+    workflow.init(str(tmp_path / "wf_cont"))
+
+    @ray_tpu.remote
+    def second(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def first():
+        return workflow.continuation(second.bind(4))
+
+    assert workflow.run(first.bind(), workflow_id="w_cont") == 40
+    # both generations' steps persisted; resume replays from storage
+    steps = workflow.get_metadata("w_cont")["checkpointed_steps"]
+    assert any(s.startswith("g1_") for s in steps)
+    assert workflow.resume("w_cont") == 40
+
+
+def test_workflow_options_and_exceptions(ray_cluster, tmp_path):
+    workflow.init(str(tmp_path / "wf_opts"))
+
+    @ray_tpu.remote
+    def a():
+        return 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x + 1
+
+    named = workflow.options(name="step_a")(a.bind())
+    dag = workflow.options(name="step_b", checkpoint=False)(b.bind(named))
+    assert workflow.run(dag, workflow_id="w_opts") == 2
+    steps = workflow.get_metadata("w_opts")["checkpointed_steps"]
+    assert "step_a" in steps          # named checkpoint
+    assert "step_b" not in steps      # checkpoint=False skipped
+
+    assert issubclass(workflow.WorkflowExecutionError,
+                      workflow.WorkflowError)
+    assert workflow.WorkflowCancellationError is not None
+    with pytest.raises(workflow.WorkflowExecutionError):
+        # status exists but the persisted DAG is gone
+        import os as _os
+        (tmp_path / "wf_opts" / "w_broken").mkdir()
+        import json as _json
+        (tmp_path / "wf_opts" / "w_broken" / "status.json").write_text(
+            _json.dumps({"workflow_id": "w_broken", "status": "FAILED"}))
+        workflow.resume("w_broken")
