@@ -219,6 +219,48 @@ def main():
     results["rpc_p50_ms"] = round(percentile(lats, 0.5) * 1000, 2)
     results["rpc_p99_ms"] = round(percentile(lats, 0.99) * 1000, 2)
 
+    # -------------------------------------- concurrent-client capacity
+    # The serial loops above measure per-request LATENCY (1 in flight);
+    # serving capacity is what the proxy sustains with many clients in
+    # flight (reference: release/serve_tests drive concurrent users).
+    import threading
+
+    def measure_concurrent(n_clients: int, calls_each: int,
+                           make_call) -> float:
+        barrier = threading.Barrier(n_clients + 1)
+        done = threading.Barrier(n_clients + 1)
+
+        def worker():
+            call = make_call()
+            barrier.wait()
+            for _ in range(calls_each):
+                call()
+            done.wait()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        done.wait()
+        dt = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=10)
+        return n_clients * calls_each / dt
+
+    def rpc_call_factory():
+        c = ServeRpcClient(port=serve.get_rpc_port())
+        return lambda: c.call("/bench", {})
+
+    def http_call_factory():
+        return http_call
+
+    results["rpc_rps_c16"] = round(
+        measure_concurrent(16, 40, rpc_call_factory), 1)
+    results["http_rps_c16"] = round(
+        measure_concurrent(16, 20, http_call_factory), 1)
+
     try:
         serve.shutdown()
         ray_tpu.shutdown()

@@ -164,6 +164,13 @@ class Replica:
         out = target(*args, **kwargs)
         if asyncio.iscoroutine(out):
             out = await out
+        import inspect
+
+        if inspect.isgenerator(out) or inspect.isasyncgen(out):
+            # Generators can't ride the unary reply; the ingress probes
+            # with a unary call first (the fast batched actor-call path)
+            # and falls back to the streaming channel on this marker.
+            return {"__serve_needs_stream__": True}
         return out
 
     def handle_request_stream(self, spec):

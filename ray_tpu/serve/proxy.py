@@ -78,6 +78,20 @@ class ProxyActor:
                 return b"data: " + raw + b"\n\n"
             return raw
 
+        def render_unary(result):
+            if isinstance(result, dict) and result.get("__asgi__"):
+                # serve.ingress ASGI bridge: status/headers preserved
+                return web.Response(
+                    status=result["status"],
+                    headers={k: v for k, v in result["headers"]
+                             if k.lower() != "content-length"},
+                    body=result["body"])
+            if isinstance(result, (dict, list)):
+                return web.json_response(result)
+            if isinstance(result, bytes):
+                return web.Response(body=result)
+            return web.Response(text=str(result))
+
         async def handler(request: "web.Request"):
             path = request.path
             match = self._find_route(path)
@@ -87,10 +101,20 @@ class ProxyActor:
             req = Request(request.method, path, dict(request.query), body,
                           dict(request.headers))
             handle = self.handles[match]
-            # Stream-first (reference: Serve streaming responses,
-            # proxy.py:1129): the replica's generator chunks flow straight
-            # to the client; a non-generator handler produces exactly one
-            # chunk and falls through to the plain response shapes below.
+            # Unary first, on the batched actor-call path (~an order of
+            # magnitude cheaper per call than the streaming channel);
+            # generator handlers answer with the needs-stream marker and
+            # fall through to the streaming flow below.
+            try:
+                result = await handle.remote(req)
+            except Exception as e:  # noqa: BLE001
+                return web.Response(status=500, text=str(e))
+            if not (isinstance(result, dict)
+                    and result.get("__serve_needs_stream__")):
+                return render_unary(result)
+            # Streaming handler (reference: Serve streaming responses,
+            # proxy.py:1129): the replica's generator chunks flow
+            # straight to the client.
             gen = handle.stream(req)
             try:
                 first = await anext(gen)
@@ -101,19 +125,7 @@ class ProxyActor:
             try:
                 second = await anext(gen)
             except StopAsyncIteration:
-                result = first
-                if isinstance(result, dict) and result.get("__asgi__"):
-                    # serve.ingress ASGI bridge: status/headers preserved
-                    return web.Response(
-                        status=result["status"],
-                        headers={k: v for k, v in result["headers"]
-                                 if k.lower() != "content-length"},
-                        body=result["body"])
-                if isinstance(result, (dict, list)):
-                    return web.json_response(result)
-                if isinstance(result, bytes):
-                    return web.Response(body=result)
-                return web.Response(text=str(result))
+                return render_unary(first)
             except Exception as e:  # noqa: BLE001
                 return web.Response(status=500, text=str(e))
             # ≥2 chunks: a real stream. SSE framing when the client asked
@@ -179,8 +191,8 @@ class ProxyActor:
             req = Request("RPC", msg.get("route", route), {}, body,
                           msg.get("meta") or {})
             handle = self.handles[route]
-            gen = handle.stream(req)
             if msg.get("stream"):
+                gen = handle.stream(req)
                 try:
                     async for item in gen:
                         writer.write(protocol.pack(
@@ -192,9 +204,15 @@ class ProxyActor:
                         {"i": corr, "ok": False, "error": str(e)}))
                 return
             try:
-                result = None
-                async for item in gen:
-                    result = item  # unary: last chunk wins
+                # Unary on the batched actor-call path; a generator
+                # handler answers with the needs-stream marker and is
+                # drained over the streaming channel instead.
+                result = await handle.remote(req)
+                if isinstance(result, dict) and \
+                        result.get("__serve_needs_stream__"):
+                    result = None
+                    async for item in handle.stream(req):
+                        result = item  # unary client: last chunk wins
                 writer.write(protocol.pack(
                     {"i": corr, "ok": True, "result": _rpc_safe(result)}))
             except Exception as e:  # noqa: BLE001
