@@ -121,6 +121,31 @@ def main():
         print(f"batch {batch}: {tok_s:.1f} tok/s, {step_ms:.2f} ms/step, "
               f"MBU {mbu:.3f}", file=sys.stderr)
 
+    # Weight-only int8 at the champion batch: decode is HBM-bound, so
+    # halving weight bytes should approach 2x tokens/s (ops/quant.py).
+    from ray_tpu.ops.quant import quantize_params, quantized_nbytes
+
+    champ_batch = max(rows, key=lambda r: r["decode_tok_s"])["batch"]
+    qparams = quantize_params(params)
+    qprompt = jax.random.randint(jax.random.PRNGKey(99),
+                                 (champ_batch, prompt_len), 0,
+                                 cfg.vocab_size)
+    np.asarray(generate_greedy(qparams, qprompt, cfg, max_new=max_new))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = generate_greedy(qparams, qprompt, cfg, max_new=max_new)
+    np.asarray(out)
+    qdt = (time.perf_counter() - t0) / 3
+    int8_row = {
+        "batch": champ_batch,
+        "decode_tok_s": round(champ_batch * max_new / qdt, 1),
+        "step_ms": round(qdt / max_new * 1e3, 3),
+        "weight_bytes_ratio": round(
+            quantized_nbytes(qparams) / quantized_nbytes(params), 3),
+    }
+    print(f"int8 batch {champ_batch}: {int8_row['decode_tok_s']} tok/s",
+          file=sys.stderr)
+
     # Prefill: compute-bound forward over 2k context, batch 1.
     import functools
 
@@ -150,6 +175,7 @@ def main():
         "extra": {
             "champion_batch": champ["batch"],
             "batch_sweep": rows,
+            "int8_weight_only": int8_row,
             "prefill_tok_s_b1_2k": round(prefill_tok_s, 1),
             "prefill_mfu": round(prefill_mfu, 4),
             "device": str(dev),

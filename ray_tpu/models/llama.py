@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.quant import mm
 from ..ops.attention import dense_attention, flash_attention
 from ..ops.layers import apply_rope, cross_entropy_loss, rms_norm, rope_frequencies
 
@@ -100,9 +101,9 @@ def _attention_block(layer, x, cos, sin, cfg: LlamaConfig, attn_impl,
                      kv_cache=None, positions=None):
     B, L, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = jnp.dot(h, layer["wq"]).reshape(B, L, cfg.n_heads, cfg.head_dim)
-    k = jnp.dot(h, layer["wk"]).reshape(B, L, cfg.n_kv_heads, cfg.head_dim)
-    v = jnp.dot(h, layer["wv"]).reshape(B, L, cfg.n_kv_heads, cfg.head_dim)
+    q = mm(h, layer["wq"]).reshape(B, L, cfg.n_heads, cfg.head_dim)
+    k = mm(h, layer["wk"]).reshape(B, L, cfg.n_kv_heads, cfg.head_dim)
+    v = mm(h, layer["wv"]).reshape(B, L, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     new_cache = None
@@ -129,14 +130,14 @@ def _attention_block(layer, x, cos, sin, cfg: LlamaConfig, attn_impl,
     else:
         o = attn_impl(q, k, v, causal=True)
     o = o.reshape(B, L, cfg.n_heads * cfg.head_dim)
-    return jnp.dot(o, layer["wo"]), new_cache
+    return mm(o, layer["wo"]), new_cache
 
 
 def _mlp_block(layer, x, cfg: LlamaConfig):
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    g = jnp.dot(h, layer["w_gate"])
-    u = jnp.dot(h, layer["w_up"])
-    return jnp.dot(jax.nn.silu(g) * u, layer["w_down"])
+    g = mm(h, layer["w_gate"])
+    u = mm(h, layer["w_up"])
+    return mm(jax.nn.silu(g) * u, layer["w_down"])
 
 
 def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
@@ -168,7 +169,7 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
                        remat=remat)
     head = (params["embedding"].T if cfg.tie_embeddings
             else params["lm_head"])
-    return jnp.dot(x, head.astype(x.dtype))
+    return mm(x, head)
 
 
 def next_token_targets(tokens: jax.Array) -> jax.Array:
@@ -197,6 +198,12 @@ def loss_fn(params, batch, cfg: LlamaConfig, attn_impl=None,
                            remat=remat)
         head = (params["embedding"].T if cfg.tie_embeddings
                 else params["lm_head"])
+        from ..ops.quant import Q8
+
+        if isinstance(head, Q8):
+            # chunked CE streams its own matmuls; feed it dense weights
+            # (int8 training isn't a thing — this path is train-only)
+            head = head.w.astype(x.dtype) * head.s
         B, L, D = x.shape
         return chunked_cross_entropy(
             x.reshape(B * L, D), head, targets.reshape(B * L),
@@ -229,7 +236,7 @@ def _decode_step(params, tokens, caches, start, cfg: LlamaConfig, cos, sin):
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     head = (params["embedding"].T if cfg.tie_embeddings
             else params["lm_head"])
-    return jnp.dot(x, head.astype(x.dtype)), new_caches
+    return mm(x, head), new_caches
 
 
 def _prefill(params, prompt, cfg: LlamaConfig, max_new: int):
