@@ -9,11 +9,12 @@ from .session import (
     report,
 )
 from .trainer import JaxTrainer, Result
+from . import huggingface  # RayTrainReportCallback + prepare_trainer
 from . import torch  # ray_tpu.train.torch.prepare_model etc.
 from .torch_trainer import TorchTrainer
 
 __all__ = [
-    "JaxTrainer", "TorchTrainer", "torch", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
+    "JaxTrainer", "TorchTrainer", "torch", "huggingface", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "DataConfig", "SyncConfig",
     "BackendConfig", "TRAIN_DATASET_KEY", "report", "get_context",
     "get_checkpoint", "get_dataset_shard", "save_pytree", "load_pytree",

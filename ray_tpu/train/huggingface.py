@@ -1,0 +1,97 @@
+"""HuggingFace Transformers integration for Train.
+
+Reference: ``python/ray/train/huggingface/transformers`` —
+``RayTrainReportCallback`` (a ``transformers.TrainerCallback`` that
+feeds HF checkpoints + metrics into the Train session) and
+``prepare_trainer`` (routes a Train dataset shard into the HF Trainer's
+dataloaders). transformers + torch (CPU) ship in this image, so the
+integration is exercised by real HF ``Trainer`` runs in the tests.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Optional
+
+try:
+    from transformers.trainer_callback import TrainerCallback
+    _TRANSFORMERS_ERR: Optional[ImportError] = None
+except ImportError as e:  # pragma: no cover - transformers is baked in
+    TrainerCallback = object
+    _TRANSFORMERS_ERR = e
+
+
+class RayTrainReportCallback(TrainerCallback):
+    """Report HF Trainer progress into the Train session (reference:
+    ``ray.train.huggingface.transformers.RayTrainReportCallback``).
+
+    ``on_log`` reports the latest metric dict; ``on_save`` additionally
+    attaches the just-written HF checkpoint directory, so Tune
+    schedulers / fault tolerance see the same stream a native loop
+    produces.
+    """
+
+    CHECKPOINT_NAME = "checkpoint"
+
+    def __init__(self):
+        if _TRANSFORMERS_ERR is not None:
+            raise _TRANSFORMERS_ERR
+        self._latest_metrics: dict = {}
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        import ray_tpu.train as train
+
+        logs = dict(logs or {})
+        logs.setdefault("step", state.global_step)
+        logs.setdefault("epoch", state.epoch)
+        self._latest_metrics = logs
+        train.report(logs)
+
+    def on_save(self, args, state, control, **kwargs):
+        import ray_tpu.train as train
+        from ray_tpu.train import Checkpoint
+
+        src = os.path.join(args.output_dir,
+                           f"checkpoint-{state.global_step}")
+        if not os.path.isdir(src):
+            return
+        metrics = dict(self._latest_metrics)
+        metrics.setdefault("step", state.global_step)
+        train.report(metrics, checkpoint=Checkpoint.from_directory(src))
+
+
+def prepare_trainer(trainer: Any) -> Any:
+    """Adapt an HF ``Trainer`` built inside a Train worker (reference:
+    ``transformers.prepare_trainer``): dataset shards from
+    ``get_dataset_shard`` (ray_tpu datasets / iterators) become torch
+    iterables the HF dataloader accepts, and the report callback is
+    installed if the user forgot it."""
+    if _TRANSFORMERS_ERR is not None:
+        raise _TRANSFORMERS_ERR
+
+    for attr in ("train_dataset", "eval_dataset"):
+        ds = getattr(trainer, attr, None)
+        if ds is not None and hasattr(ds, "iter_batches"):
+            # Dataset or DataIterator (what get_dataset_shard hands out)
+            setattr(trainer, attr, _as_torch_iterable(ds))
+    has_report = any(isinstance(cb, RayTrainReportCallback)
+                     for cb in getattr(
+                         trainer, "callback_handler").callbacks)
+    if not has_report:
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer
+
+
+def _as_torch_iterable(ds):
+    import torch
+
+    class _Shard(torch.utils.data.IterableDataset):
+        def __iter__(self):
+            for batch in ds.iter_batches(batch_size=1,
+                                         batch_format="numpy"):
+                # HF collates rows itself: yield row dicts of tensors
+                yield {k: torch.as_tensor(v[0])
+                       for k, v in batch.items()}
+
+    return _Shard()
