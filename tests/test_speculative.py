@@ -1,0 +1,63 @@
+"""Speculative decoding (models/speculative.py): output must be
+bit-identical to the target model's greedy decode regardless of the
+draft model's quality — the draft only changes the round structure."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.models import LlamaConfig, generate_greedy, init_params
+from ray_tpu.models.speculative import generate_speculative
+
+
+@pytest.fixture(scope="module")
+def cfgs():
+    target_cfg = LlamaConfig(vocab_size=96, d_model=64, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=128,
+                             max_seq_len=128, dtype=jnp.float32)
+    draft_cfg = LlamaConfig(vocab_size=96, d_model=32, n_layers=1,
+                            n_heads=2, n_kv_heads=1, d_ff=64,
+                            max_seq_len=128, dtype=jnp.float32)
+    target = init_params(target_cfg, jax.random.PRNGKey(0))
+    draft = init_params(draft_cfg, jax.random.PRNGKey(1))
+    return target_cfg, target, draft_cfg, draft
+
+
+def test_perfect_draft_accepts_everything(cfgs):
+    target_cfg, target, _, _ = cfgs
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0,
+                                target_cfg.vocab_size)
+    ref = generate_greedy(target, prompt, target_cfg, max_new=24)
+    out, stats = generate_speculative(target, target, prompt, target_cfg,
+                                      target_cfg, max_new=24, k=4)
+    assert out.tolist() == ref.tolist()
+    assert stats["acceptance_rate"] == 1.0
+    # full acceptance: ~k+1 tokens per round
+    assert stats["rounds"] <= -(-23 // 5) + 1
+
+
+def test_weak_draft_still_exact(cfgs):
+    target_cfg, target, draft_cfg, draft = cfgs
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0,
+                                target_cfg.vocab_size)
+    ref = generate_greedy(target, prompt, target_cfg, max_new=20)
+    out, stats = generate_speculative(target, draft, prompt, target_cfg,
+                                      draft_cfg, max_new=20, k=3)
+    # THE property: an unrelated random draft cannot change the output.
+    assert out.tolist() == ref.tolist()
+    assert 0.0 <= stats["acceptance_rate"] <= 1.0
+    assert stats["drafted"] == stats["rounds"] * 3
+
+
+def test_k_one_and_batch_guard(cfgs):
+    target_cfg, target, draft_cfg, draft = cfgs
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0,
+                                target_cfg.vocab_size)
+    ref = generate_greedy(target, prompt, target_cfg, max_new=10)
+    out, _ = generate_speculative(target, draft, prompt, target_cfg,
+                                  draft_cfg, max_new=10, k=1)
+    assert out.tolist() == ref.tolist()
+    with pytest.raises(ValueError, match="batch-1"):
+        generate_speculative(target, draft,
+                             jnp.zeros((2, 4), jnp.int32),
+                             target_cfg, draft_cfg)
