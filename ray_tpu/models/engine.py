@@ -1,0 +1,199 @@
+"""Continuous-batching generation engine.
+
+The serving-side decode loop (the role vLLM plays for the reference;
+here framework-native and TPU-shaped): S cache slots share one jitted
+step, requests join/leave between steps — a long request never blocks a
+short one, and the chip sees a full [S, 1] decode batch every step
+instead of per-request batch-1 decodes.
+
+Per-slot cache positions differ, so the step vmaps the single-sequence
+cached attention over the slot axis (per-slot write offsets +
+position-masked reads); XLA lowers that to batched scatters/gathers.
+Inactive slots still flow through the math (their outputs are ignored)
+— static shapes, one compilation.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig, _decode_step, rope_frequencies
+
+
+def _single_step(params, caches, tok, length, cfg, cos, sin):
+    """One token for ONE sequence: caches are per-layer (k, v) WITHOUT a
+    batch axis; ``length`` is this sequence's current position."""
+    b_caches = [(kc[None], vc[None]) for kc, vc in caches]
+    logits, new = _decode_step(params, tok[None, None], b_caches, length,
+                               cfg, cos, sin)
+    out = [(kc[0], vc[0]) for kc, vc in new]
+    return logits[0, -1], out
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _step_all(params, caches, toks, lengths, cfg, cos, sin):
+    """Vmapped engine step: every slot advances one token at its own
+    position. caches: per-layer (k [S,total,h,d], v [S,total,h,d])."""
+    fn = jax.vmap(
+        lambda c, t, l: _single_step(params, c, t, l, cfg, cos, sin),
+        in_axes=(0, 0, 0))
+    logits, new_caches = fn(caches, toks, lengths)
+    return jnp.argmax(logits, axis=-1), new_caches
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "total", "pad_len"))
+def _prefill_one(params, prompt_padded, n_valid, total, cfg, cos, sin,
+                 pad_len):
+    """Prefill one request on a fresh single-sequence cache. The padded
+    tail writes stale K/V beyond ``n_valid``, which is harmless: decode
+    overwrites position p before any query can attend it (the causal
+    position mask admits keys <= the query position only), and the
+    next-token logits are read AT position ``n_valid - 1``."""
+    caches = [
+        (jnp.zeros((total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
+         jnp.zeros((total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
+        for _ in range(cfg.n_layers)
+    ]
+    b_caches = [(kc[None], vc[None]) for kc, vc in caches]
+    logits, new = _decode_step(params, prompt_padded[None], b_caches, 0,
+                               cfg, cos, sin)
+    first = jnp.argmax(logits[0, n_valid - 1], axis=-1)
+    return first, [(kc[0], vc[0]) for kc, vc in new]
+
+
+@dataclass
+class _Slot:
+    request_id: str
+    length: int              # tokens currently in the slot's cache
+    max_new: int             # emit exactly this many (or stop at eos)
+    eos_id: Optional[int]
+    emitted: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class GenerationEngine:
+    """Slot-based continuous batching over one model replica.
+
+    ``submit`` enqueues a request; ``step`` advances every active slot
+    one token and returns the (request_id, token) events produced this
+    step — token ``None`` marks completion (the serving layer streams
+    these out). ``run_to_completion`` drives the loop synchronously for
+    non-streaming callers.
+    """
+
+    def __init__(self, params, cfg: LlamaConfig, *, max_slots: int = 4,
+                 max_len: int = 512):
+        self.params = params
+        self.cfg = cfg
+        self.S = max_slots
+        self.total = max_len
+        self.cos, self.sin = rope_frequencies(cfg.head_dim, max_len,
+                                              cfg.rope_theta)
+        self.caches = [
+            (jnp.zeros((self.S, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       cfg.dtype),
+             jnp.zeros((self.S, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       cfg.dtype))
+            for _ in range(cfg.n_layers)
+        ]
+        self.slots: List[Optional[_Slot]] = [None] * self.S
+        self.last_tok = np.zeros(self.S, dtype=np.int32)
+        self.pending: List[tuple] = []
+        self._admit_events: List[tuple] = []
+        # one padded-prefill compilation per bucket, not per prompt len
+        self._prefill_buckets = (16, 64, 256)
+
+    # ------------------------------------------------------------ admit
+    def submit(self, request_id: str, prompt: List[int], *,
+               max_new_tokens: int = 32,
+               eos_id: Optional[int] = None) -> None:
+        if len(prompt) + max_new_tokens + 1 > self.total:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
+                f"exceeds engine max_len {self.total}")
+        self.pending.append((request_id, list(prompt), max_new_tokens,
+                             eos_id))
+
+    def _admit(self):
+        while self.pending and any(s is None for s in self.slots):
+            rid, prompt, max_new, eos_id = self.pending.pop(0)
+            idx = self.slots.index(None)
+            n = len(prompt)
+            pad = next((b for b in self._prefill_buckets if b >= n),
+                       self.total)
+            padded = jnp.asarray(
+                prompt + [0] * (pad - n), dtype=jnp.int32)
+            first, seq_caches = _prefill_one(
+                self.params, padded, n, self.total, self.cfg, self.cos,
+                self.sin, pad)
+            for li, (kc, vc) in enumerate(seq_caches):
+                bk, bv = self.caches[li]
+                self.caches[li] = (bk.at[idx].set(kc), bv.at[idx].set(vc))
+            slot = _Slot(rid, length=n, max_new=max_new, eos_id=eos_id)
+            tok = int(first)
+            slot.emitted.append(tok)
+            self.last_tok[idx] = tok
+            self._admit_events.append((rid, tok))
+            if (eos_id is not None and tok == eos_id) or \
+                    len(slot.emitted) >= max_new:
+                slot.done = True  # reaped by the next step()
+            self.slots[idx] = slot
+
+    # ------------------------------------------------------------- step
+    def step(self) -> List[tuple]:
+        """Admit pending, advance active slots one token. Returns the
+        (request_id, token) events emitted this step in order; a token
+        of ``None`` marks that request's completion."""
+        self._admit()
+        events: List[tuple] = list(self._admit_events)
+        self._admit_events = []
+        # reap slots finished at admit time (short max_new / instant eos)
+        for i, s in enumerate(self.slots):
+            if s is not None and s.done:
+                events.append((s.request_id, None))
+                self.slots[i] = None
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return events
+        lengths = np.array([self.slots[i].length if self.slots[i] else 0
+                            for i in range(self.S)], dtype=np.int32)
+        toks, self.caches = _step_all(
+            self.params, self.caches, jnp.asarray(self.last_tok),
+            jnp.asarray(lengths), self.cfg, self.cos, self.sin)
+        toks = np.asarray(toks)
+        for i in active:
+            s = self.slots[i]
+            tok = int(toks[i])
+            s.length += 1
+            s.emitted.append(tok)
+            self.last_tok[i] = tok
+            events.append((s.request_id, tok))
+            if (s.eos_id is not None and tok == s.eos_id) or \
+                    len(s.emitted) >= s.max_new:
+                s.done = True
+                events.append((s.request_id, None))
+                self.slots[i] = None
+        return events
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or any(s is not None
+                                         for s in self.slots)
+
+    def run_to_completion(self) -> Dict[str, List[int]]:
+        """Drive until every submitted request finishes; returns each
+        request's full token list."""
+        results: Dict[str, List[int]] = {}
+        acc: Dict[str, List[int]] = {}
+        while self.has_work():
+            for rid, tok in self.step():
+                if tok is None:
+                    results[rid] = acc.pop(rid, [])
+                else:
+                    acc.setdefault(rid, []).append(tok)
+        return results

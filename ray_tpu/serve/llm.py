@@ -1,0 +1,113 @@
+"""LLM serving: a deployment hosting the continuous-batching engine.
+
+The reference serves LLMs by embedding vLLM inside Serve deployments;
+the TPU-native equivalent pairs ``models/engine.py``'s slot-based
+continuous batching with an ordinary Serve deployment: unary calls get
+the full token list, streaming calls get tokens as the engine emits
+them, and concurrent requests share every decode step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Dict, Optional
+
+# NB: `serve.deployment` the attribute shadows the submodule; import
+# the decorator from the module itself.
+from .deployment import deployment as _deployment
+
+
+class LLMServer:
+    """Serve callable hosting one :class:`GenerationEngine`.
+
+    Construct via ``build_llm_app`` (which wraps it in a deployment) or
+    directly inside ``@serve.deployment`` with a params/config factory —
+    the factory runs replica-side, so weights never ride the deploy RPC.
+    Requests: ``{"prompt": [token ids], "max_new_tokens": n,
+    "eos_id": optional, "stream": bool}``.
+    """
+
+    def __init__(self, model_factory, *, max_slots: int = 4,
+                 max_len: int = 512):
+        from ray_tpu.models.engine import GenerationEngine
+
+        params, cfg = model_factory()
+        self.engine = GenerationEngine(params, cfg, max_slots=max_slots,
+                                       max_len=max_len)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._loop_task: Optional[asyncio.Task] = None
+
+    # ----------------------------------------------------- engine pump
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._engine_loop())
+
+    async def _engine_loop(self):
+        loop = asyncio.get_running_loop()
+        while self.engine.has_work():
+            # The jitted step is device-bound; run it off the event loop
+            # so health checks / new submissions stay responsive.
+            events = await loop.run_in_executor(None, self.engine.step)
+            for rid, tok in events:
+                q = self._queues.get(rid)
+                if q is not None:
+                    q.put_nowait(tok)
+            await asyncio.sleep(0)
+
+    def _submit(self, body: dict) -> str:
+        rid = uuid.uuid4().hex
+        self._queues[rid] = asyncio.Queue()
+        self.engine.submit(rid, [int(t) for t in body["prompt"]],
+                           max_new_tokens=int(
+                               body.get("max_new_tokens", 32)),
+                           eos_id=body.get("eos_id"))
+        self._ensure_loop()
+        return rid
+
+    @staticmethod
+    def _body(request: Any) -> dict:
+        if isinstance(request, dict):
+            return request
+        if hasattr(request, "json"):
+            return request.json()
+        raise TypeError(f"unsupported request: {type(request)}")
+
+    # ------------------------------------------------------- handlers
+    async def __call__(self, request: Any):
+        body = self._body(request)
+        if body.get("stream"):
+            return self._stream(body)
+        rid = self._submit(body)
+        q = self._queues[rid]
+        toks = []
+        try:
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    break
+                toks.append(tok)
+        finally:
+            self._queues.pop(rid, None)
+        return {"tokens": toks, "num_tokens": len(toks)}
+
+    async def _stream(self, body: dict):
+        rid = self._submit(body)
+        q = self._queues[rid]
+        try:
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    return
+                yield tok
+        finally:
+            self._queues.pop(rid, None)
+
+
+def build_llm_app(model_factory, *, max_slots: int = 4,
+                  max_len: int = 512, num_replicas: int = 1):
+    """Bind an LLM serving app (reference shape: ``serve.llm``
+    builders): ``serve.run(build_llm_app(factory))``."""
+    dep = _deployment(LLMServer, num_replicas=num_replicas)
+    return dep.bind(model_factory, max_slots=max_slots, max_len=max_len)
