@@ -68,6 +68,13 @@ def test_example_serve():
 
 
 @pytest.mark.slow
+def test_example_llm_serving():
+    p = _run("09_llm_serving.py", timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "streamed:" in p.stdout and "speculative:" in p.stdout
+
+
+@pytest.mark.slow
 def test_example_rl():
     p = _run("06_rl_ppo.py", timeout=600)
     assert p.returncode == 0, p.stderr[-2000:]
