@@ -57,15 +57,13 @@ def main():
 
     # Speculative decoding: a draft model proposes, the target verifies —
     # output is EXACTLY the target's greedy decode, just fewer target
-    # forward passes.
+    # forward passes. The demo drafts with the target itself (perfect
+    # acceptance); in practice the draft is a distilled smaller model
+    # whose acceptance rate sets the speedup.
     params, cfg = tiny_model()
-    draft_cfg = LlamaConfig(vocab_size=256, d_model=32, n_layers=1,
-                            n_heads=2, n_kv_heads=1, d_ff=64,
-                            max_seq_len=256, dtype=jnp.float32)
-    draft = init_params(draft_cfg, jax.random.PRNGKey(1))
     prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
-    toks, stats = generate_speculative(params, draft, prompt, cfg,
-                                       draft_cfg, max_new=16, k=4)
+    toks, stats = generate_speculative(params, params, prompt, cfg,
+                                       cfg, max_new=16, k=4)
     print("speculative:", toks[0].tolist())
     print(f"  acceptance={stats['acceptance_rate']:.2f} "
           f"tokens/target-forward={stats['tokens_per_target_forward']:.2f}")
