@@ -146,6 +146,32 @@ def main():
     print(f"int8 batch {champ_batch}: {int8_row['decode_tok_s']} tok/s",
           file=sys.stderr)
 
+    # Continuous batching: S concurrent requests sharing every decode
+    # step (models/engine.py) — the serving-throughput shape, measured
+    # as aggregate tokens/s across staggered requests.
+    from ray_tpu.models.engine import GenerationEngine
+
+    eng_slots = 8
+    eng = GenerationEngine(params, cfg, max_slots=eng_slots,
+                           max_len=prompt_len + max_new + 8)
+    rng = np.random.default_rng(0)
+    for r in range(eng_slots):
+        eng.submit(f"r{r}", rng.integers(
+            0, cfg.vocab_size, prompt_len).tolist(),
+            max_new_tokens=max_new)
+    # warmup: one step compiles prefill + step_all
+    eng.step()
+    t0 = time.perf_counter()
+    produced = 0
+    while eng.has_work():
+        produced += sum(1 for _, tok in eng.step() if tok is not None)
+    edt = time.perf_counter() - t0
+    engine_row = {"slots": eng_slots, "agg_decode_tok_s":
+                  round(produced / edt, 1),
+                  "requests": eng_slots, "max_new": max_new}
+    print(f"engine x{eng_slots}: {engine_row['agg_decode_tok_s']} "
+          f"aggregate tok/s", file=sys.stderr)
+
     # Prefill: compute-bound forward over 2k context, batch 1.
     import functools
 
@@ -176,6 +202,7 @@ def main():
             "champion_batch": champ["batch"],
             "batch_sweep": rows,
             "int8_weight_only": int8_row,
+            "continuous_batching": engine_row,
             "prefill_tok_s_b1_2k": round(prefill_tok_s, 1),
             "prefill_mfu": round(prefill_mfu, 4),
             "device": str(dev),
