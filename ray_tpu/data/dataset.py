@@ -1051,9 +1051,23 @@ class Dataset:
 
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List["DataIterator"]:
-        """Per-worker streaming shards (reference: ``dataset.py:1390``)."""
+        """Per-worker streaming shards (reference: ``dataset.py:1390``).
+
+        ``equal=True`` balances ROW counts exactly (materializing block
+        boundaries, like the reference's equal-split repartition); the
+        default splits by round-robin over blocks and stays fully lazy.
+        """
         from .iterator import DataIterator
 
+        if equal:
+            total = self.count()
+            per = total // n
+            # drop the remainder so every shard sees the same row count
+            # (the reference's equal=True contract for SPMD ingest)
+            cuts = [per * i for i in builtins.range(1, n)]
+            shards = self.limit(per * n).split_at_indices(cuts) if per \
+                else self.split(n)
+            return [DataIterator(ds) for ds in shards]
         return [DataIterator(ds) for ds in self.split(n)]
 
     def iterator(self) -> "DataIterator":

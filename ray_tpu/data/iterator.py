@@ -101,6 +101,27 @@ class DataIterator:
                           else jax.device_put(v))
             yield out
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes: Optional[Dict[str, Any]] = None,
+                           drop_last: bool = False,
+                           **kw) -> Iterator[Dict[str, Any]]:
+        """Torch-tensor batches (reference:
+        ``DataIterator.iter_torch_batches``)."""
+        import torch
+
+        from .dataset import _tensorable
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last, **kw):
+            out = {}
+            for k, v in batch.items():
+                arr = _tensorable(v)
+                if dtypes and k in dtypes:
+                    arr = arr.astype(dtypes[k])
+                out[k] = torch.as_tensor(arr)
+            yield out
+
     def materialize(self):
         return self._dataset.materialize()
 

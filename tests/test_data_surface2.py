@@ -274,3 +274,25 @@ def test_lineage_rejects_partial_wrapped_refs(ray_cluster):
     assert not ds.has_serializable_lineage()
     with pytest.raises(ValueError):
         ds.serialize_lineage()
+
+
+def test_streaming_split_equal(ray_cluster):
+    ds = rd.range(103, parallelism=5)  # ragged blocks
+    its = ds.streaming_split(4, equal=True)
+    counts = [sum(len(b["id"]) for b in it.iter_batches(batch_size=32))
+              for it in its]
+    assert counts == [25, 25, 25, 25]  # 103 -> 100, remainder dropped
+    # default stays lazy block-round-robin: all rows, possibly uneven
+    lazy = ds.streaming_split(4)
+    total = sum(sum(len(b["id"]) for b in it.iter_batches(batch_size=32))
+                for it in lazy)
+    assert total == 103
+
+
+def test_iterator_torch_batches(ray_cluster):
+    import torch
+
+    it = rd.range(10).iterator()
+    batches = list(it.iter_torch_batches(batch_size=4))
+    assert isinstance(batches[0]["id"], torch.Tensor)
+    assert sum(len(b["id"]) for b in batches) == 10
