@@ -1720,6 +1720,47 @@ class Dataset:
                         info.size = len(payload)
                         tar.addfile(info, io.BytesIO(payload))
 
+    # Gated externals: these integrations need packages this image does
+    # not ship; the reference raises the same ImportError at call time
+    # in an env without them, so the surface + failure mode match.
+
+    def _require(self, pkg: str, api: str):
+        try:
+            __import__(pkg)
+        except ImportError as e:
+            raise ImportError(
+                f"{pkg} is not installed in this image; install "
+                f"`{pkg}` to use {api}") from e
+        return __import__(pkg)
+
+    def iter_tf_batches(self, **kw):
+        """TF-tensor batches (reference: ``Dataset.iter_tf_batches``;
+        requires tensorflow)."""
+        tf = self._require("tensorflow", "iter_tf_batches")
+        for batch in self.iter_batches(batch_format="numpy", **kw):
+            yield {k: tf.convert_to_tensor(_tensorable(v))
+                   for k, v in batch.items()}
+
+    def to_tf(self, feature_columns, label_columns, **kw):
+        """``tf.data.Dataset`` view (reference: ``Dataset.to_tf``;
+        requires tensorflow)."""
+        self._require("tensorflow", "to_tf")
+        raise NotImplementedError(
+            "to_tf requires tensorflow feature-signature inference; "
+            "iter_tf_batches covers the ingest path")
+
+    def to_dask(self):
+        self._require("dask", "to_dask")
+
+    def to_modin(self):
+        self._require("modin", "to_modin")
+
+    def to_mars(self):
+        self._require("mars", "to_mars")
+
+    def to_spark(self, spark):
+        self._require("pyspark", "to_spark")
+
     def copy(self) -> "Dataset":
         """Independent handle over the same plan (stats/actor-pool state
         not shared)."""

@@ -296,3 +296,17 @@ def test_iterator_torch_batches(ray_cluster):
     batches = list(it.iter_torch_batches(batch_size=4))
     assert isinstance(batches[0]["id"], torch.Tensor)
     assert sum(len(b["id"]) for b in batches) == 10
+
+
+def test_gated_external_integrations(ray_cluster):
+    ds = rd.range(4)
+    for api, call in [
+        ("tensorflow", lambda: list(ds.iter_tf_batches(batch_size=2))),
+        ("tensorflow", lambda: ds.to_tf(["id"], ["id"])),
+        ("dask", ds.to_dask),
+        ("modin", ds.to_modin),
+        ("mars", ds.to_mars),
+        ("pyspark", lambda: ds.to_spark(None)),
+    ]:
+        with pytest.raises(ImportError, match=api):
+            call()
