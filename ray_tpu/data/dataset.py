@@ -1741,13 +1741,38 @@ class Dataset:
             yield {k: tf.convert_to_tensor(_tensorable(v))
                    for k, v in batch.items()}
 
-    def to_tf(self, feature_columns, label_columns, **kw):
-        """``tf.data.Dataset`` view (reference: ``Dataset.to_tf``;
-        requires tensorflow)."""
-        self._require("tensorflow", "to_tf")
-        raise NotImplementedError(
-            "to_tf requires tensorflow feature-signature inference; "
-            "iter_tf_batches covers the ingest path")
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 256, **kw):
+        """``tf.data.Dataset`` of (features, labels) batches (reference:
+        ``Dataset.to_tf``). Single column names yield bare tensors;
+        lists yield dicts, matching the reference's signature rules."""
+        tf = self._require("tensorflow", "to_tf")
+
+        def norm(cols):
+            return [cols] if isinstance(cols, str) else list(cols)
+
+        fc, lc = norm(feature_columns), norm(label_columns)
+        sample = self.take_batch(max(batch_size, 1))
+
+        def spec_of(cols):
+            specs = {
+                c: tf.TensorSpec(
+                    shape=(None,) + _tensorable(sample[c]).shape[1:],
+                    dtype=tf.as_dtype(_tensorable(sample[c]).dtype))
+                for c in cols}
+            return specs[cols[0]] if len(cols) == 1 else specs
+
+        def pick(batch, cols):
+            vals = {c: _tensorable(batch[c]) for c in cols}
+            return vals[cols[0]] if len(cols) == 1 else vals
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy"):
+                yield pick(batch, fc), pick(batch, lc)
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(spec_of(fc), spec_of(lc)))
 
     def to_dask(self):
         self._require("dask", "to_dask")

@@ -301,8 +301,6 @@ def test_iterator_torch_batches(ray_cluster):
 def test_gated_external_integrations(ray_cluster):
     ds = rd.range(4)
     for api, call in [
-        ("tensorflow", lambda: list(ds.iter_tf_batches(batch_size=2))),
-        ("tensorflow", lambda: ds.to_tf(["id"], ["id"])),
         ("dask", ds.to_dask),
         ("modin", ds.to_modin),
         ("mars", ds.to_mars),
@@ -310,3 +308,21 @@ def test_gated_external_integrations(ray_cluster):
     ]:
         with pytest.raises(ImportError, match=api):
             call()
+
+
+def test_tf_interop(ray_cluster):
+    # tensorflow ships in this image: the tf ingest paths run for real.
+    ds = rd.from_items([{"x": float(i), "y": i % 2} for i in range(20)])
+    batches = list(ds.iter_tf_batches(batch_size=10))
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (10,)
+
+    tfds = ds.to_tf("x", "y", batch_size=5)
+    feats, labels = next(iter(tfds))
+    assert feats.shape == (5,) and labels.shape == (5,)
+    total = sum(int(f.shape[0]) for f, _ in tfds)
+    assert total == 20
+
+    multi = ds.to_tf(["x", "y"], "y", batch_size=10)
+    f, l = next(iter(multi))
+    assert set(f.keys()) == {"x", "y"}
