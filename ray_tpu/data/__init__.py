@@ -25,6 +25,7 @@ from .read_api import (
     from_numpy_refs,
     from_pandas,
     from_pandas_refs,
+    from_tf,
     from_torch,
     range,
     read_avro,
@@ -55,7 +56,7 @@ __all__ = [
     "Datasource", "read_datasource", "read_sql", "read_tfrecords",
     "read_delta", "read_iceberg", "read_mongo", "read_avro",
     "read_parquet_bulk", "from_blocks", "from_arrow_refs",
-    "from_pandas_refs", "from_numpy_refs", "from_torch",
+    "from_pandas_refs", "from_numpy_refs", "from_torch", "from_tf",
     "RandomAccessDataset",
     "DataContext", "BackpressurePolicy", "ConcurrencyCapPolicy",
     "MemoryBudgetPolicy",

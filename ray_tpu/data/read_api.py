@@ -642,3 +642,18 @@ def range_tensor(n: int, *, shape: tuple = (1,),
         return {"data": col}
 
     return range(n, parallelism=parallelism).map_batches(to_tensor)
+
+
+def from_tf(tf_dataset) -> Dataset:
+    """A ``tf.data.Dataset`` materialized into a distributed dataset
+    (reference: ``ray.data.from_tf`` — the reference also materializes;
+    streaming TF pipelines should feed ``from_items`` incrementally)."""
+    rows = []
+    for item in tf_dataset.as_numpy_iterator():
+        if isinstance(item, dict):
+            rows.append(item)
+        elif isinstance(item, tuple):
+            rows.append({f"item_{i}": v for i, v in enumerate(item)})
+        else:
+            rows.append({"item": item})
+    return from_items(rows)

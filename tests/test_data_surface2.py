@@ -326,3 +326,13 @@ def test_tf_interop(ray_cluster):
     multi = ds.to_tf(["x", "y"], "y", batch_size=10)
     f, l = next(iter(multi))
     assert set(f.keys()) == {"x", "y"}
+
+
+def test_from_tf(ray_cluster):
+    import tensorflow as tf
+
+    tfds = tf.data.Dataset.from_tensor_slices(
+        {"a": [1.0, 2.0, 3.0], "b": [10, 20, 30]})
+    ds = rd.from_tf(tfds)
+    got = sorted(ds.take_all(), key=lambda r: r["b"])
+    assert [r["b"] for r in got] == [10, 20, 30]
