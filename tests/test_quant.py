@@ -74,3 +74,39 @@ def test_bytes_halved(small):
     assert quant_b < dense_b * 0.75
     ql = quantize_params(params)["layers"][0]["wq"]
     assert isinstance(ql, Q8)
+
+
+def test_quant_composes_with_speculative(small):
+    """int8 target + speculative decode: output equals the int8 model's
+    own greedy decode (quantization changes the model, not the
+    speculative machinery)."""
+    from ray_tpu.models import generate_greedy
+    from ray_tpu.models.speculative import generate_speculative
+
+    cfg, params = small
+    qparams = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (1, 5), 0,
+                                cfg.vocab_size)
+    ref = generate_greedy(qparams, prompt, cfg, max_new=12)
+    out, stats = generate_speculative(qparams, qparams, prompt, cfg, cfg,
+                                      max_new=12, k=3)
+    assert out.tolist() == ref.tolist()
+    assert stats["acceptance_rate"] == 1.0
+
+
+def test_quant_composes_with_engine(small):
+    """int8 params drive the continuous-batching engine unchanged."""
+    from ray_tpu.models import generate_greedy
+    from ray_tpu.models.engine import GenerationEngine
+
+    cfg, params = small
+    qparams = quantize_params(params)
+    eng = GenerationEngine(qparams, cfg, max_slots=2, max_len=48)
+    eng.submit("a", [3, 4, 5], max_new_tokens=8)
+    eng.submit("b", [9, 8], max_new_tokens=6)
+    got = eng.run_to_completion()
+    for rid, prompt, n in (("a", [3, 4, 5], 8), ("b", [9, 8], 6)):
+        ref = generate_greedy(
+            qparams, jnp.asarray(prompt, jnp.int32)[None, :], cfg,
+            max_new=n)[0].tolist()
+        assert got[rid] == ref, rid
