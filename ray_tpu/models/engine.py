@@ -36,15 +36,42 @@ def _single_step(params, caches, tok, length, cfg, cos, sin):
     return logits[0, -1], out
 
 
+def _pick_token(logits, temp, top_k, top_p, key):
+    """Per-slot sampling: temp<=0 is greedy; otherwise temperature +
+    top-k + nucleus (top-p) over one [V] logit row. k/p are traced, so
+    masks come from one descending sort instead of static-k top_k."""
+    greedy = jnp.argmax(logits)
+    order = jnp.argsort(-logits)                 # descending
+    ranks = jnp.argsort(order)                   # rank of each token
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    sorted_probs = jax.nn.softmax(scaled[order])
+    cum = jnp.cumsum(sorted_probs)
+    k_mask = jnp.where(top_k > 0, ranks < top_k, True)
+    # nucleus: keep tokens whose PRECEDING cumulative mass < p (always
+    # keeps the top token)
+    p_mask = (cum - sorted_probs)[ranks] < top_p
+    masked = jnp.where(k_mask & p_mask, scaled, -1e30)
+    sampled = jax.random.categorical(key, masked)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def _step_all(params, caches, toks, lengths, cfg, cos, sin):
+def _step_all(params, caches, toks, lengths, temps, top_ks, top_ps,
+              keys, cfg, cos, sin):
     """Vmapped engine step: every slot advances one token at its own
-    position. caches: per-layer (k [S,total,h,d], v [S,total,h,d])."""
+    position with its own sampling params. caches: per-layer
+    (k [S,total,h,d], v [S,total,h,d])."""
     fn = jax.vmap(
         lambda c, t, l: _single_step(params, c, t, l, cfg, cos, sin),
         in_axes=(0, 0, 0))
     logits, new_caches = fn(caches, toks, lengths)
-    return jnp.argmax(logits, axis=-1), new_caches
+    splits = jax.vmap(jax.random.split)(keys)     # [S, 2, 2]
+    toks_out = jax.vmap(_pick_token)(logits, temps, top_ks, top_ps,
+                                     splits[:, 1])
+    return toks_out, new_caches, splits[:, 0]
+
+
+_pick_one = jax.jit(_pick_token)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "total", "pad_len"))
@@ -63,8 +90,7 @@ def _prefill_one(params, prompt_padded, n_valid, total, cfg, cos, sin,
     b_caches = [(kc[None], vc[None]) for kc, vc in caches]
     logits, new = _decode_step(params, prompt_padded[None], b_caches, 0,
                                cfg, cos, sin)
-    first = jnp.argmax(logits[0, n_valid - 1], axis=-1)
-    return first, [(kc[0], vc[0]) for kc, vc in new]
+    return logits[0, n_valid - 1], [(kc[0], vc[0]) for kc, vc in new]
 
 
 @dataclass
@@ -104,6 +130,11 @@ class GenerationEngine:
         ]
         self.slots: List[Optional[_Slot]] = [None] * self.S
         self.last_tok = np.zeros(self.S, dtype=np.int32)
+        self.temps = np.zeros(self.S, dtype=np.float32)   # 0 = greedy
+        self.top_ks = np.zeros(self.S, dtype=np.int32)    # 0 = off
+        self.top_ps = np.ones(self.S, dtype=np.float32)
+        self.keys = np.stack([np.asarray(jax.random.PRNGKey(i))
+                              for i in range(self.S)])
         self.pending: List[tuple] = []
         self._admit_events: List[tuple] = []
         # one padded-prefill compilation per bucket, not per prompt len
@@ -111,27 +142,43 @@ class GenerationEngine:
 
     # ------------------------------------------------------------ admit
     def submit(self, request_id: str, prompt: List[int], *,
-               max_new_tokens: int = 32,
-               eos_id: Optional[int] = None) -> None:
+               max_new_tokens: int = 32, eos_id: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None) -> None:
+        """``temperature=0`` (default) is greedy; otherwise temperature
+        sampling with optional top-k and nucleus top-p, deterministic
+        per ``seed``."""
         if len(prompt) + max_new_tokens + 1 > self.total:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
                 f"exceeds engine max_len {self.total}")
         self.pending.append((request_id, list(prompt), max_new_tokens,
-                             eos_id))
+                             eos_id, float(temperature), int(top_k),
+                             float(top_p), seed))
 
     def _admit(self):
         while self.pending and any(s is None for s in self.slots):
-            rid, prompt, max_new, eos_id = self.pending.pop(0)
+            (rid, prompt, max_new, eos_id, temp, top_k, top_p,
+             seed) = self.pending.pop(0)
             idx = self.slots.index(None)
+            self.temps[idx] = temp
+            self.top_ks[idx] = top_k
+            self.top_ps[idx] = top_p
+            if seed is not None:
+                self.keys[idx] = np.asarray(jax.random.PRNGKey(seed))
             n = len(prompt)
             pad = next((b for b in self._prefill_buckets if b >= n),
                        self.total)
             padded = jnp.asarray(
                 prompt + [0] * (pad - n), dtype=jnp.int32)
-            first, seq_caches = _prefill_one(
+            first_logits, seq_caches = _prefill_one(
                 self.params, padded, n, self.total, self.cfg, self.cos,
                 self.sin, pad)
+            key = jnp.asarray(self.keys[idx], dtype=jnp.uint32)
+            key, sub = jax.random.split(key)
+            self.keys[idx] = np.array(key)
+            first = _pick_one(first_logits, jnp.float32(temp),
+                              jnp.int32(top_k), jnp.float32(top_p), sub)
             for li, (kc, vc) in enumerate(seq_caches):
                 bk, bv = self.caches[li]
                 self.caches[li] = (bk.at[idx].set(kc), bv.at[idx].set(vc))
@@ -163,10 +210,14 @@ class GenerationEngine:
             return events
         lengths = np.array([self.slots[i].length if self.slots[i] else 0
                             for i in range(self.S)], dtype=np.int32)
-        toks, self.caches = _step_all(
+        toks, self.caches, new_keys = _step_all(
             self.params, self.caches, jnp.asarray(self.last_tok),
-            jnp.asarray(lengths), self.cfg, self.cos, self.sin)
+            jnp.asarray(lengths), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+            jnp.asarray(self.keys, dtype=jnp.uint32), self.cfg,
+            self.cos, self.sin)
         toks = np.asarray(toks)
+        self.keys = np.array(new_keys)  # writable copy
         for i in active:
             s = self.slots[i]
             tok = int(toks[i])

@@ -62,7 +62,12 @@ class LLMServer:
         self.engine.submit(rid, [int(t) for t in body["prompt"]],
                            max_new_tokens=int(
                                body.get("max_new_tokens", 32)),
-                           eos_id=body.get("eos_id"))
+                           eos_id=body.get("eos_id"),
+                           temperature=float(
+                               body.get("temperature", 0.0)),
+                           top_k=int(body.get("top_k", 0)),
+                           top_p=float(body.get("top_p", 1.0)),
+                           seed=body.get("seed"))
         self._ensure_loop()
         return rid
 

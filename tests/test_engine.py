@@ -57,3 +57,48 @@ def test_capacity_guard(model):
     eng = GenerationEngine(params, cfg, max_slots=1, max_len=32)
     with pytest.raises(ValueError, match="exceeds engine max_len"):
         eng.submit("big", list(range(20)), max_new_tokens=20)
+
+
+def test_sampling_deterministic_and_bounded(model):
+    cfg, params = model
+    eng = GenerationEngine(params, cfg, max_slots=2, max_len=64)
+    eng.submit("s1", [1, 2, 3], max_new_tokens=10, temperature=0.8,
+               top_k=10, seed=42)
+    eng.submit("greedy", [1, 2, 3], max_new_tokens=10)  # temp 0
+    got = eng.run_to_completion()
+    # greedy slot unchanged by its sampled neighbor
+    assert got["greedy"] == _ref(params, cfg, [1, 2, 3], 10)
+    assert len(got["s1"]) == 10
+    # same seed -> same sample; different seed -> (almost surely) differs
+    eng2 = GenerationEngine(params, cfg, max_slots=1, max_len=64)
+    eng2.submit("s1", [1, 2, 3], max_new_tokens=10, temperature=0.8,
+                top_k=10, seed=42)
+    assert eng2.run_to_completion()["s1"] == got["s1"]
+    eng3 = GenerationEngine(params, cfg, max_slots=1, max_len=64)
+    eng3.submit("s1", [1, 2, 3], max_new_tokens=10, temperature=0.8,
+                top_k=10, seed=7)
+    assert eng3.run_to_completion()["s1"] != got["s1"]
+
+
+def test_top_p_and_top_k_masks(model):
+    cfg, params = model
+    import numpy as np
+
+    from ray_tpu.models.engine import _pick_token
+
+    logits = jnp.asarray([0.0, 10.0, 9.0, -5.0, 8.0])
+    # top_k=1 at any temperature is argmax
+    for seed in range(5):
+        t = _pick_token(logits, jnp.float32(1.0), jnp.int32(1),
+                        jnp.float32(1.0), jax.random.PRNGKey(seed))
+        assert int(t) == 1
+    # tiny top_p keeps only the top token
+    for seed in range(5):
+        t = _pick_token(logits, jnp.float32(5.0), jnp.int32(0),
+                        jnp.float32(1e-6), jax.random.PRNGKey(seed))
+        assert int(t) == 1
+    # top_k=3 never samples outside {1, 2, 4}
+    seen = {int(_pick_token(logits, jnp.float32(5.0), jnp.int32(3),
+                            jnp.float32(1.0), jax.random.PRNGKey(s)))
+            for s in range(30)}
+    assert seen <= {1, 2, 4} and len(seen) > 1

@@ -77,3 +77,14 @@ def test_http_llm_endpoint(llm_app):
                       timeout=120)
     assert r.status_code == 200
     assert r.json()["tokens"] == _ref([1, 2, 3], 4)
+
+
+def test_sampled_request(llm_app):
+    a = llm_app.remote({"prompt": [1, 2, 3], "max_new_tokens": 8,
+                        "temperature": 0.9, "top_k": 20,
+                        "seed": 5}).result(timeout=120)
+    b = llm_app.remote({"prompt": [1, 2, 3], "max_new_tokens": 8,
+                        "temperature": 0.9, "top_k": 20,
+                        "seed": 5}).result(timeout=120)
+    assert a["tokens"] == b["tokens"]  # seeded sampling is reproducible
+    assert len(a["tokens"]) == 8
