@@ -1,0 +1,314 @@
+"""Paged KV cache: on-demand block allocation for the generation engine.
+
+The vLLM memory model, TPU-shaped: instead of one preallocated
+``[S, max_len]`` cache per slot (paying worst-case length for every
+request), K/V live in a shared page pool — ``[num_pages, page_size]``
+per layer — and each sequence holds a page table. Pages are allocated
+as a sequence actually grows and return to the free list when it
+finishes, so the pool admits far more concurrent sequences than a dense
+cache of the same bytes whenever lengths vary.
+
+Reads gather a sequence's pages (XLA batched gather — same bytes the
+dense cache reads); writes are one batched scatter at each slot's
+(page, offset). Decode math is otherwise identical to
+``llama._decode_step``, and the engine API mirrors
+``engine.GenerationEngine`` (parity-tested against it).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.layers import apply_rope, rms_norm, rope_frequencies
+from ..ops.quant import mm
+from .engine import _pick_token, _prefill_one
+from .llama import LlamaConfig, _mlp_block
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "page"))
+def _paged_step(params, pools_k, pools_v, tables, toks, lengths, temps,
+                top_ks, top_ps, keys, cfg, cos, sin, page):
+    """One token for every slot against the shared page pool.
+
+    pools_*: per-layer [num_pages, page, kvh, d]. tables: [S, P] page
+    ids per slot. Writes: one batched scatter per layer at each slot's
+    (page_of(length), length % page). Reads: gather each slot's pages
+    into its [P*page, kvh, d] view, mask by position.
+    """
+    S, P = tables.shape
+    cap = P * page
+    x = params["embedding"][toks].astype(cfg.dtype)[:, None, :]  # [S,1,D]
+    positions = lengths[:, None]
+    page_idx = jnp.take_along_axis(
+        tables, (lengths // page)[:, None], axis=1)[:, 0]  # [S]
+    offs = lengths % page
+    new_pools_k, new_pools_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = mm(h, layer["wq"]).reshape(S, 1, cfg.n_heads, cfg.head_dim)
+        k = mm(h, layer["wk"]).reshape(S, 1, cfg.n_kv_heads,
+                                       cfg.head_dim)
+        v = mm(h, layer["wv"]).reshape(S, 1, cfg.n_kv_heads,
+                                       cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        pool_k = pools_k[li].at[page_idx, offs].set(
+            k[:, 0].astype(pools_k[li].dtype))
+        pool_v = pools_v[li].at[page_idx, offs].set(
+            v[:, 0].astype(pools_v[li].dtype))
+        new_pools_k.append(pool_k)
+        new_pools_v.append(pool_v)
+        # gather each slot's pages -> [S, cap, kvh, d]
+        k_seq = pool_k[tables].reshape(S, cap, cfg.n_kv_heads,
+                                       cfg.head_dim)
+        v_seq = pool_v[tables].reshape(S, cap, cfg.n_kv_heads,
+                                       cfg.head_dim)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        s = jnp.einsum("sqhd,skhd->shqk", q.astype(jnp.float32),
+                       jnp.repeat(k_seq, rep, axis=2).astype(
+                           jnp.float32)) * (cfg.head_dim ** -0.5)
+        admit = (jnp.arange(cap)[None, :] <=
+                 lengths[:, None])  # keys <= query position
+        s = jnp.where(admit[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("shqk,skhd->sqhd", p.astype(v_seq.dtype),
+                       jnp.repeat(v_seq, rep, axis=2))
+        o = o.reshape(S, 1, cfg.n_heads * cfg.head_dim)
+        x = x + mm(o, layer["wo"])
+        x = x + _mlp_block(layer, x, cfg)
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    head = (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = mm(x[:, 0], head)                     # [S, V]
+    splits = jax.vmap(jax.random.split)(keys)
+    out = jax.vmap(_pick_token)(logits, temps, top_ks, top_ps,
+                                splits[:, 1])
+    return out, new_pools_k, new_pools_v, splits[:, 0]
+
+
+@dataclass
+class _PagedSlot:
+    request_id: str
+    length: int
+    max_new: int
+    eos_id: Optional[int]
+    prompt: List[int] = field(default_factory=list)   # original prompt
+    pages: List[int] = field(default_factory=list)
+    emitted: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class PagedEngine:
+    """``GenerationEngine`` semantics over a shared page pool.
+
+    ``num_pages * page_size`` total cache positions are shared by ALL
+    sequences; a request only ever holds ceil(current_len / page_size)
+    pages, so short requests don't pay for long ones. Admission waits
+    for pages, not for a worst-case slot.
+    """
+
+    def __init__(self, params, cfg: LlamaConfig, *, max_slots: int = 8,
+                 num_pages: int = 64, page_size: int = 16,
+                 max_len: int = 512):
+        self.params = params
+        self.cfg = cfg
+        self.S = max_slots
+        self.page = page_size
+        self.num_pages = num_pages
+        self.P = max_len // page_size           # table width per slot
+        self.max_len = self.P * page_size
+        self.cos, self.sin = rope_frequencies(cfg.head_dim, self.max_len,
+                                              cfg.rope_theta)
+        shape = (num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        self.pools_k = [jnp.zeros(shape, cfg.dtype)
+                        for _ in range(cfg.n_layers)]
+        self.pools_v = [jnp.zeros(shape, cfg.dtype)
+                        for _ in range(cfg.n_layers)]
+        # Page 0 is a reserved scratch page: INACTIVE slots still flow
+        # through the jitted step (static shapes) and their writes land
+        # at tables[i,0]=0 / offset 0 — which must never be a page a
+        # live sequence owns. Table padding also points at it; reads
+        # beyond a sequence's length are position-masked regardless.
+        self.free_pages = list(range(1, num_pages))
+        self.tables = np.zeros((self.S, self.P), dtype=np.int32)
+        self.slots: List[Optional[_PagedSlot]] = [None] * self.S
+        self.last_tok = np.zeros(self.S, dtype=np.int32)
+        self.temps = np.zeros(self.S, dtype=np.float32)
+        self.top_ks = np.zeros(self.S, dtype=np.int32)
+        self.top_ps = np.ones(self.S, dtype=np.float32)
+        self.keys = np.stack([np.asarray(jax.random.PRNGKey(i))
+                              for i in range(self.S)])
+        self.pending: List[tuple] = []
+        self._admit_events: List[tuple] = []
+        self._prefill_buckets = (16, 64, 256)
+
+    # ---------------------------------------------------------- pages
+    def _pages_needed(self, length: int) -> int:
+        return -(-length // self.page)
+
+    def _free(self, slot: _PagedSlot):
+        self.free_pages.extend(slot.pages)
+        slot.pages = []
+
+    # ---------------------------------------------------------- admit
+    def submit(self, request_id: str, prompt: List[int], *,
+               max_new_tokens: int = 32, eos_id: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None) -> None:
+        if len(prompt) + max_new_tokens + 1 > self.max_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
+                f"exceeds per-sequence capacity {self.max_len}")
+        if self._pages_needed(len(prompt) + max_new_tokens + 1) > \
+                self.num_pages - 1:
+            raise ValueError(
+                "request needs more pages than the pool holds; grow "
+                "num_pages or shrink the request")
+        self.pending.append((request_id, list(prompt), max_new_tokens,
+                             eos_id, float(temperature), int(top_k),
+                             float(top_p), seed, None))
+
+    def _admit(self):
+        while self.pending and any(s is None for s in self.slots):
+            head = self.pending[0]
+            prompt = head[1]
+            need = self._pages_needed(len(prompt) + 1)
+            if need > len(self.free_pages):
+                return  # wait for pages, preserve FIFO order
+            (rid, prompt, max_new, eos_id, temp, top_k, top_p,
+             seed, key_state) = self.pending.pop(0)
+            idx = self.slots.index(None)
+            self.temps[idx] = temp
+            self.top_ks[idx] = top_k
+            self.top_ps[idx] = top_p
+            if key_state is not None:   # resuming a preempted request
+                self.keys[idx] = np.array(key_state)
+            elif seed is not None:
+                self.keys[idx] = np.array(jax.random.PRNGKey(seed))
+            n = len(prompt)
+            pad = next((b for b in self._prefill_buckets if b >= n),
+                       self.max_len)
+            padded = jnp.asarray(prompt + [0] * (pad - n),
+                                 dtype=jnp.int32)
+            first_logits, seq_caches = _prefill_one(
+                self.params, padded, n, self.max_len, self.cfg,
+                self.cos, self.sin, pad)
+            slot = _PagedSlot(rid, length=n, max_new=max_new,
+                              eos_id=eos_id, prompt=list(prompt))
+            slot.pages = [self.free_pages.pop()
+                          for _ in range(self._pages_needed(n + 1))]
+            self.tables[idx] = 0
+            self.tables[idx, :len(slot.pages)] = slot.pages
+            # scatter the dense prefill K/V into this slot's pages
+            for li, (kc, vc) in enumerate(seq_caches):
+                pk, pv = self.pools_k[li], self.pools_v[li]
+                for pi, pg in enumerate(slot.pages):
+                    lo = pi * self.page
+                    pk = pk.at[pg].set(kc[lo:lo + self.page])
+                    pv = pv.at[pg].set(vc[lo:lo + self.page])
+                self.pools_k[li], self.pools_v[li] = pk, pv
+            key = jnp.asarray(self.keys[idx], dtype=jnp.uint32)
+            key, sub = jax.random.split(key)
+            self.keys[idx] = np.array(key)
+            from .engine import _pick_one
+
+            tok = int(_pick_one(first_logits, jnp.float32(temp),
+                                jnp.int32(top_k), jnp.float32(top_p),
+                                sub))
+            slot.emitted.append(tok)
+            self.last_tok[idx] = tok
+            self._admit_events.append((rid, tok))
+            if (eos_id is not None and tok == eos_id) or \
+                    len(slot.emitted) >= max_new:
+                slot.done = True
+            self.slots[idx] = slot
+
+    # ----------------------------------------------------------- step
+    def step(self) -> List[tuple]:
+        self._admit()
+        events: List[tuple] = list(self._admit_events)
+        self._admit_events = []
+        for i, s in enumerate(self.slots):
+            if s is not None and s.done:
+                events.append((s.request_id, None))
+                self._free(s)
+                self.slots[i] = None
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return events
+        # Grow page tables BEFORE the step for slots crossing a page
+        # boundary (the write this step lands at position `length`).
+        for i in active:
+            s = self.slots[i]
+            if s.length % self.page == 0 and \
+                    self._pages_needed(s.length + 1) > len(s.pages):
+                if not self.free_pages:
+                    # Pool exhausted mid-flight: PREEMPT by recompute
+                    # (vLLM's recompute policy) — free this sequence's
+                    # pages and requeue it with prompt+emitted as the
+                    # new prompt; re-prefill resumes it exactly where
+                    # it paused once pages free up. Already-streamed
+                    # tokens are not re-emitted: the resumed request's
+                    # budget is what remains.
+                    remaining = s.max_new - len(s.emitted)
+                    self.pending.insert(0, (
+                        s.request_id, s.prompt + s.emitted, remaining,
+                        s.eos_id, float(self.temps[i]),
+                        int(self.top_ks[i]), float(self.top_ps[i]),
+                        None, np.array(self.keys[i])))
+                    self._free(s)
+                    self.slots[i] = None
+                    continue
+                pg = self.free_pages.pop()
+                s.pages.append(pg)
+                self.tables[i, len(s.pages) - 1] = pg
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return events
+        lengths = np.array([self.slots[i].length if self.slots[i]
+                            else 0 for i in range(self.S)],
+                           dtype=np.int32)
+        toks, self.pools_k, self.pools_v, new_keys = _paged_step(
+            self.params, self.pools_k, self.pools_v,
+            jnp.asarray(self.tables), jnp.asarray(self.last_tok),
+            jnp.asarray(lengths), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+            jnp.asarray(self.keys, dtype=jnp.uint32), self.cfg,
+            self.cos, self.sin, self.page)
+        toks = np.asarray(toks)
+        self.keys = np.array(new_keys)
+        for i in active:
+            s = self.slots[i]
+            tok = int(toks[i])
+            s.length += 1
+            s.emitted.append(tok)
+            self.last_tok[i] = tok
+            events.append((s.request_id, tok))
+            if (s.eos_id is not None and tok == s.eos_id) or \
+                    len(s.emitted) >= s.max_new:
+                s.done = True
+                events.append((s.request_id, None))
+                self._free(s)
+                self.slots[i] = None
+        return events
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or any(s is not None
+                                         for s in self.slots)
+
+    def run_to_completion(self) -> Dict[str, List[int]]:
+        results: Dict[str, List[int]] = {}
+        acc: Dict[str, List[int]] = {}
+        while self.has_work():
+            for rid, tok in self.step():
+                if tok is None:
+                    results[rid] = acc.pop(rid, [])
+                else:
+                    acc.setdefault(rid, []).append(tok)
+        return results
