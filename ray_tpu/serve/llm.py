@@ -29,12 +29,25 @@ class LLMServer:
     """
 
     def __init__(self, model_factory, *, max_slots: int = 4,
-                 max_len: int = 512):
-        from ray_tpu.models.engine import GenerationEngine
-
+                 max_len: int = 512, kv_cache: str = "dense",
+                 num_pages: int = 64, page_size: int = 16):
         params, cfg = model_factory()
-        self.engine = GenerationEngine(params, cfg, max_slots=max_slots,
-                                       max_len=max_len)
+        if kv_cache == "paged":
+            from ray_tpu.models.paged import PagedEngine
+
+            self.engine = PagedEngine(params, cfg, max_slots=max_slots,
+                                      num_pages=num_pages,
+                                      page_size=page_size,
+                                      max_len=max_len)
+        elif kv_cache == "dense":
+            from ray_tpu.models.engine import GenerationEngine
+
+            self.engine = GenerationEngine(params, cfg,
+                                           max_slots=max_slots,
+                                           max_len=max_len)
+        else:
+            raise ValueError(f"kv_cache must be 'dense' or 'paged', "
+                             f"got {kv_cache!r}")
         self._queues: Dict[str, asyncio.Queue] = {}
         self._loop_task: Optional[asyncio.Task] = None
 
@@ -111,8 +124,13 @@ class LLMServer:
 
 
 def build_llm_app(model_factory, *, max_slots: int = 4,
-                  max_len: int = 512, num_replicas: int = 1):
+                  max_len: int = 512, num_replicas: int = 1,
+                  kv_cache: str = "dense", num_pages: int = 64,
+                  page_size: int = 16):
     """Bind an LLM serving app (reference shape: ``serve.llm``
-    builders): ``serve.run(build_llm_app(factory))``."""
+    builders): ``serve.run(build_llm_app(factory))``. ``kv_cache=
+    "paged"`` swaps in the shared-page-pool engine (models/paged.py)."""
     dep = _deployment(LLMServer, num_replicas=num_replicas)
-    return dep.bind(model_factory, max_slots=max_slots, max_len=max_len)
+    return dep.bind(model_factory, max_slots=max_slots, max_len=max_len,
+                    kv_cache=kv_cache, num_pages=num_pages,
+                    page_size=page_size)

@@ -88,3 +88,15 @@ def test_sampled_request(llm_app):
                         "seed": 5}).result(timeout=120)
     assert a["tokens"] == b["tokens"]  # seeded sampling is reproducible
     assert len(a["tokens"]) == 8
+
+
+def test_paged_llm_app(llm_app):
+    from ray_tpu.serve.llm import build_llm_app
+
+    handle = serve.run(build_llm_app(tiny_model, max_slots=4,
+                                     kv_cache="paged", num_pages=24,
+                                     page_size=8, max_len=96),
+                       name="llm-paged", route_prefix=None)
+    got = handle.remote({"prompt": [2, 3, 4],
+                         "max_new_tokens": 9}).result(timeout=120)
+    assert got["tokens"] == _ref([2, 3, 4], 9)
