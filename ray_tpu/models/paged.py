@@ -92,6 +92,26 @@ def _paged_step(params, pools_k, pools_v, tables, toks, lengths, temps,
     return out, new_pools_k, new_pools_v, splits[:, 0]
 
 
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "total", "pad_len"))
+def _suffix_prefill(params, prefix_caches, suffix_padded, prefix_len,
+                    n_valid_total, total, cfg, cos, sin, pad_len):
+    """Prefill only the NON-cached suffix of a prompt: the dense
+    single-sequence cache arrives pre-seeded with the shared prefix
+    K/V (gathered from cached pages); suffix tokens run from position
+    ``prefix_len``. Returns next-token logits at the prompt end plus
+    the full dense cache (prefix + suffix) for page scatter."""
+    from .llama import _decode_step
+
+    b_caches = [(kc[None], vc[None]) for kc, vc in prefix_caches]
+    logits, new = _decode_step(params, suffix_padded[None], b_caches,
+                               prefix_len, cfg, cos, sin)
+    first = logits[0, n_valid_total - prefix_len - 1]
+    return first, [(kc[0], vc[0]) for kc, vc in new]
+
+
 @dataclass
 class _PagedSlot:
     request_id: str
@@ -100,6 +120,7 @@ class _PagedSlot:
     eos_id: Optional[int]
     prompt: List[int] = field(default_factory=list)   # original prompt
     pages: List[int] = field(default_factory=list)
+    n_shared: int = 0        # leading pages borrowed from the prefix cache
     emitted: List[int] = field(default_factory=list)
     done: bool = False
 
@@ -115,7 +136,7 @@ class PagedEngine:
 
     def __init__(self, params, cfg: LlamaConfig, *, max_slots: int = 8,
                  num_pages: int = 64, page_size: int = 16,
-                 max_len: int = 512):
+                 max_len: int = 512, enable_prefix_cache: bool = False):
         self.params = params
         self.cfg = cfg
         self.S = max_slots
@@ -147,14 +168,52 @@ class PagedEngine:
         self.pending: List[tuple] = []
         self._admit_events: List[tuple] = []
         self._prefill_buckets = (16, 64, 256)
+        # Prefix cache: full-prompt-page content hash -> (page id,
+        # refcount). Pages with refcount 0 stay resident (reusable)
+        # until pool pressure evicts them LRU (``_reclaim``).
+        self.enable_prefix_cache = enable_prefix_cache
+        self._prefix: Dict[tuple, list] = {}   # key -> [page, refs]
+        self._prefix_lru: List[tuple] = []     # keys, oldest first
+        self.prefix_hits = 0
+        self.prefix_misses = 0
 
     # ---------------------------------------------------------- pages
     def _pages_needed(self, length: int) -> int:
         return -(-length // self.page)
 
     def _free(self, slot: _PagedSlot):
-        self.free_pages.extend(slot.pages)
+        for i, pg in enumerate(slot.pages):
+            if i < slot.n_shared:
+                self._decref(pg)
+            else:
+                self.free_pages.append(pg)
         slot.pages = []
+        slot.n_shared = 0
+
+    def _decref(self, page: int):
+        for entry in self._prefix.values():
+            if entry[0] == page:
+                entry[1] -= 1
+                return
+        self.free_pages.append(page)  # cache entry was evicted
+
+    def _reclaim(self, need: int) -> None:
+        """Evict LRU unreferenced prefix pages until ``need`` are free."""
+        while len(self.free_pages) < need and self._prefix_lru:
+            for key in list(self._prefix_lru):
+                entry = self._prefix.get(key)
+                if entry is not None and entry[1] == 0:
+                    self._prefix.pop(key)
+                    self._prefix_lru.remove(key)
+                    self.free_pages.append(entry[0])
+                    break
+            else:
+                return  # everything referenced; nothing to evict
+
+    def _available_pages(self) -> int:
+        return len(self.free_pages) + sum(
+            1 for k in self._prefix_lru
+            if self._prefix.get(k, [0, 1])[1] == 0)
 
     # ---------------------------------------------------------- admit
     def submit(self, request_id: str, prompt: List[int], *,
@@ -174,11 +233,46 @@ class PagedEngine:
                              eos_id, float(temperature), int(top_k),
                              float(top_p), seed, None))
 
+    def _cached_prefix_pages(self, prompt: List[int]) -> List[int]:
+        """Longest run of already-cached FULL prompt pages (never the
+        whole prompt: at least one suffix token must run to produce the
+        next-token logits)."""
+        if not self.enable_prefix_cache:
+            return []
+        n = len(prompt)
+        j_max = min(n // self.page, (n - 1) // self.page)
+        pages: List[int] = []
+        for j in range(1, j_max + 1):
+            entry = self._prefix.get(tuple(prompt[:j * self.page]))
+            if entry is None:
+                break
+            pages.append(entry[0])
+        return pages
+
+    def _register_prefix_pages(self, slot: _PagedSlot):
+        """Put every full prompt page (borrowed or fresh) in the prefix
+        cache and pin them via the slot's refcounts."""
+        n = len(slot.prompt)
+        j_max = min(n // self.page, (n - 1) // self.page)
+        for j in range(1, j_max + 1):
+            key = tuple(slot.prompt[:j * self.page])
+            entry = self._prefix.get(key)
+            if entry is None:
+                self._prefix[key] = [slot.pages[j - 1], 1]
+                self._prefix_lru.append(key)
+            else:
+                entry[1] += 1
+                self._prefix_lru.remove(key)
+                self._prefix_lru.append(key)  # LRU refresh
+        slot.n_shared = j_max
+
     def _admit(self):
         while self.pending and any(s is None for s in self.slots):
             head = self.pending[0]
             prompt = head[1]
-            need = self._pages_needed(len(prompt) + 1)
+            shared = self._cached_prefix_pages(prompt)
+            need = self._pages_needed(len(prompt) + 1) - len(shared)
+            self._reclaim(need)
             if need > len(self.free_pages):
                 return  # wait for pages, preserve FIFO order
             (rid, prompt, max_new, eos_id, temp, top_k, top_p,
@@ -192,27 +286,56 @@ class PagedEngine:
             elif seed is not None:
                 self.keys[idx] = np.array(jax.random.PRNGKey(seed))
             n = len(prompt)
-            pad = next((b for b in self._prefill_buckets if b >= n),
-                       self.max_len)
-            padded = jnp.asarray(prompt + [0] * (pad - n),
-                                 dtype=jnp.int32)
-            first_logits, seq_caches = _prefill_one(
-                self.params, padded, n, self.max_len, self.cfg,
-                self.cos, self.sin, pad)
             slot = _PagedSlot(rid, length=n, max_new=max_new,
                               eos_id=eos_id, prompt=list(prompt))
-            slot.pages = [self.free_pages.pop()
-                          for _ in range(self._pages_needed(n + 1))]
+            own = [self.free_pages.pop() for _ in range(need)]
+            slot.pages = list(shared) + own
+            L0 = len(shared) * self.page       # cached prefix length
+            if shared:
+                self.prefix_hits += 1
+            elif self.enable_prefix_cache:
+                self.prefix_misses += 1
+            suffix = prompt[L0:]
+            pad = next((b for b in self._prefill_buckets
+                        if b >= len(suffix)), self.max_len)
+            padded = jnp.asarray(suffix + [0] * (pad - len(suffix)),
+                                 dtype=jnp.int32)
+            if shared:
+                # Seed a dense cache with the shared prefix K/V, then
+                # run ONLY the suffix — the compute the cache saves.
+                tbl = jnp.asarray(shared, dtype=jnp.int32)
+                prefix_caches = []
+                zpad = self.max_len - L0
+                for li in range(self.cfg.n_layers):
+                    pk = self.pools_k[li][tbl].reshape(
+                        L0, self.cfg.n_kv_heads, self.cfg.head_dim)
+                    pv = self.pools_v[li][tbl].reshape(
+                        L0, self.cfg.n_kv_heads, self.cfg.head_dim)
+                    z = jnp.zeros((zpad,) + pk.shape[1:], pk.dtype)
+                    prefix_caches.append(
+                        (jnp.concatenate([pk, z]),
+                         jnp.concatenate([pv, z])))
+                first_logits, seq_caches = _suffix_prefill(
+                    self.params, prefix_caches, padded,
+                    jnp.int32(L0), jnp.int32(n), self.max_len,
+                    self.cfg, self.cos, self.sin, pad)
+            else:
+                first_logits, seq_caches = _prefill_one(
+                    self.params, padded, n, self.max_len, self.cfg,
+                    self.cos, self.sin, pad)
             self.tables[idx] = 0
             self.tables[idx, :len(slot.pages)] = slot.pages
-            # scatter the dense prefill K/V into this slot's pages
+            # scatter the computed K/V into the slot's OWN pages only
+            # (shared prefix pages already hold their content)
             for li, (kc, vc) in enumerate(seq_caches):
                 pk, pv = self.pools_k[li], self.pools_v[li]
-                for pi, pg in enumerate(slot.pages):
+                for pi in range(len(shared), len(slot.pages)):
                     lo = pi * self.page
+                    pg = slot.pages[pi]
                     pk = pk.at[pg].set(kc[lo:lo + self.page])
                     pv = pv.at[pg].set(vc[lo:lo + self.page])
                 self.pools_k[li], self.pools_v[li] = pk, pv
+            self._register_prefix_pages(slot)
             key = jnp.asarray(self.keys[idx], dtype=jnp.uint32)
             key, sub = jax.random.split(key)
             self.keys[idx] = np.array(key)
@@ -239,6 +362,7 @@ class PagedEngine:
                 events.append((s.request_id, None))
                 self._free(s)
                 self.slots[i] = None
+                self.tables[i] = 0  # inactive lane writes -> scratch
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return events
@@ -248,6 +372,8 @@ class PagedEngine:
             s = self.slots[i]
             if s.length % self.page == 0 and \
                     self._pages_needed(s.length + 1) > len(s.pages):
+                if not self.free_pages:
+                    self._reclaim(1)  # evict idle prefix pages first
                 if not self.free_pages:
                     # Pool exhausted mid-flight: PREEMPT by recompute
                     # (vLLM's recompute policy) — free this sequence's
@@ -264,6 +390,7 @@ class PagedEngine:
                         None, np.array(self.keys[i])))
                     self._free(s)
                     self.slots[i] = None
+                    self.tables[i] = 0
                     continue
                 pg = self.free_pages.pop()
                 s.pages.append(pg)
@@ -296,6 +423,7 @@ class PagedEngine:
                 events.append((s.request_id, None))
                 self._free(s)
                 self.slots[i] = None
+                self.tables[i] = 0
         return events
 
     def has_work(self) -> bool:
