@@ -396,3 +396,19 @@ __all__ += [
     "cpp_function", "java_function", "java_actor_class", "client",
     "ClientBuilder", "ClientContext", "autoscaler",
 ]
+
+
+def exit_actor():
+    """Gracefully exit the current actor after the in-flight call
+    completes (reference: ``ray.actor.exit_actor``): the caller of THIS
+    method receives ``None``; later calls observe the actor's death."""
+    ctx = get_runtime_context()
+    if ctx.get_actor_id() is None:
+        raise RuntimeError(
+            "exit_actor() can only be called inside an actor method")
+    from ray_tpu._private.serialization import ActorExitSignal
+
+    raise ActorExitSignal()
+
+
+__all__ += ["exit_actor"]

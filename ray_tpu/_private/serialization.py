@@ -240,6 +240,12 @@ class WorkerCrashedError(Exception):
     """The worker process executing the task died unexpectedly."""
 
 
+class ActorExitSignal(BaseException):
+    """Raised by ``ray_tpu.exit_actor()``: the current call completes
+    with ``None`` and the actor process exits after the reply drains
+    (reference: ``ray.actor.exit_actor`` semantics)."""
+
+
 class ActorDiedError(Exception):
     """The actor is dead (crashed, killed, or out of restarts)."""
 

@@ -682,6 +682,12 @@ class Executor:
                 results = await loop.run_in_executor(
                     self.pool, self._execute_method_sync, method, msg, tid,
                     nret)
+        except serialization.ActorExitSignal:
+            # exit_actor(): the call completes normally, then the
+            # process leaves once the reply has drained.
+            results = self._pack_results(
+                tid, self._split_returns(None, nret), True)
+            self._exit_requested = True
         except BaseException as e:  # noqa: BLE001
             results = self._actor_error_results(msg, tid, nret, e)
             ok = False
@@ -690,6 +696,7 @@ class Executor:
         self.record_event(tid, method_name, "actor_call", t0, time.time(), ok)
         if not conn.closed:
             conn.reply(msg, {"results": results})
+        self._maybe_exit_after_reply()
 
     async def _run_stream_call(self, conn: protocol.Connection, msg: dict):
         loop = asyncio.get_running_loop()
@@ -784,6 +791,10 @@ class Executor:
                 try:
                     results = self._execute_method_sync(
                         method, msg, tid, nret)
+                except serialization.ActorExitSignal:
+                    results = self._pack_results(
+                        tid, self._split_returns(None, nret), True)
+                    self._exit_requested = True
                 except BaseException as e:  # noqa: BLE001
                     ok = False
                     try:
@@ -801,6 +812,15 @@ class Executor:
             except RuntimeError:
                 pass  # loop closed (shutdown)
 
+    def _maybe_exit_after_reply(self):
+        if getattr(self, "_exit_requested", False):
+            import os as _os
+
+            # Give the just-written completion a beat to drain, then
+            # leave; callers of FUTURE methods observe ActorDiedError.
+            self.worker.loop.call_later(0.2, _os._exit, 0)
+            self._exit_requested = False
+
     def _deliver_sync_batch(self, batch):
         for conn, msg, results, ok, t0, t1 in batch:
             for r in results:
@@ -813,6 +833,7 @@ class Executor:
                     pass
         # Cleared HERE (loop thread): a call that arrived while the pump
         # was finishing restarts it rather than stranding.
+        self._maybe_exit_after_reply()
         self._sync_pump_running = False
         if self._sync_calls:
             self._sync_pump_running = True

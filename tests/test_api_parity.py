@@ -100,3 +100,31 @@ def test_cpp_function_reexport():
 
 def test_autoscaler_namespace():
     assert hasattr(ray_tpu.autoscaler, "__path__")
+
+
+def test_exit_actor(ray_cluster):
+    import time
+
+    @ray_tpu.remote
+    class Quitter:
+        def ping(self):
+            return "alive"
+
+        def leave(self):
+            ray_tpu.exit_actor()
+            return "unreachable"  # never runs
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.ping.remote()) == "alive"
+    # the exiting call itself completes with None
+    assert ray_tpu.get(q.leave.remote(), timeout=30) is None
+    # later calls observe the death
+    time.sleep(0.5)
+    with pytest.raises((ray_tpu.ActorDiedError,
+                        ray_tpu.WorkerCrashedError, ray_tpu.TaskError)):
+        ray_tpu.get(q.ping.remote(), timeout=30)
+
+
+def test_exit_actor_outside_actor(ray_cluster):
+    with pytest.raises(RuntimeError, match="inside an actor"):
+        ray_tpu.exit_actor()
