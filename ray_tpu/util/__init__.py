@@ -28,6 +28,10 @@ def __getattr__(name):
         from .actor_pool import ActorPool as _AP
 
         return _AP
+    if name == "accelerators":
+        from . import accelerators as _acc
+
+        return _acc
     if name == "inspect_serializability":
         from .check_serialize import inspect_serializability as _is
 

@@ -1,0 +1,65 @@
+"""Accelerator-type constants + TPU pod helpers.
+
+Reference: ``python/ray/util/accelerators/`` — the string constants are
+the public spec (used as ``accelerator_type=`` scheduling labels); the
+TPU pod helpers delegate to the framework's TPU topology manager
+(``ray_tpu/accelerators/tpu.py``), which reads the TPU-VM environment.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+NVIDIA_TESLA_V100 = "V100"
+NVIDIA_TESLA_P100 = "P100"
+NVIDIA_TESLA_T4 = "T4"
+NVIDIA_TESLA_P4 = "P4"
+NVIDIA_TESLA_K80 = "K80"
+NVIDIA_TESLA_A10G = "A10G"
+NVIDIA_L4 = "L4"
+NVIDIA_L40S = "L40S"
+NVIDIA_A100 = "A100"
+NVIDIA_H100 = "H100"
+NVIDIA_A100_40G = "A100-40G"
+NVIDIA_A100_80G = "A100-80G"
+INTEL_MAX_1550 = "Intel-GPU-Max-1550"
+INTEL_MAX_1100 = "Intel-GPU-Max-1100"
+INTEL_GAUDI = "Intel-GAUDI"
+AMD_INSTINCT_MI100 = "AMD-Instinct-MI100"
+AMD_INSTINCT_MI250x = "AMD-Instinct-MI250X"
+AMD_INSTINCT_MI250 = "AMD-Instinct-MI250X-MI250"
+AMD_INSTINCT_MI210 = "AMD-Instinct-MI210"
+AMD_INSTINCT_MI300x = "AMD-Instinct-MI300X-OAM"
+AWS_NEURON_CORE = "aws-neuron-core"
+GOOGLE_TPU_V2 = "TPU-V2"
+GOOGLE_TPU_V3 = "TPU-V3"
+GOOGLE_TPU_V4 = "TPU-V4"
+GOOGLE_TPU_V5P = "TPU-V5P"
+GOOGLE_TPU_V5LITEPOD = "TPU-V5LITEPOD"
+GOOGLE_TPU_V6E = "TPU-V6E"
+
+
+def get_current_pod_name() -> Optional[str]:
+    """Name of the TPU pod this worker belongs to (reference:
+    ``ray.util.accelerators.tpu.get_current_pod_name``)."""
+    return os.environ.get("TPU_NAME") or None
+
+
+def get_current_pod_worker_count() -> Optional[int]:
+    """Workers in this TPU pod (reference:
+    ``tpu.get_current_pod_worker_count``)."""
+    from ray_tpu.accelerators.tpu import WORKER_HOSTNAMES_ENV
+
+    hosts = os.environ.get(WORKER_HOSTNAMES_ENV)
+    if hosts:
+        return len([h for h in hosts.split(",") if h])
+    return None
+
+
+def get_num_tpu_chips_on_node() -> int:
+    """Chips on this host (reference: ``tpu.get_num_tpu_chips_on_node``)."""
+    from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+    return int(
+        TPUAcceleratorManager().get_current_node_num_accelerators())

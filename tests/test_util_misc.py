@@ -45,3 +45,16 @@ def test_inspect_serializability_nested_object():
     # condition, not the Event wrapper.
     assert any("lock" in f.name or "lock" in type(f.obj).__name__
                for f in failures)
+
+
+def test_accelerator_constants(monkeypatch):
+    from ray_tpu.util import accelerators as acc
+
+    assert acc.GOOGLE_TPU_V5P == "TPU-V5P"
+    assert acc.NVIDIA_A100 == "A100"
+    monkeypatch.setenv("TPU_NAME", "pod-7")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+    assert acc.get_current_pod_name() == "pod-7"
+    assert acc.get_current_pod_worker_count() == 2
+    monkeypatch.delenv("TPU_NAME")
+    assert acc.get_current_pod_name() is None
