@@ -29,9 +29,9 @@ def __getattr__(name):
 
         return _AP
     if name == "accelerators":
-        from . import accelerators as _acc
+        import importlib
 
-        return _acc
+        return importlib.import_module(".accelerators", __name__)
     if name == "inspect_serializability":
         from .check_serialize import inspect_serializability as _is
 
