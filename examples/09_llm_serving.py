@@ -32,8 +32,12 @@ def tiny_model():
 
 def main():
     ray_tpu.init(num_cpus=4, probe_tpu=False)
+    # kv_cache="paged": K/V in a shared page pool with prefix caching —
+    # short requests stop paying for worst-case length.
     handle = serve.run(build_llm_app(tiny_model, max_slots=4,
-                                     max_len=128),
+                                     max_len=128, kv_cache="paged",
+                                     num_pages=48, page_size=8,
+                                     enable_prefix_cache=True),
                        name="llm", route_prefix="/generate")
 
     # Concurrent unary requests share every decode step (continuous
