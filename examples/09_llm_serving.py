@@ -41,8 +41,12 @@ def main():
                        name="llm", route_prefix="/generate")
 
     # Concurrent unary requests share every decode step (continuous
-    # batching): a long generation never blocks a short one.
-    futs = [handle.remote({"prompt": [1 + i, 2, 3],
+    # batching): a long generation never blocks a short one. The shared
+    # 8-token prefix (one full page) exercises the prefix cache: later
+    # requests borrow the first request's prefix pages and prefill only
+    # their suffix.
+    shared = [9, 8, 7, 6, 5, 4, 3, 2]
+    futs = [handle.remote({"prompt": shared + [1 + i],
                            "max_new_tokens": 8 + i * 4})
             for i in range(3)]
     for i, f in enumerate(futs):
