@@ -21,11 +21,12 @@ from .mixtral import (
     MixtralConfig,
     mixtral_shardings,
 )
+from .mixtral import generate_greedy as mixtral_generate_greedy
 
 __all__ = [
     "LlamaConfig", "LLAMA3_8B", "LLAMA3_1B", "LLAMA_DEBUG", "init_params",
     "forward", "loss_fn", "generate_greedy", "generate_sample", "flops_per_token",
     "mixtral", "MixtralConfig", "MIXTRAL_8X7B", "MIXTRAL_DEBUG",
     "generate_speculative", "GenerationEngine", "PagedEngine",
-    "mixtral_shardings",
+    "mixtral_shardings", "mixtral_generate_greedy",
 ]
