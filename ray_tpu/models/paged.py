@@ -31,9 +31,20 @@ from .engine import _pick_token, _prefill_one
 from .llama import LlamaConfig, _mlp_block
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "page"))
-def _paged_step(params, pools_k, pools_v, tables, toks, lengths, temps,
-                top_ks, top_ps, keys, cfg, cos, sin, page):
+def _quant_kv(vec):
+    """Per-head-vector symmetric int8: vec [..., d] -> (int8, scale)."""
+    amax = jnp.max(jnp.abs(vec.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(vec.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale[..., 0].astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "page", "kv_int8"))
+def _paged_step(params, pools_k, pools_v, scales_k, scales_v, tables,
+                toks, lengths, temps, top_ks, top_ps, keys, cfg, cos,
+                sin, page, kv_int8):
     """One token for every slot against the shared page pool.
 
     pools_*: per-layer [num_pages, page, kvh, d]. tables: [S, P] page
@@ -49,6 +60,8 @@ def _paged_step(params, pools_k, pools_v, tables, toks, lengths, temps,
         tables, (lengths // page)[:, None], axis=1)[:, 0]  # [S]
     offs = lengths % page
     new_pools_k, new_pools_v = [], []
+    new_scales_k, new_scales_v = ([], []) if kv_int8 else (scales_k,
+                                                           scales_v)
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = mm(h, layer["wq"]).reshape(S, 1, cfg.n_heads, cfg.head_dim)
@@ -58,17 +71,38 @@ def _paged_step(params, pools_k, pools_v, tables, toks, lengths, temps,
                                        cfg.head_dim)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        pool_k = pools_k[li].at[page_idx, offs].set(
-            k[:, 0].astype(pools_k[li].dtype))
-        pool_v = pools_v[li].at[page_idx, offs].set(
-            v[:, 0].astype(pools_v[li].dtype))
+        if kv_int8:
+            kq, ks = _quant_kv(k[:, 0])
+            vq, vs = _quant_kv(v[:, 0])
+            pool_k = pools_k[li].at[page_idx, offs].set(kq)
+            pool_v = pools_v[li].at[page_idx, offs].set(vq)
+            scale_k = scales_k[li].at[page_idx, offs].set(ks)
+            scale_v = scales_v[li].at[page_idx, offs].set(vs)
+            new_scales_k.append(scale_k)
+            new_scales_v.append(scale_v)
+            # gather + dequantize each slot's pages
+            k_seq = (pool_k[tables].reshape(S, cap, cfg.n_kv_heads,
+                                            cfg.head_dim)
+                     .astype(cfg.dtype)
+                     * scale_k[tables].reshape(
+                         S, cap, cfg.n_kv_heads, 1).astype(cfg.dtype))
+            v_seq = (pool_v[tables].reshape(S, cap, cfg.n_kv_heads,
+                                            cfg.head_dim)
+                     .astype(cfg.dtype)
+                     * scale_v[tables].reshape(
+                         S, cap, cfg.n_kv_heads, 1).astype(cfg.dtype))
+        else:
+            pool_k = pools_k[li].at[page_idx, offs].set(
+                k[:, 0].astype(pools_k[li].dtype))
+            pool_v = pools_v[li].at[page_idx, offs].set(
+                v[:, 0].astype(pools_v[li].dtype))
+            new_scales_k, new_scales_v = scales_k, scales_v
+            k_seq = pool_k[tables].reshape(S, cap, cfg.n_kv_heads,
+                                           cfg.head_dim)
+            v_seq = pool_v[tables].reshape(S, cap, cfg.n_kv_heads,
+                                           cfg.head_dim)
         new_pools_k.append(pool_k)
         new_pools_v.append(pool_v)
-        # gather each slot's pages -> [S, cap, kvh, d]
-        k_seq = pool_k[tables].reshape(S, cap, cfg.n_kv_heads,
-                                       cfg.head_dim)
-        v_seq = pool_v[tables].reshape(S, cap, cfg.n_kv_heads,
-                                       cfg.head_dim)
         rep = cfg.n_heads // cfg.n_kv_heads
         s = jnp.einsum("sqhd,skhd->shqk", q.astype(jnp.float32),
                        jnp.repeat(k_seq, rep, axis=2).astype(
@@ -89,7 +123,8 @@ def _paged_step(params, pools_k, pools_v, tables, toks, lengths, temps,
     splits = jax.vmap(jax.random.split)(keys)
     out = jax.vmap(_pick_token)(logits, temps, top_ks, top_ps,
                                 splits[:, 1])
-    return out, new_pools_k, new_pools_v, splits[:, 0]
+    return (out, new_pools_k, new_pools_v, new_scales_k, new_scales_v,
+            splits[:, 0])
 
 
 
@@ -136,7 +171,8 @@ class PagedEngine:
 
     def __init__(self, params, cfg: LlamaConfig, *, max_slots: int = 8,
                  num_pages: int = 64, page_size: int = 16,
-                 max_len: int = 512, enable_prefix_cache: bool = False):
+                 max_len: int = 512, enable_prefix_cache: bool = False,
+                 kv_dtype: str = "model"):
         self.params = params
         self.cfg = cfg
         self.S = max_slots
@@ -146,11 +182,26 @@ class PagedEngine:
         self.max_len = self.P * page_size
         self.cos, self.sin = rope_frequencies(cfg.head_dim, self.max_len,
                                               cfg.rope_theta)
+        if kv_dtype not in ("model", "int8"):
+            raise ValueError("kv_dtype must be 'model' or 'int8'")
+        # kv_dtype="int8": pages store per-head-vector-quantized K/V
+        # (half the bytes in bf16 deployments; the long-context memory
+        # lever). Dequantize happens in the gather; outputs are CLOSE
+        # to full precision, not bit-identical.
+        self.kv_int8 = kv_dtype == "int8"
         shape = (num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
-        self.pools_k = [jnp.zeros(shape, cfg.dtype)
+        pool_dt = jnp.int8 if self.kv_int8 else cfg.dtype
+        self.pools_k = [jnp.zeros(shape, pool_dt)
                         for _ in range(cfg.n_layers)]
-        self.pools_v = [jnp.zeros(shape, cfg.dtype)
+        self.pools_v = [jnp.zeros(shape, pool_dt)
                         for _ in range(cfg.n_layers)]
+        sshape = shape[:-1]
+        self.scales_k = [jnp.ones(sshape, jnp.float32)
+                         for _ in range(cfg.n_layers)] \
+            if self.kv_int8 else [None] * cfg.n_layers
+        self.scales_v = [jnp.ones(sshape, jnp.float32)
+                         for _ in range(cfg.n_layers)] \
+            if self.kv_int8 else [None] * cfg.n_layers
         # Page 0 is a reserved scratch page: INACTIVE slots still flow
         # through the jitted step (static shapes) and their writes land
         # at tables[i,0]=0 / offset 0 — which must never be a page a
@@ -311,6 +362,15 @@ class PagedEngine:
                         L0, self.cfg.n_kv_heads, self.cfg.head_dim)
                     pv = self.pools_v[li][tbl].reshape(
                         L0, self.cfg.n_kv_heads, self.cfg.head_dim)
+                    if self.kv_int8:  # dequantize borrowed pages
+                        pk = pk.astype(self.cfg.dtype) * \
+                            self.scales_k[li][tbl].reshape(
+                                L0, self.cfg.n_kv_heads, 1
+                            ).astype(self.cfg.dtype)
+                        pv = pv.astype(self.cfg.dtype) * \
+                            self.scales_v[li][tbl].reshape(
+                                L0, self.cfg.n_kv_heads, 1
+                            ).astype(self.cfg.dtype)
                     z = jnp.zeros((zpad,) + pk.shape[1:], pk.dtype)
                     prefix_caches.append(
                         (jnp.concatenate([pk, z]),
@@ -332,8 +392,20 @@ class PagedEngine:
                 for pi in range(len(shared), len(slot.pages)):
                     lo = pi * self.page
                     pg = slot.pages[pi]
-                    pk = pk.at[pg].set(kc[lo:lo + self.page])
-                    pv = pv.at[pg].set(vc[lo:lo + self.page])
+                    ks = kc[lo:lo + self.page]
+                    vs = vc[lo:lo + self.page]
+                    if self.kv_int8:
+                        kq, ksc = _quant_kv(ks)
+                        vq, vsc = _quant_kv(vs)
+                        pk = pk.at[pg].set(kq)
+                        pv = pv.at[pg].set(vq)
+                        self.scales_k[li] = \
+                            self.scales_k[li].at[pg].set(ksc)
+                        self.scales_v[li] = \
+                            self.scales_v[li].at[pg].set(vsc)
+                    else:
+                        pk = pk.at[pg].set(ks)
+                        pv = pv.at[pg].set(vs)
                 self.pools_k[li], self.pools_v[li] = pk, pv
             self._register_prefix_pages(slot)
             key = jnp.asarray(self.keys[idx], dtype=jnp.uint32)
@@ -401,13 +473,16 @@ class PagedEngine:
         lengths = np.array([self.slots[i].length if self.slots[i]
                             else 0 for i in range(self.S)],
                            dtype=np.int32)
-        toks, self.pools_k, self.pools_v, new_keys = _paged_step(
+        (toks, self.pools_k, self.pools_v, self.scales_k,
+         self.scales_v, new_keys) = _paged_step(
             self.params, self.pools_k, self.pools_v,
+            self.scales_k if self.kv_int8 else [0] * self.cfg.n_layers,
+            self.scales_v if self.kv_int8 else [0] * self.cfg.n_layers,
             jnp.asarray(self.tables), jnp.asarray(self.last_tok),
             jnp.asarray(lengths), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             jnp.asarray(self.keys, dtype=jnp.uint32), self.cfg,
-            self.cos, self.sin, self.page)
+            self.cos, self.sin, self.page, self.kv_int8)
         toks = np.asarray(toks)
         self.keys = np.array(new_keys)
         for i in active:

@@ -31,7 +31,8 @@ class LLMServer:
     def __init__(self, model_factory, *, max_slots: int = 4,
                  max_len: int = 512, kv_cache: str = "dense",
                  num_pages: int = 64, page_size: int = 16,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False,
+                 kv_dtype: str = "model"):
         params, cfg = model_factory()
         if kv_cache == "paged":
             from ray_tpu.models.paged import PagedEngine
@@ -41,7 +42,8 @@ class LLMServer:
                                       page_size=page_size,
                                       max_len=max_len,
                                       enable_prefix_cache=
-                                      enable_prefix_cache)
+                                      enable_prefix_cache,
+                                      kv_dtype=kv_dtype)
         elif kv_cache == "dense":
             from ray_tpu.models.engine import GenerationEngine
 
@@ -130,7 +132,8 @@ def build_llm_app(model_factory, *, max_slots: int = 4,
                   max_len: int = 512, num_replicas: int = 1,
                   kv_cache: str = "dense", num_pages: int = 64,
                   page_size: int = 16,
-                  enable_prefix_cache: bool = False):
+                  enable_prefix_cache: bool = False,
+                  kv_dtype: str = "model"):
     """Bind an LLM serving app (reference shape: ``serve.llm``
     builders): ``serve.run(build_llm_app(factory))``. ``kv_cache=
     "paged"`` swaps in the shared-page-pool engine (models/paged.py)."""
@@ -138,4 +141,5 @@ def build_llm_app(model_factory, *, max_slots: int = 4,
     return dep.bind(model_factory, max_slots=max_slots, max_len=max_len,
                     kv_cache=kv_cache, num_pages=num_pages,
                     page_size=page_size,
-                    enable_prefix_cache=enable_prefix_cache)
+                    enable_prefix_cache=enable_prefix_cache,
+                    kv_dtype=kv_dtype)
