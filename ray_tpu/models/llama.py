@@ -220,8 +220,15 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     return 6 * n_params + attn
 
 
-def _decode_step(params, tokens, caches, start, cfg: LlamaConfig, cos, sin):
-    """One cached forward over ``tokens`` beginning at position ``start``."""
+def _decode_step(params, tokens, caches, start, cfg: LlamaConfig, cos,
+                 sin, ffn=None):
+    """One cached forward over ``tokens`` beginning at position ``start``.
+
+    ``ffn(layer, x, cfg)`` swaps the feed-forward block — the hook the
+    MoE family (mixtral) uses to share this loop; default is the dense
+    SwiGLU MLP."""
+    if ffn is None:
+        ffn = _mlp_block
     x = params["embedding"][tokens].astype(cfg.dtype)
     positions = start + jnp.arange(tokens.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, tokens.shape)
@@ -231,7 +238,7 @@ def _decode_step(params, tokens, caches, start, cfg: LlamaConfig, cos, sin):
             layer, x, cos, sin, cfg, None,
             kv_cache=(kc, vc, start), positions=positions)
         x = x + a
-        x = x + _mlp_block(layer, x, cfg)
+        x = x + ffn(layer, x, cfg)
         new_caches.append((nc[0], nc[1]))
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     head = (params["embedding"].T if cfg.tie_embeddings
@@ -239,7 +246,7 @@ def _decode_step(params, tokens, caches, start, cfg: LlamaConfig, cos, sin):
     return mm(x, head), new_caches
 
 
-def _prefill(params, prompt, cfg: LlamaConfig, max_new: int):
+def _prefill(params, prompt, cfg: LlamaConfig, max_new: int, ffn=None):
     B, L = prompt.shape
     total = L + max_new
     caches = [
@@ -248,13 +255,17 @@ def _prefill(params, prompt, cfg: LlamaConfig, max_new: int):
         for _ in range(cfg.n_layers)
     ]
     cos, sin = rope_frequencies(cfg.head_dim, total, cfg.rope_theta)
-    logits, caches = _decode_step(params, prompt, caches, 0, cfg, cos, sin)
+    logits, caches = _decode_step(params, prompt, caches, 0, cfg, cos,
+                                  sin, ffn=ffn)
     return logits, caches, L, cos, sin
 
 
-def _generate(params, prompt, cfg: LlamaConfig, max_new: int, pick):
-    """Shared scan-based decode loop; ``pick(logits, key) -> tokens``."""
-    logits, caches, L, cos, sin = _prefill(params, prompt, cfg, max_new)
+def _generate(params, prompt, cfg: LlamaConfig, max_new: int, pick,
+              ffn=None):
+    """Shared scan-based decode loop; ``pick(logits, key) -> tokens``,
+    ``ffn`` as in ``_decode_step`` (the MoE family passes its router)."""
+    logits, caches, L, cos, sin = _prefill(params, prompt, cfg, max_new,
+                                           ffn=ffn)
     key0 = jax.random.PRNGKey(0)
     key0, sub = jax.random.split(key0)
     next_tok = pick(logits[:, -1], sub)
@@ -262,7 +273,7 @@ def _generate(params, prompt, cfg: LlamaConfig, max_new: int, pick):
     def scan_body(carry, _):
         caches, tok, pos, key = carry
         logits, caches = _decode_step(params, tok[:, None], caches, pos,
-                                      cfg, cos, sin)
+                                      cfg, cos, sin, ffn=ffn)
         key, sub = jax.random.split(key)
         nxt = pick(logits[:, -1], sub)
         return (caches, nxt, pos + 1, key), nxt

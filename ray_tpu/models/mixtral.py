@@ -149,56 +149,33 @@ def mixtral_shardings(params: Dict[str, Any], mesh) -> Dict[str, Any]:
     return sh
 
 
+def _moe_decode_ffn(layer, x, cfg: MixtralConfig):
+    """FFN hook for the shared llama decode loop: per-token expert
+    routing (mlp_norm lives here because llama's loop norms inside its
+    dense block)."""
+    from ..parallel.moe import moe_ffn_dense
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    y, _ = moe_ffn_dense(h, layer["router"], layer["experts"], cfg.top_k)
+    return y
+
+
 def _decode_step(params, tokens, caches, start, cfg: MixtralConfig,
                  cos, sin):
-    """One cached forward (llama's ``_decode_step`` with the MoE FFN)."""
-    from ..parallel.moe import moe_ffn_dense
-    from .llama import _attention_block
+    """One cached forward — llama's loop with the MoE FFN hook."""
+    from .llama import _decode_step as _llama_decode_step
 
-    x = params["embedding"][tokens].astype(cfg.dtype)
-    positions = start + jnp.arange(tokens.shape[1])[None, :]
-    positions = jnp.broadcast_to(positions, tokens.shape)
-    new_caches = []
-    for layer, (kc, vc) in zip(params["layers"], caches):
-        a, nc = _attention_block(
-            layer, x, cos, sin, cfg, None,
-            kv_cache=(kc, vc, start), positions=positions)
-        x = x + a
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        y, _ = moe_ffn_dense(h, layer["router"], layer["experts"],
-                             cfg.top_k)
-        x = x + y
-        new_caches.append((nc[0], nc[1]))
-    x = rms_norm(x, params["norm"], cfg.norm_eps)
-    head = (params["embedding"].T if cfg.tie_embeddings
-            else params["lm_head"])
-    return jnp.dot(x, head.astype(x.dtype)), new_caches
+    return _llama_decode_step(params, tokens, caches, start, cfg, cos,
+                              sin, ffn=_moe_decode_ffn)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new"))
 def generate_greedy(params, prompt: jax.Array, cfg: MixtralConfig,
                     max_new: int = 32) -> jax.Array:
-    """KV-cached greedy decode for the MoE family (mirrors
-    ``llama.generate_greedy``; routing runs per decoded token)."""
-    B, L = prompt.shape
-    total = L + max_new
-    caches = [
-        (jnp.zeros((B, total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype),
-         jnp.zeros((B, total, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
-        for _ in range(cfg.n_layers)
-    ]
-    cos, sin = rope_frequencies(cfg.head_dim, total, cfg.rope_theta)
-    logits, caches = _decode_step(params, prompt, caches, 0, cfg, cos,
-                                  sin)
-    next_tok = jnp.argmax(logits[:, -1], axis=-1)
+    """KV-cached greedy decode for the MoE family (the shared llama
+    ``_generate`` loop with per-token expert routing)."""
+    from .llama import _generate
 
-    def body(carry, _):
-        caches, tok, pos = carry
-        logits, caches = _decode_step(params, tok[:, None], caches, pos,
-                                      cfg, cos, sin)
-        nxt = jnp.argmax(logits[:, -1], axis=-1)
-        return (caches, nxt, pos + 1), nxt
-
-    (_, _, _), toks = jax.lax.scan(body, (caches, next_tok, L), None,
-                                   length=max_new - 1)
-    return jnp.concatenate([next_tok[:, None], toks.T], axis=1)
+    return _generate(params, prompt, cfg, max_new,
+                     lambda logits, key: jnp.argmax(logits, axis=-1),
+                     ffn=_moe_decode_ffn)

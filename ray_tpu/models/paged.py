@@ -96,7 +96,6 @@ def _paged_step(params, pools_k, pools_v, scales_k, scales_v, tables,
                 k[:, 0].astype(pools_k[li].dtype))
             pool_v = pools_v[li].at[page_idx, offs].set(
                 v[:, 0].astype(pools_v[li].dtype))
-            new_scales_k, new_scales_v = scales_k, scales_v
             k_seq = pool_k[tables].reshape(S, cap, cfg.n_kv_heads,
                                            cfg.head_dim)
             v_seq = pool_v[tables].reshape(S, cap, cfg.n_kv_heads,
@@ -473,8 +472,8 @@ class PagedEngine:
         lengths = np.array([self.slots[i].length if self.slots[i]
                             else 0 for i in range(self.S)],
                            dtype=np.int32)
-        (toks, self.pools_k, self.pools_v, self.scales_k,
-         self.scales_v, new_keys) = _paged_step(
+        (toks, self.pools_k, self.pools_v, sk, sv,
+         new_keys) = _paged_step(
             self.params, self.pools_k, self.pools_v,
             self.scales_k if self.kv_int8 else [0] * self.cfg.n_layers,
             self.scales_v if self.kv_int8 else [0] * self.cfg.n_layers,
@@ -483,6 +482,9 @@ class PagedEngine:
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             jnp.asarray(self.keys, dtype=jnp.uint32), self.cfg,
             self.cos, self.sin, self.page, self.kv_int8)
+        if self.kv_int8:
+            # model-dtype mode keeps scales stable at [None]*n_layers
+            self.scales_k, self.scales_v = sk, sv
         toks = np.asarray(toks)
         self.keys = np.array(new_keys)
         for i in active:
