@@ -1,20 +1,30 @@
-"""On-chip kernel microbench: Pallas flash attention vs XLA dense attention.
+"""On-chip kernel microbench + block autotune: Pallas flash vs XLA dense.
 
 Run (requires a free TPU chip; see bench.py's acquire logic for the probe):
 
     python benchmarks/tpu_kernels.py
 
-Measures forward attention TFLOP/s at several sequence lengths and writes a
-``records/tpu_kernels_<ts>.json`` evidence record (committed immediately,
-same convention as bench.py's ``_save_tpu_record``).
+Round-4 lesson (records/tpu_kernels_1785459793 era): a single-chain timing
+with one D2H fetch per measurement folds the tunnel's ~75 ms host round-trip
+into every row — at 1k the "kernel time" was ~95% tunnel RTT, which is why
+flash appeared to lose to dense at short L and cap at 12 TFLOP/s at 8k.
+Round-5 method fixes both the measurement and the kernel:
 
-Timing method: ``block_until_ready`` alone does NOT reliably fence on the
-tunneled axon platform (a first cut of this bench measured 28 PFLOP/s on a
-197 TFLOP/s chip — pure dispatch overhead). Each measurement therefore runs
-``ITERS`` kernel calls inside one jitted ``lax.scan`` whose carry feeds the
-next call's query tensor (forcing sequential execution, defeating CSE), and
-the wall time is taken around a scalar host fetch of the final carry — one
-D2H round-trip per measurement, not per iteration.
+1. **Slope timing**: each op is timed as two jitted ``lax.scan`` chains of
+   N_LO and N_HI data-dependent calls (one D2H fetch each); per-call time is
+   the slope ``(T_hi - T_lo) / (N_hi - N_lo)``, which cancels the constant
+   per-measurement RTT exactly. The implied RTT is recorded per row as a
+   sanity check.
+2. **Block autotune**: Mosaic's default BlockSizes are 128/128/128 at every
+   L; the sweep times candidate (block_q, block_k_major, block_k) triples
+   (single-chain raw ranking — RTT is a shared constant at fixed L, so it
+   cannot change the argmin), picks the per-L winner, and writes it to
+   ``records/flash_autotune.json`` (committed), which
+   ``ray_tpu/ops/attention.py`` loads for all production flash calls.
+
+The sweep is time-boxed (the round-4 window lasted ~11 minutes) and runs in
+evidence-priority order: 2k sweep, 8k sweep, final slope-timed table at all
+four L, 1k/4k quick sweeps if time remains.
 
 Reference analog: the reference's fused-attention GPU benchmarks live in its
 release suites; on TPU the comparison that matters is Pallas kernel vs the
@@ -34,7 +44,13 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-ITERS = 10
+N_LO, N_HI = 4, 20
+BUDGET_S = float(os.environ.get("KERNEL_BENCH_BUDGET_S", "480"))
+_T0 = time.monotonic()
+
+
+def _left() -> float:
+    return BUDGET_S - (time.monotonic() - _T0)
 
 
 def _chained(attn_fn, iters: int):
@@ -58,8 +74,8 @@ def _chained(attn_fn, iters: int):
     return run
 
 
-def _bench(run, q, k, v, repeats: int = 5) -> float:
-    """Median wall seconds per kernel call (scan of ITERS, one D2H sync)."""
+def _time_once(run, q, k, v, repeats: int) -> float:
+    """Median wall seconds for one full chain (compile excluded)."""
     import numpy as np
 
     float(np.asarray(run(q, k, v)))  # compile + warm
@@ -67,8 +83,75 @@ def _bench(run, q, k, v, repeats: int = 5) -> float:
     for _ in range(repeats):
         t0 = time.perf_counter()
         float(np.asarray(run(q, k, v)))
-        times.append((time.perf_counter() - t0) / ITERS)
+        times.append(time.perf_counter() - t0)
     return statistics.median(times)
+
+
+def _slope_time(attn_fn, q, k, v, repeats: int = 3):
+    """(per_call_s | None, implied_rtt_s) via two chain lengths.
+
+    A non-positive slope means RTT jitter swamped the kernel time (short-L
+    hazard); rather than clamping — which once turned noise into a committed
+    28 PFLOP/s record — retry with more repeats, then report the row invalid
+    (per_call None) so no TFLOP/s figure is derived from it.
+    """
+    run_lo, run_hi = _chained(attn_fn, N_LO), _chained(attn_fn, N_HI)
+    for attempt_repeats in (repeats, repeats * 3):
+        t_lo = _time_once(run_lo, q, k, v, attempt_repeats)
+        t_hi = _time_once(run_hi, q, k, v, attempt_repeats)
+        slope = (t_hi - t_lo) / (N_HI - N_LO)
+        if slope > 0:
+            return slope, max(t_lo - N_LO * slope, 0.0)
+    return None, t_lo
+
+
+def _mosaic_fn(block_q, block_k_major, block_k, causal=True):
+    """[B,L,H,D] flash with explicit fwd block sizes."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as mosaic_flash)
+
+    bs = BlockSizes(block_q=block_q, block_k_major=block_k_major,
+                    block_k=block_k, block_b=1)
+
+    def fn(q, k, v):
+        scale = q.shape[-1] ** -0.5
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        ot = mosaic_flash(qt, kt, vt, causal=causal, sm_scale=scale,
+                          block_sizes=bs)
+        return ot.transpose(0, 2, 1, 3)
+
+    return fn
+
+
+def _candidates(seq: int):
+    cands = [(128, 128, 128), (256, 256, 256), (512, 512, 512),
+             (256, 512, 512), (512, 1024, 512), (512, 256, 256),
+             (1024, 1024, 512)]
+    return [(bq, bkm, bk) for bq, bkm, bk in cands
+            if seq % bq == 0 and seq % bkm == 0 and bkm % bk == 0
+            and bq <= seq and bkm <= seq]
+
+
+def _sweep(seq: int, q, k, v, rows_sweep: list, repeats: int = 2):
+    """Raw single-chain ranking of block candidates at one L."""
+    results = []
+    for bq, bkm, bk in _candidates(seq):
+        if _left() < 30:
+            break
+        try:
+            t = _time_once(_chained(_mosaic_fn(bq, bkm, bk), 8), q, k, v,
+                           repeats)
+        except Exception as e:  # candidate doesn't tile / VMEM blowout
+            rows_sweep.append({"seq": seq, "block_q": bq,
+                               "block_k_major": bkm, "block_k": bk,
+                               "error": repr(e)[:120]})
+            continue
+        row = {"seq": seq, "block_q": bq, "block_k_major": bkm,
+               "block_k": bk, "chain8_ms": round(t * 1e3, 3)}
+        rows_sweep.append(row)
+        results.append((t, (bq, bkm, bk)))
+        print(json.dumps(row))
+    return min(results)[1] if results else (128, 128, 128)
 
 
 def main() -> int:
@@ -81,67 +164,131 @@ def main() -> int:
         print(json.dumps({"error": f"no TPU (got {dev.platform})"}))
         return 1
 
-    from ray_tpu.ops import dense_attention, flash_attention
+    from ray_tpu.ops import dense_attention
 
     batch, heads, head_dim = 4, 8, 128
-    causal = True
-    flash_fn = functools.partial(flash_attention, causal=causal)
-    dense_fn = functools.partial(dense_attention, causal=causal)
-    rows = []
-    for seq in (1024, 2048, 4096, 8192):
+    dense_fn = functools.partial(dense_attention, causal=True)
+
+    def make_qkv(seq):
         key = jax.random.PRNGKey(seq)
         kq, kk, kv = jax.random.split(key, 3)
         shape = (batch, seq, heads, head_dim)
-        q = jax.random.normal(kq, shape, dtype=jnp.bfloat16)
-        k = jax.random.normal(kk, shape, dtype=jnp.bfloat16)
-        v = jax.random.normal(kv, shape, dtype=jnp.bfloat16)
+        return (jax.random.normal(kq, shape, dtype=jnp.bfloat16),
+                jax.random.normal(kk, shape, dtype=jnp.bfloat16),
+                jax.random.normal(kv, shape, dtype=jnp.bfloat16))
 
+    rows_sweep: list = []
+    best: dict = {}
+
+    # Priority 1: sweeps at the two load-bearing lengths.
+    for seq in (2048, 8192):
+        if _left() < 60:
+            break
+        q, k, v = make_qkv(seq)
+        best[seq] = _sweep(seq, q, k, v, rows_sweep)
+        del q, k, v
+
+    # Priority 2: slope-timed final table, tuned flash vs dense.
+    rows = []
+    for seq in (1024, 2048, 4096, 8192):
+        if _left() < 45:
+            break
+        q, k, v = make_qkv(seq)
+        # Nearest swept L supplies the blocks for unswept lengths.
+        if best:
+            cfg = best.get(seq) or best[min(best, key=lambda s: abs(s - seq))]
+        else:
+            cfg = (512, 512, 512)
+        cfg = tuple(min(c, seq) for c in cfg)
         # fwd FLOPs: 2*L^2*D (QK^T) + 2*L^2*D (PV) per head, halved causal.
         flops = 4.0 * batch * heads * seq * seq * head_dim * 0.5
-
-        t_flash = _bench(_chained(flash_fn, ITERS), q, k, v)
-        row = {"seq": seq, "flash_ms": round(t_flash * 1e3, 3),
-               "flash_tflops": round(flops / t_flash / 1e12, 2)}
+        t_flash, rtt_f = _slope_time(_mosaic_fn(*cfg), q, k, v)
+        row = {"seq": seq, "blocks": list(cfg)}
+        if t_flash is None:
+            row["invalid_slope"] = True
+            row["chain_lo_s"] = round(rtt_f, 4)
+        else:
+            row.update(flash_ms=round(t_flash * 1e3, 3),
+                       flash_tflops=round(flops / t_flash / 1e12, 2),
+                       implied_rtt_ms=round(rtt_f * 1e3, 1))
         # Dense materializes the [B,H,L,L] score matrix — skip where it
         # cannot fit (8k: 4*8*8192^2 * 4B ~= 8.6 GB > HBM).
-        if seq <= 4096:
-            t_dense = _bench(_chained(dense_fn, ITERS), q, k, v)
-            row["dense_ms"] = round(t_dense * 1e3, 3)
-            row["dense_tflops"] = round(flops / t_dense / 1e12, 2)
-            row["speedup"] = round(t_dense / t_flash, 2)
+        if seq > 4096:
+            row["dense_skip_reason"] = "scores matrix exceeds HBM"
+        elif _left() <= 45:
+            row["dense_skip_reason"] = "time budget exhausted"
         else:
-            row["dense_ms"] = None
-            row["note"] = "dense scores matrix exceeds HBM; flash only"
+            t_dense, _ = _slope_time(dense_fn, q, k, v)
+            if t_dense is not None:
+                row["dense_ms"] = round(t_dense * 1e3, 3)
+                row["dense_tflops"] = round(flops / t_dense / 1e12, 2)
+                if t_flash is not None:
+                    row["speedup"] = round(t_dense / t_flash, 2)
+            else:
+                row["dense_skip_reason"] = "invalid slope"
         rows.append(row)
         print(json.dumps(row))
+        del q, k, v
+
+    # Priority 3: quick sweeps at the remaining lengths.
+    for seq in (1024, 4096):
+        if _left() < 90:
+            break
+        q, k, v = make_qkv(seq)
+        best[seq] = _sweep(seq, q, k, v, rows_sweep, repeats=1)
+        del q, k, v
+
+    ts = int(time.time())
+    paths = []
+    if best:
+        autotune = {
+            "note": "fwd-block autotune by benchmarks/tpu_kernels.py; "
+                    "loaded by ray_tpu/ops/attention.py flash_block_sizes()",
+            "device": str(dev),
+            "head_dim": head_dim,
+            "ts": ts,
+            "best": [{"seq": s, "block_q": b[0], "block_k_major": b[1],
+                      "block_k": b[2]} for s, b in sorted(best.items())],
+        }
+        apath = os.path.join(_REPO, "records", "flash_autotune.json")
+        with open(apath, "w") as f:
+            json.dump(autotune, f, indent=1)
+        paths.append(apath)
 
     record = {
         "metric": "attention_fwd_tflops",
         "unit": "TFLOP/s (bf16, causal, B4 H8 D128)",
         "device": str(dev),
-        "method": f"lax.scan chain of {ITERS} data-dependent calls, "
-                  "one D2H sync per measurement, median of 5",
+        "method": f"slope timing over scan chains of {N_LO} and {N_HI} "
+                  "data-dependent calls (cancels tunnel RTT); block sweep "
+                  "ranked by raw chain-8 time (RTT constant at fixed L)",
         "rows": rows,
-        "ts": time.time(),
+        "sweep": rows_sweep,
+        "best_blocks": {str(s): list(b) for s, b in sorted(best.items())},
+        "budget_s": BUDGET_S,
+        "elapsed_s": round(time.monotonic() - _T0, 1),
+        "ts": ts,
     }
-    path = os.path.join(_REPO, "records", f"tpu_kernels_{int(time.time())}.json")
-    with open(path, "w") as f:
+    rpath = os.path.join(_REPO, "records", f"tpu_kernels_{ts}.json")
+    with open(rpath, "w") as f:
         json.dump(record, f, indent=1)
+    paths.append(rpath)
     if os.environ.get("BENCH_NO_COMMIT") != "1":
         try:
-            subprocess.run(["git", "-C", _REPO, "add", path],
+            subprocess.run(["git", "-C", _REPO, "add"] + paths,
                            capture_output=True, timeout=30)
-            # -o <path>: commit ONLY the record — never sweep in whatever
+            # -o <paths>: commit ONLY the records — never sweep in whatever
             # else is staged (that once erased a prior record under a
             # "kernel record" message).
+            peak = max((r.get("flash_tflops", 0) for r in rows), default=0)
             subprocess.run(
-                ["git", "-C", _REPO, "commit", "--no-verify", "-o", path,
-                 "-m", f"TPU kernel record: flash attention up to "
-                       f"{max(r['flash_tflops'] for r in rows)} TFLOP/s fwd"],
+                ["git", "-C", _REPO, "commit", "--no-verify", "-o", *paths,
+                 "-m", f"TPU kernel record: autotuned flash attention, "
+                       f"peak {peak} TFLOP/s fwd"],
                 capture_output=True, timeout=30)
         except Exception:
-            pass  # the file on disk is still the evidence
-    print(json.dumps({"record_file": path}))
+            pass  # the files on disk are still the evidence
+    print(json.dumps({"record_file": rpath}))
     return 0
 
 

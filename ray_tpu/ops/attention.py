@@ -77,10 +77,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_k, causal,
 
     def body(i, carry):
         o_acc, m_acc, l_acc = carry
-        k_blk = pl.load(k_ref, (pl.dslice(i * block_k, block_k),
-                                slice(None))).astype(jnp.float32)
-        v_blk = pl.load(v_ref, (pl.dslice(i * block_k, block_k),
-                                slice(None))).astype(jnp.float32)
+        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -166,20 +164,89 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            segment_ids=segment_ids)
 
 
+#: On-chip autotuned (block_q, block_k_major, block_k) per sequence length,
+#: loaded once from records/flash_autotune.json (written + committed by
+#: benchmarks/tpu_kernels.py during a TPU window). Mosaic's own defaults are
+#: 128/128/128 at every size — conservative for v5e, where larger q/k blocks
+#: amortize the softmax rescale and keep the MXU busy; the sweep picks per-L
+#: winners empirically.
+_AUTOTUNE_CACHE: Optional[dict] = None
+import os as _os
+_AUTOTUNE_PATH = _os.path.join(_os.path.dirname(_os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__)))),
+    "records", "flash_autotune.json")
+
+
+def _autotune_table() -> dict:
+    global _AUTOTUNE_CACHE
+    if _AUTOTUNE_CACHE is None:
+        import json
+        table = {}
+        try:
+            with open(_AUTOTUNE_PATH) as f:
+                rec = json.load(f)
+                # Tuned blocks are only valid at the head_dim they were
+                # swept at (default 128, the sweep geometry).
+                table["head_dim"] = int(rec.get("head_dim", 128))
+                for row in rec.get("best", []):
+                    table[int(row["seq"])] = (int(row["block_q"]),
+                                              int(row["block_k_major"]),
+                                              int(row["block_k"]))
+        except Exception:
+            pass
+        _AUTOTUNE_CACHE = table
+    return _AUTOTUNE_CACHE
+
+
+def flash_block_sizes(seq_len: int, head_dim: int = 128):
+    """BlockSizes for the Mosaic kernel: fwd blocks autotuned if an on-chip
+    record exists for this (L, head_dim), else a v5e-oriented heuristic
+    (512-wide where they tile). Backward blocks stay at a conservative 128
+    — the sweep only ever times the forward kernel, so copying tuned fwd
+    blocks into the never-validated dkv/dq fields risks a bwd compile
+    failure that surfaces at the *caller's* jit, where no fallback can
+    catch it."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    table = _autotune_table()
+    tuned = table.get(seq_len) if table.get("head_dim") == head_dim else None
+    if tuned is not None and all(seq_len % b == 0 for b in tuned):
+        bq, bkm, bk = tuned
+    else:
+        bq = bkm = bk = min(512, seq_len)
+    bwd = min(128, seq_len)
+    return BlockSizes(
+        block_q=bq, block_k_major=bkm, block_k=bk, block_b=1,
+        block_q_major_dkv=bwd, block_k_major_dkv=bwd,
+        block_k_dkv=bwd, block_q_dkv=bwd,
+        block_k_major_dq=bwd, block_k_dq=bwd, block_q_dq=bwd,
+    )
+
+
 def _tpu_flash(q, k, v, causal: bool, scale: float) -> jax.Array:
     """Mosaic TPU flash attention ([B, H, L, D] layout internally)."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention as mosaic_flash,
     )
 
-    H, Hk = q.shape[2], k.shape[2]
+    B, L, H, D = q.shape
+    Hk = k.shape[2]
     if Hk != H:
         k = jnp.repeat(k, H // Hk, axis=2)
         v = jnp.repeat(v, H // Hk, axis=2)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    ot = mosaic_flash(qt, kt, vt, causal=causal, sm_scale=scale)
+    try:
+        bs = flash_block_sizes(L, D)
+        ot = mosaic_flash(qt, kt, vt, causal=causal, sm_scale=scale,
+                          block_sizes=bs)
+    except Exception:
+        # Trace-time tiling rejection — Mosaic defaults. (Compile-time
+        # failures under an outer jit are prevented structurally instead:
+        # flash_block_sizes only returns divisibility-checked fwd blocks
+        # and conservative 128 bwd blocks.)
+        ot = mosaic_flash(qt, kt, vt, causal=causal, sm_scale=scale)
     return ot.transpose(0, 2, 1, 3)
 
 
