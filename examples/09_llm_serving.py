@@ -63,15 +63,19 @@ def main():
 
     print("streamed:", asyncio.run(stream_demo()))
 
-    # Speculative decoding: a draft model proposes, the target verifies —
-    # output is EXACTLY the target's greedy decode, just fewer target
-    # forward passes. The demo drafts with the target itself (perfect
-    # acceptance); in practice the draft is a distilled smaller model
-    # whose acceptance rate sets the speedup.
+    # Speculative decoding: a REAL draft — the target's first layer via
+    # truncated_draft (the cheap-draft construction when no distilled
+    # checkpoint exists) — proposes, the target verifies. Output is
+    # EXACTLY the target's greedy decode; the draft's acceptance rate
+    # (< 1 here, it is half the model) sets how many tokens each target
+    # forward yields.
+    from ray_tpu.models.speculative import truncated_draft
+
     params, cfg = tiny_model()
+    draft_params, draft_cfg = truncated_draft(params, cfg, 1)
     prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
-    toks, stats = generate_speculative(params, params, prompt, cfg,
-                                       cfg, max_new=16, k=4)
+    toks, stats = generate_speculative(params, draft_params, prompt, cfg,
+                                       draft_cfg, max_new=16, k=4)
     print("speculative:", toks[0].tolist())
     print(f"  acceptance={stats['acceptance_rate']:.2f} "
           f"tokens/target-forward={stats['tokens_per_target_forward']:.2f}")

@@ -22,7 +22,31 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+import dataclasses
+
 from .llama import LlamaConfig, _decode_step, _prefill, rope_frequencies
+
+
+def truncated_draft(params, cfg: LlamaConfig, n_layers: int):
+    """Build a REAL draft from the target checkpoint: its first
+    ``n_layers`` transformer layers plus the target's embedding, final
+    norm, and lm_head. Returns ``(draft_params, draft_cfg)``.
+
+    This is the standard cheap-draft construction when no distilled
+    model exists (the role vLLM fills for the reference with separately
+    served draft checkpoints): the draft shares the target's token space
+    and output head, costs ``n_layers/target_layers`` of a target
+    forward, and its acceptance rate — not assumed 1.0 — sets the
+    speedup. Tune it further with a few self-distillation steps on
+    in-domain data (see tests/test_speculative.py).
+    """
+    if not 0 < n_layers < cfg.n_layers:
+        raise ValueError(
+            f"draft needs 1..{cfg.n_layers - 1} layers, got {n_layers}")
+    draft_cfg = dataclasses.replace(cfg, n_layers=n_layers)
+    draft_params = dict(params)
+    draft_params["layers"] = list(params["layers"][:n_layers])
+    return draft_params, draft_cfg
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k"))

@@ -32,8 +32,19 @@ class LLMServer:
                  max_len: int = 512, kv_cache: str = "dense",
                  num_pages: int = 64, page_size: int = 16,
                  enable_prefix_cache: bool = False,
-                 kv_dtype: str = "model"):
+                 kv_dtype: str = "model",
+                 draft_factory=None, draft_k: int = 4):
         params, cfg = model_factory()
+        # Speculative decoding: a replica-side draft factory (a distilled
+        # checkpoint loader, or models.speculative.truncated_draft over
+        # the target). Requests opting in with {"speculative": true} run
+        # the verify-k loop instead of the slot engine — batch-1 latency
+        # path; batched throughput stays on the engine.
+        self._spec = None
+        self._max_len = max_len
+        if draft_factory is not None:
+            draft_params, draft_cfg = draft_factory(params, cfg)
+            self._spec = (params, cfg, draft_params, draft_cfg, draft_k)
         if kv_cache == "paged":
             from ray_tpu.models.paged import PagedEngine
 
@@ -100,6 +111,8 @@ class LLMServer:
     # ------------------------------------------------------- handlers
     async def __call__(self, request: Any):
         body = self._body(request)
+        if body.get("speculative"):
+            return await self._speculative(body)
         if body.get("stream"):
             return self._stream(body)
         rid = self._submit(body)
@@ -114,6 +127,38 @@ class LLMServer:
         finally:
             self._queues.pop(rid, None)
         return {"tokens": toks, "num_tokens": len(toks)}
+
+    async def _speculative(self, body: dict):
+        """Batch-1 speculative decode; response carries the round stats
+        (acceptance rate, tokens per target forward) so callers can see
+        the draft's real speedup, not an assumed one."""
+        if self._spec is None:
+            raise ValueError(
+                "speculative request but no draft_factory configured")
+        import asyncio as _asyncio
+
+        import jax.numpy as jnp
+
+        from ray_tpu.models.speculative import generate_speculative
+
+        params, cfg, dparams, dcfg, k = self._spec
+        prompt = jnp.asarray([[int(t) for t in body["prompt"]]], jnp.int32)
+        max_new = int(body.get("max_new_tokens", 32))
+        k = int(body.get("k", k))
+        # Same admission bound as the engine path (models/engine.py):
+        # the speculative KV caches are sized prompt + max_new + k + 1.
+        total = prompt.shape[1] + max_new + k + 1
+        if k < 1 or total > self._max_len:
+            raise ValueError(
+                f"prompt+max_new_tokens+k+1 = {total} exceeds engine "
+                f"max_len {self._max_len} (or k < 1)")
+        loop = _asyncio.get_running_loop()
+        toks, stats = await loop.run_in_executor(
+            None, lambda: generate_speculative(
+                params, dparams, prompt, cfg, dcfg, max_new=max_new, k=k))
+        out = [int(t) for t in toks[0]]
+        return {"tokens": out, "num_tokens": len(out),
+                "speculative_stats": stats}
 
     async def _stream(self, body: dict):
         rid = self._submit(body)
@@ -133,13 +178,18 @@ def build_llm_app(model_factory, *, max_slots: int = 4,
                   kv_cache: str = "dense", num_pages: int = 64,
                   page_size: int = 16,
                   enable_prefix_cache: bool = False,
-                  kv_dtype: str = "model"):
+                  kv_dtype: str = "model",
+                  draft_factory=None, draft_k: int = 4):
     """Bind an LLM serving app (reference shape: ``serve.llm``
     builders): ``serve.run(build_llm_app(factory))``. ``kv_cache=
-    "paged"`` swaps in the shared-page-pool engine (models/paged.py)."""
+    "paged"`` swaps in the shared-page-pool engine (models/paged.py).
+    ``draft_factory=(params, cfg) -> (draft_params, draft_cfg)`` enables
+    the speculative request path (e.g. ``lambda p, c:
+    truncated_draft(p, c, n_layers)``)."""
     dep = _deployment(LLMServer, num_replicas=num_replicas)
     return dep.bind(model_factory, max_slots=max_slots, max_len=max_len,
                     kv_cache=kv_cache, num_pages=num_pages,
                     page_size=page_size,
                     enable_prefix_cache=enable_prefix_cache,
-                    kv_dtype=kv_dtype)
+                    kv_dtype=kv_dtype,
+                    draft_factory=draft_factory, draft_k=draft_k)

@@ -61,3 +61,135 @@ def test_k_one_and_batch_guard(cfgs):
         generate_speculative(target, draft,
                              jnp.zeros((2, 4), jnp.int32),
                              target_cfg, draft_cfg)
+
+
+def _progression_batch(key, vocab, b=16, length=24):
+    """Cyclic arithmetic progressions — a task a 4-layer target learns to
+    near-zero loss in ~150 small-batch steps on CPU."""
+    ks, kt = jax.random.split(key)
+    start = jax.random.randint(ks, (b, 1), 0, vocab)
+    stride = jax.random.randint(kt, (b, 1), 1, 4)
+    idx = jnp.arange(length)[None, :]
+    return (start + stride * idx) % vocab
+
+
+def _train(params, cfg, steps, key, lr=5e-3):
+    import optax
+
+    from ray_tpu.models import loss_fn
+
+    opt = optax.adam(lr)
+    st = opt.init(params)
+
+    @jax.jit
+    def step(p, st, toks):
+        l, g = jax.value_and_grad(
+            lambda p: loss_fn(p, {"tokens": toks}, cfg))(p)
+        up, st = opt.update(g, st, p)
+        return optax.apply_updates(p, up), st, l
+
+    loss = None
+    for _ in range(steps):
+        key, k = jax.random.split(key)
+        params, st, loss = step(params, st,
+                                _progression_batch(k, cfg.vocab_size))
+    return params, float(loss)
+
+
+@pytest.fixture(scope="module")
+def trained_target():
+    cfg = LlamaConfig(vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq_len=64,
+                      dtype=jnp.float32, tie_embeddings=False)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params, loss = _train(params, cfg, 150, jax.random.PRNGKey(42))
+    assert loss < 0.3, f"target failed to learn the task: loss={loss}"
+    return params, cfg
+
+
+def test_real_truncated_draft_speeds_up_decode(trained_target):
+    """VERDICT r4 directive #8: the mechanism that makes speculation
+    worth having — a CHEAPER draft (2 of the target's 4 layers) with
+    acceptance < 1 still yielding > 1 tokens per target forward, with
+    exact greedy parity. (Every quantity is seeded → deterministic; the
+    prototype measured acceptance 0.643 and 3.0 tok/target-forward.)"""
+    from ray_tpu.models.speculative import truncated_draft
+
+    params, cfg = trained_target
+    draft, draft_cfg = truncated_draft(params, cfg, 2)
+    assert draft_cfg.n_layers == 2
+    prompt = jnp.asarray([[3, 5, 7, 9]], jnp.int32)  # stride-2 progression
+    max_new = 24
+    ref = generate_greedy(params, prompt, cfg, max_new=max_new)
+    out, stats = generate_speculative(params, draft, prompt, cfg,
+                                      draft_cfg, max_new=max_new, k=4)
+    assert out.tolist() == ref.tolist()              # exact parity
+    assert 0.0 < stats["acceptance_rate"] < 1.0, stats   # a REAL draft
+    assert stats["tokens_per_target_forward"] > 2.0, stats
+    # Structural speedup: far fewer target forwards than tokens emitted.
+    assert stats["target_forwards"] < max_new / 2, stats
+
+
+def _self_distill(draft, dcfg, target, cfg, steps, key, lr=5e-3):
+    """TRUE self-distillation: the draft trains to reproduce the TARGET's
+    greedy next-token choices on unlabeled in-domain inputs — no ground
+    truth consulted. This is the recipe truncated_draft's docstring points
+    operators to (only the target's distribution is available in a real
+    deployment)."""
+    import optax
+
+    from ray_tpu.models import forward
+
+    opt = optax.adam(lr)
+    st = opt.init(draft)
+
+    @jax.jit
+    def step(dp, st, toks):
+        labels = jnp.argmax(forward(target, toks, cfg), axis=-1)
+
+        def loss(dp):
+            logits = forward(dp, toks, dcfg)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        l, g = jax.value_and_grad(loss)(dp)
+        up, st = opt.update(g, st, dp)
+        return optax.apply_updates(dp, up), st, l
+
+    for _ in range(steps):
+        key, k = jax.random.split(key)
+        draft, st, _ = step(draft, st,
+                            _progression_batch(k, cfg.vocab_size))
+    return draft
+
+
+def test_distilled_draft_improves_acceptance(trained_target):
+    """A few self-distillation steps (draft imitates the target's own
+    greedy outputs — no labels) raise the truncated draft's acceptance
+    rate — the tuning knob serve operators get."""
+    from ray_tpu.models.speculative import truncated_draft
+
+    params, cfg = trained_target
+    prompt = jnp.asarray([[10, 11, 12, 13]], jnp.int32)
+
+    draft0, dcfg = truncated_draft(params, cfg, 2)
+    _, s0 = generate_speculative(params, draft0, prompt, cfg, dcfg,
+                                 max_new=24, k=4)
+    draft1 = _self_distill(draft0, dcfg, params, cfg, 20,
+                           jax.random.PRNGKey(7))
+    out1, s1 = generate_speculative(params, draft1, prompt, cfg, dcfg,
+                                    max_new=24, k=4)
+    ref = generate_greedy(params, prompt, cfg, max_new=24)
+    assert out1.tolist() == ref.tolist()
+    assert s1["acceptance_rate"] >= s0["acceptance_rate"], (s0, s1)
+    assert s1["acceptance_rate"] > 0.9, s1
+
+
+def test_truncated_draft_validates_layers(trained_target):
+    from ray_tpu.models.speculative import truncated_draft
+
+    params, cfg = trained_target
+    with pytest.raises(ValueError):
+        truncated_draft(params, cfg, 0)
+    with pytest.raises(ValueError):
+        truncated_draft(params, cfg, cfg.n_layers)
