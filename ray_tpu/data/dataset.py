@@ -20,7 +20,8 @@ import builtins
 import itertools
 import math
 import time
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Union
+from typing import (Any, Callable, Dict, Iterable, Iterator, List,
+                    Optional, Tuple, Union)
 
 import numpy as np
 
@@ -560,6 +561,7 @@ class Dataset:
         created, the class is constructed once per actor, and blocks
         stream through the pool — the shape for expensive-init UDFs.
         """
+        pool_min = pool_max = None
         if compute is not None and hasattr(compute, "pool_size"):
             # ray.data.ActorPoolStrategy compute strategy object
             if not isinstance(fn, type):
@@ -570,14 +572,26 @@ class Dataset:
                 raise ValueError(
                     "ActorPoolStrategy requires a callable class UDF; "
                     "got a plain function")
+            if compute.size is not None:
+                pool_min = pool_max = max(1, int(compute.size))
+            else:
+                # min/max bounds -> THIS op's pool autoscales between
+                # them against its own queue depth (reference:
+                # ActorPoolMapOperator + resource_manager per-op budgets).
+                pool_min = max(1, int(compute.min_size))
+                pool_max = (max(pool_min, int(compute.max_size))
+                            if compute.max_size is not None else None)
             if concurrency is None:
                 concurrency = compute.pool_size()
         if isinstance(fn, type):
+            if pool_min is None:
+                pool_min = pool_max = concurrency or 2
             op = _Op("map_batches", None, batch_size, batch_format,
                      udf_cls=fn, fn_args=fn_constructor_args,
-                     fn_kwargs=fn_constructor_kwargs or {})
+                     fn_kwargs=fn_constructor_kwargs or {},
+                     pool_min=pool_min, pool_max=pool_max)
             ds = self._with_op(op)
-            ds._actor_pool_size = concurrency or 2
+            ds._actor_pool_size = concurrency or pool_min
         else:
             ds = self._with_op(
                 _Op("map_batches", fn, batch_size, batch_format))
@@ -829,32 +843,182 @@ class Dataset:
 
     def _stream_refs_actor_pool(self, sources,
                                 ops) -> Iterator[ray_tpu.ObjectRef]:
-        """Actor-pool compute: blocks stream through N stateful actors,
-        bounded in-flight per actor (reference: ActorPoolMapOperator)."""
-        n = self._actor_pool_size or 2
+        """Per-operator actor pools: the op chain is split into segments —
+        leading task ops run on the task executor, then EACH class-UDF op
+        owns its own autoscaling pool (reference: one ActorPoolMapOperator
+        per operator + per-op budgets in execution/resource_manager.py).
+        Different stages of a mixed pipeline converge to different pool
+        sizes: a cheap stage stays at min_size while an expensive stage
+        under backlog grows toward max_size."""
+        segments: List[Tuple[str, List[_Op]]] = []
+        for op in ops:
+            if op.kw.get("udf_cls") is not None:
+                segments.append(("pool", [op]))
+            elif segments and segments[-1][0] == "pool":
+                # Cheap row/batch ops after a pool stage fuse into it.
+                segments[-1][1].append(op)
+            else:
+                if not segments or segments[-1][0] != "tasks":
+                    segments.append(("tasks", []))
+                segments[-1][1].append(op)
+        stream: Iterator[ray_tpu.ObjectRef] = iter(sources)
+        self._last_pool_stats = []
+        for i, (kind, seg_ops) in enumerate(segments):
+            if kind == "tasks":
+                # The segmenter fuses post-pool task ops INTO the pool
+                # segment, so a tasks segment can only lead the chain.
+                assert i == 0, segments
+                stream = self._stream_refs_tasks(sources, seg_ops)
+            else:
+                pmin = seg_ops[0].kw.get("pool_min") or 2
+                pmax = seg_ops[0].kw.get("pool_max")
+                stats: dict = {}
+                self._last_pool_stats.append(stats)
+                stream = self._stream_pool_segment(stream, seg_ops, pmin,
+                                                   pmax, stats)
+        yield from stream
+
+    def _resolve_pool_max(self, pmin: int, pmax: Optional[int],
+                          opts: dict) -> int:
+        """An unbounded max resolves against the per-op resource budget:
+        ExecutionOptions.resource_limits.cpu divided by this op's per-
+        actor CPU ask (reference: resource_manager.py op budgets)."""
+        from .context import DataContext
+
+        if pmax is not None:
+            return pmax
+        limits = getattr(DataContext.get_current(), "execution_options",
+                         None)
+        cpu_limit = getattr(getattr(limits, "resource_limits", None),
+                            "cpu", None)
+        if cpu_limit:
+            per_actor_cpu = float(opts.get("num_cpus") or 1)
+            return max(pmin, int(cpu_limit / per_actor_cpu))
+        try:
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
+        except Exception:
+            cpus = 4
+        return max(pmin, cpus)
+
+    def _stream_pool_segment(self, source_iter, seg_ops: List[_Op],
+                             pmin: int, pmax: Optional[int], stats: dict
+                             ) -> Iterator[ray_tpu.ObjectRef]:
+        """One autoscaling pool stage. Admission is bounded per actor;
+        the pool grows one worker at a time while saturated with backlog
+        (and the memory-budget policy admits), and shrinks idle workers
+        back toward min when the backlog clears. Submission order is
+        preserved (head-of-line wait), matching the task executor."""
+        from .context import DataContext, MemoryBudgetPolicy
+
+        PER_ACTOR = 2
+        GROW_PATIENCE, SHRINK_PATIENCE = 2, 3
+        # A stage only earns a new worker after individual head-of-line
+        # waits LONGER than this while backlogged — a fast stage with an
+        # instantly-available upstream saturates its PER_ACTOR window too,
+        # but its per-block waits are dispatch-sized (ms), never counted,
+        # so it stays at min_size (the differential-scaling signal).
+        # Lifetime sums would misfire: many tiny RPC waits add up.
+        SLOW_WAIT_S = 0.05
         opts = {k: v for k, v in self._remote_args.items()
                 if k in ("num_cpus", "num_tpus", "resources")}
-        pool = [_PoolWorker.options(**opts).remote(ops)
-                for _ in range(n)]
+        pmax = self._resolve_pool_max(pmin, pmax, opts)
+        mem_policies = [
+            p for p in (DataContext.get_current().backpressure_policies
+                        or []) if isinstance(p, MemoryBudgetPolicy)]
+
+        pool: List[Any] = []
+        load: List[int] = []
+
+        def spawn():
+            pool.append(_PoolWorker.options(**opts).remote(seg_ops))
+            load.append(0)
+
+        for _ in range(pmin):
+            spawn()
+        stats.update(initial=pmin, max=pmax, peak=pmin, final=pmin,
+                     peak_inflight=0, grew=0, shrank=0)
+        pending: List[Tuple[ray_tpu.ObjectRef, int]] = []
+        est_out = 0   # rolling max of produced block bytes (source refs
+                      # and read thunks have no size until resolved)
+        it = iter(source_iter)
+        exhausted = False
+        held: Optional[Any] = None   # upstream block awaiting capacity
+        sat_streak = idle_streak = 0
+        blocked_s = 0.0
         try:
-            per_actor = 2
-            pending: List[ray_tpu.ObjectRef] = []
-            it = iter(sources)
-            exhausted = False
-            i = 0
-            while pending or not exhausted:
-                while not exhausted and len(pending) < n * per_actor:
-                    try:
-                        src = next(it)
-                    except StopIteration:
-                        exhausted = True
-                        break
-                    pending.append(pool[i % n].run.remote(src))
-                    i += 1
+            while True:
+                # Admit onto the least-loaded worker while capacity lasts.
+                while not exhausted or held is not None:
+                    if held is None:
+                        try:
+                            held = next(it)
+                        except StopIteration:
+                            exhausted = True
+                            break
+                    w = min(range(len(pool)), key=load.__getitem__)
+                    if load[w] >= PER_ACTOR:
+                        break  # saturated — backlog in `held`
+                    pending.append((pool[w].run.remote(held), w))
+                    load[w] += 1
+                    held = None
+                    stats["peak_inflight"] = max(stats["peak_inflight"],
+                                                 len(pending))
+                # Scale up: saturated with a held block, under max, and
+                # the memory budget (if configured) admits another task.
+                if held is not None and len(pool) < pmax:
+                    sat_streak += 1
+                    if (sat_streak >= GROW_PATIENCE
+                            and blocked_s >= 2 * SLOW_WAIT_S and all(
+                            p.can_admit(len(pending) + 1,
+                                        est_out * len(pending))
+                            for p in mem_policies)):
+                        spawn()
+                        stats["grew"] += 1
+                        stats["peak"] = max(stats["peak"], len(pool))
+                        sat_streak = 0
+                        blocked_s = 0.0
+                        continue
+                else:
+                    sat_streak = 0
                 if not pending:
                     break
-                ray_tpu.wait(pending[:1], num_returns=1, timeout=None)
-                yield pending.pop(0)
+                # Order-preserving head wait.
+                t0 = time.perf_counter()
+                ray_tpu.wait([pending[0][0]], num_returns=1, timeout=None)
+                dt = time.perf_counter() - t0
+                if held is not None and dt > SLOW_WAIT_S:
+                    blocked_s += dt
+                else:
+                    # Fast waits wash out sporadic host-noise stalls:
+                    # only SUSTAINED congestion (every recent wait slow)
+                    # reaches the growth threshold.
+                    blocked_s *= 0.5
+                ref, w = pending.pop(0)
+                load[w] -= 1
+                est_out = max(est_out, _resolved_nbytes(ref))
+                yield ref
+                # Scale down: backlog clear, an idle worker, above min.
+                if held is None and len(pool) > pmin and 0 in load:
+                    idle_streak += 1
+                    if idle_streak >= SHRINK_PATIENCE:
+                        # Kill the idle worker with the highest index so
+                        # earlier (warm) workers keep their UDF state.
+                        for w_idle in range(len(pool) - 1, -1, -1):
+                            if load[w_idle] == 0:
+                                break
+                        victim = pool.pop(w_idle)
+                        load.pop(w_idle)
+                        pending = [(r, w if w < w_idle else w - 1)
+                                   for r, w in pending]
+                        try:
+                            ray_tpu.kill(victim)
+                        except Exception:
+                            pass
+                        stats["shrank"] += 1
+                        idle_streak = 0
+                else:
+                    idle_streak = 0
+            stats["final"] = len(pool)
         finally:
             for a in pool:
                 try:

@@ -81,12 +81,15 @@ class RowBasedFileDatasink(Datasink):
 
 @dataclass
 class ActorPoolStrategy:
-    """``map_batches(..., compute=ActorPoolStrategy(size=N))`` — the
+    """``map_batches(..., compute=ActorPoolStrategy(...))`` — the
     actor-pool compute strategy object (reference:
-    ``ray.data.ActorPoolStrategy``). ``size`` wins; otherwise the pool
-    opens at ``min_size`` (the streaming pool here is fixed-size, so
-    min_size is the honored knob and max_size is accepted for source
-    compatibility)."""
+    ``ray.data.ActorPoolStrategy``). ``size`` pins a fixed pool;
+    otherwise the op's pool AUTOSCALES between ``min_size`` and
+    ``max_size`` against its own queue depth (sustained head-of-line
+    congestion grows it, idle workers shrink it back — see
+    ``Dataset._stream_pool_segment``). ``max_size=None`` resolves
+    against the per-op budget from
+    ``ExecutionOptions.resource_limits.cpu``, else cluster CPUs."""
 
     size: Optional[int] = None
     min_size: int = 1

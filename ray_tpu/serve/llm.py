@@ -42,6 +42,8 @@ class LLMServer:
         # path; batched throughput stays on the engine.
         self._spec = None
         self._max_len = max_len
+        self._max_slots = max_slots
+        self._spec_sem: Optional[asyncio.Semaphore] = None
         if draft_factory is not None:
             draft_params, draft_cfg = draft_factory(params, cfg)
             self._spec = (params, cfg, draft_params, draft_cfg, draft_k)
@@ -152,10 +154,17 @@ class LLMServer:
             raise ValueError(
                 f"prompt+max_new_tokens+k+1 = {total} exceeds engine "
                 f"max_len {self._max_len} (or k < 1)")
+        # Same admission budget as the engine: at most max_slots
+        # speculative decodes in flight (each allocates its own target +
+        # draft KV caches); excess requests queue on the semaphore.
+        if self._spec_sem is None:
+            self._spec_sem = _asyncio.Semaphore(self._max_slots)
         loop = _asyncio.get_running_loop()
-        toks, stats = await loop.run_in_executor(
-            None, lambda: generate_speculative(
-                params, dparams, prompt, cfg, dcfg, max_new=max_new, k=k))
+        async with self._spec_sem:
+            toks, stats = await loop.run_in_executor(
+                None, lambda: generate_speculative(
+                    params, dparams, prompt, cfg, dcfg, max_new=max_new,
+                    k=k))
         out = [int(t) for t in toks[0]]
         return {"tokens": out, "num_tokens": len(out),
                 "speculative_stats": stats}
