@@ -41,6 +41,15 @@ def _tensorable(v) -> np.ndarray:
     return arr
 
 
+def _cluster_cpus(default: int = 4) -> int:
+    """Cluster CPU count with an off-cluster default — shared by the task
+    executor's concurrency window and the pool-max resolver."""
+    try:
+        return int(ray_tpu.cluster_resources().get("CPU", default))
+    except Exception:
+        return default
+
+
 class _Op:
     """A per-block transform (fusable)."""
 
@@ -753,10 +762,7 @@ class Dataset:
                               MemoryBudgetPolicy)
 
         ctx = DataContext.get_current()
-        try:
-            cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
-        except Exception:
-            cpus = 4
+        cpus = _cluster_cpus()
         policies = ctx.backpressure_policies
         exec_opts = getattr(ctx, "execution_options", None)
         if policies is None:
@@ -894,11 +900,7 @@ class Dataset:
         if cpu_limit:
             per_actor_cpu = float(opts.get("num_cpus") or 1)
             return max(pmin, int(cpu_limit / per_actor_cpu))
-        try:
-            cpus = int(ray_tpu.cluster_resources().get("CPU", 4))
-        except Exception:
-            cpus = 4
-        return max(pmin, cpus)
+        return max(pmin, _cluster_cpus())
 
     def _stream_pool_segment(self, source_iter, seg_ops: List[_Op],
                              pmin: int, pmax: Optional[int], stats: dict

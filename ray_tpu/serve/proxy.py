@@ -41,8 +41,12 @@ class ProxyActor:
     def __init__(self):
         self.apps: Dict[str, str] = {}  # route_prefix -> (app, ingress dep)
         self.handles: Dict[str, Any] = {}
+        self._route_order: list = []  # prefixes, longest first
         self.port: Optional[int] = None
         self._runner = None
+
+    def _reindex_routes(self):
+        self._route_order = sorted(self.handles, key=len, reverse=True)
 
     async def register(self, route_prefix: str, app_name: str,
                        ingress_deployment: str):
@@ -50,15 +54,18 @@ class ProxyActor:
 
         self.handles[route_prefix] = DeploymentHandle(
             ingress_deployment, app_name)
+        self._reindex_routes()
         return True
 
     async def unregister(self, route_prefix: str):
         self.handles.pop(route_prefix, None)
+        self._reindex_routes()
         return True
 
     def _find_route(self, path: str):
-        """Longest-prefix route match, shared by HTTP and RPC ingress."""
-        for prefix in sorted(self.handles, key=len, reverse=True):
+        """Longest-prefix route match, shared by HTTP and RPC ingress
+        (route order precomputed at register time, not per request)."""
+        for prefix in self._route_order:
             if path == prefix or path.startswith(
                     prefix.rstrip("/") + "/") or prefix == "/":
                 return prefix
