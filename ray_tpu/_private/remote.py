@@ -94,7 +94,6 @@ def _strategy_opts(opts: dict) -> dict:
 _RENV_WIRE_CACHE: Dict[tuple, dict] = {}
 
 # Cached wire form of an empty (args, kwargs) tuple (see _prepare_args).
-_EMPTY_ARGS_BYTES: Optional[bytes] = None
 
 
 def _prepared_runtime_env(opts: dict):
@@ -134,12 +133,10 @@ def _prepare_args(args: tuple, kwargs: dict,
     it (the reference resolves dependencies BEFORE taking a lease,
     ``transport/dependency_resolver.h``).
     """
-    global _EMPTY_ARGS_BYTES
     if not args and not kwargs:
-        # No-arg calls are the hottest microbench shape; skip the pickle.
-        if _EMPTY_ARGS_BYTES is None:
-            _EMPTY_ARGS_BYTES = serialize(((), {})).to_bytes()
-        return {"args": _EMPTY_ARGS_BYTES}
+        # No-arg calls are the hottest microbench shape; skip the pickle
+        # (single definition site shared with the worker-side match).
+        return {"args": serialization.empty_args_bytes()}
     w = global_worker()
     out: dict = {}
     if collect_deps:

@@ -16,10 +16,13 @@ header = {"p": pickle_bytes, "o": [buffer offsets], "l": [buffer lengths]}
 
 from __future__ import annotations
 
+import hashlib
+import io
 import pickle
 import struct
 import threading
 import traceback
+import types
 from typing import Any, List, Optional, Tuple
 
 import cloudpickle
@@ -94,6 +97,32 @@ def _id_cache_put(obj, token: str) -> None:
     _export_by_id[id(obj)] = (token, wr)
 
 
+def reset_export_cache() -> None:
+    """Called on every new driver session (Worker construction): tokens
+    cached against a previous session's GCS KV must not leak into a fresh
+    cluster whose KV never saw the export — the receiver would fail
+    resolution. Worker processes are freshly forked, so this matters for
+    the re-init()-ed driver/notebook case."""
+    with _export_lock:
+        _export_by_id.clear()
+        _export_by_token.clear()
+
+
+_EMPTY_ARGS_CACHE: Optional[bytes] = None
+
+
+def empty_args_bytes() -> bytes:
+    """THE canonical wire form of ((), {}) — remote._prepare_args sends
+    it for every no-arg call and worker_main._load_args matches it to
+    skip the unpickle; a single definition site keeps the bytes from
+    silently drifting apart (which would quietly disable the fast path).
+    """
+    global _EMPTY_ARGS_CACHE
+    if _EMPTY_ARGS_CACHE is None:
+        _EMPTY_ARGS_CACHE = serialize(((), {})).to_bytes()
+    return _EMPTY_ARGS_CACHE
+
+
 def _export_kv():
     """GCS KV accessors of the connected worker, or None off-cluster."""
     try:
@@ -134,8 +163,6 @@ class _ExportPickler(cloudpickle.CloudPickler):
     """cloudpickle that tokenizes ``__main__`` classes/functions."""
 
     def reducer_override(self, obj):
-        import types
-
         if (isinstance(obj, (type, types.FunctionType))
                 and getattr(obj, "__module__", None) == "__main__"):
             with _export_lock:
@@ -144,8 +171,6 @@ class _ExportPickler(cloudpickle.CloudPickler):
                 w = _export_kv()
                 if w is not None:
                     try:
-                        import hashlib
-
                         blob = cloudpickle.dumps(obj, protocol=5)
                         token = ("dx:" + getattr(obj, "__qualname__", "?")
                                  + ":" + hashlib.sha1(blob).hexdigest())
@@ -171,6 +196,17 @@ class SerializedObject:
 
     def __init__(self, pickle_bytes: bytes, buffers: List[pickle.PickleBuffer]):
         self.pickle_bytes = pickle_bytes
+        if not buffers:
+            # Buffer-less values (every small task arg/result) need no
+            # offset fix-point: one header pack instead of two — this
+            # runs on EVERY control-plane message, visible at benchmark
+            # rates on both the submit and reply paths.
+            self.buffers = []
+            self._header = msgpack.packb(
+                {"p": pickle_bytes, "o": [], "l": []}, use_bin_type=True)
+            self._offsets = []
+            self.total_size = 4 + len(self._header)
+            return
         self.buffers = [b.raw() for b in buffers]
         offsets: List[int] = []
         lens = [len(b) for b in self.buffers]
@@ -191,7 +227,7 @@ class SerializedObject:
             raise RuntimeError("serialization header overflow")
         self._header = header
         self._offsets = offsets
-        self.total_size = pos if self.buffers else 4 + len(header)
+        self.total_size = pos
 
     def write_into(self, buf: memoryview):
         buf[:4] = _U32.pack(len(self._header))
@@ -229,8 +265,6 @@ def serialize(value: Any) -> SerializedObject:
         _REDUCE_LEDGER.lst = prev
     for cb in undo:
         cb()
-    import io
-
     buffers = []
     buf = io.BytesIO()
     _ExportPickler(buf, protocol=5, buffer_callback=buffers.append

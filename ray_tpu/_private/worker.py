@@ -344,6 +344,9 @@ class Worker:
             self._loop_thread.start()
         else:
             self.loop = loop
+        # A fresh session's GCS KV has no defexports: drop tokens cached
+        # against a previous cluster (notebook re-init case).
+        serialization.reset_export_cache()
         hello = self.run_async(self._connect_async(gcs_address))
         self.session_name = hello["session"]
         self.session_dir = hello["session_dir"]
