@@ -39,7 +39,7 @@ while true; do
     timeout 1200 python bench.py >>"$LOG" 2>&1
     echo "$(date -u +%FT%TZ) bench rc=$?" >>"$LOG"
     sts=$(date +%s)
-    RAY_TPU_TPU_SMOKE=1 timeout 1200 python -m pytest tests/test_tpu_smoke.py -v --durations=0 \
+    RAY_TPU_TPU_SMOKE=1 timeout 1200 python -m pytest tests/test_tpu_smoke.py -v -s --durations=0 \
       > "records/tpu_smoke_verbose_${sts}.txt" 2>&1
     echo "$(date -u +%FT%TZ) smoke rc=$?" >>"$LOG"
     git add "records/tpu_smoke_verbose_${sts}.txt" >>"$LOG" 2>&1

@@ -171,6 +171,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 #: amortize the softmax rescale and keep the MXU busy; the sweep picks per-L
 #: winners empirically.
 _AUTOTUNE_CACHE: Optional[dict] = None
+#: Diagnostics: the fwd block config the last _tpu_flash dispatch actually
+#: used — "(bq, bkm, bk)" or "mosaic-defaults" after a tiling-rejection
+#: fallback. Smoke records print this so they cannot misreport the chooser
+#: output as the executed config.
+_LAST_FLASH_BLOCKS: Any = None
 import os as _os
 _AUTOTUNE_PATH = _os.path.join(_os.path.dirname(_os.path.dirname(
     _os.path.dirname(_os.path.abspath(__file__)))),
@@ -237,16 +242,19 @@ def _tpu_flash(q, k, v, causal: bool, scale: float) -> jax.Array:
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
+    global _LAST_FLASH_BLOCKS
     try:
         bs = flash_block_sizes(L, D)
         ot = mosaic_flash(qt, kt, vt, causal=causal, sm_scale=scale,
                           block_sizes=bs)
+        _LAST_FLASH_BLOCKS = (bs.block_q, bs.block_k_major, bs.block_k)
     except Exception:
         # Trace-time tiling rejection — Mosaic defaults. (Compile-time
         # failures under an outer jit are prevented structurally instead:
         # flash_block_sizes only returns divisibility-checked fwd blocks
         # and conservative 128 bwd blocks.)
         ot = mosaic_flash(qt, kt, vt, causal=causal, sm_scale=scale)
+        _LAST_FLASH_BLOCKS = "mosaic-defaults"
     return ot.transpose(0, 2, 1, 3)
 
 
