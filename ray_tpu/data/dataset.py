@@ -1348,14 +1348,34 @@ class Dataset:
         enforces the exact cross-block cutoff and stops submitting block
         tasks once ``n`` rows are covered.
 
-        Degenerate shapes fall back to eager truncation: a second limit
-        in one chain, or an actor-pool compute stage (the pool path has
-        no per-block limit-point stats channel)."""
+        A second limit stays lazy when every op after the existing limit
+        is row-preserving: those ops keep row count AND order, so the
+        composition equals a single ``limit(min(n_prev, n))`` placed at
+        the EXISTING limit's position — merged structurally right here,
+        below the optimizer, so correctness never depends on
+        ``DataContext.optimizer_enabled`` (the streaming executor
+        assumes a single limit point). Degenerate shapes fall back to
+        eager truncation: a second limit separated by a count-changing op
+        (filter/flat_map), or an actor-pool compute stage (the pool path
+        has no per-block limit-point stats channel)."""
+        from . import plan as _plan
+
         n = int(n)
-        if self._actor_pool_size or any(o.kind == "limit"
-                                        for o in self._ops):
+        li = next((i for i in range(len(self._ops) - 1, -1, -1)
+                   if self._ops[i].kind == "limit"), None)
+        mergeable = li is not None and all(
+            o.kind in _plan._ROW_PRESERVING for o in self._ops[li + 1:])
+        if self._actor_pool_size or (li is not None and not mergeable):
             rows = self.take(n)
             return Dataset([to_block(rows)], [], self._remote_args)
+        if li is not None:
+            merged = min(int(self._ops[li].kw["n"]), n)
+            ops = list(self._ops)
+            ops[li] = _Op("limit", n=merged)
+            ds = Dataset(self._sources, ops, self._remote_args)
+            ds._actor_pool_size = self._actor_pool_size
+            ds._input_files = list(self._input_files)
+            return ds
         return self._with_op(_Op("limit", n=n))
 
     def show(self, limit: int = 20):

@@ -188,3 +188,87 @@ def test_exchange_runs_once_per_node(ray_cluster):
     assert first is not None
     assert ds.count() == 32  # second consumption
     assert ds._sources[0].expanded is first  # same partitions, not re-run
+
+
+# ---------------------------------------------- rule framework (round 5)
+
+def test_merge_limits_rule(ray_cluster):
+    """The rule itself, on a raw op chain (Dataset.limit merges at build
+    time below the optimizer, so adjacent limit ops only reach the rule
+    from hand-built or composed plans)."""
+    from ray_tpu.data.dataset import _Op
+    from ray_tpu.data.plan import optimize
+
+    _, ops, trace = optimize([], [_Op("limit", n=50), _Op("limit", n=10)])
+    limits = [o for o in ops if o.kind == "limit"]
+    assert len(limits) == 1 and limits[0].kw["n"] == 10
+    assert any("merge_limits" in t for t in trace)
+
+
+def test_double_limit_correct_without_optimizer(ray_cluster):
+    """Dataset.limit merges a second limit STRUCTURALLY (min of the two,
+    at the first limit's position) whenever only row-preserving ops sit
+    between — so the executor's single-limit-point assumption holds even
+    with the optimizer disabled (this exact shape over-delivered 41 rows
+    before the build-time merge)."""
+    ds = rd.range(100).repartition(8).limit(50).limit(10)
+    assert len(ds.take_all()) == 10
+    ds2 = rd.range(100).limit(50).map(lambda r: r).limit(10)
+    assert [o.kind for o in ds2._ops].count("limit") == 1
+    assert len(ds2.take_all()) == 10
+    # Larger second limit: min() keeps the tighter first one.
+    ds3 = rd.range(100).limit(5).limit(50)
+    assert len(ds3.take_all()) == 5
+
+
+def test_fuse_row_ops_rule(ray_cluster):
+    from ray_tpu.data.plan import optimize
+
+    ds = (rd.range(20)
+          .map(lambda r: {"id": r["id"] + 1})
+          .map(lambda r: {"id": r["id"] * 2})
+          .filter(lambda r: r["id"] > 4)
+          .filter(lambda r: r["id"] < 30))
+    _, ops, trace = optimize(list(ds._sources), list(ds._ops))
+    assert [o.kind for o in ops] == ["map", "filter"]
+    assert any("map∘map" in t for t in trace)
+    assert any("filter∘filter" in t for t in trace)
+    # Semantics preserved: ((id+1)*2) in (4, 30) exclusive.
+    want = sorted((i + 1) * 2 for i in range(20) if 4 < (i + 1) * 2 < 30)
+    assert sorted(r["id"] for r in ds.take_all()) == want
+
+
+def test_rules_compose_across_passes(ray_cluster):
+    """The optimized plan of a limit-map-limit chain carries exactly one
+    limit at the tighter bound (merged at build time; PushLimitEarly +
+    MergeLimits would do the same for hand-built plans)."""
+    from ray_tpu.data.plan import optimize
+
+    ds = rd.range(100).limit(30).map(lambda r: r).limit(5)
+    _, ops, trace = optimize(list(ds._sources), list(ds._ops))
+    limits = [o for o in ops if o.kind == "limit"]
+    assert len(limits) == 1 and limits[0].kw["n"] == 5, (
+        [o.kind for o in ops], trace)
+    assert len(ds.take_all()) == 5
+
+
+def test_custom_rule_registration(ray_cluster):
+    from ray_tpu.data import plan as plan_mod
+
+    class DropNoopRename(plan_mod.Rule):
+        name = "drop_noop_rename"
+
+        def apply(self, sources, ops, trace):
+            out = [o for o in ops
+                   if not (o.kind == "rename_columns"
+                           and not o.kw.get("mapping"))]
+            if len(out) != len(ops):
+                trace.append("drop_noop_rename: removed no-op rename")
+            return sources, out
+
+    ds = rd.range(5).rename_columns({})
+    rules = plan_mod.DEFAULT_RULES + [DropNoopRename()]
+    _, ops, trace = plan_mod.optimize(
+        list(ds._sources), list(ds._ops), rules=rules)
+    assert not any(o.kind == "rename_columns" for o in ops)
+    assert any("drop_noop_rename" in t for t in trace)
