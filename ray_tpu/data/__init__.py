@@ -1,4 +1,5 @@
-from .block import BlockAccessor, to_block
+from .block import (BlockAccessor, SchemaMismatchError, normalize_schema,
+                    to_block)
 from .context import (BackpressurePolicy, ConcurrencyCapPolicy, DataContext,
                       MemoryBudgetPolicy)
 from .dataset import Dataset, MaterializedDataset

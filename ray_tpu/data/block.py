@@ -173,3 +173,59 @@ class BlockAccessor:
     def concat(blocks: List["pa.Table"]) -> "pa.Table":
         blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
         return pa.concat_tables(blocks, promote_options="default")
+
+
+class SchemaMismatchError(TypeError):
+    """A block violated an enforced schema contract (strict-schema
+    analog of the reference's strict-mode type checks; raised inside the
+    producing task so the failure names the offending stage, not a
+    downstream consumer)."""
+
+
+def normalize_schema(schema) -> "pa.Schema":
+    """Accept a ``pa.Schema`` or a ``{name: type}`` mapping — values may
+    be arrow ``DataType``s, numpy/str dtype specs, or ``str``/``object``
+    (mapped to ``pa.string()``, the type text columns actually carry)."""
+    if isinstance(schema, pa.Schema):
+        return schema
+    if isinstance(schema, dict):
+        fields = []
+        for k, v in schema.items():
+            if isinstance(v, pa.DataType):
+                fields.append((k, v))
+                continue
+            if v in (str, "str", "string", "object", object):
+                fields.append((k, pa.string()))
+                continue
+            fields.append((k, pa.from_numpy_dtype(np.dtype(v))))
+        return pa.schema(fields)
+    raise TypeError(f"schema must be a pyarrow.Schema or dict, "
+                    f"got {type(schema)}")
+
+
+def check_schema(block: "pa.Table", expected: "pa.Schema",
+                 where: str = "enforce_schema") -> None:
+    """Exact-contract validation: column names (order-insensitive) and
+    arrow types must match. Raises SchemaMismatchError naming every
+    difference — silent promotion is exactly what a schema contract
+    exists to prevent."""
+    if block.num_rows == 0:
+        # A fully-filtered block carries whatever schema its producer
+        # left (possibly the pre-map input schema) — there are no rows
+        # to violate the contract.
+        return
+    got = {f.name: f.type for f in block.schema}
+    want = {f.name: f.type for f in expected}
+    problems = []
+    for name in want.keys() - got.keys():
+        problems.append(f"missing column {name!r} ({want[name]})")
+    for name in got.keys() - want.keys():
+        problems.append(f"unexpected column {name!r} ({got[name]})")
+    for name in want.keys() & got.keys():
+        if want[name] != got[name]:
+            problems.append(
+                f"column {name!r}: expected {want[name]}, got {got[name]}")
+    if problems:
+        raise SchemaMismatchError(
+            f"[{where}] block schema violates the enforced contract: "
+            + "; ".join(sorted(problems)))

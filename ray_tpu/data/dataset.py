@@ -76,7 +76,11 @@ class _Op:
                 out_batches.append(to_block(res))
             return BlockAccessor.concat(out_batches) if out_batches else block
         if self.kind == "map":
-            return to_block([self.fn(r) for r in acc.rows()])
+            rows = [self.fn(r) for r in acc.rows()]
+            # Empty block: keep a 0-row slice (to_block([]) would invent
+            # an 'item' column and destroy the schema for downstream
+            # contracts/concat).
+            return to_block(rows) if rows else block.slice(0, 0)
         if self.kind == "flat_map":
             out: List[dict] = []
             for r in acc.rows():
@@ -127,6 +131,12 @@ class _Op:
             # executor's limit operator).
             n = self.kw["n"]
             return block if acc.num_rows() <= n else block.slice(0, n)
+        if self.kind == "enforce_schema":
+            from .block import check_schema
+
+            check_schema(block, self.kw["schema"],
+                         where=self.kw.get("where", "enforce_schema"))
+            return block
         raise ValueError(f"unknown op {self.kind}")
 
 
@@ -629,6 +639,21 @@ class Dataset:
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         return self._with_op(_Op("rename_columns", mapping=mapping))
+
+    def enforce_schema(self, schema) -> "Dataset":
+        """Strict-schema contract (the reference's strict-mode type
+        discipline as an explicit operator): every block flowing past
+        this point must match ``schema`` exactly — column names
+        (order-insensitive) and arrow types. Violations raise
+        ``SchemaMismatchError`` inside the PRODUCING task, naming every
+        difference, instead of being silently promoted by downstream
+        concat. ``schema`` is a ``pyarrow.Schema`` or a ``{name:
+        numpy-dtype}`` mapping."""
+        from .block import normalize_schema
+
+        return self._with_op(
+            _Op("enforce_schema", schema=normalize_schema(schema),
+                where=f"enforce_schema@op{len(self._ops)}"))
 
     # ------------------------------------------------------- execution
 

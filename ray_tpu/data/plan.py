@@ -40,7 +40,7 @@ from typing import Any, List, Tuple
 # them). filter / flat_map / map_batches can change the count; exchange
 # boundaries reorder.
 _ROW_PRESERVING = {"map", "add_column", "select_columns", "drop_columns",
-                   "rename_columns"}
+                   "rename_columns", "enforce_schema"}
 
 
 class Rule:
