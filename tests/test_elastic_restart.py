@@ -143,7 +143,12 @@ def test_elastic_dip_and_recover_2_1_2(cluster, tmp_path):
         scaling_config=ScalingConfig(num_workers=2, jax_distributed=True,
                                      elastic_min_workers=1),
         run_config=RunConfig(storage_path=str(tmp_path), name="elastic",
-                             failure_config=FailureConfig(max_failures=2)))
+                             # 3, not 2: on a loaded host a slow heartbeat
+                             # during the re-form can count a surviving
+                             # rank as a second failure — one unit of
+                             # headroom keeps the test about elasticity,
+                             # not scheduler jitter.
+                             failure_config=FailureConfig(max_failures=3)))
     res = trainer.fit()
     assert res.error is None, res.error
     # Finished all steps, RE-GROWN to the 2-worker mesh after the dip.
@@ -190,8 +195,11 @@ def test_elastic_scale_up_from_constrained_start(tmp_path):
         os.makedirs(run_dir, exist_ok=True)
         trainer = JaxTrainer(
             _train_loop,
+            # 16 steps (same lesson as the dip test): the world-1 phase
+            # needs runway for add_node + re-form on a loaded host; with
+            # 10 steps the growth can land after the final report.
             train_loop_config={"run_dir": run_dir, "step_sleep": 0.4,
-                               "crash": False},
+                               "crash": False, "total_steps": 16},
             scaling_config=ScalingConfig(
                 num_workers=2, jax_distributed=True, elastic_min_workers=1,
                 resources_per_worker={"CPU": 1, "trainslot": 1},
@@ -219,7 +227,7 @@ def test_elastic_scale_up_from_constrained_start(tmp_path):
         res = trainer.fit()
         t.join()
         assert res.error is None, res.error
-        assert res.metrics["step"] == TOTAL_STEPS - 1
+        assert res.metrics["step"] == 15
         assert res.metrics["world"] == 2, (
             f"run never grew to 2: final world={res.metrics['world']}")
         assert res.metrics["resumed_from"] >= 1  # grew from a checkpoint
