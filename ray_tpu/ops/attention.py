@@ -258,6 +258,146 @@ def _tpu_flash(q, k, v, causal: bool, scale: float) -> jax.Array:
     return ot.transpose(0, 2, 1, 3)
 
 
+def _flash_stats_kernel(q_ref, k_ref, v_ref, vis_ref, o_ref, m_ref, l_ref,
+                        *, scale, block_k, seq_len_k):
+    """Flash block with ONLINE-SOFTMAX STATS OUT — the composable unit of
+    ring attention (ring steps merge (o, m, l) across devices; a
+    normalizing kernel cannot compose). Per program: q [block_q, D],
+    full K/V [Lk, D] for this head, vis [block_q, 1] = per-row count of
+    visible key columns (global causal masking precomputed by the
+    caller — keeps traced ring offsets out of kernel scalars).
+    Outputs: o UNnormalized [block_q, D], m/l stats [block_q, 1].
+
+    Masked entries use the finite NEG_INF: a fully-masked row yields
+    m = NEG_INF and junk o/l, which the ring merge then multiplies by
+    beta = exp(NEG_INF - m_new) = 0 — same contract as the dense
+    ring _block_attn (parallel/ring_attention.py)."""
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape
+    q = q_ref[...].astype(jnp.float32) * scale
+    vis = vis_ref[...]  # [block_q, 1] int32
+
+    def body(i, carry):
+        o_acc, m_acc, l_acc = carry
+        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        cols = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(cols < vis, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_acc, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1)
+        o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, seq_len_k // block_k, body,
+                                (o0, m0, l0))
+    o_ref[...] = o
+    m_ref[...] = m[:, None]
+    l_ref[...] = l[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_q", "block_k",
+                                             "interpret"))
+def _flash_stats_bhld(q, k, v, visible, scale, block_q, block_k,
+                      interpret):
+    """q,k,v: [BH, L, D]; visible: [BH, Lq, 1] int32 per-row visible-col
+    counts. Returns (o [BH,Lq,D] unnormalized f32, m [BH,Lq] f32,
+    l [BH,Lq] f32)."""
+    from jax.experimental import pallas as pl
+
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    if Lq % block_q or Lk % block_k:
+        raise ValueError(f"L ({Lq},{Lk}) must tile ({block_q},{block_k})")
+    grid = (BH, Lq // block_q)
+    kernel = functools.partial(_flash_stats_kernel, scale=scale,
+                               block_k=block_k, seq_len_k=Lk)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lq, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Lq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, visible)
+    return o, m[..., 0], l[..., 0]
+
+
+def flash_attention_stats(q, k, v, visible, scale: Optional[float] = None,
+                          block_q: int = 512, block_k: int = 512,
+                          interpret: Optional[bool] = None):
+    """Ring-composable flash block: [B, L, H, D] in, unnormalized
+    ``(o [B,Lq,H,D] f32, m [B,H,Lq] f32, l [B,H,Lq] f32)`` out.
+
+    FORWARD-ONLY (no VJP is defined for the stats kernel yet) — the
+    ring path keeps this opt-in for inference/long-context serving.
+    VMEM residency: each program holds this head's full K/V
+    ([Lk, D] f32 each) plus block-sized tiles, which bounds practical
+    shard lengths to Lk*D*8B within the per-core VMEM budget (e.g.
+    Lk=16k at D=128 is ~16 MiB); gridding K/V into block_k_major tiles
+    (as Mosaic's kernel does) is the lift that removes the bound.
+
+    ``visible``: [B, H, Lq] int32 — per-row count of visible key columns
+    (Lk for unmasked rows, 0 for fully-masked rows; ring callers derive
+    it from global q/k offsets, which keeps traced offsets out of the
+    kernel). K/V may carry fewer heads (GQA) — repeated here.
+    """
+    B, Lq, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    Hk = k.shape[2]
+    if Hk != H:
+        k = jnp.repeat(k, H // Hk, axis=2)
+        v = jnp.repeat(v, H // Hk, axis=2)
+    Lk = k.shape[1]
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    def pick(limit, L):
+        # Largest 128-multiple block <= limit that DIVIDES L (so any
+        # L % 128 == 0 tiles — 768 would reject a blind min(512, L)).
+        for b in (limit, 512, 384, 256, 128):
+            if b <= limit and L % b == 0:
+                return b
+        return min(limit, L)
+
+    bq = pick(min(block_q, Lq), Lq)
+    bk = pick(min(block_k, Lk), Lk)
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    visf = visible.reshape(B * H, Lq, 1).astype(jnp.int32)
+    o, m, l = _flash_stats_bhld(qf, kf, vf, visf, scale, bq, bk, interpret)
+    o = o.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)
+    return o, m.reshape(B, H, Lq), l.reshape(B, H, Lq)
+
+
 def pallas_flash_reference(q, k, v, causal: bool = False,
                            scale: Optional[float] = None,
                            block_q: int = 128, block_k: int = 128,

@@ -47,14 +47,32 @@ def _block_attn(q, k, v, bias, scale):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis: str = "sp", causal: bool = False,
                    scale: Optional[float] = None,
-                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                   segment_ids: Optional[jax.Array] = None,
+                   block_impl: str = "auto") -> jax.Array:
     """Exact attention with KV rotating around the ``axis`` ring.
 
     Args (per-device shards, inside shard_map):
       q, k, v: [B, L_local, H, D]
       causal: apply causal mask in *global* coordinates.
+      block_impl: the per-ring-step attention —
+        * ``"dense"``: einsum scores (materializes [B,H,Lq,Lk] fp32 per
+          step — fine at short shards, the CPU-test oracle);
+        * ``"flash"``: the in-tree Pallas stats kernel
+          (``ops.attention.flash_attention_stats``): O(block) memory, so
+          the per-device footprint stays O(L_local·D) even at long
+          shards — flash WITHIN the shard, ring ACROSS shards;
+        * ``"auto"`` (default): dense — the flash path is FORWARD-ONLY
+          (the stats kernel has no VJP yet), so training paths must not
+          silently route through it; opt into ``"flash"`` for
+          inference/long-context serving forwards.
     Returns: [B, L_local, H, D]
     """
+    if segment_ids is not None:
+        raise NotImplementedError(
+            "ring_attention does not apply segment masking; use "
+            "dense_attention(segment_ids=...) or pad documents apart "
+            "(silently ignoring the mask would cross document "
+            "boundaries)")
     B, Lq, H, D = q.shape
     # GQA KV stays in grouped form while rotating around the ring (1/group
     # the ICI bytes); heads are repeated per-block inside _block_attn.
@@ -63,30 +81,47 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     my_idx = lax.axis_index(axis)
     if scale is None:
         scale = D ** -0.5
+    if block_impl == "auto":
+        block_impl = "dense"
 
     q32 = q.astype(jnp.float32)
 
     def step(carry, i):
         o_acc, m_acc, l_acc, kv = carry
         k_blk, v_blk = kv
-        if kv_rep > 1:
-            k_cmp = jnp.repeat(k_blk, kv_rep, axis=2)
-            v_cmp = jnp.repeat(v_blk, kv_rep, axis=2)
-        else:
-            k_cmp, v_cmp = k_blk, v_blk
         src_idx = (my_idx - i) % n  # whose KV block we currently hold
-        bias = None
-        if causal:
-            # Global positions: q row r on this device = my_idx*Lq + r;
-            # kv col c in this block = src_idx*Lk + c.
-            Lk = k_blk.shape[1]
-            q_pos = my_idx * Lq + jnp.arange(Lq)
-            k_pos = src_idx * Lk + jnp.arange(Lk)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            bias = jnp.where(mask, 0.0, NEG_INF)[None, None]
-        o_blk, m_blk, l_blk = _block_attn(
-            q32, k_cmp.astype(jnp.float32), v_cmp.astype(jnp.float32),
-            bias, scale)
+        Lk = k_blk.shape[1]
+        if block_impl == "flash":
+            from ray_tpu.ops.attention import flash_attention_stats
+
+            if causal:
+                # Per-row visible-column count in THIS block's local
+                # coordinates: row r sees global cols <= my_idx*Lq + r,
+                # i.e. local cols < my_idx*Lq + r - src_idx*Lk + 1.
+                q_pos = my_idx * Lq + jnp.arange(Lq)
+                vis_row = jnp.clip(q_pos - src_idx * Lk + 1, 0, Lk)
+            else:
+                vis_row = jnp.full((Lq,), Lk, jnp.int32)
+            visible = jnp.broadcast_to(vis_row[None, None, :], (B, H, Lq))
+            o_blk, m_blk, l_blk = flash_attention_stats(
+                q, k_blk, v_blk, visible, scale=scale)
+        else:
+            if kv_rep > 1:
+                k_cmp = jnp.repeat(k_blk, kv_rep, axis=2)
+                v_cmp = jnp.repeat(v_blk, kv_rep, axis=2)
+            else:
+                k_cmp, v_cmp = k_blk, v_blk
+            bias = None
+            if causal:
+                # Global positions: q row r on this device = my_idx*Lq+r;
+                # kv col c in this block = src_idx*Lk + c.
+                q_pos = my_idx * Lq + jnp.arange(Lq)
+                k_pos = src_idx * Lk + jnp.arange(Lk)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                bias = jnp.where(mask, 0.0, NEG_INF)[None, None]
+            o_blk, m_blk, l_blk = _block_attn(
+                q32, k_cmp.astype(jnp.float32), v_cmp.astype(jnp.float32),
+                bias, scale)
         # Online-softmax merge of (o_acc, m_acc, l_acc) with the new block.
         m_new = jnp.maximum(m_acc, m_blk)
         alpha = jnp.exp(m_acc - m_new)  # rescale old accumulator
@@ -110,16 +145,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def make_ring_attention(mesh, *, causal: bool = True, axis: str = "sp",
-                        batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
+                        batch_axes=("dp", "fsdp"), head_axis: str = "tp",
+                        block_impl: str = "auto"):
     """shard_map-wrapped ring attention over a full mesh.
 
     q/k/v are global arrays [B, L, H, D]; batch sharded over ``batch_axes``,
-    sequence over ``axis``, heads over ``head_axis``.
+    sequence over ``axis``, heads over ``head_axis``. ``block_impl``
+    selects the per-step attention (see ``ring_attention``).
     """
     from jax.sharding import PartitionSpec as P
 
     spec = P(batch_axes, axis, head_axis, None)
-    fn = functools.partial(ring_attention, axis=axis, causal=causal)
+    fn = functools.partial(ring_attention, axis=axis, causal=causal,
+                           block_impl=block_impl)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

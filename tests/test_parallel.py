@@ -136,3 +136,49 @@ def test_ring_attention_grad(cpu_mesh8):
     g_dense = jax.grad(loss_dense)(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
                                atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_blocks_match_dense(cpu_mesh8, causal):
+    """block_impl="flash": the Pallas stats kernel (interpret mode on
+    CPU) inside each ring step must reproduce full dense attention —
+    flash WITHIN the shard, ring ACROSS shards, incl. GQA kv heads."""
+    mesh = make_mesh(MeshSpec(sp=4), devices=cpu_mesh8[:4])
+    B, L, H, Hk, D = 1, 64, 4, 2, 16
+    key = jax.random.PRNGKey(7)
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, Hk, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, Hk, D), jnp.float32)
+    ring = make_ring_attention(mesh, causal=causal, batch_axes=("dp",),
+                               head_axis="tp", block_impl="flash")
+    out = ring(q, k, v)
+    expected = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_stats_unit():
+    """The composable stats contract: normalizing (o, m, l) directly
+    equals dense attention; fully-masked rows carry m == NEG_INF."""
+    from ray_tpu.ops.attention import NEG_INF, flash_attention_stats
+
+    B, L, H, D = 1, 32, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.float32)
+    vis = jnp.broadcast_to(jnp.arange(1, L + 1)[None, None, :],
+                           (B, H, L))  # causal within the block
+    o, m, l = flash_attention_stats(q, k, v, vis, block_q=16, block_k=16,
+                                    interpret=True)
+    got = o / l.transpose(0, 2, 1)[..., None]
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    # Fully-masked rows (visible=0) must flag themselves via m=NEG_INF
+    # so a ring merge zeroes them with beta=exp(m - m_new).
+    vis0 = jnp.zeros((B, H, L), jnp.int32)
+    _, m0, _ = flash_attention_stats(q, k, v, vis0, block_q=16,
+                                     block_k=16, interpret=True)
+    assert float(jnp.max(m0)) == float(np.float32(NEG_INF))
