@@ -70,10 +70,20 @@ def _save(record: dict) -> str:
 
 
 def main():
-    probe = bench.acquire_tpu()
-    if not probe.get("ok"):
-        print(json.dumps({"error": "tpu unavailable", "diag": probe}))
-        return 1
+    # TPU_INFER_CPU_SMOKE=1: run the ENTIRE harness on CPU with tiny
+    # shapes — every code path (sweep, int8, engine, prefill, record
+    # assembly) executes, so a latent bug cannot wait for a tunnel
+    # window to surface. Numbers are meaningless and never committed.
+    smoke = os.environ.get("TPU_INFER_CPU_SMOKE") == "1"
+    if smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        probe = bench.acquire_tpu()
+        if not probe.get("ok"):
+            print(json.dumps({"error": "tpu unavailable", "diag": probe}))
+            return 1
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -81,13 +91,18 @@ def main():
     from ray_tpu.models import LlamaConfig, generate_greedy
 
     dev = jax.devices()[0]
-    if dev.platform != "tpu":
+    if dev.platform != "tpu" and not smoke:
         print(json.dumps({"error": f"not a TPU: {dev}"}))
         return 1
 
-    cfg = LlamaConfig(vocab_size=32768, d_model=2048, n_layers=16,
-                      n_heads=16, n_kv_heads=8, d_ff=8192,
-                      max_seq_len=4096, dtype=jnp.bfloat16)
+    if smoke:
+        cfg = LlamaConfig(vocab_size=512, d_model=64, n_layers=2,
+                          n_heads=4, n_kv_heads=2, d_ff=128,
+                          max_seq_len=128, dtype=jnp.float32)
+    else:
+        cfg = LlamaConfig(vocab_size=32768, d_model=2048, n_layers=16,
+                          n_heads=16, n_kv_heads=8, d_ff=8192,
+                          max_seq_len=4096, dtype=jnp.bfloat16)
     from ray_tpu.models import init_params
 
     params = init_params(cfg, jax.random.PRNGKey(0))
@@ -95,9 +110,9 @@ def main():
     hbm_gbps = detect_hbm_gbps(dev)
     peak_flops = bench.detect_peak_flops(dev)
 
-    prompt_len, max_new = 128, 256
+    prompt_len, max_new = (16, 8) if smoke else (128, 256)
     rows = []
-    for batch in (1, 8, 32):
+    for batch in (1, 2) if smoke else (1, 8, 32):
         prompt = jax.random.randint(jax.random.PRNGKey(batch),
                                     (batch, prompt_len), 0, cfg.vocab_size)
         out = generate_greedy(params, prompt, cfg, max_new=max_new)
@@ -181,7 +196,8 @@ def main():
     def prefill(params, tokens, cfg):
         return forward(params, tokens, cfg, remat=False)
 
-    ptoks = jax.random.randint(jax.random.PRNGKey(7), (1, 2048), 0,
+    ptoks = jax.random.randint(jax.random.PRNGKey(7),
+                               (1, 64 if smoke else 2048), 0,
                                cfg.vocab_size)
     np.asarray(prefill(params, ptoks, cfg)[0, -1, :8])
     t0 = time.perf_counter()
@@ -190,7 +206,7 @@ def main():
         logits = prefill(params, ptoks, cfg)
     np.asarray(logits[0, -1, :8])
     pdt = (time.perf_counter() - t0) / reps
-    prefill_tok_s = 2048 / pdt
+    prefill_tok_s = ptoks.shape[1] / pdt
     prefill_mfu = 2 * n_params * prefill_tok_s / peak_flops
 
     champ = max(rows, key=lambda r: r["decode_tok_s"])
@@ -213,6 +229,10 @@ def main():
         },
         "ts": time.time(),
     }
+    if smoke:
+        record["extra"]["cpu_smoke"] = True
+        print(json.dumps(record))
+        return 0
     record["extra"]["record_file"] = _save(record)
     print(json.dumps(record))
     return 0
