@@ -355,9 +355,11 @@ def flash_attention_stats(q, k, v, visible, scale: Optional[float] = None,
     """Ring-composable flash block: [B, L, H, D] in, unnormalized
     ``(o [B,Lq,H,D] f32, m [B,H,Lq] f32, l [B,H,Lq] f32)`` out.
 
-    FORWARD-ONLY (no VJP is defined for the stats kernel yet) — the
-    ring path keeps this opt-in for inference/long-context serving.
-    VMEM residency: each program holds this head's full K/V
+    The stats kernel itself defines no VJP; gradients through the ring
+    flash path come from the RING-level custom VJP in
+    ``parallel/ring_attention.py`` (standard ring backward from the
+    final merged stats), which is what makes ``block_impl="flash"``
+    trainable. VMEM residency: each program holds this head's full K/V
     ([Lk, D] f32 each) plus block-sized tiles, which bounds practical
     shard lengths to Lk*D*8B within the per-core VMEM budget (e.g.
     Lk=16k at D=128 is ~16 MiB); gridding K/V into block_k_major tiles

@@ -61,10 +61,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
           (``ops.attention.flash_attention_stats``): O(block) memory, so
           the per-device footprint stays O(L_local·D) even at long
           shards — flash WITHIN the shard, ring ACROSS shards;
-        * ``"auto"`` (default): dense — the flash path is FORWARD-ONLY
-          (the stats kernel has no VJP yet), so training paths must not
-          silently route through it; opt into ``"flash"`` for
-          inference/long-context serving forwards.
+        * ``"auto"`` (default): flash on TPU when shapes tile (L_local
+          a multiple of 128, D >= 64), dense otherwise. The flash path
+          is DIFFERENTIABLE via a ring-level custom VJP (standard ring
+          backward: probabilities reconstructed from the final merged
+          stats, block grads chunked over keys, (dk, dv) rotating home
+          with their blocks).
     Returns: [B, L_local, H, D]
     """
     if segment_ids is not None:
@@ -82,7 +84,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if scale is None:
         scale = D ** -0.5
     if block_impl == "auto":
-        block_impl = "dense"
+        from ray_tpu.ops.attention import _on_tpu
+
+        block_impl = ("flash" if _on_tpu() and Lq % 128 == 0 and D >= 64
+                      else "dense")
+
+    if block_impl == "flash":
+        return _ring_attention_flash(q, k, v, axis, causal, scale)
 
     q32 = q.astype(jnp.float32)
 
@@ -91,37 +99,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k_blk, v_blk = kv
         src_idx = (my_idx - i) % n  # whose KV block we currently hold
         Lk = k_blk.shape[1]
-        if block_impl == "flash":
-            from ray_tpu.ops.attention import flash_attention_stats
-
-            if causal:
-                # Per-row visible-column count in THIS block's local
-                # coordinates: row r sees global cols <= my_idx*Lq + r,
-                # i.e. local cols < my_idx*Lq + r - src_idx*Lk + 1.
-                q_pos = my_idx * Lq + jnp.arange(Lq)
-                vis_row = jnp.clip(q_pos - src_idx * Lk + 1, 0, Lk)
-            else:
-                vis_row = jnp.full((Lq,), Lk, jnp.int32)
-            visible = jnp.broadcast_to(vis_row[None, None, :], (B, H, Lq))
-            o_blk, m_blk, l_blk = flash_attention_stats(
-                q, k_blk, v_blk, visible, scale=scale)
+        if kv_rep > 1:
+            k_cmp = jnp.repeat(k_blk, kv_rep, axis=2)
+            v_cmp = jnp.repeat(v_blk, kv_rep, axis=2)
         else:
-            if kv_rep > 1:
-                k_cmp = jnp.repeat(k_blk, kv_rep, axis=2)
-                v_cmp = jnp.repeat(v_blk, kv_rep, axis=2)
-            else:
-                k_cmp, v_cmp = k_blk, v_blk
-            bias = None
-            if causal:
-                # Global positions: q row r on this device = my_idx*Lq+r;
-                # kv col c in this block = src_idx*Lk + c.
-                q_pos = my_idx * Lq + jnp.arange(Lq)
-                k_pos = src_idx * Lk + jnp.arange(Lk)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                bias = jnp.where(mask, 0.0, NEG_INF)[None, None]
-            o_blk, m_blk, l_blk = _block_attn(
-                q32, k_cmp.astype(jnp.float32), v_cmp.astype(jnp.float32),
-                bias, scale)
+            k_cmp, v_cmp = k_blk, v_blk
+        bias = None
+        if causal:
+            # Global positions: q row r on this device = my_idx*Lq + r;
+            # kv col c in this block = src_idx*Lk + c.
+            q_pos = my_idx * Lq + jnp.arange(Lq)
+            k_pos = src_idx * Lk + jnp.arange(Lk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, NEG_INF)[None, None]
+        o_blk, m_blk, l_blk = _block_attn(
+            q32, k_cmp.astype(jnp.float32), v_cmp.astype(jnp.float32),
+            bias, scale)
         # Online-softmax merge of (o_acc, m_acc, l_acc) with the new block.
         m_new = jnp.maximum(m_acc, m_blk)
         alpha = jnp.exp(m_acc - m_new)  # rescale old accumulator
@@ -142,6 +135,161 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         step, (o0, m0, l0, (k, v)), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+# ------------------------------------------------------------------ flash
+# Trainable flash ring: custom VJP at the RING level. Forward runs the
+# stats-kernel scan (O(block) memory per step); backward is the standard
+# ring-attention backward — normalized probabilities are RECONSTRUCTED
+# from the final merged (m, l) stats (the flash-bwd trick), the block
+# gradient is computed chunked over keys, and (dk, dv) rotate around the
+# ring WITH their (k, v) blocks so after n steps every gradient shard is
+# home. This avoids defining cotangents for the kernel's raw (o, m, l)
+# outputs (the merge's max/exp coupling makes that error-prone); the
+# only primal output differentiated is the normalized attention.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_flash(q, k, v, axis, causal, scale):
+    out, _, _ = _ring_flash_forward(q, k, v, axis, causal, scale)
+    return out
+
+
+def _ring_flash_forward(q, k, v, axis, causal, scale):
+    from ray_tpu.ops.attention import flash_attention_stats
+
+    B, Lq, H, D = q.shape
+    n = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+
+    def step(carry, i):
+        o_acc, m_acc, l_acc, kv = carry
+        k_blk, v_blk = kv
+        Lk = k_blk.shape[1]
+        vis_row = _visible_rows(my_idx, (my_idx - i) % n, Lq, Lk, causal)
+        visible = jnp.broadcast_to(vis_row[None, None, :], (B, H, Lq))
+        o_blk, m_blk, l_blk = flash_attention_stats(
+            q, k_blk, v_blk, visible, scale=scale)
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_acc * alpha + l_blk * beta
+        o_new = (o_acc * alpha.transpose(0, 2, 1)[..., None]
+                 + o_blk * beta.transpose(0, 2, 1)[..., None])
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        return (o_new, m_new, l_new,
+                (lax.ppermute(k_blk, axis, perm),
+                 lax.ppermute(v_blk, axis, perm))), None
+
+    o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    (o, m, l, _), _ = lax.scan(step, (o0, m0, l0, (k, v)), jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    return out, m, l
+
+
+def _visible_rows(my_idx, src_idx, Lq, Lk, causal):
+    """Per-q-row count of visible key columns of the ``src_idx`` block,
+    in the block's local coordinates (global causal order)."""
+    if not causal:
+        return jnp.full((Lq,), Lk, jnp.int32)
+    q_pos = my_idx * Lq + jnp.arange(Lq)
+    return jnp.clip(q_pos - src_idx * Lk + 1, 0, Lk).astype(jnp.int32)
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale):
+    out, m, l = _ring_flash_forward(q, k, v, axis, causal, scale)
+    return out, (q, k, v, out, m, l)
+
+
+def _ring_flash_bwd(axis, causal, scale, res, dout):
+    q, k, v, out, m, l = res
+    B, Lq, H, D = q.shape
+    Hk = k.shape[2]
+    rep = H // Hk
+    n = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    q32 = q.astype(jnp.float32)
+    do = dout.astype(jnp.float32)
+    # D_i = do_i . out_i  — the softmax-grad rowsum, from final stats.
+    Di = jnp.einsum("bqhd,bqhd->bhq", do, out.astype(jnp.float32))
+    linv = 1.0 / l  # [B, H, Lq]
+
+    def block_grads(k_blk, v_blk, vis_row):
+        """(dq_partial, dk_blk, dv_blk) for one ring block, chunked over
+        keys so peak scratch is [B,H,Lq,C] with C<=512 (flash-class
+        memory in backward too)."""
+        Lk = k_blk.shape[1]
+        # Largest 128-multiple chunk <= 512 that DIVIDES Lk (shards like
+        # 640 pass the auto gate but 512 would not tile them).
+        C = next((c for c in (512, 384, 256, 128) if Lk % c == 0),
+                 Lk)
+        k_rep = jnp.repeat(k_blk, rep, axis=2).astype(jnp.float32)
+        v_rep = jnp.repeat(v_blk, rep, axis=2).astype(jnp.float32)
+        kc = k_rep.reshape(B, Lk // C, C, H, D)
+        vc = v_rep.reshape(B, Lk // C, C, H, D)
+
+        def chunk(carry, idx):
+            dq_acc = carry
+            kcb = kc[:, idx]
+            vcb = vc[:, idx]
+            cols = idx * C + jnp.arange(C)
+            mask = (cols[None, None, None, :]
+                    < vis_row[None, None, :, None])
+            s = jnp.einsum("bqhd,bkhd->bhqk", q32, kcb) * scale
+            # Mask BEFORE exp: a fully-masked row carries m = NEG_INF,
+            # and exp(s - NEG_INF) would be inf (inf*0 = nan downstream);
+            # masked-to-NEG_INF entries stay finite and are zeroed below.
+            s = jnp.where(mask, s, NEG_INF)
+            p = jnp.where(mask, jnp.exp(s - m[..., None])
+                          * linv[..., None], 0.0)
+            dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, do)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", do, vcb)
+            ds = p * (dp - Di[..., None])
+            dq_acc = dq_acc + jnp.einsum(
+                "bhqk,bkhd->bqhd", ds, kcb) * scale
+            dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, q32) * scale
+            return dq_acc, (dk_c, dv_c)
+
+        dq0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+        dq_p, (dk_chunks, dv_chunks) = lax.scan(
+            chunk, dq0, jnp.arange(Lk // C))
+        dk_rep = dk_chunks.transpose(1, 0, 2, 3, 4).reshape(B, Lk, H, D)
+        dv_rep = dv_chunks.transpose(1, 0, 2, 3, 4).reshape(B, Lk, H, D)
+        # GQA: fold the repeated query-head groups back onto the kv head.
+        dk_blk = dk_rep.reshape(B, Lk, Hk, rep, D).sum(axis=3)
+        dv_blk = dv_rep.reshape(B, Lk, Hk, rep, D).sum(axis=3)
+        return dq_p, dk_blk, dv_blk
+
+    def step(carry, i):
+        dq_acc, k_blk, v_blk, dk_blk, dv_blk = carry
+        src_idx = (my_idx - i) % n
+        Lk = k_blk.shape[1]
+        vis_row = _visible_rows(my_idx, src_idx, Lq, Lk, causal)
+        dq_p, dk_p, dv_p = block_grads(k_blk, v_blk, vis_row)
+        dq_acc = dq_acc + dq_p
+        dk_blk = dk_blk + dk_p
+        dv_blk = dv_blk + dv_p
+        # Rotate (k, v) AND their gradient shards together: after n
+        # steps every (dk, dv) lands back on its owner.
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        return (dq_acc,
+                lax.ppermute(k_blk, axis, perm),
+                lax.ppermute(v_blk, axis, perm),
+                lax.ppermute(dk_blk, axis, perm),
+                lax.ppermute(dv_blk, axis, perm)), None
+
+    dq0 = jnp.zeros((B, Lq, H, D), jnp.float32)
+    dk0 = jnp.zeros((B, k.shape[1], Hk, D), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_attention_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def make_ring_attention(mesh, *, causal: bool = True, axis: str = "sp",
