@@ -47,9 +47,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kh = _seq_to_heads(k, axis)
     vh = _seq_to_heads(v, axis)
     if attn_fn is None:
-        from ..ops.attention import dense_attention
+        # flash_attention == the Mosaic kernel (differentiable) on TPU
+        # when the full-seq shard tiles, dense otherwise — after the
+        # all-to-all each device holds the FULL sequence for its head
+        # subset, which is exactly the single-chip flash shape.
+        from ..ops.attention import flash_attention
 
-        out = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
     else:
         out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
     return _heads_to_seq(out, axis)
