@@ -82,6 +82,20 @@ CLIENT_SCRIPT = textwrap.dedent("""
     assert ray_tpu.get(c.incr.remote(np.full(200_000, 2.0))) == 2
     assert ray_tpu.get(c.incr.remote(np.full(200_000, 3.0))) == 5
 
+    # --- __main__-defined ARG classes ride the definition-export cache
+    # across the client relay: the class publishes once to the cluster
+    # KV; workers resolve the ~60-byte token (serialization.py).
+    class Payload:
+        def __init__(self, tag):
+            self.tag = tag
+
+    @ray_tpu.remote
+    def read_tag(p):
+        return p.tag
+
+    assert ray_tpu.get(read_tag.remote(Payload("a"))) == "a"
+    assert ray_tpu.get(read_tag.remote(Payload("b"))) == "b"
+
     ray_tpu.shutdown()
     print("CLIENT-OK")
 """)
