@@ -81,8 +81,23 @@ def _http_control(n: int = 300) -> float:
     for _ in range(n):
         call()
     rps = n / (time.perf_counter() - t0)
+
+    # Keep-alive floor: one persistent connection, same server.
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", loop_box["port"])
+    def ka_call():
+        conn.request("POST", "/bench", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        conn.getresponse().read()
+    ka_call()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ka_call()
+    ka_rps = n / (time.perf_counter() - t0)
+    conn.close()
     loop_box["loop"].call_soon_threadsafe(loop_box["loop"].stop)
-    return round(rps, 1)
+    return round(rps, 1), round(ka_rps, 1)
 
 
 def _rpc_control(n: int = 500) -> float:
@@ -202,6 +217,27 @@ def main():
     results["http_p50_ms"] = round(percentile(lats, 0.5) * 1000, 2)
     results["http_p99_ms"] = round(percentile(lats, 0.99) * 1000, 2)
 
+    # HTTP keep-alive: one persistent connection (what real serving
+    # clients do — the fresh-connection number above is dominated by
+    # TCP setup/teardown on both sides; same treatment as the control).
+    import http.client
+
+    hconn = http.client.HTTPConnection("127.0.0.1", port)
+
+    def http_ka_call():
+        hconn.request("POST", "/bench", body=b"{}", headers={
+            "Content-Type": "application/json"})
+        hconn.getresponse().read()
+
+    http_ka_call()
+    t0 = time.perf_counter()
+    N = 400
+    for _ in range(N):
+        http_ka_call()
+    results["http_keepalive_rps"] = round(
+        N / (time.perf_counter() - t0), 1)
+    hconn.close()
+
     # ----------------------------------------------------- RPC path
     from ray_tpu.serve.rpc_client import ServeRpcClient
 
@@ -274,10 +310,14 @@ def main():
     # control is best-effort: a control failure must not discard the
     # framework numbers measured above.
     try:
-        results["http_control_rps"] = _http_control()
+        ctrl, ka_ctrl = _http_control()
+        results["http_control_rps"] = ctrl
+        results["http_keepalive_control_rps"] = ka_ctrl
         results["http_overhead_pct"] = round(
-            (1 - results["http_rps"] / results["http_control_rps"]) * 100,
-            1)
+            (1 - results["http_rps"] / ctrl) * 100, 1)
+        if "http_keepalive_rps" in results:
+            results["http_keepalive_overhead_pct"] = round(
+                (1 - results["http_keepalive_rps"] / ka_ctrl) * 100, 1)
     except Exception as e:  # noqa: BLE001
         results["http_control_error"] = repr(e)
     try:
