@@ -46,7 +46,12 @@ while true; do
     git commit --no-verify -o "records/tpu_smoke_verbose_${sts}.txt" \
       -m "TPU window: verbose on-chip smoke record ${sts}" >>"$LOG" 2>&1
     timeout 1800 python benchmarks/tpu_kernels.py >>"$LOG" 2>&1
-    echo "$(date -u +%FT%TZ) kernels rc=$?  - window sequence done" >>"$LOG"
+    echo "$(date -u +%FT%TZ) kernels rc=$?" >>"$LOG"
+    # Second bench pass AFTER the kernel autotune landed: the 8k train
+    # config now rides the tuned flash blocks (flash_block_sizes reads
+    # records/flash_autotune.json); both records auto-commit, best wins.
+    timeout 1200 python bench.py >>"$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) post-autotune bench rc=$? - window done" >>"$LOG"
     sleep 300
   else
     echo "$ts probe: no chip (wedged or timeout)" >>"$LOG"
