@@ -220,27 +220,63 @@ def nodes() -> List[dict]:
     info = _worker_mod.global_worker().cluster_info()
     return [
         {"NodeID": n["node_id"].hex(), "Alive": n["alive"],
+         "State": n.get("state",
+                        "ALIVE" if n["alive"] else "DEAD"),
+         "Draining": n.get("draining", False),
+         "DrainReason": n.get("drain_reason", ""),
          "NodeManagerHostname": n["hostname"], "Resources": n["total"],
          "Available": n["avail"], "Workers": n["workers"]}
         for n in info["nodes"]
     ]
 
 
+def drain_node(node_id: str, *, reason: str = "",
+               deadline_s: Optional[float] = None) -> bool:
+    """Gracefully drain a node (lifecycle ``ALIVE -> DRAINING -> DEAD``;
+    reference: the ``DrainNode`` autoscaler protocol).
+
+    From the moment the GCS records the drain the scheduler places
+    nothing new on the node (tasks, actors, placement-group bundles),
+    restartable actors are proactively migrated elsewhere, and in-flight
+    tasks get until the deadline to finish; at the deadline the node is
+    force-transitioned to DEAD and the normal recovery paths (task retry,
+    lineage reconstruction, actor restart) complete the workload.
+
+    Args:
+        node_id: hex node id (see ``ray_tpu.nodes()`` /
+            ``ray_tpu.util.state.list_nodes``).
+        reason: human-readable drain reason, surfaced by the state API.
+        deadline_s: migration window; defaults to the ``drain_deadline_s``
+            config flag.
+    """
+    msg: Dict[str, Any] = {"t": "drain_node",
+                           "node_id": bytes.fromhex(node_id),
+                           "reason": reason}
+    if deadline_s is not None:
+        msg["deadline_s"] = float(deadline_s)
+    reply = _worker_mod.global_worker().request_gcs(msg)
+    return bool(reply.get("ok"))
+
+
 def cluster_resources() -> Dict[str, float]:
+    # DRAINING nodes are excluded: their capacity is leaving the cluster
+    # and nothing new can be placed on them.
     info = _worker_mod.global_worker().cluster_info()
     out: Dict[str, float] = {}
     for n in info["nodes"]:
-        if n["alive"]:
+        if n["alive"] and not n.get("draining"):
             for k, v in n["total"].items():
                 out[k] = out.get(k, 0.0) + v
     return out
 
 
 def available_resources() -> Dict[str, float]:
+    # DRAINING nodes are excluded (see cluster_resources): elastic
+    # consumers sizing against this must not count doomed capacity.
     info = _worker_mod.global_worker().cluster_info()
     out: Dict[str, float] = {}
     for n in info["nodes"]:
-        if n["alive"]:
+        if n["alive"] and not n.get("draining"):
             for k, v in n["avail"].items():
                 out[k] = out.get(k, 0.0) + v
     return out
@@ -248,7 +284,8 @@ def available_resources() -> Dict[str, float]:
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "kill", "cancel", "get_actor", "nodes", "drain_node",
+    "cluster_resources",
     "available_resources", "timeline", "ObjectRef", "ActorHandle", "ActorClass",
     "RemoteFunction", "TaskError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",

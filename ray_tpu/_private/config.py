@@ -46,6 +46,15 @@ class RayTpuConfig:
     gcs_wal_compact_every: int = 50_000
     health_check_interval_s: float = 5.0   # GCS->agent active pings
     health_check_failures: int = 3         # misses before node is dead
+    # ---- graceful node drain (ALIVE -> DRAINING -> DEAD)
+    drain_deadline_s: float = 30.0         # default migration window
+    preemption_poll_interval_s: float = 1.0  # agent notice-source poll
+    # Notice-source plug point: "file" polls preemption_notice_file (or
+    # <session_dir>/preempt-<node_id> when unset — the fake source tests
+    # and simulated fleets use), "gce" polls the GCE metadata server's
+    # preempted/maintenance-event keys, "none" disables the watcher.
+    preemption_notice_source: str = "file"
+    preemption_notice_file: str = ""
     # ---- memory monitor (0 disables; reference: memory_monitor.h)
     memory_monitor_threshold: float = 0.95
     memory_monitor_interval_s: float = 1.0

@@ -52,6 +52,16 @@ A_ALIVE = "alive"
 A_RESTARTING = "restarting"
 A_DEAD = "dead"
 
+# Node lifecycle states (reference: the DrainNode protocol in
+# autoscaler.proto + GCS node state transitions): ALIVE -> DRAINING ->
+# DEAD. A DRAINING node accepts no new placements (tasks, actors, PG
+# bundles); in-flight work gets until the drain deadline, after which the
+# node is force-transitioned to DEAD and normal recovery (task retry,
+# lineage reconstruction, actor restart) takes over.
+N_ALIVE = "ALIVE"
+N_DRAINING = "DRAINING"
+N_DEAD = "DEAD"
+
 
 def _res_fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
@@ -76,6 +86,13 @@ class NodeInfo:
         self.hostname = hostname
         self.agent_conn = agent_conn
         self.alive = True
+        # Graceful drain (ALIVE -> DRAINING -> DEAD): while draining the
+        # scheduler refuses new placements here; at drain_deadline the
+        # node is forced DEAD (timer handle kept for cancellation).
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_deadline = 0.0
+        self.drain_timer = None
         self.idle_workers: deque = deque()  # WorkerID
         self.workers: Set[WorkerID] = set()
         self.spawning = 0
@@ -90,6 +107,14 @@ class NodeInfo:
         if cpu_t <= 0:
             return 0.0
         return 1.0 - self.avail.get("CPU", 0.0) / cpu_t
+
+    def lifecycle_state(self) -> str:
+        if not self.alive:
+            return N_DEAD
+        return N_DRAINING if self.draining else N_ALIVE
+
+    def schedulable(self) -> bool:
+        return self.alive and not self.draining
 
 
 class WorkerInfo:
@@ -218,6 +243,10 @@ class ActorRecord:
         self.node_id: Optional[NodeID] = None
         self.addr_waiters: List[Tuple[protocol.Connection, dict]] = []
         self.death_cause: Optional[str] = None
+        # Set while the actor is proactively moved off a DRAINING node:
+        # the next worker death is an orchestrated migration, not a crash
+        # — restart without consuming the restart budget.
+        self.migrating = False
         # GCS-restart recovery (owner re-linked by worker_id on driver
         # reconnect; ``restored`` marks records awaiting re-claim).
         self.owner_wid: Optional[bytes] = None
@@ -423,7 +452,7 @@ class GcsServer:
         self.counters: Dict[str, float] = {
             "tasks_submitted": 0, "tasks_finished": 0, "tasks_failed": 0,
             "tasks_retried": 0, "actors_created": 0, "actors_restarted": 0,
-            "objects_stored": 0,
+            "actors_migrated": 0, "nodes_drained": 0, "objects_stored": 0,
         }
         # Durable state + crash recovery (reference: GCS tables through the
         # Redis store client, store_client_kv.cc, replayed by
@@ -1656,7 +1685,7 @@ class GcsServer:
 
     def _feasible_nodes(self, res: Dict[str, float]) -> List[NodeInfo]:
         return [n for n in self.nodes.values()
-                if n.alive and _res_fits(n.avail, res)]
+                if n.schedulable() and _res_fits(n.avail, res)]
 
     def _pick_node(self, record) -> Optional[NodeInfo]:
         """Hybrid policy: pack onto low-utilization nodes first, spill to
@@ -1668,7 +1697,10 @@ class GcsServer:
             bix = record.bundle if record.bundle is not None else 0
             node_id = pg.placement[bix]
             node = self.nodes.get(node_id)
-            if node is None or not node.alive:
+            # A DRAINING node dispatches nothing new, including work
+            # targeting bundles already reserved there — it pends until
+            # the drain resolves (deadline -> DEAD -> normal recovery).
+            if node is None or not node.schedulable():
                 return None
             if not _res_fits(pg.bundle_avail[bix], record.resources):
                 return None
@@ -1833,6 +1865,9 @@ class GcsServer:
         the CPU count. ``node.spawning`` tracks in-flight spawns so repeated
         scheduling passes never stampede the host with interpreter startups.
         """
+        if node.draining:
+            # No new worker processes on a node that is being vacated.
+            return
         actor_workers = sum(
             1 for wid in node.workers
             if (w := self.workers.get(wid)) is not None and w.state == W_ACTOR)
@@ -1966,14 +2001,143 @@ class GcsServer:
                                         "results": results})
         self._wake_scheduler()
 
+    # ------------------------------------------------------- graceful drain
+
+    async def _h_drain_node(self, client, msg):
+        """Begin a graceful drain of a node (reference: ``DrainNode``,
+        autoscaler.proto): no new placements from this moment, restartable
+        actors are proactively migrated, in-flight tasks get until the
+        deadline, then the node is forced DEAD with normal recovery.
+
+        Callers: the node agent self-reporting a preemption notice, the
+        autoscaler vacating an idle node before terminating it, and
+        operators via ``ray_tpu.drain_node``."""
+        node = self.nodes.get(NodeID(msg["node_id"]))
+        if node is None or not node.alive:
+            if msg.get("i") is not None:
+                client.conn.reply(msg, {"ok": False,
+                                        "err": "no such live node"})
+            return
+        raw_deadline = msg.get("deadline_s")
+        # `is not None`, not `or`: an explicit deadline_s=0 means "drain
+        # immediately", not "use the default".
+        deadline_s = (float(raw_deadline) if raw_deadline is not None
+                      else _cfg().drain_deadline_s)
+        reason = str(msg.get("reason") or "unspecified")
+        deadline = time.time() + max(0.0, deadline_s)
+        if node.draining:
+            # Repeated notices (agent poll, autoscaler rounds): keep the
+            # EARLIEST deadline — a drain can only get more urgent.
+            if deadline < node.drain_deadline:
+                node.drain_deadline = deadline
+                if node.drain_timer is not None:
+                    node.drain_timer.cancel()
+                node.drain_timer = asyncio.get_running_loop().call_later(
+                    max(0.0, deadline - time.time()),
+                    self._drain_deadline_expired, node.node_id)
+        else:
+            node.draining = True
+            node.drain_reason = reason
+            node.drain_deadline = deadline
+            self.counters["nodes_drained"] += 1
+            logger.info("draining node %s (%s, deadline in %.1fs)",
+                        node.node_id.hex()[:8], reason, deadline_s)
+            self._pub("node_events", {"event": "node_draining",
+                                      "node_id": node.node_id.hex(),
+                                      "reason": reason,
+                                      "deadline": deadline,
+                                      "hostname": node.hostname})
+            node.drain_timer = asyncio.get_running_loop().call_later(
+                max(0.0, deadline_s), self._drain_deadline_expired,
+                node.node_id)
+            # Proactive migration: every restartable actor on the node is
+            # restarted elsewhere NOW (while its state can still be
+            # rebuilt under controlled conditions) instead of dying with
+            # the hardware at the deadline.
+            for record in list(self.actors.values()):
+                if (record.node_id == node.node_id
+                        and record.state == A_ALIVE
+                        and record.max_restarts != 0):
+                    self._migrate_actor(record)
+            # Revoke worker leases on the node: the direct path pushes
+            # tasks straight to leased workers, bypassing the scheduler —
+            # without revocation a lease-holding driver would keep
+            # placing NEW work here. Revocation is graceful (the driver
+            # keeps the worker connection open until in-flight pushes
+            # finish) and the re-requested leases land elsewhere.
+            for w in list(self.workers.values()):
+                if w.node_id != node.node_id or w.leased_to is None:
+                    continue
+                owner = w.leased_to
+                self._release_lease(w)
+                if not owner.conn.closed:
+                    try:
+                        owner.conn.send({"t": "lease_revoked",
+                                         "wid": w.worker_id.binary()})
+                    except ConnectionError:
+                        pass
+        # Re-run scheduling: pending work parked on this node must move.
+        self._wake_scheduler()
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True,
+                                    "deadline": node.drain_deadline})
+
+    def _migrate_actor(self, record: ActorRecord):
+        """Move a restartable actor off its (draining) node: retire the
+        worker; the death path sees ``migrating`` and restarts the actor
+        through normal placement — which now excludes the draining node —
+        without consuming the restart budget (infrastructure loss, not an
+        actor crash)."""
+        record.migrating = True
+        worker = (self.workers.get(record.worker_id)
+                  if record.worker_id else None)
+        if worker is not None and not worker.conn.closed:
+            logger.info("migrating actor %s off draining node %s",
+                        record.actor_id.hex()[:8],
+                        record.node_id.hex()[:8] if record.node_id else "?")
+            try:
+                worker.conn.send({"t": "exit"})
+                return
+            except ConnectionError:
+                pass
+        # No live worker link: treat as already gone and re-place now.
+        record.migrating = False
+        record.state = A_RESTARTING
+        record.worker_id = None
+        record.addr = None
+        self._try_place_actor(record)
+
+    def _drain_deadline_expired(self, node_id: NodeID):
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive or not node.draining:
+            return
+        logger.warning("drain deadline expired for node %s (%s): forcing "
+                       "DEAD", node_id.hex()[:8], node.drain_reason)
+        self._pub("node_events", {"event": "drain_deadline_expired",
+                                  "node_id": node_id.hex(),
+                                  "reason": node.drain_reason})
+        # Retire the agent (and with it the node's worker processes); the
+        # death transition below runs the normal recovery paths for
+        # whatever was still in flight.
+        if node.agent_conn is not None and not node.agent_conn.closed:
+            try:
+                node.agent_conn.send({"t": "exit"})
+            except ConnectionError:
+                pass
+        self._on_node_death(node_id)
+
     def _on_node_death(self, node_id: NodeID):
         node = self.nodes.get(node_id)
         if node is None:
             return
         node.alive = False
+        if node.drain_timer is not None:
+            node.drain_timer.cancel()
+            node.drain_timer = None
         self._pub("node_events", {"event": "node_died",
                                   "node_id": node_id.hex(),
-                                  "hostname": node.hostname})
+                                  "hostname": node.hostname,
+                                  "was_draining": node.draining})
         for wid in list(node.workers):
             asyncio.get_running_loop().create_task(self._on_worker_death(wid))
 
@@ -2086,7 +2250,7 @@ class GcsServer:
             return
         demand: Dict[tuple, tuple] = {}  # (node_id, env_key) -> (n, spec)
         idle_left = sum(len(n.idle_workers) for n in self.nodes.values()
-                        if n.alive)
+                        if n.schedulable())
         for record in list(self._actor_pending_place.values()):
             if record.state not in (A_PENDING, A_RESTARTING):
                 self._actor_pending_place.pop(record.actor_id, None)
@@ -2094,7 +2258,7 @@ class GcsServer:
             if idle_left <= 0:
                 park_id = getattr(record, "park_node", None)
                 node = self.nodes.get(park_id) if park_id else None
-                if node is not None and node.alive:
+                if node is not None and node.schedulable():
                     key = (node.node_id, record.env_key)
                     cnt, _ = demand.get(key, (0, None))
                     demand[key] = (cnt + 1, record.env_spec)
@@ -2199,6 +2363,8 @@ class GcsServer:
                           cause: str):
         if no_restart:
             record.max_restarts = record.restarts_used
+            # An explicit kill overrides an in-flight drain migration.
+            record.migrating = False
         worker = self.workers.get(record.worker_id) if record.worker_id else None
         if worker is not None and not worker.conn.closed:
             worker.conn.send({"t": "exit"})
@@ -2213,6 +2379,18 @@ class GcsServer:
         if record is None:
             return
         self._release(worker, record)
+        if record.migrating:
+            # Orchestrated drain migration, not a crash: restart through
+            # normal placement (draining nodes excluded) without touching
+            # the restart budget.
+            record.migrating = False
+            self.counters["actors_migrated"] += 1
+            record.state = A_RESTARTING
+            record.worker_id = None
+            record.addr = None
+            logger.info("re-placing migrated actor %s", actor_id.hex()[:8])
+            self._try_place_actor(record)
+            return
         if (record.restarts_used < record.max_restarts
                 or record.max_restarts < 0):
             record.restarts_used += 1
@@ -2292,7 +2470,7 @@ class GcsServer:
         reference's 2PC prepare/commit, node_manager.h:507-512 — centralized
         here so a plain transactional update suffices)."""
         strategy = record.strategy
-        nodes = [n for n in self.nodes.values() if n.alive]
+        nodes = [n for n in self.nodes.values() if n.schedulable()]
         nodes.sort(key=lambda n: n.node_id.binary())
         staged: Dict[NodeID, Dict[str, float]] = {
             n.node_id: dict(n.avail) for n in nodes}
@@ -2518,6 +2696,10 @@ class GcsServer:
         out.append({"name": "gcs_alive_nodes", "tags": {}, "type": "gauge",
                     "value": float(sum(1 for n in self.nodes.values()
                                        if n.alive))})
+        out.append({"name": "gcs_draining_nodes", "tags": {},
+                    "type": "gauge",
+                    "value": float(sum(1 for n in self.nodes.values()
+                                       if n.alive and n.draining))})
         out.append({"name": "gcs_alive_actors", "tags": {}, "type": "gauge",
                     "value": float(sum(1 for a in self.actors.values()
                                        if a.state == A_ALIVE))})
@@ -2547,6 +2729,9 @@ class GcsServer:
             if busy or demands:
                 n.last_active = now
             nodes.append({"node_id": n.node_id.hex(), "alive": n.alive,
+                          "state": n.lifecycle_state(),
+                          "draining": n.draining, "busy": busy,
+                          "drain_deadline": n.drain_deadline,
                           "total": n.total, "avail": n.avail,
                           "idle_s": 0.0 if busy else now - n.last_active})
         # Explicit capacity requests (reference: autoscaler
@@ -2582,6 +2767,10 @@ class GcsServer:
         if kind == "nodes":
             for n in self.nodes.values():
                 out.append({"node_id": n.node_id.hex(), "alive": n.alive,
+                            "state": n.lifecycle_state(),
+                            "draining": n.draining,
+                            "drain_reason": n.drain_reason,
+                            "drain_deadline": n.drain_deadline,
                             "hostname": n.hostname, "total": n.total,
                             "avail": n.avail, "workers": len(n.workers)})
         elif kind == "workers":
@@ -2642,6 +2831,8 @@ class GcsServer:
 
     async def _h_cluster_info(self, client, msg):
         nodes = [{"node_id": n.node_id.binary(), "alive": n.alive,
+                  "state": n.lifecycle_state(), "draining": n.draining,
+                  "drain_reason": n.drain_reason,
                   "hostname": n.hostname, "total": n.total, "avail": n.avail,
                   "workers": len(n.workers)}
                  for n in self.nodes.values()]

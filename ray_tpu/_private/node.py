@@ -274,6 +274,83 @@ def worker_sys_path() -> str:
     return os.pathsep.join(out)
 
 
+# ---------------------------------------------------------------- drain
+# Preemption-notice sources (the pluggable half of the graceful-drain
+# subsystem): real TPU fleets get ADVANCE notice before a slice is
+# reclaimed (GCE preemption/maintenance signals); the agent polls a
+# source and self-reports a drain request to the GCS so work migrates
+# BEFORE the hardware disappears. Select with the
+# ``preemption_notice_source`` flag: "file" (default; also the fake
+# source chaos tests drive), "gce", or "none".
+
+
+class FilePreemptionSource:
+    """Notice = the watched file exists. Contents may be empty (defaults
+    apply) or JSON ``{"reason": ..., "deadline_s": ...}`` / plain text
+    (used as the reason)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def poll(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                raw = f.read().strip()
+        except OSError:
+            return None
+        notice = {"reason": f"preemption notice ({self.path})",
+                  "deadline_s": None}
+        if raw:
+            try:
+                data = json.loads(raw)
+            except ValueError:
+                data = raw
+            if isinstance(data, dict):
+                notice.update({k: data[k] for k in ("reason", "deadline_s")
+                               if k in data})
+            else:
+                notice["reason"] = str(data)
+        return notice
+
+
+class GceMetadataPreemptionSource:
+    """GCE metadata-shaped source: the instance ``preempted`` key flips to
+    TRUE (and ``maintenance-event`` becomes non-NONE) ahead of a
+    preemption — the advance signal Podracer-style preemptible TPU fleets
+    schedule around."""
+
+    BASE = "http://metadata.google.internal/computeMetadata/v1/instance/"
+    KEYS = (("preempted", "gce preemption"),
+            ("maintenance-event", "gce maintenance"))
+
+    def poll(self) -> Optional[dict]:
+        import urllib.request
+
+        for key, label in self.KEYS:
+            try:
+                req = urllib.request.Request(
+                    self.BASE + key, headers={"Metadata-Flavor": "Google"})
+                body = urllib.request.urlopen(
+                    req, timeout=1).read().decode().strip()
+            except Exception:
+                continue
+            if body and body.upper() not in ("FALSE", "NONE"):
+                return {"reason": f"{label}: {body}", "deadline_s": None}
+        return None
+
+
+def make_preemption_source(node_id: NodeID, session_dir: str):
+    """Build this node's notice source from config (None = disabled)."""
+    kind = _cfg().preemption_notice_source
+    if kind == "none":
+        return None
+    if kind == "gce":
+        return GceMetadataPreemptionSource()
+    path = _cfg().preemption_notice_file or os.path.join(
+        session_dir, f"preempt-{node_id.hex()}")
+    return FilePreemptionSource(path)
+
+
 class NodeAgent:
     """Per-node agent: registers the node, spawns/reaps workers."""
 
@@ -314,6 +391,57 @@ class NodeAgent:
         if _cfg().memory_monitor_threshold > 0:
             asyncio.get_running_loop().create_task(
                 self._memory_monitor_loop())
+        self._preempt_source = make_preemption_source(self.node_id,
+                                                      self.session_dir)
+        if self._preempt_source is not None:
+            asyncio.get_running_loop().create_task(
+                self._preemption_watch_loop())
+
+    async def _preemption_watch_loop(self):
+        """Poll the preemption-notice source; on notice, self-report a
+        drain request to the GCS (the node agent half of the graceful
+        drain protocol — the control plane stops placements, migrates
+        restartable actors, and forces DEAD at the deadline)."""
+        interval = _cfg().preemption_poll_interval_s
+        notified = False
+        while not self.stopped.is_set():
+            await asyncio.sleep(interval)
+            try:
+                # Executor thread: sources may block (GCE metadata HTTP /
+                # DNS) and must not stall the agent loop — a wedged loop
+                # misses GCS health checks and gets the node declared
+                # dead.
+                notice = await asyncio.get_running_loop().run_in_executor(
+                    None, self._preempt_source.poll)
+            except Exception:  # noqa: BLE001 — a broken source never
+                continue       # takes the agent down
+            if notice is None:
+                continue
+            if self.conn is None or self.conn.closed:
+                continue  # retry after reconnect: the notice must land
+            raw = notice.get("deadline_s")
+            deadline_s = (float(raw) if raw is not None
+                          else _cfg().drain_deadline_s)
+            try:
+                self.conn.send({
+                    "t": "drain_node", "node_id": self.node_id.binary(),
+                    "reason": notice.get("reason", "preemption notice"),
+                    "deadline_s": deadline_s})
+            except ConnectionError:
+                continue
+            if not notified:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "preemption notice on node %s: %s (drain deadline "
+                    "%.1fs)", self.node_id.hex()[:8], notice.get("reason"),
+                    deadline_s)
+            notified = True
+            # Keep polling and RE-SENDING (idempotent on the GCS — the
+            # earliest deadline wins): a fire-and-forget notice sent just
+            # before a GCS crash/restart would otherwise be lost forever,
+            # with the node silently accepting placements until the
+            # hardware vanishes.
 
     async def _memory_monitor_loop(self):
         """Host-memory OOM protection (reference: ``memory_monitor.h:52``

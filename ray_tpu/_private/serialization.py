@@ -59,42 +59,85 @@ def note_reduce_undo(undo) -> None:
 # the round-4 serve handle regression profiled exactly here (~0.29 ms of
 # cloudpickle per call vs ~20 us for the tokenized form).
 #
-# Semantics (same as the reference's export table): the definition is
-# frozen at first export — later mutation of the class body/closure is
-# not re-shipped.
+# Semantics: unlike the reference's frozen-at-registration export table,
+# a cached token is only reused while a cheap fingerprint of the
+# definition still matches — mutating a ``__main__`` class body /
+# attribute or a function's code/defaults/closure between sends
+# re-exports under the NEW content hash, so workers never silently run
+# stale code (the notebook re-def case ADVICE r5 flagged).
 
 _EXPORT_NS = "defexports"
 _export_lock = threading.Lock()
-# id(obj) -> (token, weakref). Weak so the cache never pins a definition
-# (a __main__ lambda closing over a large array must stay collectable);
-# the weakref doubles as the id-reuse guard — an entry only counts if its
-# referent IS the object being serialized. KV blobs are content-hashed,
-# so re-exporting an identical definition rewrites the same key (the GCS
-# export table is cluster-lifetime, as in the reference).
+# id(obj) -> (token, weakref, fingerprint). Weak so the cache never pins
+# a definition (a __main__ lambda closing over a large array must stay
+# collectable); the weakref doubles as the id-reuse guard — an entry only
+# counts if its referent IS the object being serialized. KV blobs are
+# content-hashed, so re-exporting an identical definition rewrites the
+# same key (the GCS export table is cluster-lifetime, as in the
+# reference).
 _export_by_id: dict = {}
 import weakref as _weakref
 _export_by_token: "_weakref.WeakValueDictionary" = \
     _weakref.WeakValueDictionary()
 
 
+def _definition_fingerprint(obj):
+    """Cheap mutation detector for a cached export. Identity-based: any
+    rebinding of a class attribute (monkeypatched method, changed class
+    attr) or of a function's code/defaults/closure cell produces new
+    constituent objects, so the id tuple changes. False negatives need a
+    recycled id at the same key — vanishingly rare for a notebook edit —
+    and cost only a stale-token reuse; false positives just re-export."""
+    try:
+        if isinstance(obj, types.FunctionType):
+            cells = ()
+            if obj.__closure__:
+                ids = []
+                for c in obj.__closure__:
+                    try:
+                        ids.append(id(c.cell_contents))
+                    except ValueError:  # empty cell
+                        ids.append(-1)
+                cells = tuple(ids)
+            return (id(obj.__code__), id(obj.__defaults__),
+                    id(obj.__kwdefaults__), cells)
+        return tuple((k, id(v)) for k, v in obj.__dict__.items())
+    except Exception:
+        return object()  # un-fingerprintable: never matches → re-export
+
+
 def _id_cache_get(obj):
     ent = _export_by_id.get(id(obj))
     if ent is None:
         return None
-    token, wr = ent
-    if wr() is obj:
-        return token
-    del _export_by_id[id(obj)]  # id reuse after GC — stale entry
-    return None
+    token, wr, fp = ent
+    if wr() is not obj:
+        _export_by_id.pop(id(obj), None)  # id reuse after GC — stale entry
+        return None
+    if fp != _definition_fingerprint(obj):
+        # Definition mutated since export: drop the token so this send
+        # re-exports the current body under its new content hash.
+        _export_by_id.pop(id(obj), None)
+        return None
+    return token
 
 
 def _id_cache_put(obj, token: str) -> None:
+    i = id(obj)
+    ent = None
+
+    def _evict(_):
+        # Pop only OUR entry: after CPython id reuse, this (delayed) GC
+        # callback must not evict a NEW object's live cache entry.
+        if _export_by_id.get(i) is ent:
+            _export_by_id.pop(i, None)
+
     try:
-        wr = _weakref.ref(
-            obj, lambda _, i=id(obj): _export_by_id.pop(i, None))
+        wr = _weakref.ref(obj, _evict)
     except TypeError:
         return  # not weakref-able: never cached, always re-tokenized
-    _export_by_id[id(obj)] = (token, wr)
+    ent = (token, wr, _definition_fingerprint(obj))
+    _export_by_id[i] = ent
 
 
 def reset_export_cache() -> None:

@@ -1034,6 +1034,8 @@ class Worker:
             self._on_lease_grant(msg)
         elif t == "lease_dead":
             self._on_lease_dead(msg)
+        elif t == "lease_revoked":
+            self._on_lease_revoked(msg)
         elif t == "lease_void":
             # The GCS voided our demand (e.g. the targeted placement
             # group was removed): queued tasks of this class can never
@@ -1408,6 +1410,28 @@ class Worker:
         cls, lease = entry
         self._on_lease_broken(cls, lease)
         # In-flight replies fail via the closing conn; just refresh demand.
+        self._pump_class(cls)
+
+    def _on_lease_revoked(self, msg: dict):
+        """Graceful lease revocation (node drain): stop pushing NEW tasks
+        through this lease, but leave its connection OPEN so in-flight
+        pushes finish normally — they have until the drain deadline. If
+        the worker dies at the deadline instead, the connection errors
+        and ``_on_exec_reply``'s normal retry path covers the remainder.
+        Replacement capacity is re-requested immediately; the GCS grants
+        it off the draining node."""
+        entry = self._leases_by_wid.get(bytes(msg["wid"]))
+        if entry is None:
+            return
+        cls, lease = entry
+        if lease.dead:
+            return
+        lease.dead = True  # _pump_class skips + drops dead leases
+        cls.leases.pop(lease.wid, None)
+        self._leases_by_wid.pop(lease.wid, None)
+        if lease.idle_handle is not None:
+            lease.idle_handle.cancel()
+            lease.idle_handle = None
         self._pump_class(cls)
 
     def _retain_spec(self, oid_b: bytes, key: str, wire: dict,

@@ -4,10 +4,13 @@ Analog of the reference's v2 ``InstanceManager``
 (``python/ray/autoscaler/v2/instance_manager/instance_manager.py:29``):
 every cloud instance the autoscaler owns moves through explicit states,
 
-    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
-                 |             |            |
-                 v             v            v
-         ALLOCATION_FAILED  TERMINATED  TERMINATED   (+ TERMINATING)
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING -> RAY_DRAINING
+                 |             |            |              |
+                 v             v            v              v
+         ALLOCATION_FAILED  TERMINATED  TERMINATED  TERMINATING/TERMINATED
+
+(RAY_DRAINING: the autoscaler requested a GCS drain for the node —
+scale-down vacates work before the provider instance is terminated.)
 
 and each ``reconcile()`` compares that ledger against two ground truths —
 what the PROVIDER still reports (cloud reality) and which nodes the GCS
@@ -35,11 +38,15 @@ QUEUED = "QUEUED"                    # decided to launch; not yet requested
 REQUESTED = "REQUESTED"              # provider.create_node in flight
 ALLOCATED = "ALLOCATED"              # cloud instance exists; ray not up yet
 RAY_RUNNING = "RAY_RUNNING"          # node registered alive with the GCS
+RAY_DRAINING = "RAY_DRAINING"        # GCS drain requested; vacating work
 TERMINATING = "TERMINATING"          # terminate requested, not yet gone
 TERMINATED = "TERMINATED"            # gone from the provider
 ALLOCATION_FAILED = "ALLOCATION_FAILED"
 
-LIVE_STATES = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+# RAY_DRAINING still counts as live capacity: the node exists until its
+# work migrates, and excluding it would make the demand scheduler launch
+# a replacement for a node being scaled DOWN.
+LIVE_STATES = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING, RAY_DRAINING)
 
 
 class Instance:
@@ -92,9 +99,19 @@ class InstanceManager:
             out.append(inst)
         return out
 
+    def drain(self, im_id: str, reason: str = "drain"):
+        """Mark an instance as vacating: a GCS drain was requested for its
+        node — terminate() follows once the GCS reports the node idle (or
+        dead), never while work is still running there."""
+        inst = self.instances.get(im_id)
+        if inst is None or inst.state != RAY_RUNNING:
+            return
+        inst.transition(RAY_DRAINING, reason)
+
     def terminate(self, im_id: str, reason: str = "requested"):
         inst = self.instances.get(im_id)
-        if inst is None or inst.state not in (ALLOCATED, RAY_RUNNING):
+        if inst is None or inst.state not in (ALLOCATED, RAY_RUNNING,
+                                              RAY_DRAINING):
             return
         try:
             self.provider.terminate_node(inst.cloud_instance_id)
@@ -175,7 +192,7 @@ class InstanceManager:
                     events.append({"event": "ray_running",
                                    "instance": inst.im_id,
                                    "type": inst.node_type})
-            elif inst.state == RAY_RUNNING:
+            elif inst.state in (RAY_RUNNING, RAY_DRAINING):
                 if inst.cloud_instance_id not in cloud:
                     # The cloud took the instance back (TPU preemption /
                     # maintenance): detect and release its capacity.

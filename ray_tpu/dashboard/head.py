@@ -80,6 +80,8 @@ class DashboardActor:
                       if a.get("state") == "alive"]
             return {
                 "num_nodes": len([n for n in nodes if n["Alive"]]),
+                "num_draining": len([n for n in nodes
+                                     if n.get("State") == "DRAINING"]),
                 "resources": ray_tpu.cluster_resources(),
                 "available": ray_tpu.available_resources(),
                 "num_actors": len(actors),

@@ -1045,8 +1045,10 @@ class Dataset:
                         idle_streak = 0
                 else:
                     idle_streak = 0
-            stats["final"] = len(pool)
         finally:
+            # In finally: an early generator close (downstream take/limit
+            # stopping iteration) must still record the autoscaled size.
+            stats["final"] = len(pool)
             for a in pool:
                 try:
                     ray_tpu.kill(a)

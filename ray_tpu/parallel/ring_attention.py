@@ -19,6 +19,7 @@ Use inside ``jax.shard_map`` with sequence dim sharded on ``sp``:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -26,6 +27,14 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+
+# Per-core VMEM the ``auto`` gate lets the flash kernel's resident K/V
+# shard occupy (TPU VMEM is ~16 MiB/core; half leaves headroom for the
+# Q/O tiles and double buffering). Shards whose ~Lk*D*8B footprint
+# exceeds this fall back to the dense ring step instead of failing at
+# runtime. Override: RAY_TPU_FLASH_KV_VMEM_BUDGET (bytes).
+_FLASH_KV_VMEM_BUDGET = int(
+    os.environ.get("RAY_TPU_FLASH_KV_VMEM_BUDGET", 8 << 20))
 
 
 def _block_attn(q, k, v, bias, scale):
@@ -62,7 +71,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
           the per-device footprint stays O(L_local·D) even at long
           shards — flash WITHIN the shard, ring ACROSS shards;
         * ``"auto"`` (default): flash on TPU when shapes tile (L_local
-          a multiple of 128, D >= 64), dense otherwise. The flash path
+          a multiple of 128, D >= 64) AND the resident K/V shard fits
+          the per-core VMEM budget (``_FLASH_KV_VMEM_BUDGET``), dense
+          otherwise. The flash path
           is DIFFERENTIABLE via a ring-level custom VJP (standard ring
           backward: probabilities reconstructed from the final merged
           stats, block grads chunked over keys, (dk, dv) rotating home
@@ -86,7 +97,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if block_impl == "auto":
         from ray_tpu.ops.attention import _on_tpu
 
+        # The flash stats kernel keeps the full per-head K/V shard
+        # resident in VMEM (~Lk*D*8B for fp32 K+V); above the per-core
+        # budget it would OOM/spill at runtime where dense gridding would
+        # not — fall back to dense until the kernel grids K/V into
+        # block_k_major tiles.
+        kv_resident_bytes = k.shape[1] * D * 8
         block_impl = ("flash" if _on_tpu() and Lq % 128 == 0 and D >= 64
+                      and kv_resident_bytes <= _FLASH_KV_VMEM_BUDGET
                       else "dense")
 
     if block_impl == "flash":

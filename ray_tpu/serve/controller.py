@@ -55,6 +55,13 @@ class ServeController:
     def _health_loop(self, period: float):
         while not self._stop_health.wait(period):
             try:
+                # Drain first: replicas on DRAINING nodes are replaced
+                # proactively (new replicas healthy BEFORE the old stop),
+                # so check_health never sees them as surprise deaths.
+                self.check_drain()
+            except Exception:
+                pass
+            try:
                 self.check_health()
             except Exception:
                 pass  # transient cluster churn; next period retries
@@ -147,6 +154,69 @@ class ServeController:
             dep["replicas"] = cur[:num_replicas]
         self._notify(app_name, deployment_name)
         return True
+
+    def check_drain(self):
+        """Vacate replicas off DRAINING nodes (graceful node drain).
+
+        For every replica whose node the GCS reports as draining: spawn a
+        replacement (the scheduler already refuses draining nodes), wait
+        for it to come healthy, publish the new replica set so routers /
+        handles stop sending the old replica traffic, THEN kill the old
+        one — requests in flight on it finish; no request ever lands on a
+        replica that is about to vanish with its node."""
+        from ray_tpu.util import state as state_api
+
+        try:
+            draining_nodes = {n["node_id"] for n in state_api.list_nodes()
+                              if n.get("draining") and n.get("alive")}
+        except Exception:
+            return 0
+        if not draining_nodes:
+            return 0
+        try:
+            actor_node = {a["actor_id"]: a["node_id"]
+                          for a in state_api.list_actors(limit=100000)}
+        except Exception:
+            return 0
+        moved = 0
+        for app_name, app in self.apps.items():
+            for dep in app.values():
+                doomed = [r for r in dep["replicas"]
+                          if actor_node.get(r._id.hex()) in draining_nodes]
+                if not doomed:
+                    continue
+                spec = dep["spec"]
+                fresh = [_spawn_replica(app_name, spec) for _ in doomed]
+                if spec.get("user_config") is not None:
+                    for r in fresh:
+                        try:
+                            ray_tpu.get(r.reconfigure.remote(
+                                spec["user_config"]), timeout=30)
+                        except Exception:
+                            pass
+                try:
+                    ray_tpu.get([r.health_check.remote() for r in fresh],
+                                timeout=30)
+                except Exception:
+                    # Replacements not up (e.g. no capacity left): keep
+                    # the old replicas serving until the next round — a
+                    # draining node still works until its deadline.
+                    for r in fresh:
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                    continue
+                dep["replicas"] = [r for r in dep["replicas"]
+                                   if r not in doomed] + fresh
+                moved += len(doomed)
+                self._notify(app_name, spec["name"])
+                for r in doomed:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+        return moved
 
     def check_health(self):
         """Replace dead replicas (reference: DeploymentState health loop)."""

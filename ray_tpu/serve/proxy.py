@@ -48,6 +48,32 @@ class ProxyActor:
     def _reindex_routes(self):
         self._route_order = sorted(self.handles, key=len, reverse=True)
 
+    def _node_draining(self) -> bool:
+        """Is THIS proxy's node draining? (cached ~5s). External load
+        balancers watch the health endpoints; flipping them to "draining"
+        the moment the GCS records the drain lets the LB stop sending new
+        connections before the node goes away."""
+        import time as _time
+
+        now = _time.monotonic()
+        cached = getattr(self, "_drain_cache", None)
+        if cached is not None and now - cached[0] < 5.0:
+            return cached[1]
+        draining = False
+        try:
+            from ray_tpu import get_runtime_context
+            from ray_tpu.util import state as state_api
+
+            my_node = get_runtime_context().get_node_id()
+            for n in state_api.list_nodes():
+                if n["node_id"] == my_node:
+                    draining = bool(n.get("draining"))
+                    break
+        except Exception:
+            draining = False
+        self._drain_cache = (now, draining)
+        return draining
+
     async def register(self, route_prefix: str, app_name: str,
                        ingress_deployment: str):
         from .deployment import DeploymentHandle
@@ -101,6 +127,16 @@ class ProxyActor:
 
         async def handler(request: "web.Request"):
             path = request.path
+            if path == "/-/healthz":
+                # LB health endpoint: 503 while this proxy's node drains
+                # so upstreams stop opening new connections here.
+                import asyncio as _asyncio
+
+                draining = await _asyncio.get_event_loop().run_in_executor(
+                    None, self._node_draining)
+                if draining:
+                    return web.Response(status=503, text="draining")
+                return web.Response(text="ok")
             match = self._find_route(path)
             if match is None:
                 return web.Response(status=404, text="no app for route")
@@ -240,9 +276,11 @@ class ProxyActor:
                             {"i": msg.get("i"), "ok": True,
                              "result": sorted(self.handles)}))
                     elif t == "serve_healthz":
+                        draining = await asyncio.get_event_loop() \
+                            .run_in_executor(None, self._node_draining)
                         writer.write(protocol.pack(
                             {"i": msg.get("i"), "ok": True,
-                             "result": "ok"}))
+                             "result": "draining" if draining else "ok"}))
                     else:
                         writer.write(protocol.pack(
                             {"i": msg.get("i"), "ok": False,

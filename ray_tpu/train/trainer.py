@@ -249,6 +249,55 @@ class JaxTrainer:
         t.start()
         return stop
 
+    def _start_drain_monitor(self, collector, group, n_workers: int):
+        """Treat a node DRAIN notice as a checkpoint-and-reshape trigger,
+        not a surprise failure: when a node hosting one of the group's
+        workers starts draining (TPU preemption notice, autoscaler
+        scale-down), post a cooperative rescale so every rank exits at
+        the same ``report()`` boundary with the checkpoint persisted; the
+        trainer re-forms the group smaller — off the draining node —
+        without burning the failure budget. Without this, the drain
+        deadline kills a rank mid-step and recovery costs a full failure
+        + restore cycle."""
+        import threading
+
+        stop = threading.Event()
+        worker_ids = {w._id.hex() for w in group.workers}
+        floor = max(self.scaling_config.elastic_min_workers or 1, 1)
+
+        def watch():
+            from ray_tpu.util import state as state_api
+
+            while not stop.is_set():
+                time.sleep(1.0)
+                try:
+                    draining = {n["node_id"]
+                                for n in state_api.list_nodes()
+                                if n.get("draining") and n.get("alive")}
+                    if not draining:
+                        continue
+                    actors = state_api.list_actors(limit=100000)
+                except Exception:
+                    continue
+                doomed = sum(1 for a in actors
+                             if a["actor_id"] in worker_ids
+                             and a.get("node_id") in draining)
+                if not doomed:
+                    continue
+                target = max(floor, n_workers - doomed)
+                if target >= n_workers:
+                    return  # already at/below the post-drain size
+                try:
+                    ray_tpu.get(collector.request_rescale.remote(target))
+                except Exception:
+                    continue  # transient collector hiccup: retry next tick
+                return
+
+        t = threading.Thread(target=watch, daemon=True,
+                             name="elastic-drain-monitor")
+        t.start()
+        return stop
+
     def _setup_backend(self, group: "WorkerGroup", num_workers: int):
         """Framework rendezvous hook (reference: ``Backend.on_start``,
         ``train/torch/config.py:153``). Jax: the mesh worker group
@@ -285,6 +334,11 @@ class JaxTrainer:
                 and n_workers < sc.num_workers):
             monitor_stop = self._start_capacity_monitor(
                 collector, n_workers, sc.num_workers)
+        drain_stop = None
+        if (sc.elastic_min_workers is not None
+                and n_workers > max(sc.elastic_min_workers, 1)):
+            drain_stop = self._start_drain_monitor(collector, group,
+                                                   n_workers)
         try:
             fn_blob = cloudpickle.dumps(self.train_loop)
             # Pre-split datasets into per-worker shards
@@ -339,6 +393,8 @@ class JaxTrainer:
         finally:
             if monitor_stop is not None:
                 monitor_stop.set()
+            if drain_stop is not None:
+                drain_stop.set()
             group.shutdown()
             try:
                 ray_tpu.kill(collector)

@@ -12,6 +12,7 @@ with STRICT_SPREAD, or pinning a whole job to one host with STRICT_PACK.
 from __future__ import annotations
 
 import threading
+from concurrent import futures
 from concurrent.futures import Future as SyncFuture
 from typing import Dict, List, Optional
 
@@ -37,6 +38,11 @@ class PlacementGroup:
             return True
         try:
             reply = self._ready_future.result(timeout_seconds)
+        except futures.TimeoutError:
+            # On py<3.11 concurrent.futures.TimeoutError is NOT the
+            # builtin TimeoutError — catching only the builtin let a
+            # reservation timeout escape as an exception.
+            return False
         except TimeoutError:
             return False
         return bool(reply.get("ready"))

@@ -13,8 +13,10 @@ from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, \
 from ray_tpu.autoscaler.instance_manager import (
     ALLOCATED,
     ALLOCATION_FAILED,
+    RAY_DRAINING,
     RAY_RUNNING,
     TERMINATED,
+    TERMINATING,
     InstanceManager,
 )
 from ray_tpu.autoscaler.node_provider import NodeInstance, NodeProvider
@@ -145,3 +147,100 @@ def test_reconciler_replaces_preempted_slice():
     # Round 4: the replacement reaches RAY_RUNNING.
     a.update()
     assert live[0].state == RAY_RUNNING
+
+
+class _DrainTrackingAutoscaler(Autoscaler):
+    """Fake-GCS autoscaler whose drain requests are recorded and applied
+    to the fake node view instead of hitting a real control plane."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.drain_requests = []  # node_id_hex, in request order
+        self.busy_nodes = set()   # node_id_hex with running work
+        self.idle_s = 1e9
+
+    def _state(self):
+        nodes = []
+        for inst in self.im.instances.values():
+            if inst.state in (ALLOCATED, RAY_RUNNING, RAY_DRAINING) and \
+                    inst.cloud_instance_id in self.provider.nodes:
+                nodes.append({"node_id": inst.node_id_hex, "alive": True,
+                              "avail": dict(inst.resources),
+                              "idle_s": self.idle_s,
+                              "busy": inst.node_id_hex in self.busy_nodes,
+                              "draining": inst.state == RAY_DRAINING})
+        return {"nodes": nodes, "demands": []}
+
+    def _request_drain(self, node_id_hex, reason):
+        self.drain_requests.append(node_id_hex)
+        return True
+
+
+def test_idle_termination_goes_through_drain_path():
+    """Acceptance: the autoscaler never directly kills a node with
+    running work — idle scale-down first drains the node in the GCS and
+    terminates the provider instance only once the node reports no busy
+    workers."""
+    cloud = FakeCloud()
+    cfg = AutoscalerConfig(node_types={
+        "tpu_v5e": NodeTypeConfig(resources={"TPU": 4.0},
+                                  min_workers=0, max_workers=3)},
+        idle_timeout_s=0.0)
+    a = _DrainTrackingAutoscaler(cfg, cloud, gcs_address="fake")
+
+    (inst,) = a.im.launch("tpu_v5e", {"TPU": 4.0}, 1)
+    a.im.reconcile([])                     # QUEUED -> ALLOCATED
+    a.im.reconcile([inst.node_id_hex])     # ALLOCATED -> RAY_RUNNING
+    assert inst.state == RAY_RUNNING
+    a.busy_nodes.add(inst.node_id_hex)
+
+    # Round 1: idle past timeout -> DRAIN requested, instance NOT killed
+    # (work is still running on it).
+    summary = a.update()
+    assert a.drain_requests == [inst.node_id_hex]
+    assert inst.state == RAY_DRAINING
+    assert summary["drained"] == ["tpu_v5e"]
+    assert inst.cloud_instance_id in cloud.nodes
+
+    # Round 2: still busy -> still alive; no duplicate drain request.
+    a.update()
+    assert inst.cloud_instance_id in cloud.nodes
+    assert a.drain_requests == [inst.node_id_hex]
+
+    # Rounds 3-4: work migrated off -> one settle round (direct-push
+    # work invisible to the GCS busy bit gets a beat to finish), THEN
+    # the instance is terminated.
+    a.busy_nodes.discard(inst.node_id_hex)
+    a.update()
+    assert inst.state == RAY_DRAINING
+    assert inst.cloud_instance_id in cloud.nodes
+    summary = a.update()
+    assert inst.state in (TERMINATING, TERMINATED)
+    assert inst.cloud_instance_id not in cloud.nodes
+    assert summary["terminated"] == ["tpu_v5e"]
+
+
+def test_draining_instance_released_when_node_forced_dead():
+    """A draining node the GCS forced DEAD (drain deadline) vanishes from
+    the alive view — its instance must be terminated, not leaked."""
+    cloud = FakeCloud()
+    cfg = AutoscalerConfig(node_types={
+        "tpu_v5e": NodeTypeConfig(resources={"TPU": 4.0},
+                                  min_workers=0, max_workers=3)},
+        idle_timeout_s=0.0)
+    a = _DrainTrackingAutoscaler(cfg, cloud, gcs_address="fake")
+
+    (inst,) = a.im.launch("tpu_v5e", {"TPU": 4.0}, 1)
+    a.im.reconcile([])
+    a.im.reconcile([inst.node_id_hex])
+    a.busy_nodes.add(inst.node_id_hex)
+    a.update()
+    assert inst.state == RAY_DRAINING
+
+    # Simulate the GCS drain deadline: the ray node is forced DEAD and
+    # vanishes from the alive view while the CLOUD instance still exists.
+    cloud_id = inst.cloud_instance_id
+    a._state = lambda: {"nodes": [], "demands": []}
+    a.update()
+    assert inst.state in (TERMINATING, TERMINATED)
+    assert cloud_id not in cloud.nodes

@@ -63,9 +63,10 @@ def test_worker_resolves_token_via_kv(cluster):
     assert any("BenchReq" in k for k in keys), keys
 
 
-def test_export_frozen_at_first_send(cluster):
-    """Reference semantics: the definition is frozen at first export —
-    later class-body mutation is not re-shipped."""
+def test_mutated_definition_reexported(cluster):
+    """A ``__main__`` class mutated between sends (the notebook re-def
+    case) is detected by the fingerprint check and re-exported under its
+    new content hash — workers never silently run stale code."""
     cls = _main_class()
 
     @ray_tpu.remote
@@ -74,8 +75,41 @@ def test_export_frozen_at_first_send(cluster):
 
     assert ray_tpu.get(use.remote(cls(3))) == "hi-3"
     cls.greet = lambda self: "mutated"
-    # Same class object -> same token -> worker keeps the frozen copy.
-    assert ray_tpu.get(use.remote(cls(4))) == "hi-4"
+    # Same class object, changed body -> new token -> workers observe
+    # the NEW definition.
+    assert ray_tpu.get(use.remote(cls(4))) == "mutated"
+    # Unchanged since the re-export: the new token is reused (two
+    # distinct dx: exports total, not three).
+    ser.serialize((cls, cls())).to_bytes()
+    w = ser._export_kv()
+    keys = [k for k in w.kv_keys(prefix="dx:", ns="defexports")
+            if "BenchReq" in k]
+    assert len(keys) == 2, keys
+
+
+def test_id_reuse_does_not_evict_live_entry():
+    """The weakref death callback only pops its OWN cache entry: a stale
+    callback (delayed GC of an old object whose id was recycled) must not
+    evict the new object's live entry (ADVICE r5 low)."""
+    import gc
+
+    old = _main_class()
+    ser._id_cache_put(old, "tok-old")
+    key = id(old)
+    assert ser._export_by_id[key][0] == "tok-old"
+    # Simulate id reuse: a NEW object was cached under the same integer
+    # key (as happens when the allocator recycles the address).
+    new = _main_class()
+    ser._id_cache_put(new, "tok-new")
+    ser._export_by_id[key] = ser._export_by_id[id(new)]
+    # The OLD object dies; its death callback fires against `key` — and
+    # must leave the new object's entry alone.
+    del old
+    gc.collect()
+    assert key in ser._export_by_id
+    assert ser._export_by_id[key][0] == "tok-new"
+    ser._export_by_id.pop(key, None)
+    ser._export_by_id.pop(id(new), None)
 
 
 def test_serialize_without_cluster_falls_back_by_value():

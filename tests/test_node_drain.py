@@ -1,0 +1,405 @@
+"""Graceful node drain (ISSUE 1 tentpole): the ALIVE -> DRAINING -> DEAD
+lifecycle. A drain (operator call or preemption notice) stops new
+placements instantly, proactively migrates restartable actors, lets
+in-flight tasks run until the deadline, then forces the node DEAD with
+normal recovery semantics — the control-plane primitive preemptible TPU
+fleets (Podracer-style) schedule around.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state as state_api
+
+
+def _wait(pred, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _node_rec(node_id_hex):
+    for n in state_api.list_nodes():
+        if n["node_id"] == node_id_hex:
+            return n
+    return None
+
+
+@ray_tpu.remote(num_cpus=1)
+def _where():
+    from ray_tpu import get_runtime_context
+
+    return get_runtime_context().get_node_id()
+
+
+def test_drain_blocks_placement_then_deadline_forces_dead():
+    """From the moment the GCS records the drain: no new task placements
+    on the node, drain status visible via list_nodes, and at the deadline
+    the node transitions to DEAD."""
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 2})
+    try:
+        node = c.add_node(num_cpus=2, resources={"spot": 2})
+        assert c.wait_for_nodes(2)
+        assert c.wait_for_workers(1)
+
+        spot_probe = _where.options(resources={"spot": 1}, num_cpus=0)
+        assert ray_tpu.get(spot_probe.remote(), timeout=60) == node.node_id
+
+        assert ray_tpu.drain_node(node.node_id, reason="test-drain",
+                                  deadline_s=6)
+        rec = _node_rec(node.node_id)
+        assert rec["state"] == "DRAINING" and rec["draining"]
+        assert rec["drain_reason"] == "test-drain"
+        assert rec["drain_deadline"] > time.time() - 1
+
+        # A task only the draining node could host pends instead of
+        # landing there.
+        blocked = spot_probe.remote()
+        done, pending = ray_tpu.wait([blocked], timeout=1.5)
+        assert not done and pending == [blocked]
+        # Plain CPU work keeps flowing — on the OTHER node(s) only.
+        homes = ray_tpu.get([_where.remote() for _ in range(6)], timeout=60)
+        assert all(h != node.node_id for h in homes)
+
+        # Deadline expiry: forced DEAD, surfaced in the state API and the
+        # cluster event log.
+        assert _wait(lambda: _node_rec(node.node_id)["state"] == "DEAD",
+                     timeout=30)
+        events = [e.get("event") for e in
+                  state_api.list_cluster_events(limit=10000)]
+        assert "node_draining" in events
+        assert "drain_deadline_expired" in events
+        ray_tpu.cancel(blocked)  # unplaceable forever once the node died
+    finally:
+        c.shutdown()
+
+
+def test_restartable_actor_migrates_without_burning_restart_budget():
+    """Restartable actors are proactively moved OFF the draining node and
+    keep answering calls; the migration does not consume max_restarts."""
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 2})
+    try:
+        n1 = c.add_node(num_cpus=2, resources={"slot": 1})
+        n2 = c.add_node(num_cpus=2, resources={"slot": 1})
+        assert c.wait_for_nodes(3)
+        assert c.wait_for_workers(1)
+
+        @ray_tpu.remote(max_restarts=1, max_task_retries=-1,
+                        resources={"slot": 1}, num_cpus=0)
+        class Sticky:
+            def where(self):
+                from ray_tpu import get_runtime_context
+
+                return get_runtime_context().get_node_id()
+
+        a = Sticky.remote()
+        home = ray_tpu.get(a.where.remote(), timeout=60)
+        assert home in (n1.node_id, n2.node_id)
+        other = n2.node_id if home == n1.node_id else n1.node_id
+
+        assert ray_tpu.drain_node(home, reason="migrate-test",
+                                  deadline_s=30)
+        # The actor re-homes onto the surviving slot node and stays
+        # callable throughout (max_task_retries=-1 absorbs the hop).
+        assert _wait(lambda: ray_tpu.get(a.where.remote(),
+                                         timeout=60) == other, timeout=60)
+        actors = state_api.list_actors()
+        rec = [x for x in actors if x["state"] == "alive"
+               and x["node_id"] == other]
+        assert rec, actors
+        # Migration was orchestrated, not a crash: restart budget intact.
+        assert rec[0]["restarts"] == 0
+    finally:
+        c.shutdown()
+
+
+def test_preemption_notice_mid_workload_zero_failures():
+    """Chaos: a (fake file-source) preemption notice lands mid-workload —
+    running tasks, a restartable actor, and an ACTIVE collective — and
+    the whole workload completes with zero user-visible failures."""
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 4, "resources": {"col": 1}})
+    try:
+        node = c.add_node(num_cpus=2, resources={"col": 1})
+        assert c.wait_for_nodes(2)
+        assert c.wait_for_workers(1)
+
+        @ray_tpu.remote(max_retries=10)
+        def slow_square(x):
+            time.sleep(0.2)
+            return x * x
+
+        @ray_tpu.remote(max_restarts=-1, max_task_retries=-1)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return True
+
+        @ray_tpu.remote(num_cpus=0, resources={"col": 1})
+        class Ranker:
+            """One collective rank per node: non-restartable, so the
+            drain leaves it running — in-flight collective rounds get
+            until the deadline and must finish."""
+
+            def setup(self, world, rank):
+                from ray_tpu.util import collective
+
+                collective.init_collective_group(world, rank,
+                                                 group_name="drainco")
+                return True
+
+            def run_rounds(self, rounds):
+                import numpy as np
+
+                from ray_tpu.util import collective
+
+                out = []
+                for i in range(rounds):
+                    time.sleep(0.1)
+                    out.append(float(collective.allreduce(
+                        np.ones(4) * (i + 1), group_name="drainco")[0]))
+                return out
+
+        counter = Counter.remote()
+        assert ray_tpu.get(counter.bump.remote(), timeout=60)
+        r0, r1 = Ranker.remote(), Ranker.remote()
+        assert ray_tpu.get([r0.setup.remote(2, 0), r1.setup.remote(2, 1)],
+                           timeout=60) == [True, True]
+        # Collective ACTIVE across the notice: ~20 lockstep allreduce
+        # rounds spanning several seconds.
+        col_refs = [r0.run_rounds.remote(20), r1.run_rounds.remote(20)]
+        refs = [slow_square.remote(i) for i in range(60)]
+        time.sleep(0.4)  # let work land on both nodes
+
+        # The fake notice source: drop the per-node file the agent polls.
+        notice = os.path.join(c.head.session_dir,
+                              f"preempt-{node.node_id}")
+        with open(notice, "w") as f:
+            json.dump({"reason": "spot reclaim", "deadline_s": 8}, f)
+
+        # Everything completes despite the node draining (and then dying
+        # at the deadline): retries + migration absorb it all, and the
+        # active collective's rounds all reduce to the right values.
+        assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(60)]
+        expected = [2.0 * (i + 1) for i in range(20)]
+        got0, got1 = ray_tpu.get(col_refs, timeout=120)
+        assert got0 == expected and got1 == expected
+        for _ in range(10):
+            assert ray_tpu.get(counter.bump.remote(), timeout=60)
+
+        # The notice became a DRAIN (graceful), observable as an event,
+        # with the agent's reason attached.
+        assert _wait(lambda: any(
+            e.get("event") == "node_draining"
+            and e.get("node_id") == node.node_id
+            and "spot reclaim" in str(e.get("reason"))
+            for e in state_api.list_cluster_events(limit=10000)),
+            timeout=30)
+        assert _wait(lambda: _node_rec(node.node_id)["state"] == "DEAD",
+                     timeout=30)
+    finally:
+        c.shutdown()
+
+
+def test_inflight_tasks_get_deadline_then_retry_elsewhere():
+    """In-flight tasks on the drained node get until the deadline; past
+    it they are killed with the node and the normal retry path completes
+    them on surviving nodes — zero user-visible failures."""
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 4})
+    try:
+        node = c.add_node(num_cpus=2)
+        assert c.wait_for_nodes(2)
+        assert c.wait_for_workers(1)
+
+        @ray_tpu.remote(max_retries=5, num_cpus=1)
+        def sleepy(x):
+            time.sleep(3.0)
+            return x + 1
+
+        refs = [sleepy.remote(i) for i in range(6)]
+        time.sleep(0.5)  # some dispatch to the doomed node
+        assert ray_tpu.drain_node(node.node_id, reason="expiry",
+                                  deadline_s=1.0)
+        assert ray_tpu.get(refs, timeout=120) == [i + 1 for i in range(6)]
+        assert _wait(lambda: _node_rec(node.node_id)["state"] == "DEAD",
+                     timeout=30)
+    finally:
+        c.shutdown()
+
+
+def _drain_train_loop(config):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    ctx = train.get_context()
+    world = ctx.get_world_size()
+    rank = ctx.get_world_rank()
+    run_dir = config["run_dir"]
+
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        start_step = int(ckpt.get_metadata()["step"]) + 1
+
+    acc = np.float32(0.0)
+    for step in range(start_step, config["total_steps"]):
+        time.sleep(0.4)
+        acc = jnp.asarray(acc) + 1.0  # trivially deterministic "training"
+        metrics = {"step": step, "world": world}
+        if rank == 0:
+            ckpt_dir = os.path.join(run_dir, f"step_{step}")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            c = Checkpoint.from_directory(ckpt_dir)
+            c.set_metadata({"step": step})
+            train.report(metrics, checkpoint=c)
+        else:
+            train.report(metrics)
+
+
+def test_train_drain_is_checkpoint_and_reshape_not_failure(tmp_path):
+    """Elastic train: a drain notice on a node hosting a group worker is
+    a cooperative checkpoint-and-reshape trigger — the run re-forms
+    smaller at a report boundary WITHOUT burning the failure budget
+    (max_failures=0 would fail the run if the drain surfaced as a
+    worker death)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train.config import FailureConfig
+
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 4})
+    try:
+        n1 = c.add_node(num_cpus=2, resources={"trainslot": 1})
+        n2 = c.add_node(num_cpus=2, resources={"trainslot": 1})
+        assert c.wait_for_nodes(3)
+        run_dir = str(tmp_path / "ckpts")
+        os.makedirs(run_dir, exist_ok=True)
+        total = 14
+        trainer = JaxTrainer(
+            _drain_train_loop,
+            train_loop_config={"run_dir": run_dir, "total_steps": total},
+            scaling_config=ScalingConfig(
+                num_workers=2, jax_distributed=False,
+                elastic_min_workers=1, elastic_scale_up=False,
+                resources_per_worker={"CPU": 1, "trainslot": 1},
+                formation_timeout_s=30),
+            run_config=RunConfig(storage_path=str(tmp_path), name="drain",
+                                 failure_config=FailureConfig(
+                                     max_failures=0)))
+
+        import threading
+
+        def drain_one():
+            # Gate on observed progress: the 2-worker phase must have
+            # reported at least once before the drain lands.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if os.path.isdir(os.path.join(run_dir, "step_1")):
+                    break
+                time.sleep(0.2)
+            ray_tpu.drain_node(n2.node_id, reason="preempt",
+                               deadline_s=45)
+
+        t = threading.Thread(target=drain_one, daemon=True)
+        t.start()
+        res = trainer.fit()
+        t.join()
+        assert res.error is None, res.error
+        assert res.metrics["step"] == total - 1
+        # Finished on the reshaped (1-worker) group after the drain.
+        assert res.metrics["world"] == 1
+    finally:
+        c.shutdown()
+
+
+def test_serve_replicas_vacate_draining_node():
+    """Serve: the controller proactively replaces replicas on a draining
+    node (replacements healthy BEFORE the old stop serving), so the
+    router never sends traffic at a replica about to vanish."""
+    from ray_tpu import serve
+
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 4})
+    try:
+        n1 = c.add_node(num_cpus=2, resources={"srv": 2})
+        n2 = c.add_node(num_cpus=2, resources={"srv": 2})
+        assert c.wait_for_nodes(3)
+        assert c.wait_for_workers(1)
+
+        @serve.deployment(num_replicas=2,
+                          ray_actor_options={"num_cpus": 0,
+                                             "resources": {"srv": 1}})
+        class Hello:
+            def __call__(self, x):
+                return x + 1
+
+        handle = serve.run(Hello.bind(), name="drain-app",
+                           route_prefix=None)
+        assert handle.remote(1).result(timeout=60) == 2
+
+        ctl = ray_tpu.get_actor("SERVE_CONTROLLER")
+        reps = ray_tpu.get(ctl.get_replicas.remote("drain-app", "Hello"),
+                           timeout=30)
+        actor_node = {a["actor_id"]: a["node_id"]
+                      for a in state_api.list_actors()}
+        homes = [actor_node.get(r._id.hex()) for r in reps]
+        target = next(h for h in homes if h in (n1.node_id, n2.node_id))
+
+        assert ray_tpu.drain_node(target, reason="serve-drain",
+                                  deadline_s=60)
+        moved = ray_tpu.get(ctl.check_drain.remote(), timeout=120)
+        assert moved >= 1
+
+        reps = ray_tpu.get(ctl.get_replicas.remote("drain-app", "Hello"),
+                           timeout=30)
+        actor_node = {a["actor_id"]: a["node_id"]
+                      for a in state_api.list_actors()}
+        assert len(reps) == 2
+        assert all(actor_node.get(r._id.hex()) != target for r in reps)
+        # The app keeps serving through and after the vacate.
+        for i in range(5):
+            assert handle.remote(i).result(timeout=60) == i + 1
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+
+
+def test_placement_group_refuses_draining_node():
+    """New PG bundle reservations exclude draining nodes."""
+    c = Cluster(initialize_head=True, connect=True,
+                head_node_args={"num_cpus": 2})
+    try:
+        node = c.add_node(num_cpus=4, resources={"big": 4})
+        assert c.wait_for_nodes(2)
+        assert ray_tpu.drain_node(node.node_id, reason="pg-test",
+                                  deadline_s=60)
+        from ray_tpu.util import placement_group
+
+        # Only the draining node could host this bundle: must stay
+        # pending, not reserve there.
+        pg = placement_group([{"big": 1}], strategy="PACK")
+        assert not pg.wait(1.5)
+        pgs = state_api.list_placement_groups()
+        assert pgs and all(p["state"] == "pending" for p in pgs)
+    finally:
+        c.shutdown()
