@@ -1,0 +1,102 @@
+"""`ray_tpu check` tour: the distributed anti-patterns it catches.
+
+Run the analyzer on this file to see every rule fire:
+
+    python -m ray_tpu check examples/10_anti_patterns.py
+    python -m ray_tpu check examples/10_anti_patterns.py --format json
+
+Each ``_bad_*`` function below is a deliberate anti-pattern (they are
+*not* executed — some would deadlock); ``main()`` runs the idiomatic
+versions, which the analyzer leaves clean. The repo's committed
+``raylint_baseline.json`` allowlists this file so the tier-1 self-scan
+stays green — exactly the adopted-codebase workflow.
+
+With ``RAY_TPU_STATIC_CHECKS=1`` the same findings surface as warnings
+the moment ``@ray_tpu.remote`` wraps each function — before any TPU time
+is spent.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu
+from jax import lax
+
+# RTL003: large module-level literal captured by a remote fn below.
+LOOKUP = [0] * 1_000_000
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+def _bad_nested_blocking(xs):
+    # RTL001: get() inside a task blocks a finite worker-pool slot while
+    # the child waits for one — deep chains deadlock.
+    return sum(ray_tpu.get([square.remote(x) for x in xs]))
+
+
+@ray_tpu.remote
+def _bad_capture(i, acc=[]):  # RTL008: default shared per worker
+    # RTL003: LOOKUP rides the pickled function blob to every worker.
+    acc.append(LOOKUP[i])
+    return acc
+
+
+def _bad_serial_loop():
+    out = []
+    for i in range(8):
+        # RTL002: one task in flight at a time — N scheduler round-trips
+        # instead of one fan-out.
+        out.append(ray_tpu.get(square.remote(i)))
+    # RTL007: nobody can ever observe this task (or its failure).
+    square.remote(99)
+    return out
+
+
+@ray_tpu.remote
+class _BadActor:
+    def __init__(self):
+        self.me = ray_tpu.get_runtime_context().current_actor
+
+    def compute(self, x):
+        return x + 1
+
+    def blocked(self, x):
+        # RTL004: waiting on yourself — the nested call queues behind
+        # the method that is blocking on it. Deadlock.
+        return ray_tpu.get(self.me.compute.remote(x))
+
+    async def stalls_the_loop(self):
+        # RTL006: one sync sleep freezes every concurrent method,
+        # heartbeat, and connection on this worker's IO loop.
+        time.sleep(1.0)
+        return ray_tpu.get(square.remote(1))
+
+
+def _bad_collective(x):
+    # RTL005: "dpp" is bound by no Mesh/shard_map — dies at trace time,
+    # after the TPU slice was already reserved.
+    return lax.psum(x, "dpp")
+
+
+def main():
+    ray_tpu.init(num_cpus=4, probe_tpu=False)
+
+    # The idiomatic versions of everything above:
+    refs = [square.remote(i) for i in range(8)]      # fan out first
+    print("squares:", ray_tpu.get(refs))             # one barrier
+
+    big = ray_tpu.put(LOOKUP)                        # share via the store
+    print("put large object:", ray_tpu.get(big)[0:3])
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,10 @@ class RayTpuConfig:
     # ---- memory monitor (0 disables; reference: memory_monitor.h)
     memory_monitor_threshold: float = 0.95
     memory_monitor_interval_s: float = 1.0
+    # ---- static analysis (analysis/: decoration-time anti-pattern
+    # warnings; RAY_TPU_STATIC_CHECKS env var wins over this flag, so a
+    # single process can opt out of a cluster-wide _system_config)
+    static_checks: bool = False
     # ---- observability
     max_done_tasks: int = 10_000
     max_task_events: int = 50_000

@@ -408,10 +408,27 @@ class ActorClass:
                            self._method_num_returns())
 
 
+def _maybe_static_check(target):
+    """Decoration-time anti-pattern analysis (``ray_tpu/analysis/``),
+    gated on ``RAY_TPU_STATIC_CHECKS=1`` exactly like the thread-check
+    gate (``thread_check.checks_enabled``); the ``static_checks`` config
+    flag is the cluster-wide fallback when the env var is unset.
+    Warnings only — registration NEVER fails because of a lint."""
+    try:
+        from ray_tpu.analysis.decoration import (static_checks_enabled,
+                                                 warn_on_decoration)
+
+        if static_checks_enabled():
+            warn_on_decoration(target)
+    except Exception:
+        pass  # a lint bug must never take down @remote
+
+
 def remote(*args, **kwargs):
     """``@remote`` decorator for functions and classes."""
 
     def wrap(target):
+        _maybe_static_check(target)
         if inspect.isclass(target):
             return ActorClass(target, kwargs)
         return RemoteFunction(target, kwargs)

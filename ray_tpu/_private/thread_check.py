@@ -39,15 +39,27 @@ class ThreadChecker:
         if not checks_enabled():
             return
         ident = threading.get_ident()
+        # Fast path: once bound, read lock-free. A stale None just falls
+        # through to the locked bind below; a stale non-None can only be
+        # a PREVIOUS binding (reset+rebind race), which the locked path
+        # would have raced identically — checks run on every hot-path
+        # call, so the uncontended-lock cost was pure overhead.
+        bound = self._ident
+        if bound is not None:
+            if bound != ident:
+                self._raise(ident, bound)
+            return
         with self._lock:
             if self._ident is None:
                 self._ident = ident
-                return
-            if self._ident != ident:
-                raise RuntimeError(
-                    f"ThreadChecker[{self.name}]: accessed from thread "
-                    f"{ident}, bound to {self._ident} — single-thread "
-                    f"affinity violated")
+            elif self._ident != ident:
+                self._raise(ident, self._ident)
+
+    def _raise(self, ident: int, bound: int):
+        raise RuntimeError(
+            f"ThreadChecker[{self.name}]: accessed from thread "
+            f"{ident}, bound to {bound} — single-thread "
+            f"affinity violated")
 
     def reset(self):
         with self._lock:
@@ -100,9 +112,11 @@ class LoopMonitor:
                 self.max_lag = lag
 
     def stop(self):
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+        """Idempotent: safe to call twice, after the loop closed, or when
+        the monitor task already finished/was cancelled externally."""
+        task, self._task = self._task, None
+        if task is not None and not task.done():
+            task.cancel()
 
     def stats(self) -> Dict[str, float]:
         return {

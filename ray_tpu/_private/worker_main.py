@@ -982,6 +982,25 @@ async def amain(args):
     worker.handle_control = handle_control
     await executor.start()
 
+    # Loop-lag instrumentation on the worker's IO loop (the GCS has had
+    # this since the drain PR): a sync call stalling an async actor's
+    # loop shows up as lag here — the runtime corroboration of the
+    # static RTL006 blocking-in-async rule. Exported through the normal
+    # metrics push path so the dashboard/Prometheus surface it per
+    # worker.
+    from .thread_check import LoopMonitor
+
+    loop_monitor = LoopMonitor(name="worker").start()
+    from ray_tpu.util.metrics import Gauge
+
+    wid_tag = {"wid": worker.worker_id.hex()[:16]}
+    lag_mean_g = Gauge("worker_loop_mean_lag_ms",
+                       "mean event-loop tick lag of this worker's IO loop",
+                       tag_keys=("wid",))
+    lag_max_g = Gauge("worker_loop_max_lag_ms",
+                      "max event-loop tick lag of this worker's IO loop",
+                      tag_keys=("wid",))
+
     async def flush_events_loop():
         while not stop.is_set():
             await asyncio.sleep(0.5)
@@ -989,6 +1008,9 @@ async def amain(args):
             # having been imported by a traced call, not this process's
             # env var — the driver may enable tracing after worker spawn).
             executor.flush_events()
+            stats = loop_monitor.stats()
+            lag_mean_g.set(stats["mean_lag_ms"], tags=wid_tag)
+            lag_max_g.set(stats["max_lag_ms"], tags=wid_tag)
 
     worker.gcs_address = args.gcs
 
@@ -1041,6 +1063,7 @@ async def amain(args):
     asyncio.get_running_loop().create_task(flush_events_loop())
 
     await stop.wait()
+    loop_monitor.stop()
     executor.flush_events()
     worker._flush_refs()
     try:

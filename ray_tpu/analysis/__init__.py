@@ -1,0 +1,29 @@
+"""Static analysis of distributed anti-patterns (``ray_tpu check``).
+
+A rule-based analyzer over Python ASTs with two delivery modes:
+
+- **Offline CLI**: ``python -m ray_tpu check <paths>`` (or ``python -m
+  ray_tpu.analysis <paths>``) — human or ``--format json`` output, exit
+  code = max severity, JSON ``--baseline`` for adopted codebases.
+- **Decoration-time**: with ``RAY_TPU_STATIC_CHECKS=1`` each
+  ``@ray_tpu.remote`` function/actor is analyzed as it registers and
+  findings surface as warnings (never errors) before any TPU time is
+  spent.
+
+Suppress any finding inline with ``# raylint: disable=RTL001`` (or a
+bare ``# raylint: disable`` for the whole line).
+"""
+
+from .engine import (Finding, Rule, all_rules, analyze_file, analyze_paths,
+                     analyze_source, apply_baseline, findings_to_json,
+                     load_baseline, max_severity, register_rule, rule_table)
+from .decoration import (StaticCheckWarning, check_decorated,
+                         static_checks_enabled, warn_on_decoration)
+
+__all__ = [
+    "Finding", "Rule", "all_rules", "analyze_file", "analyze_paths",
+    "analyze_source", "apply_baseline", "findings_to_json",
+    "load_baseline", "max_severity", "register_rule", "rule_table",
+    "StaticCheckWarning", "check_decorated", "static_checks_enabled",
+    "warn_on_decoration",
+]

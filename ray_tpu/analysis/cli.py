@@ -1,0 +1,114 @@
+"""``ray_tpu check`` — offline static analysis CLI.
+
+Two spellings, one implementation: ``python -m ray_tpu check <paths>``
+(scripts.py subcommand) and ``python -m ray_tpu.analysis <paths>``.
+Exit code is the max severity of un-baselined findings: 0 clean (or
+fully baselined), 1 warnings, 2 errors.
+
+``--format json`` output IS the baseline file format — redirect it to a
+file (or use ``--write-baseline``) to adopt an existing codebase, then
+only *new* violations fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .engine import (Finding, all_rules, analyze_paths, apply_baseline,
+                     findings_to_json, load_baseline, max_severity,
+                     rule_table)
+
+DEFAULT_BASELINE = "raylint_baseline.json"
+
+
+def add_arguments(parser: argparse.ArgumentParser):
+    parser.add_argument("paths", nargs="*", default=["."],
+                        help="files or directories to analyze (default: .)")
+    parser.add_argument("--format", choices=["human", "json"],
+                        default="human", dest="fmt")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="JSON baseline of accepted findings "
+                        "(the --format json output format)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="(re)generate the baseline file from the "
+                        "current findings and exit 0 — the deliberate "
+                        "allowlist-refresh path")
+    parser.add_argument("--select", default="", metavar="IDS",
+                        help="comma-separated rule IDs to run "
+                        "(default: all)")
+    parser.add_argument("--disable", default="", metavar="IDS",
+                        help="comma-separated rule IDs to skip")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    return parser
+
+
+def _selected_rules(args):
+    rules = all_rules()
+    if args.select:
+        keep = {s.strip() for s in args.select.split(",") if s.strip()}
+        rules = [r for r in rules if r.id in keep]
+    if args.disable:
+        drop = {s.strip() for s in args.disable.split(",") if s.strip()}
+        rules = [r for r in rules if r.id not in drop]
+    return rules
+
+
+def run_check(args) -> int:
+    if args.list_rules:
+        for row in rule_table():
+            print(f"{row['id']}  {row['severity']:7}  {row['name']}")
+        return 0
+
+    skipped: List[str] = []
+    findings = analyze_paths(
+        args.paths, rules=_selected_rules(args),
+        on_error=lambda p, e: skipped.append(f"{p}: {e}"))
+
+    baseline_path = args.baseline
+    if args.write_baseline:
+        baseline_path = baseline_path or DEFAULT_BASELINE
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(findings_to_json(findings))
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    baselined = 0
+    if baseline_path:
+        try:
+            base = load_baseline(baseline_path)
+        except OSError:
+            base = []
+        before = len(findings)
+        findings = apply_baseline(findings, base)
+        baselined = before - len(findings)
+
+    if args.fmt == "json":
+        sys.stdout.write(findings_to_json(findings))
+    else:
+        for f in findings:
+            print(f)
+        for s in skipped:
+            print(f"skipped (unparseable): {s}", file=sys.stderr)
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        summary = (f"{n_err} error(s), {n_warn} warning(s)"
+                   if findings else "clean")
+        if baselined:
+            summary += f" ({baselined} baselined)"
+        print(summary)
+    return max_severity(findings)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu check",
+        description="static analysis for distributed anti-patterns")
+    add_arguments(parser)
+    return run_check(parser.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

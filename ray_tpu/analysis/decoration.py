@@ -1,0 +1,100 @@
+"""Decoration-time static checks: analyze as ``@ray_tpu.remote`` registers.
+
+The opt-in twin of the offline CLI: with ``RAY_TPU_STATIC_CHECKS=1``
+(mirroring the ``RAY_TPU_THREAD_CHECKS`` gate) every function/actor class
+is analyzed the moment the decorator wraps it — before any task is
+submitted, before any TPU time is burned. Findings are *warnings only*:
+registration NEVER fails because of a lint, and any internal error here
+(no source available, exotic AST, exec'd code) is swallowed.
+
+Alias resolution can't come from imports — ``inspect.getsource`` returns
+just the decorated snippet — so it is seeded from the target's live
+``__globals__``: the actual module objects and ray_tpu callables the
+function will call at runtime, which is *more* precise than re-parsing
+imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+import sys
+import textwrap
+import types
+import warnings
+from typing import Dict, List
+
+from .engine import Finding, analyze_source
+
+
+class StaticCheckWarning(UserWarning):
+    """A distributed anti-pattern found while registering a remote."""
+
+
+def static_checks_enabled() -> bool:
+    """Env var wins; the ``static_checks`` config flag (settable via
+    ``_system_config``) is the cluster-wide fallback."""
+    env = os.environ.get("RAY_TPU_STATIC_CHECKS")
+    if env is not None:
+        return env == "1"
+    try:
+        from ray_tpu._private.config import config
+
+        return bool(config().static_checks)
+    except Exception:
+        return False
+
+
+def _aliases_from_globals(g: dict) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for name, val in g.items():
+        if isinstance(val, types.ModuleType):
+            out[name] = val.__name__
+        elif callable(val):
+            mod = getattr(val, "__module__", None) or ""
+            if ((mod == "ray_tpu" or mod.startswith("ray_tpu."))
+                    and getattr(val, "__name__", "") in (
+                        "get", "put", "wait", "remote", "method", "kill",
+                        "cancel", "get_actor", "get_runtime_context")):
+                out[name] = "ray_tpu." + val.__name__
+    return out
+
+
+def check_decorated(target) -> List[Finding]:
+    """Analyze one function/class about to become remote. Never raises."""
+    try:
+        source, start_line = inspect.getsourcelines(target)
+        tree_src = textwrap.dedent("".join(source))
+        path = inspect.getsourcefile(target) or "<unknown>"
+        g = getattr(target, "__globals__", None)
+        if g is None:
+            mod = sys.modules.get(getattr(target, "__module__", ""), None)
+            g = getattr(mod, "__dict__", {})
+        return analyze_source(tree_src, path,
+                              seed_aliases=_aliases_from_globals(g),
+                              line_offset=start_line - 1,
+                              assume_remote_toplevel=True)
+    except Exception:
+        # (OSError: no source; SyntaxError: dedent edge cases; anything
+        # else: a lint must never break @remote)
+        return []
+
+
+def warn_on_decoration(target):
+    """Emit one StaticCheckWarning per finding; never raises."""
+    try:
+        findings = check_decorated(target)
+    except Exception:
+        return
+    name = getattr(target, "__qualname__",
+                   getattr(target, "__name__", "?"))
+    for f in findings:
+        try:
+            warnings.warn(
+                f"[{f.rule}] {f.path}:{f.line}: {f.message} "
+                f"(in @ray_tpu.remote {name}; hint: {f.hint}; suppress "
+                f"with # raylint: disable={f.rule})",
+                StaticCheckWarning, stacklevel=4)
+        except Exception:
+            return

@@ -1,0 +1,697 @@
+"""Rule engine for ``ray_tpu check``: AST walk + shared analysis context.
+
+The reference Ray only ever shipped *runtime* warnings for the
+distributed anti-patterns that serialize TPU pipelines (sync ``get`` in a
+task chain, blocked actor IO loops — the bug class
+``_private/thread_check.py`` catches after the fact). This module is the
+static twin: a single AST pass per file with a shared context (import
+aliases, remote-decoration tracking, loop/async nesting, per-module axis
+bindings) that a registry of small rules draws from, so every rule
+resolves ``import ray_tpu as rt`` and handle renames the same way.
+
+Delivery modes built on top:
+- offline CLI (``ray_tpu check`` / ``python -m ray_tpu.analysis``,
+  ``cli.py``) with a JSON baseline for adopted codebases, and
+- decoration-time warnings as ``@ray_tpu.remote`` registers each
+  function/actor (``decoration.py``, gated on ``RAY_TPU_STATIC_CHECKS=1``
+  next to the thread-check gate).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Severity ladder; the CLI exit code is the max severity of un-baselined
+# findings (0 = clean).
+SEVERITY_RANK = {"warning": 1, "error": 2}
+
+BASELINE_VERSION = 1
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(rule=d["rule"], severity=d.get("severity", "warning"),
+                   path=d["path"], line=int(d.get("line", 0)),
+                   col=int(d.get("col", 0)), message=d.get("message", ""),
+                   hint=d.get("hint", ""))
+
+    def __str__(self):
+        s = (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+             f"[{self.severity}] {self.message}")
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+# --------------------------------------------------------------- registry
+
+_RULE_CLASSES: List[type] = []
+
+
+def register_rule(cls):
+    _RULE_CLASSES.append(cls)
+    return cls
+
+
+def all_rules() -> List["Rule"]:
+    from . import rules as _rules  # noqa: F401  (populates the registry)
+
+    return [cls() for cls in _RULE_CLASSES]
+
+
+def rule_table() -> List[dict]:
+    """Stable metadata for docs/README (id, severity, name, hint)."""
+    return [{"id": r.id, "severity": r.severity, "name": r.name,
+             "hint": r.hint} for r in
+            sorted(all_rules(), key=lambda r: r.id)]
+
+
+class Rule:
+    """One anti-pattern detector.
+
+    Subclasses set ``id``/``severity``/``name``/``hint`` and implement
+    hooks named after the walker events they care about; every hook
+    returns an iterable of Findings (or None). The walker owns traversal
+    and shared state — rules only pattern-match.
+    """
+
+    id = "RTL000"
+    severity = "warning"
+    name = ""
+    hint = ""
+
+    def on_call(self, node: ast.Call, ctx: "Context"):
+        return ()
+
+    def on_expr(self, node: ast.Expr, ctx: "Context"):
+        return ()
+
+    def on_name(self, node: ast.Name, ctx: "Context"):
+        return ()
+
+    def on_function(self, node, ctx: "Context"):
+        """FunctionDef / AsyncFunctionDef, fired at entry."""
+        return ()
+
+    def finding(self, node, ctx: "Context", message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(rule=self.id, severity=self.severity, path=ctx.path,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0), message=message,
+                       hint=self.hint if hint is None else hint)
+
+
+# ----------------------------------------------------------- module context
+
+# Roots whose attributes we track. "ray" resolves as "ray_tpu" so adopted
+# reference-Ray code lints identically.
+_RAY_ROOTS = {"ray_tpu", "ray"}
+
+# Names importable straight off the package root (``from ray_tpu import
+# get``): map them to their canonical dotted form.
+_RAY_TOPLEVEL = {"get", "put", "wait", "remote", "method", "kill", "cancel",
+                 "get_actor", "get_runtime_context"}
+
+
+def _norm(dotted: str) -> str:
+    """Canonicalize reference-Ray spellings onto ray_tpu's."""
+    if dotted == "ray" or dotted.startswith("ray."):
+        return "ray_tpu" + dotted[3:]
+    return dotted
+
+
+class _FuncInfo:
+    __slots__ = ("node", "is_async", "is_remote_task", "in_actor",
+                 "local_names", "handle_locals", "aliases")
+
+    def __init__(self, node, is_async, is_remote_task, in_actor,
+                 local_names):
+        self.node = node
+        self.is_async = is_async
+        self.is_remote_task = is_remote_task
+        self.in_actor = in_actor
+        self.local_names: Set[str] = local_names
+        # local variables holding the actor's OWN handle (RTL004)
+        self.handle_locals: Set[str] = set()
+        # function-scoped rename aliases, overlaying the module map
+        self.aliases: Dict[str, str] = {}
+
+
+class _ClassInfo:
+    __slots__ = ("node", "is_remote_actor", "self_handle_attrs")
+
+    def __init__(self, node, is_remote_actor):
+        self.node = node
+        self.is_remote_actor = is_remote_actor
+        # ``self.<attr>`` assigned from the actor's own handle
+        self.self_handle_attrs: Set[str] = set()
+
+
+class Context:
+    """Shared per-file analysis state maintained by the walker."""
+
+    def __init__(self, path: str, lines: Sequence[str],
+                 seed_aliases: Optional[Dict[str, str]] = None,
+                 line_offset: int = 0,
+                 assume_remote_toplevel: bool = False):
+        self.path = path
+        self.lines = lines
+        self.line_offset = line_offset
+        # Decoration mode analyzes the target's bare source snippet — the
+        # caller KNOWS it is becoming remote even when the snippet carries
+        # no ``@ray_tpu.remote`` line (``remote(fn)`` call form, options).
+        self.assume_remote_toplevel = assume_remote_toplevel
+        self.aliases: Dict[str, str] = dict(seed_aliases or {})
+        self.func_stack: List[_FuncInfo] = []
+        self.class_stack: List[_ClassInfo] = []
+        self.loop_depth = 0
+        # names assigned from ``.remote()`` calls inside each active loop
+        self.loop_remote_names: List[Set[str]] = []
+        # module pre-scan products
+        self.bound_axes: Set[str] = set()
+        self.large_globals: Dict[str, str] = {}  # name -> description
+        self.map_fn_names: Set[str] = set()
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, expr) -> Optional[str]:
+        """Dotted resolution of a Name/Attribute chain through aliases.
+
+        ``rt.get`` -> "ray_tpu.get"; bare ``get`` (from-import or a
+        ``g = ray_tpu.get`` rename) -> "ray_tpu.get"; ``lax.psum`` ->
+        "jax.lax.psum". Returns None for untracked roots.
+        """
+        parts: List[str] = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        base = None
+        for f in reversed(self.func_stack):
+            if expr.id in f.aliases:
+                base = f.aliases[expr.id]
+                break
+        if base is None:
+            base = self.aliases.get(expr.id)
+        if base is None:
+            return None
+        parts.append(base)
+        return _norm(".".join(reversed(parts)))
+
+    def is_remote_decorator(self, dec) -> bool:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        return self.resolve(target) == "ray_tpu.remote"
+
+    # -- convenience queries ----------------------------------------------
+
+    @property
+    def current_function(self) -> Optional[_FuncInfo]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def current_class(self) -> Optional[_ClassInfo]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    def in_remote_task(self) -> bool:
+        return any(f.is_remote_task for f in self.func_stack)
+
+    def in_actor_method(self) -> bool:
+        f = self.current_function
+        return f is not None and f.in_actor
+
+    def source_line(self, lineno: int) -> str:
+        idx = lineno - 1 - self.line_offset
+        if 0 <= idx < len(self.lines):
+            return self.lines[idx]
+        return ""
+
+
+# ------------------------------------------------------------- module scan
+
+_AXIS_BINDERS = {"Mesh", "make_mesh", "P", "PartitionSpec", "NamedSharding",
+                 "pmap", "xmap", "shard_map"}
+# Axes this framework's canonical mesh always defines (parallel/mesh.py
+# AXES): collectives over them are bindable even when the Mesh literal
+# lives in another module.
+CANONICAL_AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+_NUMPY_CREATORS = re.compile(
+    r"(?:^|\.)(?:numpy|jnp|np)\.(?:zeros|ones|empty|full|arange|"
+    r"random\.\w+)$")
+_LARGE_LITERAL_ELEMS = 64
+_LARGE_REPEAT_ELEMS = 4096
+_LARGE_ARRAY_ELEMS = 65536
+
+_DATASET_MAP_METHODS = {"map", "map_batches", "flat_map", "filter",
+                        "foreach", "map_groups"}
+
+
+def _str_constants(node) -> Iterable[str]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value
+
+
+def _literal_size(node) -> Optional[int]:
+    """Approximate element count of a literal container expression."""
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return len(node.elts)
+    if isinstance(node, ast.Dict):
+        return len(node.keys)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        for a, b in ((node.left, node.right), (node.right, node.left)):
+            inner = _literal_size(a)
+            if (inner is not None and isinstance(b, ast.Constant)
+                    and isinstance(b.value, int)):
+                return inner * b.value
+    if isinstance(node, ast.Call):
+        try:
+            name = ast.unparse(node.func)
+        except Exception:  # pragma: no cover - unparse of exotic nodes
+            return None
+        if _NUMPY_CREATORS.search(name):
+            shape = node.args[0] if node.args else None
+            total = 1
+            dims = (shape.elts if isinstance(shape, (ast.Tuple, ast.List))
+                    else [shape] if shape is not None else [])
+            for d in dims:
+                if isinstance(d, ast.Constant) and isinstance(d.value, int):
+                    total *= d.value
+                else:
+                    return None
+            return total if dims else None
+    return None
+
+
+def _prescan_module(tree: ast.Module, ctx: Context):
+    """One cheap pass for module-wide facts rules need ahead of time:
+    import aliases, axis-name bindings, large module-level literals, and
+    function names handed to dataset-style ``.map`` calls."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                ctx.aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                ctx.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        elif isinstance(node, ast.Call):
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            if fname in _AXIS_BINDERS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    ctx.bound_axes.update(
+                        s for s in _str_constants(arg) if s.isidentifier())
+            if (fname in _DATASET_MAP_METHODS
+                    and isinstance(node.func, ast.Attribute)):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        ctx.map_fn_names.add(arg.id)
+            if fname == "MeshSpec":
+                ctx.bound_axes.update(k.arg for k in node.keywords if k.arg)
+        elif isinstance(node, ast.keyword) and node.arg in (
+                "axis_name", "axis_names"):
+            ctx.bound_axes.update(
+                s for s in _str_constants(node.value) if s.isidentifier())
+    # module-level large literals + AXES-style constants
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        for t in targets:
+            if "axes" in t.id.lower() or "axis" in t.id.lower():
+                ctx.bound_axes.update(
+                    s for s in _str_constants(value) if s.isidentifier())
+            size = _literal_size(value)
+            if size is not None and (
+                    size >= _LARGE_ARRAY_ELEMS
+                    if isinstance(value, ast.Call)
+                    else size >= (_LARGE_REPEAT_ELEMS
+                                  if isinstance(value, ast.BinOp)
+                                  else _LARGE_LITERAL_ELEMS)):
+                ctx.large_globals[t.id] = f"~{size} elements"
+
+
+# ----------------------------------------------------------------- walker
+
+def _is_remote_call(node) -> bool:
+    """``<anything>.remote(...)``"""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "remote")
+
+
+def _collect_local_names(node) -> Set[str]:
+    """Names bound inside a function body (args + assignment targets):
+    used to tell captured globals from shadowed locals."""
+    names: Set[str] = set()
+    args = node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in tgts:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)) and n is not node:
+            names.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+    return names
+
+
+def _is_current_actor_expr(node, ctx: Context) -> bool:
+    """``ray_tpu.get_runtime_context().current_actor`` (any alias)."""
+    return (isinstance(node, ast.Attribute)
+            and node.attr == "current_actor"
+            and isinstance(node.value, ast.Call)
+            and ctx.resolve(node.value.func) == "ray_tpu.get_runtime_context")
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, ctx: Context, rules: List[Rule]):
+        self.ctx = ctx
+        self.rules = rules
+        self.findings: List[Finding] = []
+
+    def _fire(self, hook: str, node):
+        for rule in self.rules:
+            out = getattr(rule, hook)(node, self.ctx)
+            if out:
+                self.findings.extend(out)
+
+    # -- scopes ------------------------------------------------------------
+
+    def _visit_func(self, node, is_async: bool):
+        ctx = self.ctx
+        is_remote = any(ctx.is_remote_decorator(d) for d in
+                        node.decorator_list) or (
+            ctx.assume_remote_toplevel and not ctx.func_stack
+            and not ctx.class_stack)
+        if ctx.func_stack:
+            # a def nested inside a method is still "in the actor" for
+            # the blocking rules — inherit the enclosing flag.
+            in_actor = ctx.func_stack[-1].in_actor
+        else:
+            in_actor = (ctx.current_class is not None
+                        and ctx.current_class.is_remote_actor)
+        info = _FuncInfo(node, is_async, is_remote, in_actor,
+                         _collect_local_names(node))
+        ctx.func_stack.append(info)
+        self._fire("on_function", node)
+        # loops don't leak across a nested def boundary
+        saved_depth, ctx.loop_depth = ctx.loop_depth, 0
+        saved_names, ctx.loop_remote_names = ctx.loop_remote_names, []
+        try:
+            self.generic_visit(node)
+        finally:
+            ctx.loop_depth = saved_depth
+            ctx.loop_remote_names = saved_names
+            ctx.func_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node, is_async=True)
+
+    def visit_ClassDef(self, node):
+        ctx = self.ctx
+        is_actor = any(ctx.is_remote_decorator(d)
+                       for d in node.decorator_list) or (
+            ctx.assume_remote_toplevel and not ctx.class_stack
+            and not ctx.func_stack)
+        info = _ClassInfo(node, is_actor)
+        if is_actor:
+            # pre-collect self.<attr> = <own handle> so a method defined
+            # before __init__ still resolves the attribute (RTL004).
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign) and _is_current_actor_expr(
+                        n.value, ctx):
+                    for t in n.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            info.self_handle_attrs.add(t.attr)
+        ctx.class_stack.append(info)
+        # methods of an actor class must not see the enclosing module's
+        # function stack tricks; plain traversal is fine here.
+        try:
+            self.generic_visit(node)
+        finally:
+            ctx.class_stack.pop()
+
+    # -- loops -------------------------------------------------------------
+
+    def _in_loop(self, visit_body):
+        ctx = self.ctx
+        ctx.loop_depth += 1
+        ctx.loop_remote_names.append(set())
+        try:
+            visit_body()
+        finally:
+            ctx.loop_remote_names.pop()
+            ctx.loop_depth -= 1
+
+    def _visit_for(self, node):
+        # the iter expression evaluates ONCE, before the loop:
+        # ``for x in get(refs.remote())`` is not a get-per-iteration.
+        self.visit(node.iter)
+        self.visit(node.target)
+        self._in_loop(lambda: [self.visit(s)
+                               for s in node.body + node.orelse])
+
+    visit_For = visit_AsyncFor = _visit_for
+
+    def visit_While(self, node):
+        # the test re-evaluates every iteration — it IS loop body.
+        self._in_loop(lambda: [self.visit(node.test)]
+                      + [self.visit(s) for s in node.body + node.orelse])
+
+    def _visit_comp(self, node):
+        # comprehension bodies are loops for serialization purposes; the
+        # FIRST generator's iterable evaluates once, outside.
+        gens = node.generators
+        self.visit(gens[0].iter)
+
+        def body():
+            for i, g in enumerate(gens):
+                self.visit(g.target)
+                if i > 0:
+                    self.visit(g.iter)
+                for cond in g.ifs:
+                    self.visit(cond)
+            if isinstance(node, ast.DictComp):
+                self.visit(node.key)
+                self.visit(node.value)
+            else:
+                self.visit(node.elt)
+
+        self._in_loop(body)
+
+    visit_ListComp = visit_SetComp = visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    # -- statements --------------------------------------------------------
+
+    def visit_Assign(self, node):
+        ctx = self.ctx
+        f = ctx.current_function
+        single = (node.targets[0] if len(node.targets) == 1
+                  and isinstance(node.targets[0], ast.Name) else None)
+        if single is not None:
+            # rename alias: g = rt.get (module or function scope)
+            resolved = ctx.resolve(node.value)
+            if resolved is not None:
+                if f is not None:
+                    f.aliases[single.id] = resolved
+                else:
+                    ctx.aliases[single.id] = resolved
+            # handle-local for RTL004: me = <runtime ctx>.current_actor
+            if f is not None and _is_current_actor_expr(node.value, ctx):
+                f.handle_locals.add(single.id)
+            # loop-local ref names for RTL002
+            if ctx.loop_remote_names and _is_remote_call(node.value):
+                ctx.loop_remote_names[-1].add(single.id)
+        self.generic_visit(node)
+
+    def visit_Expr(self, node):
+        self._fire("on_expr", node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._fire("on_call", node)
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self._fire("on_name", node)
+        self.generic_visit(node)
+
+
+# ------------------------------------------------------------ suppressions
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*disable(?:=(?P<ids>[A-Za-z0-9_,\s]+))?")
+
+
+def _suppressed(finding: Finding, ctx: Context) -> bool:
+    m = _SUPPRESS_RE.search(ctx.source_line(finding.line))
+    if not m:
+        return False
+    ids = m.group("ids")
+    if ids is None:
+        return True  # bare ``# raylint: disable`` silences the line
+    return finding.rule in {s.strip() for s in ids.split(",")}
+
+
+# ------------------------------------------------------------- entry points
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[List[Rule]] = None,
+                   seed_aliases: Optional[Dict[str, str]] = None,
+                   line_offset: int = 0,
+                   assume_remote_toplevel: bool = False) -> List[Finding]:
+    """Analyze one file's source; returns findings (suppressions applied).
+
+    ``line_offset`` shifts reported line numbers (decoration mode analyzes
+    a function snippet but reports file line numbers).
+    """
+    tree = ast.parse(source)
+    if line_offset:
+        ast.increment_lineno(tree, line_offset)
+    ctx = Context(path, source.splitlines(), seed_aliases, line_offset,
+                  assume_remote_toplevel)
+    _prescan_module(tree, ctx)
+    walker = _Walker(ctx, rules if rules is not None else all_rules())
+    walker.visit(tree)
+    out = [f for f in walker.findings if not _suppressed(f, ctx)]
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_file(path: str, rules: Optional[List[Rule]] = None,
+                 display_path: Optional[str] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    return analyze_source(source, display_path or path, rules)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def display_path(path: str) -> str:
+    """Repo-relative posix path when under cwd (stable baseline keys)."""
+    ap = os.path.abspath(path)
+    cwd = os.getcwd()
+    if ap.startswith(cwd + os.sep):
+        ap = os.path.relpath(ap, cwd)
+    return ap.replace(os.sep, "/")
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[List[Rule]] = None,
+                  on_error=None) -> List[Finding]:
+    rules = rules if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            findings.extend(analyze_file(path, rules, display_path(path)))
+        except (SyntaxError, ValueError, OSError) as e:
+            if on_error is not None:
+                on_error(path, e)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------- baseline
+
+def findings_to_json(findings: List[Finding]) -> str:
+    return json.dumps({"version": BASELINE_VERSION,
+                       "findings": [f.to_dict() for f in findings]},
+                      indent=2) + "\n"
+
+
+def load_baseline(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    items = data["findings"] if isinstance(data, dict) else data
+    return [Finding.from_dict(d) for d in items]
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: List[Finding]) -> List[Finding]:
+    """Drop findings covered by the baseline.
+
+    Matching is a per-``(path, rule)`` count allowance, NOT exact lines —
+    edits that shift line numbers must not fail an adopted codebase; only
+    a *new* violation of a rule in a file (count exceeds the baseline)
+    surfaces.
+    """
+    allow = Counter((f.path, f.rule) for f in baseline)
+    out = []
+    for f in findings:
+        key = (f.path, f.rule)
+        if allow.get(key, 0) > 0:
+            allow[key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def max_severity(findings: List[Finding]) -> int:
+    return max((SEVERITY_RANK.get(f.severity, 1) for f in findings),
+               default=0)

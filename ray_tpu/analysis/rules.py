@@ -1,0 +1,378 @@
+"""The built-in RTL rule set (distributed anti-patterns, TPU edition).
+
+Each rule is grounded in this framework's actual execution semantics —
+file references point at the mechanism that makes the pattern a bug here,
+not just a style nit. IDs are stable (baselines and ``# raylint:
+disable=RTLxxx`` suppressions key on them); severity ``error`` is
+reserved for patterns that deadlock or produce wrong results, ``warning``
+for ones that serialize or leak.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import (CANONICAL_AXES, Context, Rule, _is_remote_call,
+                     _is_current_actor_expr, register_rule)
+
+
+def _contains_direct_remote_call(node) -> bool:
+    """A ``.remote()`` call in this expression that is NOT nested under a
+    comprehension: ``get(f.remote(i))`` serializes, but
+    ``get([f.remote(i) for i in xs])`` fans the whole batch out before
+    the single get — the idiomatic fix, not the bug."""
+    if _is_remote_call(node):
+        return True
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.GeneratorExp)):
+        return False
+    return any(_contains_direct_remote_call(c)
+               for c in ast.iter_child_nodes(node))
+
+
+def _receiver_root(call: ast.Call):
+    """Walk ``a.b.c.remote(...)`` down to the leftmost expression."""
+    expr = call.func
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr
+
+
+def _options_names_chain(call: ast.Call) -> bool:
+    """True when the ``.remote()`` receiver chain contains
+    ``.options(name=...)`` — a named (discoverable) actor/task whose
+    handle may be legitimately dropped and re-fetched via get_actor."""
+    expr = call.func
+    while True:
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            if (isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr == "options"
+                    and any(k.arg == "name" for k in expr.keywords)):
+                return True
+            expr = expr.func
+        else:
+            return False
+
+
+@register_rule
+class GetInRemoteTask(Rule):
+    """Sync ``ray_tpu.get`` inside a remote task function.
+
+    The worker pool is finite (``config.task_pool_threads`` per worker);
+    a task that blocks in ``get`` on a child task occupies its slot while
+    waiting, and a deep enough chain (or enough siblings) leaves no slot
+    for the child to run in — the nested-task deadlock the reference
+    documents as "don't block on submitted work inside a task".
+    """
+
+    id = "RTL001"
+    severity = "warning"
+    name = "get-in-remote-task"
+    hint = ("pass ObjectRefs as arguments (they resolve before the task "
+            "starts), return refs to the caller, or use ray_tpu.wait "
+            "with a timeout")
+
+    def on_call(self, node, ctx: Context):
+        if not ctx.in_remote_task():
+            return ()
+        if ctx.resolve(node.func) != "ray_tpu.get":
+            return ()
+        return (self.finding(
+            node, ctx,
+            "blocking ray_tpu.get() inside a remote task — a chain of "
+            "tasks each waiting on a child can exhaust the worker pool "
+            "and deadlock"),)
+
+
+@register_rule
+class GetInLoop(Rule):
+    """``.remote()`` + immediate ``get`` per loop iteration.
+
+    Submitting then synchronously waiting inside the loop serializes the
+    whole batch: one task in flight at a time, N round-trips of scheduler
+    latency instead of one fan-out (the serialization pattern the
+    concurrency paper measures as the dominant TPU-utilization loss).
+    """
+
+    id = "RTL002"
+    severity = "warning"
+    name = "get-in-loop"
+    hint = ("submit every .remote() first, then one "
+            "ray_tpu.get(list_of_refs) outside the loop (or drain with "
+            "ray_tpu.wait as results arrive)")
+
+    def on_call(self, node, ctx: Context):
+        if ctx.loop_depth == 0:
+            return ()
+        if ctx.resolve(node.func) != "ray_tpu.get":
+            return ()
+        immediate = any(_contains_direct_remote_call(a) for a in node.args)
+        loop_local = any(
+            isinstance(a, ast.Name)
+            and any(a.id in names for names in ctx.loop_remote_names)
+            for a in node.args)
+        if not (immediate or loop_local):
+            return ()
+        return (self.finding(
+            node, ctx,
+            "ray_tpu.get() on a just-submitted .remote() inside a loop "
+            "serializes the tasks — only one is ever in flight"),)
+
+
+@register_rule
+class LargeGlobalCapture(Rule):
+    """Remote function closes over a large module-level object.
+
+    Captured globals ride the cloudpickled function blob: re-serialized
+    at registration and shipped to every executing worker, instead of
+    landing in the shared-memory object store once
+    (``_private/remote.py`` registers the pickle per session; large args
+    go through ``ray_tpu.put`` / the inline-vs-shm split).
+    """
+
+    id = "RTL003"
+    severity = "warning"
+    name = "large-global-capture"
+    hint = ("ref = ray_tpu.put(big) once, then pass ref as an argument — "
+            "workers map it zero-copy from the object store")
+
+    def on_name(self, node, ctx: Context):
+        if node.id not in ctx.large_globals:
+            return ()
+        f = ctx.current_function
+        if f is None or node.id in f.local_names:
+            return ()
+        if not (ctx.in_remote_task()
+                or (f.in_actor and ctx.current_class is not None)):
+            return ()
+        return (self.finding(
+            node, ctx,
+            f"remote function captures large module-level object "
+            f"{node.id!r} ({ctx.large_globals[node.id]}) — it is "
+            f"re-pickled into the function blob instead of shared via "
+            f"the object store"),)
+
+
+@register_rule
+class ActorSelfGet(Rule):
+    """Actor blocks on a method of its own handle: self-deadlock.
+
+    A ``max_concurrency=1`` actor executes methods one at a time
+    (sequential executor, ``worker_main.Executor``); ``get`` on a ref
+    produced by calling *yourself* can never resolve — the nested call
+    waits behind the very method that is blocking on it.
+    """
+
+    id = "RTL004"
+    severity = "error"
+    name = "actor-self-get"
+    hint = ("return the ObjectRef (or the value) to the caller instead, "
+            "or make the method async and await the ref")
+
+    def on_call(self, node, ctx: Context):
+        if ctx.resolve(node.func) != "ray_tpu.get":
+            return ()
+        f = ctx.current_function
+        cls = ctx.current_class
+        if f is None or not f.in_actor or cls is None:
+            return ()
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if not _is_remote_call(sub):
+                    continue
+                root = _receiver_root(sub)
+                # self.<handle_attr>.method.remote()
+                chain = sub.func
+                attrs = []
+                while isinstance(chain, ast.Attribute):
+                    attrs.append(chain.attr)
+                    chain = chain.value
+                if (isinstance(chain, ast.Name) and chain.id == "self"
+                        and any(a in cls.self_handle_attrs
+                                for a in attrs)):
+                    return (self._hit(node, ctx),)
+                # me = get_runtime_context().current_actor; get(me.f.remote())
+                if (isinstance(root, ast.Name)
+                        and root.id in f.handle_locals):
+                    return (self._hit(node, ctx),)
+                # get(get_runtime_context().current_actor.f.remote())
+                if any(_is_current_actor_expr(n, ctx)
+                       for n in ast.walk(sub.func)):
+                    return (self._hit(node, ctx),)
+        return ()
+
+    def _hit(self, node, ctx):
+        return self.finding(
+            node, ctx,
+            "actor calls ray_tpu.get() on its own handle — the nested "
+            "method waits behind the method that is blocking on it: "
+            "guaranteed deadlock on a sequential actor")
+
+
+@register_rule
+class UnboundCollectiveAxis(Rule):
+    """Collective over an axis name no mesh/shard_map binds.
+
+    ``lax.psum(x, "dpp")`` inside ``shard_map`` dies at trace time deep
+    in XLA with an unbound-axis error — after the mesh was built and the
+    TPU slice reserved. The canonical mesh axes here are fixed
+    (``parallel/mesh.py`` AXES); anything else must be bound by a
+    ``Mesh``/``shard_map``/``pmap`` visible in the module.
+    """
+
+    id = "RTL005"
+    severity = "error"
+    name = "unbound-collective-axis"
+    hint = ("bind the axis via Mesh(devices, (...)) / shard_map, or fix "
+            f"the name — canonical axes: {', '.join(CANONICAL_AXES)}")
+
+    _COLLECTIVES = {
+        "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+        "jax.lax.all_gather", "jax.lax.psum_scatter", "jax.lax.all_to_all",
+        "jax.lax.ppermute", "jax.lax.axis_index", "jax.lax.axis_size",
+    }
+
+    def on_call(self, node, ctx: Context):
+        resolved = ctx.resolve(node.func)
+        if resolved not in self._COLLECTIVES:
+            return ()
+        axis = None
+        if len(node.args) >= 2:
+            axis = node.args[1]
+        elif resolved in ("jax.lax.axis_index", "jax.lax.axis_size") \
+                and node.args:
+            axis = node.args[0]
+        for k in node.keywords:
+            # only a *string* axis/axis_name kwarg names an axis —
+            # all_gather's ``axis=`` int kwarg is the array dimension
+            if (k.arg in ("axis_name", "axis")
+                    and isinstance(k.value, ast.Constant)
+                    and isinstance(k.value.value, str)):
+                axis = k.value
+        if not (isinstance(axis, ast.Constant)
+                and isinstance(axis.value, str)):
+            return ()
+        name = axis.value
+        if name in ctx.bound_axes or name in CANONICAL_AXES:
+            return ()
+        return (self.finding(
+            node, ctx,
+            f"collective over axis {name!r} which no Mesh/shard_map in "
+            f"this module binds — this fails at trace time after the "
+            f"TPU slice is already reserved"),)
+
+
+@register_rule
+class BlockingInAsync(Rule):
+    """Sync blocking call inside an ``async def``.
+
+    The static twin of ``thread_check.LoopMonitor``: one ``time.sleep``
+    or sync ``get`` inside an async actor method stalls the whole IO
+    loop — every other in-flight method, heartbeat, and connection on
+    this worker stops until it returns.
+    """
+
+    id = "RTL006"
+    severity = "warning"
+    name = "blocking-in-async"
+    hint = ("use `await asyncio.sleep(...)`, `await ref` (ObjectRefs are "
+            "awaitable), or loop.run_in_executor for unavoidable "
+            "blocking work")
+
+    _BLOCKING = {
+        "time.sleep": "time.sleep()",
+        "ray_tpu.get": "sync ray_tpu.get()",
+        "subprocess.run": "subprocess.run()",
+        "subprocess.call": "subprocess.call()",
+        "subprocess.check_call": "subprocess.check_call()",
+        "subprocess.check_output": "subprocess.check_output()",
+        "os.system": "os.system()",
+        "urllib.request.urlopen": "urllib.request.urlopen()",
+        "requests.get": "requests.get()",
+        "requests.post": "requests.post()",
+        "socket.create_connection": "socket.create_connection()",
+    }
+
+    def on_call(self, node, ctx: Context):
+        f = ctx.current_function
+        if f is None or not f.is_async:
+            return ()
+        what = self._BLOCKING.get(ctx.resolve(node.func) or "")
+        if what is None:
+            return ()
+        return (self.finding(
+            node, ctx,
+            f"blocking {what} inside `async def "
+            f"{f.node.name}` stalls the event loop — every concurrent "
+            f"method and heartbeat on this worker waits"),)
+
+
+@register_rule
+class DroppedObjectRef(Rule):
+    """Bare ``x.remote()`` statement: the ObjectRef is discarded.
+
+    Nobody will ever ``get``/``wait`` it, so failures are invisible
+    (errors live in the result object) and for actors the only handle is
+    lost. Named actors (``.options(name=...)``) are exempt — they are
+    re-fetchable via ``get_actor``.
+    """
+
+    id = "RTL007"
+    severity = "warning"
+    name = "dropped-object-ref"
+    hint = ("keep the ref and get()/wait() it (errors surface there); "
+            "for intentional fire-and-forget add "
+            "# raylint: disable=RTL007")
+
+    def on_expr(self, node, ctx: Context):
+        call = node.value
+        if not _is_remote_call(call):
+            return ()
+        if _options_names_chain(call):
+            return ()
+        return (self.finding(
+            node, ctx,
+            "ObjectRef from .remote() is discarded — the task/actor may "
+            "fail silently and its result is unreachable"),)
+
+
+@register_rule
+class MutableDefaultArg(Rule):
+    """Mutable default on a remote / dataset-map function.
+
+    Workers are long-lived and cache the unpickled function
+    (``worker_main.Executor.fn_cache``): a ``def f(x, acc=[])`` default
+    is created once per worker and *shared across every task that lands
+    there* — state bleeds between unrelated calls, differently per
+    worker.
+    """
+
+    id = "RTL008"
+    severity = "warning"
+    name = "mutable-default-arg"
+    hint = "default to None and create the container inside the body"
+
+    def on_function(self, node, ctx: Context):
+        f = ctx.current_function
+        is_target = (
+            (f is not None and f.is_remote_task)
+            or (f is not None and f.in_actor and len(ctx.func_stack) == 1)
+            or node.name in ctx.map_fn_names)
+        if not is_target:
+            return ()
+        out = []
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set", "bytearray")):
+                out.append(self.finding(
+                    d, ctx,
+                    f"mutable default argument on remote function "
+                    f"{node.name!r} — the default is created once per "
+                    f"worker and shared across every call that lands "
+                    f"there"))
+        return out

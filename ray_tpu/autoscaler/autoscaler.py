@@ -153,6 +153,15 @@ class Autoscaler:
         #    Still never below min_workers, never while demand is pending.
         terminated = []
         drained = []
+        # Instances already RAY_DRAINING still count in live_counts()
+        # (they hold capacity until terminated) but are ALREADY leaving:
+        # the min_workers floor must see them as gone, or successive
+        # rounds drain one node each past the floor down to zero.
+        already_draining: Dict[str, int] = {}
+        for i2 in self.im.instances.values():
+            if i2.state == RAY_DRAINING:
+                already_draining[i2.node_type] = (
+                    already_draining.get(i2.node_type, 0) + 1)
         if not demands:
             for n in alive_nodes:
                 inst = self.im.find_by_node_id(n["node_id"])
@@ -180,8 +189,10 @@ class Autoscaler:
                 min_w = cfg.min_workers if cfg else 0
                 live = counts.get(inst.node_type, 0)
                 if (n["idle_s"] > self.config.idle_timeout_s
-                        and live - len([t for t in drained
-                                        if t.node_type == inst.node_type])
+                        and live
+                        - already_draining.get(inst.node_type, 0)
+                        - len([t for t in drained
+                               if t.node_type == inst.node_type])
                         > min_w):
                     if self._request_drain(n["node_id"],
                                            "autoscaler idle scale-down"):
@@ -194,6 +205,9 @@ class Autoscaler:
         alive_ids = {n["node_id"] for n in alive_nodes}
         for inst in list(self.im.instances.values()):
             if inst.state == RAY_DRAINING and inst.node_id_hex not in alive_ids:
+                # also forget its settle counter — this release path
+                # bypasses the two-round settle bookkeeping above
+                self._drain_settle.pop(inst.im_id, None)
                 self.im.terminate(inst.im_id, "drained (node dead)")
                 terminated.append(inst)
         self.terminated_total += len(terminated)

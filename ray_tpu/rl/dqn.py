@@ -150,15 +150,19 @@ class DQN(Algorithm):
         stats: Dict[str, Any] = {}
         if size >= cfg.learning_starts:
             for _ in range(cfg.num_updates_per_iter):
-                mb = ray_tpu.get(self.replay.sample.remote(
+                # sample -> train is a true data dependency per update
+                # (priorities shift between samples): serial on purpose
+                mb = ray_tpu.get(self.replay.sample.remote(  # raylint: disable=RTL002
                     cfg.train_batch_size))
                 if mb is None:
                     break
                 idx = mb.pop("_indices")
-                out = ray_tpu.get(self.learner.train_on.remote(mb))
+                out = ray_tpu.get(self.learner.train_on.remote(mb))  # raylint: disable=RTL002
                 stats = out["stats"]
                 if cfg.prioritized_replay:
-                    self.replay.update_priorities.remote(idx, out["td_abs"])
+                    # fire-and-forget by design: priority updates are
+                    # advisory and must not block the training loop
+                    self.replay.update_priorities.remote(idx, out["td_abs"])  # raylint: disable=RTL007
         self.learner_weights_ref = w
         return {"learner": stats, "epsilon": self.epsilon,
                 "replay_size": size,

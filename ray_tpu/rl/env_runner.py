@@ -186,8 +186,10 @@ class EnvRunnerGroup:
             try:
                 out.append(ray_tpu.get(ref, timeout=300))
             except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError):
+                # single-runner crash recovery: the immediate retry IS
+                # the point — not a fan-out opportunity
                 self.runners[i] = self._make(i)
-                out.append(ray_tpu.get(
+                out.append(ray_tpu.get(  # raylint: disable=RTL002
                     getattr(self.runners[i], method).remote(*args),
                     timeout=300))
         return out

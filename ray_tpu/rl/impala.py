@@ -140,9 +140,10 @@ class IMPALA(Algorithm):
                     self.env_runner_group.restart_runner(i)
             # Dead aggregators would otherwise poison every later round the
             # round-robin lands on them.
-            for j, a in enumerate(self.aggregators):
+            pings = [a.ping.remote() for a in self.aggregators]
+            for j, ref in enumerate(pings):
                 try:
-                    ray_tpu.get(a.ping.remote(), timeout=5)
+                    ray_tpu.get(ref, timeout=5)
                 except Exception:
                     self.aggregators[j] = _Aggregator.remote()
             return {"learner": {}, "num_env_steps_sampled": 0}

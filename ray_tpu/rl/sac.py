@@ -225,12 +225,14 @@ class SAC(Algorithm):
         stats: Dict[str, Any] = {}
         if size >= cfg.learning_starts:
             for _ in range(cfg.num_updates_per_iter):
-                mb = ray_tpu.get(self.replay.sample.remote(
+                # sample -> train is a true data dependency per update:
+                # serial on purpose
+                mb = ray_tpu.get(self.replay.sample.remote(  # raylint: disable=RTL002
                     cfg.train_batch_size))
                 if mb is None:
                     break
                 mb.pop("_indices", None)
-                stats = ray_tpu.get(self.learner.train_on.remote(mb))
+                stats = ray_tpu.get(self.learner.train_on.remote(mb))  # raylint: disable=RTL002
         return {"learner": stats, "replay_size": size,
                 "num_env_steps_sampled": len(batch["obs"])}
 

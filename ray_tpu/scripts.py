@@ -235,6 +235,14 @@ def cmd_serve(args):
         print("serve shut down")
 
 
+def cmd_check(args):
+    """Static analysis for distributed anti-patterns (no cluster needed;
+    see ``ray_tpu/analysis/``)."""
+    from ray_tpu.analysis.cli import run_check
+
+    raise SystemExit(run_check(args))
+
+
 def cmd_up(args):
     """Cluster launcher (reference: ``ray up``, ``autoscaler/_private/
     commands.py create_or_update_cluster``)."""
@@ -282,6 +290,13 @@ def main(argv=None):
     sp = ssub.add_parser("shutdown")
     sp.add_argument("--address", default="")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("check", help="static analysis for distributed "
+                       "anti-patterns (RTL rules)")
+    from ray_tpu.analysis.cli import add_arguments as _check_args
+
+    _check_args(p)
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("up", help="launch a cloud TPU cluster from YAML")
     p.add_argument("config", help="cluster YAML (see autoscaler/launcher.py)")

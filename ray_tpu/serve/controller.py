@@ -188,10 +188,13 @@ class ServeController:
                 spec = dep["spec"]
                 fresh = [_spawn_replica(app_name, spec) for _ in doomed]
                 if spec.get("user_config") is not None:
-                    for r in fresh:
+                    # fan out, then collect: one straggler must not
+                    # serialize the whole batch (ray_tpu check RTL002)
+                    cfg_refs = [r.reconfigure.remote(spec["user_config"])
+                                for r in fresh]
+                    for ref in cfg_refs:
                         try:
-                            ray_tpu.get(r.reconfigure.remote(
-                                spec["user_config"]), timeout=30)
+                            ray_tpu.get(ref, timeout=30)
                         except Exception:
                             pass
                 try:
@@ -226,9 +229,13 @@ class ServeController:
         for app_name, app in self.apps.items():
             for dep in app.values():
                 alive = []
-                for r in dep["replicas"]:
+                # all probes in flight at once: N replicas cost one
+                # 5s timeout worst-case, not N (ray_tpu check RTL002)
+                probes = [(r, r.health_check.remote())
+                          for r in dep["replicas"]]
+                for r, ref in probes:
                     try:
-                        ray_tpu.get(r.health_check.remote(), timeout=5)
+                        ray_tpu.get(ref, timeout=5)
                         alive.append(r)
                     except Exception:
                         replaced += 1

@@ -106,7 +106,8 @@ def get_and_run_worker_killer(kill_interval_s: float = 0.3,
     killer = WorkerKillerActor.options(
         name="_chaos_worker_killer", max_concurrency=2).remote(
             kill_interval_s=kill_interval_s, max_kills=max_kills)
-    killer.run.remote()
+    # the kill loop runs until stop(): fire-and-forget by design
+    killer.run.remote()  # raylint: disable=RTL007
     return killer
 
 
@@ -114,7 +115,8 @@ def get_and_run_actor_killer(kill_interval_s: float = 0.5, exclude=()):
     killer = ActorKillerActor.options(
         name="_chaos_actor_killer", max_concurrency=2).remote(
             kill_interval_s=kill_interval_s, exclude=exclude)
-    killer.run.remote()
+    # the kill loop runs until stop(): fire-and-forget by design
+    killer.run.remote()  # raylint: disable=RTL007
     return killer
 
 

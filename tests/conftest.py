@@ -23,6 +23,11 @@ os.environ["RAY_TPU_JAX_PLATFORM"] = "cpu"  # workers inherit this
 # ``.bazelrc:104-116``): loop/thread affinity assertions are live in every
 # test process — an off-loop Connection write fails the test that did it.
 os.environ.setdefault("RAY_TPU_THREAD_CHECKS", "1")
+# Decoration-time static analysis across the whole suite (the offline
+# `ray_tpu check` twin, ray_tpu/analysis/): every @ray_tpu.remote in any
+# test is linted as it registers. Warnings only — registration must never
+# hard-fail (tests/test_static_analysis.py asserts exactly that).
+os.environ.setdefault("RAY_TPU_STATIC_CHECKS", "1")
 
 import jax  # noqa: E402
 

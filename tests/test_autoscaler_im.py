@@ -244,3 +244,37 @@ def test_draining_instance_released_when_node_forced_dead():
     a.update()
     assert inst.state in (TERMINATING, TERMINATED)
     assert cloud_id not in cloud.nodes
+
+
+def test_idle_drain_respects_min_workers_across_rounds():
+    """An instance drained in an earlier round still counts in
+    live_counts() (RAY_DRAINING is live capacity) — the min_workers
+    floor must treat it as already leaving, or successive rounds drain
+    one node each until the pool hits zero."""
+    cloud = FakeCloud()
+    cfg = AutoscalerConfig(node_types={
+        "tpu_v5e": NodeTypeConfig(resources={"TPU": 4.0},
+                                  min_workers=1, max_workers=3)},
+        idle_timeout_s=0.0)
+    a = _DrainTrackingAutoscaler(cfg, cloud, gcs_address="fake")
+
+    insts = a.im.launch("tpu_v5e", {"TPU": 4.0}, 2)
+    a.im.reconcile([])
+    a.im.reconcile([i.node_id_hex for i in insts])
+    assert all(i.state == RAY_RUNNING for i in insts)
+    for i in insts:
+        a.busy_nodes.add(i.node_id_hex)
+
+    # Round 1: 2 live > min_workers=1 -> exactly one drain request.
+    a.update()
+    assert len(a.drain_requests) == 1
+    states = sorted(i.state for i in insts)
+    assert states == sorted([RAY_RUNNING, RAY_DRAINING])
+
+    # Rounds 2-4: the drained node is still vacating (busy) — the OTHER
+    # node must never be drained: it IS the min_workers floor.
+    for _ in range(3):
+        a.update()
+    assert len(a.drain_requests) == 1
+    assert sorted(i.state for i in insts) == sorted(
+        [RAY_RUNNING, RAY_DRAINING])

@@ -160,6 +160,55 @@ def test_loop_monitor_sees_blocking():
     assert stats["max_lag_ms"] > 200  # the 300ms block was observed
 
 
+def test_loop_monitor_stop_idempotent():
+    """stop() is safe twice, after the task finished, and post-loop."""
+    async def run():
+        mon = LoopMonitor(interval=0.01, name="t").start()
+        await asyncio.sleep(0.05)
+        mon.stop()
+        mon.stop()  # second call: no task left — must be a no-op
+        # stop against an externally-finished task must not cancel-crash
+        mon2 = LoopMonitor(interval=0.01, name="t2").start()
+        mon2._task.cancel()
+        try:
+            await mon2._task
+        except asyncio.CancelledError:
+            pass
+        assert mon2._task.done()
+        mon2.stop()
+        return mon.stats()
+
+    stats = asyncio.run(run())
+    assert stats["samples"] >= 1
+
+
+def test_thread_checker_lock_free_after_bind(monkeypatch):
+    """The bound-path read takes no lock; affinity still enforced."""
+    monkeypatch.setenv("RAY_TPU_THREAD_CHECKS", "1")
+    tc = ThreadChecker("fast")
+    tc.check()  # binds
+    for _ in range(3):
+        tc.check()  # fast path
+    seen = []
+
+    def other():
+        try:
+            tc.check()
+        except RuntimeError as e:
+            seen.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert len(seen) == 1 and "affinity violated" in str(seen[0])
+    tc.reset()
+    t2 = threading.Thread(target=tc.check)  # rebind from another thread
+    t2.start()
+    t2.join()
+    with pytest.raises(RuntimeError):
+        tc.check()  # now THIS thread is the violator
+
+
 def test_cluster_info_exposes_loop_stats():
     import ray_tpu
 

@@ -1,0 +1,514 @@
+"""ray_tpu/analysis/: rule positives+negatives, alias tracking,
+suppressions, baseline round-trip, CLI exit codes, decoration-time gate,
+and the tier-1 self-scan against the committed baseline."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import pytest
+
+import ray_tpu
+from ray_tpu.analysis import (StaticCheckWarning, analyze_source,
+                              apply_baseline, check_decorated,
+                              findings_to_json, load_baseline, rule_table,
+                              warn_on_decoration)
+from ray_tpu.analysis.cli import main as check_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str):
+    return [f.rule for f in analyze_source(textwrap.dedent(src), "t.py")]
+
+
+def lines_of(src: str, rule: str):
+    return [f.line for f in analyze_source(textwrap.dedent(src), "t.py")
+            if f.rule == rule]
+
+
+# ------------------------------------------------------------ RTL001
+
+def test_rtl001_get_in_remote_task_fires():
+    src = '''
+    import ray_tpu
+
+    @ray_tpu.remote
+    def parent(refs):
+        return ray_tpu.get(refs)
+    '''
+    assert lines_of(src, "RTL001") == [6]
+
+
+def test_rtl001_plain_function_clean():
+    src = '''
+    import ray_tpu
+
+    def driver(refs):
+        return ray_tpu.get(refs)
+    '''
+    assert "RTL001" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL002
+
+def test_rtl002_get_in_loop_fires():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        out = []
+        for i in range(10):
+            out.append(ray_tpu.get(f.remote(i)))
+        return out
+    '''
+    assert lines_of(src, "RTL002") == [7]
+
+
+def test_rtl002_loop_local_ref_name_fires():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        for i in range(10):
+            r = f.remote(i)
+            ray_tpu.get(r)
+    '''
+    assert lines_of(src, "RTL002") == [7]
+
+
+def test_rtl002_comprehension_of_gets_fires():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        return [ray_tpu.get(f.remote(i)) for i in range(10)]
+    '''
+    assert lines_of(src, "RTL002") == [5]
+
+
+def test_rtl002_fan_out_then_get_clean():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        refs = [f.remote(i) for i in range(10)]
+        return ray_tpu.get(refs)
+    '''
+    assert "RTL002" not in rules_of(src)
+
+
+def test_rtl002_batched_get_inside_outer_loop_clean():
+    # get([listcomp of .remote()]) fans the batch out even when the get
+    # sits inside an outer loop — the idiom, not the bug.
+    src = '''
+    import ray_tpu
+
+    def run(deployments):
+        for dep in deployments:
+            ray_tpu.get([r.health.remote() for r in dep])
+    '''
+    assert "RTL002" not in rules_of(src)
+
+
+def test_rtl002_for_iter_expression_clean():
+    # ``for x in get(a.remote())``: the iter evaluates once, before the
+    # loop — not a get per iteration.
+    src = '''
+    import ray_tpu
+
+    def run(ctl):
+        for app in ray_tpu.get(ctl.list.remote()):
+            print(app)
+    '''
+    assert "RTL002" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL003
+
+def test_rtl003_large_global_capture_fires():
+    src = '''
+    import ray_tpu
+
+    BIG = [0] * 1000000
+
+    @ray_tpu.remote
+    def f(i):
+        return BIG[i]
+    '''
+    assert lines_of(src, "RTL003") == [8]
+
+
+def test_rtl003_local_shadow_and_small_global_clean():
+    src = '''
+    import ray_tpu
+
+    SMALL = [1, 2, 3]
+    BIG = [0] * 1000000
+
+    @ray_tpu.remote
+    def f(i):
+        BIG = {}
+        return BIG.get(i, SMALL[0])
+    '''
+    assert "RTL003" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL004
+
+def test_rtl004_actor_self_get_fires():
+    src = '''
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self):
+            self.me = ray_tpu.get_runtime_context().current_actor
+
+        def f(self, x):
+            return ray_tpu.get(self.me.f.remote(x))
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL004"]
+    assert [f.line for f in hits] == [10]
+    assert hits[0].severity == "error"
+
+
+def test_rtl004_get_on_other_actor_clean():
+    src = '''
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, other):
+            self.other = other
+
+        def f(self, x):
+            return ray_tpu.get(self.other.f.remote(x))
+    '''
+    assert "RTL004" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL005
+
+def test_rtl005_unbound_axis_fires_as_error():
+    src = '''
+    from jax import lax
+
+    def f(x):
+        return lax.psum(x, "dpp")
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL005"]
+    assert [f.line for f in hits] == [5]
+    assert hits[0].severity == "error"
+
+
+def test_rtl005_bound_and_canonical_axes_clean():
+    src = '''
+    from jax import lax
+    from jax.sharding import Mesh
+
+    def make(devices):
+        return Mesh(devices, ("rows", "cols"))
+
+    def f(x):
+        return lax.psum(x, "rows") + lax.pmean(x, "dp")
+    '''
+    assert "RTL005" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL006
+
+def test_rtl006_blocking_in_async_fires():
+    src = '''
+    import time
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        async def f(self, ref):
+            time.sleep(1)
+            return ray_tpu.get(ref)
+    '''
+    assert lines_of(src, "RTL006") == [8, 9]
+
+
+def test_rtl006_async_sleep_clean():
+    src = '''
+    import asyncio
+
+    @ray_tpu.remote
+    class A:
+        async def f(self, ref):
+            await asyncio.sleep(1)
+            return await ref
+    '''
+    assert "RTL006" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL007
+
+def test_rtl007_dropped_ref_fires():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        f.remote(1)
+    '''
+    assert lines_of(src, "RTL007") == [5]
+
+
+def test_rtl007_named_actor_and_kept_ref_clean():
+    src = '''
+    import ray_tpu
+
+    def run(f, Actor):
+        Actor.options(name="svc", lifetime="detached").remote()
+        ref = f.remote(1)
+        return ray_tpu.get(ref)
+    '''
+    assert "RTL007" not in rules_of(src)
+
+
+# ------------------------------------------------------------ RTL008
+
+def test_rtl008_mutable_default_fires():
+    src = '''
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f(x, acc=[]):
+        return acc
+
+    def mapper(row, seen={}):
+        return row
+
+    def pipe(ds):
+        return ds.map_batches(mapper)
+    '''
+    assert lines_of(src, "RTL008") == [5, 8]
+
+
+def test_rtl008_plain_function_and_none_default_clean():
+    src = '''
+    import ray_tpu
+
+    def local(x, acc=[]):
+        return acc
+
+    @ray_tpu.remote
+    def f(x, acc=None):
+        return acc
+    '''
+    assert "RTL008" not in rules_of(src)
+
+
+# ------------------------------------------- aliasing / renames
+
+def test_alias_import_as_resolves():
+    src = '''
+    import ray_tpu as rt
+
+    @rt.remote
+    def parent(refs):
+        return rt.get(refs)
+    '''
+    assert "RTL001" in rules_of(src)
+
+
+def test_alias_from_import_and_rename_resolve():
+    src = '''
+    from ray_tpu import remote, get
+
+    g = get
+
+    @remote
+    def parent(refs):
+        return g(refs)
+    '''
+    assert "RTL001" in rules_of(src)
+
+
+# ------------------------------------------------- suppressions
+
+def test_inline_suppression_by_id():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        f.remote(1)  # raylint: disable=RTL007
+        f.remote(2)
+    '''
+    assert lines_of(src, "RTL007") == [6]
+
+
+def test_inline_suppression_bare_disables_line():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        f.remote(1)  # raylint: disable
+    '''
+    assert rules_of(src) == []
+
+
+def test_suppression_of_other_rule_does_not_apply():
+    src = '''
+    import ray_tpu
+
+    def run(f):
+        f.remote(1)  # raylint: disable=RTL001
+    '''
+    assert "RTL007" in rules_of(src)
+
+
+# ---------------------------------------------- baseline / CLI
+
+def test_baseline_round_trip(tmp_path):
+    src = textwrap.dedent('''
+    import ray_tpu
+
+    def run(f):
+        f.remote(1)
+        for i in range(4):
+            ray_tpu.get(f.remote(i))
+    ''')
+    findings = analyze_source(src, "m.py")
+    assert {f.rule for f in findings} == {"RTL007", "RTL002"}
+    blob = findings_to_json(findings)
+    p = tmp_path / "base.json"
+    p.write_text(blob)
+    loaded = load_baseline(str(p))
+    assert [f.to_dict() for f in loaded] == [f.to_dict() for f in findings]
+    # fully baselined -> nothing left; one extra -> only the extra left
+    assert apply_baseline(findings, loaded) == []
+    extra = analyze_source(src + "\n\ndef g(f):\n    f.remote(9)\n", "m.py")
+    left = apply_baseline(extra, loaded)
+    assert [f.rule for f in left] == ["RTL007"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("import ray_tpu\n\n"
+                     "def f(x):\n    return ray_tpu.get(x)\n")
+    warn = tmp_path / "warn.py"
+    warn.write_text("import ray_tpu\n\ndef f(g):\n    g.remote(1)\n")
+    err = tmp_path / "err.py"
+    err.write_text("from jax import lax\n\n"
+                   "def f(x):\n    return lax.psum(x, 'bogus_axis')\n")
+    assert check_main([str(clean)]) == 0
+    assert check_main([str(warn)]) == 1
+    assert check_main([str(err)]) == 2
+    assert check_main([str(err), "--disable", "RTL005"]) == 0
+    assert check_main([str(err), "--select", "RTL007"]) == 0
+    capsys.readouterr()
+    # --format json output IS the baseline format
+    assert check_main([str(warn), "--format", "json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(data))
+    assert check_main([str(warn), "--baseline", str(base)]) == 0
+    # --write-baseline is the deliberate allowlist-refresh path
+    assert check_main([str(err), "--write-baseline",
+                       "--baseline", str(base)]) == 0
+    assert check_main([str(err), "--baseline", str(base)]) == 0
+
+
+# ------------------------------------------------- self-scan (tier-1)
+
+def test_self_scan_against_committed_baseline():
+    """Any NEW violation in ray_tpu/ or examples/ fails the suite; the
+    committed baseline allowlists the reviewed existing ones. Refresh it
+    deliberately with:  python -m ray_tpu check ray_tpu examples
+    --write-baseline --baseline raylint_baseline.json"""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu", "examples",
+         "--baseline", "raylint_baseline.json", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "new static-analysis violations (fix them or deliberately "
+        "refresh raylint_baseline.json):\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []
+
+
+def test_rule_table_covers_all_eight():
+    ids = [r["id"] for r in rule_table()]
+    assert ids == [f"RTL00{i}" for i in range(1, 9)]
+
+
+# ------------------------------------- decoration-time (RAY_TPU_STATIC_CHECKS)
+
+def test_decoration_time_warns_but_registers(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_STATIC_CHECKS", "1")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+
+        @ray_tpu.remote
+        def deco_bad(refs):
+            return ray_tpu.get(refs)
+
+    assert isinstance(deco_bad, ray_tpu.RemoteFunction)  # never hard-fails
+    msgs = [str(x.message) for x in w
+            if isinstance(x.message, StaticCheckWarning)]
+    assert any("RTL001" in m for m in msgs)
+
+
+def test_decoration_time_actor_class_warns_but_registers(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_STATIC_CHECKS", "1")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+
+        @ray_tpu.remote
+        class DecoActor:
+            def __init__(self):
+                self.me = ray_tpu.get_runtime_context().current_actor
+
+            def f(self, x):
+                return ray_tpu.get(self.me.f.remote(x))
+
+    assert isinstance(DecoActor, ray_tpu.ActorClass)
+    msgs = [str(x.message) for x in w
+            if isinstance(x.message, StaticCheckWarning)]
+    assert any("RTL004" in m for m in msgs)
+
+
+def test_decoration_time_gate_off(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_STATIC_CHECKS", "0")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+
+        @ray_tpu.remote
+        def deco_bad2(refs):
+            return ray_tpu.get(refs)
+
+    assert not [x for x in w if isinstance(x.message, StaticCheckWarning)]
+
+
+def test_decoration_time_never_raises_without_source():
+    # exec'd code has no retrievable source: silently clean, never an error
+    ns = {"ray_tpu": ray_tpu}
+    exec("def nosrc(refs):\n    return ray_tpu.get(refs)\n", ns)
+    assert check_decorated(ns["nosrc"]) == []
+    warn_on_decoration(ns["nosrc"])  # must not raise
+
+
+def test_decoration_time_reports_real_file_and_line():
+    import inspect
+
+    def bad_local(refs):
+        return ray_tpu.get(refs)  # the finding must anchor HERE
+
+    findings = check_decorated(bad_local)
+    assert [f.rule for f in findings] == ["RTL001"]
+    assert findings[0].path.endswith("test_static_analysis.py")
+    src, start = inspect.getsourcelines(bad_local)
+    want = start + next(i for i, line in enumerate(src)
+                        if "ray_tpu.get" in line)
+    assert findings[0].line == want
