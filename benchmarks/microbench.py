@@ -117,6 +117,20 @@ def main():
     # Warm the exact shape (like every other metric here): the first
     # array-arg call per actor pays that worker's lazy numpy import.
     ray_tpu.get([actors[i % 4].with_arg.remote(arr) for i in range(8)])
+    ray_tpu.get(a.with_arg.remote(arr))
+
+    # Transport-tier counters bracket the with-arg shapes: the report
+    # shows where payloads actually rode (direct lane vs shm+GCS) so a
+    # silent routing regression is visible next to the rate it tanks.
+    from ray_tpu._private import serialization as _ser
+
+    _ser.reset_transport_stats()
+
+    def one_one_actor_arg(n):
+        ray_tpu.get([a.with_arg.remote(arr) for _ in range(n)])
+
+    timeit("1_1_actor_calls_with_arg_async", one_one_actor_arg,
+           int(1000 * scale), results)
 
     def nn_actor_arg(n):
         refs = []
@@ -126,6 +140,9 @@ def main():
 
     timeit("n_n_actor_calls_with_arg_async", nn_actor_arg, int(1000 * scale),
            results)
+
+    results["transport"] = _ser.transport_stats()
+    print(f"transport: {results['transport']}", flush=True)
 
     small = {"k": 1}
 

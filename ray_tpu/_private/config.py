@@ -38,6 +38,13 @@ class RayTpuConfig:
     pull_chunk_bytes: int = 4 << 20  # p2p transfer chunk
     pull_window: int = 4            # outstanding chunks per pull
     inline_threshold: int = 100 * 1024
+    # Direct-lane ceiling: actor-call args above inline_threshold and at
+    # most this ride the already-open actor connection out-of-band
+    # (scatter-gather frames, zero-copy write side) instead of the
+    # per-call shm create/seal + GCS register round trip. Larger args —
+    # and anything a second consumer might borrow — keep the shm+GCS
+    # object-plane path.
+    direct_arg_threshold: int = 1 << 20
     # ---- fault tolerance
     reconnect_attempts: int = 75    # GCS reconnect budget (x delay ~15s)
     reconnect_delay_s: float = 0.2

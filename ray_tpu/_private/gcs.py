@@ -672,6 +672,14 @@ class GcsServer:
 
     async def _dispatch(self, client: ClientConn, msg: dict):
         t = msg.get("t")
+        if t is None:
+            # Empty/typeless frame (the undecodable-frame placeholder from
+            # protocol's decode guard, or a buggy peer): skip explicitly
+            # instead of falling through handler lookup with t=None.
+            if msg:
+                logger.warning("dropping typeless message %r",
+                               sorted(msg)[:8])
+            return
         handler = getattr(self, f"_h_{t}", None)
         if handler is None:
             logger.warning("unknown message type %r", t)
@@ -1068,20 +1076,20 @@ class GcsServer:
                     "nbytes": entry.nbytes}
         return {"ok": True, "where": "shm", "nbytes": entry.nbytes}
 
-    async def _h_obj_put(self, client, msg):
-        oid = ObjectID(msg["oid"])
+    def _obj_put_one(self, client, o: dict):
+        """Register one object (shared by obj_put and the coalesced
+        obj_puts batch)."""
+        oid = ObjectID(o["oid"])
         entry = self._obj(oid)
         if entry.ready:  # duplicate registration
-            if client.node_id is not None and msg.get("shm"):
+            if client.node_id is not None and o.get("shm"):
                 entry.holders.add(client.node_id.binary())
-            if msg.get("i") is not None:
-                client.conn.reply(msg, {"ok": True})
             return
         # ``owner_wid``: a leased worker registering a task result on
         # behalf of the task's owner (the submitting driver/worker) —
         # ownership and the initial reference belong to that owner.
         owner = client
-        owner_wid = msg.get("owner_wid")
+        owner_wid = o.get("owner_wid")
         if owner_wid is not None:
             owner = self._client_by_wid.get(bytes(owner_wid), client)
         if entry.owner is None:
@@ -1093,14 +1101,27 @@ class GcsServer:
             entry.owner = owner
             self._owned_objects.setdefault(self._owner_key(owner),
                                            set()).add(oid)
-        if client.node_id is not None and msg.get("shm"):
+        if client.node_id is not None and o.get("shm"):
             entry.holders.add(client.node_id.binary())
-        self._mark_ready(entry, msg["nbytes"], msg.get("data"),
-                         msg.get("shm", False))
-        if msg.get("data") is not None:
+        self._mark_ready(entry, o["nbytes"], o.get("data"),
+                         o.get("shm", False))
+        if o.get("data") is not None:
             # Inline payloads are durable (small by definition); shm objects
             # need no WAL — the arena survives a GCS crash and is rescanned.
-            self._log_append("obj", [msg["oid"], msg["data"]])
+            self._log_append("obj", [o["oid"], o["data"]])
+
+    async def _h_obj_put(self, client, msg):
+        self._obj_put_one(client, msg)
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True})
+
+    async def _h_obj_puts(self, client, msg):
+        """Coalesced object registrations: one frame for a whole result
+        set (multi-return tasks / actor calls) — part of the object-plane
+        traffic coalescing that keeps the GCS off the per-call data
+        path."""
+        for o in msg["objs"]:
+            self._obj_put_one(client, o)
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
 
@@ -2556,6 +2577,17 @@ class GcsServer:
         record = self.pgs.pop(pg_id, None)
         if record is not None:
             self._log_append("pgd", pg_id.binary())
+        if record is not None and record.state == "pending":
+            # Stop the placement retry timer: a removed-while-pending
+            # group must never commit (the retry loop held the popped
+            # record and would have reserved resources into the void once
+            # capacity appeared).
+            record.state = "removed"
+            for conn, req in record.ready_waiters:
+                if not conn.closed:
+                    conn.reply(req, {"ok": True, "ready": False,
+                                     "err": "placement group removed"})
+            record.ready_waiters.clear()
         if record is not None and record.state == "ready":
             for node_id, bundle, avail in zip(
                     record.placement, record.bundles, record.bundle_avail):

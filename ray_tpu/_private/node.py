@@ -897,6 +897,8 @@ class NodeAgent:
 
     async def _on_msg(self, msg: dict):
         t = msg.get("t")
+        if t is None:
+            return  # empty/typeless frame: skip, never fall through
         if t == "spawn_worker":
             self.spawn_worker(msg.get("env_spec"), msg.get("env_key", ""))
         elif t == "health_check":

@@ -13,6 +13,20 @@ Messages are dicts with short keys:
   ``i``  correlation id for request/reply (int, optional)
 plus type-specific fields. Raw binary (pickled data, buffers) rides msgpack
 bin fields zero-copy on the read side via ``memoryview``.
+
+Scatter-gather variant (the out-of-band data plane): setting the top bit
+of the length prefix marks a frame whose payload is
+``uint32 header_len | msgpack header | raw buffer section``. The header is
+a normal message dict carrying ``bl`` (buffer lengths); the raw section is
+the concatenation of the buffers. On the write side the buffers are handed
+to the transport as memoryviews (``writelines`` — no ``to_bytes()``
+flatten, no msgpack-bin copy: the transport's gather write is the single
+write-side copy). On the read side they are sliced back out of one
+immutable payload as memoryviews under ``msg["_bufs"]``, feeding
+``pickle.loads(..., buffers=...)`` / ``jax.device_put`` without a copy.
+This is what lets pickle5 out-of-band numpy/JAX buffers
+(``SerializedObject.buffers``) cross a process boundary without riding
+the shared-memory store (remote._prepare_args direct-lane args).
 """
 
 from __future__ import annotations
@@ -27,7 +41,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 import msgpack
 
 _LEN = struct.Struct("<I")
-MAX_FRAME = 1 << 31
+_SG_FLAG = 0x8000_0000  # top bit of the length prefix: scatter-gather
+MAX_FRAME = 1 << 30
 
 # RPC chaos (reference: src/ray/rpc/rpc_chaos.h:23 — env-var-driven failure
 # injection). ``RAY_TPU_RPC_FAILURE="actor_call=0.2,submit=0.1"`` fails that
@@ -60,7 +75,57 @@ def _maybe_inject_failure(msg: dict):
 
 def pack(msg: dict) -> bytes:
     payload = msgpack.packb(msg, use_bin_type=True)
+    if len(payload) > MAX_FRAME:
+        # Fail at the SENDER: bit 31 of the prefix is the scatter-gather
+        # flag, so an unchecked jumbo frame would be misread by the peer
+        # (flag bit set) and desynchronize the stream instead of erroring
+        # cleanly. Payloads this size belong on the chunked object plane.
+        raise ValueError(f"frame too large: {len(payload)}")
     return _LEN.pack(len(payload)) + payload
+
+
+def pack_with_buffers(msg: dict, buffers) -> list:
+    """Build a scatter-gather frame as a write list.
+
+    Returns ``[prefix+header, buf0, buf1, ...]`` where the buffers are the
+    CALLER'S memoryviews, untouched — this function never copies payload
+    bytes (asserted by the buffer-identity test); the transport's gather
+    write is the only write-side copy. ``bl`` (buffer lengths) is injected
+    into the packed header so the read side can slice the raw section
+    without any per-buffer framing.
+    """
+    lens = [len(b) for b in buffers]
+    msg["bl"] = lens
+    try:
+        header = msgpack.packb(msg, use_bin_type=True)
+    finally:
+        del msg["bl"]
+    total = 4 + len(header) + sum(lens)
+    if total > MAX_FRAME:
+        raise ValueError(f"frame too large: {total}")
+    head = _LEN.pack(total | _SG_FLAG) + _LEN.pack(len(header)) + header
+    return [head, *buffers]
+
+
+def decode_sg_payload(payload) -> dict:
+    """Decode a scatter-gather payload (everything after the length
+    prefix). ``payload`` must be immutable or never-resized: the returned
+    ``msg["_bufs"]`` memoryviews alias it zero-copy."""
+    view = memoryview(payload)
+    (header_len,) = _LEN.unpack(view[:4])
+    if 4 + header_len > len(view):
+        raise ValueError("scatter-gather header overruns frame")
+    msg = msgpack.unpackb(view[4:4 + header_len], raw=False)
+    lens = msg.pop("bl", None) or []
+    bufs = []
+    pos = 4 + header_len
+    for ln in lens:
+        if pos + ln > len(view):
+            raise ValueError("scatter-gather buffer overruns frame")
+        bufs.append(view[pos:pos + ln])
+        pos += ln
+    msg["_bufs"] = bufs
+    return msg
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
@@ -70,6 +135,8 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     (length,) = _LEN.unpack(header)
+    sg = bool(length & _SG_FLAG)
+    length &= ~_SG_FLAG
     if length > MAX_FRAME:
         raise ValueError(f"frame too large: {length}")
     try:
@@ -77,7 +144,11 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     try:
-        return msgpack.unpackb(payload, raw=False)
+        msg = (decode_sg_payload(payload) if sg
+               else msgpack.unpackb(payload, raw=False))
+        if not isinstance(msg, dict):
+            raise TypeError(f"non-dict frame: {type(msg).__name__}")
+        return msg
     except Exception:
         # A malformed frame (e.g. int map keys, corrupt payload) must not
         # kill the read loop — the length prefix keeps the stream
@@ -123,6 +194,15 @@ class Connection:
         # syscalls, the dominant cost of the control plane.
         self._wbuf: list = []
         self._flush_scheduled = False
+        # Backpressure (data-plane bursts): once the transport's write
+        # buffer passes the high-water mark, queued parts stay HERE (a
+        # plain list) and a drain waiter resumes flushing when the kernel
+        # catches up. Without this, a payload burst (thousands of 100KB
+        # direct-lane frames submitted in one tick) balloons the transport
+        # buffer, whose per-send ``del buffer[:n]`` compaction is
+        # O(backlog) — quadratic in the burst (measured: the whole arg
+        # data plane collapsed to ~1.4k frames/s before this).
+        self._drain_waiting = False
         self._affinity_check = None  # set in start() when checks enabled
 
     def start(self):
@@ -139,13 +219,29 @@ class Connection:
             if checks_enabled() else None)
         self._read_task = loop.create_task(self._read_loop())
 
+    # Transport-buffer congestion threshold and the per-tick byte budget
+    # handed to the transport while draining a backlog. Both bound the
+    # transport's own buffer (its send-compaction is O(len)); the burst
+    # itself waits in ``_wbuf`` as cheap list entries / memoryviews.
+    _SEND_HIGH_WATER = 1 << 20
+    _SEND_BATCH = 1 << 20
+
+    def _congested(self) -> bool:
+        try:
+            return (self.writer.transport.get_write_buffer_size()
+                    > self._SEND_HIGH_WATER)
+        except Exception:
+            return False
+
     def _write_frame(self, data: bytes):
         if self._affinity_check is not None:
             self._affinity_check()
-        if self._flush_scheduled:
-            # A frame already went out this loop tick: buffer the rest of
-            # the burst for one combined write at the end of the tick.
+        if self._flush_scheduled or self._congested():
+            # A frame already went out this loop tick (coalesce the burst
+            # into one combined write at tick end), or the transport is
+            # backed up (park the frame here until drain).
             self._wbuf.append(data)
+            self._schedule_flush()
             return
         self._flush_scheduled = True
         asyncio.get_running_loop().call_soon(self._flush_wbuf)
@@ -154,17 +250,102 @@ class Connection:
         except (ConnectionResetError, BrokenPipeError, OSError):
             self._mark_closed()
 
+    def _write_parts(self, parts: list):
+        """Write a scatter-gather frame: the parts (header bytes + caller
+        buffer memoryviews) go straight to the transport — a large buffer
+        view is handed over as-is, so an uncongested transport sends it
+        from the caller's memory with NO user-space copy (the transport's
+        buffering is the single write-side copy otherwise)."""
+        if self._affinity_check is not None:
+            self._affinity_check()
+        if self._flush_scheduled or self._congested():
+            self._wbuf.extend(parts)
+            self._schedule_flush()
+            return
+        self._flush_scheduled = True
+        asyncio.get_running_loop().call_soon(self._flush_wbuf)
+        self._transport_write_batch(parts)
+
+    # Parts at least this large are written to the transport individually
+    # (zero-join); smaller ones batch through one gather write so a burst
+    # of control frames still costs one syscall.
+    _BIG_PART = 32 * 1024
+
+    def _transport_write_batch(self, batch: list):
+        w = self.writer
+        small: list = []
+        try:
+            for p in batch:
+                if len(p) >= self._BIG_PART:
+                    if small:
+                        if len(small) == 1:
+                            w.write(small[0])
+                        else:
+                            w.writelines(small)
+                        small = []
+                    w.write(p)
+                else:
+                    small.append(p)
+            if small:
+                if len(small) == 1:
+                    w.write(small[0])
+                else:
+                    w.writelines(small)
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            self._mark_closed()
+
+    def _schedule_flush(self):
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_wbuf)
+
     def _flush_wbuf(self):
         self._flush_scheduled = False
         if self._closed or not self._wbuf:
             self._wbuf.clear()
             return
-        data = self._wbuf[0] if len(self._wbuf) == 1 else b"".join(self._wbuf)
-        self._wbuf.clear()
+        if self._congested():
+            # Keep the backlog in _wbuf; resume when the kernel drains.
+            # The drain waiter owns the next flush — leaving the scheduled
+            # flag set lets concurrent senders append without spinning a
+            # no-op call_soon per frame.
+            self._flush_scheduled = True
+            if not self._drain_waiting:
+                self._drain_waiting = True
+                asyncio.get_running_loop().create_task(
+                    self._drain_then_flush())
+            return
+        parts = self._wbuf
+        if len(parts) == 1:
+            self._wbuf = []
+            batch = parts
+        else:
+            # Bounded batch per tick: the transport buffer stays near the
+            # high-water mark instead of swallowing the entire burst.
+            budget = self._SEND_BATCH
+            i = 0
+            n = len(parts)
+            while i < n and budget > 0:
+                budget -= len(parts[i])
+                i += 1
+            batch = parts[:i]
+            self._wbuf = parts[i:]
+        self._transport_write_batch(batch)
+        if self._closed:
+            return
+        if self._wbuf:
+            self._schedule_flush()
+
+    async def _drain_then_flush(self):
         try:
-            self.writer.write(data)
-        except (ConnectionResetError, BrokenPipeError, OSError):
+            await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError,
+                ConnectionError):
+            self._drain_waiting = False
             self._mark_closed()
+            return
+        self._drain_waiting = False
+        self._flush_wbuf()
 
     async def _read_loop(self):
         # Batched decode: drain whatever the kernel has buffered in ONE
@@ -173,44 +354,107 @@ class Connection:
         # two readexactly() coroutine hops per frame that dominated the
         # async call path's CPU (reference analog: gRPC's batched
         # completion-queue drain).
-        buf = bytearray()
-        pos = 0
+        #
+        # Fast path: with no carryover from the previous wakeup, frames
+        # are parsed STRAIGHT out of the ``read()`` chunk — an immutable
+        # bytes — so scatter-gather buffer views alias it with zero
+        # additional copies and ordinary frames skip the stream-buffer
+        # append. Only a partial tail (or a frame spanning reads) goes
+        # through the mutable carry buffer.
+        carry = bytearray()
         try:
             while True:
-                chunk = await self.reader.read(1 << 18)
+                chunk = await self.reader.read(1 << 20)
                 if not chunk:
                     break
-                buf += chunk
-                n = len(buf)
-                while n - pos >= 4:
-                    length = int.from_bytes(buf[pos:pos + 4], "little")
-                    if length > MAX_FRAME:
-                        raise ValueError(f"frame too large: {length}")
-                    end = pos + 4 + length
-                    if end > n:
-                        break  # incomplete frame: wait for more bytes
-                    try:
-                        msg = msgpack.unpackb(
-                            memoryview(buf)[pos + 4:end], raw=False)
-                    except Exception:
-                        # A malformed frame must not kill the read loop —
-                        # the length prefix keeps the stream consistent.
-                        import logging
+                if carry:
+                    carry += chunk
+                    src: Any = carry
+                    mutable = True
+                else:
+                    src = chunk
+                    mutable = False
+                n = len(src)
+                pos = 0
+                mv = memoryview(src)
+                try:
+                    while n - pos >= 4:
+                        length = int.from_bytes(mv[pos:pos + 4], "little")
+                        sg = length & _SG_FLAG
+                        if sg:
+                            length &= ~_SG_FLAG
+                        if length > MAX_FRAME:
+                            raise ValueError(f"frame too large: {length}")
+                        end = pos + 4 + length
+                        if end > n:
+                            break  # incomplete frame: wait for more bytes
+                        try:
+                            if sg and not mutable and 4 * length >= n:
+                                # Zero-copy: _bufs alias the immutable
+                                # chunk directly. Gated on the frame being
+                                # a decent fraction of the chunk: a
+                                # handler retaining the value pins the
+                                # WHOLE chunk through the views, so small
+                                # frames sharing a big chunk would retain
+                                # up to chunk/frame times their size —
+                                # this bounds that amplification at 4x
+                                # (smaller frames take the copy below,
+                                # which is what the shm path pays anyway).
+                                msg = decode_sg_payload(mv[pos + 4:end])
+                            elif sg and not mutable:
+                                msg = decode_sg_payload(
+                                    bytes(mv[pos + 4:end]))
+                            elif sg:
+                                # Carve the payload out as one IMMUTABLE
+                                # bytes: the msg's ``_bufs`` memoryviews
+                                # alias it for as long as the handler (and
+                                # any value unpickled zero-copy from them)
+                                # needs — the mutable carry buffer gets
+                                # compacted below.
+                                msg = decode_sg_payload(
+                                    bytes(mv[pos + 4:end]))
+                            else:
+                                msg = msgpack.unpackb(mv[pos + 4:end],
+                                                      raw=False)
+                            if not isinstance(msg, dict):
+                                # Valid msgpack, wrong shape (e.g. a bare
+                                # int): same skip as undecodable.
+                                raise TypeError(
+                                    f"non-dict frame: {type(msg).__name__}")
+                        except Exception:
+                            # A malformed frame must not kill the read
+                            # loop — the length prefix keeps the stream
+                            # consistent.
+                            import logging
 
-                        logging.getLogger(__name__).exception(
-                            "dropping undecodable %d-byte frame", length)
-                        msg = {}
-                    pos = end
-                    await self._dispatch_frame(msg)
-                if pos:
-                    del buf[:pos]
-                    pos = 0
+                            logging.getLogger(__name__).exception(
+                                "dropping undecodable %d-byte frame",
+                                length)
+                            msg = {}
+                        pos = end
+                        await self._dispatch_frame(msg)
+                finally:
+                    # The view must die before the bytearray resize below
+                    # (exported views block it with a BufferError).
+                    mv.release()
+                if mutable:
+                    if pos:
+                        del carry[:pos]
+                else:
+                    if pos < n:
+                        carry += memoryview(chunk)[pos:]  # partial tail
         except (ConnectionResetError, BrokenPipeError, OSError):
             pass
         finally:
             self._mark_closed()
 
     async def _dispatch_frame(self, msg: dict):
+        if not msg:
+            # Undecodable frame placeholder ({} from the decode guard
+            # above): already logged there — never hand it to correlation
+            # or handler dispatch, where a missing "t"/"i" would be
+            # misread as a typeless push.
+            return
         rid = msg.get("i")
         # "r" marks a reply: requests and replies share the "i" field but
         # the two sides allocate ids independently, so a peer-initiated
@@ -256,19 +500,25 @@ class Connection:
             pass
         return n
 
-    def send(self, msg: dict):
-        """Fire-and-forget send."""
+    def send(self, msg: dict, buffers=None):
+        """Fire-and-forget send. ``buffers``: out-of-band memoryviews
+        shipped in a scatter-gather frame (zero-copy write side)."""
         if self._closed:
             raise ConnectionError("connection closed")
         _maybe_inject_failure(msg)
-        self._write_frame(pack(msg))
+        if buffers:
+            self._write_parts(pack_with_buffers(msg, buffers))
+        else:
+            self._write_frame(pack(msg))
 
-    def request_nowait(self, msg: dict) -> asyncio.Future:
+    def request_nowait(self, msg: dict, buffers=None) -> asyncio.Future:
         """Synchronously send a request; returns the reply future.
 
         The synchronous send preserves caller ordering (the analog of the
         reference's sequenced actor submit queue,
-        ``transport/actor_task_submitter.h:75``).
+        ``transport/actor_task_submitter.h:75``). ``buffers``: out-of-band
+        payload memoryviews (scatter-gather frame — the direct-lane arg
+        path).
         """
         if self._closed:
             raise ConnectionError("connection closed")
@@ -277,7 +527,10 @@ class Connection:
         msg["i"] = rid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        self._write_frame(pack(msg))
+        if buffers:
+            self._write_parts(pack_with_buffers(msg, buffers))
+        else:
+            self._write_frame(pack(msg))
         return fut
 
     async def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
@@ -315,7 +568,13 @@ class Connection:
 
     async def close(self):
         if self._wbuf and not self._closed:
-            self._flush_wbuf()
+            # Final flush hands EVERYTHING to the transport, bypassing the
+            # bounded batching / congestion parking (steady-state
+            # machinery): transport.close() drains its own buffer before
+            # closing the socket, so nothing queued here is dropped.
+            parts, self._wbuf = self._wbuf, []
+            self._flush_scheduled = True  # suppress a pending tick flush
+            self._transport_write_batch(parts)
         if self._read_task is not None:
             self._read_task.cancel()
         self._mark_closed()
@@ -349,12 +608,19 @@ async def reconnect_with_retry(attempt, *, should_stop=None,
     return False
 
 
+# StreamReader buffer limit. The asyncio default (64KB) forces ~2 read
+# wakeups per 100KB data-plane frame (flow control pauses the transport at
+# 2x the limit); 1MB lets a whole direct-lane frame arrive in one recv.
+_READ_LIMIT = 1 << 20
+
+
 async def connect(address: str) -> tuple:
     """Open a stream to ``address`` — 'unix:<path>' or 'host:port'."""
     if address.startswith("unix:"):
-        return await asyncio.open_unix_connection(address[5:])
+        return await asyncio.open_unix_connection(address[5:],
+                                                  limit=_READ_LIMIT)
     host, _, port = address.rpartition(":")
-    return await asyncio.open_connection(host, int(port))
+    return await asyncio.open_connection(host, int(port), limit=_READ_LIMIT)
 
 
 async def serve(
@@ -368,6 +634,8 @@ async def serve(
             os.unlink(path)
         except OSError:
             pass
-        return await asyncio.start_unix_server(client_connected_cb, path)
+        return await asyncio.start_unix_server(client_connected_cb, path,
+                                               limit=_READ_LIMIT)
     host, _, port = address.rpartition(":")
-    return await asyncio.start_server(client_connected_cb, host, int(port))
+    return await asyncio.start_server(client_connected_cb, host, int(port),
+                                      limit=_READ_LIMIT)

@@ -118,13 +118,24 @@ def _prepared_runtime_env(opts: dict):
 
 
 def _prepare_args(args: tuple, kwargs: dict,
-                  collect_deps: bool = False) -> dict:
+                  collect_deps: bool = False,
+                  direct_ok: bool = False) -> dict:
     """Serialize call arguments; large blobs go to shared memory.
 
     Mirrors the reference's inline-vs-plasma arg split
     (``DependencyResolver`` inlining, ``transport/dependency_resolver.h``):
     small args travel in the control message, large ones are put into the
     object store and fetched zero-copy by the executing worker.
+
+    ``direct_ok`` marks call sites with an already-open peer connection
+    (direct actor calls): mid-size args — above the inline limit, at most
+    ``direct_arg_threshold`` — skip the shm create/seal + GCS register
+    round trip and ride that connection as out-of-band scatter-gather
+    buffers instead (``protocol.pack_with_buffers``). The returned dict
+    then carries ``"ap"`` (pickle bytes, in the frame header) plus the
+    non-serializable ``"_sg"`` SerializedObject whose raw buffers the
+    dispatcher hands to the transport; huge args and anything a borrower
+    might need later keep the shm+GCS object-plane path.
 
     ``collect_deps`` additionally reports top-level ObjectRef arguments so
     the submitter can defer dispatch until they resolve — pushing a task
@@ -134,7 +145,7 @@ def _prepare_args(args: tuple, kwargs: dict,
     ``transport/dependency_resolver.h``).
     """
     if not args and not kwargs:
-        # No-arg calls are the hottest microbench shape; skip the pickle
+        # No-arg calls are the hottest control-plane shape; skip the pickle
         # (single definition site shared with the worker-side match).
         return {"args": serialization.empty_args_bytes()}
     w = global_worker()
@@ -148,9 +159,21 @@ def _prepare_args(args: tuple, kwargs: dict,
         if deps:
             out["deps"] = deps
     sobj = serialize((args, kwargs))
-    if sobj.total_size <= serialization.INLINE_THRESHOLD:
+    # Route on data_size (pickle + raw buffers): the direct lane never
+    # builds the shm segment layout, so total_size (which computes it)
+    # must not be touched before routing.
+    nbytes = sobj.data_size
+    if nbytes <= serialization.INLINE_THRESHOLD:
+        serialization.TRANSPORT_STATS["inline_args"] += 1
         out["args"] = sobj.to_bytes()
         return out
+    if direct_ok and nbytes <= serialization.DIRECT_ARG_THRESHOLD:
+        serialization.TRANSPORT_STATS["direct_lane_args"] += 1
+        serialization.TRANSPORT_STATS["direct_lane_bytes"] += nbytes
+        out["ap"] = sobj.pickle_bytes
+        out["_sg"] = sobj
+        return out
+    serialization.TRANSPORT_STATS["shm_args"] += 1
     oid = w.put_serialized(sobj)
     # Hold a reference until the consuming task is done: register then let
     # the GCS-side refcount keep it; the executing worker borrows it. The
@@ -294,7 +317,9 @@ class ActorHandle:
     def _call(self, method: str, args: tuple, kwargs: dict,
               num_returns: int, extra_opts: dict):
         w = global_worker()
-        msg_args = _prepare_args(args, kwargs)
+        # direct_ok: the call rides the actor's own connection, so
+        # mid-size args can go out-of-band on it (the direct arg lane).
+        msg_args = _prepare_args(args, kwargs, direct_ok=True)
         opts = {"retries": self._max_task_retries}
         opts.update(extra_opts)
         if tracing.active():

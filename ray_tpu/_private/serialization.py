@@ -233,9 +233,17 @@ def _align(n: int) -> int:
 
 
 class SerializedObject:
-    """Pickle bytes plus out-of-band buffers, ready to be written."""
+    """Pickle bytes plus out-of-band buffers, ready to be written.
 
-    __slots__ = ("pickle_bytes", "buffers", "_header", "_offsets", "total_size")
+    The shared-memory segment layout (header + aligned buffer offsets) is
+    computed LAZILY: the direct arg lane ships ``pickle_bytes`` and the
+    raw ``buffers`` straight onto a connection (scatter-gather frame) and
+    never needs offsets, so the two msgpack header packs + offset
+    fix-point would be pure waste on that path — ``data_size`` routes the
+    threshold decision without them.
+    """
+
+    __slots__ = ("pickle_bytes", "buffers", "_header", "_offsets", "_total")
 
     def __init__(self, pickle_bytes: bytes, buffers: List[pickle.PickleBuffer]):
         self.pickle_bytes = pickle_bytes
@@ -248,13 +256,33 @@ class SerializedObject:
             self._header = msgpack.packb(
                 {"p": pickle_bytes, "o": [], "l": []}, use_bin_type=True)
             self._offsets = []
-            self.total_size = 4 + len(self._header)
+            self._total = 4 + len(self._header)
             return
         self.buffers = [b.raw() for b in buffers]
+        self._header = None
+        self._offsets = None
+        self._total = None
+
+    @property
+    def data_size(self) -> int:
+        """Payload bytes (pickle + buffers), without segment-layout
+        padding — the cheap routing size for threshold decisions."""
+        n = len(self.pickle_bytes)
+        for b in self.buffers:
+            n += len(b)
+        return n
+
+    @property
+    def total_size(self) -> int:
+        if self._total is None:
+            self._layout()
+        return self._total
+
+    def _layout(self):
         offsets: List[int] = []
         lens = [len(b) for b in self.buffers]
         header = msgpack.packb(
-            {"p": pickle_bytes, "o": [], "l": lens}, use_bin_type=True
+            {"p": self.pickle_bytes, "o": [], "l": lens}, use_bin_type=True
         )
         # Offsets depend on header length; header length depends on offsets'
         # encoded size. Fix-point in two passes (offset ints encode stably the
@@ -264,15 +292,18 @@ class SerializedObject:
             offsets.append(pos)
             pos = _align(pos + ln)
         header = msgpack.packb(
-            {"p": pickle_bytes, "o": offsets, "l": lens}, use_bin_type=True
+            {"p": self.pickle_bytes, "o": offsets, "l": lens},
+            use_bin_type=True
         )
         if 4 + len(header) > offsets[0] if offsets else False:
             raise RuntimeError("serialization header overflow")
         self._header = header
         self._offsets = offsets
-        self.total_size = pos
+        self._total = pos
 
     def write_into(self, buf: memoryview):
+        if self._header is None:
+            self._layout()
         buf[:4] = _U32.pack(len(self._header))
         buf[4 : 4 + len(self._header)] = self._header
         for off, b in zip(self._offsets, self.buffers):
@@ -350,6 +381,15 @@ class _PinnedBuffer:
         return memoryview(self.mv)
 
 
+import sys as _sys
+
+# _PinnedBuffer relies on the pure-Python buffer protocol (PEP 688,
+# ``__buffer__``), which exists only on 3.12+. Earlier runtimes get a
+# copy-out fallback: correctness over zero-copy (numpy's frombuffer would
+# otherwise reject the wrapper with "a bytes-like object is required").
+_HAS_PY_BUFFER_PROTOCOL = _sys.version_info >= (3, 12)
+
+
 def deserialize(data: memoryview, pin=None) -> Any:
     data = memoryview(data)
     (header_len,) = _U32.unpack(data[:4])
@@ -361,7 +401,15 @@ def deserialize(data: memoryview, pin=None) -> Any:
         if pin is not None:
             pin()
         return msgpack.unpackb(header["x"], raw=False)
-    if pin is not None and header["o"]:
+    if pin is not None and header["o"] and not _HAS_PY_BUFFER_PROTOCOL:
+        # Pre-3.12: copy the out-of-band buffers out of the arena and
+        # release the reader pin immediately.
+        try:
+            buffers = [bytes(data[off : off + ln])
+                       for off, ln in zip(header["o"], header["l"])]
+        finally:
+            pin()
+    elif pin is not None and header["o"]:
         holder = _Pin(pin)
         buffers = [
             _PinnedBuffer(data[off : off + ln], holder)
@@ -382,16 +430,42 @@ from .config import config as _cfg, on_config_change as _on_cfg_change
 # RAY_TPU_INLINE_THRESHOLD). Read via ``serialization.INLINE_THRESHOLD``
 # (module attribute), not by-value import — the refresh hook below
 # re-snapshots it when ``init(_system_config=...)`` overrides flags after
-# this module was imported.
+# this module was imported. DIRECT_ARG_THRESHOLD caps the actor-call
+# direct arg lane (out-of-band scatter-gather frames on the actor
+# connection, protocol.pack_with_buffers).
 INLINE_THRESHOLD = _cfg().inline_threshold
+DIRECT_ARG_THRESHOLD = _cfg().direct_arg_threshold
 
 
 def _refresh_flags():
-    global INLINE_THRESHOLD
+    global INLINE_THRESHOLD, DIRECT_ARG_THRESHOLD
     INLINE_THRESHOLD = _cfg().inline_threshold
+    DIRECT_ARG_THRESHOLD = _cfg().direct_arg_threshold
 
 
 _on_cfg_change(_refresh_flags)
+
+
+# Transport counters for the argument data plane (read via
+# ``transport_stats()``; asserted by the tier-1 data-plane smoke test and
+# printed by benchmarks/microbench.py). Driver-side, per-process; plain
+# ints under the GIL — the hot path pays one dict-incref each.
+TRANSPORT_STATS = {
+    "inline_args": 0,       # args rode the control frame (msgpack bin)
+    "direct_lane_args": 0,  # args rode the actor conn out-of-band
+    "direct_lane_bytes": 0,
+    "shm_args": 0,          # args went through shm create + GCS register
+}
+
+
+def transport_stats() -> dict:
+    """Snapshot of this process's argument-transport counters."""
+    return dict(TRANSPORT_STATS)
+
+
+def reset_transport_stats() -> None:
+    for k in TRANSPORT_STATS:
+        TRANSPORT_STATS[k] = 0
 
 
 class DynamicReturns:

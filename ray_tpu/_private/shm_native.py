@@ -42,8 +42,10 @@ def _build_lib() -> str:
     if not os.path.exists(out):
         tmp = out + f".tmp{os.getpid()}"
         subprocess.run(
+            # -lrt: shm_open/shm_unlink live in librt before glibc 2.34
+            # (a no-op link on newer hosts where they merged into libc).
             ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp,
-             "-lpthread"],
+             "-lpthread", "-lrt"],
             check=True, capture_output=True)
         os.replace(tmp, out)
     return out
@@ -53,7 +55,26 @@ def get_lib():
     global _lib
     with _lib_lock:
         if _lib is None:
-            lib = ctypes.CDLL(_build_lib())
+            try:
+                lib = ctypes.CDLL(_build_lib())
+            except OSError:
+                # The content-hash cache can hold a .so built on an
+                # INCOMPATIBLE host (e.g. a newer glibc than this
+                # container) — its presence blocks the rebuild, and
+                # every process then silently falls back to the Python
+                # shared_memory store, which cannot rescan the arena
+                # after a GCS restart. Rebuild from source into a
+                # host-local cache; exporting the env var points spawned
+                # workers/agents at the same rebuilt lib.
+                import tempfile
+
+                # uid-scoped: a shared world-writable dir could be
+                # pre-created/poisoned by another user (CDLL would load
+                # their .so) or be unwritable for us.
+                cache = os.path.join(tempfile.gettempdir(),
+                                     f"ray_tpu_native_cache_{os.getuid()}")
+                os.environ["RAY_TPU_NATIVE_CACHE"] = cache
+                lib = ctypes.CDLL(_build_lib())
             lib.rtpu_store_open.restype = ctypes.c_void_p
             lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                             ctypes.c_int]

@@ -75,7 +75,22 @@ class ObjectRef:
         return self.id.task_id()
 
     def future(self) -> SyncFuture:
-        return self._worker.object_future(self.id)
+        """Public bridge to a real ``concurrent.futures.Future`` (usable
+        with ``asyncio.wrap_future`` / ``concurrent.futures.wait``); the
+        internal resolution path runs on SlimFuture."""
+        fut = self._worker.object_future(self.id)
+        out = SyncFuture()
+
+        def _copy(f, out=out):
+            if out.set_running_or_notify_cancel():
+                exc = f.exception()
+                if exc is not None:
+                    out.set_exception(exc)
+                else:
+                    out.set_result(f._value)
+
+        fut.add_done_callback(_copy)
+        return out
 
     def __reduce__(self):
         # A serialized ref must be resolvable by the receiver: values held
@@ -142,6 +157,120 @@ class ObjectRefGenerator:
     def __getitem__(self, i):
         return self._refs[i]
 
+
+
+_SLIM_EVENT_LOCK = threading.Lock()
+
+
+class SlimFuture:
+    """Single-waiter future for the object-resolution path.
+
+    ``concurrent.futures.Future`` allocates a ``Condition`` (lock + waiter
+    list) per instance — measurable at benchmark rates, since EVERY task
+    return and actor call allocates one (PROFILE_nn_r05). The driver's
+    dominant access pattern is one producer (IO loop) and at most one
+    blocked consumer (``get``), so this slim variant defers its
+    ``threading.Event`` until someone actually blocks; the sequential-get
+    fast path (result already set when ``get`` arrives) never allocates
+    any synchronization object at all.
+
+    Thread-safety leans on the GIL plus write ordering: the producer
+    stores value/exception BEFORE flipping ``_done``; consumers re-check
+    ``_done`` after publishing their event/callback, so a completion
+    racing either registration is never lost (both sides drain callbacks
+    via an atomic list swap, so each callback runs exactly once).
+    """
+
+    __slots__ = ("_done", "_value", "_exc", "_event", "_cbs")
+
+    def __init__(self):
+        self._done = False
+        self._value = None
+        self._exc = None
+        self._event = None
+        self._cbs = None
+
+    def done(self) -> bool:
+        return self._done
+
+    def set_result(self, value):
+        self._value = value
+        self._finish()
+
+    def set_exception(self, exc: BaseException):
+        self._exc = exc
+        self._finish()
+
+    def _finish(self):
+        self._done = True
+        ev = self._event
+        if ev is not None:
+            ev.set()
+        self._drain_cbs()
+
+    def _drain_cbs(self):
+        with _SLIM_EVENT_LOCK:
+            cbs, self._cbs = self._cbs, None
+        if cbs:
+            for cb in cbs:
+                try:
+                    cb(self)
+                except Exception:
+                    pass
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done:
+            with _SLIM_EVENT_LOCK:
+                # Cold path only (a consumer actually blocking): the
+                # shared lock serializes concurrent waiters creating the
+                # event, so none can strand on an overwritten one.
+                ev = self._event
+                if ev is None:
+                    ev = self._event = threading.Event()
+            if self._done:  # completed while publishing the event
+                ev.set()
+            if not ev.wait(timeout):
+                raise TimeoutError()
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._done:
+            try:
+                self.result(timeout)
+            except Exception:
+                pass  # a stored exception is RETURNED, never raised here
+            # KeyboardInterrupt/SystemExit propagate (interruptibility,
+            # matching concurrent.futures.Future.exception()).
+            if not self._done:
+                raise TimeoutError()
+        return self._exc
+
+    def add_done_callback(self, fn):
+        if self._done:
+            fn(self)
+            return
+        # The shared lock makes registration atomic against the
+        # producer's _drain_cbs swap — without it an append can land in
+        # an already-detached (drained) list and the callback is lost.
+        with _SLIM_EVENT_LOCK:
+            if not self._done:
+                if self._cbs is None:
+                    self._cbs = []
+                self._cbs.append(fn)
+                return
+        fn(self)  # completed while acquiring: run inline, like done()
+
+    def remove_done_callback(self, fn):
+        """Best-effort deregistration (wait() detaches its wakers so a
+        polling loop doesn't accumulate dead callbacks per call)."""
+        with _SLIM_EVENT_LOCK:
+            if self._cbs is not None:
+                try:
+                    self._cbs.remove(fn)
+                except ValueError:
+                    pass
 
 
 class _Lease:
@@ -265,8 +394,8 @@ class Worker:
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self._put_counter = _Counter()
-        # oid -> SyncFuture resolving to ("inline", bytes) | ("shm", nbytes)
-        self._object_futures: Dict[ObjectID, SyncFuture] = {}
+        # oid -> SlimFuture resolving to ("inline", bytes) | ("shm", nbytes)
+        self._object_futures: Dict[ObjectID, "SlimFuture"] = {}
         self._memory_store: Dict[ObjectID, bytes] = {}
         self._ref_deltas: Dict[ObjectID, int] = {}
         # Count-only corrections (failed-serialize incref undos queued
@@ -500,6 +629,9 @@ class Worker:
             self._store_obj.close()
 
     async def _disconnect_async(self):
+        # Push out anything still parked in the outbound queue (e.g. a
+        # fire-and-forget pg_remove issued just before shutdown).
+        self._drain_out()
         self._flush_refs()
         if self.gcs is not None:
             await self.gcs.close()
@@ -617,10 +749,10 @@ class Worker:
 
     # -------------------------------------------------------------- objects
 
-    def object_future(self, object_id: ObjectID) -> SyncFuture:
+    def object_future(self, object_id: ObjectID) -> "SlimFuture":
         fut = self._object_futures.get(object_id)
         if fut is None:
-            fut = SyncFuture()
+            fut = SlimFuture()
             self._object_futures[object_id] = fut
             if object_id in self._memory_store:
                 fut.set_result(("inline", self._memory_store[object_id]))
@@ -928,25 +1060,39 @@ class Worker:
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        from concurrent.futures import FIRST_COMPLETED
-        from concurrent.futures import wait as cf_wait
-
         deadline = None if timeout is None else time.monotonic() + timeout
         futs = [self.object_future(r.id) for r in refs]
-        while True:
-            not_done = [f for f in futs if not f.done()]
-            if len(futs) - len(not_done) >= num_returns or not not_done:
-                break
-            remaining = None
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
+        # One shared Event woken by ANY completion (SlimFutures don't
+        # support concurrent.futures.wait; a per-call Event matches its
+        # single-waiter design). Still a real blocking wait — no busy-poll
+        # (the reference blocks in plasma Wait the same way).
+        ev = threading.Event()
+
+        def _wake(_f):
+            ev.set()
+
+        for f in futs:
+            f.add_done_callback(_wake)
+        try:
+            while True:
+                # Clear BEFORE counting: a completion landing after the
+                # count re-sets the event, so the wait below returns
+                # promptly instead of losing that wakeup.
+                ev.clear()
+                n_done = sum(f.done() for f in futs)
+                if n_done >= num_returns or n_done == len(futs):
                     break
-            # Real blocking wait (condition variable under the hood) — no
-            # 1ms busy-poll (the reference blocks in plasma Wait the same
-            # way).
-            cf_wait(not_done, timeout=remaining,
-                    return_when=FIRST_COMPLETED)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                ev.wait(remaining)
+        finally:
+            # Detach our waker: a polling loop (wait in a while-loop)
+            # must not grow every pending future's callback list.
+            for f in futs:
+                f.remove_done_callback(_wake)
         done_idx = [i for i, f in enumerate(futs) if f.done()][:num_returns]
         done_set = set(done_idx)
         ready = [refs[i] for i in done_idx]
@@ -1021,13 +1167,15 @@ class Worker:
                 payload = ("shm", r["nbytes"])
             fut = self._object_futures.get(oid)
             if fut is None:
-                fut = SyncFuture()
+                fut = SlimFuture()
                 self._object_futures[oid] = fut
             if not fut.done():
                 fut.set_result(payload)
 
     async def _on_gcs_push(self, msg: dict):
         t = msg.get("t")
+        if t is None:
+            return  # empty/typeless frame: skip, never fall through
         if t == "task_done":
             self.push_result(msg["tid"], msg["results"])
         elif t == "lease_grant":
@@ -1094,7 +1242,7 @@ class Worker:
             num_returns = 1
         for i in range(num_returns):
             oid = ObjectID.for_task_return(tid, i + 1)
-            fut = SyncFuture()
+            fut = SlimFuture()
             self._object_futures[oid] = fut
             oids.append(oid)
             refs.append(ObjectRef(oid, self))
@@ -1460,7 +1608,7 @@ class Worker:
         # _on_exec_reply/_finish_item_error decrements it).
         for oid in item.oids:
             self._object_futures.pop(oid, None)
-            fut = SyncFuture()
+            fut = SlimFuture()
             self._object_futures[oid] = fut
         item.retries -= 1 if item.retries > 0 else 0
         with self._out_lock:
@@ -1528,10 +1676,15 @@ class Worker:
         oids = []
         for i in range(num_returns):
             oid = ObjectID.for_task_return(tid, i + 1)
-            fut = SyncFuture()
+            fut = SlimFuture()
             self._object_futures[oid] = fut
             oids.append(oid)
             refs.append(ObjectRef(oid, self))
+        # "_sg" (direct-lane SerializedObject, remote._prepare_args) stays
+        # attached to the call dict: every send site strips it before
+        # packing and hands its raw buffers to the transport out-of-band;
+        # keeping it on the dict preserves the payload across the retry /
+        # reconnect paths, which re-dispatch the same dict.
         call = {"t": "actor_call", "aid": actor_id.binary(),
                 "tid": tid.binary(), "m": method,
                 "nret": num_returns, "opts": opts, **msg_args}
@@ -1595,7 +1748,7 @@ class Worker:
             ch = self._actor_chans[actor_id] = _ActorChannel()
         if ch.conn is not None and not ch.conn.closed and not ch.sendq:
             try:
-                fut = ch.conn.request_nowait(call)
+                fut = self._send_actor_call(ch.conn, call)
             except ConnectionError:
                 self._actor_call_failed(actor_id, call, oids, retries,
                                         ConnectionError("connection closed"))
@@ -1608,6 +1761,25 @@ class Worker:
         if not ch.connecting:
             ch.connecting = True
             self.loop.create_task(self._connect_and_flush(actor_id, ch))
+
+    @staticmethod
+    def _send_actor_call(conn: protocol.Connection,
+                         call: dict) -> asyncio.Future:
+        """Send one actor call, routing direct-lane args out-of-band.
+
+        The "_sg" SerializedObject is stripped for the duration of the
+        pack (it is not wire-serializable) and re-attached afterwards so
+        a retry re-sends the same payload; its pickle5 buffers go to the
+        transport as memoryviews — the zero-copy direct arg lane.
+        """
+        sobj = call.pop("_sg", None)
+        try:
+            if sobj is not None:
+                return conn.request_nowait(call, buffers=sobj.buffers)
+            return conn.request_nowait(call)
+        finally:
+            if sobj is not None:
+                call["_sg"] = sobj
 
     async def _connect_and_flush(self, actor_id: ActorID, ch: _ActorChannel):
         try:
@@ -1642,7 +1814,7 @@ class Worker:
         while ch.sendq:
             call, oids, retries = ch.sendq.popleft()
             try:
-                fut = ch.conn.request_nowait(call)
+                fut = self._send_actor_call(ch.conn, call)
             except ConnectionError as e:
                 self._actor_call_failed(actor_id, call, oids, retries, e)
                 continue
@@ -1696,11 +1868,14 @@ class Worker:
         reply = fut.result()
         results = reply["results"]
         # Register large (shm) actor-call results with the GCS: we are
-        # the owner; this makes the ref resolvable by borrowers.
-        for r in results:
-            if r.get("shm"):
-                self._send_gcs({"t": "obj_put", "oid": r["oid"],
-                                "nbytes": r["nbytes"], "shm": True})
+        # the owner; this makes the ref resolvable by borrowers. One
+        # coalesced frame for the whole result set (obj_puts) — a
+        # num_returns=N call used to cost N object-plane frames.
+        shm_rs = [r for r in results if r.get("shm")]
+        if shm_rs:
+            self._send_gcs({"t": "obj_puts", "objs": [
+                {"oid": r["oid"], "nbytes": r["nbytes"], "shm": True}
+                for r in shm_rs]})
         self.push_result(call["tid"], results)
         self.release_task_args(call)
 
@@ -1761,3 +1936,11 @@ class Worker:
 
     def request_gcs(self, msg: dict, timeout: Optional[float] = 60) -> dict:
         return self.run_async(self.gcs.request(msg), timeout)
+
+    def request_gcs_future(self, msg: dict):
+        """Fire a GCS request from any thread without blocking; returns a
+        ``concurrent.futures.Future`` resolving to the reply dict (the
+        placement-group create path — callers that want a handle now and
+        the reply later, without a helper thread per call)."""
+        return asyncio.run_coroutine_threadsafe(
+            self.gcs.request(msg), self.loop)

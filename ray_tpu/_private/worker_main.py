@@ -136,6 +136,12 @@ class Executor:
 
     async def _on_direct_msg(self, conn: protocol.Connection, msg: dict):
         t = msg.get("t")
+        if t is None:
+            # Empty/typeless frame (undecodable-frame placeholder from
+            # protocol.read_frame, or a malformed peer): skip explicitly —
+            # falling through the handler chain with t=None must never
+            # match, and a reply-correlated fragment must not be executed.
+            return
         if t == "actor_call":
             # Fast path for plain sync methods on a max_concurrency=1
             # actor: calls batch through ONE executor-thread hop per
@@ -351,7 +357,17 @@ class Executor:
         ab = msg.get("args")
         if ab is not None and bytes(ab) == serialization.empty_args_bytes():
             return (), {}
-        if msg.get("argsref") is not None:
+        if msg.get("ap") is not None:
+            # Direct-lane args (remote._prepare_args direct_ok): pickle
+            # bytes in the frame header, pickle5 buffers sliced out of the
+            # scatter-gather frame as memoryviews ("_bufs") — numpy/JAX
+            # values rebuild over them without a copy (the frame payload
+            # is immutable and stays alive through the buffer views).
+            import pickle
+
+            args, kwargs = pickle.loads(bytes(msg["ap"]),
+                                        buffers=msg.get("_bufs") or [])
+        elif msg.get("argsref") is not None:
             oid = ObjectID(msg["argsref"])
             view = self.worker.store.get(oid, msg.get("argsn", 0))
             if view is None:
@@ -449,12 +465,13 @@ class Executor:
 
     def _send_exec_reply(self, conn, msg: dict, reply: dict):
         """Runs on the IO loop: register shm results, reply to the owner."""
-        for r in reply["results"]:
-            if r.get("shm"):
-                self.worker.gcs.send({
-                    "t": "obj_put", "oid": r["oid"],
-                    "nbytes": r["nbytes"], "shm": True,
-                    "owner_wid": msg.get("owner")})
+        shm_rs = [r for r in reply["results"] if r.get("shm")]
+        if shm_rs:
+            # One coalesced registration frame for the whole result set —
+            # the GCS decodes one message instead of N (obj_puts).
+            self.worker.gcs.send({"t": "obj_puts", "objs": [
+                {"oid": r["oid"], "nbytes": r["nbytes"], "shm": True,
+                 "owner_wid": msg.get("owner")} for r in shm_rs]})
         if not conn.closed:
             conn.reply(msg, reply)
         if self.die_after_task:
@@ -936,6 +953,8 @@ async def amain(args):
 
     async def handle_control(msg: dict):
         t = msg.get("t")
+        if t is None:
+            return  # empty/typeless frame: never dispatch (see protocol)
         if t == "exec":
             asyncio.get_running_loop().create_task(executor.run_task(msg))
         elif t == "actor_init":

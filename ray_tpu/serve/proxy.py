@@ -268,6 +268,8 @@ class ProxyActor:
                     msg = await protocol.read_frame(reader)
                     if msg is None:
                         break
+                    if not msg:
+                        continue  # undecodable frame placeholder: skip
                     t = msg.get("t")
                     if t == "serve_call":
                         await handle_call(writer, msg)

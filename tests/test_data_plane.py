@@ -1,0 +1,451 @@
+"""Out-of-band zero-copy argument transport (the data plane).
+
+Covers the scatter-gather frame variant in ``_private/protocol.py``
+(``uint32 total|SG | uint32 header_len | msgpack header | raw buffers``),
+the direct arg lane it feeds (``remote._prepare_args`` ``direct_ok`` →
+``worker._send_actor_call`` → ``worker_main._load_args``), the transport
+tier counters, and the tier fallbacks: inline below ``inline_threshold``,
+direct lane up to ``direct_arg_threshold``, shm + GCS object plane above
+it (including the cross-"node" GCS fetch when stores are isolated).
+"""
+
+import asyncio
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol, serialization
+
+
+# --------------------------------------------------------------------------
+# frame-level tests (no cluster)
+
+
+def _run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def _echo_pair(handler):
+    """A served Connection pair: returns (client_conn, server, sock_path)."""
+    path = f"/tmp/rtpu_dp_{os.getpid()}_{time.monotonic_ns()}.sock"
+    conns = []
+
+    async def on_client(reader, writer):
+        conn = protocol.Connection(reader, writer)
+        conn._handler = lambda m: handler(conn, m)
+        conn.start()
+        conns.append(conn)
+
+    server = await protocol.serve("unix:" + path, on_client)
+    reader, writer = await protocol.connect("unix:" + path)
+    conn = protocol.Connection(reader, writer)
+    conn.start()
+    return conn, server, path
+
+
+def test_sg_frame_round_trip():
+    async def main():
+        got = {}
+
+        async def handler(conn, msg):
+            got["msg"] = msg
+            bufs = msg.get("_bufs") or []
+            conn.reply(msg, {"ok": True,
+                             "lens": [len(b) for b in bufs],
+                             "sums": [int(np.frombuffer(b, np.uint8).sum())
+                                      for b in bufs]})
+
+        conn, server, path = await _echo_pair(handler)
+        a = np.arange(256, dtype=np.uint8)
+        b = np.zeros(70_000, dtype=np.uint8)
+        b[-1] = 7
+        reply = await conn.request_nowait(
+            {"t": "x", "payload": "hdr"},
+            buffers=[memoryview(a), memoryview(b)])
+        assert reply["lens"] == [256, 70_000]
+        assert reply["sums"] == [int(a.sum()), 7]
+        # read side delivered memoryviews, not copies-into-msgpack
+        bufs = got["msg"]["_bufs"]
+        assert all(isinstance(x, memoryview) for x in bufs)
+        # header fields intact, "bl" framing key stripped
+        assert got["msg"]["payload"] == "hdr"
+        assert "bl" not in got["msg"]
+        await conn.close()
+        server.close()
+
+    _run(main())
+
+
+def test_sg_zero_length_and_empty_buffers():
+    async def main():
+        async def handler(conn, msg):
+            conn.reply(msg, {"n": len(msg.get("_bufs") or []),
+                             "lens": [len(b) for b in msg.get("_bufs") or []]})
+
+        conn, server, _ = await _echo_pair(handler)
+        reply = await conn.request_nowait(
+            {"t": "x"}, buffers=[memoryview(b""), memoryview(b"abc")])
+        assert reply["lens"] == [0, 3]
+        await conn.close()
+        server.close()
+
+    _run(main())
+
+
+def test_pack_with_buffers_is_zero_copy():
+    """The write side must hand the CALLER'S buffer objects to the
+    transport — identity, not equality (the at-most-one-copy guarantee:
+    only the transport's own buffering may copy payload bytes)."""
+    arr = np.zeros(100_000, dtype=np.uint8)
+    views = [memoryview(arr), memoryview(b"tail")]
+    parts = protocol.pack_with_buffers({"t": "x"}, views)
+    assert parts[1] is views[0]
+    assert parts[2] is views[1]
+    # header carries the buffer lengths
+    hlen = int.from_bytes(parts[0][4:8], "little")
+    import msgpack
+
+    hdr = msgpack.unpackb(parts[0][8:8 + hlen], raw=False)
+    assert hdr["bl"] == [100_000, 4]
+
+
+def test_sg_truncated_buffer_tail_closes_cleanly():
+    """A peer dying mid-buffer must not crash or wedge the read loop."""
+
+    async def main():
+        seen = []
+
+        async def handler(conn, msg):
+            seen.append(msg)
+
+        path = f"/tmp/rtpu_dp_tr_{os.getpid()}.sock"
+
+        async def on_client(reader, writer):
+            conn = protocol.Connection(reader, writer)
+            conn._handler = lambda m: handler(conn, m)
+            conn.start()
+
+        server = await protocol.serve("unix:" + path, on_client)
+        reader, writer = await protocol.connect("unix:" + path)
+        parts = protocol.pack_with_buffers(
+            {"t": "x"}, [memoryview(b"A" * 50_000)])
+        head = bytes(parts[0])
+        writer.write(head + b"A" * 10_000)  # 40KB short
+        await writer.drain()
+        writer.close()
+        await asyncio.sleep(0.2)
+        assert seen == []  # truncated frame never dispatched
+        server.close()
+
+    _run(main())
+
+
+def test_sg_oversize_and_undecodable_header_skipped():
+    """A lying header (overrunning lengths / garbage msgpack) drops the
+    frame; later frames on the same connection still dispatch."""
+
+    async def main():
+        seen = []
+
+        async def handler(conn, msg):
+            seen.append(msg.get("t"))
+
+        path = f"/tmp/rtpu_dp_bad_{os.getpid()}.sock"
+
+        async def on_client(reader, writer):
+            conn = protocol.Connection(reader, writer)
+            conn._handler = lambda m: handler(conn, m)
+            conn.start()
+
+        server = await protocol.serve("unix:" + path, on_client)
+        reader, writer = await protocol.connect("unix:" + path)
+        # frame 1: SG frame whose header_len overruns the payload
+        payload = protocol._LEN.pack(9999) + b"xx"
+        writer.write(protocol._LEN.pack(
+            (len(payload)) | protocol._SG_FLAG) + payload)
+        # frame 2: SG frame with garbage msgpack header
+        garbage = protocol._LEN.pack(4) + b"\xc1\xc1\xc1\xc1"
+        writer.write(protocol._LEN.pack(
+            len(garbage) | protocol._SG_FLAG) + garbage)
+        # frame 3: a good plain frame
+        writer.write(protocol.pack({"t": "good"}))
+        await writer.drain()
+        await asyncio.sleep(0.2)
+        assert seen == ["good"]
+        writer.close()
+        server.close()
+
+    _run(main())
+
+
+def test_non_dict_frame_skipped():
+    """A frame decoding to a non-dict (valid msgpack, wrong shape) is
+    dropped without killing the read loop."""
+
+    async def main():
+        seen = []
+
+        async def handler(conn, msg):
+            seen.append(msg.get("t"))
+
+        path = f"/tmp/rtpu_dp_nd_{os.getpid()}.sock"
+
+        async def on_client(reader, writer):
+            conn = protocol.Connection(reader, writer)
+            conn._handler = lambda m: handler(conn, m)
+            conn.start()
+
+        server = await protocol.serve("unix:" + path, on_client)
+        reader, writer = await protocol.connect("unix:" + path)
+        import msgpack
+
+        raw = msgpack.packb(42)
+        writer.write(protocol._LEN.pack(len(raw)) + raw)
+        writer.write(protocol.pack({"t": "after"}))
+        await writer.drain()
+        await asyncio.sleep(0.2)
+        assert seen == ["after"]
+        writer.close()
+        server.close()
+
+    _run(main())
+
+
+def test_read_frame_sg_variant():
+    """The standalone read_frame (serve proxy et al) decodes SG frames."""
+
+    async def main():
+        path = f"/tmp/rtpu_dp_rf_{os.getpid()}.sock"
+        got = {}
+        done = asyncio.Event()
+
+        async def on_client(reader, writer):
+            got["msg"] = await protocol.read_frame(reader)
+            done.set()
+
+        server = await protocol.serve("unix:" + path, on_client)
+        reader, writer = await protocol.connect("unix:" + path)
+        for part in protocol.pack_with_buffers(
+                {"t": "x", "k": 1}, [memoryview(b"\x01\x02\x03")]):
+            writer.write(part)
+        await writer.drain()
+        await asyncio.wait_for(done.wait(), 10)
+        assert got["msg"]["k"] == 1
+        assert bytes(got["msg"]["_bufs"][0]) == b"\x01\x02\x03"
+        writer.close()
+        server.close()
+
+    _run(main())
+
+
+def test_burst_backpressure_bounded_transport_buffer():
+    """A burst far beyond the socket buffer must flow through the
+    drain-aware flusher (transport buffer stays bounded, every frame
+    arrives, order preserved)."""
+
+    async def main():
+        seen = []
+        done = asyncio.Event()
+
+        async def handler(conn, msg):
+            seen.append(msg["n"])
+            if len(seen) == 200:
+                done.set()
+
+        conn, server, _ = await _echo_pair(handler)
+        blob = np.zeros(100 * 1024, dtype=np.uint8)
+        for i in range(200):  # ~20 MB burst in one tick
+            conn.send({"t": "x", "n": i}, buffers=[memoryview(blob)])
+            # the transport's own buffer must stay near the high-water
+            # mark; the backlog waits in _wbuf
+            assert (conn.writer.transport.get_write_buffer_size()
+                    < 8 * protocol.Connection._SEND_HIGH_WATER)
+        await asyncio.wait_for(done.wait(), 30)
+        assert seen == list(range(200))
+        await conn.close()
+        server.close()
+
+    _run(main())
+
+
+# --------------------------------------------------------------------------
+# SlimFuture
+
+
+def test_slim_future_basics():
+    from ray_tpu._private.worker import SlimFuture
+
+    f = SlimFuture()
+    assert not f.done()
+    with pytest.raises(TimeoutError):
+        f.result(0.01)
+    f.set_result(41)
+    assert f.done() and f.result() == 41 and f.exception() is None
+
+    f2 = SlimFuture()
+    f2.set_exception(ValueError("boom"))
+    with pytest.raises(ValueError):
+        f2.result()
+    assert isinstance(f2.exception(), ValueError)
+
+    calls = []
+    f3 = SlimFuture()
+    f3.add_done_callback(lambda fut: calls.append(1))
+    f3.set_result(None)
+    f3.add_done_callback(lambda fut: calls.append(2))  # post-done: immediate
+    assert calls == [1, 2]
+
+
+def test_slim_future_cross_thread_wakeup():
+    from ray_tpu._private.worker import SlimFuture
+
+    f = SlimFuture()
+
+    def producer():
+        time.sleep(0.05)
+        f.set_result("v")
+
+    t = threading.Thread(target=producer)
+    t.start()
+    assert f.result(5) == "v"
+    t.join()
+
+
+# --------------------------------------------------------------------------
+# cluster tests: transport tiers end to end
+
+
+@pytest.fixture(scope="module")
+def dp_cluster():
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def counters():
+    serialization.reset_transport_stats()
+    yield serialization.transport_stats
+
+
+def test_direct_lane_actor_arg(dp_cluster, counters):
+    @ray_tpu.remote
+    class A:
+        def probe(self, arr):
+            # OWNDATA False == the worker-side array is a zero-copy view
+            # over the received frame payload, not a copy.
+            return (arr.nbytes, float(arr.sum()),
+                    bool(arr.flags["OWNDATA"]))
+
+    a = A.remote()
+    arr = np.ones(150 * 1024, dtype=np.uint8)  # inline < 150KB < direct
+    nbytes, total, owndata = ray_tpu.get(a.probe.remote(arr))
+    assert (nbytes, total) == (arr.nbytes, float(arr.nbytes))
+    assert owndata is False
+    stats = counters()
+    assert stats["direct_lane_args"] >= 1
+    assert stats["shm_args"] == 0
+    assert stats["direct_lane_bytes"] >= arr.nbytes
+
+
+def test_transport_tier_routing(dp_cluster, counters):
+    @ray_tpu.remote
+    class A:
+        def nbytes(self, arr):
+            return arr.nbytes
+
+    a = A.remote()
+    small = np.zeros(1024, dtype=np.uint8)           # inline tier
+    mid = np.zeros(200 * 1024, dtype=np.uint8)       # direct lane tier
+    big = np.zeros(2 << 20, dtype=np.uint8)          # shm + GCS tier
+    assert ray_tpu.get(a.nbytes.remote(small)) == small.nbytes
+    assert ray_tpu.get(a.nbytes.remote(mid)) == mid.nbytes
+    assert ray_tpu.get(a.nbytes.remote(big)) == big.nbytes
+    stats = counters()
+    assert stats["inline_args"] >= 1
+    assert stats["direct_lane_args"] == 1
+    assert stats["shm_args"] == 1
+
+
+def test_direct_lane_with_object_ref_arg(dp_cluster, counters):
+    """Top-level ObjectRefs inside direct-lane args still resolve."""
+
+    @ray_tpu.remote
+    class A:
+        def combine(self, arr, val):
+            return float(arr.sum()) + val
+
+    a = A.remote()
+    ref = ray_tpu.put(5.0)
+    arr = np.ones(150 * 1024, dtype=np.uint8)
+    out = ray_tpu.get(a.combine.remote(arr, ref))
+    assert out == float(arr.nbytes) + 5.0
+
+
+def test_direct_lane_under_rpc_chaos(dp_cluster, counters):
+    """Injected actor_call failures must be absorbed by the retry path
+    with direct-lane payloads preserved across re-dispatch."""
+    os.environ["RAY_TPU_RPC_FAILURE"] = "actor_call=0.3"
+    protocol.reload_rpc_chaos()
+    try:
+        @ray_tpu.remote(max_task_retries=20)
+        class A:
+            def nbytes(self, arr):
+                return arr.nbytes
+
+        a = A.remote()
+        arr = np.zeros(120 * 1024, dtype=np.uint8)
+        outs = ray_tpu.get([a.nbytes.remote(arr) for _ in range(20)],
+                           timeout=60)
+        assert outs == [arr.nbytes] * 20
+    finally:
+        os.environ.pop("RAY_TPU_RPC_FAILURE", None)
+        protocol.reload_rpc_chaos()
+
+
+def test_direct_arg_threshold_knob(dp_cluster, counters):
+    """direct_arg_threshold=0 disables the lane: mid-size args take shm."""
+    from ray_tpu._private import config as cfg
+
+    old = serialization.DIRECT_ARG_THRESHOLD
+    serialization.DIRECT_ARG_THRESHOLD = 0
+    try:
+        @ray_tpu.remote
+        class A:
+            def nbytes(self, arr):
+                return arr.nbytes
+
+        a = A.remote()
+        arr = np.zeros(150 * 1024, dtype=np.uint8)
+        assert ray_tpu.get(a.nbytes.remote(arr)) == arr.nbytes
+        stats = counters()
+        assert stats["shm_args"] == 1
+        assert stats["direct_lane_args"] == 0
+    finally:
+        serialization.DIRECT_ARG_THRESHOLD = old
+
+
+def test_microbench_smoke_counters(dp_cluster, counters):
+    """Tier-1 smoke for the microbench assertion: the with-arg shape
+    rides the direct lane (payload copied at most once write-side is
+    covered by test_pack_with_buffers_is_zero_copy; here we pin the
+    transport tier so a routing regression fails fast)."""
+
+    @ray_tpu.remote
+    class Actor:
+        def with_arg(self, arr):
+            return arr.nbytes
+
+    actors = [Actor.remote() for _ in range(2)]
+    arr = np.zeros(100 * 1024 + 1024, dtype=np.uint8)
+    outs = ray_tpu.get([actors[i % 2].with_arg.remote(arr)
+                        for i in range(16)])
+    assert outs == [arr.nbytes] * 16
+    stats = counters()
+    assert stats["direct_lane_args"] == 16
+    assert stats["shm_args"] == 0

@@ -1,0 +1,58 @@
+"""Cross-"node" argument transport: direct lane vs GCS fetch fallback.
+
+With per-node isolated arenas (``RAY_TPU_STORE_SUFFIX``, the fake
+multi-host setup), an actor on another "host" cannot see the driver's shm
+store. Direct-lane args are connection-based — they must work unchanged —
+while above-threshold args ride the shm+GCS object plane and the remote
+worker must fall back to the GCS-mediated fetch (``worker_main._load_args``
+store-miss path).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    c = Cluster(connect=True)
+    c.add_node(num_cpus=2, resources={"side": 2})
+    assert c.wait_for_nodes(2, timeout=60)
+    assert c.wait_for_workers(timeout=60)
+    yield c
+    c.shutdown()
+
+
+def test_direct_lane_and_gcs_fallback_across_nodes(two_node_cluster):
+    @ray_tpu.remote(resources={"side": 0.1})
+    class Remote:
+        def probe(self, arr):
+            import os
+
+            return (float(arr.sum()),
+                    os.environ.get("RAY_TPU_STORE_SUFFIX", ""))
+
+    a = Remote.remote()
+    serialization.reset_transport_stats()
+
+    # Direct lane: 200KB rides the actor connection — no store sharing
+    # needed, must work across simulated hosts unchanged.
+    mid = np.ones(200 * 1024, dtype=np.uint8)
+    total, suffix = ray_tpu.get(a.probe.remote(mid), timeout=60)
+    assert total == float(mid.nbytes)
+    assert suffix != ""  # really placed on the isolated-store node
+
+    # Above direct_arg_threshold: shm + argsref. The remote worker's
+    # store.get misses (different arena) and falls back to the GCS
+    # fetch path — the bytes still arrive intact.
+    big = np.ones(2 << 20, dtype=np.uint8)
+    total, suffix = ray_tpu.get(a.probe.remote(big), timeout=120)
+    assert total == float(big.nbytes)
+    assert suffix != ""
+
+    stats = serialization.transport_stats()
+    assert stats["direct_lane_args"] == 1
+    assert stats["shm_args"] == 1
