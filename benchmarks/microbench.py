@@ -17,6 +17,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Sibling benchmark module (shared obj_xfer_stats accounting helper).
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import ray_tpu  # noqa: E402
 
@@ -202,8 +204,64 @@ def main():
         "memcpy_gbps": round(len(src) / (time.perf_counter() - t0) / 1e9, 2),
     }
 
-    print(json.dumps(results))
     ray_tpu.shutdown()
+
+    # Small-payload cooperative-broadcast smoke (the P2P chunk plane):
+    # separate simulated-node arenas so the striped pull path really
+    # runs; records aggregate GB/s + how much the source served, so a
+    # path regression (relay dead, copies back on the serve side) shows
+    # up next to the rate it tanks.
+    # Guarded: a smoke failure (cluster spin-up timeout on a loaded CI
+    # host) must not discard every metric measured above.
+    try:
+        results["object_broadcast_small"] = broadcast_smoke(
+            mb=16 if args.quick else 32)
+    except Exception as e:
+        results["object_broadcast_small"] = {"error": repr(e)}
+
+    print(json.dumps(results))
+
+
+def broadcast_smoke(mb: int = 32, nodes: int = 2) -> dict:
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(connect=True)
+    try:
+        for i in range(nodes):
+            c.add_node(num_cpus=1, resources={f"mb{i}": 2})
+        assert c.wait_for_nodes(nodes + 1, timeout=120)
+        assert c.wait_for_workers(timeout=120)
+        payload = np.random.RandomState(0).bytes(mb << 20)
+        ref = ray_tpu.put(payload)
+
+        @ray_tpu.remote
+        def fetch(wrapped):
+            return len(ray_tpu.get(wrapped[0]))
+
+        small = ray_tpu.put(b"x")
+        opts = [dict(resources={f"mb{i}": 1}) for i in range(nodes)]
+        ray_tpu.get([fetch.options(**o).remote([small]) for o in opts],
+                    timeout=60)
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [fetch.options(**o).remote([ref]) for o in opts], timeout=300)
+        dt = time.perf_counter() - t0
+        assert outs == [mb << 20] * nodes
+        from object_broadcast import xfer_stats
+
+        served = xfer_stats()
+        total = sum(r[2] for r in served)
+        source = sum(r[2] for r in served if r[1] == "")
+        out = {
+            "gbps": round(mb / 1024 * nodes / dt, 3),
+            "source_share": round(source / total, 3) if total else None,
+        }
+        print(f"object_broadcast_small: {out}", flush=True)
+        return out
+    finally:
+        # A failed spin-up must not leak the simulated-node subprocesses
+        # into the benchmarks that run after this one.
+        c.shutdown()
 
 
 if __name__ == "__main__":

@@ -3,8 +3,11 @@
 nodes in 61.9 s ≈ 0.83 GB/s aggregate, BASELINE.md).
 
 A head-arena object is pulled by N simulated nodes (per-node arenas) over
-the P2P chunk path concurrently. Prints one JSON line with the aggregate
-broadcast bandwidth.
+the cooperative chunk-striped P2P broadcast plane concurrently. Prints one
+JSON line with the aggregate broadcast bandwidth plus the per-source
+served-bytes split (the proof that non-source peers relayed most of the
+traffic), and writes the full record into ``records/`` (the bench-record
+flow — see records/README.md).
 """
 
 from __future__ import annotations
@@ -20,6 +23,19 @@ import numpy as np  # noqa: E402
 
 import ray_tpu  # noqa: E402
 from ray_tpu.cluster_utils import Cluster  # noqa: E402
+
+
+def xfer_stats() -> list:
+    """[[source_key, store_suffix, bytes_served], ...] from the GCS
+    broadcast accounting (suffix "" = the head/source node)."""
+    from ray_tpu._private.worker import global_worker
+
+    try:
+        reply = global_worker().request_gcs({"t": "obj_xfer_stats"},
+                                            timeout=10)
+    except Exception:
+        return []
+    return reply.get("served", []) if reply.get("ok") else []
 
 
 def main():
@@ -54,13 +70,36 @@ def main():
     nodes_hit = len({s for s, _ in outs})
     assert all(n == mb << 20 for _, n in outs)
     total_gb = mb / 1024 * n_nodes
-    print(json.dumps({
+
+    served = xfer_stats()
+    served_total = sum(r[2] for r in served)
+    # The source is the head arena: its agents register with an EMPTY
+    # store suffix; unresolved entries (None suffix) are counted as
+    # unknown, not as relay credit.
+    source_bytes = sum(r[2] for r in served if r[1] == "")
+    record = {
         "metric": "object_broadcast_aggregate",
         "value": round(total_gb / dt, 3),
         "unit": "GB/s",
         "extra": {"nodes": n_nodes, "mb": mb, "seconds": round(dt, 2),
-                  "distinct_nodes_hit": nodes_hit},
-    }))
+                  "distinct_nodes_hit": nodes_hit,
+                  "served_bytes_total": served_total,
+                  "source_served_bytes": source_bytes,
+                  "source_share": round(source_bytes / served_total, 3)
+                  if served_total else None,
+                  "served_by_source": served},
+    }
+    print(json.dumps(record))
+    rec_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "records")
+    try:
+        os.makedirs(rec_dir, exist_ok=True)
+        with open(os.path.join(
+                rec_dir, f"object_broadcast_{int(time.time())}.json"),
+                "w") as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
     c.shutdown()
 
 

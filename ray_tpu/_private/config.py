@@ -36,7 +36,24 @@ class RayTpuConfig:
     store_capacity: int = 2 << 30   # logical capacity before evict/spill
     arena_bytes: int = 4 << 30      # shm arena size (sparse)
     pull_chunk_bytes: int = 4 << 20  # p2p transfer chunk
-    pull_window: int = 4            # outstanding chunks per pull
+    pull_window: int = 8            # outstanding chunks per pull PER SOURCE
+    # Transport write-buffer ceiling on chunk-serving connections. The
+    # asyncio default (64KB high water) empties the pipe between chunks —
+    # the serve side stalls a drain round-trip per chunk and fan-out
+    # collapses (measured 3x on a 3-puller fan-out). Serving at most a
+    # pull window per puller bounds the real buffering anyway.
+    obj_serve_buffer: int = 16 << 20
+    # ---- cooperative pipelined broadcast (P2P striped pull)
+    # Deadlines scale with object size: base + nbytes/min_bandwidth, so a
+    # multi-GB pull on a slow link is not killed by a flat cap while tiny
+    # pulls still fail fast.
+    pull_timeout_base_s: float = 30.0
+    pull_min_bandwidth: int = 8 << 20      # bytes/s assumed worst case
+    pull_chunk_timeout_floor_s: float = 10.0
+    pull_progress_chunks: int = 4          # chunk-bitmap report cadence
+    pull_refresh_interval_s: float = 0.05  # mid-pull directory re-locate
+    pull_max_sources: int = 8              # stripe fan-in cap per pull
+    max_peer_conns: int = 32               # cached idle pull connections
     inline_threshold: int = 100 * 1024
     # Direct-lane ceiling: actor-call args above inline_threshold and at
     # most this ride the already-open actor connection out-of-band

@@ -33,6 +33,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import protocol
+from .broadcast import bitmap_make, bitmap_set, bitmap_test
 from .config import config as _cfg
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .object_store import make_store
@@ -125,6 +126,7 @@ class WorkerInfo:
         self.conn = conn
         self.addr = addr
         self.pid = pid
+        self.obj_addr = ""  # TCP chunk-serve endpoint (broadcast plane)
         self.env_key = ""  # interpreter env pool ("" = base image)
         self.state = W_IDLE
         self.current_task: Optional[TaskID] = None
@@ -192,7 +194,8 @@ class TaskRecord:
 
 class ObjectEntry:
     __slots__ = ("object_id", "nbytes", "ready", "inline", "on_shm", "refcount",
-                 "waiters", "producing_task", "spilled", "holders", "owner")
+                 "waiters", "producing_task", "spilled", "holders", "owner",
+                 "partial", "pullers", "cs", "pseq")
 
     def __init__(self, object_id: ObjectID):
         self.object_id = object_id
@@ -211,6 +214,17 @@ class ObjectEntry:
         # ray:// client drivers).
         self.holders: Set[bytes] = set()
         self.owner: Optional["ClientConn"] = None
+        # Chunk-level holder registration (cooperative broadcast): serve
+        # addr -> [node_id_bytes, chunk bitmap, completed count] for
+        # pullers that hold SOME chunks mid-pull, the object's canonical
+        # chunk size (set by the first progress report), and each active
+        # puller's [ordinal, current source set] (the stagger index for
+        # stripe ownership + the per-holder in-flight serve load). All
+        # lazily allocated — most objects are never broadcast.
+        self.partial: Optional[Dict[str, list]] = None
+        self.pullers: Optional[Dict[int, list]] = None
+        self.cs = 0
+        self.pseq = 0  # monotone puller-ordinal counter
 
 
 class ActorRecord:
@@ -378,6 +392,10 @@ class ClientConn:
         self.serial = _client_serial()
         self.worker_id: Optional[WorkerID] = None
         self.node_id: Optional[NodeID] = None
+        # (oid_bytes, serve_addr|None) pairs this client registered via
+        # obj_progress — retired when the client disconnects so dead
+        # pullers don't linger as partial holders.
+        self.pull_regs: Set[tuple] = set()
 
 
 class GcsServer:
@@ -435,6 +453,13 @@ class GcsServer:
         # eventual exit dereferences them.
         self._owned_objects: Dict[Any, Set[ObjectID]] = {}
         self._client_by_wid: Dict[bytes, ClientConn] = {}
+        # Cooperative-broadcast accounting: served bytes per source (node
+        # hex where resolvable, else raw serve addr) reported by pullers
+        # at pull completion — the "who actually carried the broadcast"
+        # signal (benchmarks assert the source served a minority).
+        self.bcast_served: Dict[str, dict] = {}
+        self._addr_nodes: Dict[str, tuple] = {}  # serve addr -> (hex, sfx)
+        self._locate_rr = 0  # worker-endpoint rotation (obj_locate)
         # Observability stores (reference: GcsTaskManager task-event store
         # gcs_task_manager.h:86; metrics agent metrics_agent.py). Both bounded.
 
@@ -733,6 +758,7 @@ class GcsServer:
             client.node_id = node_id
             info = WorkerInfo(worker_id, node_id, client.conn,
                               msg.get("addr", ""), msg.get("pid", 0))
+            info.obj_addr = msg.get("obj_addr") or ""
             info.env_key = msg.get("env_key", "")
             if info.env_key:
                 self._env_failures.pop(info.env_key, None)  # env builds now
@@ -838,6 +864,9 @@ class GcsServer:
         if client in self.clients:
             self.clients.remove(client)
         self.publisher.drop_conn(client.conn)
+        if client.pull_regs:
+            # A dead puller must not linger as a partial broadcast holder.
+            self._drop_pull_regs(client)
         if (client.worker_id is not None
                 and self._client_by_wid.get(client.worker_id.binary())
                 is client):
@@ -1183,10 +1212,12 @@ class GcsServer:
             client.conn.reply(msg, {"ok": True, "data": entry.inline})
             return
         addrs = []
+        holder_nodes = []
         for node_id in entry.holders:
             node = self.nodes.get(NodeID(node_id))
             if node is not None and node.alive and node.obj_addr:
                 addrs.append(node.obj_addr)
+                holder_nodes.append(node)
         if entry.on_shm and self.store.contains(oid):
             # Head-arena object (e.g. a driver put): served by any agent
             # attached to the head arena (empty store suffix).
@@ -1195,13 +1226,179 @@ class GcsServer:
                         and node.store_suffix == ""
                         and node.obj_addr not in addrs):
                     addrs.append(node.obj_addr)
-        client.conn.reply(msg, {"ok": True, "nbytes": entry.nbytes,
-                                "addrs": addrs,
-                                # Holder NODE ids too: locality-aware
-                                # consumers (ray_tpu.data) schedule the
-                                # reading task onto a holding node.
-                                "nids": [nid for nid in entry.holders],
-                                "spilled": entry.spilled is not None})
+                    holder_nodes.append(node)
+        # A holder NODE can serve from several processes: its agent plus
+        # idle workers attached to the same arena (each with its own TCP
+        # serve socket). One serving process tops out well below a
+        # broadcast fan-in's demand — advertising multiple endpoints
+        # multiplies the node's egress. The worker list is ROTATED per
+        # lookup so concurrent pullers land on different endpoints
+        # instead of all sharing the first two.
+        self._locate_rr += 1
+        for node in holder_nodes:
+            added = 0
+            wids = list(node.idle_workers)
+            k = len(wids)
+            for j in range(k):
+                w = self.workers.get(wids[(j + self._locate_rr) % k])
+                a = (w.obj_addr or w.addr) if w is not None else ""
+                if (w is not None and not w.conn.closed and a
+                        and a not in addrs):
+                    addrs.append(a)
+                    added += 1
+                    if added >= 2:
+                        break
+        reply = {"ok": True, "nbytes": entry.nbytes,
+                 "addrs": addrs,
+                 # Holder NODE ids too: locality-aware
+                 # consumers (ray_tpu.data) schedule the
+                 # reading task onto a holding node.
+                 "nids": [nid for nid in entry.holders],
+                 "spilled": entry.spilled is not None}
+        # Cooperative-broadcast surface: mid-pull partial holders with
+        # their chunk bitmaps, the canonical chunk size, and per-source
+        # in-flight pull counts (load-aware striping).
+        if entry.cs:
+            reply["cs"] = entry.cs
+        if msg.get("pull"):
+            # The caller is about to PULL this object: register it as an
+            # active puller and hand back a stable ordinal + the live
+            # puller count. Pullers stagger their chunk order by the
+            # ordinal (disjoint early stripes -> relay fodder) and
+            # restrict full-holder claims to ~1/npull of the object, so
+            # the source's egress approaches ONE copy instead of N.
+            if entry.pullers is None:
+                entry.pullers = {}
+            prec = entry.pullers.get(client.serial)
+            if prec is None:
+                prec = entry.pullers[client.serial] = [entry.pseq, set()]
+                entry.pseq += 1
+                # GC on disconnect even if the puller never reports
+                # progress (it would otherwise inflate npull forever).
+                client.pull_regs.add((oid.binary(), None))
+            reply["pidx"] = prec[0]
+            reply["npull"] = len(entry.pullers)
+        loads: Dict[str, int] = {}
+        if entry.pullers:
+            for prec in entry.pullers.values():
+                for a in prec[1]:
+                    loads[a] = loads.get(a, 0) + 1
+        if loads:
+            reply["loads"] = loads
+        if entry.partial:
+            reply["partial"] = [
+                [addr, bytes(p[1]), entry.cs, loads.get(addr, 0)]
+                for addr, p in entry.partial.items() if p[2] > 0]
+        client.conn.reply(msg, reply)
+
+    # ------------------------------------ cooperative broadcast directory
+
+    async def _h_obj_progress(self, client, msg):
+        """Chunk-bitmap progress from a mid-pull holder (cooperative
+        broadcast): the directory learns which chunks the puller already
+        holds — so later pullers stripe off it immediately — and which
+        sources it is pulling from (the per-holder in-flight load
+        ``obj_locate`` hands back for load-aware striping). A ``done``
+        report retires the partial entry (the sealed copy was registered
+        as a full holder in the same FIFO stream) and credits per-source
+        served bytes to the transfer accounting."""
+        entry = self.objects.get(ObjectID(msg["oid"]))
+        if entry is None:
+            return
+        addr = msg.get("addr")
+        if msg.get("done"):
+            for a, n in (msg.get("src_bytes") or {}).items():
+                self._bcast_account(entry, a, n)
+            if addr and entry.partial:
+                entry.partial.pop(addr, None)
+            if entry.pullers:
+                entry.pullers.pop(client.serial, None)
+            client.pull_regs.discard((bytes(msg["oid"]), addr))
+            client.pull_regs.discard((bytes(msg["oid"]), None))
+            return
+        cs = int(msg.get("cs") or 0)
+        if cs <= 0:
+            return
+        if entry.cs and cs != entry.cs:
+            return  # mismatched chunk geometry: ignore, don't corrupt
+        entry.cs = cs
+        srcs = msg.get("srcs")
+        if srcs is not None:
+            if entry.pullers is None:
+                entry.pullers = {}
+            prec = entry.pullers.get(client.serial)
+            if prec is None:
+                prec = entry.pullers[client.serial] = [entry.pseq, set()]
+                entry.pseq += 1
+            prec[1] = set(srcs)
+            client.pull_regs.add((bytes(msg["oid"]), addr))
+        if not addr:
+            return
+        nchunks = max(1, (int(msg.get("nbytes") or entry.nbytes) + cs - 1)
+                      // cs)
+        if entry.partial is None:
+            entry.partial = {}
+        p = entry.partial.get(addr)
+        if p is None:
+            node_b = bytes(msg["node"]) if msg.get("node") else b""
+            p = entry.partial[addr] = [node_b, bitmap_make(nchunks), 0]
+            if node_b:
+                node = self.nodes.get(NodeID(node_b))
+                self._addr_nodes[addr] = (
+                    NodeID(node_b).hex(),
+                    node.store_suffix if node is not None else None)
+        bm = p[1]
+        for idx in msg.get("add") or ():
+            i = int(idx)
+            if 0 <= i < nchunks and not bitmap_test(bm, i):
+                bitmap_set(bm, i)
+                p[2] += 1
+
+    def _bcast_account(self, entry, addr: str, n):
+        hint = self._addr_nodes.get(addr)
+        if hint is None:
+            for nid, node in self.nodes.items():
+                if node.obj_addr == addr:
+                    hint = self._addr_nodes[addr] = (nid.hex(),
+                                                     node.store_suffix)
+                    break
+        if hint is None:
+            # Worker serve endpoints (obj_locate advertises idle workers
+            # next to the agent) must attribute to their NODE too —
+            # otherwise bytes the source node's workers served vanish
+            # from the source-share metric and it reads better than it is.
+            for w in self.workers.values():
+                if w.obj_addr == addr and w.node_id is not None:
+                    node = self.nodes.get(w.node_id)
+                    hint = self._addr_nodes[addr] = (
+                        w.node_id.hex(),
+                        node.store_suffix if node is not None else None)
+                    break
+        key = hint[0] if hint else addr
+        rec = self.bcast_served.get(key)
+        if rec is None:
+            rec = self.bcast_served[key] = {
+                "suffix": hint[1] if hint else None, "bytes": 0}
+        rec["bytes"] += int(n)
+
+    async def _h_obj_xfer_stats(self, client, msg):
+        """Per-source served-bytes totals for the cooperative broadcast
+        plane (node hex where resolvable, else serve addr): the proof
+        surface that non-source peers carried the traffic."""
+        client.conn.reply(msg, {"ok": True, "served": [
+            [key, rec["suffix"], rec["bytes"]]
+            for key, rec in self.bcast_served.items()]})
+
+    def _drop_pull_regs(self, client: ClientConn):
+        for oid_b, addr in client.pull_regs:
+            entry = self.objects.get(ObjectID(oid_b))
+            if entry is None:
+                continue
+            if addr and entry.partial:
+                entry.partial.pop(addr, None)
+            if entry.pullers:
+                entry.pullers.pop(client.serial, None)
+        client.pull_regs.clear()
 
     async def _h_obj_holders(self, client, msg):
         """Batch holder-node lookup: oids -> [[node_id, ...], ...].
@@ -2071,6 +2268,10 @@ class GcsServer:
             node.drain_timer = asyncio.get_running_loop().call_later(
                 max(0.0, deadline_s), self._drain_deadline_expired,
                 node.node_id)
+            # Pull-connection hygiene: tell every client to retire cached
+            # peer connections to this node (they re-dial if the draining
+            # node is still the only holder of something they need).
+            self._push_node_addrs_gone(node)
             # Proactive migration: every restartable actor on the node is
             # restarted elsewhere NOW (while its state can still be
             # rebuilt under controlled conditions) instead of dying with
@@ -2159,8 +2360,29 @@ class GcsServer:
                                   "node_id": node_id.hex(),
                                   "hostname": node.hostname,
                                   "was_draining": node.draining})
+        self._push_node_addrs_gone(node)
         for wid in list(node.workers):
             asyncio.get_running_loop().create_task(self._on_worker_death(wid))
+
+    def _push_node_addrs_gone(self, node):
+        """Broadcast a node's serve addresses to every connected client on
+        DEAD/DRAINING so cached pull connections are evicted (node death
+        is rare — the fan-out is cheap relative to leaking sockets)."""
+        addrs = [a for a in (node.obj_addr,) if a]
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None and w.obj_addr:
+                addrs.append(w.obj_addr)
+        if not addrs:
+            return
+        out = {"t": "node_addrs_gone", "addrs": addrs,
+               "node_id": node.node_id.hex()}
+        for c in self.clients:
+            if not c.conn.closed:
+                try:
+                    c.conn.send(out)
+                except ConnectionError:
+                    pass
 
     def _driver_exit_after_grace(self, wid_b: bytes, client: ClientConn):
         self._driver_exit_graces.pop(wid_b, None)

@@ -18,6 +18,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 from typing import Dict, List, Optional
@@ -370,9 +371,10 @@ class NodeAgent:
         self.conn: Optional[protocol.Connection] = None
         self.procs: List[subprocess.Popen] = []
         self.stopped = asyncio.Event()
-        self._obj_server: Optional[asyncio.AbstractServer] = None
+        self._obj_serve_sock = None
         self.obj_addr: Optional[str] = None
         self._store = None
+        self._store_lock = threading.Lock()
         self._zygote: Optional[subprocess.Popen] = None
         self._zygote_rbuf = b""   # raw pid-line read buffer (spawner thread)
         self._spawn_q = None      # queue.SimpleQueue, created lazily
@@ -549,47 +551,39 @@ class NodeAgent:
 
     async def _start_obj_server(self):
         # Loopback for same-host (UDS-attached) clusters; the node's
-        # reachable IP when the cluster spans hosts (TCP GCS).
+        # reachable IP when the cluster spans hosts (TCP GCS). Runs on
+        # dedicated blocking-IO threads: bulk chunk serving must not
+        # contend with the agent's control loop (or, on the head, the
+        # whole GCS), and blocking sendall straight from the pinned arena
+        # view skips the asyncio transport's buffering copy.
+        from . import broadcast
+        from .serialization import TRANSPORT_STATS
+
         host = ("127.0.0.1" if self.gcs_address.startswith("unix:")
                 else get_node_ip_address())
-        try:
-            self._obj_server = await protocol.serve(
-                f"{host}:0", self._on_obj_client)
-            port = self._obj_server.sockets[0].getsockname()[1]
-            self.obj_addr = f"{host}:{port}"
-        except OSError:
-            self.obj_addr = None
-
-    async def _on_obj_client(self, reader, writer):
-        conn = protocol.Connection(reader, writer)
-        conn._handler = lambda msg: self._on_obj_msg(conn, msg)
-        conn.start()
+        self.obj_addr, self._obj_serve_sock = broadcast.start_serve_thread(
+            host, self._resolve_obj_fetch, name="agent-obj-serve",
+            stats=TRANSPORT_STATS)
 
     def _host_store(self):
         if self._store is None:
-            from .object_store import make_store
+            with self._store_lock:
+                if self._store is None:
+                    from .object_store import make_store
 
-            self._store = make_store(os.path.basename(self.session_dir))
+                    self._store = make_store(
+                        os.path.basename(self.session_dir))
         return self._store
 
-    async def _on_obj_msg(self, conn: protocol.Connection, msg: dict):
-        if msg.get("t") != "obj_fetch":
-            return
+    def _resolve_obj_fetch(self, msg: dict):
         from .ids import ObjectID
 
-        oid = ObjectID(msg["oid"])
-        off = int(msg.get("off", 0))
-        length = int(msg.get("len", 0))
-        view = self._host_store().get(oid, msg.get("nbytes", 0))
-        if view is None:
-            conn.reply(msg, {"ok": False})
-            return
+        oid = ObjectID(bytes(msg["oid"]))
         try:
-            total = len(view.data)
-            chunk = bytes(view.data[off:off + length]) if length else b""
-            conn.reply(msg, {"ok": True, "data": chunk, "total": total})
-        finally:
-            view.close()
+            view = self._host_store().get(oid, msg.get("nbytes", 0))
+        except Exception:
+            view = None
+        return view, False
 
     def _on_gcs_close(self):
         if not self.stopped.is_set():

@@ -274,8 +274,26 @@ class Connection:
     def _transport_write_batch(self, batch: list):
         w = self.writer
         small: list = []
+        i = 0
         try:
-            for p in batch:
+            for i, p in enumerate(batch):
+                if callable(p):
+                    # Release marker (pinned-buffer serves): every part
+                    # queued before it must reach the transport BEFORE the
+                    # pin drops — flush the coalesced small parts first,
+                    # or a store abort could recycle the arena range while
+                    # its bytes still sit in ``small`` unwritten.
+                    if small:
+                        if len(small) == 1:
+                            w.write(small[0])
+                        else:
+                            w.writelines(small)
+                        small = []
+                    try:
+                        p()
+                    except Exception:
+                        pass
+                    continue
                 if len(p) >= self._BIG_PART:
                     if small:
                         if len(small) == 1:
@@ -292,6 +310,14 @@ class Connection:
                 else:
                     w.writelines(small)
         except (ConnectionResetError, BrokenPipeError, OSError):
+            # Unreached release markers must still run (the data is never
+            # going out; leaking the pins would wedge store aborts).
+            for p in batch[i:]:
+                if callable(p):
+                    try:
+                        p()
+                    except Exception:
+                        pass
             self._mark_closed()
 
     def _schedule_flush(self):
@@ -302,6 +328,12 @@ class Connection:
     def _flush_wbuf(self):
         self._flush_scheduled = False
         if self._closed or not self._wbuf:
+            for p in self._wbuf:
+                if callable(p):  # never-sent frames still release pins
+                    try:
+                        p()
+                    except Exception:
+                        pass
             self._wbuf.clear()
             return
         if self._congested():
@@ -325,8 +357,16 @@ class Connection:
             budget = self._SEND_BATCH
             i = 0
             n = len(parts)
-            while i < n and budget > 0:
-                budget -= len(parts[i])
+            while i < n:
+                p = parts[i]
+                if callable(p):
+                    # Zero-byte release marker: always rides with (after)
+                    # its frame's parts.
+                    i += 1
+                    continue
+                if budget <= 0:
+                    break
+                budget -= len(p)
                 i += 1
             batch = parts[:i]
             self._wbuf = parts[i:]
@@ -475,6 +515,16 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        if self._wbuf:
+            # Parked frames will never be written: run their release
+            # markers so pinned serve buffers are freed.
+            for p in self._wbuf:
+                if callable(p):
+                    try:
+                        p()
+                    except Exception:
+                        pass
+            self._wbuf.clear()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("connection closed"))
@@ -493,23 +543,39 @@ class Connection:
         """Unsent bytes queued on this connection (coalescing buffer +
         transport write buffer) — the pubsub slow-subscriber backpressure
         signal (``_private/pubsub.py``)."""
-        n = sum(len(b) for b in self._wbuf) if self._wbuf else 0
+        n = (sum(len(b) for b in self._wbuf if not callable(b))
+             if self._wbuf else 0)
         try:
             n += self.writer.transport.get_write_buffer_size()
         except Exception:
             pass
         return n
 
-    def send(self, msg: dict, buffers=None):
+    def send(self, msg: dict, buffers=None, release=None):
         """Fire-and-forget send. ``buffers``: out-of-band memoryviews
-        shipped in a scatter-gather frame (zero-copy write side)."""
+        shipped in a scatter-gather frame (zero-copy write side).
+        ``release``: invoked once the frame's bytes were handed to the
+        transport (or are known never to go out) — the unpin hook for
+        buffers aliasing pinned store memory (chunk serving)."""
         if self._closed:
+            if release is not None:
+                release()
             raise ConnectionError("connection closed")
-        _maybe_inject_failure(msg)
+        try:
+            _maybe_inject_failure(msg)
+        except ConnectionError:
+            if release is not None:
+                release()
+            raise
         if buffers:
-            self._write_parts(pack_with_buffers(msg, buffers))
+            parts = pack_with_buffers(msg, buffers)
+            if release is not None:
+                parts.append(release)
+            self._write_parts(parts)
         else:
             self._write_frame(pack(msg))
+            if release is not None:
+                release()
 
     def request_nowait(self, msg: dict, buffers=None) -> asyncio.Future:
         """Synchronously send a request; returns the reply future.
@@ -557,11 +623,12 @@ class Connection:
         self._write_frame(pack(msg))
         return q
 
-    def reply(self, req: dict, msg: dict):
-        """Send the reply to a received request."""
+    def reply(self, req: dict, msg: dict, buffers=None, release=None):
+        """Send the reply to a received request. ``buffers``/``release``
+        as in :meth:`send` (scatter-gather replies — chunk serving)."""
         msg["i"] = req["i"]
         msg["r"] = 1
-        self.send(msg)
+        self.send(msg, buffers=buffers, release=release)
 
     async def drain(self):
         await self.writer.drain()
@@ -583,6 +650,27 @@ class Connection:
             await self.writer.wait_closed()
         except (ConnectionResetError, BrokenPipeError, OSError):
             pass
+
+
+def widen_for_serving(conn: Connection):
+    """Raise a chunk-serving connection's write-buffer ceilings (transport
+    pause/resume limits + the connection's own congestion thresholds).
+
+    The asyncio default high water (64KB) drains the pipe to near-empty
+    between multi-MB chunk frames, so every chunk pays a full drain
+    round-trip and fan-out serving collapses (measured: a 3-puller
+    fan-out at ~1/3 the per-stream rate). A pull-window of chunks per
+    puller bounds what actually accumulates here."""
+    from .config import config as _cfg
+
+    high = max(1 << 20, _cfg().obj_serve_buffer)
+    try:
+        conn.writer.transport.set_write_buffer_limits(high=high,
+                                                      low=high // 2)
+    except (AttributeError, RuntimeError, OSError):
+        pass
+    conn._SEND_HIGH_WATER = high
+    conn._SEND_BATCH = high
 
 
 async def reconnect_with_retry(attempt, *, should_stop=None,

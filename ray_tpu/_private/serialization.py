@@ -232,6 +232,12 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+# Pickle streams at least this large are stored out-of-line in the
+# segment layout ("po"/"pl") instead of inline in the msgpack header —
+# see SerializedObject._layout.
+_PICKLE_OOL_MIN = 64 * 1024
+
+
 class SerializedObject:
     """Pickle bytes plus out-of-band buffers, ready to be written.
 
@@ -243,11 +249,19 @@ class SerializedObject:
     threshold decision without them.
     """
 
-    __slots__ = ("pickle_bytes", "buffers", "_header", "_offsets", "_total")
+    __slots__ = ("pickle_bytes", "buffers", "_header", "_offsets", "_total",
+                 "_po", "raw")
 
-    def __init__(self, pickle_bytes: bytes, buffers: List[pickle.PickleBuffer]):
+    def __init__(self, pickle_bytes: bytes,
+                 buffers: List[pickle.PickleBuffer], raw: bool = False):
+        # ``raw``: pickle_bytes IS the value (a large bytes blob stored
+        # verbatim — checkpoint shards, tokenizer files, packed pages).
+        # Skipping pickle on both sides saves a full scan + copy each way
+        # at exactly the sizes where it costs hundreds of ms.
         self.pickle_bytes = pickle_bytes
-        if not buffers:
+        self.raw = raw
+        self._po = None
+        if not buffers and not raw and len(pickle_bytes) < _PICKLE_OOL_MIN:
             # Buffer-less values (every small task arg/result) need no
             # offset fix-point: one header pack instead of two — this
             # runs on EVERY control-plane message, visible at benchmark
@@ -281,31 +295,60 @@ class SerializedObject:
     def _layout(self):
         offsets: List[int] = []
         lens = [len(b) for b in self.buffers]
-        header = msgpack.packb(
-            {"p": self.pickle_bytes, "o": [], "l": lens}, use_bin_type=True
-        )
+        # Large pickle streams (a big bytes/str value pickles INLINE) go
+        # out-of-line like a buffer ("po"/"pl" offsets) instead of riding
+        # inside the msgpack header as a bin: packing copies the bin into
+        # the header and unpacking copies it back out — a full extra copy
+        # each way at exactly the sizes where it hurts (measured ~0.25 s
+        # per side for a 256 MB blob).
+        big = self.raw or len(self.pickle_bytes) >= _PICKLE_OOL_MIN
+        probe = {"o": [], "l": lens}
+        if self.raw:
+            probe["rb"] = 1
+        if big:
+            probe["po"] = 0
+            probe["pl"] = len(self.pickle_bytes)
+        else:
+            probe["p"] = self.pickle_bytes
+        header = msgpack.packb(probe, use_bin_type=True)
         # Offsets depend on header length; header length depends on offsets'
         # encoded size. Fix-point in two passes (offset ints encode stably the
-        # second time because we pad the data start to alignment).
-        pos = _align(4 + len(header) + 16 * len(lens))
+        # second time because we pad the data start to alignment and reserve
+        # 16 bytes of int-growth slack per slot).
+        pos = _align(4 + len(header) + 16 * (len(lens) + (1 if big else 0)))
+        po = None
+        if big:
+            po = pos
+            pos = _align(pos + len(self.pickle_bytes))
         for ln in lens:
             offsets.append(pos)
             pos = _align(pos + ln)
-        header = msgpack.packb(
-            {"p": self.pickle_bytes, "o": offsets, "l": lens},
-            use_bin_type=True
-        )
-        if 4 + len(header) > offsets[0] if offsets else False:
+        final = {"o": offsets, "l": lens}
+        if self.raw:
+            final["rb"] = 1
+        if big:
+            final["po"] = po
+            final["pl"] = len(self.pickle_bytes)
+        else:
+            final["p"] = self.pickle_bytes
+        header = msgpack.packb(final, use_bin_type=True)
+        first_slot = po if po is not None else (offsets[0] if offsets
+                                                else None)
+        if first_slot is not None and 4 + len(header) > first_slot:
             raise RuntimeError("serialization header overflow")
         self._header = header
         self._offsets = offsets
-        self._total = pos
+        self._po = po
+        self._total = pos if (big or offsets) else 4 + len(header)
 
     def write_into(self, buf: memoryview):
         if self._header is None:
             self._layout()
         buf[:4] = _U32.pack(len(self._header))
         buf[4 : 4 + len(self._header)] = self._header
+        if self._po is not None:
+            buf[self._po : self._po + len(self.pickle_bytes)] = \
+                self.pickle_bytes
         for off, b in zip(self._offsets, self.buffers):
             buf[off : off + len(b)] = b
 
@@ -325,6 +368,11 @@ def serialize(value: Any) -> SerializedObject:
     # record names its module, so a ``__main__`` marker in the bytes means
     # the value needs cloudpickle's by-value treatment. False positives
     # (the literal string in user data) just take the slow path.
+    if type(value) is bytes and len(value) >= _PICKLE_OOL_MIN:
+        # Large raw blob: store verbatim — pickling a big bytes value
+        # copies it twice (dumps + the __main__ marker scan) and loads
+        # copies it again, all for an identity transform.
+        return SerializedObject(value, [], raw=True)
     buffers: List[pickle.PickleBuffer] = []
     prev = getattr(_REDUCE_LEDGER, "lst", None)
     _REDUCE_LEDGER.lst = undo = []
@@ -401,27 +449,41 @@ def deserialize(data: memoryview, pin=None) -> Any:
         if pin is not None:
             pin()
         return msgpack.unpackb(header["x"], raw=False)
-    if pin is not None and header["o"] and not _HAS_PY_BUFFER_PROTOCOL:
-        # Pre-3.12: copy the out-of-band buffers out of the arena and
-        # release the reader pin immediately.
+    # Out-of-line pickle stream (large values): a zero-copy view into the
+    # data, so the pin must survive until loads() has consumed it —
+    # released in the finally below, never before.
+    po = header.get("po")
+    pk = data[po : po + header["pl"]] if po is not None else header["p"]
+    if header.get("rb"):
+        # Raw bytes blob stored verbatim (no pickle): one memcpy out of
+        # the segment and done.
         try:
-            buffers = [bytes(data[off : off + ln])
-                       for off, ln in zip(header["o"], header["l"])]
+            return bytes(pk)
         finally:
-            pin()
+            if pin is not None:
+                pin()
+    release_after = pin
+    if pin is not None and header["o"] and not _HAS_PY_BUFFER_PROTOCOL:
+        # Pre-3.12: copy the out-of-band buffers out of the arena so the
+        # returned value holds no pin.
+        buffers = [bytes(data[off : off + ln])
+                   for off, ln in zip(header["o"], header["l"])]
     elif pin is not None and header["o"]:
         holder = _Pin(pin)
+        release_after = None  # ownership moved to the value's buffers
         buffers = [
             _PinnedBuffer(data[off : off + ln], holder)
             for off, ln in zip(header["o"], header["l"])
         ]
     else:
-        if pin is not None:
-            pin()  # no out-of-band buffers -> nothing zero-copy to pin
         buffers = [
             data[off : off + ln] for off, ln in zip(header["o"], header["l"])
         ]
-    return pickle.loads(header["p"], buffers=buffers)
+    try:
+        return pickle.loads(pk, buffers=buffers)
+    finally:
+        if release_after is not None:
+            release_after()
 
 
 from .config import config as _cfg, on_config_change as _on_cfg_change
@@ -455,6 +517,15 @@ TRANSPORT_STATS = {
     "direct_lane_args": 0,  # args rode the actor conn out-of-band
     "direct_lane_bytes": 0,
     "shm_args": 0,          # args went through shm create + GCS register
+    # Cooperative broadcast (the P2P chunk plane, _private/broadcast.py):
+    # serve side — SG serves slice the pinned view with no bytes() copy;
+    # a nonzero copy counter means a peer fell back to the legacy path.
+    "bcast_sg_chunks_served": 0,
+    "bcast_copy_chunks_served": 0,
+    "bcast_bytes_served": 0,
+    # pull side — chunk-granular retries and coalesced concurrent gets.
+    "bcast_chunk_retries": 0,
+    "pull_dedup_hits": 0,
 }
 
 

@@ -138,12 +138,16 @@ class NativeStore:
             raise OSError("failed to open native shm store")
         total = self.lib.rtpu_store_total_size(self.handle)
         # Python-side mmap of the same segment for zero-copy memoryviews
-        # (ctypes pointers can't produce safe releasable buffers).
-        fd = os.open(f"/dev/shm{self._name.decode()}", os.O_RDWR)
+        # (ctypes pointers can't produce safe releasable buffers). The fd
+        # stays open: page pre-commit falls back to fallocate() on
+        # kernels without MADV_POPULATE_WRITE (pre-5.14).
+        self._fd = os.open(f"/dev/shm{self._name.decode()}", os.O_RDWR)
         try:
-            self._mmap = mmap.mmap(fd, total)
-        finally:
-            os.close(fd)
+            self._mmap = mmap.mmap(self._fd, total)
+        except BaseException:
+            os.close(self._fd)
+            self._fd = None
+            raise
         self._view = memoryview(self._mmap)
         self._total = total
         # Serializes close() against calls that can legally arrive after
@@ -208,6 +212,34 @@ class NativeStore:
             ctypes.c_size_t(length), ctypes.c_int(advice))
         return rc == 0
 
+    def _commit_range(self, off: int, length: int) -> bool:
+        """Commit tmpfs pages for [off, off+length): POPULATE_WRITE where
+        the kernel has it, else fallocate — an in-kernel batched
+        zero-allocation (~25x cheaper than taking a zero-fill fault per
+        4K page during a bulk write, measured on a 4.x host). Both
+        release the GIL and only ALLOCATE, so running concurrently with
+        writes into the range is safe."""
+        if length <= 0:
+            return True
+        if self._madvise(off, length):
+            return True
+        # Under the close lock: a background commit thread racing close()
+        # could otherwise see the fd closed and REUSED by an unrelated
+        # open, and fallocate would extend that file on disk. tmpfs
+        # fallocate is an in-kernel zero-alloc (ms for hundreds of MB),
+        # so the hold is short.
+        with self._close_lock:
+            fd = self._fd
+            if fd is None:
+                return False
+            try:
+                rc = self._libc.fallocate(
+                    fd, ctypes.c_int(0),
+                    ctypes.c_long(off), ctypes.c_long(length))
+            except Exception:
+                return False
+        return rc == 0
+
     def _ensure_walk(self):
         """Start the committed-region walk on first store use (see
         __init__: never-touching workers must not pay for it)."""
@@ -265,7 +297,11 @@ class NativeStore:
         for off in range(start, nbytes, window):
             # madvise needs no close-lock (unmapped ranges fail with
             # ENOMEM, no fault); the C watermark call does — close() frees
-            # the Handle it dereferences.
+            # the Handle it dereferences. Deliberately NOT the fallocate
+            # fallback: eagerly committing the whole logical capacity on
+            # kernels without MADV_POPULATE_WRITE would turn every
+            # (possibly leaked) session arena into real tmpfs pages —
+            # per-object commits in create() cover the paths that matter.
             if not self.handle:
                 return
             if not self._madvise(off, min(window, nbytes - off)):
@@ -292,13 +328,24 @@ class NativeStore:
                 f"native store out of memory allocating {nbytes} bytes")
         if nbytes >= (1 << 20) and off + nbytes > self._walked:
             # Populate the destination range up front. Cold pages: ~2x
-            # faster than zero-fill faults during the copy. Committed
-            # pages: still ~2x faster than taking shared-memory minor
-            # faults inline (~1us each). Skipped only once this process's
-            # background page-table walk has covered the range.
+            # faster than zero-fill faults during the copy (fallocate
+            # fallback on pre-5.14 kernels: ~25x). Committed pages: still
+            # ~2x faster than taking shared-memory minor faults inline
+            # (~1us each). Skipped only once this process's background
+            # page-table walk has covered the range.
             start = off & ~0xFFF
-            self._madvise(start, min(off - start + nbytes,
-                                     self._total - start))
+            length = min(off - start + nbytes, self._total - start)
+            if nbytes >= (32 << 20):
+                # Big buffers (bulk pulls, checkpoint writes): commit in
+                # the background, overlapping the fill. Safe concurrent
+                # with writes — both commit paths only ALLOCATE pages; a
+                # write racing ahead just takes the ordinary fault for
+                # that page.
+                threading.Thread(target=self._commit_range,
+                                 args=(start, length), daemon=True,
+                                 name="arena-commit").start()
+            else:
+                self._commit_range(start, length)
         return self._view[off:off + nbytes]
 
     def seal(self, object_id: ObjectID):
@@ -383,6 +430,13 @@ class NativeStore:
             if self.handle:
                 self.lib.rtpu_store_close(self.handle)
                 self.handle = None
+            fd = getattr(self, "_fd", None)
+            if fd is not None:
+                self._fd = None
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
     def unlink(self):
         self.lib.rtpu_store_unlink(self._name)

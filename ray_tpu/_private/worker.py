@@ -18,6 +18,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future as SyncFuture
+from concurrent.futures import TimeoutError as SyncTimeoutError
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import protocol, serialization
@@ -358,6 +359,25 @@ def _refresh_flags():
 _on_cfg_change(_refresh_flags)
 
 
+def pull_deadline_s(nbytes: int) -> float:
+    """Whole-pull deadline, scaled by object size: a flat cap either
+    aborts multi-GB pulls on slow links or lets tiny pulls hang for
+    minutes — base covers control latency, the size term covers the
+    transfer at the assumed worst-case bandwidth."""
+    c = _cfg()
+    return c.pull_timeout_base_s + nbytes / max(c.pull_min_bandwidth, 1)
+
+
+def chunk_timeout_s(chunk_bytes: int, window: int) -> float:
+    """Per-chunk reply deadline: a full window of chunks may be queued
+    ahead of the one being awaited, so the budget covers the whole
+    window's bytes at worst-case bandwidth (x4 slack)."""
+    c = _cfg()
+    return max(c.pull_chunk_timeout_floor_s,
+               4.0 * max(window, 1) * chunk_bytes
+               / max(c.pull_min_bandwidth, 1))
+
+
 class _ActorChannel:
     """Per-actor direct connection plus its FIFO submission queue.
 
@@ -412,7 +432,22 @@ class Worker:
         self._ref_lock = threading.Lock()
         self._actor_chans: Dict[ActorID, _ActorChannel] = {}
         self._dead_actors: Dict[ActorID, str] = {}
-        self._peer_conns: Dict[str, protocol.Connection] = {}  # p2p pulls
+        # P2P pull-connection cache: addr -> idle ChunkClients. A client
+        # is checked OUT for the duration of one pull's source stripe
+        # (FIFO reply pairing forbids sharing), checked back in healthy,
+        # and evicted on node-DEAD/DRAINING pushes or when the cache
+        # exceeds ``max_peer_conns``.
+        self._peer_conns: Dict[str, list] = {}
+        # In-progress pulls serveable to peers: oid -> StripedPull engine
+        # (chunk-level holder registration — we serve chunks we already
+        # hold while the rest are still arriving).
+        self._partials: Dict[ObjectID, Any] = {}
+        # Concurrent-get coalescing: oid -> in-flight pull future.
+        self._pull_lock = threading.Lock()
+        self._pull_inflight: Dict[ObjectID, "SlimFuture"] = {}
+        # Where peers can fetch our partial chunks (worker_main sets this
+        # to the worker's listening socket; drivers don't serve).
+        self.serve_addr: Optional[str] = None
         # Outbound message queue: producer threads enqueue, a single loop
         # wakeup drains the burst (write coalescing in protocol.Connection
         # then collapses the burst into one syscall).
@@ -635,9 +670,10 @@ class Worker:
         self._flush_refs()
         if self.gcs is not None:
             await self.gcs.close()
-        for conn in self._peer_conns.values():
-            if not conn.closed:
-                await conn.close()
+        for pool in self._peer_conns.values():
+            for cl in pool:
+                cl.close()
+        self._peer_conns.clear()
         for ch in self._actor_chans.values():
             if ch.conn is not None:
                 await ch.conn.close()
@@ -841,37 +877,132 @@ class Worker:
     def _pull_object(self, object_id: ObjectID):
         """Fetch an object from another node; cache locally.
 
+        Concurrent gets of the same not-yet-local object coalesce behind
+        a single in-flight pull (the reference's PullManager dedups by
+        object id the same way, ``object_manager/pull_manager.h:52``) —
+        without this, racing threads both run the transfer and race
+        ``store.create`` on the same id.
+        """
+        with self._pull_lock:
+            fut = self._pull_inflight.get(object_id)
+            owner = fut is None
+            if owner:
+                fut = self._pull_inflight[object_id] = SlimFuture()
+        if not owner:
+            serialization.TRANSPORT_STATS["pull_dedup_hits"] += 1
+            while True:
+                try:
+                    kind, payload = fut.result(pull_deadline_s(1 << 30))
+                    break
+                except TimeoutError:
+                    with self._pull_lock:
+                        still = self._pull_inflight.get(object_id) is fut
+                    if still:
+                        # Owner still actively pulling. Its own deadlines
+                        # scale with the TRUE object size (ours used a
+                        # 1 GiB guess): keep waiting — racing a duplicate
+                        # pull would collide on store.create, the exact
+                        # race the dedup exists to prevent. The owner
+                        # cannot wedge unboundedly: every path inside
+                        # _pull_object_impl is deadline-bounded and
+                        # always resolves the future.
+                        continue
+                    # Owner finished between our timeout and the check:
+                    # its result is set (or microseconds away).
+                    try:
+                        kind, payload = fut.result(5.0)
+                    except TimeoutError:
+                        kind, payload = None, None
+                    break
+            if kind == "view":
+                view = self.store.get(object_id, payload)
+                if view is not None:
+                    return view
+            elif kind == "bytes":
+                return payload
+            # Sealed copy evicted between pulls (or the owner vanished
+            # without a result): re-enter the dedup gate so exactly one
+            # retrier becomes the registered owner — an unregistered
+            # direct pull here would race a fresh owner on store.create,
+            # the collision this method exists to prevent.
+            return self._pull_object(object_id)
+        try:
+            result = self._pull_object_impl(object_id)
+        except BaseException as e:
+            if owner:
+                with self._pull_lock:
+                    self._pull_inflight.pop(object_id, None)
+                fut.set_exception(e)
+            raise
+        if owner:
+            if isinstance(result, (bytes, bytearray, memoryview)):
+                fut.set_result(("bytes", result))
+            else:
+                fut.set_result(("view", len(result.data)))
+            with self._pull_lock:
+                self._pull_inflight.pop(object_id, None)
+        return result
+
+    def _pull_object_impl(self, object_id: ObjectID):
+        """One actual transfer: striped P2P pull, else the GCS relay.
+
         Client-side half of the reference's object-manager Pull
         (``object_manager/pull_manager.h:52``): locate holders via the
-        GCS object directory, then pull CHUNKS directly from a holder
-        node's agent (peer-to-peer — bulk bytes never transit the head).
-        Falls back to the GCS relay (spilled objects, no serving agent).
-        Returns a store view (zero-copy, pinned) when caching succeeds,
-        else raw bytes.
+        GCS object directory, then stripe CHUNKS across every advertised
+        holder — full holders AND mid-pull partial holders — peer-to-peer
+        (bulk bytes never transit the head). Falls back to the GCS relay
+        (spilled objects, no serving agent). Returns a store view
+        (zero-copy, pinned) when caching succeeds, else raw bytes.
         """
+        nbytes = None
         if not self.client_mode:
             try:
                 loc = self.request_gcs(
-                    {"t": "obj_locate", "oid": object_id.binary()},
-                    timeout=60)
+                    {"t": "obj_locate", "oid": object_id.binary(),
+                     "pull": 1},
+                    timeout=_cfg().pull_timeout_base_s)
             except (ConnectionError, TimeoutError) as e:
                 raise serialization.ObjectLostError(
                     f"locate of {object_id.hex()} failed: {e}")
             if loc.get("ok") and loc.get("data") is not None:
                 return loc["data"]  # inline value
             if loc.get("ok"):
-                for addr in loc.get("addrs", []):
+                nbytes = loc["nbytes"]
+                if loc.get("addrs") or loc.get("partial"):
                     try:
-                        view = self._pull_from_peer(addr, object_id,
-                                                    loc["nbytes"])
+                        view = self._pull_from_peers(loc, object_id, nbytes)
                         if view is not None:
                             return view
-                    except (ConnectionError, OSError,
-                            asyncio.TimeoutError, TimeoutError):
-                        continue
+                    except (ConnectionError, OSError, asyncio.TimeoutError,
+                            TimeoutError, SyncTimeoutError, MemoryError):
+                        # py<3.11: concurrent.futures.TimeoutError (what a
+                        # timed-out cfut.result raises) is NOT the builtin
+                        # — without it a slow striped pull skips the GCS
+                        # relay fallback and surfaces a raw timeout.
+                        # MemoryError: a full local store cannot host the
+                        # striped copy, but the relay below still hands
+                        # the caller raw bytes (its store.create cache is
+                        # best-effort).
+                        pass
+                elif loc.get("pidx") is not None:
+                    # Locate registered us as an active puller but the
+                    # striped path never ran (no serving holders): retire
+                    # the registration so this object's npull doesn't
+                    # count a long-lived worker forever. (The striped
+                    # path retires via _finish_pull; a duplicate done is
+                    # a no-op.)
+                    try:
+                        self.loop.call_soon_threadsafe(
+                            self._send_gcs,
+                            {"t": "obj_progress",
+                             "oid": object_id.binary(), "done": True,
+                             "ok": False})
+                    except RuntimeError:
+                        pass
         try:
             reply = self.request_gcs(
-                {"t": "obj_pull", "oid": object_id.binary()}, timeout=60)
+                {"t": "obj_pull", "oid": object_id.binary()},
+                timeout=pull_deadline_s(nbytes or (64 << 20)))
         except (ConnectionError, TimeoutError) as e:
             raise serialization.ObjectLostError(
                 f"pull of {object_id.hex()} failed: {e}")
@@ -893,18 +1024,73 @@ class Worker:
         return data
 
     _PULL_CHUNK = _cfg().pull_chunk_bytes  # per-fetch bytes (ref: 5 MiB)
-    _PULL_WINDOW = _cfg().pull_window  # outstanding chunk requests
+    _PULL_WINDOW = _cfg().pull_window  # outstanding chunks per source
 
-    def _pull_from_peer(self, addr: str, object_id: ObjectID, nbytes: int):
-        """Chunked direct pull from a holder node's agent into the local
-        store; seal + register so this node becomes a holder too."""
-        buf = self.create_in_store(object_id, nbytes)
-        cfut = asyncio.run_coroutine_threadsafe(
-            self._pull_chunks_async(addr, object_id, nbytes, buf), self.loop)
+    def _pull_from_peers(self, loc: dict, object_id: ObjectID, nbytes: int):
+        """Cooperative striped pull into the local store; seal + register
+        so this node becomes a holder too. Chunks are striped across all
+        advertised holders (full AND mid-pull partial ones), and chunks
+        that land here are immediately serveable to OTHER pullers
+        (chunk-level holder registration via ``obj_progress``) — an
+        N-node broadcast pipelines instead of serializing on the source's
+        egress."""
+        from . import broadcast
+
+        cfg = _cfg()
+        cs = int(loc.get("cs") or self._PULL_CHUNK)
+        oid_b = object_id.binary()
         try:
-            ok = cfut.result(120)
-        except Exception:
-            # The coroutine must be DEAD before the buffer is recycled:
+            buf = self.create_in_store(object_id, nbytes)
+        except BaseException:
+            # The locate(pull=1) that routed us here already registered
+            # this worker as an active puller; retire that registration
+            # before bailing or the object's npull counts a phantom
+            # puller (narrowing every later puller's stripe) until this
+            # process disconnects.
+            try:
+                self.loop.call_soon_threadsafe(
+                    self._send_gcs,
+                    {"t": "obj_progress", "oid": oid_b,
+                     "done": True, "ok": False})
+            except RuntimeError:
+                pass
+            raise
+        exclude = {self.serve_addr} if self.serve_addr else set()
+
+        async def locate():
+            return await self.gcs.request(
+                {"t": "obj_locate", "oid": oid_b, "pull": 1}, timeout=5)
+
+        engine = broadcast.StripedPull(
+            oid_b, nbytes, buf, chunk_bytes=cs, window=self._PULL_WINDOW,
+            max_sources=cfg.pull_max_sources,
+            chunk_timeout_s=chunk_timeout_s(cs, self._PULL_WINDOW),
+            refresh_interval_s=cfg.pull_refresh_interval_s,
+            progress_every=cfg.pull_progress_chunks,
+            locate=locate, conn_factory=self._chunk_conn,
+            conn_release=self._release_chunk_conn, exclude_addrs=exclude,
+            pidx=loc.get("pidx"), npull=int(loc.get("npull") or 1))
+
+        def report(idxs, _e=engine):
+            # Runs on the IO loop (engine context): publish our
+            # chunk-bitmap progress + current sources (the directory's
+            # per-holder load signal).
+            msg = {"t": "obj_progress", "oid": oid_b, "cs": _e.cs,
+                   "nbytes": nbytes, "add": idxs, "srcs": _e.live_addrs()}
+            if self.serve_addr:
+                msg["addr"] = self.serve_addr
+                if self.node_id is not None:
+                    msg["node"] = self.node_id
+            self._send_gcs(msg)
+
+        engine.report = report
+        if self.serve_addr and engine.nchunks > 1:
+            self._partials[object_id] = engine
+        cfut = asyncio.run_coroutine_threadsafe(engine.run(loc), self.loop)
+        try:
+            ok = cfut.result(pull_deadline_s(nbytes))
+        except BaseException:
+            # The engine must be DEAD before the buffer is recycled:
             # aborting while it still writes would corrupt whatever object
             # the arena hands this range to next.
             cfut.cancel()
@@ -912,56 +1098,126 @@ class Worker:
                 cfut.result(10)
             except Exception:
                 pass
-            self.store.abort(object_id)
+            self._finish_pull(object_id, engine, ok=False)
             raise
+        serialization.TRANSPORT_STATS["bcast_chunk_retries"] += engine.retries
         if not ok:
-            self.store.abort(object_id)
+            self._finish_pull(object_id, engine, ok=False)
             return None
+        # Seal BEFORE dropping the partial registration: a peer request
+        # landing in between is served from the sealed store instead of
+        # getting a spurious failure.
         self.store.seal(object_id)
-        self.send_gcs_threadsafe({
-            "t": "obj_put", "oid": object_id.binary(),
-            "nbytes": nbytes, "shm": True})
+        self._finish_pull(object_id, engine, ok=True)
         return self.store.get(object_id, nbytes)
 
-    async def _pull_chunks_async(self, addr: str, object_id: ObjectID,
-                                 nbytes: int, buf) -> bool:
-        conn = self._peer_conns.get(addr)
-        if conn is None or conn.closed:
-            reader, writer = await protocol.connect(addr)
-            conn = protocol.Connection(reader, writer)
-            conn.start()
-            self._peer_conns[addr] = conn
-        offs = list(range(0, nbytes, self._PULL_CHUNK))
-        pending: Dict[int, asyncio.Future] = {}
-        i = 0
+    def _finish_pull(self, object_id: ObjectID, engine, ok: bool):
+        """Terminal bookkeeping for a striped pull: directory updates
+        (holder registration + partial-entry retirement, FIFO-ordered on
+        the GCS conn so there is no holderless window) and, on failure, a
+        serve-drain-guarded abort (recycling the buffer while a chunk
+        serve still aliases it would corrupt the next object)."""
+        self._partials.pop(object_id, None)
+        oid_b = object_id.binary()
+
+        def _send():
+            if ok:
+                self._send_gcs({"t": "obj_put", "oid": oid_b,
+                                "nbytes": engine.nbytes, "shm": True})
+            msg = {"t": "obj_progress", "oid": oid_b, "done": True,
+                   "ok": ok, "src_bytes": engine.src_bytes}
+            if self.serve_addr:
+                msg["addr"] = self.serve_addr
+            self._send_gcs(msg)
+
         try:
-            while i < len(offs) or pending:
-                while i < len(offs) and len(pending) < self._PULL_WINDOW:
-                    off = offs[i]
-                    pending[off] = conn.request_nowait({
-                        "t": "obj_fetch", "oid": object_id.binary(),
-                        "off": off,
-                        "len": min(self._PULL_CHUNK, nbytes - off),
-                        "nbytes": nbytes})
-                    i += 1
-                done_off = next(iter(pending))
-                reply = await asyncio.wait_for(pending.pop(done_off), 60)
-                if not reply.get("ok"):
-                    return False
-                data = reply["data"]
-                want = min(self._PULL_CHUNK, nbytes - done_off)
-                if len(data) != want or reply.get("total") != nbytes:
-                    # Holder's copy disagrees with the directory (racing
-                    # re-put, stale rescan): sealing a short read would
-                    # spread a corrupt copy cluster-wide.
-                    return False
-                buf[done_off:done_off + len(data)] = data
-        except (ConnectionError, OSError):
-            stale = self._peer_conns.pop(addr, None)
-            if stale is not None and not stale.closed:
-                self.loop.create_task(stale.close())
-            raise
-        return True
+            self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+        if not ok:
+            # Recycle only after the engine refuses new serves AND every
+            # in-flight serve released its view (close_for_serve takes the
+            # serve lock, so there is no window where a serve slips past
+            # the gate onto a recycled range). Bounded wait: a peer wedged
+            # mid-sendall must not hang the failure path — skipping the
+            # abort then leaks one arena range instead of corrupting
+            # whatever object the range is handed to next.
+            drained = threading.Event()
+            engine.close_for_serve(drained.set)
+            if drained.wait(10):
+                self.store.abort(object_id)
+
+    # ------------------------------------------------ chunk serving (P2P)
+
+    def resolve_obj_fetch(self, msg: dict):
+        """Resolve an obj_fetch to ``(view, miss)`` — from an IN-PROGRESS
+        pull's landed chunks (chunk-level relay) or from the sealed local
+        store. Thread-safe: called by the dedicated serve threads."""
+        oid = ObjectID(bytes(msg["oid"]))
+        engine = self._partials.get(oid)
+        if engine is not None:
+            view = engine.serve_view(int(msg.get("off", 0)),
+                                     int(msg.get("len", 0)))
+            return view, view is None
+        view = (self.store.get(oid, msg.get("nbytes", 0))
+                if self.store is not None else None)
+        return view, False
+
+    def handle_obj_fetch(self, conn, msg: dict):
+        """Framed-connection serve fallback (UDS direct socket). Runs
+        synchronously on the IO loop so replies stay FIFO per connection
+        (the ChunkClient read side relies on it)."""
+        from . import broadcast
+
+        if not getattr(conn, "_obj_serve_widened", False):
+            conn._obj_serve_widened = True
+            protocol.widen_for_serving(conn)
+        view, miss = self.resolve_obj_fetch(msg)
+        broadcast.serve_obj_fetch(conn, msg, view, miss=miss,
+                                  stats=serialization.TRANSPORT_STATS)
+
+    # ------------------------------------------- pull-connection caching
+
+    async def _chunk_conn(self, addr: str):
+        """Check out a pull connection for ``addr`` (reuse an idle cached
+        one, else dial). Loop-only; a checked-out client is exclusive to
+        one source stripe (FIFO reply pairing forbids sharing)."""
+        from . import broadcast
+
+        pool = self._peer_conns.get(addr)
+        while pool:
+            cl = pool.pop()
+            if not pool:
+                self._peer_conns.pop(addr, None)
+            if not cl.closed:
+                return cl
+        return await broadcast.ChunkClient.connect(addr)
+
+    def _release_chunk_conn(self, addr: str, client, healthy: bool):
+        if not healthy or client.closed:
+            client.close()
+            return
+        self._peer_conns.setdefault(addr, []).append(client)
+        self._cap_peer_conns()
+
+    def _cap_peer_conns(self):
+        cap = max(1, _cfg().max_peer_conns)
+        total = sum(len(v) for v in self._peer_conns.values())
+        while total > cap and self._peer_conns:
+            addr = next(iter(self._peer_conns))
+            pool = self._peer_conns[addr]
+            pool.pop(0).close()
+            if not pool:
+                del self._peer_conns[addr]
+            total -= 1
+
+    def _evict_peer_addrs(self, addrs):
+        """Drop cached pull connections to nodes the control plane says
+        are DEAD or DRAINING (PR 1 lifecycle events): without this, dead
+        peers leave closed-socket entries in the cache forever."""
+        for addr in addrs or ():
+            for cl in self._peer_conns.pop(addr, []):
+                cl.close()
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         futs = [self.object_future(r.id) for r in refs]
@@ -1209,6 +1465,10 @@ class Worker:
                                          "data": bytes(view.data)})
                 finally:
                     view.close()
+        elif t == "node_addrs_gone":
+            # Node lifecycle push (DEAD/DRAINING): retire cached pull
+            # connections to its serve addresses.
+            self._evict_peer_addrs(msg.get("addrs"))
         elif t == "actor_dead":
             aid = ActorID(msg["aid"])
             self._dead_actors[aid] = msg.get("cause", "actor died")

@@ -208,6 +208,11 @@ class Executor:
                     if not target.closed:
                         await target.close()
             conn.reply(msg, {"ok": True})
+        elif t == "obj_fetch":
+            # Chunk-level broadcast relay: serve landed chunks of an
+            # in-progress pull (or a sealed local object) to peer
+            # pullers. Synchronous — replies must stay FIFO per conn.
+            self.worker.handle_obj_fetch(conn, msg)
         elif t == "ping":
             conn.reply(msg, {"ok": True})
 
@@ -1001,6 +1006,27 @@ async def amain(args):
     worker.handle_control = handle_control
     await executor.start()
 
+    # Dedicated TCP chunk-serve socket on its OWN thread + loop: peers
+    # fetch this worker's landed chunks mid-pull (chunk-level broadcast
+    # relay) and its sealed local objects here. TCP rather than the UDS
+    # direct-call socket (per-process UDS throughput is a fraction of
+    # loopback TCP on sandboxed kernels, and TCP stays reachable
+    # cross-host); a separate thread so serve memcpys never steal cycles
+    # from this worker's recv stripe or actor traffic.
+    from . import broadcast
+    from .node import get_node_ip_address
+
+    from .serialization import TRANSPORT_STATS
+
+    serve_host = ("127.0.0.1" if args.gcs.startswith("unix:")
+                  else get_node_ip_address())
+    serve_addr, _serve_sock = broadcast.start_serve_thread(
+        serve_host, worker.resolve_obj_fetch, name="worker-obj-serve",
+        stats=TRANSPORT_STATS)
+    # Fallback: serve on the direct socket (the obj_fetch branch in
+    # _on_direct_msg) when TCP binding failed.
+    worker.serve_addr = serve_addr or ("unix:" + listen_path)
+
     # Loop-lag instrumentation on the worker's IO loop (the GCS has had
     # this since the drain PR): a sync call stalling an async actor's
     # loop shows up as lag here — the runtime corroboration of the
@@ -1044,6 +1070,7 @@ async def amain(args):
             "worker_id": worker.worker_id.binary(),
             "node_id": worker.node_id,
             "addr": "unix:" + listen_path,
+            "obj_addr": worker.serve_addr,
             "pid": os.getpid(),
             # Which interpreter-env pool this worker belongs to ("" =
             # base image; otherwise a pip/uv venv key set at spawn).

@@ -167,11 +167,18 @@ class LearnerGroup:
         return self.learners[0].get_weights.remote()
 
     def sync_weights(self):
-        """Learner 0's weights to all learners (after divergence)."""
+        """Learner 0's weights to all learners (after divergence).
+
+        The weights ride as ONE object ref resolved on each receiving
+        worker (cooperative chunk-striped broadcast) — materializing them
+        on the driver and re-shipping a copy per learner made the driver
+        the bandwidth bottleneck at exactly the weight sizes where it
+        hurts."""
         if self.num_learners <= 1:
             return
-        w = ray_tpu.get(self.learners[0].get_weights.remote())
-        ray_tpu.get([l.set_weights.remote(w) for l in self.learners[1:]])
+        wref = self.learners[0].get_weights.remote()
+        ray_tpu.get([l.set_weights.remote(wref)
+                     for l in self.learners[1:]])
 
     def shutdown(self):
         for l in self.learners:

@@ -42,6 +42,11 @@ class ReduceOp:
     MAX = "max"
 
 
+# Broadcast payloads at least this large ride the object store as ONE
+# shared object (cooperative chunk-striped pull) instead of being copied
+# into every rank's rendezvous reply.
+_BCAST_REF_MIN = 1 << 20
+
 _REDUCERS = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
     ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
@@ -86,7 +91,15 @@ class _Coordinator:
                 red = _REDUCERS[op](np.stack([np.asarray(p) for p in parts]))
                 st["result"] = np.array_split(red, self.world)
             elif kind == "broadcast":
-                st["result"] = np.asarray(st["parts"][src_rank])
+                arr = np.asarray(st["parts"][src_rank])
+                if arr.nbytes >= _BCAST_REF_MIN and self.world > 1:
+                    # Large broadcast: put ONCE and hand every rank the
+                    # same ref — ranks pull the single object over the
+                    # cooperative chunk-striped broadcast plane instead
+                    # of each reply re-serializing the full payload.
+                    st["result"] = ray_tpu.put(arr)
+                else:
+                    st["result"] = arr
             elif kind == "barrier":
                 st["result"] = True
             st["event"].set()
@@ -207,8 +220,17 @@ def _g(group_name: str) -> _GroupState:
 def _rendezvous(kind: str, tensor, group_name: str, **kw):
     st = _g(group_name)
     seq = st.next_seq(kind)
-    return ray_tpu.get(st.coordinator.collect.remote(
+    out = ray_tpu.get(st.coordinator.collect.remote(
         kind, seq, st.rank, tensor, **kw), timeout=300)
+    if isinstance(out, ray_tpu.ObjectRef):
+        # Large-broadcast result: one shared object, pulled per node over
+        # the cooperative broadcast plane. Copy out of the store view:
+        # get() hands every same-node rank zero-copy views over the SAME
+        # arena range, and broadcast() has always returned a private
+        # mutable array per rank — in-place updates must not corrupt the
+        # shared object (or trip read-only views) for the other ranks.
+        out = np.array(ray_tpu.get(out, timeout=300), copy=True)
+    return out
 
 
 def allreduce(tensor, group_name: str = "default",

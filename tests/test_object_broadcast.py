@@ -1,0 +1,584 @@
+"""Cooperative pipelined object broadcast (``_private/broadcast.py``).
+
+Covers the chunk plane end to end: zero-copy serve (scatter-gather frames
+sliced straight from the pinned view — buffer identity + counters), the
+raw blocking-socket serve loop, the multi-source striped pull engine
+(striping, chunk-granular failover when a holder dies mid-serve, legacy
+copy replies), size-scaled pull deadlines, the peer-connection cache cap,
+and — on a real multi-"node" cluster — chunk-level relay (non-source
+holders carry traffic, proven by the GCS transfer accounting), concurrent
+same-object get coalescing, and holder-death failover.
+"""
+
+import asyncio
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import broadcast, protocol, serialization
+from ray_tpu._private.worker import chunk_timeout_s, pull_deadline_s
+from ray_tpu.cluster_utils import Cluster
+
+# --------------------------------------------------------------- unit: maps
+
+
+def test_bitmap_helpers():
+    bm = broadcast.bitmap_make(19)
+    assert len(bm) == 3
+    for i in (0, 7, 8, 18):
+        assert not broadcast.bitmap_test(bm, i)
+        broadcast.bitmap_set(bm, i)
+        assert broadcast.bitmap_test(bm, i)
+    broadcast.bitmap_clear(bm, 8)
+    assert not broadcast.bitmap_test(bm, 8)
+    assert broadcast.bitmap_test(bm, 7) and broadcast.bitmap_test(bm, 18)
+
+
+# ------------------------------------------------------- unit: serve side
+
+
+class _StubConn:
+    """Captures reply() calls; invokes release like the transport would."""
+
+    def __init__(self):
+        self.sent = []
+
+    def reply(self, req, msg, buffers=None, release=None):
+        self.sent.append((dict(msg), buffers))
+        if release is not None:
+            release()
+
+
+def test_serve_obj_fetch_sg_zero_copy():
+    """SG serves slice the view — no bytes() copy (buffer identity), and
+    the pin releases only via the transport-handoff callback."""
+    base = bytearray(range(256)) * 64  # 16KB
+    closed = []
+    view = broadcast.ServeView(memoryview(base), lambda: closed.append(1))
+    conn = _StubConn()
+    stats = {k: 0 for k in serialization.TRANSPORT_STATS}
+    msg = {"t": "obj_fetch", "i": 7, "off": 4096, "len": 8192, "sg": 1}
+    broadcast.serve_obj_fetch(conn, msg, view, stats=stats)
+    (reply, buffers), = conn.sent
+    assert reply["ok"] and reply["total"] == len(base)
+    assert reply["off"] == 4096
+    assert len(buffers) == 1 and isinstance(buffers[0], memoryview)
+    # Buffer identity: the shipped buffer aliases the SOURCE buffer.
+    assert buffers[0].obj is base
+    assert bytes(buffers[0]) == bytes(base[4096:4096 + 8192])
+    assert closed == [1]  # pin released exactly once, by the handoff
+    assert stats["bcast_sg_chunks_served"] == 1
+    assert stats["bcast_copy_chunks_served"] == 0
+    assert stats["bcast_bytes_served"] == 8192
+
+
+def test_serve_obj_fetch_bounds_and_miss():
+    conn = _StubConn()
+    broadcast.serve_obj_fetch(conn, {"i": 1}, None, miss=True)
+    assert conn.sent[-1][0] == {"ok": False, "miss": True}
+    broadcast.serve_obj_fetch(conn, {"i": 2}, None)
+    assert conn.sent[-1][0] == {"ok": False}
+    closed = []
+    view = broadcast.ServeView(memoryview(b"abc"), lambda: closed.append(1))
+    broadcast.serve_obj_fetch(conn, {"i": 3, "off": 1, "len": 16, "sg": 1},
+                              view)
+    assert conn.sent[-1][0] == {"ok": False}
+    assert closed == [1]  # out-of-bounds still releases the pin
+
+
+def test_raw_serve_thread_round_trip():
+    """The blocking-socket serve loop speaks the same wire format the
+    ChunkClient reads — payload received straight into the destination."""
+    blob = bytearray(os.urandom(1 << 20))
+
+    def resolve(msg):
+        return broadcast.ServeView(memoryview(blob)), False
+
+    stats = {k: 0 for k in serialization.TRANSPORT_STATS}
+    addr, srv = broadcast.start_serve_thread("127.0.0.1", resolve,
+                                             stats=stats)
+    assert addr is not None
+
+    async def main():
+        client = await broadcast.ChunkClient.connect(addr)
+        dst = bytearray(1 << 20)
+        for i, (off, ln) in enumerate([(0, 256 << 10), (256 << 10, 768 << 10)]):
+            await client.send({"t": "obj_fetch", "oid": b"x" * 20,
+                               "off": off, "len": ln,
+                               "nbytes": len(blob), "sg": 1, "i": i + 1})
+            view = memoryview(dst)[off:off + ln]
+            hdr, wrote = await client.read_reply(lambda h, v=view: v)
+            assert hdr["ok"] and wrote == ln and hdr["total"] == len(blob)
+        client.close()
+        return dst
+
+    dst = asyncio.run(asyncio.wait_for(main(), 30))
+    assert dst == blob
+    assert stats["bcast_sg_chunks_served"] == 2
+    assert stats["bcast_copy_chunks_served"] == 0
+    srv.close()
+
+
+# ---------------------------------------------------- unit: striped pull
+
+
+async def _framed_blob_server(blob, *, die_after=None, legacy=False):
+    """A holder speaking the framed protocol (the UDS-fallback serve
+    path). ``die_after``: close the connection after N chunk serves —
+    the mid-serve holder death the failover test injects. ``legacy``:
+    reply with copied msgpack-bin chunks (no SG)."""
+    served = {"n": 0}
+
+    async def on_client(reader, writer):
+        conn = protocol.Connection(reader, writer)
+        protocol.widen_for_serving(conn)
+
+        async def handler(msg, conn=conn):
+            if msg.get("t") != "obj_fetch":
+                return
+            if die_after is not None and served["n"] >= die_after:
+                await conn.close()
+                return
+            served["n"] += 1
+            if legacy:
+                msg.pop("sg", None)
+            broadcast.serve_obj_fetch(
+                conn, msg, broadcast.ServeView(memoryview(blob)))
+
+        conn._handler = handler
+        conn.start()
+
+    server = await protocol.serve("127.0.0.1:0", on_client)
+    port = server.sockets[0].getsockname()[1]
+    return server, f"127.0.0.1:{port}", served
+
+
+def test_striped_pull_multi_source():
+    blob = bytearray(os.urandom(4 << 20))
+    cs = 128 * 1024
+
+    async def main():
+        s1, a1, n1 = await _framed_blob_server(blob)
+        s2, a2, n2 = await _framed_blob_server(blob)
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=cs,
+            window=4, chunk_timeout_s=20)
+        ok = await asyncio.wait_for(eng.run({"addrs": [a1, a2]}), 60)
+        s1.close()
+        s2.close()
+        return ok, dst, n1["n"], n2["n"], dict(eng.src_bytes)
+
+    ok, dst, c1, c2, src_bytes = asyncio.run(main())
+    assert ok and dst == blob
+    # Both sources actually carried chunks (striping, not failover).
+    assert c1 > 0 and c2 > 0
+    assert src_bytes and sum(src_bytes.values()) == len(blob)
+
+
+def test_stripe_ownership_restricts_full_holder_claims():
+    """With npull concurrent pullers, a FULL holder's claims stop at
+    ~1/npull of the ring (+ margin): the rest is deliberately left for
+    relays. The idle-stall valve widens the stripe when nothing lands."""
+    cs = 64 * 1024
+    nchunks = 30
+    dst = bytearray(nchunks * cs)
+    eng = broadcast.StripedPull(
+        b"o" * 20, len(dst), memoryview(dst), chunk_bytes=cs,
+        window=4, pidx=0, npull=3)
+    src = broadcast._Source("a", None)
+    eng.sources["a"] = src
+    claimed = []
+    while True:
+        i = eng._claim(src)
+        if i is None:
+            break
+        claimed.append(i)
+    width = (nchunks + 2) // 3 + 2  # ceil(n/npull) + max(2, window//2)
+    assert len(claimed) == width
+    assert claimed == eng.order[:width]
+    # Stall with no progress -> the valve widens the stripe by a window.
+    eng._note_idle(src)           # arms the stall timer
+    eng._idle_t0 -= 1.0           # pretend 1s passed with ndone frozen
+    eng._note_idle(src)
+    assert eng._relax == 4
+    more = eng._claim(src)
+    assert more is not None and more == eng.order[width]
+
+
+def test_stripe_stagger_distinct_offsets():
+    """Directory-assigned ordinals stagger pullers' chunk rings apart
+    (golden-ratio offsets), so simultaneous pullers pull disjoint early
+    stripes instead of racing the same region off the source."""
+    cs = 64 * 1024
+    dst = bytearray(64 * cs)
+    starts = set()
+    for pidx in range(4):
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(dst), memoryview(dst), chunk_bytes=cs,
+            window=4, pidx=pidx, npull=4)
+        starts.add(eng.order[0])
+    assert len(starts) == 4
+    gaps = sorted(starts) + [64 + min(starts)]
+    assert min(b - a for a, b in zip(gaps, gaps[1:])) >= 64 // 8
+
+
+def test_stripe_holdback_relaxes_to_completion():
+    """A pull whose directory claims npull=4 but where NO relay ever
+    advertises (peers died / no serve addrs) still completes off the one
+    full holder: the hold-back is policy, not a liveness hazard."""
+    blob = bytearray(os.urandom(2 << 20))
+
+    async def main():
+        s, a, n = await _framed_blob_server(blob)
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=128 * 1024,
+            window=2, chunk_timeout_s=20, pidx=1, npull=4)
+        ok = await asyncio.wait_for(eng.run({"addrs": [a]}), 60)
+        s.close()
+        return ok, dst, eng._relax
+
+    ok, dst, relax = asyncio.run(main())
+    assert ok and dst == blob
+    assert relax > 0  # the valve actually fired
+
+
+def test_striped_pull_legacy_copy_reply():
+    blob = bytearray(os.urandom(512 * 1024))
+
+    async def main():
+        s, a, _ = await _framed_blob_server(blob, legacy=True)
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=128 * 1024,
+            window=2, chunk_timeout_s=20)
+        ok = await asyncio.wait_for(eng.run({"addrs": [a]}), 60)
+        s.close()
+        return ok, dst
+
+    ok, dst = asyncio.run(main())
+    assert ok and dst == blob
+
+
+def test_chunk_failover_holder_death_mid_serve():
+    """Chaos: one holder dies after 3 chunk serves. The pull completes at
+    CHUNK granularity off the surviving holder — no object restart (total
+    fetch attempts stay far below two full passes)."""
+    blob = bytearray(os.urandom(4 << 20))
+    cs = 128 * 1024
+    nchunks = len(blob) // cs
+
+    async def main():
+        s_dying, a_dying, n_dying = await _framed_blob_server(blob,
+                                                              die_after=3)
+        s_ok, a_ok, n_ok = await _framed_blob_server(blob)
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=cs,
+            window=4, chunk_timeout_s=20)
+        ok = await asyncio.wait_for(eng.run({"addrs": [a_dying, a_ok]}), 60)
+        s_dying.close()
+        s_ok.close()
+        return ok, dst, eng, n_dying["n"], n_ok["n"]
+
+    ok, dst, eng, died_served, ok_served = asyncio.run(main())
+    assert ok and dst == blob
+    assert died_served == 3  # the dying holder really served mid-broadcast
+    assert ok_served >= nchunks - 3  # survivor covered the rest
+    assert eng.retries >= 1  # chunks re-claimed, not object restarted
+    assert eng.fetches <= 2 * nchunks
+
+
+def test_striped_pull_all_sources_dead_fails():
+    async def main():
+        dst = bytearray(256 * 1024)
+        # Nothing listens on this port: connect fails, no locate to
+        # discover replacements -> the pull must fail, not hang.
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(dst), memoryview(dst), chunk_bytes=64 * 1024,
+            window=2, chunk_timeout_s=5)
+        return await asyncio.wait_for(eng.run({"addrs": ["127.0.0.1:1"]}),
+                                      30)
+
+    assert asyncio.run(main()) is False
+
+
+# ------------------------------------------- unit: deadlines + conn cache
+
+
+def test_pull_deadlines_scale_with_size():
+    from ray_tpu._private.config import config as cfg
+
+    base = pull_deadline_s(0)
+    assert base == pytest.approx(cfg().pull_timeout_base_s)
+    one_gb = pull_deadline_s(1 << 30)
+    assert one_gb > base + 30  # a 1GB pull gets a real transfer budget
+    assert pull_deadline_s(1 << 20) < one_gb  # monotonic in size
+    # chunk deadline: floored for tiny chunks, scales for big windows
+    assert chunk_timeout_s(4096, 4) == cfg().pull_chunk_timeout_floor_s
+    assert chunk_timeout_s(64 << 20, 8) > chunk_timeout_s(4 << 20, 8)
+
+
+class _FakeClient:
+    def __init__(self):
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def test_peer_conn_cache_cap_and_eviction():
+    from ray_tpu._private.worker import Worker
+
+    w = Worker.__new__(Worker)  # no cluster: just the cache fields
+    w._peer_conns = {}
+    cap = __import__("ray_tpu._private.config",
+                     fromlist=["config"]).config().max_peer_conns
+    clients = []
+    for i in range(cap + 5):
+        cl = _FakeClient()
+        clients.append(cl)
+        Worker._release_chunk_conn(w, f"addr{i}", cl, True)
+    total = sum(len(v) for v in w._peer_conns.values())
+    assert total == cap  # cache bounded
+    assert sum(1 for c in clients if c.closed) == 5  # overflow closed
+    # Lifecycle eviction (node DEAD/DRAINING push)
+    keep = next(iter(w._peer_conns))
+    Worker._evict_peer_addrs(w, [keep])
+    assert keep not in w._peer_conns
+
+
+# ------------------------------------------------------------ cluster tests
+
+
+@pytest.fixture(scope="module")
+def bcast_cluster():
+    overrides = {
+        "RAY_TPU_PULL_CHUNK_BYTES": str(256 * 1024),
+        "RAY_TPU_PULL_PROGRESS_CHUNKS": "2",
+        "RAY_TPU_PULL_REFRESH_INTERVAL_S": "0.02",
+        "RAY_TPU_PULL_CHUNK_TIMEOUT_FLOOR_S": "5",
+    }
+    old = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    from ray_tpu._private.config import reset_config
+
+    reset_config()
+    c = Cluster(connect=True)
+    for i in range(3):
+        c.add_node(num_cpus=1, resources={f"b{i}": 4})
+    assert c.wait_for_nodes(4, timeout=120)
+    assert c.wait_for_workers(timeout=120)
+    yield c
+    c.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    reset_config()
+
+
+@ray_tpu.remote
+def _fetch_len(wrapped):
+    import os as _os
+
+    blob = ray_tpu.get(wrapped[0])
+    stats = serialization.transport_stats()
+    return (_os.environ.get("RAY_TPU_STORE_SUFFIX", "head"), len(blob),
+            stats)
+
+
+def _xfer_stats():
+    from ray_tpu._private.worker import global_worker
+
+    reply = global_worker().request_gcs({"t": "obj_xfer_stats"}, timeout=10)
+    assert reply.get("ok")
+    return reply["served"]
+
+
+def test_broadcast_chunk_relay(bcast_cluster):
+    """Concurrent pullers relay chunks to each other mid-pull: non-source
+    holders serve >0 bytes (GCS transfer accounting), and the serve path
+    is the SG one (no per-chunk copy counters)."""
+    payload = np.random.RandomState(7).bytes(24 << 20)
+    opts = [dict(resources={f"b{i}": 1}) for i in range(3)]
+    # Warm leases + serve sockets.
+    small = ray_tpu.put(b"x")
+    ray_tpu.get([_fetch_len.options(**o).remote([small]) for o in opts],
+                timeout=60)
+    relayed = 0
+    for _ in range(3):  # relay is timing-dependent: allow a retry
+        ref = ray_tpu.put(payload)
+        outs = ray_tpu.get(
+            [_fetch_len.options(**o).remote([ref]) for o in opts],
+            timeout=120)
+        assert all(n == len(payload) for _, n, _ in outs)
+        served = _xfer_stats()
+        # every puller pulled the full payload from SOMEWHERE
+        assert sum(r[2] for r in served) >= 3 * len(payload)
+        relayed = sum(r[2] for r in served if r[1] not in ("", None))
+        sg = sum(st["bcast_sg_chunks_served"] for _, _, st in outs)
+        copies = sum(st["bcast_copy_chunks_served"] for _, _, st in outs)
+        assert copies == 0  # serve side never fell back to bytes() copies
+        if relayed > 0 and sg > 0:
+            break
+        del ref
+    assert relayed > 0, "non-source holders served nothing across 3 runs"
+
+
+@ray_tpu.remote
+def _dedup_probe(wrapped):
+    import threading as _th
+
+    from ray_tpu._private import serialization as _ser
+    from ray_tpu._private import worker as _wmod
+
+    ref = wrapped[0]
+    _ser.TRANSPORT_STATS["pull_dedup_hits"] = 0
+    calls = []
+    orig = _wmod.Worker._pull_object_impl
+
+    def counted(self, oid, _orig=orig):
+        calls.append(1)
+        return _orig(self, oid)
+
+    _wmod.Worker._pull_object_impl = counted
+    try:
+        outs = []
+        errs = []
+
+        def one():
+            try:
+                outs.append(len(ray_tpu.get(ref)))
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [_th.Thread(target=one) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+    finally:
+        _wmod.Worker._pull_object_impl = orig
+    return (len(calls), _ser.TRANSPORT_STATS["pull_dedup_hits"], outs, errs)
+
+
+def test_concurrent_get_dedup(bcast_cluster):
+    """Two+ threads getting the same not-yet-local object coalesce into
+    ONE transfer (no store.create race, no duplicate pulls)."""
+    payload = np.random.RandomState(11).bytes(8 << 20)
+    ref = ray_tpu.put(payload)
+    n_impl, hits, outs, errs = ray_tpu.get(
+        _dedup_probe.options(resources={"b1": 1}).remote([ref]),
+        timeout=120)
+    assert errs == []
+    assert outs == [len(payload)] * 4
+    assert n_impl == 1, f"expected one coalesced pull, saw {n_impl}"
+    assert hits == 3
+
+
+def test_cluster_holder_death_failover(bcast_cluster):
+    """Kill a holder node's agent mid-broadcast: pulls complete off the
+    remaining holders (chunk-granular failover / source stripping), and
+    the dead node's serve addresses are evicted from peer caches."""
+    c = bcast_cluster
+    payload = np.random.RandomState(13).bytes(48 << 20)
+    ref = ray_tpu.put(payload)
+    # Seed a SECOND full holder: node b0 pulls + seals the object.
+    out = ray_tpu.get(
+        _fetch_len.options(resources={"b0": 1}).remote([ref]), timeout=120)
+    assert out[1] == len(payload)
+    # Now broadcast to b1/b2 while killing b0 shortly after the start —
+    # whether the kill lands mid-pull or not, the fetches must complete
+    # with intact payloads.
+    futs = [_fetch_len.options(resources={f"b{i}": 1}).remote([ref])
+            for i in (1, 2)]
+    time.sleep(0.05)
+    c.worker_nodes[0].kill()
+    outs = ray_tpu.get(futs, timeout=180)
+    assert all(n == len(payload) for _, n, _ in outs)
+
+
+def test_peer_conns_evicted_on_drain(bcast_cluster):
+    """DRAINING lifecycle push retires cached pull connections."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    payload = np.random.RandomState(17).bytes(4 << 20)
+    # Produce an object whose only holder is node b2, then pull it to the
+    # driver so the driver caches chunk connections to b2's endpoints.
+    made = ray_tpu.get(
+        _make_remote_blob.options(resources={"b2": 1}).remote(payload),
+        timeout=60)
+    blob = ray_tpu.get(made[0], timeout=60)[0]
+    assert len(blob) == len(payload)
+    assert w._peer_conns, "driver cached no pull connections"
+    before = set(w._peer_conns)
+    # Drain b2: the GCS pushes node_addrs_gone for its serve addrs.
+    reply = w.request_gcs({"t": "drain_node",
+                           "node_id": made[1],
+                           "reason": "test", "deadline_s": 30},
+                          timeout=30)
+    assert reply.get("ok")
+    deadline = time.time() + 15
+    while time.time() < deadline and set(w._peer_conns) & before:
+        time.sleep(0.1)
+    assert not (set(w._peer_conns) & before), \
+        "drained node's pull connections were not evicted"
+
+
+@ray_tpu.remote
+def _make_remote_blob(payload):
+    import os as _os
+
+    from ray_tpu._private.worker import global_worker
+
+    ref = ray_tpu.put(bytes(payload))
+    return [ref], global_worker().node_id
+
+
+def test_pull_registration_ordinals(bcast_cluster):
+    """obj_locate with pull=1 registers the caller as an active puller:
+    stable ordinal across refresh locates, live puller count, and
+    retirement on the done report (pseq stays monotone so a later pull
+    staggers differently)."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    ref = ray_tpu.put(os.urandom(2 << 20))
+    oid_b = ref.binary()
+    loc = w.request_gcs({"t": "obj_locate", "oid": oid_b, "pull": 1},
+                        timeout=10)
+    assert loc.get("ok") and "pidx" in loc and loc["npull"] >= 1
+    first = loc["pidx"]
+    # Refresh locate: same puller, same ordinal, count unchanged.
+    loc2 = w.request_gcs({"t": "obj_locate", "oid": oid_b, "pull": 1},
+                         timeout=10)
+    assert loc2["pidx"] == first and loc2["npull"] == loc["npull"]
+    # Done retires the registration (one-way push, like a real puller);
+    # the NEXT pull gets a fresh ordinal off the monotone pseq.
+    w.loop.call_soon_threadsafe(
+        w._send_gcs, {"t": "obj_progress", "oid": oid_b, "done": True,
+                      "ok": False})
+    deadline = time.time() + 10
+    loc3 = None
+    while time.time() < deadline:
+        loc3 = w.request_gcs({"t": "obj_locate", "oid": oid_b, "pull": 1},
+                             timeout=10)
+        if loc3["pidx"] != first:
+            break
+        # Retirement not visible yet: retire THIS registration too before
+        # retrying, or npull inflates.
+        w.loop.call_soon_threadsafe(
+            w._send_gcs, {"t": "obj_progress", "oid": oid_b, "done": True,
+                          "ok": False})
+        time.sleep(0.1)
+    assert loc3 is not None and loc3["pidx"] > first
+    assert loc3["npull"] == loc["npull"]
