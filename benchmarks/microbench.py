@@ -114,6 +114,68 @@ def main():
     timeit("n_n_actor_calls_async", nn_actor_async, int(5000 * scale),
            results)
 
+    # Async-actor subset (BASELINE rows 1_1_actor_calls_concurrent /
+    # 1_n_actor_calls_async). 1-core caveat: the concurrent row measures
+    # the submission/reply pipeline, not real parallel execution — the
+    # 16 executor threads timeshare one core with the driver.
+    ca = Actor.options(max_concurrency=16).remote()
+    ray_tpu.get(ca.ping.remote())
+
+    def concurrent_calls(n):
+        ray_tpu.get([ca.ping.remote() for _ in range(n)])
+
+    timeit("1_1_actor_calls_concurrent", concurrent_calls,
+           int(2000 * scale), results)
+
+    actors8 = [Actor.remote() for _ in range(8)]
+    ray_tpu.get([x.ping.remote() for x in actors8])
+
+    def one_n_actor_async(n):
+        refs = []
+        for i in range(n):
+            refs.append(actors8[i % 8].ping.remote())
+        ray_tpu.get(refs)
+
+    timeit("1_n_actor_calls_async", one_n_actor_async, int(5000 * scale),
+           results)
+
+    # Async-def actor rows (BASELINE 1_1/n_n_async_actor_calls_*):
+    # coroutine methods run on the worker's event loop instead of the
+    # threaded executor (worker_main dispatches iscoroutinefunction
+    # methods to the loop).
+    @ray_tpu.remote
+    class AsyncActor:
+        async def ping(self):
+            return b"ok"
+
+    aa = AsyncActor.remote()
+    ray_tpu.get(aa.ping.remote())
+
+    def async_actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(aa.ping.remote())
+
+    timeit("1_1_async_actor_calls_sync", async_actor_sync,
+           int(500 * scale), results)
+
+    def async_actor_async(n):
+        ray_tpu.get([aa.ping.remote() for _ in range(n)])
+
+    timeit("1_1_async_actor_calls_async", async_actor_async,
+           int(5000 * scale), results)
+
+    async_actors = [AsyncActor.remote() for _ in range(4)]
+    ray_tpu.get([x.ping.remote() for x in async_actors])
+
+    def nn_async_actor_async(n):
+        refs = []
+        for i in range(n):
+            refs.append(async_actors[i % 4].ping.remote())
+        ray_tpu.get(refs)
+
+    timeit("n_n_async_actor_calls_async", nn_async_actor_async,
+           int(5000 * scale), results)
+
     arr = np.zeros(100 * 1024, dtype=np.uint8)  # 100KB arg
 
     # Warm the exact shape (like every other metric here): the first
@@ -162,6 +224,66 @@ def main():
 
     timeit("single_client_get_calls", get_small, int(2000 * scale), results)
 
+    # ---- many-ref rows (the previously unmeasured BASELINE shapes:
+    # wait at scale, contained-ref fan-in, whole-batch pipelines). Each
+    # op is one full 1k/10k-ref cycle, so ops/s here are single digits
+    # by design — compare against BASELINE.md, not the per-task rows.
+
+    def wait_1k_refs(n):
+        for _ in range(n):
+            refs = [tiny.remote() for _ in range(1000)]
+            ready, _ = ray_tpu.wait(refs, num_returns=1000, timeout=300)
+            assert len(ready) == 1000
+
+    timeit("single_client_wait_1k_refs", wait_1k_refs,
+           max(int(5 * scale), 1), results)
+
+    # Foreign-ref variant: refs another process owns resolve through the
+    # GCS reference plane (own task returns short-circuit it — the lease
+    # path pushes results straight to the driver, a structural difference
+    # from the reference where every return routes through plasma). The
+    # timed region is the wait() alone, so this row isolates the
+    # per-ref-vs-batched lane cost the mixed row above buries under 1k
+    # task executions.
+    @ray_tpu.remote
+    class RefProducer:
+        def make_many(self, k):
+            return [ray_tpu.put(i) for i in range(k)]
+
+    producer = RefProducer.remote()
+    ray_tpu.get(producer.make_many.remote(10))
+    n_foreign = max(int(5 * scale), 1)
+    wait_s = 0.0
+    for _ in range(n_foreign):
+        frefs = ray_tpu.get(producer.make_many.remote(1000))
+        t0 = time.perf_counter()
+        ready, _nr = ray_tpu.wait(frefs, num_returns=1000, timeout=300)
+        wait_s += time.perf_counter() - t0
+        assert len(ready) == 1000
+        del frefs, ready
+    results["single_client_wait_1k_foreign_refs"] = round(
+        n_foreign / wait_s, 1)
+    print(f"single_client_wait_1k_foreign_refs: "
+          f"{results['single_client_wait_1k_foreign_refs']} /s", flush=True)
+
+    contained = [ray_tpu.put(i) for i in range(10_000)]
+
+    def get_containing_10k(n):
+        for _ in range(n):
+            got = ray_tpu.get(ray_tpu.put(contained))
+            assert len(got) == 10_000
+
+    timeit("single_client_get_object_containing_10k_refs",
+           get_containing_10k, max(int(5 * scale), 1), results)
+    del contained
+
+    def tasks_and_get_batch(n):
+        for _ in range(n):
+            ray_tpu.get([tiny.remote() for _ in range(1000)])
+
+    timeit("single_client_tasks_and_get_batch", tasks_and_get_batch,
+           max(int(5 * scale), 1), results)
+
     big = np.zeros((1024, 1024, 16), dtype=np.float32)  # 64 MiB
 
     def put_gb(n):
@@ -178,6 +300,49 @@ def main():
     print(f"single_client_put_gigabytes: "
           f"{results['single_client_put_gigabytes']} GB/s", flush=True)
 
+    # ---- multi-client rows (after the single-client rows so the new
+    # shapes never perturb the historically-compared ones). 1-core
+    # caveat: the "clients" are actor processes timesharing the host
+    # core with the driver and the GCS, so aggregate rates measure
+    # timesharing as much as the object plane; BASELINE numbers come
+    # from 64 dedicated cores.
+    @ray_tpu.remote
+    class PutClient:
+        def __init__(self):
+            self.small = {"k": 1}
+
+        def put_small_batch(self, n):
+            for _ in range(n):
+                ray_tpu.put(self.small)
+            return n
+
+        def put_big_batch(self, n, nbytes):
+            arr = np.zeros(nbytes, dtype=np.uint8)
+            for _ in range(n):
+                ray_tpu.put(arr)
+            return n * nbytes
+
+    put_clients = [PutClient.remote() for _ in range(4)]
+    ray_tpu.get([c.put_small_batch.remote(10) for c in put_clients])
+
+    def multi_put(n):
+        per = max(1, n // len(put_clients))
+        ray_tpu.get([c.put_small_batch.remote(per) for c in put_clients])
+
+    timeit("multi_client_put_calls", multi_put, int(4000 * scale), results)
+
+    gb_nbytes = 64 << 20
+    ray_tpu.get([c.put_big_batch.remote(1, gb_nbytes)
+                 for c in put_clients])  # warmup: commit arena pages
+    n_gb_rounds = max(int(2 * scale), 1)
+    t0 = time.perf_counter()
+    total = sum(ray_tpu.get([c.put_big_batch.remote(n_gb_rounds, gb_nbytes)
+                             for c in put_clients]))
+    dt = time.perf_counter() - t0
+    results["multi_client_put_gigabytes"] = round(total / dt / 1e9, 2)
+    print(f"multi_client_put_gigabytes: "
+          f"{results['multi_client_put_gigabytes']} GB/s", flush=True)
+
     from ray_tpu.util import placement_group, remove_placement_group
 
     def pg_cycle(n):
@@ -188,6 +353,45 @@ def main():
 
     timeit("placement_group_create/removal", pg_cycle, int(100 * scale),
            results)
+
+    # Per-row measurement caveats, recorded IN the results so a reader
+    # of the JSON sees them next to the numbers (BASELINE hardware is a
+    # 64-core m4.16xlarge; this harness usually runs on 1 core).
+    results["row_caveats"] = {
+        "single_client_wait_1k_refs":
+            "op = submit 1k tiny tasks + wait(num_returns=1000); on 1 "
+            "core the submit and the executions timeshare with the wait "
+            "loop, so the row mixes task throughput with wait cost",
+        "single_client_wait_1k_foreign_refs":
+            "op = wait(1k actor-owned refs) with the producing puts "
+            "outside the timer; the row that isolates the reference "
+            "plane (per-ref lane: 1k GCS round trips; batched lane: one "
+            "obj_waits frame)",
+        "single_client_get_object_containing_10k_refs":
+            "op = put(list of 10k refs) + get; measures contained-ref "
+            "serialize fan-in (batched incref/registration frames), not "
+            "resolution of the 10k values",
+        "single_client_tasks_and_get_batch":
+            "op = 1k-task submit + one batched get (whole-batch "
+            "pipeline); 1-core: per-op wall time is dominated by the 1k "
+            "executions themselves",
+        "multi_client_put_calls":
+            "4 actor clients on 1 core: aggregate is bounded by "
+            "timesharing, not the object plane",
+        "multi_client_put_gigabytes":
+            "4 actor clients, 64MiB puts into one shared arena; 1-core "
+            "aggregate approaches the single-client memcpy ceiling",
+        "1_1_actor_calls_concurrent":
+            "max_concurrency=16 actor on 1 core: measures the pipeline "
+            "through the threaded executor, not parallel execution",
+        "1_n_actor_calls_async":
+            "1 driver -> 8 actors on 1 core (n_n row uses 4 actors; "
+            "both collapse toward the single-pipeline rate here)",
+        "async_actor_rows":
+            "async-def methods run on the worker's event loop; on 1 "
+            "core the rows measure loop dispatch overhead vs the "
+            "threaded executor, not I/O-bound concurrency",
+    }
 
     # Host context: BASELINE.md numbers come from an m4.16xlarge-class
     # machine (64 vCPU); absolute throughput scales with cores and memory

@@ -42,24 +42,45 @@ def main():
     }
 
     # ---------------- many PGs: churn
+    from ray_tpu._private.worker import global_worker
     from ray_tpu.util import placement_group, remove_placement_group
 
     N_PGS = int(os.environ.get("SCALE_PGS", "200"))
+    w = global_worker()
+    phases0 = w.request_gcs({"t": "pg_stats"})["phases"]
+    lat = []
     t0 = time.perf_counter()
     pgs = []
     for _ in range(N_PGS):
+        t1 = time.perf_counter()
         pg = placement_group([{"CPU": 0.01}])
         pg.wait(30)
+        lat.append(time.perf_counter() - t1)
         pgs.append(pg)
     create_dt = time.perf_counter() - t0
+    phases1 = w.request_gcs({"t": "pg_stats"})["phases"]
     t0 = time.perf_counter()
     for pg in pgs:
         remove_placement_group(pg)
     remove_dt = time.perf_counter() - t0
+    lat.sort()
+    # Per-phase attribution (GCS-side) + the driver-side latency tail:
+    # the create rate is 1/mean(create+wait round trip), so cross-run
+    # variance must show up either in a GCS phase (code path) or in the
+    # driver-side tail with flat GCS phases (host noise / scheduling).
+    gcs_phases = {k: round(phases1[k] - phases0.get(k, 0), 6)
+                  for k in phases1}
     results["many_pgs"] = {
         "n": N_PGS,
         "create_per_s": round(N_PGS / create_dt, 1),
         "remove_per_s": round(N_PGS / remove_dt, 1),
+        "create_latency_ms": {
+            "p50": round(lat[len(lat) // 2] * 1e3, 3),
+            "p90": round(lat[int(len(lat) * 0.9)] * 1e3, 3),
+            "p99": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+            "max": round(lat[-1] * 1e3, 3),
+        },
+        "gcs_phases": gcs_phases,
     }
 
     # ---------------- many actors: launch rate, all alive at once

@@ -62,6 +62,19 @@ class RayTpuConfig:
     # and anything a second consumer might borrow — keep the shm+GCS
     # object-plane path.
     direct_arg_threshold: int = 1 << 20
+    # ---- reference plane (batched obj_waits wait groups)
+    # False falls back to the per-ref obj_wait lane (one GCS round trip
+    # per unresolved ref) — the escape hatch for A/B measurement and for
+    # bisecting directory regressions.
+    batched_obj_wait: bool = True
+    # Max oids per obj_waits frame: one wait over 100k refs chunks into
+    # ceil(n/batch) frames so a single frame never stalls the GCS loop
+    # (still O(1) frames per thousand refs, vs O(n) on the per-ref lane).
+    obj_waits_max_batch: int = 4096
+    # GCS-side resolution-row push coalescing: rows for one client flush
+    # when this many accumulate, else on the next loop tick (a burst of
+    # obj_put registrations resolves a whole group in one obj_res frame).
+    obj_res_flush_rows: int = 512
     # ---- fault tolerance
     reconnect_attempts: int = 75    # GCS reconnect budget (x delay ~15s)
     reconnect_delay_s: float = 0.2

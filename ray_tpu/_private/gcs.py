@@ -383,6 +383,25 @@ class PendingQueues:
 _client_serial = iter(range(1, 1 << 62)).__next__
 
 
+class WaitGroup:
+    """One ``obj_waits`` request's server-side state (the vectorized
+    reference plane): N oids + a num_returns threshold registered in ONE
+    frame. The group replies once when the threshold is met (carrying
+    every resolution row gathered so far); rows resolving after the
+    reply stream back as coalesced ``obj_res`` pushes. Replaces N
+    per-ref request/reply pairs with O(1) frames per call."""
+
+    __slots__ = ("client", "msg", "need", "rows", "replied")
+
+    def __init__(self, client: "ClientConn", msg: dict, need: int,
+                 rows: list):
+        self.client = client
+        self.msg = msg
+        self.need = need
+        self.rows = rows  # gathered resolution rows until the reply
+        self.replied = False
+
+
 class ClientConn:
     """A registered client: driver, worker, or node agent."""
 
@@ -396,6 +415,9 @@ class ClientConn:
         # obj_progress — retired when the client disconnects so dead
         # pullers don't linger as partial holders.
         self.pull_regs: Set[tuple] = set()
+        # Post-threshold wait-group resolution rows awaiting a coalesced
+        # obj_res push (flushed on the next loop tick or at the row cap).
+        self.res_rows: list = []
 
 
 class GcsServer:
@@ -458,6 +480,20 @@ class GcsServer:
         # at pull completion — the "who actually carried the broadcast"
         # signal (benchmarks assert the source served a minority).
         self.bcast_served: Dict[str, dict] = {}
+        # PG-creation phase accounting (reserve = staging scan, commit =
+        # resource debit, reply = wire write, wal = durable append):
+        # cumulative seconds + counts, served by ``pg_stats`` — the
+        # instrumentation that lets the scale bench attribute cross-run
+        # create-rate variance to a phase instead of guessing.
+        self.pg_phases: Dict[str, float] = {
+            "n": 0, "reserve_s": 0.0, "commit_s": 0.0, "reply_s": 0.0,
+            "wal_s": 0.0, "retries": 0, "deferred": 0}
+        # PGs awaiting capacity, retried on every scheduler wake (the
+        # poll timers remain only as a backstop): a deferred create used
+        # to pay 50-100ms of timer quantization even when the blocking
+        # resources freed microseconds later — the dominant term in
+        # cross-run many_pgs create-rate variance.
+        self._pending_pgs: Set[PlacementGroupID] = set()
         self._addr_nodes: Dict[str, tuple] = {}  # serve addr -> (hex, sfx)
         self._locate_rr = 0  # worker-endpoint rotation (obj_locate)
         # Observability stores (reference: GcsTaskManager task-event store
@@ -1079,10 +1115,7 @@ class GcsServer:
             # shm_bytes (triggering spurious eviction); overwriting a live
             # shm entry with inline error bytes would strand its arena
             # accounting. Keep the first registration.
-            for conn, req in entry.waiters:
-                if not conn.closed:
-                    conn.reply(req, self._obj_reply(entry))
-            entry.waiters.clear()
+            self._notify_obj_waiters(entry)
             return
         entry.nbytes = nbytes
         entry.inline = inline
@@ -1091,10 +1124,7 @@ class GcsServer:
         self.counters["objects_stored"] += 1
         if on_shm:
             self.shm_bytes += nbytes
-        for conn, req in entry.waiters:
-            if not conn.closed:
-                conn.reply(req, self._obj_reply(entry))
-        entry.waiters.clear()
+        self._notify_obj_waiters(entry)
         if entry.refcount <= 0:
             self._lru_touch(entry)
         self._maybe_evict()
@@ -1104,6 +1134,76 @@ class GcsServer:
             return {"ok": True, "where": "inline", "data": entry.inline,
                     "nbytes": entry.nbytes}
         return {"ok": True, "where": "shm", "nbytes": entry.nbytes}
+
+    def _notify_obj_waiters(self, entry: ObjectEntry):
+        """Resolve everything waiting on ``entry`` becoming ready: legacy
+        per-ref waiters get their own reply frame; wait groups get a
+        resolution row routed through the group (threshold reply or a
+        coalesced ``obj_res`` push)."""
+        if not entry.waiters:
+            return
+        waiters, entry.waiters = entry.waiters, []
+        row = None
+        for w in waiters:
+            if isinstance(w, WaitGroup):
+                if row is None:
+                    if entry.inline is not None:
+                        row = [entry.object_id.binary(), 1, entry.inline]
+                    else:
+                        row = [entry.object_id.binary(), 2, entry.nbytes]
+                self._group_deliver(w, row)
+            else:
+                conn, req = w
+                if not conn.closed:
+                    conn.reply(req, self._obj_reply(entry))
+
+    def _fail_obj_waiters(self, entry: ObjectEntry, err: str):
+        """Terminal failure for everything waiting on ``entry``: one lost
+        oid must not poison its wait groups — the group keeps running and
+        this oid alone resolves to an error row."""
+        if not entry.waiters:
+            return
+        waiters, entry.waiters = entry.waiters, []
+        row = [entry.object_id.binary(), 0, err]
+        for w in waiters:
+            if isinstance(w, WaitGroup):
+                self._group_deliver(w, row)
+            else:
+                conn, req = w
+                if not conn.closed:
+                    conn.reply(req, {"ok": False, "err": err})
+
+    def _group_deliver(self, group: WaitGroup, row: list):
+        """Route one resolution row: gather until the group's threshold
+        fires its single reply; stream the rest as coalesced pushes."""
+        client = group.client
+        if client.conn.closed:
+            return
+        if not group.replied:
+            group.rows.append(row)
+            if len(group.rows) >= group.need:
+                group.replied = True
+                rows, group.rows = group.rows, None
+                client.conn.reply(group.msg, {"ok": True, "rows": rows})
+        else:
+            buf = client.res_rows
+            buf.append(row)
+            if len(buf) >= _cfg().obj_res_flush_rows:
+                self._flush_res_rows(client)
+            elif len(buf) == 1:
+                # One scheduled flush per burst: rows accumulating in the
+                # same loop drain (a batch of obj_puts resolving a whole
+                # group) ride one obj_res frame.
+                asyncio.get_running_loop().call_soon(
+                    self._flush_res_rows, client)
+
+    def _flush_res_rows(self, client: ClientConn):
+        rows, client.res_rows = client.res_rows, []
+        if rows and not client.conn.closed:
+            try:
+                client.conn.send({"t": "obj_res", "rows": rows})
+            except ConnectionError:
+                pass
 
     def _obj_put_one(self, client, o: dict):
         """Register one object (shared by obj_put and the coalesced
@@ -1155,23 +1255,97 @@ class GcsServer:
             client.conn.reply(msg, {"ok": True})
 
     async def _h_obj_wait(self, client, msg):
-        oid = ObjectID(msg["oid"])
+        # Per-ref lane: same resolve-now logic as the batched lane (ONE
+        # source of truth — the lanes must never drift), row translated
+        # back to the legacy reply shape.
+        oid_b = bytes(msg["oid"])
+        row = self._obj_wait_row(oid_b)
+        if row is None:
+            self.objects[ObjectID(oid_b)].waiters.append((client.conn, msg))
+            return
+        code, payload = row[1], row[2]
+        if code == 1:
+            client.conn.reply(msg, {"ok": True, "where": "inline",
+                                    "data": payload,
+                                    "nbytes": len(payload)})
+        elif code == 2:
+            client.conn.reply(msg, {"ok": True, "where": "shm",
+                                    "nbytes": payload})
+        else:
+            client.conn.reply(msg, {"ok": False, "err": payload})
+
+    def _obj_wait_row(self, oid_b: bytes) -> Optional[list]:
+        """Resolve-now attempt for one waited-on oid — the shared
+        resolution logic of BOTH lanes (per-ref ``obj_wait`` translates
+        the row to its legacy reply; ``obj_waits`` ships rows verbatim):
+        spilled restore / serve-inline-from-disk, unrecoverable-spill
+        fast-fail, reconstruction trigger. Returns a resolution row, or
+        None when the oid must pend (the caller registers its waiter on
+        the entry). Row shapes: ``[oid, 1, data]`` inline,
+        ``[oid, 2, nbytes]`` shm, ``[oid, 0, err]`` lost."""
+        oid = ObjectID(oid_b)
         entry = self._obj(oid)
         if entry.spilled is not None and not self._restore_spilled(entry):
             # Can't re-admit to the store: serve the disk bytes inline.
             try:
                 with open(entry.spilled, "rb") as f:
-                    client.conn.reply(msg, {"ok": True, "where": "inline",
-                                            "data": f.read(),
-                                            "nbytes": entry.nbytes})
-                return
+                    return [oid_b, 1, f.read()]
             except OSError:
-                pass
+                if not entry.on_shm and not entry.holders:
+                    # Spill file gone and no node holds a copy: the value
+                    # is unrecoverable — fail THIS oid fast instead of
+                    # sending the client on a doomed pull (and never
+                    # poison the rest of its group).
+                    return [oid_b, 0,
+                            f"object {oid.hex()} lost: spill file "
+                            "unreadable and no holders remain"]
         if entry.ready:
-            client.conn.reply(msg, self._obj_reply(entry))
-        else:
-            self._try_reconstruct(entry)
-            entry.waiters.append((client.conn, msg))
+            if entry.inline is not None:
+                return [oid_b, 1, entry.inline]
+            return [oid_b, 2, entry.nbytes]
+        self._try_reconstruct(entry)
+        return None
+
+    async def _h_obj_waits(self, client, msg):
+        """Batched wait group: N oids + a num_returns threshold in one
+        frame (the vectorized reference plane — plasma's batch Wait/Get
+        surface). Already-resolved oids row up immediately; the reply
+        fires as soon as the threshold is met; later resolutions stream
+        as coalesced ``obj_res`` pushes. Duplicate oids in one call
+        collapse to a single row."""
+        oids = msg["oids"]
+        rows: list = []
+        seen: Set[bytes] = set()
+        pending_entries = []
+        for oid_b in oids:
+            ob = bytes(oid_b)
+            if ob in seen:
+                continue
+            seen.add(ob)
+            try:
+                row = self._obj_wait_row(ob)
+            except Exception:
+                logger.exception("obj_waits resolution failed for %s",
+                                 ObjectID(ob).hex())
+                row = [ob, 0, "internal error resolving object"]
+            if row is not None:
+                rows.append(row)
+            else:
+                pending_entries.append(self.objects[ObjectID(ob)])
+        need = int(msg.get("nr") or len(seen))
+        need = max(1, min(need, len(seen))) if seen else 0
+        if len(rows) >= need:
+            client.conn.reply(msg, {"ok": True, "rows": rows})
+            if pending_entries:
+                group = WaitGroup(client, msg, need, rows)
+                group.replied = True
+                group.rows = None
+                for entry in pending_entries:
+                    entry.waiters.append(group)
+            return
+        group = WaitGroup(client, msg, need, rows)
+        for entry in pending_entries:
+            entry.waiters.append(group)
 
     async def _h_obj_contains(self, client, msg):
         oid = ObjectID(msg["oid"])
@@ -1192,10 +1366,7 @@ class GcsServer:
                 entry.nbytes = nbytes
                 entry.on_shm = True
                 entry.ready = True
-                for conn, req in entry.waiters:
-                    if not conn.closed:
-                        conn.reply(req, self._obj_reply(entry))
-                entry.waiters.clear()
+                self._notify_obj_waiters(entry)
 
     async def _h_obj_locate(self, client, msg):
         """Object directory lookup for the P2P object plane (reference:
@@ -1531,6 +1702,10 @@ class GcsServer:
                     pass
             if entry.inline is not None:
                 self._log_append("objd", oid.binary())
+            if entry.waiters:
+                # Defensive: deleting an entry must never strand a wait
+                # group — each waiter gets a lost row, not silence.
+                self._fail_obj_waiters(entry, "object evicted")
             del self.objects[oid]
         if self.shm_bytes > target_bytes:
             self._spill_until_under(target_bytes)
@@ -1978,7 +2153,19 @@ class GcsServer:
         node, or no idle worker) is skipped wholesale for the rest of the
         pass — its per-task state never needs re-examination.
         """
-        # Parked actors first: dedicated workers, and idle workers freed
+        # Deferred placement groups first: resources freed by the wake
+        # that triggered this pass can satisfy a pending group NOW
+        # instead of after a 50-100ms backstop poll timer — timer
+        # quantization was the dominant term in many_pgs create-rate
+        # variance. The create-time timers stay as a backstop only.
+        if self._pending_pgs:
+            for pg_id in list(self._pending_pgs):
+                record = self.pgs.get(pg_id)
+                if record is None or record.state != "pending":
+                    self._pending_pgs.discard(pg_id)
+                    continue
+                self._retry_pg(record, reschedule=False)
+        # Parked actors next: dedicated workers, and idle workers freed
         # by finished tasks should prefer waiting actors (FIFO by park
         # order) before new task dispatch claims them.
         self._place_parked_actors()
@@ -2683,36 +2870,92 @@ class GcsServer:
         record = PGRecord(pg_id, msg["bundles"], msg["strategy"],
                           msg.get("name", ""), client)
         self.pgs[pg_id] = record
+        ph = self.pg_phases
+        t0 = time.perf_counter()
         self._log_append("pg", {"pgid": pg_id.binary(),
                                 "bundles": record.bundles,
                                 "strategy": record.strategy,
                                 "name": record.name})
+        ph["wal_s"] += time.perf_counter() - t0
         placed = self._place_bundles(record)
         if placed:
             record.state = "ready"
+            t1 = time.perf_counter()
             client.conn.reply(msg, {"ok": True, "ready": True})
+            ph["reply_s"] += time.perf_counter() - t1
+            ph["n"] += 1
         else:
+            ph["deferred"] += 1
             record.ready_waiters.append((client.conn, msg))
+            self._pending_pgs.add(pg_id)
             asyncio.get_running_loop().call_later(0.05, self._retry_pg, record)
+            self._nudge_idle_leases()
 
-    def _retry_pg(self, record: PGRecord):
+    async def _h_pg_stats(self, client, msg):
+        """Cumulative PG-creation phase timings (the many_pgs variance
+        root-causing surface): per-phase seconds, placement counts, and
+        retry pressure since boot."""
+        client.conn.reply(msg, {"ok": True, "phases": dict(self.pg_phases)})
+
+    def _retry_pg(self, record: PGRecord, reschedule: bool = True):
+        """Retry a deferred placement. ``reschedule=False`` is the
+        event-driven path (scheduler pass on resource release): it must
+        not plant new timers — the create-time backstop timer is enough."""
         if record.state != "pending":
+            self._pending_pgs.discard(record.pg_id)
             return
+        self.pg_phases["retries"] += 1
         if self._place_bundles(record):
             record.state = "ready"
+            self._pending_pgs.discard(record.pg_id)
+            ph = self.pg_phases
+            t0 = time.perf_counter()
             for conn, req in record.ready_waiters:
                 if not conn.closed:
                     conn.reply(req, {"ok": True, "ready": True})
             record.ready_waiters.clear()
+            # Deferred-then-placed creates count toward n/reply_s too —
+            # otherwise a loaded host where most creates defer reports
+            # n~0 while reserve_s keeps accumulating (every failed
+            # retry's staging scan lands there), and per-create phase
+            # attribution (the whole point of pg_stats) turns nonsense.
+            ph["reply_s"] += time.perf_counter() - t0
+            ph["n"] += 1
             self._wake_scheduler()
-        else:
+        elif reschedule:
             asyncio.get_running_loop().call_later(0.1, self._retry_pg, record)
+            # Leases that went idle AFTER the create deferred (their
+            # last task finished since) are invisible here until the
+            # lessee's idle-return timer fires; re-nudge on each timer
+            # retry so a pending group never waits out that full hold.
+            self._nudge_idle_leases()
+
+    def _nudge_idle_leases(self):
+        """Placement demand is blocked while drivers may be sitting on
+        warm-but-idle leased workers (each pinning its acquired
+        resources for up to ``lease_idle_return_s``): ask every lessee
+        to return leases that are idle RIGHT NOW. Only the lessee knows
+        which leases are idle (in-flight pushes never route through the
+        GCS), so this is a cooperative nudge, not a revocation — busy
+        leases and classes with queued work are untouched. The returns
+        arrive as normal ``lease_ret`` frames -> ``_wake_scheduler`` ->
+        the event-driven pending-PG pass."""
+        owners = {}
+        for w in self.workers.values():
+            if w.leased_to is not None and not w.leased_to.conn.closed:
+                owners[w.leased_to.serial] = w.leased_to
+        for owner in owners.values():
+            try:
+                owner.conn.send({"t": "lease_nudge"})
+            except ConnectionError:
+                pass
 
     def _place_bundles(self, record: PGRecord) -> bool:
         """Reserve every bundle or nothing (all-or-nothing like the
         reference's 2PC prepare/commit, node_manager.h:507-512 — centralized
         here so a plain transactional update suffices)."""
         strategy = record.strategy
+        t0 = time.perf_counter()
         nodes = [n for n in self.nodes.values() if n.schedulable()]
         nodes.sort(key=lambda n: n.node_id.binary())
         staged: Dict[NodeID, Dict[str, float]] = {
@@ -2782,9 +3025,13 @@ class GcsServer:
                 else:
                     return False
         # Commit
+        t1 = time.perf_counter()
         for node_id, bundle in zip(placement, record.bundles):
             _res_sub(self.nodes[node_id].avail, bundle)
         record.placement = placement
+        t2 = time.perf_counter()
+        self.pg_phases["reserve_s"] += t1 - t0
+        self.pg_phases["commit_s"] += t2 - t1
         return True
 
     @staticmethod

@@ -526,6 +526,12 @@ TRANSPORT_STATS = {
     # pull side — chunk-granular retries and coalesced concurrent gets.
     "bcast_chunk_retries": 0,
     "pull_dedup_hits": 0,
+    # Reference plane: outbound GCS wait subscriptions. The per-ref lane
+    # pays one obj_wait frame per unresolved ref; the batched lane pays
+    # one obj_waits frame per burst (tests assert a 1k-ref wait stays
+    # O(1) here — the frame counters are the proof surface).
+    "obj_wait_frames": 0,
+    "obj_waits_frames": 0,
 }
 
 

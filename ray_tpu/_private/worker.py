@@ -445,6 +445,11 @@ class Worker:
         # Concurrent-get coalescing: oid -> in-flight pull future.
         self._pull_lock = threading.Lock()
         self._pull_inflight: Dict[ObjectID, "SlimFuture"] = {}
+        # Batched reference plane: unresolved ids parked for the next
+        # coalesced obj_waits subscribe (one frame per burst, not per ref).
+        self._wait_lock = threading.Lock()
+        self._wait_buf: List[ObjectID] = []
+        self._wait_flush_scheduled = False
         # Where peers can fetch our partial chunks (worker_main sets this
         # to the worker's listening socket; drivers don't serve).
         self.serve_addr: Optional[str] = None
@@ -625,10 +630,31 @@ class Worker:
                         for oid, n in self._live_refs.items()]
             if live:
                 self._send_gcs({"t": "ref", "d": live})
-        for oid, fut in list(self._object_futures.items()):
-            if not fut.done() and oid not in self._memory_store:
-                asyncio.run_coroutine_threadsafe(
-                    self._wait_remote(oid, fut), self.loop)
+            # Retained outbound "ref" frames (pickled-copy increfs queued
+            # while the link was down) would double-count against the
+            # snapshot just replayed: drop them, exactly as the delta
+            # queues above were cleared. Other retained frames (obj_put
+            # registrations etc.) still replay.
+            with self._out_lock:
+                kept = [m for m in self._out_q
+                        if not (isinstance(m, dict) and m.get("t") == "ref")]
+                if len(kept) != len(self._out_q):
+                    self._out_q.clear()
+                    self._out_q.extend(kept)
+        # Re-subscribe every unresolved future — one batched wait-group
+        # frame (the fresh GCS lost all per-request wait groups).
+        unresolved = [oid for oid, fut in self._object_futures.items()
+                      if not fut.done() and oid not in self._memory_store]
+        if unresolved:
+            if _cfg().batched_obj_wait:
+                batch = max(1, _cfg().obj_waits_max_batch)
+                for i in range(0, len(unresolved), batch):
+                    self.loop.create_task(
+                        self._obj_waits_request(unresolved[i:i + batch]))
+            else:
+                for oid in unresolved:
+                    self.loop.create_task(
+                        self._wait_remote(oid, self._object_futures[oid]))
         if gcs_restarted:
             # Re-claim leases this driver still holds: the fresh GCS
             # re-registered resyncing workers as IDLE (their hello has no
@@ -734,6 +760,11 @@ class Worker:
         # replays live counts only after a real GCS restart.
         if self.gcs is None or self.gcs.closed:
             return
+        # Queued fire-and-forget frames can hold pickled-copy increfs
+        # (send_ref_incref_now rides the outbound queue): they must hit
+        # the wire before any decref deltas below, or a fast
+        # serialize-then-drop could underflow the GCS count.
+        self._drain_out()
         with self._ref_lock:
             deltas = [(oid.binary(), d) for oid, d in self._ref_deltas.items()
                       if d != 0]
@@ -788,17 +819,140 @@ class Worker:
     def object_future(self, object_id: ObjectID) -> "SlimFuture":
         fut = self._object_futures.get(object_id)
         if fut is None:
-            fut = SlimFuture()
-            self._object_futures[object_id] = fut
-            if object_id in self._memory_store:
-                fut.set_result(("inline", self._memory_store[object_id]))
-            else:
-                # Ask the GCS; reply resolves the future.
-                asyncio.run_coroutine_threadsafe(
-                    self._wait_remote(object_id, fut), self.loop)
+            fut = self.object_futures((object_id,))[0]
         return fut
 
+    def object_futures(self, object_ids) -> List["SlimFuture"]:
+        """Futures for a whole batch of ids, subscribing every unresolved
+        one through ONE ``obj_waits`` frame (the vectorized reference
+        plane). ``get``/``wait`` over n refs used to issue n ``obj_wait``
+        round trips and n cross-thread coroutine handoffs; a batch costs
+        one of each regardless of n."""
+        out = []
+        remote: Optional[List[ObjectID]] = None
+        # get-or-create under the lock: two threads racing get() on the
+        # same unseen ref must share ONE future — resolution goes through
+        # the dict only (the per-ref lane carried each future into its
+        # own coroutine, so a lost-race duplicate still resolved; here an
+        # overwritten future would hang its waiter forever). Inline
+        # results are set BEFORE publication, so no one observes an
+        # unresolved future for a locally-available value.
+        with self._wait_lock:
+            for oid in object_ids:
+                fut = self._object_futures.get(oid)
+                if fut is None:
+                    fut = SlimFuture()
+                    data = self._memory_store.get(oid)
+                    if data is not None:
+                        fut.set_result(("inline", data))
+                    else:
+                        if remote is None:
+                            remote = []
+                        remote.append(oid)
+                    self._object_futures[oid] = fut
+                out.append(fut)
+        if remote:
+            if _cfg().batched_obj_wait:
+                self._queue_obj_waits(remote)
+            else:
+                for oid in remote:
+                    asyncio.run_coroutine_threadsafe(
+                        self._wait_remote(oid, self._object_futures[oid]),
+                        self.loop)
+        return out
+
+    def _queue_obj_waits(self, oids: List[ObjectID]):
+        """Park unresolved ids for the next batched subscribe flush. A
+        burst of subscriptions (one big get, or many small ones racing)
+        coalesces into one loop wakeup and one ``obj_waits`` frame."""
+        with self._wait_lock:
+            self._wait_buf.extend(oids)
+            wake = not self._wait_flush_scheduled
+            if wake:
+                self._wait_flush_scheduled = True
+        if wake:
+            try:
+                self.loop.call_soon_threadsafe(self._flush_waits)
+            except RuntimeError:
+                pass  # loop shut down: disconnect fails the futures
+
+    def _flush_waits(self):  # runs on the IO loop
+        with self._wait_lock:
+            self._wait_flush_scheduled = False
+            oids, self._wait_buf = self._wait_buf, []
+        todo = []
+        for oid in oids:
+            # .get, not []: maybe_reconstruct swaps futures out of the
+            # dict from other threads; a KeyError here would discard the
+            # whole already-swapped batch and strand every other oid.
+            fut = self._object_futures.get(oid)
+            if fut is not None and not fut.done():
+                todo.append(oid)
+        if not todo:
+            return
+        batch = max(1, _cfg().obj_waits_max_batch)
+        for i in range(0, len(todo), batch):
+            self.loop.create_task(self._obj_waits_request(todo[i:i + batch]))
+
+    async def _obj_waits_request(self, oids: List[ObjectID]):
+        """One wait-group subscription: N oids, one frame. The worker
+        lane always passes num_returns=1 — blocking is per-FUTURE here,
+        so the reply must carry whatever is resolvable NOW (all rows when
+        everything is ready — still one frame) and later resolutions
+        stream back as coalesced ``obj_res`` pushes; a higher threshold
+        would hold ready rows hostage to the group's stragglers and
+        stall ``wait(num_returns=1)`` behind its slowest ref."""
+        serialization.TRANSPORT_STATS["obj_waits_frames"] += 1
+        try:
+            reply = await self.gcs.request(
+                {"t": "obj_waits", "oids": [oid.binary() for oid in oids],
+                 "nr": 1})
+        except asyncio.CancelledError:
+            for oid in oids:
+                fut = self._object_futures.get(oid)
+                if fut is not None and not fut.done():
+                    fut.set_exception(ConnectionError("wait cancelled"))
+        except ConnectionError:
+            # GCS link blip: futures stay PENDING — the reconnect resync
+            # re-subscribes every unresolved future (same contract as the
+            # per-ref lane).
+            pass
+        else:
+            if reply.get("ok"):
+                self._apply_res_rows(reply.get("rows") or ())
+            else:
+                # The directory could not take the group (internal error):
+                # fall back to the per-ref lane rather than stranding the
+                # futures.
+                for oid in oids:
+                    fut = self._object_futures.get(oid)
+                    if fut is not None and not fut.done():
+                        self.loop.create_task(self._wait_remote(oid, fut))
+
+    def _apply_res_rows(self, rows):
+        """Resolve per-oid futures from wait-group resolution rows
+        (positional: ``[oid, code, payload]`` — 1=inline data, 2=shm
+        nbytes, 0=lost err string)."""
+        for r in rows:
+            oid = ObjectID(bytes(r[0]))
+            with self._wait_lock:
+                fut = self._object_futures.get(oid)
+                if fut is None:
+                    fut = SlimFuture()
+                    self._object_futures[oid] = fut
+            if fut.done():
+                continue
+            code = r[1]
+            if code == 1:
+                fut.set_result(("inline", r[2]))
+            elif code == 2:
+                fut.set_result(("shm", r[2]))
+            else:
+                fut.set_exception(
+                    serialization.ObjectLostError(str(r[2])))
+
     async def _wait_remote(self, object_id: ObjectID, fut: SyncFuture):
+        serialization.TRANSPORT_STATS["obj_wait_frames"] += 1
         try:
             reply = await self.gcs.request(
                 {"t": "obj_wait", "oid": object_id.binary()})
@@ -1220,7 +1374,7 @@ class Worker:
                 cl.close()
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
-        futs = [self.object_future(r.id) for r in refs]
+        futs = self.object_futures([r.id for r in refs])
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         for r, fut in zip(refs, futs):
@@ -1279,6 +1433,11 @@ class Worker:
         """
         oid = ObjectID.for_put(self._put_counter.next())
         sobj = serialize(value)
+        # The registration below covers this object for borrowers:
+        # serializing the returned ref later must not re-ship the payload
+        # through promote_on_serialize (per-ref obj_put frames dominated
+        # the contained-refs shapes before this mark).
+        self._registered_inline.add(oid)
         if sobj.total_size <= serialization.INLINE_THRESHOLD:
             data = sobj.to_bytes()
             self._memory_store[oid] = data
@@ -1309,6 +1468,7 @@ class Worker:
         sobj.write_into(buf)
         self.store.seal(oid)
         if register:
+            self._registered_inline.add(oid)
             self.loop.call_soon_threadsafe(self._send_gcs, {
                 "t": "obj_put", "oid": oid.binary(),
                 "nbytes": sobj.total_size, "shm": True})
@@ -1317,26 +1477,36 @@ class Worker:
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        futs = [self.object_future(r.id) for r in refs]
+        futs = self.object_futures([r.id for r in refs])
         # One shared Event woken by ANY completion (SlimFutures don't
         # support concurrent.futures.wait; a per-call Event matches its
         # single-waiter design). Still a real blocking wait — no busy-poll
-        # (the reference blocks in plasma Wait the same way).
+        # (the reference blocks in plasma Wait the same way). Completions
+        # feed a shared counter, so each wakeup costs O(1) instead of
+        # recounting every future (O(n^2) across a batch of n
+        # completions — the wait-at-scale pathology).
         ev = threading.Event()
+        done_count = [0]
+        count_lock = threading.Lock()
 
         def _wake(_f):
+            # Count-then-set ordering pairs with the loop's
+            # clear-then-read: a completion is either visible in the
+            # count or re-sets the event — never silently lost.
+            with count_lock:
+                done_count[0] += 1
             ev.set()
 
         for f in futs:
             f.add_done_callback(_wake)
         try:
             while True:
-                # Clear BEFORE counting: a completion landing after the
-                # count re-sets the event, so the wait below returns
-                # promptly instead of losing that wakeup.
+                # Clear BEFORE reading the counter: a completion landing
+                # after the read re-sets the event, so the wait below
+                # returns promptly instead of losing that wakeup.
                 ev.clear()
-                n_done = sum(f.done() for f in futs)
-                if n_done >= num_returns or n_done == len(futs):
+                n_done = done_count[0]
+                if n_done >= num_returns or n_done >= len(futs):
                     break
                 remaining = None
                 if deadline is not None:
@@ -1362,10 +1532,17 @@ class Worker:
         bypasses the 0.1s delta flush so it cannot lose the race with the
         owner's decref while the message is in flight. The receiving
         process's wrapper owns (and eventually decrefs) this count, so
-        local live-ref tracking here is untouched."""
+        local live-ref tracking here is untouched.
+
+        Rides the outbound queue, NOT a per-ref loop wakeup: serializing
+        an object that contains k nested refs (the 10k-refs shape) fires
+        k of these back-to-back — ``_drain_out`` coalesces the run into
+        ONE ``ref`` frame, and any later message carrying the ref is
+        queued behind it, so the orders-before-carrier invariant holds.
+        ``_flush_refs`` drains this queue before sending decref deltas,
+        so a queued +1 can never lose to the owner's own -1 either."""
         if self.gcs is not None and not self.gcs.closed:
-            self.loop.call_soon_threadsafe(
-                self._send_gcs,
+            self.send_gcs_threadsafe(
                 {"t": "ref", "d": [(object_id.binary(), 1)]})
         else:
             # Link down (reconnect in progress): the receiver's wrapper
@@ -1403,7 +1580,9 @@ class Worker:
             # Value not here yet (in-flight actor call) — promote on arrival.
             self._promote_pending.add(object_id)
             return
-        self.loop.call_soon_threadsafe(self._send_gcs, {
+        # Outbound queue, not a per-ref wakeup: a serialize pass that
+        # promotes many contained refs coalesces into one obj_puts frame.
+        self.send_gcs_threadsafe({
             "t": "obj_put", "oid": object_id.binary(),
             "nbytes": len(data), "data": bytes(data)})
 
@@ -1434,12 +1613,18 @@ class Worker:
             return  # empty/typeless frame: skip, never fall through
         if t == "task_done":
             self.push_result(msg["tid"], msg["results"])
+        elif t == "obj_res":
+            # Streamed wait-group resolutions (rows past the group's
+            # num_returns threshold arrive as coalesced pushes).
+            self._apply_res_rows(msg.get("rows") or ())
         elif t == "lease_grant":
             self._on_lease_grant(msg)
         elif t == "lease_dead":
             self._on_lease_dead(msg)
         elif t == "lease_revoked":
             self._on_lease_revoked(msg)
+        elif t == "lease_nudge":
+            self._on_lease_nudge()
         elif t == "lease_void":
             # The GCS voided our demand (e.g. the targeted placement
             # group was removed): queued tasks of this class can never
@@ -1598,8 +1783,8 @@ class Worker:
             if wake:
                 self.loop.call_soon_threadsafe(self._drain_out)
 
-        for d_oid in deps:
-            self.object_future(d_oid).add_done_callback(on_dep)
+        for fut in self.object_futures(deps):
+            fut.add_done_callback(on_dep)
 
     def _send_gcs(self, msg: dict):
         if self.gcs is not None and not self.gcs.closed:
@@ -1842,6 +2027,22 @@ class Worker:
             lease.idle_handle = None
         self._pump_class(cls)
 
+    def _on_lease_nudge(self):
+        """The GCS has blocked placement demand (a deferred placement
+        group) while we hold warm-but-idle leases: return them now
+        instead of at the ``lease_idle_return_s`` timer. Busy leases and
+        classes with queued work keep their capacity — the nudge only
+        surrenders what is idle at this instant, so task latency never
+        pays for it (a later burst simply re-requests leases)."""
+        for cls in list(self._task_classes.values()):
+            if cls.queue:
+                continue
+            for lease in list(cls.leases.values()):
+                if not lease.dead and lease.busy == 0:
+                    if lease.idle_handle is not None:
+                        lease.idle_handle.cancel()
+                    self._return_lease(cls, lease)
+
     def _retain_spec(self, oid_b: bytes, key: str, wire: dict,
                      item: _TaskItem):
         old = self._task_specs.get(oid_b)
@@ -1866,10 +2067,9 @@ class Worker:
         # args_pins unchanged: the popped spec's pin transfers to the
         # resubmission now entering flight (its terminal disposition in
         # _on_exec_reply/_finish_item_error decrements it).
-        for oid in item.oids:
-            self._object_futures.pop(oid, None)
-            fut = SlimFuture()
-            self._object_futures[oid] = fut
+        with self._wait_lock:
+            for oid in item.oids:
+                self._object_futures[oid] = SlimFuture()
         item.retries -= 1 if item.retries > 0 else 0
         with self._out_lock:
             self._out_q.append(("task", key, wire, item))
@@ -1968,6 +2168,28 @@ class Worker:
         pumped = set()
         gcs_down = self.gcs is None or self.gcs.closed
         retained: List[dict] = []
+        # Frame coalescing for the contained-ref fan-in: a serialize pass
+        # over an object holding k nested refs enqueues k "ref" increfs
+        # (and up to k promote "obj_put"s) back-to-back. Within a
+        # contiguous run of fire-and-forget ref/obj_put frames the two
+        # kinds commute (the directory parks early deltas), so the run
+        # collapses to ONE ref frame + ONE obj_puts frame — emitted
+        # before the next non-mergeable message, preserving the
+        # registration-before-carrier and incref-before-carrier orders.
+        ref_rows: list = []
+        put_objs: List[dict] = []
+
+        def _flush_merged():
+            if put_objs:
+                if len(put_objs) == 1:
+                    self._send_gcs(put_objs[0])
+                else:
+                    self._send_gcs({"t": "obj_puts", "objs": put_objs})
+                put_objs.clear()  # pack() copied synchronously
+            if ref_rows:
+                self._send_gcs({"t": "ref", "d": ref_rows})
+                ref_rows.clear()
+
         for m in msgs:
             if isinstance(m, dict):
                 if gcs_down:
@@ -1976,10 +2198,24 @@ class Worker:
                     # orphan objects the user already holds refs to.
                     retained.append(m)
                     continue
+                t = m.get("t")
+                if m.get("i") is None:
+                    if t == "ref":
+                        ref_rows.extend(m["d"])
+                        continue
+                    if t == "obj_put":
+                        put_objs.append(m)
+                        continue
+                    if t == "obj_puts":
+                        put_objs.extend(m["objs"])
+                        continue
+                _flush_merged()
                 self._send_gcs(m)
             elif m[0] == "actor":
+                _flush_merged()
                 self._dispatch_actor_call(*m[1:])
             else:  # ("task", key, wire, item)
+                _flush_merged()
                 _, key, wire, item = m
                 cls = self._task_classes.get(key)
                 if cls is None:
@@ -1987,6 +2223,7 @@ class Worker:
                 cls.queue.append(item)
                 self._inflight[item.msg["tid"]] = ("queued", cls, item)
                 pumped.add(key)
+        _flush_merged()
         if retained:
             with self._out_lock:
                 # Prepend so original order holds when the link returns.

@@ -384,17 +384,19 @@ class Executor:
         else:
             args, kwargs = deserialize(memoryview(msg["args"]))
         # Resolve top-level ObjectRef arguments (reference semantics:
-        # ``DependencyResolver`` inlines resolved args, nested refs stay refs).
+        # ``DependencyResolver`` inlines resolved args, nested refs stay
+        # refs). Positional and keyword refs resolve through ONE batched
+        # get — one wait-group frame for the whole argument list instead
+        # of a round trip per ref (the 10k-args-to-one-task shape).
         flat = list(args)
         ref_idx = [i for i, a in enumerate(flat) if isinstance(a, ObjectRef)]
-        if ref_idx:
-            vals = self.worker.get([flat[i] for i in ref_idx])
+        kw_keys = [k for k, v in kwargs.items() if isinstance(v, ObjectRef)]
+        if ref_idx or kw_keys:
+            vals = self.worker.get([flat[i] for i in ref_idx]
+                                   + [kwargs[k] for k in kw_keys])
             for i, v in zip(ref_idx, vals):
                 flat[i] = v
-        kw_ref = {k: v for k, v in kwargs.items() if isinstance(v, ObjectRef)}
-        if kw_ref:
-            vals = self.worker.get(list(kw_ref.values()))
-            for (k, _), v in zip(kw_ref.items(), vals):
+            for k, v in zip(kw_keys, vals[len(ref_idx):]):
                 kwargs[k] = v
         return tuple(flat), kwargs
 
