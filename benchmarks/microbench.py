@@ -23,7 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import ray_tpu  # noqa: E402
 
 
+_ONLY = None  # compiled row filter (--only)
+
+
 def timeit(name, fn, number: int, results: dict):
+    if _ONLY is not None and not _ONLY.search(name):
+        return  # filtered out: setup/warmup ran, timing skipped
     t0 = time.perf_counter()
     fn(number)
     dt = time.perf_counter() - t0
@@ -32,9 +37,17 @@ def timeit(name, fn, number: int, results: dict):
 
 
 def main():
+    global _ONLY
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--only", default="",
+                        help="regex: time only matching rows (setup still "
+                             "runs, so later rows keep their state)")
     args = parser.parse_args()
+    if args.only:
+        import re
+
+        _ONLY = re.compile(args.only)
     scale = 0.2 if args.quick else 1.0
 
     ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
@@ -417,11 +430,12 @@ def main():
     # up next to the rate it tanks.
     # Guarded: a smoke failure (cluster spin-up timeout on a loaded CI
     # host) must not discard every metric measured above.
-    try:
-        results["object_broadcast_small"] = broadcast_smoke(
-            mb=16 if args.quick else 32)
-    except Exception as e:
-        results["object_broadcast_small"] = {"error": repr(e)}
+    if _ONLY is None or _ONLY.search("object_broadcast_small"):
+        try:
+            results["object_broadcast_small"] = broadcast_smoke(
+                mb=16 if args.quick else 32)
+        except Exception as e:
+            results["object_broadcast_small"] = {"error": repr(e)}
 
     print(json.dumps(results))
 

@@ -1,0 +1,256 @@
+"""Multi-driver harness: N real driver processes against ONE cluster.
+
+Fills the last honest N/A in BASELINE.md (`multi_client_tasks_async`,
+reference 21,824 tasks/s on m4.16xlarge): every number benched before
+this harness was single-driver, while the north star — many concurrent
+controllers sharing one control plane ("Exploring the limits of
+Concurrency in ML Training on Google TPUs", PAPERS.md) — is exactly the
+multi-tenant shape. Each driver is a REAL process doing
+``ray_tpu.init(address=...)`` under its own tenant namespace, submitting
+through its own lease plane; the parent aggregates per-driver
+throughput + latency and samples the GCS's CPU from /proc.
+
+Modes (``--mode``):
+  tasks_async  N drivers each submit async no-op task batches for a
+               fixed window -> the BASELINE row. Aggregate = sum of
+               per-driver completions / window.
+  fairness     driver 0 FLOODS the GCS with raw control frames
+               (obj_put+ref bursts, no throttle) while drivers 1..N-1
+               run tasks_async. Reports min/mean per-driver task
+               throughput — the fair-admission bound (>= 0.5 asserted in
+               tests/test_multi_tenant.py).
+
+Usage:
+  python benchmarks/multi_driver.py [--drivers 4] [--seconds 8]
+                                    [--mode tasks_async] [--cpus 8]
+Prints one JSON object. The test fixture (tests/test_multi_driver.py)
+imports ``run_multi_driver`` directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# ----------------------------------------------------------- driver child
+
+DRIVER = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("RAY_TPU_JAX_PLATFORM", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu
+
+ADDR, MODE, SECONDS, IDX = (sys.argv[1], sys.argv[2], float(sys.argv[3]),
+                            int(sys.argv[4]))
+BATCH = int(os.environ.get("MD_BATCH", "100"))
+
+ray_tpu.init(address=ADDR, namespace=f"tenant-{IDX}", probe_tpu=False)
+
+@ray_tpu.remote
+def _noop():
+    return 1
+
+# Warmup: spin this driver's leases + workers and ship the function def.
+ray_tpu.get([_noop.remote() for _ in range(BATCH)])
+print("READY", flush=True)
+sys.stdin.readline()  # start barrier: parent releases all drivers at once
+
+done = 0
+lat = []
+t_end = time.perf_counter() + SECONDS
+t_start = time.perf_counter()
+while time.perf_counter() < t_end:
+    t0 = time.perf_counter()
+    out = ray_tpu.get([_noop.remote() for _ in range(BATCH)], timeout=120)
+    lat.append(time.perf_counter() - t0)
+    done += len(out)
+wall = time.perf_counter() - t_start
+lat.sort()
+print(json.dumps({
+    "idx": IDX, "mode": "tasks_async", "tasks": done, "wall_s": round(wall, 3),
+    "tasks_per_s": round(done / wall, 1),
+    "batch": BATCH,
+    "batch_latency_ms": {
+        "p50": round(lat[len(lat) // 2] * 1e3, 2) if lat else None,
+        "p99": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2)
+        if lat else None,
+        "max": round(lat[-1] * 1e3, 2) if lat else None,
+    }}), flush=True)
+ray_tpu.shutdown()
+'''
+
+# A flooding tenant: raw pre-encoded control frames at socket speed (the
+# shape admission control exists for). Deliberately NOT a ray_tpu driver
+# loop — the point is an adversarial firehose, bounded only by the GCS's
+# willingness to read.
+FLOODER = r'''
+import asyncio, json, os, sys, time
+sys.path.insert(0, %(repo)r)
+from ray_tpu._private import protocol
+from ray_tpu._private.ids import ObjectID, WorkerID
+
+ADDR, SECONDS = sys.argv[1], float(sys.argv[3])
+
+async def main():
+    reader, writer = await protocol.connect(ADDR)
+    conn = protocol.Connection(reader, writer)
+    conn.start()
+    await conn.request({"t": "hello", "role": "driver",
+                        "worker_id": WorkerID.from_random().binary(),
+                        "namespace": "tenant-flood",
+                        "pid": os.getpid()}, timeout=30)
+    import msgpack
+    payload = b"x" * 64
+    frames = []
+    for _ in range(500):
+        oid = ObjectID.from_random().binary()
+        for m in ({"t": "obj_put", "oid": oid, "nbytes": 64,
+                   "data": payload},
+                  {"t": "ref", "d": [(oid, 1)]}):
+            b = msgpack.packb(m, use_bin_type=True)
+            frames.append(len(b).to_bytes(4, "little") + b)
+    blob = b"".join(frames)
+    print("READY", flush=True)
+    await asyncio.get_running_loop().run_in_executor(
+        None, sys.stdin.readline)
+    sent = 0
+    t_end = time.perf_counter() + SECONDS
+    t0 = time.perf_counter()
+    while time.perf_counter() < t_end:
+        writer.write(blob)
+        await writer.drain()
+        sent += len(frames)
+    wall = time.perf_counter() - t0
+    print(json.dumps({"idx": 0, "mode": "flood", "frames": sent,
+                      "wall_s": round(wall, 3),
+                      "frames_per_s": round(sent / wall, 1)}), flush=True)
+
+asyncio.run(main())
+'''
+
+
+# Shared /proc sampling helpers (one definition for both harnesses).
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from gcs_saturation import _cpu_seconds, _gcs_pid  # noqa: E402
+
+
+def spawn_driver(addr: str, mode: str, seconds: float, idx: int,
+                 batch: int = 100) -> subprocess.Popen:
+    code = (FLOODER if mode == "flood" else DRIVER) % {"repo": _REPO}
+    env = dict(os.environ, MD_BATCH=str(batch), JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-c", code, addr, mode, str(seconds), str(idx)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env)
+
+
+def run_multi_driver(addr: str, n_drivers: int, seconds: float,
+                     mode: str = "tasks_async", batch: int = 100,
+                     gcs_pid: int = 0) -> dict:
+    """Spawn ``n_drivers`` real driver processes against ``addr``, start
+    them on a shared barrier, aggregate per-driver results."""
+    modes = ["tasks_async"] * n_drivers
+    if mode == "fairness":
+        modes[0] = "flood"
+    procs = [spawn_driver(addr, m, seconds, i, batch)
+             for i, m in enumerate(modes)]
+    try:
+        # Barrier: all drivers warmed up before any starts its window.
+        for p in procs:
+            line = p.stdout.readline()
+            assert line.strip() == "READY", \
+                f"driver failed to start: {line!r}\n{p.stderr.read()[:2000]}"
+        c0 = _cpu_seconds(gcs_pid) if gcs_pid else 0.0
+        t0 = time.perf_counter()
+        for p in procs:
+            p.stdin.write("\n")
+            p.stdin.flush()
+        rows = []
+        for p in procs:
+            out, err = p.communicate(timeout=seconds * 20 + 120)
+            line = out.strip().splitlines()[-1] if out.strip() else "{}"
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                raise AssertionError(
+                    f"driver emitted no JSON: {out[:500]!r} / {err[:2000]}")
+        window = time.perf_counter() - t0
+        gcs_cpu = ((_cpu_seconds(gcs_pid) - c0) / window if gcs_pid
+                   else None)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    task_rows = [r for r in rows if r.get("mode") == "tasks_async"]
+    rates = [r["tasks_per_s"] for r in task_rows]
+    total = sum(r["tasks"] for r in task_rows)
+    result = {
+        "mode": mode,
+        "drivers": n_drivers,
+        "window_s": round(window, 2),
+        "per_driver": rows,
+        "aggregate_tasks_per_s": round(total / window, 1),
+        "sum_of_rates": round(sum(rates), 1),
+    }
+    if rates:
+        mean = sum(rates) / len(rates)
+        result["fairness"] = {
+            "min_rate": round(min(rates), 1),
+            "mean_rate": round(mean, 1),
+            "min_over_mean": round(min(rates) / mean, 3) if mean else None,
+        }
+    if gcs_cpu is not None:
+        result["gcs_cpu_fraction"] = round(gcs_cpu, 3)
+    if mode == "fairness":
+        flood = next((r for r in rows if r.get("mode") == "flood"), None)
+        if flood:
+            result["flood_frames_per_s"] = flood.get("frames_per_s")
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--drivers", type=int, default=4)
+    parser.add_argument("--seconds", type=float, default=8.0)
+    parser.add_argument("--mode", default="tasks_async",
+                        choices=["tasks_async", "fairness"])
+    parser.add_argument("--cpus", type=int, default=8)
+    parser.add_argument("--batch", type=int, default=100)
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=args.cpus, probe_tpu=False,
+                 ignore_reinit_error=True)
+    addr = "unix:" + os.path.join(global_worker().session_dir, "gcs.sock")
+    result = run_multi_driver(addr, args.drivers, args.seconds,
+                              mode=args.mode, batch=args.batch,
+                              gcs_pid=_gcs_pid())
+    # Control-plane context: shard balance + per-tenant ingress after the
+    # run (who actually flooded, what admission did about it).
+    st = global_worker().request_gcs({"t": "gcs_stats"})
+    result["gcs"] = {
+        "shards": st.get("shards"),
+        "backpressure_events":
+            (st.get("admission") or {}).get("backpressure_events"),
+        "ingress_tenants": [
+            {"namespace": c["namespace"], "frames_in": c["frames_in"]}
+            for c in st.get("ingress", [])
+            if c["role"] == "driver" and c["namespace"] != "default"],
+    }
+    print(json.dumps({"multi_driver": result}))
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

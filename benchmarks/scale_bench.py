@@ -105,7 +105,25 @@ def main():
     for a in actors:
         ray_tpu.kill(a)
 
-    results["host_cores"] = os.cpu_count()
+    # Host context + outlier-rule coverage (VERDICT r5 #10): scale rows —
+    # many_pgs in particular, the PR 5 create-rate fix's regression guard
+    # — adopt the microbench convention: each run records this host's
+    # memcpy ceiling, and runs whose ceiling is <60% of the median
+    # ceiling are excluded from cross-run medians (raw runs retained).
+    buf = bytearray(64 << 20)
+    src = os.urandom(1 << 20) * 64
+    memoryview(buf)[:] = src  # untimed warmup
+    t0 = time.perf_counter()
+    memoryview(buf)[:] = src
+    results["host"] = {
+        "cores": os.cpu_count(),
+        "memcpy_gbps": round(len(src) / (time.perf_counter() - t0) / 1e9,
+                             2),
+    }
+    results["outlier_rule"] = (
+        "runs whose host memcpy ceiling is <60% of the median ceiling "
+        "are excluded from cross-run medians (incl. many_pgs); raw runs "
+        "retained")
     print(json.dumps(results))
     ray_tpu.shutdown()
 

@@ -75,6 +75,42 @@ class RayTpuConfig:
     # when this many accumulate, else on the next loop tick (a burst of
     # obj_put registrations resolves a whole group in one obj_res frame).
     obj_res_flush_rows: int = 512
+    # ---- multi-tenant control plane (sharding / fairness / admission)
+    # Hot directory tables (objects/actors/PGs) partition into this many
+    # independent sub-dicts (rounded up to a power of two). 1 disables.
+    gcs_shards: int = 8
+    # Fair per-connection frame drain: each registered client gets at
+    # most this many frames handled per round-robin cycle, so one
+    # flooding connection cannot monopolize the control loop between
+    # yields (reference analog: gRPC's per-call completion-queue
+    # fairness the single-reader asyncio loop otherwise lacks). 256
+    # bounds a tenant's burst monopoly at ~2.5ms of GCS time while
+    # keeping the yield overhead unmeasurable (64 cost ~20% of the raw
+    # frame ceiling; per-RPC costs at 256 match the pre-fairness plane
+    # — SCALE_BENCH_r07 A/B).
+    gcs_fair_slice: int = 256
+    # Admission control: a DRIVER with more than this many frames queued
+    # inside the GCS gets a backpressure frame and its socket stops being
+    # read (kernel backpressure) until the queue drains below the low
+    # water mark. Lanes are naturally paced to O(fair_slice) by the
+    # mid-chunk yields, so a lane this deep means the drain has genuinely
+    # stalled behind this tenant (blocking handler, overload) — the
+    # budget is a stall guard, not a steady-state throttle. Workers and
+    # agents are exempt — stalling the data plane or health checks to
+    # punish a tenant would be self-harm.
+    admission_inflight_high: int = 4_096
+    admission_inflight_low: int = 1_024
+    # Per-tenant quotas: JSON {namespace: {resource: amount}} enforced at
+    # lease grant and placement-group reservation. A demand that can
+    # NEVER fit its namespace quota fails cleanly (lease_void / pg error
+    # reply); one that only transiently exceeds it waits like any other
+    # resource shortage. Empty = no quotas.
+    tenant_quotas: str = ""
+    # Namespace isolation: when true, a driver can only resolve/kill
+    # named actors in its own namespace (get_actor across namespaces
+    # errors). Off by default — the reference allows explicit
+    # cross-namespace lookup, and single-tenant clusters rely on it.
+    tenant_isolation: bool = False
     # ---- fault tolerance
     reconnect_attempts: int = 75    # GCS reconnect budget (x delay ~15s)
     reconnect_delay_s: float = 0.2

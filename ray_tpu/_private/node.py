@@ -30,6 +30,11 @@ from .config import config as _cfg
 
 DEFAULT_STORE_CAPACITY = _cfg().store_capacity
 
+# Rows per obj_report frame (agent arena resync). Own constant: sizing
+# these frames with a reference-plane knob (obj_waits_max_batch) would
+# couple two unrelated tuning surfaces.
+_OBJ_REPORT_BATCH = 4096
+
 
 def default_session_root() -> str:
     return os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
@@ -538,10 +543,14 @@ class NodeAgent:
             objs = store.list_objects()
         except Exception:
             return
-        if objs:
-            self.conn.send({
-                "t": "obj_report",
-                "objs": [[oid.binary(), n] for oid, n in objs]})
+        # Chunked frames: a big arena (tens of thousands of objects)
+        # must not arrive as one giant frame — the GCS fair drain hands
+        # every connection bounded slices, and one monolithic report
+        # would both bloat the frame and stall its decode slot.
+        rows = [[oid.binary(), n] for oid, n in objs]
+        for i in range(0, len(rows), _OBJ_REPORT_BATCH):
+            self.conn.send({"t": "obj_report",
+                            "objs": rows[i:i + _OBJ_REPORT_BATCH]})
 
     # ------------------------------------------------ p2p object serving
     # The node-to-node half of the object plane (reference: object manager

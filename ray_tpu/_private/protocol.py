@@ -204,6 +204,18 @@ class Connection:
         # data plane collapsed to ~1.4k frames/s before this).
         self._drain_waiting = False
         self._affinity_check = None  # set in start() when checks enabled
+        # Ingress accounting (read loop increments): the per-connection
+        # rate signal the GCS fairness/admission stats surface — who is
+        # actually flooding the control plane, in frames and bytes.
+        self.frames_in = 0
+        self.bytes_in = 0
+        # Cooperative fairness (server-side use): when set, the read
+        # loop yields to the event loop every N dispatched frames, so a
+        # single connection's 1MB chunk (thousands of decoded frames)
+        # cannot monopolize the loop — and a consumer draining parked
+        # frames (the GCS fair drain) interleaves instead of watching a
+        # queue balloon. None = legacy behavior (no mid-chunk yields).
+        self.yield_every: Optional[int] = None
 
     def start(self):
         loop = asyncio.get_running_loop()
@@ -407,6 +419,7 @@ class Connection:
                 chunk = await self.reader.read(1 << 20)
                 if not chunk:
                     break
+                self.bytes_in += len(chunk)
                 if carry:
                     carry += chunk
                     src: Any = carry
@@ -472,7 +485,11 @@ class Connection:
                                 length)
                             msg = {}
                         pos = end
+                        self.frames_in += 1
                         await self._dispatch_frame(msg)
+                        ye = self.yield_every
+                        if ye is not None and self.frames_in % ye == 0:
+                            await asyncio.sleep(0)
                 finally:
                     # The view must die before the bytearray resize below
                     # (exported views block it with a BufferError).
@@ -509,7 +526,13 @@ class Connection:
             if not fut.done():
                 fut.set_result(msg)
         elif self._handler is not None:
-            await self._handler(msg)
+            # Handlers may be plain functions returning None (cheap
+            # enqueue paths — the GCS fair-ingress hot path) or an
+            # awaitable / coroutine functions; only await real
+            # awaitables so the sync path pays no coroutine setup.
+            res = self._handler(msg)
+            if res is not None:
+                await res
 
     def _mark_closed(self):
         if self._closed:

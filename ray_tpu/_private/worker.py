@@ -403,6 +403,9 @@ class Worker:
         self.role = role
         self.worker_id = WorkerID.from_random()
         self.namespace = "default"
+        # Admission-control state pushed by the GCS (backpressure frames):
+        # while True, lease growth pauses; existing leases keep draining.
+        self._gcs_backpressured = False
         self.closed = False
         self.client_mode = False
         self.session_name: Optional[str] = None
@@ -556,6 +559,9 @@ class Worker:
             "t": "hello", "role": self.role,
             "worker_id": self.worker_id.binary(),
             "pid": os.getpid(),
+            # Tenant identity: quotas and named-actor isolation key on
+            # the namespace this driver connected under.
+            "namespace": getattr(self, "namespace", "default"),
         }
         if self.node_id is not None:
             hello["node_id"] = self.node_id
@@ -585,6 +591,7 @@ class Worker:
                     "t": "hello", "role": self.role,
                     "worker_id": self.worker_id.binary(),
                     "pid": os.getpid(),
+                    "namespace": getattr(self, "namespace", "default"),
                     **({"node_id": self.node_id}
                        if self.node_id is not None else {}),
                 }, timeout=30)
@@ -610,6 +617,9 @@ class Worker:
     def _resync_after_reconnect(self, gcs_restarted: bool = True):
         """Rebuild GCS-side state that only this process knows.
 
+        0. Admission state: a fresh (or resynced) GCS has no memory of
+           having backpressured us, and would never send the 'off'
+           frame — a stale flag would freeze lease growth forever.
         1. Live ref counts — ONLY when the GCS actually restarted (epoch
            changed): a fresh instance starts all refcounts at zero.
            Replaying them into a surviving GCS after a mere link blip
@@ -618,6 +628,7 @@ class Worker:
         3. Owned inline values not yet re-registered (promote-pending).
         Lease demand refreshes itself on the next pump.
         """
+        self._gcs_backpressured = False
         if gcs_restarted:
             with self._ref_lock:
                 # Queued deltas are already folded into _live_refs; the
@@ -1625,6 +1636,17 @@ class Worker:
             self._on_lease_revoked(msg)
         elif t == "lease_nudge":
             self._on_lease_nudge()
+        elif t == "backpressure":
+            # GCS admission control: this tenant exceeded its in-flight
+            # frame budget. The GCS has already stopped reading our
+            # socket (kernel backpressure throttles the flood); the
+            # advisory frame additionally pauses lease GROWTH — existing
+            # leases keep draining, so progress continues at the current
+            # allocation instead of amplifying the burst.
+            self._gcs_backpressured = bool(msg.get("on"))
+            if not self._gcs_backpressured:
+                for cls in self._task_classes.values():
+                    self._pump_class(cls)
         elif t == "lease_void":
             # The GCS voided our demand (e.g. the targeted placement
             # group was removed): queued tasks of this class can never
@@ -1853,7 +1875,8 @@ class Worker:
         if backlog0:
             want = min(backlog0, _MAX_LEASES_PER_CLASS) - len(cls.leases) \
                 - cls.demand
-            if want > 0 and backlog0 > free_base:
+            if want > 0 and backlog0 > free_base \
+                    and not self._gcs_backpressured:
                 cls.demand += want
                 self._send_gcs({"t": "lease_req", "key": cls.key,
                                 "n": want, **cls.wire})

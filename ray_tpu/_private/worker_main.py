@@ -354,6 +354,42 @@ class Executor:
             self.fn_cache[fid] = fn
         return fn
 
+    def _load_args_fast(self, msg: dict):
+        """Loop-safe arg loading for coroutine dispatch: returns
+        ``(args, kwargs, needs_resolve)`` when the argument BYTES can be
+        materialized without blocking (no store read), else None and the
+        caller takes the full executor path. ``needs_resolve`` is True
+        when top-level ObjectRefs remain — the caller must finish with
+        ``_resolve_top_refs`` in an executor (worker.get blocks), but
+        NEVER by re-running ``_load_args``: deserializing the same
+        payload twice would create two ref wrappers whose __del__ deltas
+        double-debit the sender's single pickled incref.
+
+        This is the async-def dispatch fix (MICROBENCH_r06 filed
+        pathology: 0.33x the threaded-sync path): the old path paid a
+        default-executor thread handoff per call — thread wake + loop
+        wake back, ~50-100us — to load arguments that for the dominant
+        call shapes (no args / small inline args / direct-lane args) are
+        microseconds of pure CPU. Those now load inline on the actor's
+        running loop."""
+        ab = msg.get("args")
+        if ab is not None and bytes(ab) == serialization.empty_args_bytes():
+            return (), {}, False
+        if msg.get("argsref") is not None:
+            return None  # shm/GCS fetch: may block
+        if msg.get("ap") is not None:
+            import pickle
+
+            args, kwargs = pickle.loads(bytes(msg["ap"]),
+                                        buffers=msg.get("_bufs") or [])
+        elif ab is not None:
+            args, kwargs = deserialize(memoryview(ab))
+        else:
+            return None
+        need = any(isinstance(a, ObjectRef) for a in args) or \
+            any(isinstance(v, ObjectRef) for v in kwargs.values())
+        return tuple(args), kwargs, need
+
     def _load_args(self, msg: dict) -> Tuple[tuple, dict]:
         # No-arg calls (the hottest control-plane shape) carry one
         # canonical byte string (serialization.empty_args_bytes, shared
@@ -383,11 +419,15 @@ class Executor:
             args, kwargs = deserialize(view.data, pin=view.transfer())
         else:
             args, kwargs = deserialize(memoryview(msg["args"]))
-        # Resolve top-level ObjectRef arguments (reference semantics:
-        # ``DependencyResolver`` inlines resolved args, nested refs stay
-        # refs). Positional and keyword refs resolve through ONE batched
-        # get — one wait-group frame for the whole argument list instead
-        # of a round trip per ref (the 10k-args-to-one-task shape).
+        return self._resolve_top_refs(args, kwargs)
+
+    def _resolve_top_refs(self, args, kwargs) -> Tuple[tuple, dict]:
+        """Resolve top-level ObjectRef arguments (reference semantics:
+        ``DependencyResolver`` inlines resolved args, nested refs stay
+        refs). Positional and keyword refs resolve through ONE batched
+        get — one wait-group frame for the whole argument list instead
+        of a round trip per ref (the 10k-args-to-one-task shape).
+        Blocking: runs off the loop."""
         flat = list(args)
         ref_idx = [i for i, a in enumerate(flat) if isinstance(a, ObjectRef)]
         kw_keys = [k for k, v in kwargs.items() if isinstance(v, ObjectRef)]
@@ -712,8 +752,21 @@ class Executor:
                                          if self.actor_id else None),
                                resources=(self.actor_opts or {}).get("res"))
                 async with sem:
-                    args, kwargs = await loop.run_in_executor(
-                        None, self._load_args, msg)
+                    fast = self._load_args_fast(msg)
+                    if fast is None:
+                        args, kwargs = await loop.run_in_executor(
+                            None, self._load_args, msg)
+                    elif fast[2]:
+                        # Refs present: only the blocking RESOLUTION
+                        # hops to a thread — never a re-deserialize.
+                        args, kwargs = await loop.run_in_executor(
+                            None, self._resolve_top_refs, fast[0],
+                            fast[1])
+                    else:
+                        # Dispatch stays on the actor's running loop: no
+                        # per-call thread handoff for args that load in
+                        # microseconds (the async-def pathology fix).
+                        args, kwargs = fast[0], fast[1]
                     tp = (msg.get("opts") or {}).get("tp")
                     if tp:
                         from ray_tpu.util import tracing
@@ -767,8 +820,15 @@ class Executor:
             if self.actor_instance is None:
                 raise serialization.ActorDiedError("actor not initialized")
             method = getattr(self.actor_instance, msg["m"])
-            args, kwargs = await loop.run_in_executor(
-                None, self._load_args, msg)
+            fast = self._load_args_fast(msg)
+            if fast is None:
+                args, kwargs = await loop.run_in_executor(
+                    None, self._load_args, msg)
+            elif fast[2]:
+                args, kwargs = await loop.run_in_executor(
+                    None, self._resolve_top_refs, fast[0], fast[1])
+            else:
+                args, kwargs = fast[0], fast[1]
             import inspect
 
             if inspect.isasyncgenfunction(method):

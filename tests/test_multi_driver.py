@@ -1,0 +1,39 @@
+"""Multi-driver harness smoke (tier-1): N REAL driver processes against
+one cluster — the fixture behind the `multi_client_tasks_async` BASELINE
+row and the fairness bound. Kept small (2 drivers, short window) so the
+harness itself cannot rot without CI noticing."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+from multi_driver import run_multi_driver  # noqa: E402
+
+
+def test_two_driver_smoke():
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    try:
+        addr = "unix:" + os.path.join(global_worker().session_dir,
+                                      "gcs.sock")
+        result = run_multi_driver(addr, 2, seconds=2.0, batch=50)
+        rows = result["per_driver"]
+        assert len(rows) == 2
+        # Both REAL driver processes made progress through their own
+        # lease planes, concurrently.
+        for r in rows:
+            assert r["tasks"] > 0, r
+            assert r["tasks_per_s"] > 0, r
+        assert result["aggregate_tasks_per_s"] > 0
+        assert result["fairness"]["min_over_mean"] > 0
+        # The tenants arrived under distinct namespaces (hello plumbing).
+        st = global_worker().request_gcs({"t": "gcs_stats"})
+        assert st["ok"]
+        assert st["shards"]["objects"]["nshards"] >= 1
+    finally:
+        ray_tpu.shutdown()
