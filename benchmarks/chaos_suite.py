@@ -1,0 +1,688 @@
+"""Chaos certification suite: seeded fault schedules against every plane.
+
+PRs 3-6 rebuilt the data (direct arg lane), broadcast (chunk striping),
+reference (wait groups) and control (sharded multi-tenant GCS) planes for
+speed; this suite systematically kills processes, drops/truncates frames,
+and crash-restarts the GCS INSIDE those fast paths, then asserts end-state
+invariants — results correct, refcounts drained, tenant usage back to
+zero, no leaked leases/arenas/orphan processes (the shared core in
+``ray_tpu.util.invariants``, also the pytest ``invariants`` fixture).
+
+Every schedule is (spec, seed): a deterministic failpoint schedule
+(``ray_tpu._private.failpoints``) armed through the environment so the
+head/agent/worker processes inherit it. Any failing run prints the seed,
+the spec, and the fired-failpoint journal — one-command reproducible::
+
+    python benchmarks/chaos_suite.py --only gcs_crash_post_wal
+    python benchmarks/chaos_suite.py --tier fast   # the tier-1 subset
+    python benchmarks/chaos_suite.py               # everything
+
+Fault classes covered (acceptance asks >= 8): frame drop, injected send
+failure, truncation mid-SG-payload, disconnect, GCS crash pre-WAL, GCS
+crash post-WAL, GCS crash mid-wait-group-registration, GCS crash
+mid-lease-rebalance, worker kill mid-call, worker kill mid-direct-arg,
+broadcast holder short-read / chunk miss, lost spawn request, store
+seal failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import traceback
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# --------------------------------------------------------------- workloads
+#
+# Each workload runs under an armed failpoint schedule, inside a cluster
+# this module controls, and VERIFIES ITS OWN RESULTS (chaos that corrupts
+# answers must fail loudly, not just slowly). They return a metrics dict.
+
+
+def workload_lineage(n: int = 48) -> dict:
+    """Task graph with dependencies: a fan of chains whose final values
+    are checkable arithmetic — exercises the lease plane, task retries,
+    and owner-side reconstruction."""
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=8)
+    def add(a, b):
+        return a + b
+
+    refs = []
+    for i in range(n):
+        r = add.remote(i, 1)
+        r = add.remote(r, 10)
+        r = add.remote(r, 100)
+        refs.append(r)
+    out = ray_tpu.get(refs, timeout=120)
+    expect = [i + 111 for i in range(n)]
+    assert out == expect, f"lineage results wrong: {out[:5]}..."
+    return {"tasks": 3 * n}
+
+
+def workload_direct_args(calls: int = 30, kb: int = 200,
+                         restarts: int = 4) -> dict:
+    """Actor traffic whose args ride the out-of-band direct lane
+    (inline_threshold < size < direct_arg_threshold): checksummed echo,
+    restartable actor, retryable methods — a kill mid-direct-arg call
+    must re-ship the payload."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote(max_restarts=restarts, max_task_retries=8)
+    class Echo:
+        def csum(self, arr):
+            return int(arr.sum())
+
+    a = Echo.remote()
+    rng = np.random.RandomState(7)
+    arrs = [rng.randint(0, 255, size=kb * 1024 // 8).astype(np.int64)
+            for _ in range(4)]
+    refs, expect = [], []
+    for i in range(calls):
+        arr = arrs[i % len(arrs)]
+        refs.append(a.csum.remote(arr))
+        expect.append(int(arr.sum()))
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == expect, "direct-arg checksums wrong"
+    ray_tpu.kill(a)
+    return {"calls": calls, "arg_kb": kb}
+
+
+def workload_wait_groups(n: int = 150) -> dict:
+    """A wait-group burst on the PR 5 batched ``obj_waits`` lane. The
+    subtlety: a driver waiting on its OWN task returns never touches the
+    GCS wait lane (results ride the direct worker connection), so the
+    GCS-side wait groups are exercised by a CONSUMER task whose worker
+    must resolve n still-running foreign refs — one batched obj_waits
+    frame full of genuinely pending rows, the state a crash
+    mid-group-registration tears."""
+    import time as _time
+
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=8)
+    def val(i):
+        _time.sleep(0.1)  # still pending when the consumer subscribes
+        return i * 3
+
+    @ray_tpu.remote(max_retries=8)
+    def consume(refs):
+        # the foreign wait-group under test IS this blocking get
+        return sum(ray_tpu.get(refs))  # raylint: disable=RTL001
+
+    refs = [val.remote(i) for i in range(n)]
+    # Zero-resource, own scheduling class: same-class FIFO would
+    # dispatch the consumer only after every producer finished, and the
+    # producers hold every CPU — the consumer must place NOW so its
+    # wait group subscribes while the producers are still PENDING.
+    total_ref = consume.options(num_cpus=0).remote(refs)
+    ready, pending = ray_tpu.wait(refs, num_returns=n, timeout=120)
+    assert not pending, f"{len(pending)} refs never resolved"
+    out = ray_tpu.get(refs, timeout=60)
+    assert out == [i * 3 for i in range(n)], "wait-group values wrong"
+    total = ray_tpu.get(total_ref, timeout=120)
+    assert total == sum(i * 3 for i in range(n)), "foreign wait sum wrong"
+    return {"refs": n, "foreign_sum": total}
+
+
+def workload_puts(n: int = 40, kb: int = 256) -> dict:
+    """Store create/seal churn: sized so objects land on shm (not
+    inline). Injected seal failures must surface cleanly AND leave no
+    stranded arena blocks (host invariant checks the arena after)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.failpoints import FailpointError
+
+    ok = 0
+    injected = 0
+    for i in range(n):
+        arr = np.full(kb * 128, i, dtype=np.float64)  # kb KiB
+        try:
+            ref = ray_tpu.put(arr)
+        except FailpointError:
+            injected += 1
+            continue
+        got = ray_tpu.get(ref, timeout=30)
+        assert got[0] == i and got.shape == arr.shape
+        ok += 1
+        del ref, got
+    assert ok > 0, "no put ever succeeded"
+    return {"puts_ok": ok, "seal_failures_injected": injected}
+
+
+def workload_broadcast(nodes: int = 4, mb: int = 12) -> dict:
+    """Multi-node cooperative broadcast (the PR 4 plane): one blob pulled
+    by every node concurrently, chunk serves failing under the armed
+    schedule — every puller must still land the exact payload via
+    chunk-granular failover. Returns the per-puller transport stats."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import serialization
+    from ray_tpu.cluster_utils import Cluster
+
+    @ray_tpu.remote(max_retries=4)
+    def fetch_len(wrapped):
+        # wrapped ref: the in-task get IS the broadcast under test
+        blob = ray_tpu.get(wrapped[0])  # raylint: disable=RTL001
+        return (len(blob),
+                serialization.transport_stats()["bcast_chunk_retries"])
+
+    c = Cluster(connect=True)
+    for i in range(nodes - 1):
+        c.add_node(num_cpus=1, resources={f"b{i}": 4})
+    try:
+        assert c.wait_for_nodes(nodes, timeout=120)
+        assert c.wait_for_workers(timeout=120)
+        payload = np.random.RandomState(3).bytes(mb << 20)
+        opts = [dict(resources={f"b{i}": 1}) for i in range(nodes - 1)]
+        # Warm leases + serve sockets first.
+        small = ray_tpu.put(b"x")
+        ray_tpu.get([fetch_len.options(**o).remote([small]) for o in opts],
+                    timeout=60)
+        ref = ray_tpu.put(payload)
+        outs = ray_tpu.get(
+            [fetch_len.options(**o).remote([ref]) for o in opts],
+            timeout=180)
+        assert all(ln == len(payload) for ln, _ in outs), \
+            f"broadcast payloads wrong: {[ln for ln, _ in outs]}"
+        return {"nodes": nodes, "mb": mb,
+                "chunk_retries": sum(r for _, r in outs)}
+    finally:
+        c.shutdown()
+
+
+_TENANT_CHILD = r'''
+import ray_tpu
+ray_tpu.init(address=%(addr)r, namespace="tenant_b", probe_tpu=False)
+
+@ray_tpu.remote(max_retries=8)
+def burn(i):
+    return i * 2
+
+out = ray_tpu.get([burn.remote(i) for i in range(%(n)d)], timeout=120)
+assert out == [i * 2 for i in range(%(n)d)]
+ray_tpu.shutdown()
+print("CHILD_OK")
+'''
+
+
+def workload_tenants(n: int = 200) -> dict:
+    """Two quota'd drivers (REAL second driver process) contending for
+    the lease pool: the main driver saturates first, the late joiner
+    must still finish (fair-share rebalance — and an injected crash
+    mid-rebalance must recover), and BOTH tenants' usage must return to
+    zero afterwards (the lease_claim resync re-charge)."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote(max_retries=8)
+    def burn(i):
+        return i * 2
+
+    refs = [burn.remote(i) for i in range(n)]
+    addr = "unix:" + os.path.join(global_worker().session_dir, "gcs.sock")
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                     RAY_TPU_JAX_PLATFORM="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _TENANT_CHILD % {"addr": addr, "n": n}],
+        capture_output=True, text=True, timeout=240, cwd=_REPO,
+        env=child_env)
+    assert proc.returncode == 0 and "CHILD_OK" in proc.stdout, (
+        f"tenant child failed\nstdout:{proc.stdout[-2000:]}\n"
+        f"stderr:{proc.stderr[-2000:]}")
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == [i * 2 for i in range(n)]
+    return {"tasks_per_tenant": n}
+
+
+def workload_gang(n: int = 4) -> dict:
+    """The gang fault plane's acceptance schedule: a 4-process gang
+    forms (registration -> generation 1), joins gang-bound collectives
+    (rendezvous), and the armed ``train.collective.r2=once:kill``
+    failpoint SIGKILLs rank 2 in the gap between rendezvous and the
+    first collective. Survivors must fail TYPED and FAST — membership
+    push, not timeout expiry (asserted against ``collective_timeout_s``)
+    — and the gang must re-form at N-1 under the SAME name (generation
+    2) and complete its first collective."""
+    import ray_tpu
+    from ray_tpu._private.config import config as _cfg
+    from ray_tpu.train.worker_group import (WorkerGroup,
+                                            WorkerGroupMemberLost)
+
+    g = WorkerGroup(n, {"CPU": 1.0}, gang_name="chaos-gang",
+                    formation_timeout_s=60.0)
+    gen1 = g.generation
+    assert gen1 >= 1
+    gn = g.setup_gang_collectives()
+    t0 = time.time()
+    detect_s = None
+    try:
+        try:
+            g.run_collective("gang_barrier", gn,
+                             timeout=_cfg().collective_timeout_s)
+            raise AssertionError(
+                "gang survived a kill schedule that must fire")
+        except WorkerGroupMemberLost as e:
+            detect_s = time.time() - t0
+            assert e.generation == gen1
+            bound = _cfg().collective_timeout_s / 4
+            assert detect_s < bound, (
+                f"loss surfaced in {detect_s:.1f}s — that is timeout "
+                f"territory (bound {bound:.0f}s), not a membership push")
+    finally:
+        g.shutdown()
+    # Elastic reshape: same gang name, N-1 ranks, generation must bump.
+    # The schedule is per-PROCESS (the reshaped rank 2 is a new process
+    # whose first train.collective.r2 hit would fire again): the
+    # re-formed generation runs DISARMED via env_per_worker — the
+    # schedule certifies the generation-1 gap, the reshape certifies
+    # recovery.
+    g2 = WorkerGroup(n - 1, {"CPU": 1.0}, gang_name="chaos-gang",
+                     formation_timeout_s=60.0,
+                     env_per_worker=[{"RAY_TPU_FAILPOINTS": ""}
+                                     for _ in range(n - 1)])
+    try:
+        assert g2.generation == gen1 + 1, (gen1, g2.generation)
+        gn2 = g2.setup_gang_collectives()
+        out = g2.run_collective("gang_barrier", gn2, timeout=60.0)
+        assert sorted(out) == list(range(n - 1))
+    finally:
+        g2.shutdown()
+    return {"detect_s": round(detect_s, 2),
+            "generations": [gen1, g2.generation]}
+
+
+def workload_coord_death(n: int = 3, rounds: int = 8) -> dict:
+    """Coordinator-actor death mid-allreduce stream: the armed
+    ``collective.coord.collect=hitK:kill`` failpoint SIGKILLs the
+    coordinator's worker process partway through a run of allreduces.
+    Ranks must surface a typed/connection failure fast (never the flat
+    timeout), and re-joining the SAME group name must produce a fresh
+    coordinator that completes the remaining rounds correctly."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import config as _cfg
+    from ray_tpu.train.worker_group import (WorkerGroup,
+                                            WorkerGroupMemberLost)
+
+    from ray_tpu._private.serialization import ActorDiedError
+
+    g = WorkerGroup(n, {"CPU": 1.0}, gang_name="chaos-coord",
+                    formation_timeout_s=60.0)
+    deaths = 0
+    done = 0
+    try:
+        gn = g.setup_gang_collectives()
+        vec = np.ones(8)
+        while done < rounds:
+            t0 = time.time()
+            try:
+                outs = g.run_collective("gang_allreduce", vec, gn,
+                                        timeout=_cfg().collective_timeout_s)
+                for o in outs:
+                    assert np.array_equal(o, vec * n), o
+                done += 1
+            except (ActorDiedError, ConnectionError):
+                # The coordinator died (not a member): recovery is a
+                # re-join — same group name, fresh coordinator actor.
+                wall = time.time() - t0
+                assert wall < _cfg().collective_timeout_s / 2, (
+                    f"coordinator death took {wall:.1f}s to surface")
+                deaths += 1
+                assert deaths <= 4, "coordinator dying every round?"
+                gn = g.setup_gang_collectives()
+    finally:
+        g.shutdown()
+    assert deaths >= 1, "kill schedule never fired on the coordinator"
+    return {"rounds": done, "coordinator_deaths": deaths}
+
+
+def workload_drain_pipeline() -> dict:
+    """Drain-mid-1F1B (the gang fault plane composed with the PR 1
+    drain lifecycle): a 2-node, 2-stage MPMD pipeline; the node hosting
+    stage 1 receives a drain notice mid-schedule (with an injected
+    admission stall from the armed ``mpmd.admit`` delay). The step must
+    stop admitting at a microbatch boundary, checkpoint the merged
+    params while the draining stage is reachable, and the reshaped
+    pipeline must train entirely off the draining node."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.models import LlamaConfig, init_params
+    from ray_tpu.parallel.mpmd_pipeline import (MPMDPipeline,
+                                                PipelineDrainSignal)
+    from ray_tpu.util import state as state_api
+
+    cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=4, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq_len=32,
+                      dtype=jnp.float32, tie_embeddings=False)
+    c = Cluster(connect=True)
+    c.add_node(num_cpus=2, resources={"s1": 2})
+    pipe = pipe2 = None
+    try:
+        assert c.wait_for_nodes(2, timeout=120)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (12, 16), 0, cfg.vocab_size))
+        pipe = MPMDPipeline(cfg, params, n_stages=2, n_microbatches=6,
+                            simulate_compute_s=0.15,
+                            stage_options=[{}, {"resources": {"s1": 1}}])
+        actors = {a["actor_id"]: a.get("node_id")
+                  for a in state_api.list_actors()}
+        doomed = actors[pipe.stages[1]._id.hex()]
+        assert np.isfinite(pipe.step(tokens))  # warm full schedule
+        threading.Timer(0.4, lambda: ray_tpu.drain_node(
+            doomed, reason="preemption notice", deadline_s=60.0)).start()
+        try:
+            pipe.step(tokens)
+            raise AssertionError("drain notice never interrupted the step")
+        except PipelineDrainSignal as sig:
+            assert 0 < sig.completed_microbatches < 6, sig
+            assert 1 in sig.draining_stages
+            ckpt = sig.checkpoint_path
+            completed = sig.completed_microbatches
+        pipe.teardown()
+        pipe = None
+        pipe2 = MPMDPipeline.from_checkpoint(ckpt, cfg, n_stages=2,
+                                             n_microbatches=2,
+                                             drain_aware=False)
+        assert np.isfinite(pipe2.step(tokens[:4]))
+        actors = {a["actor_id"]: a.get("node_id")
+                  for a in state_api.list_actors()}
+        for s in pipe2.stages:
+            assert actors[s._id.hex()] != doomed, (
+                "reshaped stage landed on the draining node")
+        return {"completed_microbatches": completed,
+                "checkpoint": os.path.basename(ckpt)}
+    finally:
+        for p in (pipe, pipe2):
+            if p is not None:
+                p.teardown()
+        c.shutdown()
+
+
+WORKLOADS = {
+    "lineage": workload_lineage,
+    "direct_args": workload_direct_args,
+    "wait_groups": workload_wait_groups,
+    "puts": workload_puts,
+    "broadcast": workload_broadcast,
+    "tenants": workload_tenants,
+    "gang": workload_gang,
+    "coord_death": workload_coord_death,
+    "drain_pipeline": workload_drain_pipeline,
+}
+
+# -------------------------------------------------------------- schedules
+#
+# tier "fast": deterministic fire-once/hit-K schedules, no heavyweight
+# cluster shapes — the tier-1 subset (tests/test_chaos_planes.py).
+# tier "slow": probabilistic schedules and multi-node clusters.
+
+SCHEDULES = [
+    # --- transport faults on the direct-arg actor lane
+    dict(name="actor_call_send_raise", tier="fast", seed=11,
+         spec="conn.send.actor_call=hit3:raise",
+         workload="direct_args", fault="injected send failure"),
+    dict(name="actor_call_short_frame", tier="fast", seed=12,
+         spec="conn.send.actor_call=hit5:short",
+         workload="direct_args", fault="truncation mid-SG-payload"),
+    dict(name="actor_call_disconnect", tier="fast", seed=13,
+         spec="conn.send.actor_call=hit4:disconnect",
+         workload="direct_args", fault="disconnect"),
+    dict(name="actor_call_raise_p", tier="slow", seed=14,
+         spec="conn.send.actor_call=p0.2:raise",
+         workload="direct_args", fault="injected send failure"),
+    # --- GCS crash-restart at durable-state boundaries
+    dict(name="gcs_crash_pre_wal", tier="fast", seed=21,
+         spec="gcs.wal.before=hit3:crash",
+         workload="lineage", fault="GCS crash pre-WAL"),
+    dict(name="gcs_crash_post_wal", tier="fast", seed=22,
+         spec="gcs.wal.after=hit3:crash",
+         workload="lineage", fault="GCS crash post-WAL"),
+    dict(name="gcs_crash_mid_waitgroup", tier="fast", seed=23,
+         spec="gcs.obj_waits.mid=once:crash",
+         workload="wait_groups", fault="GCS crash mid-registration"),
+    dict(name="gcs_crash_mid_direct_args", tier="fast", seed=25,
+         spec="gcs.wal.after=hit2:crash",
+         workload="direct_args",
+         fault="GCS crash mid direct-arg actor traffic"),
+    dict(name="gcs_crash_mid_rebalance", tier="slow", seed=24,
+         spec="gcs.rebalance.mid=once:crash",
+         workload="tenants", fault="GCS crash mid-lease-rebalance"),
+    # --- worker kills inside the dispatch fast paths. The hit-K counts
+    # are PER PROCESS, and replacement workers fire too, so K sets the
+    # kill RATE (~1/K of dispatches are fatal), not a one-shot — and
+    # retry burn is CORRELATED: a death fails every task pipelined on
+    # that lease (up to lease_window=8), so one cohort loses a retry per
+    # death it rides through. K is chosen so total deaths stay under the
+    # certified retry budget with margin (K=2 made ~40% of dispatches
+    # fatal and exhausted any finite max_retries by design — certifying
+    # nothing).
+    dict(name="worker_kill_mid_task", tier="fast", seed=31,
+         spec="worker.exec=hit16:kill",
+         workload="lineage", kwargs={"n": 24},
+         fault="worker kill mid-call"),
+    dict(name="worker_kill_mid_direct_arg", tier="fast", seed=32,
+         spec="worker.direct_arg=hit8:kill",
+         workload="direct_args", kwargs={"calls": 30, "restarts": 8},
+         fault="worker kill mid-direct-arg"),
+    # --- frame loss inside the GCS dispatch plane (advisory lanes +
+    #     the spawn plane, which must decay stale slots)
+    dict(name="gcs_drop_advisory_frames", tier="fast", seed=41,
+         spec=("gcs.dispatch.obj_progress=every2:drop;"
+               "gcs.dispatch.task_notes=every3:drop"),
+         workload="wait_groups", fault="frame drop"),
+    dict(name="spawn_request_lost", tier="fast", seed=42,
+         spec="node.spawn_worker=hit1:drop",
+         workload="lineage", fault="frame drop (spawn plane)"),
+    # --- store create/seal
+    dict(name="store_seal_fails", tier="fast", seed=51,
+         spec="store.seal=every3:raise",
+         workload="puts", fault="store seal failure"),
+    # --- broadcast chunk serving (multi-node: slow tier)
+    dict(name="bcast_short_read", tier="slow", seed=61,
+         spec="bcast.serve.chunk=p0.1:short",
+         workload="broadcast", fault="holder short-read mid-stripe"),
+    dict(name="bcast_chunk_miss", tier="slow", seed=62,
+         spec="bcast.serve.chunk=p0.15:drop",
+         workload="broadcast", fault="chunk miss / retryable drop"),
+    dict(name="bcast_holder_disconnect", tier="slow", seed=63,
+         spec="bcast.serve.chunk=p0.08:raise",
+         workload="broadcast", fault="holder death mid-stripe"),
+    # --- gang fault plane (generation-stamped membership + fail-fast
+    #     collectives + drain-aware pipeline reshape)
+    dict(name="gang_rendezvous_gap_kill", tier="fast", seed=71,
+         spec="train.collective.r2=once:kill",
+         workload="gang", config={"collective_timeout_s": 240.0},
+         fault="member kill between rendezvous and first collective"),
+    dict(name="gang_coordinator_death_mid_allreduce", tier="fast",
+         seed=72, spec="collective.coord.collect=hit12:kill",
+         workload="coord_death", config={"collective_timeout_s": 120.0},
+         fault="coordinator-actor death mid-allreduce"),
+    dict(name="drain_mid_1f1b", tier="slow", seed=73,
+         spec="mpmd.admit=hit3:delay:0.2",
+         workload="drain_pipeline",
+         fault="drain notice mid-1F1B schedule"),
+]
+
+
+# ---------------------------------------------------------------- driver
+
+
+def _cross_process_fires(session_dir) -> list:
+    """Fired-failpoint lines from EVERY session process's log (head,
+    zygote, workers): the driver's in-process journal only sees its own
+    sites, but most schedules fire inside the GCS or a worker — the
+    logs are the cross-process half of the repro record."""
+    import glob
+
+    out = []
+    if not session_dir or not os.path.isdir(session_dir):
+        return out
+    for path in glob.glob(os.path.join(session_dir, "*.out")):
+        try:
+            with open(path, errors="replace") as f:
+                for line in f:
+                    if "failpoint fired:" in line:
+                        out.append(f"{os.path.basename(path)}: "
+                                   f"{line.strip()[-140:]}")
+        except OSError:
+            continue
+    return out
+
+
+def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
+    """Run one seeded schedule end to end: arm failpoints -> init an own
+    cluster -> workload -> invariants (cluster then host) -> disarm.
+    Raises with the seed + fired-failpoint journal on ANY failure."""
+    import ray_tpu
+    from ray_tpu._private import failpoints
+    from ray_tpu.util import invariants
+
+    if ray_tpu.is_initialized():
+        raise RuntimeError("run_schedule needs a fresh (uninitialized) "
+                           "process state")
+    failpoints.set_failpoints(sched["spec"], sched["seed"])
+    failpoints.reset_journal()
+    session = None
+    session_dir = None
+    t0 = time.time()
+    try:
+        overrides = dict(sched.get("config") or {})
+        # Faster convergence under injected faults: short spawn decay,
+        # snappy health checks. Schedules can override.
+        overrides.setdefault("spawn_timeout_s", 3.0)
+        overrides.setdefault("health_check_interval_s", 1.0)
+        manages_cluster = sched["workload"] in ("broadcast",
+                                                "drain_pipeline")
+        if not manages_cluster:
+            ray_tpu.init(num_cpus=4, probe_tpu=False,
+                         _system_config=overrides)
+        metrics = WORKLOADS[sched["workload"]](**sched.get("kwargs", {}))
+        from ray_tpu._private.worker import global_worker
+
+        if ray_tpu.is_initialized():
+            session = global_worker().session_name
+            session_dir = global_worker().session_dir
+            invariants.check_cluster_invariants()
+            if not keep_cluster:
+                ray_tpu.shutdown()
+        if not keep_cluster:
+            invariants.check_host_invariants(session)
+        fired = ([f"driver: {seq} {site} -> {act}"
+                  for seq, _pid, site, act in failpoints.fired_schedule()]
+                 + _cross_process_fires(session_dir))
+        return {"name": sched["name"], "seed": sched["seed"],
+                "spec": sched["spec"], "fault": sched["fault"],
+                "ok": True, "wall_s": round(time.time() - t0, 2),
+                "metrics": metrics, "fired": fired}
+    except BaseException as e:
+        # Repro ergonomics: a red run prints everything needed to rerun
+        # it — the schedule name, seed, spec, and what actually fired.
+        print(f"\nCHAOS FAILURE in schedule {sched['name']!r} "
+              f"(seed={sched['seed']}, spec={sched['spec']!r})",
+              file=sys.stderr)
+        print(failpoints.format_schedule(), file=sys.stderr)
+        if session_dir is None:
+            try:
+                from ray_tpu._private.worker import global_worker
+
+                session_dir = global_worker().session_dir
+            except Exception:
+                pass
+        for line in _cross_process_fires(session_dir):
+            print("  " + line, file=sys.stderr)
+        print(f"repro: python benchmarks/chaos_suite.py "
+              f"--only {sched['name']}", file=sys.stderr)
+        raise AssertionError(
+            f"chaos schedule {sched['name']} failed: {e}") from e
+    finally:
+        failpoints.clear_failpoints()
+        if not keep_cluster and ray_tpu.is_initialized():
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", help="run one schedule by name")
+    ap.add_argument("--tier", choices=["fast", "slow", "all"],
+                    default="all")
+    ap.add_argument("--json", help="write results JSON here")
+    args = ap.parse_args(argv)
+
+    todo = [s for s in SCHEDULES
+            if (args.only is None or s["name"] == args.only)
+            and (args.tier == "all" or s["tier"] == args.tier)]
+    if not todo:
+        known = [s["name"] for s in SCHEDULES]
+        ap.error(f"no schedules match (known: {known})")
+
+    results = []
+    failed = []
+    for sched in todo:
+        print(f"=== chaos schedule {sched['name']} "
+              f"(seed={sched['seed']}, {sched['fault']}) ===", flush=True)
+        # Each schedule in a SUBPROCESS: a cluster's process/env state
+        # must never leak into the next schedule, and a kill-action
+        # schedule must not take the suite down with it.
+        code = (f"import sys; sys.path.insert(0, {_REPO!r});"
+                f"import json; from benchmarks.chaos_suite import "
+                f"run_schedule, SCHEDULES;"
+                f"s=[x for x in SCHEDULES if x['name']=={sched['name']!r}][0];"
+                f"print('RESULT=' + json.dumps(run_schedule(s)))")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=600, cwd=_REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     RAY_TPU_JAX_PLATFORM="cpu"))
+        row = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT="):
+                row = json.loads(line[len("RESULT="):])
+        if proc.returncode != 0 or row is None:
+            failed.append(sched["name"])
+            print(f"FAIL {sched['name']}\nstdout:{proc.stdout[-3000:]}\n"
+                  f"stderr:{proc.stderr[-3000:]}")
+            results.append({"name": sched["name"], "seed": sched["seed"],
+                            "spec": sched["spec"], "ok": False})
+        else:
+            print(f"PASS {sched['name']} wall={row['wall_s']}s "
+                  f"fired={len(row['fired'])} metrics={row['metrics']}")
+            results.append(row)
+    print(f"\nchaos suite: {len(results) - len(failed)}/{len(results)} "
+          f"schedules passed"
+          + (f"; FAILED: {failed}" if failed else ""))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"schedules": results}, f, indent=2)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception:
+        traceback.print_exc()
+        sys.exit(2)

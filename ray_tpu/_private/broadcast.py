@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional
 
 import msgpack
 
+from . import failpoints
 from .protocol import _LEN, _SG_FLAG, MAX_FRAME, pack
 
 # ----------------------------------------------------------------- bitmaps
@@ -123,6 +124,35 @@ def serve_obj_fetch(conn, msg: dict, view, *, miss: bool = False,
         except ConnectionError:
             pass
         return
+    if failpoints.active():
+        # Chunk-serve boundary (framed relay path): ``drop`` answers a
+        # retryable miss, ``short``/``disconnect`` die mid-reply — the
+        # puller must fail over to another holder at CHUNK granularity.
+        try:
+            act = failpoints.fire("bcast.serve.chunk")
+        except failpoints.FailpointError:
+            view.close()
+            raise
+        if act == "drop":
+            view.close()
+            try:
+                conn.reply(msg, {"ok": False, "miss": True})
+            except ConnectionError:
+                pass
+            return
+        if act == "short":
+            reply = {"i": msg.get("i"), "r": 1, "ok": True,
+                     "total": total, "off": off}
+            part = view.data[off:off + length]
+            try:
+                conn._fp_short_write(reply, [part])
+            finally:
+                view.close()
+            return
+        if act == "disconnect":
+            view.close()
+            conn._abort_transport()
+            return
     if msg.get("sg") and length:
         part = view.data[off:off + length]
         if stats is not None:
@@ -207,6 +237,37 @@ def _serve_conn_blocking(sock: socket.socket, resolve: Callable,
                 view.close()
                 sock.sendall(pack({"i": rid, "r": 1, "ok": False}))
                 continue
+            if failpoints.active():
+                # Chunk-serve boundary (raw-socket path — the one the
+                # 4-node broadcast actually rides): ``drop`` = retryable
+                # miss; ``short`` = header claims the full chunk, half
+                # the payload lands, socket dies (a holder crashing
+                # mid-sendall); ``raise``/``disconnect`` = socket dies
+                # cold. All must resolve as chunk-granular failover.
+                try:
+                    act = failpoints.fire("bcast.serve.chunk")
+                except failpoints.FailpointError:
+                    view.close()
+                    raise  # ConnectionError -> outer OSError handler
+                if act == "drop":
+                    view.close()
+                    sock.sendall(pack({"i": rid, "r": 1, "ok": False,
+                                       "miss": True}))
+                    continue
+                if act in ("short", "disconnect"):
+                    try:
+                        if act == "short" and ln:
+                            header = msgpack.packb(
+                                {"i": rid, "r": 1, "ok": True,
+                                 "total": total, "off": off, "bl": [ln]},
+                                use_bin_type=True)
+                            sock.sendall(
+                                _LEN.pack((4 + len(header) + ln) | _SG_FLAG)
+                                + _LEN.pack(len(header)) + header)
+                            sock.sendall(view.data[off:off + ln // 2])
+                    finally:
+                        view.close()
+                    return  # outer finally closes the socket mid-frame
             try:
                 if msg.get("sg") and ln:
                     header = msgpack.packb(

@@ -111,14 +111,44 @@ class RayTpuConfig:
     # errors). Off by default — the reference allows explicit
     # cross-namespace lookup, and single-tenant clusters rely on it.
     tenant_isolation: bool = False
+    # ---- gang fault plane (train worker groups / host collectives)
+    # Rendezvous cap for the shm-collective coordinator (was a hard-coded
+    # 300s asyncio.wait_for): a rank blocked past this raises a typed
+    # CollectiveTimeout NAMING the ranks that never arrived. Membership
+    # loss never waits this out — the gang push fails pending ops in
+    # event time; the timeout is the backstop for live-but-stuck peers.
+    collective_timeout_s: float = 300.0
+    # After a membership-loss push, how long the worker group waits for
+    # survivors to unwedge themselves (their pending collectives error
+    # out via the coordinator's fail-fast path) before SIGKILLing the
+    # ranks still blocked (e.g. wedged inside jax.distributed, which has
+    # no cooperative abort).
+    gang_abort_grace_s: float = 5.0
     # ---- fault tolerance
     reconnect_attempts: int = 75    # GCS reconnect budget (x delay ~15s)
     reconnect_delay_s: float = 0.2
+    # Shared jittered-exponential-backoff policy for reconnect/retry
+    # loops (_private/backoff.py): delays grow base * factor^n up to the
+    # cap, each multiplied by a uniform jitter in [1-j, 1] so retry
+    # storms from many peers decorrelate instead of thundering in step.
+    retry_backoff_base_s: float = 0.02
+    retry_backoff_cap_s: float = 2.0
+    retry_backoff_jitter: float = 0.5
+    # ---- deterministic failpoints (chaos certification; see
+    # _private/failpoints.py for the spec grammar). The env vars
+    # RAY_TPU_FAILPOINTS / RAY_TPU_FAILPOINT_SEED win over these flags so
+    # one process can arm/disarm under a cluster-wide _system_config.
+    failpoints: str = ""
+    failpoint_seed: int = 0
     driver_exit_grace_s: float = 3.0
     actor_adoption_grace_s: float = 5.0
     gcs_wal_compact_every: int = 50_000
     health_check_interval_s: float = 5.0   # GCS->agent active pings
     health_check_failures: int = 3         # misses before node is dead
+    # In-flight worker-spawn slots with no worker hello within this
+    # window are released (a spawn_worker frame lost between GCS and
+    # agent must not pin the pool's spawn budget forever).
+    spawn_timeout_s: float = 15.0
     # ---- graceful node drain (ALIVE -> DRAINING -> DEAD)
     drain_deadline_s: float = 30.0         # default migration window
     preemption_poll_interval_s: float = 1.0  # agent notice-source poll

@@ -32,7 +32,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from . import protocol
+from . import failpoints, protocol
 from .broadcast import bitmap_make, bitmap_set, bitmap_test
 from .config import config as _cfg
 from .gcs_shards import ShardedDict
@@ -98,6 +98,11 @@ class NodeInfo:
         self.idle_workers: deque = deque()  # WorkerID
         self.workers: Set[WorkerID] = set()
         self.spawning = 0
+        # Stale-spawn decay (chaos-found): a spawn request lost between
+        # GCS and agent (dropped frame, agent crash mid-spawn) would pin
+        # ``spawning`` forever — the health loop releases slots whose
+        # worker hello never arrived within spawn_timeout_s.
+        self.spawn_ts = 0.0
         self.last_active = time.time()  # autoscaler idle tracking
         # P2P object plane: the agent's chunk-serving address and which
         # arena it serves ("" = the head-host arena).
@@ -268,6 +273,40 @@ class ActorRecord:
         self.restored = False
 
 
+# Gang lifecycle (train fault plane): FORMING is client-side (the group
+# registers once every member answered its formation ping), so the GCS
+# only ever holds ACTIVE and DEGRADED records; RESHAPING is the window
+# between a deregister/teardown and the next register, which lands as a
+# NEW record at generation+1.
+G_ACTIVE = "ACTIVE"
+G_DEGRADED = "DEGRADED"
+
+
+class GangRecord:
+    """A gang-scheduled worker group's membership record.
+
+    The fault-plane primitive: members (rank -> actor id) plus a
+    per-name MONOTONIC generation number assigned by the GCS at
+    registration (durable across control-plane restarts via WAL, so a
+    superseded gang can never reuse a generation). Death and drain
+    lifecycle events on any member PUSH a ``gang:<name>`` pubsub event
+    to survivors — membership loss is detected in event time, never by
+    waiting out a collective timeout."""
+
+    __slots__ = ("name", "generation", "members", "lost", "status",
+                 "owner", "ts")
+
+    def __init__(self, name: str, generation: int,
+                 member_aids: List[ActorID], owner: "ClientConn"):
+        self.name = name
+        self.generation = generation
+        self.members: Dict[int, ActorID] = dict(enumerate(member_aids))
+        self.lost: Dict[int, str] = {}
+        self.status = G_ACTIVE
+        self.owner = owner
+        self.ts = time.time()
+
+
 class ObsTaskRecord:
     """Observability-only task record built from owner task notes (the
     direct lease path never routes task state through the scheduler)."""
@@ -308,6 +347,22 @@ class PGRecord:
         # group's aggregate demand (quota is charged at reservation).
         self.tenant = getattr(owner, "namespace", None) or "default"
         self.quota_charged = False
+
+
+class _ClaimedLeaseCtx:
+    """Lease context rebuilt from a post-restart ``lease_claim`` resync:
+    carries exactly what release-time accounting needs (tenant + charged
+    resources; never PG-scoped — PG leases don't survive a restart as
+    claims). Exists so quota usage charged at re-claim is released by the
+    same ``_release_lease`` path as a normal grant's."""
+
+    __slots__ = ("tenant", "resources", "pg", "bundle")
+
+    def __init__(self, tenant: str, resources: Dict[str, float]):
+        self.tenant = tenant
+        self.resources = resources
+        self.pg = None
+        self.bundle = None
 
 
 class LeaseDemand:
@@ -514,6 +569,15 @@ class GcsServer:
                            _cfg().tenant_quotas)
             self._tenant_quotas = {}
         self.tenant_usage: Dict[str, Dict[str, float]] = {}
+        # Gang fault plane: live gang records by name, the per-name
+        # monotonic generation counters (durable — snapshot + WAL), and
+        # the member-actor -> gang index the death/drain paths consult.
+        # Live records are EPHEMERAL across a GCS restart (the owning
+        # driver re-registers at the next formation); the counters are
+        # not, so generations stay strictly monotonic through crashes.
+        self.gangs: Dict[str, GangRecord] = {}
+        self.gang_gens: Dict[str, int] = {}
+        self._actor_gangs: Dict[ActorID, str] = {}
         # Generalized pubsub (reference: src/ray/pubsub/publisher.h) —
         # actor-state / node-event / error / job channels + user channels.
         from .pubsub import Publisher
@@ -600,7 +664,43 @@ class GcsServer:
 
     # --------------------------------------------------------- persistence
 
+    def _fp(self, site: str, key: Optional[str] = None):
+        """GCS-side failpoint hit: translates the ``crash`` action into an
+        in-place control-plane crash-restart (the supervisor rebuilds a
+        fresh instance from WAL + arena, every connection drops — the same
+        path as a real GCS death) and unwinds the current handler with a
+        FailpointError so the dying instance sends NO reply."""
+        act = failpoints.fire(site, key)
+        if act == "crash":
+            self._chaos_crash(site if key is None else f"{site}[{key}]")
+            raise failpoints.FailpointError(
+                f"GCS crashed at failpoint {site!r}")
+        return act
+
+    def _chaos_crash(self, why: str):
+        """Crash the control plane in place (failpoint action ``crash``):
+        same teardown as the ``gcs_restart`` chaos op, but triggerable
+        mid-handler — e.g. between a state mutation and its WAL append —
+        so recovery is exercised from genuinely torn intermediate states."""
+        if self.restart_requested:
+            return
+        logger.warning("GCS crash injected at %s (%s)", why,
+                       failpoints.format_schedule())
+        self.restart_requested = True
+
+        async def _teardown():
+            await self.stop_serving()
+            self._shutdown_event.set()
+
+        asyncio.get_running_loop().create_task(_teardown())
+
     def _log_append(self, op: str, payload):
+        if failpoints.active():
+            # Crash BEFORE the WAL append: the mutation this op records is
+            # lost with the instance — recovery must reconverge from
+            # resyncs alone (the torn-write case a buffered real crash
+            # leaves behind).
+            self._fp("gcs.wal.before", op)
         if self.log is not None:
             try:
                 self.log.append(op, payload)
@@ -608,6 +708,10 @@ class GcsServer:
             except OSError:
                 logger.exception("GCS WAL append failed; disabling WAL")
                 self.log = None
+        if failpoints.active():
+            # Crash AFTER the append: the record is durable but the reply
+            # /side effects never happened — replay must be idempotent.
+            self._fp("gcs.wal.after", op)
 
     def _make_snapshot(self) -> dict:
         actors = []
@@ -628,6 +732,8 @@ class GcsServer:
             "inline": [[e.object_id.binary(), e.inline]
                        for e in self.objects.values()
                        if e.ready and e.inline is not None],
+            "gang_gens": [[name, gen]
+                          for name, gen in self.gang_gens.items()],
         }
 
     def _replay_persisted(self):
@@ -649,6 +755,9 @@ class GcsServer:
                     entry.nbytes = len(data)
                     entry.inline = data
                     entry.ready = True
+            for name, gen in snapshot.get("gang_gens", []):
+                self.gang_gens[name] = max(self.gang_gens.get(name, 0),
+                                           int(gen))
         for op, payload in wal:
             had_any = True
             if op == "kv":
@@ -674,6 +783,13 @@ class GcsServer:
                     entry.ready = True
             elif op == "objd":
                 self.objects.pop(ObjectID(bytes(payload)), None)
+            elif op == "gang":
+                # Generation counters only: live membership is rebuilt by
+                # the owning driver's next registration, but monotonicity
+                # must survive the crash (stale-generation rejection is
+                # meaningless if a restart hands out generation 1 twice).
+                self.gang_gens[payload[0]] = max(
+                    self.gang_gens.get(payload[0], 0), int(payload[1]))
         if not had_any:
             return
         self.resumed = True
@@ -900,6 +1016,15 @@ class GcsServer:
                 logger.warning("dropping typeless message %r",
                                sorted(msg)[:8])
             return
+        if failpoints.active():
+            # Frame-dispatch boundary: drop (frame lost inside the GCS),
+            # delay (stalled loop), or crash (die between receiving a
+            # frame and acting on it).
+            try:
+                if self._fp("gcs.dispatch", t) == "drop":
+                    return
+            except failpoints.FailpointError:
+                return
         handler = getattr(self, f"_h_{t}", None)
         if handler is None:
             logger.warning("unknown message type %r", t)
@@ -916,6 +1041,12 @@ class GcsServer:
     async def _run_handler(self, handler, client: ClientConn, msg: dict):
         try:
             await handler(client, msg)
+        except failpoints.FailpointError:
+            # Injected crash mid-handler: the dying instance must NOT
+            # answer — a clean error reply here would make the client
+            # believe the request failed on a LIVE control plane instead
+            # of retrying against the recovered one.
+            logger.warning("handler %r aborted by failpoint", msg.get("t"))
         except Exception:
             logger.exception("error handling %r", msg.get("t"))
             if msg.get("i") is not None and not client.conn.closed:
@@ -973,6 +1104,7 @@ class GcsServer:
             if node is not None:
                 node.workers.add(worker_id)
                 node.spawning = max(0, node.spawning - 1)
+                node.spawn_ts = time.time()  # progress: refresh the decay
             claimed = False
             stale_actor = False
             aid_b = msg.get("actor_id")
@@ -1468,8 +1600,13 @@ class GcsServer:
             # First sight of this object (put()/actor results): pin the
             # owner's initial reference. Task returns submitted through
             # _h_submit were already pinned there — pinning again here
-            # double-counted and stranded the result forever.
-            entry.refcount += 1
+            # double-counted and stranded the result forever. Resync
+            # re-registrations ("rs": a reconnecting owner replaying
+            # inline values after a GCS restart) adopt ownership WITHOUT
+            # the pin — the owner's live-ref snapshot already accounts
+            # every local reference.
+            if not o.get("rs"):
+                entry.refcount += 1
             entry.owner = owner
             self._owned_objects.setdefault(self._owner_key(owner),
                                            set()).add(oid)
@@ -1577,17 +1714,32 @@ class GcsServer:
                 pending_entries.append(self.objects[ObjectID(ob)])
         need = int(msg.get("nr") or len(seen))
         need = max(1, min(need, len(seen))) if seen else 0
+        half = len(pending_entries) // 2
         if len(rows) >= need:
             client.conn.reply(msg, {"ok": True, "rows": rows})
             if pending_entries:
                 group = WaitGroup(client, msg, need, rows)
                 group.replied = True
                 group.rows = None
-                for entry in pending_entries:
+                for n, entry in enumerate(pending_entries):
+                    if n == half and failpoints.active():
+                        # Crash mid-group registration (threshold-met
+                        # branch — the worker lane's nr=1 groups land
+                        # here): the reply already went out, some
+                        # entries hold the group's waiter, the rest
+                        # never will. Recovery relies on the client's
+                        # epoch-gated resubscription replacing the
+                        # whole group on the fresh instance.
+                        self._fp("gcs.obj_waits.mid")
                     entry.waiters.append(group)
             return
         group = WaitGroup(client, msg, need, rows)
-        for entry in pending_entries:
+        for n, entry in enumerate(pending_entries):
+            if n == half and failpoints.active():
+                # Crash mid-group registration, pre-reply branch: the
+                # client never hears back AND the fresh instance has no
+                # group — same resubscription contract.
+                self._fp("gcs.obj_waits.mid")
             entry.waiters.append(group)
 
     async def _h_obj_contains(self, client, msg):
@@ -1979,8 +2131,28 @@ class GcsServer:
                         node.node_id.hex()[:8], misses.pop(nid_b))
                     self._on_node_death(node.node_id)
 
+        spawn_timeout = _cfg2().spawn_timeout_s
         while not self._shutdown_event.is_set():
             await asyncio.sleep(interval)
+            # Stale-spawn decay: a spawn_worker frame lost in flight (or
+            # an agent that died mid-spawn without reporting) would pin
+            # node.spawning and starve the lease plane of new workers
+            # forever. ONE slot per window, not the whole counter: venv
+            # worker spawns legitimately build environments for minutes
+            # before the hello — zeroing would re-spawn the whole batch
+            # every window, stampeding the node once the builds land.
+            # The rare genuinely-lost slot still drains, a window apiece.
+            now = time.time()
+            for n in self.nodes.values():
+                if (n.spawning > 0
+                        and now - n.spawn_ts > spawn_timeout):
+                    logger.warning(
+                        "releasing 1 of %d stale spawn slot(s) on %s "
+                        "(no worker hello in %.0fs)", n.spawning,
+                        n.node_id.hex()[:8], spawn_timeout)
+                    n.spawning -= 1
+                    n.spawn_ts = now  # next slot gets its own window
+                    self._wake_scheduler()
             targets = [n for n in self.nodes.values()
                        if n.alive and n.agent_conn is not None
                        and not n.agent_conn.closed]
@@ -1991,14 +2163,21 @@ class GcsServer:
 
     async def _h_lease_claim(self, client, msg):
         """A resyncing driver re-claims leases it held across a GCS
-        restart: mark those workers leased (removing them from idle) and
-        charge their resources, restoring pre-restart accounting."""
+        restart: mark those workers leased (removing them from idle),
+        charge their resources, AND re-charge the claimant's tenant quota
+        usage — restoring pre-restart accounting completely. Without the
+        tenant re-charge (the pre-chaos-certification behavior), a
+        quota'd tenant emerged from every GCS restart with its usage
+        zeroed while still HOLDING its leases, so it could acquire up to
+        a full second quota's worth on the fresh instance."""
+        ns = self._client_tenant(client)
         for wid_b, res in msg.get("leases", []):
             w = self.workers.get(WorkerID(bytes(wid_b)))
             if w is None or w.conn.closed:
                 continue
             if w.leased_to is not None and w.leased_to is not client:
                 continue  # already granted elsewhere: claimer loses
+            already = w.leased_to is client
             w.leased_to = client
             node = self.nodes.get(w.node_id)
             if node is not None:
@@ -2010,6 +2189,14 @@ class GcsServer:
                     w.acquired = {k: float(v) for k, v in
                                   (res or {}).items()}
                     _res_sub(node.avail, w.acquired)
+            if w.lease_ctx is None and not already:
+                # Synthetic lease context: release stays symmetric (the
+                # eventual lease_ret must decrement the usage charged
+                # here, exactly as a normal grant's would).
+                ctx = _ClaimedLeaseCtx(ns, {k: float(v) for k, v in
+                                            (res or {}).items()})
+                w.lease_ctx = ctx
+                self._tenant_acquire(ns, ctx.resources)
         self._wake_scheduler()
 
     async def _h_oom_candidates(self, client, msg):
@@ -2260,9 +2447,9 @@ class GcsServer:
     def _release_lease(self, worker: WorkerInfo):
         ctx = worker.lease_ctx
         if ctx is not None and self._tenant_quotas:
-            # Post-restart claimed leases have no ctx: their usage was
-            # never charged, so nothing to release (accounting restarts
-            # clean with the fresh instance).
+            # Covers normal grants AND post-restart re-claims: lease_claim
+            # attaches a _ClaimedLeaseCtx so the usage it re-charged is
+            # released here symmetrically.
             self._tenant_release(ctx.tenant, ctx.resources)
         self._release(worker, worker.lease_ctx)
         worker.leased_to = None
@@ -2325,7 +2512,13 @@ class GcsServer:
         while True:
             await self._sched_wakeup.wait()
             self._sched_wakeup.clear()
-            self._schedule()
+            try:
+                self._schedule()
+            except failpoints.FailpointError:
+                # Injected crash mid-pass: the instance is tearing down
+                # (a fresh one gets a fresh scheduler loop) — just stop
+                # this pass cleanly.
+                pass
 
     def _feasible_nodes(self, res: Dict[str, float]) -> List[NodeInfo]:
         return [n for n in self.nodes.values()
@@ -2578,6 +2771,13 @@ class GcsServer:
                     break
                 self._revoke_lease_for_rebalance(owners[serial], w)
                 revoked += 1
+                if failpoints.active():
+                    # Crash mid-rebalance: some leases are revoked (and
+                    # their lease_revoked frames may or may not have hit
+                    # the wire), the rest still hoarded. Recovery: lessees
+                    # re-claim what they still hold (lease_claim resync)
+                    # and the fresh instance rebalances from scratch.
+                    self._fp("gcs.rebalance.mid")
         if revoked == 0 and all(
                 not holdings.get(d.client.serial) for d in hungry):
             # Pool smaller than the claimant count: nobody exceeds the
@@ -2677,6 +2877,7 @@ class GcsServer:
         while (node.spawning < min(demand, inflight_cap)
                and len(node.workers) + node.spawning < cap):
             node.spawning += 1
+            node.spawn_ts = time.time()
             node.agent_conn.send(spawn_msg)
 
     async def _h_task_done(self, client, msg):
@@ -2848,6 +3049,10 @@ class GcsServer:
             # peer connections to this node (they re-dial if the draining
             # node is still the only holder of something they need).
             self._push_node_addrs_gone(node)
+            # Gang advisory: members on this node are on notice — push
+            # before the migration/revocation churn below so trainers see
+            # the drain as a cooperative checkpoint boundary first.
+            self._gang_node_draining(node, reason, deadline)
             # Proactive migration: every restartable actor on the node is
             # restarted elsewhere NOW (while its state can still be
             # rebuilt under controlled conditions) instead of dying with
@@ -2967,6 +3172,12 @@ class GcsServer:
     def _on_driver_exit(self, client: ClientConn):
         """Non-detached actors owned by an exiting driver are killed; its
         objects are dereferenced; its worker leases are reclaimed."""
+        # Gangs registered by this driver die with it (members are its
+        # non-detached actors anyway): retire the records so a crashed
+        # driver never leaks a DEGRADED gang into the directory forever.
+        for record in [g for g in self.gangs.values()
+                       if g.owner is client]:
+            self._retire_gang(record)
         for worker in self.workers.values():
             if worker.leased_to is client:
                 self._release_lease(worker)
@@ -2987,6 +3198,31 @@ class GcsServer:
 
     async def _h_actor_create(self, client, msg):
         aid = ActorID(msg["aid"])
+        existing = self.actors.get(aid)
+        if existing is not None:
+            # Idempotent retry: the owner re-sends the SAME creation msg
+            # (same client-generated aid) when a GCS crash ate its reply
+            # — the record may be freshly created (crash pre-reply) or
+            # WAL-replayed (crash post-append). Re-link the owner (a
+            # restored record has none; a pre-retry record may hold the
+            # DEAD connection the original request arrived on) and
+            # acknowledge; a second record would double-place the actor,
+            # and the named-actor check below would misreport the retry
+            # as a name collision.
+            if existing.owner is None or existing.owner.conn.closed:
+                existing.owner = client
+            client.conn.reply(msg, {"ok": True})
+            if (existing.state == A_PENDING
+                    and existing.worker_id is None
+                    and not existing.restored
+                    and existing.actor_id not in self._actor_pending_place):
+                # The original handler unwound between record creation
+                # and placement (its reply raised on a just-closed
+                # connection): without this the retry acks an actor that
+                # is never scheduled. Restored records are excluded —
+                # adoption/restart owns their placement.
+                self._try_place_actor(existing)
+            return
         opts = msg.get("opts")
         if opts is None:
             opts = msg["opts"] = {}
@@ -3246,6 +3482,11 @@ class GcsServer:
         record = self.actors.get(actor_id)
         if record is None:
             return
+        # Gang membership loss fires on the DEATH event, before any
+        # restart/migration decision: a member's collective state died
+        # with the process either way, and survivors wedged inside a
+        # collective need the push NOW, not after a restart round-trips.
+        self._gang_member_lost(actor_id, "actor worker died")
         self._release(worker, record)
         if record.migrating:
             # Orchestrated drain migration, not a crash: restart through
@@ -3275,6 +3516,11 @@ class GcsServer:
             self._cleanup_dead_actor(record)
 
     def _cleanup_dead_actor(self, record: ActorRecord):
+        # Covers the death paths that never had a live worker (creation
+        # failure, kill-while-pending); deduped by the gang record, so
+        # the worker-death path firing first is fine.
+        self._gang_member_lost(record.actor_id,
+                               record.death_cause or "actor died")
         self._actor_pending_place.pop(record.actor_id, None)
         self._log_append("actord", record.actor_id.binary())
         self._pub_actor(record, "dead")
@@ -3300,6 +3546,132 @@ class GcsServer:
                         "node": a.node_id.binary() if a.node_id else b"",
                         "restarts": a.restarts_used})
         client.conn.reply(msg, {"ok": True, "actors": out})
+
+    # ------------------------------------------------------ gang fault plane
+
+    @staticmethod
+    def _gang_channel(name: str) -> str:
+        return f"gang:{name}"
+
+    async def _h_gang_register(self, client, msg):
+        """Register a gang's membership (rank-ordered actor ids) under a
+        stable name; assigns the next strictly-monotonic generation for
+        that name. One live record per name — a re-registration (elastic
+        reshape) supersedes the previous record, whose generation can
+        never complete another collective (stale-generation rejection is
+        the coordinator's half of the contract)."""
+        name = str(msg["name"])
+        self._fp("gcs.gang.register", name)
+        aids = [ActorID(a) for a in msg["members"]]
+        gen = self.gang_gens.get(name, 0) + 1
+        self.gang_gens[name] = gen
+        self._log_append("gang", [name, gen])
+        old = self.gangs.get(name)
+        if old is not None:
+            self._retire_gang(old)
+        record = GangRecord(name, gen, aids, client)
+        self.gangs[name] = record
+        for aid in record.members.values():
+            self._actor_gangs[aid] = name
+        client.conn.reply(msg, {"ok": True, "generation": gen})
+        # A member already dead AT registration (lost the formation race
+        # with a kill) is an immediate membership loss: the push fires
+        # right behind the reply, not at the first wedged collective.
+        for rank, aid in list(record.members.items()):
+            a = self.actors.get(aid)
+            if a is None or a.state == A_DEAD:
+                self._gang_member_lost(aid, "dead at gang registration")
+
+    async def _h_gang_deregister(self, client, msg):
+        """Retire a gang record (group shutdown / pre-reshape teardown).
+        Generation-checked: a superseded group's late deregister must not
+        tear down the re-formed gang."""
+        name = str(msg["name"])
+        gen = msg.get("generation")
+        record = self.gangs.get(name)
+        if record is None or (gen is not None
+                              and record.generation != gen):
+            if msg.get("i") is not None:
+                client.conn.reply(msg, {"ok": True, "stale": True})
+            return
+        self._fp("gcs.gang.deregister", name)
+        self._retire_gang(record)
+        self._pub(self._gang_channel(name), {
+            "event": "gang_closed", "gang": name,
+            "generation": record.generation})
+        if msg.get("i") is not None:
+            client.conn.reply(msg, {"ok": True, "stale": False})
+
+    async def _h_gang_info(self, client, msg):
+        """Membership probe: the trainer's escalation path (collective
+        timeout -> probe -> reshape) and tests read this instead of
+        inferring membership from actor states."""
+        name = str(msg["name"])
+        record = self.gangs.get(name)
+        if record is None:
+            client.conn.reply(msg, {
+                "ok": True, "registered": False,
+                "generation": self.gang_gens.get(name, 0)})
+            return
+        client.conn.reply(msg, {
+            "ok": True, "registered": True,
+            "generation": record.generation, "status": record.status,
+            "world": len(record.members),
+            "lost": sorted(record.lost),
+            "lost_causes": {str(r): c for r, c in record.lost.items()}})
+
+    def _retire_gang(self, record: "GangRecord"):
+        self.gangs.pop(record.name, None)
+        for aid in record.members.values():
+            if self._actor_gangs.get(aid) == record.name:
+                self._actor_gangs.pop(aid, None)
+
+    def _gang_member_lost(self, aid: ActorID, cause: str):
+        """Membership-loss push: called from every actor-death path. A
+        restartable member that comes back is still a LOSS — its
+        collective/rendezvous state died with the process, so the gang
+        must reshape regardless."""
+        name = self._actor_gangs.get(aid)
+        if name is None:
+            return
+        record = self.gangs.get(name)
+        if record is None:
+            return
+        fresh = [r for r, a in record.members.items()
+                 if a == aid and r not in record.lost]
+        if not fresh:
+            return
+        for r in fresh:
+            record.lost[r] = cause
+        record.status = G_DEGRADED
+        self._fp("gcs.gang.member_lost", name)
+        logger.info("gang %r gen=%d lost rank(s) %s (%s)", name,
+                    record.generation, fresh, cause)
+        self._pub(self._gang_channel(name), {
+            "event": "member_lost", "gang": name,
+            "generation": record.generation,
+            "ranks": sorted(fresh), "lost_ranks": sorted(record.lost),
+            "world": len(record.members), "cause": cause})
+
+    def _gang_node_draining(self, node, reason: str, deadline: float):
+        """Drain advisory: members on a DRAINING node are about to be
+        lost — push the notice so trainers/pipelines checkpoint at the
+        next boundary and reshape cooperatively instead of discovering
+        the loss at the drain deadline."""
+        for record in self.gangs.values():
+            ranks = []
+            for r, aid in record.members.items():
+                if r in record.lost:
+                    continue
+                a = self.actors.get(aid)
+                if a is not None and a.node_id == node.node_id:
+                    ranks.append(r)
+            if ranks:
+                self._pub(self._gang_channel(record.name), {
+                    "event": "member_draining", "gang": record.name,
+                    "generation": record.generation,
+                    "ranks": sorted(ranks), "reason": reason,
+                    "deadline": deadline})
 
     # ------------------------------------------------------ placement groups
 
@@ -3835,6 +4207,11 @@ class GcsServer:
             "tenant_usage": {ns: {k: round(v, 6) for k, v in u.items()}
                              for ns, u in self.tenant_usage.items()},
             "quota_rejections": self.counters["quota_rejections"],
+            "gangs": {g.name: {"generation": g.generation,
+                               "status": g.status,
+                               "world": len(g.members),
+                               "lost": sorted(g.lost)}
+                      for g in self.gangs.values()},
         })
 
     async def _h_cluster_info(self, client, msg):

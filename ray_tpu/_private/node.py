@@ -23,7 +23,7 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-from . import protocol
+from . import failpoints, protocol
 from .ids import NodeID
 
 from .config import config as _cfg
@@ -632,6 +632,13 @@ class NodeAgent:
 
     def spawn_worker(self, env_spec: Optional[dict] = None,
                      env_key: str = ""):
+        if failpoints.active():
+            # Spawn boundary: ``drop`` loses the spawn request (the GCS's
+            # spawning counter must decay via worker-hello timeout /
+            # re-request, not wedge the lease plane); ``raise`` surfaces
+            # as a spawn failure the env-failure ladder absorbs.
+            if failpoints.fire("node.spawn_worker") == "drop":
+                return
         if env_spec is not None:
             # Venv workers: the (possibly minutes-long, cached-thereafter)
             # environment build must not block the agent loop.
@@ -1191,13 +1198,16 @@ class HeadNode:
             stderr=subprocess.STDOUT)
         ready = os.path.join(self.session_dir, "gcs.ready")
         deadline = time.time() + 30
+        from .backoff import Backoff
+
+        poll = Backoff(base=0.005, cap=0.1, jitter=0.0)
         while not os.path.exists(ready):
             if self.proc.poll() is not None:
                 out = open(os.path.join(self.session_dir, "gcs.out")).read()
                 raise RuntimeError(f"head process failed to start:\n{out}")
             if time.time() > deadline:
                 raise TimeoutError("timed out waiting for the head process")
-            time.sleep(0.01)
+            time.sleep(poll.next_delay())
         if port:
             self.tcp_address = open(ready).read().strip() or None
 

@@ -40,6 +40,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from . import failpoints
+
 _LEN = struct.Struct("<I")
 _SG_FLAG = 0x8000_0000  # top bit of the length prefix: scatter-gather
 MAX_FRAME = 1 << 30
@@ -562,6 +564,71 @@ class Connection:
     def closed(self) -> bool:
         return self._closed
 
+    # ------------------------------------------------ failpoint plumbing
+
+    def _abort_transport(self):
+        """Hard-close without flushing (the injected-crash analog of a
+        peer dying mid-stream: TCP RST / no clean FIN handshake)."""
+        try:
+            self.writer.transport.abort()
+        except Exception:
+            pass
+        self._mark_closed()
+
+    def _fp_short_write(self, msg: dict, buffers):
+        """Truncation fault: emit a frame whose length prefix claims the
+        full payload but whose body stops partway, then close — the peer
+        observes EOF mid-frame (mid-SG-payload for buffer frames), the
+        exact wire state a sender crash leaves behind. The reader is
+        specified to treat it as a disconnect, never to desync."""
+        try:
+            if buffers:
+                parts = pack_with_buffers(msg, buffers)
+                self.writer.write(bytes(parts[0]))
+                if len(parts) > 1 and len(parts[1]):
+                    first = memoryview(parts[1])
+                    self.writer.write(bytes(first[:max(1, len(first) // 2)]))
+            else:
+                data = pack(msg)
+                self.writer.write(data[:max(5, len(data) // 2)])
+            # close() (not abort) flushes the partial bytes before FIN so
+            # the truncation actually reaches the peer.
+            self.writer.close()
+        except Exception:
+            pass
+        self._mark_closed()
+
+    def _fp_outbound(self, msg: dict, buffers, release) -> Optional[str]:
+        """Hit the ``conn.send`` failpoint for an outgoing frame. Returns
+        None (common case) or the caller-action that consumed the frame
+        ("drop"/"short"/"disconnect"); re-raises injected errors after
+        running the release hook (pinned buffers must never leak)."""
+        try:
+            act = failpoints.fire("conn.send", msg.get("t"))
+        except failpoints.FailpointError:
+            if release is not None:
+                release()
+            raise
+        if act is None or act == "delay":
+            return None
+        if act == "drop":
+            # Frame silently lost on the wire: the release hook still runs
+            # (bytes are "gone"), nothing reaches the peer.
+            if release is not None:
+                release()
+            return act
+        if act == "short":
+            self._fp_short_write(msg, buffers)
+            if release is not None:
+                release()
+            return act
+        if act == "disconnect":
+            self._abort_transport()
+            if release is not None:
+                release()
+            return act
+        return None
+
     def outstanding_bytes(self) -> int:
         """Unsent bytes queued on this connection (coalescing buffer +
         transport write buffer) — the pubsub slow-subscriber backpressure
@@ -590,6 +657,9 @@ class Connection:
             if release is not None:
                 release()
             raise
+        if failpoints.active() and self._fp_outbound(msg, buffers,
+                                                     release) is not None:
+            return
         if buffers:
             parts = pack_with_buffers(msg, buffers)
             if release is not None:
@@ -616,6 +686,12 @@ class Connection:
         msg["i"] = rid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        if failpoints.active() and self._fp_outbound(msg, buffers,
+                                                     None) is not None:
+            # Request frame lost/truncated: the reply future stays pending
+            # (dropped frame) or fails via _mark_closed (disconnect/short)
+            # — exactly what the caller's timeout/retry path must absorb.
+            return fut
         if buffers:
             self._write_parts(pack_with_buffers(msg, buffers))
         else:
@@ -700,17 +776,27 @@ async def reconnect_with_retry(attempt, *, should_stop=None,
                                attempts: int = 0, delay: float = 0.0) -> bool:
     """Shared reconnect policy for every GCS client (driver, worker, node
     agent): retry ``attempt`` (an async callable performing connect +
-    re-hello) for ~``attempts*delay`` seconds, returning True on success.
-    One place to tune the retry budget for all peers."""
+    re-hello) within a ``~attempts*delay`` second budget, returning True
+    on success. One place to tune the retry budget for all peers.
+
+    Delays ride the shared jittered-exponential ladder
+    (``_private/backoff.py``) capped at ``delay``: a GCS restart drops
+    EVERY peer at once, and fixed-step retries from dozens of workers
+    would thunder back in lockstep against the recovering instance."""
     if not attempts or not delay:
         from .config import config as _cfg
 
         attempts = attempts or _cfg().reconnect_attempts
         delay = delay or _cfg().reconnect_delay_s
-    for _ in range(attempts):
+    from .backoff import Backoff
+
+    deadline = (asyncio.get_running_loop().time()
+                + max(1, attempts) * max(delay, 1e-3))
+    backoff = Backoff(cap=delay)
+    while asyncio.get_running_loop().time() < deadline:
         if should_stop is not None and should_stop():
             return False
-        await asyncio.sleep(delay)
+        await asyncio.sleep(backoff.next_delay())
         try:
             await attempt()
             return True

@@ -221,6 +221,10 @@ class RemoteFunction:
                 f"{self.__name__}-{hashlib.sha1(self._blob).hexdigest()[:16]}")
         if w.session_name not in self._registered_sessions:
             w.kv_put(self._fid, self._blob, ns="fn")
+            # Shadow for GCS-restart replay: a crash before the WAL
+            # append loses the blob durably, and this session cache
+            # would never re-send — resync replays every noted export.
+            w.note_export("fn", self._fid, self._blob)
             self._registered_sessions.add(w.session_name)
         return self._fid
 
@@ -399,6 +403,10 @@ class ActorClass:
                 f"{self.__name__}-{hashlib.sha1(self._blob).hexdigest()[:16]}")
         if w.session_name not in self._registered_sessions:
             w.kv_put(self._fid, self._blob, ns="fn")
+            # Shadow for GCS-restart replay: a crash before the WAL
+            # append loses the blob durably, and this session cache
+            # would never re-send — resync replays every noted export.
+            w.note_export("fn", self._fid, self._blob)
             self._registered_sessions.add(w.session_name)
         return self._fid
 

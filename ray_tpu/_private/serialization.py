@@ -218,6 +218,11 @@ class _ExportPickler(cloudpickle.CloudPickler):
                         token = ("dx:" + getattr(obj, "__qualname__", "?")
                                  + ":" + hashlib.sha1(blob).hexdigest())
                         w.kv_put(token, blob, ns=_EXPORT_NS)
+                        # Shadowed for GCS-restart replay (see
+                        # Worker._kv_exports): the id cache below never
+                        # re-sends, so a crash-lost export would orphan
+                        # every consumer of this token.
+                        w.note_export(_EXPORT_NS, token, blob)
                         with _export_lock:
                             _id_cache_put(obj, token)
                             _export_by_token.setdefault(token, obj)

@@ -21,7 +21,7 @@ from concurrent.futures import Future as SyncFuture
 from concurrent.futures import TimeoutError as SyncTimeoutError
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import protocol, serialization
+from . import failpoints, protocol, serialization
 from .ids import ActorID, ObjectID, TaskID, WorkerID, _Counter
 from .object_store import make_store
 from .serialization import (
@@ -470,6 +470,13 @@ class Worker:
         self._task_notes: deque = deque()
         self._registered_inline: set = set()
         self._promote_pending: set = set()
+        # Durable-export shadow: (ns, key) -> blob for function/class
+        # exports this process kv_put into the GCS. A GCS that crashed
+        # BEFORE WAL-appending an export loses it durably, and the
+        # exporters' session-level "already registered" caches would
+        # never re-send — the resync replays this shadow (chaos-found,
+        # PR 7; bounded: export blobs only, not user KV).
+        self._kv_exports: Dict[tuple, bytes] = {}
         self._flusher_handle = None
 
     @property
@@ -535,7 +542,12 @@ class Worker:
             import sys
 
             paths = [os.getcwd()] + [p for p in sys.path if p]
-            self.kv_put("driver_sys_path", json.dumps(paths).encode())
+            blob = json.dumps(paths).encode()
+            self.kv_put("driver_sys_path", blob)
+            # Replayed on GCS-restart resync like the code exports: a
+            # crash that loses this key's WAL append would otherwise
+            # leave workers unable to unpickle driver-module functions.
+            self.note_export("", "driver_sys_path", blob)
         return hello
 
     def _run_loop(self):
@@ -652,6 +664,40 @@ class Worker:
                 if len(kept) != len(self._out_q):
                     self._out_q.clear()
                     self._out_q.extend(kept)
+            # Re-register owned inline values (chaos-found, PR 7): put()
+            # registrations and lazy ownership promotions are fire-and-
+            # forget, so a GCS that died before WAL-appending one loses it
+            # — and this owner, believing it already promoted
+            # (_registered_inline), would never re-send. A borrower's
+            # obj_waits on the fresh instance then pends forever. Replay
+            # is idempotent (duplicate registrations collapse GCS-side);
+            # shm objects need none of this — the arena outlives the GCS
+            # and is rescanned/re-reported. Sent BEFORE the wait
+            # re-subscriptions below: same-connection FIFO guarantees
+            # registration-before-wait on the fresh instance.
+            # Replay code exports (fn/class blobs + __main__ export
+            # tokens): a crash before their WAL append loses them
+            # durably, and the exporters' "already registered" caches
+            # would never re-send — workers would then fail every task
+            # of that class with "function not found". Fire-and-forget
+            # (kv_put replies only when asked) and idempotent.
+            for (ns, key), blob in list(self._kv_exports.items()):
+                self._send_gcs({"t": "kv_put", "ns": ns, "k": key,
+                                "v": blob})
+            rows = []
+            # list(): user threads put()/promote concurrently with this
+            # loop-side resync — never iterate the live set.
+            for oid in list(self._registered_inline):
+                data = self._memory_store.get(oid)
+                if data is not None:
+                    # "rs" (resync): the fresh GCS must NOT pin the
+                    # owner's initial reference for these — the live-ref
+                    # snapshot sent above already carries every local
+                    # ref, and pinning again would leak +1 per object.
+                    rows.append({"oid": oid.binary(), "nbytes": len(data),
+                                 "data": bytes(data), "rs": 1})
+            for i in range(0, len(rows), 512):
+                self._send_gcs({"t": "obj_puts", "objs": rows[i:i + 512]})
         # Re-subscribe every unresolved future — one batched wait-group
         # frame (the fresh GCS lost all per-request wait groups).
         unresolved = [oid for oid, fut in self._object_futures.items()
@@ -1394,11 +1440,26 @@ class Worker:
                              else max(0.0, deadline - time.monotonic()))
                 try:
                     where, payload = fut.result(remaining)
-                    out.append(self._resolve_value(r.id, where, payload))
-                    break
+                except serialization.ObjectLostError:
+                    # Loss delivered through the wait lane (error row /
+                    # not-ok reply resolved the future itself): same
+                    # lineage-reconstruction path as a loss discovered
+                    # at value resolution below.
+                    if attempt == 3 or not self.maybe_reconstruct(r.id):
+                        raise
+                    fut = self.object_future(r.id)
+                    continue
                 except TimeoutError:
                     raise GetTimeoutError(
                         f"get timed out after {timeout}s waiting for {r}")
+                try:
+                    # Outside the timeout guard: a TASK that raised a
+                    # TimeoutError subclass (e.g. a typed
+                    # CollectiveTimeout) re-raises here — it must
+                    # surface as itself, not be masked into "get timed
+                    # out" when the get deadline never actually fired.
+                    out.append(self._resolve_value(r.id, where, payload))
+                    break
                 except serialization.ObjectLostError:
                     # Owner-side lineage reconstruction: resubmit the
                     # producing task and wait again.
@@ -1411,7 +1472,16 @@ class Worker:
         """store.create with backpressure: on allocator exhaustion, ask the
         GCS to evict/spill (reference: plasma ``CreateRequestQueue``
         backpressure, ``plasma/create_request_queue.h``) and retry."""
-        for attempt in range(12):
+        if failpoints.active():
+            failpoints.fire("store.create")
+        from .backoff import Backoff
+
+        # Consumers flush derefs every 0.1s: the retry window must span
+        # several flush cycles or a streaming producer races the eviction
+        # of just-consumed blocks — hence the 0.1s cap on the shared
+        # jittered ladder.
+        backoff = Backoff(cap=0.1)
+        for _ in range(12):
             try:
                 return self.store.create(oid, nbytes)
             except MemoryError:
@@ -1426,10 +1496,7 @@ class Worker:
                                       "nbytes": nbytes}, timeout=30)
                 except Exception:
                     pass
-                # Consumers flush derefs every 0.1s: the window must span
-                # several flush cycles or a streaming producer races the
-                # eviction of just-consumed blocks.
-                time.sleep(min(0.02 * (attempt + 1), 0.1))
+                time.sleep(backoff.next_delay())
         return self.store.create(oid, nbytes)
 
     def put(self, value: Any) -> ObjectRef:
@@ -1458,6 +1525,20 @@ class Worker:
         else:
             buf = self.create_in_store(oid, sobj.total_size)
             sobj.write_into(buf)
+            if failpoints.active():
+                # Create->seal window: an injected failure must abort the
+                # unsealed allocation (no stranded arena range) and back
+                # out the registration mark above, or the failed ref
+                # would poison later borrower serialization.
+                try:
+                    failpoints.fire("store.seal")
+                except failpoints.FailpointError:
+                    self._registered_inline.discard(oid)
+                    try:
+                        self.store.abort(oid)
+                    except Exception:
+                        pass
+                    raise
             self.store.seal(oid)
             self.send_gcs_threadsafe({
                 "t": "obj_put", "oid": oid.binary(),
@@ -1477,6 +1558,18 @@ class Worker:
             oid = ObjectID.for_put(self._put_counter.next())
         buf = self.create_in_store(oid, sobj.total_size)
         sobj.write_into(buf)
+        if failpoints.active():
+            # Between create and seal: an injected failure here must not
+            # strand the unsealed allocation — abort reclaims the range
+            # (the crashed-writer case plasma handles via client death).
+            try:
+                failpoints.fire("store.seal")
+            except failpoints.FailpointError:
+                try:
+                    self.store.abort(oid)
+                except Exception:
+                    pass
+                raise
         self.store.seal(oid)
         if register:
             self._registered_inline.add(oid)
@@ -2136,9 +2229,14 @@ class Worker:
 
     def create_actor_msg(self, fid: str, msg_args: dict, opts: dict) -> ActorID:
         aid = ActorID.from_random()
-        reply = self.run_async(self.gcs.request({
+        # Same retry contract as the KV surface: the aid is OURS, so a
+        # re-send across a GCS crash-restart is idempotent (the GCS
+        # dedups actor_create by aid, re-linking the owner) — without
+        # this, Actor.remote() during the restart window surfaced a raw
+        # ConnectionError (found by the PR 7 verify drive).
+        reply = self._request_kv({
             "t": "actor_create", "aid": aid.binary(), "fid": fid,
-            "opts": opts, **msg_args}))
+            "opts": opts, **msg_args})
         if not reply.get("ok"):
             # The bundle will never be consumed — release it now.
             if msg_args.get("argsref") is not None:
@@ -2432,9 +2530,43 @@ class Worker:
 
     # ------------------------------------------------------------------ kv
 
+    def _request_kv(self, msg: dict, timeout: float = 30.0) -> dict:
+        """KV-surface request that rides out a GCS crash-restart.
+
+        KV ops are idempotent (last-write-wins / pure reads), so
+        retrying across the reconnect window is safe — and without it
+        every driver-facing kv_put/kv_get during a restart surfaced a
+        raw ConnectionError through public API calls like
+        ``Actor.remote()`` (chaos: gcs_crash_mid_direct_args landed on
+        the fn-export kv append). ``self.gcs`` is re-read per attempt:
+        the reconnect task swaps in the fresh connection."""
+        from .backoff import Backoff
+
+        backoff = Backoff(cap=0.5)
+        deadline = time.time() + 20.0
+        attempts = 0
+        while True:
+            try:
+                return self.run_async(self.gcs.request(dict(msg)), timeout)
+            except (ConnectionError, SyncTimeoutError):
+                attempts += 1
+                # Always allow one retry even past the deadline: a
+                # SyncTimeoutError burns the full per-attempt timeout
+                # before it ever raises, which used to make the timeout
+                # branch structurally unretryable (frame lost on a LIVE
+                # connection surfaced raw after one attempt).
+                if self.closed or (time.time() > deadline
+                                   and attempts >= 2):
+                    raise
+                time.sleep(backoff.next_delay())
+
     def kv_put(self, key: str, value: bytes, ns: str = ""):
-        self.run_async(self.gcs.request(
-            {"t": "kv_put", "ns": ns, "k": key, "v": value}))
+        self._request_kv({"t": "kv_put", "ns": ns, "k": key, "v": value})
+
+    def note_export(self, ns: str, key: str, blob: bytes):
+        """Shadow a code-export kv_put for GCS-restart replay (see
+        ``_kv_exports``)."""
+        self._kv_exports[(ns, key)] = blob
 
     def kv_get(self, key: str, ns: str = "") -> Optional[bytes]:
         reply = self.run_async(self.gcs.request(

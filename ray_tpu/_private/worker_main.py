@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from . import protocol, serialization
+from . import failpoints, protocol, serialization
 from .ids import ActorID, ObjectID, TaskID, WorkerID
 from .serialization import deserialize, pack_error, serialize
 from .worker import ObjectRef, Worker, set_global_worker
@@ -142,6 +142,15 @@ class Executor:
             # falling through the handler chain with t=None must never
             # match, and a reply-correlated fragment must not be executed.
             return
+        if t in ("actor_call", "exec") and failpoints.active():
+            # Worker-dispatch failpoints (the kill-mid-call chaos class):
+            # ``worker.exec`` hits between the lease grant and the first
+            # result; ``worker.direct_arg`` hits only calls whose args
+            # rode the out-of-band direct lane — a SIGKILL here exercises
+            # the owner's retry with the direct payload re-shipped.
+            failpoints.fire("worker.exec", t)
+            if msg.get("_bufs"):
+                failpoints.fire("worker.direct_arg")
         if t == "actor_call":
             # Fast path for plain sync methods on a max_concurrency=1
             # actor: calls batch through ONE executor-thread hop per
@@ -332,7 +341,16 @@ class Executor:
         """
         import json
 
-        blob = self.worker.kv_get("driver_sys_path")
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        try:
+            # Rides out a GCS outage like every infra-phase read: a
+            # mid-restart ConnectionError here poisoned pure tasks with
+            # a non-retryable error (chaos: gcs_crash_mid_rebalance).
+            blob = self._kv_get_retry("driver_sys_path", ns="",
+                                      window_s=10.0)
+        except (ConnectionError, TimeoutError, _FutTimeout):
+            blob = None
         if not blob:
             return
         try:
@@ -346,13 +364,61 @@ class Executor:
     def _get_function(self, fid: str):
         fn = self.fn_cache.get(fid)
         if fn is None:
-            blob = self.worker.kv_get(fid, ns="fn")
+            blob = self._kv_get_retry(fid, ns="fn")
             if blob is None:
                 raise RuntimeError(f"function {fid} not found in GCS")
             self._sync_driver_sys_path()
             fn = cloudpickle.loads(blob)
             self.fn_cache[fid] = fn
         return fn
+
+    def _kv_get_retry(self, key: str, ns: str,
+                      window_s: float = 20.0) -> Optional[bytes]:
+        """Control-plane KV read that rides out a GCS outage.
+
+        A task can only be dispatched AFTER its function export landed
+        (the exporter's kv_put is an awaited request), so a miss here
+        means the control plane is mid-crash-recovery: either our link
+        is down (ConnectionError) or the fresh instance hasn't received
+        the owner's export replay yet (None). Both resolve within the
+        reconnect budget — poll on the shared backoff ladder instead of
+        poisoning the task with a permanent 'function not found' error
+        (chaos-found, PR 7: gcs_crash_pre_wal)."""
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        from .backoff import Backoff
+
+        backoff = Backoff(cap=0.5)
+        deadline = time.time() + window_s
+        while True:
+            try:
+                blob = self.worker.kv_get(key, ns=ns)
+            except (ConnectionError, TimeoutError, _FutTimeout):
+                # _FutTimeout spelled out: on py3.10 (repo floor)
+                # concurrent.futures.TimeoutError is NOT builtin
+                # TimeoutError, and run_async re-raises the futures one.
+                blob = None
+            if blob is not None or time.time() > deadline:
+                return blob
+            time.sleep(backoff.next_delay())
+
+    def _load_args_retry(self, msg: dict) -> Tuple[tuple, dict]:
+        """_load_args that rides out control-plane outages: transient
+        ConnectionErrors from arg resolution (obj_locate/pull requests on
+        a closed GCS link mid-restart) retry on the shared backoff —
+        they are SYSTEM faults, and surfacing one as the task's result
+        would poison the caller with a non-retryable app error."""
+        from .backoff import Backoff
+
+        backoff = Backoff(cap=1.0)
+        deadline = time.time() + 20.0
+        while True:
+            try:
+                return self._load_args(msg)
+            except ConnectionError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(backoff.next_delay())
 
     def _load_args_fast(self, msg: dict):
         """Loop-safe arg loading for coroutine dispatch: returns
@@ -1023,6 +1089,10 @@ async def amain(args):
         if t is None:
             return  # empty/typeless frame: never dispatch (see protocol)
         if t == "exec":
+            if failpoints.active():
+                # GCS-dispatched task path: same kill-between-dispatch-
+                # and-first-result class as the leased direct push above.
+                failpoints.fire("worker.exec", "gcs_exec")
             asyncio.get_running_loop().create_task(executor.run_task(msg))
         elif t == "actor_init":
             asyncio.get_running_loop().create_task(executor.init_actor(msg))
@@ -1143,15 +1213,40 @@ async def amain(args):
             # restored record binds to this worker instead of restarting
             # (reference: worker resync after GCS failover).
             hello["actor_id"] = executor.actor_id.binary()
-        return await worker.gcs.request(hello, timeout=30)
+        reply = await worker.gcs.request(hello, timeout=30)
+        # Epoch-gated resync (chaos-found, PR 7): the WORKER lane was
+        # re-helloing without ever running _resync_after_reconnect, so a
+        # worker blocked resolving a task arg across a GCS crash never
+        # re-subscribed its unresolved object futures on the fresh
+        # instance — the executing task wedged forever (first red
+        # schedule: gcs_crash_pre_wal). Workers borrow refs, hold live
+        # refcounts, and own nested submissions exactly like drivers;
+        # they need the same resync.
+        new_epoch = reply.get("epoch")
+        prev = getattr(worker, "_gcs_epoch", None)
+        worker._gcs_epoch = new_epoch
+        if prev is not None:
+            worker._resync_after_reconnect(
+                gcs_restarted=(new_epoch != prev))
+        return reply
 
     def on_gcs_close():
         if not stop.is_set():
             asyncio.get_running_loop().create_task(reconnect_gcs())
 
     async def reconnect_gcs():
+        def _give_up():
+            # ppid==1 means our supervisor chain (agent, or the fork
+            # zygote whose stdin pipe the agent held) is gone: either
+            # the cluster is tearing down or this node was hard-killed.
+            # Exiting NOW instead of burning the full reconnect budget
+            # is what keeps SIGKILL'd nodes from stranding orphan
+            # workers for ~15s (the chaos host invariant that caught
+            # this: bcast_short_read teardown).
+            return stop.is_set() or os.getppid() == 1
+
         ok = await protocol.reconnect_with_retry(
-            connect_gcs, should_stop=stop.is_set)
+            connect_gcs, should_stop=_give_up)
         if not ok and not stop.is_set():
             stop.set()
 

@@ -34,12 +34,65 @@ exact) — tested against the single-mesh SPMD pipeline in
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
+
+
+class PipelineDrainSignal(RuntimeError):
+    """A node hosting a pipeline stage began DRAINING mid-schedule (TPU
+    preemption notice, autoscaler scale-down). ``step()`` stopped
+    admitting microbatches at the next boundary, let the in-flight ones
+    finish their full forward+backward, applied the partial-step
+    gradient (scaled by the completed count), checkpointed the MERGED
+    params while the draining stage was still reachable, and raised
+    this. The caller reshapes — ``MPMDPipeline.from_checkpoint`` at a
+    stage count that fits the surviving nodes (drain placement exclusion
+    keeps the new stage actors off the draining node) — instead of dying
+    at the drain deadline mid-step."""
+
+    def __init__(self, checkpoint_path: str, completed_microbatches: int,
+                 total_microbatches: int, draining_stages,
+                 reason: str = ""):
+        self.checkpoint_path = checkpoint_path
+        self.completed_microbatches = completed_microbatches
+        self.total_microbatches = total_microbatches
+        self.draining_stages = sorted(draining_stages)
+        self.reason = reason
+        super().__init__(
+            f"pipeline drained mid-step: stage(s) {self.draining_stages} "
+            f"on a draining node; {completed_microbatches}/"
+            f"{total_microbatches} microbatches completed, checkpoint at "
+            f"{checkpoint_path}" + (f" ({reason})" if reason else ""))
+
+    def __reduce__(self):
+        return (type(self), (self.checkpoint_path,
+                             self.completed_microbatches,
+                             self.total_microbatches,
+                             self.draining_stages, self.reason))
+
+
+def merge_stage_params(stage_params: List[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """Inverse of :func:`split_llama_params`: stitch per-stage pytrees
+    back into one full param tree (the reshape checkpoint format — a
+    re-split at ANY stage count must see the same model)."""
+    if not stage_params:
+        raise ValueError("no stage params to merge")
+    layers: List[Any] = []
+    for sp in stage_params:
+        layers.extend(sp["layers"])
+    return {
+        "embedding": stage_params[0]["embedding"],
+        "layers": layers,
+        "norm": stage_params[-1]["norm"],
+        "lm_head": stage_params[-1]["lm_head"],
+    }
 
 
 def split_llama_params(params: Dict[str, Any], n_stages: int
@@ -281,13 +334,16 @@ class PipelineStageActor:
 
     # -------------------------------------------------------- step control
 
-    def apply_gradients(self):
-        """Average accumulated grads, step the local optimizer."""
+    def apply_gradients(self, completed: Optional[int] = None):
+        """Average accumulated grads, step the local optimizer.
+        ``completed`` overrides the microbatch divisor for a partial
+        step (drain-shortened schedule): the mean stays a mean over the
+        microbatches that actually ran."""
         import optax
 
         if self._accum is None:
             return None
-        scale = 1.0 / self.n_microbatches
+        scale = 1.0 / (completed if completed else self.n_microbatches)
         grads = self.jax.tree.map(lambda g: g * scale, self._accum)
         updates, self.opt_state = self.opt.update(
             grads, self.opt_state, self.params)
@@ -352,7 +408,10 @@ class MPMDPipeline:
                  max_inflight: Optional[int] = None,
                  schedule: str = "1f1b",
                  transport_dtype: Optional[str] = None,
-                 simulate_compute_s: Optional[float] = None):
+                 simulate_compute_s: Optional[float] = None,
+                 drain_aware: bool = True,
+                 checkpoint_dir: Optional[str] = None,
+                 stage_options: Optional[List[dict]] = None):
         import cloudpickle
 
         if schedule not in ("1f1b", "gpipe"):
@@ -361,12 +420,24 @@ class MPMDPipeline:
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
         self.schedule = schedule
+        self.lr = lr
+        self.transport_dtype = transport_dtype
+        self.simulate_compute_s = simulate_compute_s
+        self.drain_aware = drain_aware
+        self.checkpoint_dir = checkpoint_dir
         self.last_step_stats: Optional[dict] = None
+        self._drain_evt = threading.Event()
+        self._drain_info: Optional[dict] = None
+        self._drain_sub = None
         stage_params = split_llama_params(
             jax_tree_to_numpy(params), n_stages)
         cfg_blob = cloudpickle.dumps(cfg)
+        # Per-stage actor options (resources=... pins a stage to a
+        # slice/node — the drain tests pin a stage to the node they then
+        # drain; real pods pin each stage to its slice's hosts).
+        stage_options = stage_options or [{} for _ in range(n_stages)]
         self.stages = [
-            PipelineStageActor.remote(
+            PipelineStageActor.options(**stage_options[i]).remote(
                 i, n_stages, cfg_blob, cloudpickle.dumps(stage_params[i]),
                 lr, n_microbatches, transport_dtype, simulate_compute_s)
             for i in range(n_stages)
@@ -388,9 +459,110 @@ class MPMDPipeline:
             max_inflight = (n_stages if schedule == "1f1b"
                             else n_microbatches + 2)
         self._dag = dag.experimental_compile(max_inflight=max_inflight)
+        if drain_aware:
+            self._start_drain_watcher()
+
+    # --------------------------------------------------- drain fault plane
+
+    def _stages_on_nodes(self, node_ids) -> List[int]:
+        from ray_tpu.util import state as state_api
+
+        try:
+            actors = {a["actor_id"]: a.get("node_id")
+                      for a in state_api.list_actors(limit=100000)}
+        except Exception:
+            return []
+        return [i for i, s in enumerate(self.stages)
+                if actors.get(s._id.hex()) in node_ids]
+
+    def _start_drain_watcher(self):
+        """One thread on the ``node_events`` channel: a node_draining
+        event naming a node that hosts a stage arms the drain flag the
+        admission loop checks at every microbatch boundary. A node
+        already DRAINING at watcher start (the subscribe/publish race)
+        is picked up by the initial probe."""
+
+        def watch():
+            from ray_tpu.util import state as state_api
+            from ray_tpu.util.pubsub import Subscriber
+
+            try:
+                sub = Subscriber("node_events")
+            except Exception:
+                return
+            self._drain_sub = sub
+            try:
+                draining = {n["node_id"] for n in state_api.list_nodes()
+                            if n.get("draining") and n.get("alive")}
+            except Exception:
+                draining = set()
+            if draining:
+                self._arm_drain(draining, "already draining at start")
+            for item in sub:
+                m = item.get("message") or {}
+                if m.get("event") != "node_draining":
+                    continue
+                self._arm_drain({m.get("node_id")},
+                                str(m.get("reason") or "drain notice"))
+
+        threading.Thread(target=watch, daemon=True,
+                         name="mpmd-drain-watch").start()
+
+    def _arm_drain(self, node_ids, reason: str):
+        if self._drain_evt.is_set():
+            return
+        stages = self._stages_on_nodes(set(node_ids))
+        if not stages:
+            return
+        self._drain_info = {"stages": stages, "reason": reason,
+                            "node_ids": sorted(n for n in node_ids if n)}
+        self._drain_evt.set()
+
+    def save_checkpoint(self, path: Optional[str] = None) -> str:
+        """Gather every stage's params (a DRAINING node is still alive —
+        this is exactly the window the drain deadline grants), merge to
+        the full tree, persist. Returns the checkpoint path."""
+        import json
+        import tempfile
+
+        import cloudpickle
+
+        merged = merge_stage_params(self.get_params())
+        path = path or self.checkpoint_dir or tempfile.mkdtemp(
+            prefix="mpmd_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "params.pkl"), "wb") as f:
+            cloudpickle.dump(merged, f)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"n_stages": self.n_stages,
+                       "n_microbatches": self.n_microbatches,
+                       "n_layers": len(merged["layers"]),
+                       "ts": time.time()}, f)
+        return path
+
+    @classmethod
+    def from_checkpoint(cls, path: str, cfg, *, n_stages: int,
+                        **kwargs) -> "MPMDPipeline":
+        """Reshape from a drain checkpoint: re-split the merged params
+        at a NEW stage count (typically fewer — the surviving nodes) and
+        rebuild the actor chain. Placement excludes draining nodes, so
+        the reshaped pipeline lands clear of the doomed hardware."""
+        import cloudpickle
+
+        with open(os.path.join(path, "params.pkl"), "rb") as f:
+            merged = cloudpickle.load(f)
+        return cls(cfg, merged, n_stages=n_stages, **kwargs)
 
     def _run_microbatches(self, tokens: np.ndarray,
                           targets: np.ndarray) -> List[float]:
+        """Stream microbatches through the compiled chain. Admission is
+        the drain boundary: ``execute`` blocks while the pipe is full
+        (1F1B), so between any two admissions a backward has completed —
+        checking the drain flag here stops the schedule at a microbatch
+        boundary with every in-flight microbatch finishing its full
+        forward+backward before control returns."""
+        from ray_tpu._private import failpoints
+
         m = self.n_microbatches
         if tokens.shape[0] % m != 0:
             raise ValueError(
@@ -399,14 +571,19 @@ class MPMDPipeline:
         tok_mb = np.split(np.asarray(tokens), m)
         tgt_mb = np.split(np.asarray(targets), m)
         t0 = time.perf_counter()
-        refs = [self._dag.execute((i, tok_mb[i], tgt_mb[i]))
-                for i in range(m)]
+        refs = []
+        for i in range(m):
+            if self.drain_aware and self._drain_evt.is_set():
+                break
+            failpoints.fire("mpmd.admit")
+            refs.append(self._dag.execute((i, tok_mb[i], tgt_mb[i])))
         losses = [r.get(timeout=300) for r in refs]
         wall = time.perf_counter() - t0
         busy = ray_tpu.get([s.take_busy.remote() for s in self.stages],
                            timeout=300)
         self.last_step_stats = {
             "wall_s": wall, "stage_busy_s": busy,
+            "completed_microbatches": len(refs),
             "bubble_fraction": max(0.0, 1.0 - (sum(busy) / len(busy))
                                    / max(wall, 1e-9)),
         }
@@ -421,8 +598,17 @@ class MPMDPipeline:
 
             targets = np.asarray(next_token_targets(jnp.asarray(tokens)))
         losses = self._run_microbatches(tokens, targets)
-        ray_tpu.get([s.apply_gradients.remote() for s in self.stages],
-                    timeout=300)
+        k = len(losses)
+        if k:
+            ray_tpu.get([s.apply_gradients.remote(
+                completed=k if k < self.n_microbatches else None)
+                for s in self.stages], timeout=300)
+        if self.drain_aware and self._drain_evt.is_set():
+            info = self._drain_info or {}
+            ckpt = self.save_checkpoint()
+            raise PipelineDrainSignal(
+                ckpt, k, self.n_microbatches,
+                info.get("stages", []), info.get("reason", ""))
         return float(np.mean(losses))
 
     def grad_check_step(self, tokens: np.ndarray) -> float:
@@ -462,6 +648,11 @@ class MPMDPipeline:
             [s.get_params.remote() for s in self.stages], timeout=300)
 
     def teardown(self):
+        if self._drain_sub is not None:
+            try:
+                self._drain_sub.close()
+            except Exception:
+                pass
         try:
             self._dag.teardown()
         except Exception:

@@ -154,7 +154,10 @@ class JaxTrainer:
         target = self.scaling_config.num_workers
         floor = self.scaling_config.elastic_min_workers
         workers = target
-        last_rescale_result: Optional[Result] = None
+        # Last attempt that made real progress (a rescale exit OR a
+        # failed attempt whose survivors reported/checkpointed): the
+        # backfill source when the final attempt has nothing left to do.
+        last_progress: Optional[Result] = None
         from .worker_group import WorkerGroupFormationError
 
         while True:
@@ -168,17 +171,18 @@ class JaxTrainer:
                     workers = min(target, max(result.rescaled_to, 1))
                     if result.checkpoint is not None:
                         restore_path = result.checkpoint.path
-                    last_rescale_result = result
+                    last_progress = result
                     continue
-                # A rescale on the run's FINAL report leaves the follow-up
-                # attempt with zero steps to train: it reports nothing.
-                # The pre-rescale attempt's metrics/checkpoint ARE the
-                # run's outcome — backfill them.
-                if last_rescale_result is not None:
+                # A rescale — or a member loss whose survivors trained to
+                # the end before the loss surfaced — on the run's FINAL
+                # report leaves the follow-up attempt with zero steps to
+                # train: it reports nothing. The prior attempt's
+                # metrics/checkpoint ARE the run's outcome — backfill.
+                if last_progress is not None:
                     if result.metrics is None:
-                        result.metrics = last_rescale_result.metrics
+                        result.metrics = last_progress.metrics
                     if result.checkpoint is None:
-                        result.checkpoint = last_rescale_result.checkpoint
+                        result.checkpoint = last_progress.checkpoint
                 return result
             if (floor is not None
                     and isinstance(result.error, WorkerGroupFormationError)
@@ -194,18 +198,82 @@ class JaxTrainer:
                 continue
             attempt += 1
             if max_failures >= 0 and attempt > max_failures:
+                # Out of budget: the error is returned TYPED — a
+                # non-elastic run that lost a member surfaces
+                # WorkerGroupMemberLost(lost_ranks, generation), not a
+                # generic RuntimeError.
                 return result
             # Restart from the latest persisted checkpoint (reference:
             # ``TuneController._schedule_trial_restore`` tune_controller.py:1791)
             if result.checkpoint is not None:
                 restore_path = result.checkpoint.path
+            if result.metrics is not None or result.checkpoint is not None:
+                last_progress = result
             # Elastic restart (SURVEY §7 hard part 3): after a worker
             # death, assume the lost capacity is gone and re-form the
-            # group one smaller (never below the floor). The loop sees a
-            # smaller world, builds a reshaped mesh, and the checkpoint
-            # restore reshards onto it.
+            # group smaller (never below the floor). A typed membership
+            # loss names HOW MANY ranks died — re-form at N-k directly
+            # instead of paying one formation per decrement. The loop
+            # sees a smaller world, builds a reshaped mesh, and the
+            # checkpoint restore reshards onto it.
             if floor is not None and workers > max(floor, 1):
-                workers -= 1
+                from .worker_group import WorkerGroupMemberLost
+
+                k = (len(result.error.lost_ranks)
+                     if isinstance(result.error, WorkerGroupMemberLost)
+                     and result.error.lost_ranks else 1)
+                workers = max(max(floor, 1), workers - k)
+
+    def _classify_failure(self, group, outs, n_workers: int):
+        """Escalation ladder over per-rank results: a typed member loss
+        reported by any survivor wins; a collective TIMEOUT triggers a
+        membership probe (a dropped push must not demote a real loss to
+        a generic hang); anything else is a plain worker failure."""
+        from .worker_group import WorkerGroupMemberLost
+
+        lost = set()
+        timed_out = False
+        first_plain = None
+        for rank, o in enumerate(outs):
+            if o.get("ok"):
+                continue
+            et = o.get("err_type")
+            if et in ("CollectiveMemberLost", "WorkerGroupMemberLost"):
+                lost.update(o.get("lost_ranks") or [])
+            elif et == "CollectiveTimeout":
+                timed_out = True
+            elif first_plain is None:
+                first_plain = RuntimeError(
+                    f"worker {rank} failed:\n{o.get('tb')}")
+        if timed_out and not lost:
+            probed = self._probe_member_loss(group, n_workers)
+            if probed is not None:
+                return probed
+            return TimeoutError(
+                "collective timed out with full gang membership — "
+                "desynchronized program order or a wedged rank")
+        if lost:
+            return WorkerGroupMemberLost(sorted(lost), n_workers,
+                                         "reported by survivors",
+                                         generation=group.generation)
+        return first_plain
+
+    def _probe_member_loss(self, group, n_workers: int):
+        """Membership probe (escalation step between 'a collective timed
+        out / a ref died' and 'reshape'): returns the typed loss when
+        the gang record shows lost ranks, else None."""
+        from .worker_group import WorkerGroupMemberLost
+
+        try:
+            info = group.membership()
+        except Exception:
+            return None
+        lost = info.get("lost") or []
+        if info.get("registered") and lost:
+            return WorkerGroupMemberLost(lost, n_workers,
+                                         "membership probe",
+                                         generation=group.generation)
+        return None
 
     def _feasible_workers(self) -> int:
         """How many workers the cluster's AVAILABLE resources fit now —
@@ -239,7 +307,8 @@ class JaxTrainer:
                     continue
                 if all(avail.get(k, 0.0) >= v for k, v in need.items()):
                     try:
-                        ray_tpu.get(collector.request_rescale.remote(target))
+                        ray_tpu.get(collector.request_rescale.remote(  # raylint: disable=RTL002 — one rescale request, then the watcher exits
+                            target))
                     except Exception:
                         pass
                     return
@@ -288,7 +357,8 @@ class JaxTrainer:
                 if target >= n_workers:
                     return  # already at/below the post-drain size
                 try:
-                    ray_tpu.get(collector.request_rescale.remote(target))
+                    ray_tpu.get(collector.request_rescale.remote(  # raylint: disable=RTL002 — one request per drain event, then the watcher exits
+                        target))
                 except Exception:
                     continue  # transient collector hiccup: retry next tick
                 return
@@ -317,9 +387,15 @@ class JaxTrainer:
         group = None
         monitor_stop = None
         try:
+            # Stable gang name (the run name): every re-formation of this
+            # run's group registers under it, so generations stay
+            # strictly monotonic across elastic reshapes and stale ranks
+            # from attempt N can never complete a collective against
+            # attempt N+1.
             group = WorkerGroup(n_workers, sc.worker_resources(),
                                 sc.placement_strategy,
-                                formation_timeout_s=sc.formation_timeout_s)
+                                formation_timeout_s=sc.formation_timeout_s,
+                                gang_name=f"train-{run_name}")
             self._setup_backend(group, n_workers)
         except Exception as e:  # noqa: BLE001 — e.g. infeasible resources
             try:
@@ -364,14 +440,10 @@ class JaxTrainer:
                                          shard_refs[rank]))
             outs = ray_tpu.get(futs)
             state = ray_tpu.get(collector.state.remote())
-            err: Optional[Exception] = None
+            err = self._classify_failure(group, outs, n_workers)
             rescaled_to = None
-            for rank, o in enumerate(outs):
-                if not o.get("ok"):
-                    err = RuntimeError(
-                        f"worker {rank} failed:\n{o.get('tb')}")
-                    break
-                if o.get("rescaled_to"):
+            for o in outs:
+                if o.get("ok") and o.get("rescaled_to"):
                     rescaled_to = int(o["rescaled_to"])
             metrics = state["history"][-1] if state["history"] else None
             ckpt = (Checkpoint(state["latest_checkpoint"])
@@ -382,14 +454,24 @@ class JaxTrainer:
                           rescaled_to=None if err else rescaled_to)
         except (ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError,
                 ConnectionError) as e:
+            # A rank died hard enough that its run() ref errored: probe
+            # the gang record so the typed loss (with its N-k reshape
+            # semantics) survives even when no survivor reported one.
+            err = self._probe_member_loss(group, n_workers) or e
             try:
                 state = ray_tpu.get(collector.state.remote())
             except Exception:
                 state = {"history": [], "latest_checkpoint": None}
             ckpt = (Checkpoint(state["latest_checkpoint"])
                     if state["latest_checkpoint"] else None)
-            return Result(metrics=None, checkpoint=ckpt, path=run_path,
-                          error=e)
+            # Keep what the attempt DID report: survivors may have
+            # trained well past the victim's death before the loss
+            # surfaced, and the retry (restoring at their last
+            # checkpoint) may have nothing left to do — these metrics
+            # are then the run's real outcome.
+            metrics = state["history"][-1] if state["history"] else None
+            return Result(metrics=metrics, checkpoint=ckpt, path=run_path,
+                          error=err)
         finally:
             if monitor_stop is not None:
                 monitor_stop.set()

@@ -24,7 +24,9 @@ import ray_tpu
 @ray_tpu.remote
 class WorkerKillerActor:
     """Kills busy task-worker processes on an interval (SIGKILL), exercising
-    task retries. Runs until ``stop()``."""
+    task retries. Runs until ``stop()``. Victim choice is driven by the
+    ``seed`` — ``schedule()`` reports it with the kill list so any red
+    chaos run reproduces from one command (repro ergonomics)."""
 
     def __init__(self, kill_interval_s: float = 0.3,
                  max_kills: int = 1_000_000, seed: int = 0):
@@ -32,6 +34,7 @@ class WorkerKillerActor:
         self.max_kills = max_kills
         self.killed_pids = []
         self._stop = False
+        self.seed = seed
         self._rng = random.Random(seed)
 
     def run(self):
@@ -60,19 +63,30 @@ class WorkerKillerActor:
     def kills(self):
         return list(self.killed_pids)
 
+    def schedule(self):
+        """Reproduction record: the seed that drove victim choice plus
+        what actually died, printable on any failing chaos run."""
+        return {"seed": self.seed, "killed_pids": list(self.killed_pids)}
+
 
 @ray_tpu.remote
 class ActorKillerActor:
     """Kills alive actor workers (except itself and excluded names) on an
-    interval, exercising actor restarts."""
+    interval, exercising actor restarts. Victim choice rides a private
+    seeded RNG (NOT the module-global ``random`` — a workload reseeding
+    the global generator must not change the kill schedule)."""
 
-    def __init__(self, kill_interval_s: float = 0.5, exclude=()):
+    def __init__(self, kill_interval_s: float = 0.5, exclude=(),
+                 seed: int = 0):
         self.kill_interval_s = kill_interval_s
         self.exclude = set(exclude) | {"_chaos_actor_killer",
                                        "_chaos_worker_killer",
                                        "_ray_tpu_job_manager"}
         self.killed = 0
+        self.killed_pids = []
         self._stop = False
+        self.seed = seed
+        self._rng = random.Random(seed)
 
     def run(self):
         from ray_tpu.util import state
@@ -86,10 +100,11 @@ class ActorKillerActor:
             except Exception:
                 victims = []
             if victims:
-                victim = random.choice(victims)
+                victim = self._rng.choice(victims)
                 try:
                     os.kill(victim["pid"], signal.SIGKILL)
                     self.killed += 1
+                    self.killed_pids.append(victim["pid"])
                 except (ProcessLookupError, PermissionError):
                     pass
             time.sleep(self.kill_interval_s)
@@ -98,6 +113,9 @@ class ActorKillerActor:
     def stop(self):
         self._stop = True
         return self.killed
+
+    def schedule(self):
+        return {"seed": self.seed, "killed_pids": list(self.killed_pids)}
 
 
 def get_and_run_worker_killer(kill_interval_s: float = 0.3,
@@ -111,10 +129,11 @@ def get_and_run_worker_killer(kill_interval_s: float = 0.3,
     return killer
 
 
-def get_and_run_actor_killer(kill_interval_s: float = 0.5, exclude=()):
+def get_and_run_actor_killer(kill_interval_s: float = 0.5, exclude=(),
+                             seed: int = 0):
     killer = ActorKillerActor.options(
         name="_chaos_actor_killer", max_concurrency=2).remote(
-            kill_interval_s=kill_interval_s, exclude=exclude)
+            kill_interval_s=kill_interval_s, exclude=exclude, seed=seed)
     # the kill loop runs until stop(): fire-and-forget by design
     killer.run.remote()  # raylint: disable=RTL007
     return killer
@@ -139,3 +158,14 @@ def set_rpc_failure(spec: str):
 
 def clear_rpc_failure():
     set_rpc_failure("")
+
+
+# ----------------------------------------------- deterministic failpoints
+# The seeded named-site injection registry (``_private/failpoints.py``) —
+# re-exported here so chaos drivers arm schedules and print repro records
+# from one import. ``set_failpoints`` exports through the env, so worker/
+# agent processes spawned AFTER the call inherit the schedule.
+
+from ray_tpu._private.failpoints import (  # noqa: E402,F401
+    FailpointError, clear_failpoints, fired_schedule, format_schedule,
+    set_failpoints)

@@ -1,0 +1,174 @@
+"""End-state invariants for chaos certification.
+
+The checks every seeded fault schedule must leave intact, shared between
+the pytest ``invariants`` fixture (tests/conftest.py, opt-in marker) and
+``benchmarks/chaos_suite.py`` — ONE invariant core, so a workload that
+passes the suite passes the tests for the same reasons.
+
+Two layers:
+
+* :func:`check_cluster_invariants` — against the LIVE cluster: GCS
+  ingress lanes drained (no parked frames, no stuck backpressure),
+  tenant quota usage returned to zero, no workers wedged busy, object
+  refcounts back at the pre-workload level.
+* :func:`check_host_invariants` — after shutdown: no orphaned session
+  processes (a worker/agent reparented to init is a leak — its session
+  is gone), and the session's /dev/shm arena actually unlinked.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import List, Optional
+
+
+class InvariantViolation(AssertionError):
+    """A chaos end-state invariant failed. Message carries the fired
+    failpoint schedule when one is armed (repro ergonomics)."""
+
+
+def _fail(msg: str):
+    from ray_tpu._private import failpoints
+
+    raise InvariantViolation(f"{msg}\n{failpoints.format_schedule()}")
+
+
+def arena_paths(session_name: str) -> List[str]:
+    """The /dev/shm paths a session's native arena can live at (the
+    PyShm fallback's per-object segments carry the session name and are
+    matched by prefix in :func:`check_host_invariants`)."""
+    tag = hashlib.sha1(session_name.encode()).hexdigest()[:16]
+    return [f"/dev/shm/rtpu_{tag}"]
+
+
+def _gcs_stats(w) -> dict:
+    reply = w.request_gcs({"t": "gcs_stats"}, timeout=10)
+    if not reply.get("ok"):
+        _fail(f"gcs_stats failed: {reply.get('err')}")
+    return reply
+
+
+def check_cluster_invariants(*, baseline_refs: Optional[int] = None,
+                             timeout: float = 15.0) -> dict:
+    """Assert the live cluster drained back to a clean steady state.
+
+    Retries until ``timeout``: deref frames flush on 0.1s ticks, leases
+    idle-return after 0.25s, and post-chaos reconnects may still be in
+    flight — the invariant is about the CONVERGED state, not an instant.
+    Returns the final ``gcs_stats`` reply for caller-side extras.
+    """
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import state
+
+    w = global_worker()
+    deadline = time.time() + timeout
+    last = ""
+    while True:
+        try:
+            stats = _gcs_stats(w)
+            problems = []
+            for row in stats.get("ingress", []):
+                if row.get("queued"):
+                    problems.append(f"lane not drained: {row}")
+                if row.get("backpressured"):
+                    problems.append(f"stuck backpressure: {row}")
+            usage = stats.get("tenant_usage") or {}
+            for ns, used in usage.items():
+                if any(abs(v) > 1e-6 for v in used.values()):
+                    problems.append(f"tenant {ns!r} usage not zero: {used}")
+            gangs = stats.get("gangs") or {}
+            if gangs:
+                # Every WorkerGroup deregisters on shutdown (and driver
+                # exit GCs the rest): a surviving record is a leaked
+                # gang — its channel keeps publishing into the void.
+                problems.append(f"gang records not retired: {gangs}")
+            stuck = [wk for wk in state.list_workers()
+                     if wk.get("state") == "busy"]
+            if stuck:
+                problems.append(f"workers wedged busy: {stuck}")
+            if baseline_refs is not None:
+                live = sum(1 for o in state.list_objects()
+                           if o.get("refcount", 0) > 0)
+                if live > baseline_refs:
+                    problems.append(
+                        f"refcounts not drained: {live} live objects "
+                        f"(baseline {baseline_refs})")
+            if not problems:
+                return stats
+            last = "; ".join(problems)
+        except InvariantViolation:
+            raise
+        except Exception as e:  # transient (reconnect in flight)
+            last = f"stats unavailable: {e}"
+        if time.time() > deadline:
+            _fail(f"cluster invariants violated after {timeout:.0f}s: "
+                  f"{last}")
+        time.sleep(0.25)
+
+
+def live_ref_count() -> int:
+    """Objects with refcount > 0 right now — the workload baseline for
+    the refcounts-drained invariant."""
+    from ray_tpu.util import state
+
+    return sum(1 for o in state.list_objects()
+               if o.get("refcount", 0) > 0)
+
+
+def _session_procs() -> List[dict]:
+    """ray_tpu session processes (workers/agents/heads) on this host
+    that were ORPHANED — reparented to init because their supervisor
+    died without reaping them. Live clusters keep proper parent chains,
+    so ppid==1 is the leak signal that stays valid while OTHER tests'
+    clusters are up."""
+    out = []
+    markers = ("ray_tpu._private.worker_main",
+               "ray_tpu._private.agent_entry",
+               "ray_tpu._private.head_entry")
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            if not any(m in cmd for m in markers):
+                continue
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().split(")")[-1].split()[1])
+            out.append({"pid": int(pid), "ppid": ppid, "cmd": cmd[:160]})
+        except (OSError, ValueError, IndexError):
+            continue
+    return [p for p in out if p["ppid"] == 1]
+
+
+def check_host_invariants(session_name: Optional[str] = None,
+                          timeout: float = 10.0) -> None:
+    """Post-shutdown host state: no orphaned session processes, and the
+    session's shm arena (plus any per-object PyShm segments) unlinked.
+    Retried briefly — shutdown reaps children asynchronously."""
+    deadline = time.time() + timeout
+    while True:
+        problems = []
+        orphans = _session_procs()
+        if orphans:
+            problems.append(f"orphaned session processes: {orphans}")
+        if session_name:
+            for path in arena_paths(session_name):
+                if os.path.exists(path):
+                    problems.append(f"arena not unlinked: {path}")
+            try:
+                leaked = [n for n in os.listdir("/dev/shm")
+                          if session_name in n]
+            except OSError:
+                leaked = []
+            if leaked:
+                problems.append(
+                    f"leaked shm segments: {sorted(leaked)[:8]}")
+        if not problems:
+            return
+        if time.time() > deadline:
+            _fail("host invariants violated after shutdown: "
+                  + "; ".join(problems))
+        time.sleep(0.25)

@@ -64,6 +64,31 @@ def ray_cluster():
     ray_tpu.shutdown()
 
 
+@pytest.fixture(autouse=True)
+def _end_invariants(request):
+    """Opt-in end-of-test invariant check (``@pytest.mark.invariants``):
+    after the test body, assert the cluster drained clean (GCS lanes
+    empty, tenant usage zero, no wedged workers), shut it down, and
+    assert the HOST is clean too (no orphaned session processes, shm
+    arena unlinked). The chaos suite (benchmarks/chaos_suite.py) runs
+    the same ``ray_tpu.util.invariants`` core — one definition of
+    "recovered"."""
+    yield
+    if request.node.get_closest_marker("invariants") is None:
+        return
+    import ray_tpu
+    from ray_tpu.util import invariants
+
+    session = None
+    if ray_tpu.is_initialized():
+        from ray_tpu._private.worker import global_worker
+
+        session = global_worker().session_name
+        invariants.check_cluster_invariants()
+        ray_tpu.shutdown()
+    invariants.check_host_invariants(session)
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh8():
     devices = jax.devices("cpu")

@@ -1,0 +1,164 @@
+"""Unit tests for the deterministic failpoint registry
+(``ray_tpu._private.failpoints``): spec grammar, trigger semantics, seeded
+determinism, env round-trip, journal/repro output, and the protocol-layer
+caller actions."""
+
+import os
+
+import pytest
+
+from ray_tpu._private import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fp.clear_failpoints()
+    yield
+    fp.clear_failpoints()
+
+
+def test_spec_parse_and_triggers():
+    t = fp.parse_spec(
+        "a=once:raise; b=hit3:drop; c=every2:delay:0.01; d=p0.5:kill",
+        seed=7)
+    assert sorted(t) == ["a", "b", "c", "d"]
+    a, b, c = t["a"], t["b"], t["c"]
+    assert [a.should_fire() for _ in range(3)] == [True, False, False]
+    assert [b.should_fire() for _ in range(4)] == [False, False, True,
+                                                  False]
+    assert [c.should_fire() for _ in range(4)] == [False, True, False,
+                                                   True]
+
+
+def test_probabilistic_is_seed_deterministic():
+    seq1 = [fp.parse_spec("s=p0.4:drop", 42)["s"].should_fire()
+            for _ in range(1)]
+    t1 = fp.parse_spec("s=p0.4:drop", 42)["s"]
+    t2 = fp.parse_spec("s=p0.4:drop", 42)["s"]
+    t3 = fp.parse_spec("s=p0.4:drop", 43)["s"]
+    r1 = [t1.should_fire() for _ in range(64)]
+    r2 = [t2.should_fire() for _ in range(64)]
+    r3 = [t3.should_fire() for _ in range(64)]
+    assert r1 == r2  # same seed, same schedule
+    assert r1 != r3  # different seed, different schedule
+    assert seq1[0] == r1[0]
+
+
+def test_per_site_streams_are_independent():
+    """Two probabilistic sites under one seed: hitting one must not
+    perturb the other's schedule."""
+    t = fp.parse_spec("x=p0.5:drop;y=p0.5:drop", 5)
+    y_alone = fp.parse_spec("y=p0.5:drop", 5)["y"]
+    seq_y_alone = [y_alone.should_fire() for _ in range(32)]
+    seq_y_mixed = []
+    for i in range(32):
+        t["x"].should_fire()  # interleaved traffic on x
+        seq_y_mixed.append(t["y"].should_fire())
+    assert seq_y_alone == seq_y_mixed
+
+
+def test_bad_specs_rejected():
+    with pytest.raises(ValueError):
+        fp.parse_spec("a=once", 0)  # missing action
+    with pytest.raises(ValueError):
+        fp.parse_spec("a=once:explode", 0)  # unknown action
+    with pytest.raises(ValueError):
+        fp.parse_spec("a=sometimes:raise", 0)  # unknown trigger
+
+
+def test_env_roundtrip_and_fire():
+    fp.set_failpoints("site.x=every2:drop", seed=9)
+    assert os.environ[fp.ENV_SPEC] == "site.x=every2:drop"
+    assert os.environ[fp.ENV_SEED] == "9"
+    assert fp.active()
+    assert fp.fire("site.x") is None
+    assert fp.fire("site.x") == "drop"
+    assert fp.fire("site.other") is None
+    fp.clear_failpoints()
+    assert not fp.active()
+    # Disarm SETS the env var empty (popping it would fall back to the
+    # config flag and re-arm a _system_config spec).
+    assert os.environ.get(fp.ENV_SPEC) == ""
+    assert fp.fire("site.x") is None  # disarmed fast path
+
+
+def test_clear_overrides_config_flag():
+    """clear_failpoints must disarm even when the spec came from the
+    ``failpoints`` config flag (env unset -> config fallback would
+    otherwise silently re-arm a _system_config spec)."""
+    from ray_tpu._private.config import reset_config, set_system_config
+
+    os.environ.pop(fp.ENV_SPEC, None)
+    os.environ.pop(fp.ENV_SEED, None)
+    try:
+        set_system_config({"failpoints": "s=once:drop",
+                           "failpoint_seed": 3})
+        assert fp.active()  # armed via the config refresh hook
+        fp.clear_failpoints()
+        assert not fp.active()
+        assert fp.fire("s") is None
+    finally:
+        reset_config()
+        fp.clear_failpoints()
+
+
+def test_qualified_key_matches_before_bare_site():
+    fp.set_failpoints("conn.send.actor_call=once:drop;conn.send=once:drop",
+                      seed=0)
+    # actor_call traffic hits the qualified entry...
+    assert fp.fire("conn.send", "actor_call") == "drop"
+    # ...other types fall through to the bare site.
+    assert fp.fire("conn.send", "obj_put") == "drop"
+    assert fp.fire("conn.send", "obj_put") is None
+
+
+def test_raise_action_is_connection_error():
+    fp.set_failpoints("s=once:raise", seed=0)
+    with pytest.raises(ConnectionError):
+        fp.fire("s")
+    assert issubclass(fp.FailpointError, ConnectionError)
+
+
+def test_journal_and_format():
+    fp.set_failpoints("a=every1:drop", seed=3)
+    fp.reset_journal()
+    fp.fire("a")
+    fp.fire("a", "typed")
+    sched = fp.fired_schedule()
+    assert len(sched) == 2
+    assert sched[0][2] == "a" and sched[0][3] == "drop"
+    assert sched[1][2] == "a[typed]"
+    out = fp.format_schedule()
+    assert "seed=3" in out and "a -> drop" in out
+
+
+def test_delay_action_returns_and_sleeps_briefly():
+    import time
+
+    fp.set_failpoints("d=once:delay:0.02", seed=0)
+    t0 = time.perf_counter()
+    assert fp.fire("d") == "delay"
+    assert time.perf_counter() - t0 >= 0.015
+
+
+def test_connection_send_drop_and_short(ray_cluster):
+    """The protocol-layer caller actions, end to end on a live cluster:
+    a dropped actor-call frame leaves the reply pending (caller timeout
+    path), a short frame kills the channel — and the actor-call retry
+    path absorbs both."""
+    import ray_tpu
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=5)
+    class Echo:
+        def ping(self, x):
+            return x
+
+    e = Echo.remote()
+    assert ray_tpu.get(e.ping.remote(1), timeout=30) == 1
+    fp.set_failpoints("conn.send.actor_call=hit1:short", seed=1)
+    try:
+        out = ray_tpu.get([e.ping.remote(i) for i in range(6)], timeout=60)
+        assert out == list(range(6))
+    finally:
+        fp.clear_failpoints()
+    ray_tpu.kill(e)

@@ -1,0 +1,334 @@
+"""Gang fault plane: generation-stamped membership, fail-fast
+collectives, drain-aware mid-pipeline reshape.
+
+The contract under test (README "Fault plane"): a gang registers its
+membership with the GCS at formation and gets a strictly-monotonic
+generation; any member death is PUSHED to survivors (gang channel +
+coordinator fail-fast) so no pending collective ever waits out the flat
+``collective_timeout_s``; stale generations can neither rejoin nor
+complete an op; a collective that times out WITHOUT a membership event
+names the ranks that never arrived; and a formation failure leaks
+neither the placement group nor the spawned actors.
+
+Invariant tests ride the shared ``invariants`` marker / fixture
+(``ray_tpu.util.invariants``) — never a reimplementation.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train.worker_group import (WorkerGroup,
+                                        WorkerGroupFormationError,
+                                        WorkerGroupMemberLost)
+from ray_tpu.util.collective import (CollectiveMemberLost,
+                                     CollectiveTimeout,
+                                     StaleCollectiveGeneration,
+                                     _Coordinator)
+
+pytestmark = pytest.mark.chaos
+
+# High on purpose: every detection assertion below must hold because of
+# the PUSH plane, not because the timeout happened to be short.
+_TIMEOUT_S = 120.0
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=6, probe_tpu=False, ignore_reinit_error=True,
+                 _system_config={"collective_timeout_s": _TIMEOUT_S})
+    yield
+    ray_tpu.shutdown()
+
+
+def _form(n, name, timeout=60.0):
+    return WorkerGroup(n, {"CPU": 1.0}, gang_name=name,
+                       formation_timeout_s=timeout)
+
+
+# ------------------------------------------------------ generation plane
+
+
+@pytest.mark.invariants
+def test_generation_strictly_monotonic_across_reshapes():
+    """Every (re-)formation under one gang name gets generation+1 — a
+    clean shutdown, a member-loss reshape, and a shrink all bump it; no
+    generation is ever reused."""
+    ray_tpu.init(num_cpus=6, probe_tpu=False, ignore_reinit_error=True,
+                 _system_config={"collective_timeout_s": _TIMEOUT_S})
+    gens = []
+    g = _form(3, "geninv")
+    gens.append(g.generation)
+    g.shutdown()
+
+    g = _form(3, "geninv")
+    gens.append(g.generation)
+    # Member-loss reshape: kill one, re-form smaller.
+    pid = ray_tpu.get(g.workers[1].pid.remote(), timeout=30)
+    os.kill(pid, signal.SIGKILL)
+    assert g._gang_lost.wait(timeout=30), "loss push never arrived"
+    g.shutdown()
+    g = _form(2, "geninv")
+    gens.append(g.generation)
+    info = g.membership()
+    assert info["registered"] and info["generation"] == g.generation
+    g.shutdown()
+    # Deregistered on shutdown; the counter survives the record.
+    from ray_tpu._private.worker import global_worker
+
+    info = global_worker().request_gcs(
+        {"t": "gang_info", "name": "geninv"}, timeout=10)
+    assert not info["registered"]
+    assert info["generation"] == gens[-1]
+    assert gens == sorted(set(gens)), f"generations not monotonic: {gens}"
+    assert all(b > a for a, b in zip(gens, gens[1:])), gens
+
+
+def test_stale_generation_cannot_complete_collective(cluster):
+    """A rank stamped with a superseded generation is rejected by the
+    coordinator — typed, immediate, never a deadlock."""
+    coord = ray_tpu.remote(_Coordinator).remote(2, generation=3)
+    with pytest.raises(StaleCollectiveGeneration):
+        ray_tpu.get(coord.collect.remote("barrier", 0, 0, None,
+                                         generation=2), timeout=30)
+    # Newer-than-coordinator is just as stale (a never-torn-down
+    # coordinator must not serve the re-formed gang).
+    with pytest.raises(StaleCollectiveGeneration):
+        ray_tpu.get(coord.collect.remote("barrier", 0, 0, None,
+                                         generation=4), timeout=30)
+    ray_tpu.kill(coord)
+
+
+def test_lost_member_cannot_rejoin_collective(cluster):
+    """After a membership-loss event, EVERY new op against that
+    coordinator raises the typed loss — a restarted stale member cannot
+    sneak back into the group."""
+    coord = ray_tpu.remote(_Coordinator).remote(3, generation=1)
+    assert ray_tpu.get(coord.member_lost.remote([2], "killed",
+                                                generation=1), timeout=30)
+    with pytest.raises(CollectiveMemberLost) as ei:
+        ray_tpu.get(coord.collect.remote("allreduce", 0, 0, np.ones(2),
+                                         generation=1), timeout=30)
+    assert ei.value.lost_ranks == [2]
+    ray_tpu.kill(coord)
+
+
+# ----------------------------------------------------- fail-fast plane
+
+
+def test_membership_push_beats_flat_timeout(cluster):
+    """The acceptance property: a member killed between rendezvous and
+    the first collective in a 4-process gang is detected via membership
+    PUSH — survivors unwedge with the typed loss in seconds, no pending
+    collective waits out the flat ``collective_timeout_s``, and no
+    survivor needs to be SIGKILLed."""
+    g = _form(4, "pushbeat")
+    try:
+        gn = g.setup_gang_collectives()
+        # The kill lands in the rendezvous gap: after
+        # join_gang_collectives returned, before the first barrier.
+        pid = ray_tpu.get(g.workers[2].pid.remote(), timeout=30)
+        os.kill(pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        with pytest.raises(WorkerGroupMemberLost) as ei:
+            g.run_collective("gang_barrier", gn, timeout=_TIMEOUT_S)
+        elapsed = time.monotonic() - t0
+        assert 2 in ei.value.lost_ranks
+        assert ei.value.generation == g.generation
+        assert elapsed < _TIMEOUT_S / 4, (
+            f"detection took {elapsed:.1f}s — that is timeout expiry, "
+            f"not a membership push")
+        # Survivors unwedged COOPERATIVELY (the coordinator failed their
+        # pending ops): still alive, still callable.
+        for r in (0, 1, 3):
+            assert ray_tpu.get(g.workers[r].ping.remote(),  # raylint: disable=RTL002 — liveness probe per rank, order intentional
+                               timeout=10)
+        # And the coordinator's op table is clean — the killed rank's
+        # contribution did not strand a (kind, seq) entry.
+        coord = ray_tpu.get_actor(f"_collective_{gn}")
+        st = ray_tpu.get(coord.debug_state.remote(), timeout=10)
+        assert st["pending_ops"] == [], st
+        assert 2 in st["lost"]
+    finally:
+        g.shutdown()
+
+
+def test_collective_timeout_names_missing_ranks(cluster):
+    """No death, one rank never arrives: the op fails with the typed
+    timeout NAMING the missing ranks (satellite: the 300s hard-coded
+    ``wait_for`` is gone)."""
+    coord = ray_tpu.remote(_Coordinator).remote(3, timeout_s=2.0)
+    with pytest.raises(CollectiveTimeout) as ei:
+        ray_tpu.get(coord.collect.remote("allreduce", 0, 0, np.ones(2)),
+                    timeout=30)
+    assert ei.value.missing_ranks == [1, 2]
+    assert ei.value.kind == "allreduce"
+    ray_tpu.kill(coord)
+
+
+def test_op_state_gc_on_member_death(cluster):
+    """A rank that contributed and then died must not strand its
+    (kind, seq) op state: the loss event errors pending ops, pops them,
+    and later arrivals fail fast instead of deadlocking on a
+    contribution whose owner is gone."""
+    coord = ray_tpu.remote(_Coordinator).remote(3, generation=1)
+    # Rank 2 contributes first and blocks server-side (2/3 arrived).
+    ref2 = coord.collect.remote("allreduce", 0, 2, np.ones(2),
+                                generation=1)
+    ready, pending = ray_tpu.wait([ref2], timeout=1.0)
+    assert pending, "op completed with 1/3 contributions?"
+    # Rank 2 dies. Its pending op errors and is GC'd immediately.
+    assert ray_tpu.get(coord.member_lost.remote([2], "killed",
+                                                generation=1), timeout=30)
+    with pytest.raises(CollectiveMemberLost):
+        ray_tpu.get(ref2, timeout=30)
+    st = ray_tpu.get(coord.debug_state.remote(), timeout=10)
+    assert st["pending_ops"] == [], st
+    # Late arrivals of the same op fail typed+fast.
+    with pytest.raises(CollectiveMemberLost):
+        ray_tpu.get(coord.collect.remote("allreduce", 0, 0, np.ones(2),
+                                         generation=1), timeout=30)
+    st = ray_tpu.get(coord.debug_state.remote(), timeout=10)
+    assert st["pending_ops"] == [], st
+    ray_tpu.kill(coord)
+
+
+# ----------------------------------------------------- formation plane
+
+
+def test_formation_failure_leaks_nothing(cluster):
+    """Satellite: a failure AFTER the placement-group reservation (the
+    formation ping window) must kill the spawned workers and remove the
+    PG before re-raising as WorkerGroupFormationError."""
+    from ray_tpu._private import failpoints
+
+    baseline = ray_tpu.available_resources().get("CPU", 0.0)
+    failpoints.set_failpoints("gang.form=once:raise", 7)
+    try:
+        with pytest.raises(WorkerGroupFormationError):
+            _form(3, "leaky")
+    finally:
+        failpoints.clear_failpoints()
+    # Resources (PG reservation + actor CPUs) must return to baseline.
+    deadline = time.time() + 20
+    avail = -1.0
+    while time.time() < deadline:
+        avail = ray_tpu.available_resources().get("CPU", 0.0)
+        if avail >= baseline:
+            break
+        time.sleep(0.25)
+    assert avail >= baseline, (
+        f"formation failure leaked resources: {avail} < {baseline}")
+    # And the same gang name re-forms cleanly at full size.
+    g = _form(3, "leaky")
+    out = g.run_collective("host_barrier", "leaky_ok", timeout=60)
+    assert sorted(out) == [0, 1, 2]
+    g.shutdown()
+
+
+# --------------------------------------------- drain-aware pipeline plane
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models import LlamaConfig
+
+    return LlamaConfig(vocab_size=128, d_model=32, n_layers=4, n_heads=4,
+                       n_kv_heads=2, d_ff=64, max_seq_len=32,
+                       dtype=jnp.float32, tie_embeddings=False)
+
+
+def test_merge_stage_params_inverts_split():
+    """The reshape checkpoint format: merge(split(p, k)) == p for any
+    stage count, so a checkpoint taken at 3 stages re-splits exactly at
+    2 (or 4)."""
+    import jax
+
+    from ray_tpu.models import init_params
+    from ray_tpu.parallel.mpmd_pipeline import (merge_stage_params,
+                                                split_llama_params)
+
+    cfg = _tiny_cfg()
+    params = jax.tree.map(np.asarray, init_params(cfg, jax.random.PRNGKey(0)))
+    for k in (2, 3, 4):
+        merged = merge_stage_params(split_llama_params(params, k))
+        flat_a = jax.tree_util.tree_leaves(params)
+        flat_b = jax.tree_util.tree_leaves(merged)
+        assert len(flat_a) == len(flat_b)
+        assert all(np.array_equal(a, b) for a, b in zip(flat_a, flat_b))
+
+
+def test_drain_mid_1f1b_checkpoints_at_boundary_and_reshapes():
+    """Tentpole composition with the PR 1 drain lifecycle: a node
+    hosting a pipeline stage drains MID-1F1B-schedule. The step must
+    stop admitting at a microbatch boundary (completed < total), apply
+    the partial gradient, checkpoint the merged params while the
+    draining stage is still reachable, and raise the typed signal; the
+    reshaped pipeline (from_checkpoint) must land entirely off the
+    draining node and train."""
+    import threading
+
+    import jax
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.models import init_params
+    from ray_tpu.parallel.mpmd_pipeline import (MPMDPipeline,
+                                                PipelineDrainSignal)
+    from ray_tpu.util import state as state_api
+
+    c = Cluster(connect=True)
+    c.add_node(num_cpus=2, resources={"s1": 2})
+    pipe = pipe2 = None
+    try:
+        assert c.wait_for_nodes(2, timeout=120)
+        cfg = _tiny_cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (12, 16), 0, cfg.vocab_size))
+        pipe = MPMDPipeline(
+            cfg, params, n_stages=2, n_microbatches=6,
+            simulate_compute_s=0.15,
+            stage_options=[{}, {"resources": {"s1": 1}}])
+        actors = {a["actor_id"]: a.get("node_id")
+                  for a in state_api.list_actors()}
+        doomed = actors[pipe.stages[1]._id.hex()]
+        assert doomed is not None
+        loss0 = pipe.step(tokens)  # warm step, full schedule
+        assert np.isfinite(loss0)
+
+        timer = threading.Timer(0.4, lambda: ray_tpu.drain_node(
+            doomed, reason="preemption notice", deadline_s=60.0))
+        timer.start()
+        with pytest.raises(PipelineDrainSignal) as ei:
+            pipe.step(tokens)
+        sig = ei.value
+        assert 0 < sig.completed_microbatches < 6, (
+            f"drain did not stop admission at a boundary: "
+            f"{sig.completed_microbatches}/6")
+        assert 1 in sig.draining_stages
+        assert os.path.exists(
+            os.path.join(sig.checkpoint_path, "params.pkl"))
+        pipe.teardown()
+
+        # Reshape: drain placement exclusion keeps the new stage actors
+        # off the draining node automatically.
+        pipe2 = MPMDPipeline.from_checkpoint(
+            sig.checkpoint_path, cfg, n_stages=2, n_microbatches=2,
+            drain_aware=False)
+        loss1 = pipe2.step(tokens[:4])
+        assert np.isfinite(loss1)
+        actors = {a["actor_id"]: a.get("node_id")
+                  for a in state_api.list_actors()}
+        for s in pipe2.stages:
+            assert actors[s._id.hex()] != doomed, (
+                "reshaped stage landed on the draining node")
+    finally:
+        for p in (pipe, pipe2):
+            if p is not None:
+                p.teardown()
+        c.shutdown()
