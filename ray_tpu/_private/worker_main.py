@@ -439,16 +439,33 @@ class Executor:
         microseconds of pure CPU. Those now load inline on the actor's
         running loop."""
         ab = msg.get("args")
-        if ab is not None and bytes(ab) == serialization.empty_args_bytes():
+        bab = bytes(ab) if ab is not None else None  # one copy, reused
+        if bab is not None and bab == serialization.empty_args_bytes():
             return (), {}, False
         if msg.get("argsref") is not None:
             return None  # shm/GCS fetch: may block
+        # Definition-export references (__main__ classes/functions pickle
+        # as `_load_export(token)` calls) may need a BLOCKING GCS KV
+        # fetch on cache miss — run_async from the loop thread raises
+        # (and blocking it would deadlock the reply delivery). Punt the
+        # whole payload to the executor path BEFORE deserializing
+        # anything: a partial inline unpickle that raises mid-stream
+        # would already have materialized ObjectRef wrappers whose
+        # __del__ debits the sender's single pickled incref, and the
+        # executor retry would then double-debit it. Substring scan, so
+        # a false positive (user bytes containing the marker) only costs
+        # the pre-PR6 executor hop, never correctness.
         if msg.get("ap") is not None:
             import pickle
 
-            args, kwargs = pickle.loads(bytes(msg["ap"]),
+            bp = bytes(msg["ap"])
+            if b"_load_export" in bp:
+                return None
+            args, kwargs = pickle.loads(bp,
                                         buffers=msg.get("_bufs") or [])
         elif ab is not None:
+            if b"_load_export" in bab:
+                return None
             args, kwargs = deserialize(memoryview(ab))
         else:
             return None

@@ -265,6 +265,31 @@ class PagedEngine:
             1 for k in self._prefix_lru
             if self._prefix.get(k, [0, 1])[1] == 0)
 
+    def invalidate_prefix_cache(self) -> None:
+        """Drop every cached prefix mapping — REQUIRED after a live
+        weight swap, or future prompts hit K/V pages computed with the
+        old checkpoint. Unreferenced pages return to the free pool
+        immediately. Pages still shared by in-flight slots cannot be
+        freed here (``_decref`` frees a page the moment its entry is
+        gone, even with other holders) — their entries stay for the
+        page-scan refcounting but move to unmatchable keys, so no new
+        prompt can hit them; once the last holder drains, ``_reclaim``
+        evicts them like any cold entry."""
+        fresh: Dict[tuple, list] = {}
+        lru: List[tuple] = []
+        for i, key in enumerate(list(self._prefix_lru)):
+            entry = self._prefix.get(key)
+            if entry is None:
+                continue
+            if entry[1] == 0:
+                self.free_pages.append(entry[0])
+            else:
+                stale_key = ("__stale__", i, entry[0])
+                fresh[stale_key] = entry
+                lru.append(stale_key)
+        self._prefix = fresh
+        self._prefix_lru = lru
+
     # ---------------------------------------------------------- admit
     def submit(self, request_id: str, prompt: List[int], *,
                max_new_tokens: int = 32, eos_id: Optional[int] = None,

@@ -44,6 +44,19 @@ class LLMServer:
         self._max_len = max_len
         self._max_slots = max_slots
         self._spec_sem: Optional[asyncio.Semaphore] = None
+        self._cfg = cfg
+        self._draft_factory = draft_factory
+        self._weights_version = 1
+        # Speculative serving counters (surfaced via {"_admin": "stats"}):
+        # the inflight peak proves the _spec_sem admission bound held,
+        # the round/accept totals are the replica's REAL acceptance
+        # telemetry (device-computed, one fetch per generation).
+        self._spec_inflight = 0
+        self._spec_peak = 0
+        self._spec_requests = 0
+        self._spec_rounds = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         if draft_factory is not None:
             draft_params, draft_cfg = draft_factory(params, cfg)
             self._spec = (params, cfg, draft_params, draft_cfg, draft_k)
@@ -68,6 +81,15 @@ class LLMServer:
                              f"got {kv_cache!r}")
         self._queues: Dict[str, asyncio.Queue] = {}
         self._loop_task: Optional[asyncio.Task] = None
+        # Serializes engine stepping against live weight refresh: step()
+        # runs in an executor thread while a controller-path reconfigure
+        # runs in ANOTHER executor thread — an unsynchronized
+        # invalidate_prefix_cache could free a page mid-_admit
+        # (double-alloc + double-free) or let an old-weight admit
+        # re-register prefix pages AFTER the invalidation wiped them.
+        import threading
+
+        self._engine_lock = threading.Lock()
 
     # ----------------------------------------------------- engine pump
     def _ensure_loop(self):
@@ -75,12 +97,16 @@ class LLMServer:
             self._loop_task = asyncio.get_running_loop().create_task(
                 self._engine_loop())
 
+    def _locked_step(self):
+        with self._engine_lock:
+            return self.engine.step()
+
     async def _engine_loop(self):
         loop = asyncio.get_running_loop()
         while self.engine.has_work():
             # The jitted step is device-bound; run it off the event loop
             # so health checks / new submissions stay responsive.
-            events = await loop.run_in_executor(None, self.engine.step)
+            events = await loop.run_in_executor(None, self._locked_step)
             for rid, tok in events:
                 q = self._queues.get(rid)
                 if q is not None:
@@ -90,15 +116,21 @@ class LLMServer:
     def _submit(self, body: dict) -> str:
         rid = uuid.uuid4().hex
         self._queues[rid] = asyncio.Queue()
-        self.engine.submit(rid, [int(t) for t in body["prompt"]],
-                           max_new_tokens=int(
-                               body.get("max_new_tokens", 32)),
-                           eos_id=body.get("eos_id"),
-                           temperature=float(
-                               body.get("temperature", 0.0)),
-                           top_k=int(body.get("top_k", 0)),
-                           top_p=float(body.get("top_p", 1.0)),
-                           seed=body.get("seed"))
+        try:
+            self.engine.submit(rid, [int(t) for t in body["prompt"]],
+                               max_new_tokens=int(
+                                   body.get("max_new_tokens", 32)),
+                               eos_id=body.get("eos_id"),
+                               temperature=float(
+                                   body.get("temperature", 0.0)),
+                               top_k=int(body.get("top_k", 0)),
+                               top_p=float(body.get("top_p", 1.0)),
+                               seed=body.get("seed"))
+        except Exception:
+            # A rejected submit (bad prompt, over max_len) must not
+            # strand its freshly-inserted queue entry forever.
+            self._queues.pop(rid, None)
+            raise
         self._ensure_loop()
         return rid
 
@@ -113,6 +145,8 @@ class LLMServer:
     # ------------------------------------------------------- handlers
     async def __call__(self, request: Any):
         body = self._body(request)
+        if body.get("_admin"):
+            return self._admin(body)
         if body.get("speculative"):
             return await self._speculative(body)
         if body.get("stream"):
@@ -161,13 +195,104 @@ class LLMServer:
             self._spec_sem = _asyncio.Semaphore(self._max_slots)
         loop = _asyncio.get_running_loop()
         async with self._spec_sem:
-            toks, stats = await loop.run_in_executor(
-                None, lambda: generate_speculative(
-                    params, dparams, prompt, cfg, dcfg, max_new=max_new,
-                    k=k))
+            self._spec_inflight += 1
+            self._spec_peak = max(self._spec_peak, self._spec_inflight)
+            try:
+                toks, stats = await loop.run_in_executor(
+                    None, lambda: generate_speculative(
+                        params, dparams, prompt, cfg, dcfg,
+                        max_new=max_new, k=k))
+            finally:
+                self._spec_inflight -= 1
+        self._spec_requests += 1
+        self._spec_rounds += stats["rounds"]
+        self._spec_drafted += stats["drafted"]
+        self._spec_accepted += stats["accepted"]
+        # toks is the single device fetch's host array — int() here is a
+        # plain numpy read, not a per-token D2H sync.
         out = [int(t) for t in toks[0]]
         return {"tokens": out, "num_tokens": len(out),
                 "speculative_stats": stats}
+
+    # ------------------------------------------- admin / weight refresh
+    def _admin(self, body: dict):
+        op = body["_admin"]
+        if op == "stats":
+            drafted = max(self._spec_drafted, 1)
+            return {
+                "weights_version": self._weights_version,
+                "active_requests": len(self._queues),
+                "spec_requests": self._spec_requests,
+                "spec_inflight": self._spec_inflight,
+                "spec_inflight_peak": self._spec_peak,
+                "spec_rounds": self._spec_rounds,
+                "spec_drafted": self._spec_drafted,
+                "spec_accepted": self._spec_accepted,
+                "spec_acceptance_rate": self._spec_accepted / drafted,
+                "spec_admission_bound": self._max_slots,
+            }
+        raise ValueError(f"unknown _admin op {op!r}")
+
+    def reconfigure(self, user_config):
+        """Live weight refresh (controller ``reconfigure`` fan-out or a
+        direct ``handle.reconfigure.remote``): ``{"weights_ref": ref}``
+        replaces the engine's and the speculative pair's parameters
+        without dropping in-flight requests. The ref rides the
+        cooperative-broadcast object plane — the driver puts the new
+        checkpoint ONCE and every replica pulls chunks peer-to-peer —
+        so a mid-load refresh never funnels N full copies through the
+        source node.
+
+        Loop-aware: the controller fan-out calls this from an executor
+        thread (blocking fetch is fine); a handle-routed call lands ON
+        the replica's event loop, where a blocking ``ray_tpu.get``
+        would deadlock the loop that must deliver the object — so that
+        path gets a coroutine (awaited by the async dispatcher) that
+        offloads the fetch to the executor."""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return self._refresh_weights(user_config)
+
+        async def _run():
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._refresh_weights, user_config)
+
+        return _run()
+
+    def _refresh_weights(self, user_config):
+        if not isinstance(user_config, dict):
+            return
+        params = user_config.get("weights")
+        ref = user_config.get("weights_ref")
+        if ref is not None:
+            import ray_tpu
+
+            params = ray_tpu.get(ref)
+        if params is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        # Store views deserialize as host arrays; commit them to device
+        # once, NOT per engine step.
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # Atomic w.r.t. engine steps (the pump holds the same lock):
+        # the param swap and the prefix-cache invalidation land BETWEEN
+        # steps, so no in-flight _admit can allocate a just-freed page
+        # or re-register old-weight pages after the wipe.
+        with self._engine_lock:
+            self.engine.params = params
+            # Paged engine: cached prefix pages hold K/V computed with
+            # the OLD weights — a post-refresh hit would seed sequences
+            # with stale state matching neither checkpoint's greedy.
+            if hasattr(self.engine, "invalidate_prefix_cache"):
+                self.engine.invalidate_prefix_cache()
+        if self._spec is not None:
+            dparams, dcfg = self._draft_factory(params, self._cfg)
+            self._spec = (params, self._cfg, dparams, dcfg,
+                          self._spec[4])
+        self._weights_version += 1
 
     async def _stream(self, body: dict):
         rid = self._submit(body)

@@ -171,6 +171,33 @@ def test_async_actor(ray_cluster):
     assert elapsed < 1.5
 
 
+def test_async_actor_exported_class_arg(ray_cluster):
+    """Regression (PR 9, broke in PR 6): an async-def actor method whose
+    argument payload carries a definition-export reference (a __main__
+    class pickled as a `_load_export(token)` call) must take the
+    executor arg-loading path — the inline on-loop fast path cannot
+    perform the blocking KV fetch a token-cache miss needs (run_async
+    from the IO thread), which failed every such call. This is exactly
+    the Serve handle shape: serve_bench's `_Req` driver-script request
+    class against an async replica."""
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    class AsyncTaker:
+        async def take(self, x):
+            return x.v
+
+    # A genuinely __main__-scoped class (dynamic classes tokenize via
+    # the definition-export path regardless of the test module's name).
+    Dyn = type("DynExported", (),
+               {"__init__": lambda self, v: setattr(self, "v", v)})
+    Dyn.__module__ = "__main__"
+    a = AsyncTaker.remote()
+    assert ray_tpu.get(a.take.remote(Dyn(7)), timeout=60) == 7
+    # Cached-token repeat still works (and stays correct) too.
+    assert ray_tpu.get(a.take.remote(Dyn(8)), timeout=60) == 8
+
+
 def test_actor_handle_passing(ray_cluster):
     ray_tpu = ray_cluster
 

@@ -1,0 +1,48 @@
+"""Tier-1 smoke of the sustained-load serving harness
+(benchmarks/serve_bench.py --mode sustained): tiny model, 2 keep-alive
+clients, short run — the many-client continuous-batching + speculative
+load path, the mid-load broadcast weight refresh, and the per-replica
+admission telemetry cannot silently rot. The full-size shape behind
+records/SERVE_BENCH_r09.json is this exact code at bigger parameters."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+from serve_bench import run_sustained_load, spec_ab  # noqa: E402
+
+
+def test_sustained_load_smoke():
+    result = run_sustained_load(
+        n_clients=2, spec_clients=1, duration_s=2.5, num_replicas=1,
+        max_slots=2, max_new=8, ttft_probes=1, smoke=True)
+    assert result["errors"] == 0, result
+    assert result["requests"] > 0
+    assert result["rps"] > 0
+    assert result["tokens_per_s"] > 0
+    assert result["req_p50_ms"] is not None
+    assert result["req_p99_ms"] >= result["req_p50_ms"]
+    # every client made progress on its keep-alive connection
+    assert result["per_client_requests"]["min"] > 0
+    # the streaming TTFT probe produced a first-token time
+    assert result["ttft_errors"] == 0
+    assert result["ttft_p50_ms"] is not None
+    # mid-load weight refresh landed on the (single) replica
+    assert result["weight_refresh"]["weights_version_after"] == [2]
+    # speculative lane served requests under the admission bound
+    rep = result["replicas"][0]
+    assert rep["spec_requests"] > 0
+    assert rep["spec_inflight_peak"] <= rep["spec_admission_bound"]
+
+
+def test_spec_ab_probe_smoke():
+    """The A/B probe itself (fast shape): parity asserted inside, fused
+    implementation reports the guard-pinned single host sync."""
+    result = spec_ab(iters=2, max_new=12, train_steps=25)
+    assert result["bit_identical_to_greedy"] is True
+    assert result["tokens_per_s"] > 0
+    assert result["host_syncs_per_gen"] == 1
+    assert "measured" in result["host_syncs_kind"]

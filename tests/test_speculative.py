@@ -49,6 +49,75 @@ def test_weak_draft_still_exact(cfgs):
     assert stats["drafted"] == stats["rounds"] * 3
 
 
+def test_fused_round_single_fetch_contract(cfgs, monkeypatch):
+    """THE fused-round contract (ROADMAP #2 / VERDICT Weak #3): the
+    whole generation runs on-device under ``jax.transfer_guard
+    ("disallow")`` — any implicit D2H sync (the old host accept loop did
+    ~2k+4 per round) raises — and the ONE sanctioned fetch is a single
+    explicit ``device_get`` of the packed token+stats buffer, counted
+    via the module's ``_device_fetch`` alias. Bit-identity to
+    ``generate_greedy`` is asserted inside the guard at k in {1, 4}."""
+    from ray_tpu.models import speculative as spec_mod
+
+    target_cfg, target, draft_cfg, draft = cfgs
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (1, 5), 0,
+                                target_cfg.vocab_size)
+    refs = {n: generate_greedy(target, prompt, target_cfg, max_new=n)
+            for n in (1, 16)}
+    calls = []
+    real_fetch = spec_mod._device_fetch
+    monkeypatch.setattr(
+        spec_mod, "_device_fetch",
+        lambda x: (calls.append(1), real_fetch(x))[1])
+    for k in (1, 4):
+        for max_new in (1, 16):
+            calls.clear()
+            with jax.transfer_guard("disallow"):
+                out, stats = generate_speculative(
+                    target, draft, prompt, target_cfg, draft_cfg,
+                    max_new=max_new, k=k)
+            assert len(calls) == 1, (k, max_new)
+            assert stats["host_fetches"] == 1
+            assert out.tolist() == refs[max_new].tolist(), (k, max_new)
+
+
+def test_zero_accept_schedule_exact(cfgs):
+    """Adversarial draft (negated lm_head: its greedy choice is the
+    target's LEAST likely token) — every round rejects at position 0,
+    the worst-case schedule. Output must still be bit-identical and the
+    device-side accept counter must report exactly zero."""
+    target_cfg, target, _, _ = cfgs
+    anti = dict(target)
+    anti["lm_head"] = -target["lm_head"]
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (1, 6), 0,
+                                target_cfg.vocab_size)
+    ref = generate_greedy(target, prompt, target_cfg, max_new=12)
+    with jax.transfer_guard("disallow"):
+        out, stats = generate_speculative(target, anti, prompt,
+                                          target_cfg, target_cfg,
+                                          max_new=12, k=4)
+    assert out.tolist() == ref.tolist()
+    assert stats["accepted"] == 0
+    assert stats["acceptance_rate"] == 0.0
+    assert stats["rounds"] == 11  # one emitted token per round
+
+
+def test_full_accept_schedule_under_guard(cfgs):
+    """Perfect draft under the transfer guard: the full-acceptance
+    draft-cache-hole feed is a lax.cond branch INSIDE the fused round —
+    it must not reintroduce a host dispatch or sync."""
+    target_cfg, target, _, _ = cfgs
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (1, 6), 0,
+                                target_cfg.vocab_size)
+    ref = generate_greedy(target, prompt, target_cfg, max_new=21)
+    with jax.transfer_guard("disallow"):
+        out, stats = generate_speculative(target, target, prompt,
+                                          target_cfg, target_cfg,
+                                          max_new=21, k=4)
+    assert out.tolist() == ref.tolist()
+    assert stats["acceptance_rate"] == 1.0
+
+
 def test_k_one_and_batch_guard(cfgs):
     target_cfg, target, draft_cfg, draft = cfgs
     prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0,
