@@ -39,6 +39,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+# Mesh-learner workloads (podracer) drive a multi-device virtual CPU
+# mesh inside a WORKER process; the flag must be in the environment
+# before the cluster spawns so workers inherit it (pytest runs get it
+# from tests/conftest.py — this covers standalone suite runs).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
 # --------------------------------------------------------------- workloads
 #
 # Each workload runs under an armed failpoint schedule, inside a cluster
@@ -419,6 +428,48 @@ def workload_drain_pipeline() -> dict:
         c.shutdown()
 
 
+def workload_podracer(updates: int = 6) -> dict:
+    """The Podracer (Sebulba) IMPALA tier under an env-runner SIGKILL
+    schedule (``podracer.sample.r1=hitK:kill`` — per-PROCESS hits, so
+    every incarnation of rank 1 dies at its K-th rollout): the learner
+    must keep training on the surviving runners (the driver's batched
+    wait group resolves the dead runner's refs as errors — it never
+    stalls), the aggregation tier re-subscribes surviving rollout refs,
+    dead runners are replaced, and end-state invariants hold."""
+    import ray_tpu
+    from ray_tpu.rl import PodracerConfig
+
+    pod = (PodracerConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=3, num_envs_per_env_runner=4,
+                        rollout_fragment_length=8)
+           .aggregation(num_aggregators=1, agg_fanin=2, queue_depth=2)
+           .learners(mesh_devices=2)
+           .training(broadcast_interval=1)
+           ).build()
+    try:
+        # Train until BOTH the update target and at least one fired
+        # kill+recovery are in evidence — the hit count is per process
+        # and paced by rank 1's own dispatch cadence, so a fast learner
+        # could otherwise finish before the schedule's 2nd hit lands.
+        deadline = time.time() + 240
+        while ((pod._updates_done < updates or pod._runner_restarts < 1)
+               and time.time() < deadline):
+            pod.step(max_wall_s=30)
+        m = pod.metrics()
+        assert m["updates"] >= updates, (
+            f"learner stalled under runner kills: {m}")
+        assert m["runner_restarts"] >= 1, (
+            "kill schedule never fired / recovery never ran")
+        assert sum(m["staleness"].values()) >= updates * 2, m["staleness"]
+        out = {"updates": m["updates"],
+               "runner_restarts": m["runner_restarts"],
+               "env_steps": m["env_steps"]}
+    finally:
+        pod.stop()
+    return out
+
+
 WORKLOADS = {
     "lineage": workload_lineage,
     "direct_args": workload_direct_args,
@@ -429,6 +480,7 @@ WORKLOADS = {
     "gang": workload_gang,
     "coord_death": workload_coord_death,
     "drain_pipeline": workload_drain_pipeline,
+    "podracer": workload_podracer,
 }
 
 # -------------------------------------------------------------- schedules
@@ -522,6 +574,14 @@ SCHEDULES = [
          spec="mpmd.admit=hit3:delay:0.2",
          workload="drain_pipeline",
          fault="drain notice mid-1F1B schedule"),
+    # --- Podracer RL tier (r10): env-runner death inside the
+    #     three-tier dataflow. hit2 is a per-process rate: every
+    #     incarnation of rank 1 (replacements included) dies at its 2nd
+    #     rollout — sustained runner churn, not a one-shot.
+    dict(name="impala_runner_kill", tier="fast", seed=81,
+         spec="podracer.sample.r1=hit2:kill",
+         workload="podracer",
+         fault="env-runner SIGKILL mid-iteration"),
 ]
 
 

@@ -1586,7 +1586,8 @@ class GcsServer:
         oid = ObjectID(o["oid"])
         entry = self._obj(oid)
         if entry.ready:  # duplicate registration
-            if client.node_id is not None and o.get("shm"):
+            if client.node_id is not None and o.get("shm") \
+                    and not o.get("nh"):
                 entry.holders.add(client.node_id.binary())
             return
         # ``owner_wid``: a leased worker registering a task result on
@@ -1610,7 +1611,11 @@ class GcsServer:
             entry.owner = owner
             self._owned_objects.setdefault(self._owner_key(owner),
                                            set()).add(oid)
-        if client.node_id is not None and o.get("shm"):
+        # ``nh`` (no holder): an actor-call CALLER registering results
+        # held in the actor's node arena, not its own — the executing
+        # worker's registration carries the true holder.
+        if client.node_id is not None and o.get("shm") \
+                and not o.get("nh"):
             entry.holders.add(client.node_id.binary())
         self._mark_ready(entry, o["nbytes"], o.get("data"),
                          o.get("shm", False))

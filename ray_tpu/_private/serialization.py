@@ -531,6 +531,10 @@ TRANSPORT_STATS = {
     # pull side — chunk-granular retries and coalesced concurrent gets.
     "bcast_chunk_retries": 0,
     "pull_dedup_hits": 0,
+    # Versioned weight broadcast (rl/podracer.py): driver-side puts per
+    # published version — the smoke test asserts exactly one put per
+    # version (re-shipping a copy per runner is the anti-pattern).
+    "weight_bcast_puts": 0,
     # Reference plane: outbound GCS wait subscriptions. The per-ref lane
     # pays one obj_wait frame per unresolved ref; the batched lane pays
     # one obj_waits frame per burst (tests assert a 1k-ref wait stays

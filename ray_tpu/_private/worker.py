@@ -2268,7 +2268,8 @@ class Worker:
         # reconnect paths, which re-dispatch the same dict.
         call = {"t": "actor_call", "aid": actor_id.binary(),
                 "tid": tid.binary(), "m": method,
-                "nret": num_returns, "opts": opts, **msg_args}
+                "nret": num_returns, "opts": opts,
+                "owner": self.worker_id.binary(), **msg_args}
         item = ("actor", actor_id, call, oids, opts.get("retries", 0))
         with self._out_lock:
             self._out_q.append(item)
@@ -2489,10 +2490,20 @@ class Worker:
         # the owner; this makes the ref resolvable by borrowers. One
         # coalesced frame for the whole result set (obj_puts) — a
         # num_returns=N call used to cost N object-plane frames.
+        # ``nh`` (no holder): the object lives in the ACTOR's node
+        # arena, not ours — the executing worker registers the true
+        # holder on its own connection (worker_main
+        # _register_shm_results). Recording the caller's node here made
+        # every cross-node actor result unpullable (driver connections
+        # carry no node_id → zero holders; worker callers recorded a
+        # node whose arena never held the object). This frame still
+        # matters for ordering: it rides OUR GCS connection ahead of
+        # any locate/borrow traffic we emit for the ref.
         shm_rs = [r for r in results if r.get("shm")]
         if shm_rs:
             self._send_gcs({"t": "obj_puts", "objs": [
-                {"oid": r["oid"], "nbytes": r["nbytes"], "shm": True}
+                {"oid": r["oid"], "nbytes": r["nbytes"], "shm": True,
+                 "nh": 1}
                 for r in shm_rs]})
         self.push_result(call["tid"], results)
         self.release_task_args(call)

@@ -877,6 +877,7 @@ class Executor:
         for r in results:
             r.pop("_err", None)
         self.record_event(tid, method_name, "actor_call", t0, time.time(), ok)
+        self._register_shm_results(msg, results)
         if not conn.closed:
             conn.reply(msg, {"results": results})
         self._maybe_exit_after_reply()
@@ -1002,6 +1003,31 @@ class Executor:
             except RuntimeError:
                 pass  # loop closed (shutdown)
 
+    def _register_shm_results(self, msg: dict, results: List[dict]):
+        """Register shm actor-call results from THIS process — the node
+        whose arena actually holds them (mirror of the leased-exec
+        ``_send_exec_reply`` registration; runs on the IO loop at both
+        reply sites). The caller registers too, but holder-less
+        (``nh``) and only for its own-connection FIFO ordering: before
+        this, cross-node actor results had ZERO holders (driver
+        connections carry no node_id) and every pull of one died with
+        "no holder could serve" — found by the r10 Podracer multi-node
+        bench. ``owner_wid`` hands ownership (and the initial ref pin)
+        to the calling worker/driver whichever registration lands
+        first."""
+        shm_rs = [r for r in results if r.get("shm")]
+        if not shm_rs or self.worker.gcs is None or self.worker.gcs.closed:
+            return
+        try:
+            self.worker.gcs.send({"t": "obj_puts", "objs": [
+                {"oid": r["oid"], "nbytes": r["nbytes"], "shm": True,
+                 "owner_wid": msg.get("owner")} for r in shm_rs]})
+        except ConnectionError:
+            # GCS blip: the caller's ordered registration plus the
+            # restart-resync replay cover the entry; only the holder
+            # hint is lost until rescan.
+            pass
+
     def _maybe_exit_after_reply(self):
         if getattr(self, "_exit_requested", False):
             import os as _os
@@ -1016,6 +1042,7 @@ class Executor:
             for r in results:
                 r.pop("_err", None)
             self.record_event(msg["tid"], msg["m"], "actor_call", t0, t1, ok)
+            self._register_shm_results(msg, results)
             if not conn.closed:
                 try:
                     conn.reply(msg, {"results": results})

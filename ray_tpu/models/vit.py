@@ -123,9 +123,13 @@ def _mlp(layer, x):
         x.dtype)
 
 
-def forward(params: Dict[str, Any], images: jax.Array,
-            cfg: ViTConfig, attn_impl=None) -> jax.Array:
-    """[B, H, W, C] images -> [B, num_classes] logits (f32)."""
+def encode(params: Dict[str, Any], images: jax.Array,
+           cfg: ViTConfig, attn_impl=None) -> jax.Array:
+    """[B, H, W, C] images -> pooled CLS features [B, d_model] (f32).
+
+    The encoder half of :func:`forward`, exposed so non-classification
+    heads (the RL pixel policy/value module, ``rl/rl_module.py``) ride
+    the same patch-embed + transformer path."""
     if attn_impl is None:
         # flash_attention owns the platform/shape fallback internally
         # (ops/attention.py:145); same convention as llama.py.
@@ -139,7 +143,13 @@ def forward(params: Dict[str, Any], images: jax.Array,
         x = _attention(layer, x, cfg, attn_impl)
         x = _mlp(layer, x)
     x = rms_norm(x, params["norm"])
-    pooled = x[:, 0].astype(jnp.float32)  # CLS token
+    return x[:, 0].astype(jnp.float32)  # CLS token
+
+
+def forward(params: Dict[str, Any], images: jax.Array,
+            cfg: ViTConfig, attn_impl=None) -> jax.Array:
+    """[B, H, W, C] images -> [B, num_classes] logits (f32)."""
+    pooled = encode(params, images, cfg, attn_impl)
     return pooled @ params["head"]["w"] + params["head"]["b"]
 
 

@@ -15,8 +15,10 @@ from .learner import Learner, LearnerGroup, gae
 from .multi_agent import MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO
 from .offline import (BC, BCConfig, MARWIL, MARWILConfig,
                       episodes_to_rows)
+from .pixel_env import CatchEnv
+from .podracer import Podracer, PodracerConfig
 from .replay import ReplayBuffer
-from .rl_module import MLPModuleConfig
+from .rl_module import MLPModuleConfig, PixelModuleConfig
 from .sac import SAC, SACConfig
 from .vtrace import vtrace
 
@@ -28,6 +30,7 @@ __all__ = [
     "BC", "MARWIL", "episodes_to_rows",
     "SAC", "SACConfig", "APPO", "APPOConfig", "CQL", "CQLConfig",
     "BCConfig", "MARWILConfig", "Impala", "ImpalaConfig",
+    "Podracer", "PodracerConfig", "PixelModuleConfig", "CatchEnv",
     "DreamerV3", "DreamerV3Algo",
     "ConnectorPipeline", "FlattenObs", "NormalizeObs", "ClipRewards",
     "GAEConnector", "default_env_to_module", "default_learner_pipeline",

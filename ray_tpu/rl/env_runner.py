@@ -16,8 +16,11 @@ import numpy as np
 import ray_tpu
 
 
-@ray_tpu.remote
-class EnvRunner:
+class EnvRunnerImpl:
+    """Undecorated runner body — subclassable (the Podracer tier's
+    ``PodRunner`` extends it with versioned weight pulls and time-major
+    output); ``EnvRunner`` below is the registered actor class."""
+
     def __init__(self, env_id: str, num_envs: int, module_cfg_blob: bytes,
                  seed: int = 0, env_fn_blob: Optional[bytes] = None):
         import cloudpickle
@@ -35,6 +38,10 @@ class EnvRunner:
             self.env = gym.make_vec(env_id, num_envs=num_envs,
                                     vectorization_mode="sync")
         self.cfg = cloudpickle.loads(module_cfg_blob)
+        # Config-dispatched forwards (MLP or the ViT pixel path): the
+        # sampling loop below is module-family agnostic.
+        self._sample_fn = rl_module.make_sample_fn(self.cfg)
+        self._value_fn = rl_module.make_forward(self.cfg)
         self.key = jax.random.PRNGKey(seed)
         self.obs, _ = self.env.reset(seed=seed)
         self.num_envs = num_envs
@@ -57,20 +64,20 @@ class EnvRunner:
         self.completed_lengths: List[int] = []
 
     def sample(self, weights_ref, num_steps: int) -> Dict[str, np.ndarray]:
-        """Collect ``num_steps`` per env; returns flat [T*N, ...] arrays
-        plus bootstrap values."""
+        """Collect ``num_steps`` per env; returns [T, N, ...] arrays plus
+        bootstrap values."""
+        params = weights_ref  # resolved ObjectRef -> params pytree
+        return self._collect(params, num_steps)
+
+    def _collect(self, params, num_steps: int) -> Dict[str, np.ndarray]:
         import jax
 
-        from . import rl_module
-
-        params = weights_ref  # resolved ObjectRef -> params pytree
         obs_buf, act_buf, logp_buf, rew_buf, done_buf, val_buf, mask_buf = \
             [], [], [], [], [], [], []
         for _ in range(num_steps):
             valid = ~self._prev_done  # False on NEXT_STEP autoreset steps
             self.key, sub = jax.random.split(self.key)
-            actions, logp, value = rl_module.sample_actions(
-                params, self.obs, sub)
+            actions, logp, value = self._sample_fn(params, self.obs, sub)
             nxt, rew, term, trunc, _ = self.env.step(actions)
             done = np.logical_or(term, trunc)
             obs_buf.append(self.obs.copy())
@@ -90,7 +97,7 @@ class EnvRunner:
             self._prev_done = done if self._next_step_autoreset else \
                 np.zeros(self.num_envs, bool)
             self.obs = nxt
-        _, last_value = rl_module.forward_jit(params, np.asarray(self.obs))
+        _, last_value = self._value_fn(params, np.asarray(self.obs))
         return {
             "obs": np.stack(obs_buf),            # [T, N, obs]
             "actions": np.stack(act_buf),        # [T, N]
@@ -159,6 +166,9 @@ class EnvRunner:
         return True
 
 
+EnvRunner = ray_tpu.remote(EnvRunnerImpl)
+
+
 class EnvRunnerGroup:
     """Fault-aware group of sampling actors (EnvRunnerGroup analog)."""
 
@@ -181,6 +191,12 @@ class EnvRunnerGroup:
         replaced and retried once (FaultAwareApply restart semantics,
         ``env/env_runner.py:28``)."""
         refs = [getattr(r, method).remote(*args) for r in self.runners]
+        # ONE batched wait-group subscribe for the whole fan-out (the
+        # PR 5 obj_waits lane) — the per-ref gets below then hit
+        # already-resolved futures, so the n-runner sync point costs one
+        # frame instead of n serial round trips (per-ref error handling
+        # is why this is not a single list-get).
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
         out = []
         for i, ref in enumerate(refs):
             try:

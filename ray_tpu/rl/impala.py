@@ -91,7 +91,7 @@ class IMPALA(Algorithm):
         vs, pg_adv = vtrace(
             batch["logp"], tgt_logp, batch["rewards"], tgt_values,
             batch["dones"], batch["bootstrap_value"], cfg.gamma,
-            cfg.vtrace_clip_rho, cfg.vtrace_clip_c)
+            cfg.vtrace_clip_rho, cfg.vtrace_clip_c, cfg.vtrace_lambda)
         flat = lambda x: x.reshape(T * N, *x.shape[2:])  # noqa: E731
         keep = flat(batch["mask"]) if "mask" in batch else \
             np.ones(T * N, bool)
@@ -139,8 +139,11 @@ class IMPALA(Algorithm):
                 except Exception:
                     self.env_runner_group.restart_runner(i)
             # Dead aggregators would otherwise poison every later round the
-            # round-robin lands on them.
+            # round-robin lands on them. One batched wait-group subscribe
+            # covers the whole ping fan-out (PR 5 lane); the per-ref gets
+            # below are already-resolved-future reads.
             pings = [a.ping.remote() for a in self.aggregators]
+            ray_tpu.wait(pings, num_returns=len(pings), timeout=5)
             for j, ref in enumerate(pings):
                 try:
                     ray_tpu.get(ref, timeout=5)
@@ -176,18 +179,20 @@ class IMPALAConfig(AlgorithmConfig):
         self.broadcast_interval = 1
         self.vtrace_clip_rho = 1.0
         self.vtrace_clip_c = 1.0
+        self.vtrace_lambda = 1.0
         self.num_epochs = 1          # IMPALA is single-pass
         self.minibatch_size = 1 << 30  # full batch
 
     def training(self, *, num_aggregation_workers=None,
                  broadcast_interval=None, vtrace_clip_rho=None,
-                 vtrace_clip_c=None, **kw):
+                 vtrace_clip_c=None, vtrace_lambda=None, **kw):
         super().training(**kw)
         for name, val in [
                 ("num_aggregation_workers", num_aggregation_workers),
                 ("broadcast_interval", broadcast_interval),
                 ("vtrace_clip_rho", vtrace_clip_rho),
-                ("vtrace_clip_c", vtrace_clip_c)]:
+                ("vtrace_clip_c", vtrace_clip_c),
+                ("vtrace_lambda", vtrace_lambda)]:
             if val is not None:
                 setattr(self, name, val)
         return self

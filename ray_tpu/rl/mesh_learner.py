@@ -37,6 +37,7 @@ class MeshLearner:
         self.mesh = make_mesh(MeshSpec(dp=n), devices=devices[:n])
         self.n_devices = n
         self.hparams = hparams
+        self.module_cfg = module_cfg
         self._replicated = NamedSharding(self.mesh, P())
         self._batched = NamedSharding(self.mesh, P("dp"))
         self.params = jax.device_put(
@@ -90,6 +91,118 @@ class MeshLearner:
 
         self.params = jax.device_put(params, self._replicated)
         return True
+
+
+class VtraceMeshLearner(MeshLearner):
+    """IMPALA update on the mesh: time-major [T, B] batches, V-trace
+    folded INTO the jitted step (``vtrace.vtrace_scan``), single pass.
+
+    The env axis shards over ``dp`` (V-trace's reverse scan is
+    per-env independent, so the correction costs zero collectives); the
+    loss reductions are global under jit, so the gradient psum compiles
+    in exactly like the PPO step. This is the Podracer learner tier: one
+    process drives the whole mesh, there is no grad-averaging actor
+    choreography, and the host never sees the advantage tensors."""
+
+    def __init__(self, module_cfg, hparams: dict,
+                 n_devices: Optional[int] = None, seed: int = 0):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        super().__init__(module_cfg, hparams, n_devices=n_devices,
+                         seed=seed)
+        self._timemajor = NamedSharding(self.mesh, P(None, "dp"))
+        self._envaxis = NamedSharding(self.mesh, P("dp"))
+        self._vstep = self._build_vtrace_step()
+
+    def _build_vtrace_step(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from . import rl_module
+        from .vtrace import vtrace_scan
+
+        hp = self.hparams
+        gamma = hp.get("gamma", 0.99)
+        clip_rho = hp.get("vtrace_clip_rho", 1.0)
+        clip_c = hp.get("vtrace_clip_c", 1.0)
+        lam = hp.get("vtrace_lambda", 1.0)
+        vf_coeff = hp.get("vf_loss_coeff", 0.5)
+        ent_coeff = hp.get("entropy_coeff", 0.01)
+        fwd = rl_module.make_forward(self.module_cfg, jit=False)
+
+        def loss_fn(params, batch):
+            T, B = batch["rewards"].shape
+            obs = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
+            logits, values = fwd(params, obs.astype(jnp.float32))
+            logp_all = jax.nn.log_softmax(logits)
+            actions = batch["actions"].reshape(T * B).astype(jnp.int32)
+            tgt_logp = jnp.take_along_axis(
+                logp_all, actions[:, None], axis=1)[:, 0].reshape(T, B)
+            values_tb = values.reshape(T, B)
+            vs, pg_adv = vtrace_scan(
+                batch["logp"], tgt_logp, batch["rewards"], values_tb,
+                batch["dones"], batch["bootstrap_value"], gamma,
+                clip_rho, clip_c, lam)
+            vs = jax.lax.stop_gradient(vs)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            # NEXT_STEP-autoreset pseudo-rows carry no decision — mask
+            # them out of every reduction (the flat-batch paths drop the
+            # rows instead; dropping would ragged the [T, B] layout).
+            mask = batch["mask"].astype(jnp.float32)
+            denom = jnp.maximum(mask.sum(), 1.0)
+            pi_loss = -jnp.sum(tgt_logp * pg_adv * mask) / denom
+            vf_loss = 0.5 * jnp.sum(
+                jnp.square(values_tb - vs) * mask) / denom
+            ent = -jnp.sum(jax.nn.softmax(logits) * logp_all,
+                           axis=-1).reshape(T, B)
+            entropy = jnp.sum(ent * mask) / denom
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            stats = {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                     "entropy": entropy, "total_loss": total,
+                     "mean_rho": jnp.sum(
+                         jnp.exp(tgt_logp - batch["logp"]) * mask) / denom}
+            return total, stats
+
+        def step(params, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, stats
+
+        batch_shardings = {
+            "obs": self._timemajor, "actions": self._timemajor,
+            "logp": self._timemajor, "rewards": self._timemajor,
+            "dones": self._timemajor, "mask": self._timemajor,
+            "bootstrap_value": self._envaxis,
+        }
+        return jax.jit(
+            step,
+            in_shardings=(self._replicated, self._replicated,
+                          batch_shardings),
+            out_shardings=(self._replicated, self._replicated, None),
+            donate_argnums=(0, 1))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One single-pass V-trace update over a time-major batch
+        ({obs, actions, logp, rewards, dones, mask} [T, B, ...] +
+        bootstrap_value [B]). The env axis B must divide evenly over
+        the mesh (the aggregation tier guarantees it)."""
+        import jax
+
+        B = batch["rewards"].shape[1]
+        if B % self.n_devices:
+            raise ValueError(
+                f"env axis {B} not divisible by mesh size "
+                f"{self.n_devices} — size agg_fanin * num_envs so it is")
+        put = {
+            k: jax.device_put(
+                v, self._envaxis if k == "bootstrap_value"
+                else self._timemajor)
+            for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._vstep(
+            self.params, self.opt_state, put)
+        return {k: float(v) for k, v in stats.items()}
 
 
 @ray_tpu.remote

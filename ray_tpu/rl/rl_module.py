@@ -26,7 +26,48 @@ class MLPModuleConfig:
     dtype: Any = jnp.float32
 
 
-def init(cfg: MLPModuleConfig, key: jax.Array) -> Dict[str, Any]:
+@dataclasses.dataclass(frozen=True)
+class PixelModuleConfig:
+    """Policy/value module over image observations, riding the existing
+    ViT encoder (``models/vit.py``): patch-embed matmul + transformer
+    blocks + pooled CLS features, with pi/vf heads on top. The pi head
+    IS the ViT classification head (``num_classes = num_actions``); the
+    vf head is one extra [D, 1] matmul on the same pooled features —
+    no second model family, the vision path RL trains is the vision
+    path the framework serves."""
+
+    image_size: int
+    num_actions: int
+    channels: int = 1
+    patch_size: int = 4
+    d_model: int = 32
+    n_layers: int = 1
+    n_heads: int = 4
+    d_ff: int = 64
+
+    @property
+    def vit(self):
+        from ray_tpu.models import vit as _vit
+
+        return _vit.ViTConfig(
+            image_size=self.image_size, patch_size=self.patch_size,
+            channels=self.channels, num_classes=self.num_actions,
+            d_model=self.d_model, n_layers=self.n_layers,
+            n_heads=self.n_heads, d_ff=self.d_ff, dtype=jnp.float32)
+
+
+def init(cfg, key: jax.Array) -> Dict[str, Any]:
+    if isinstance(cfg, PixelModuleConfig):
+        from ray_tpu.models import vit as _vit
+
+        k1, k2 = jax.random.split(key)
+        params = {"vit": _vit.init_params(cfg.vit, k1)}
+        params["vf"] = {
+            "w": jax.random.normal(k2, (cfg.d_model, 1),
+                                   jnp.float32) * 0.02,
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+        return params
     sizes = (cfg.obs_dim,) + tuple(cfg.hidden)
     params: Dict[str, Any] = {"layers": []}
     keys = jax.random.split(key, len(sizes) + 1)
@@ -76,6 +117,50 @@ def sample_actions(params, obs, key) -> Tuple[np.ndarray, np.ndarray, np.ndarray
     actions = jax.random.categorical(key, logits)
     logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), actions]
     return (np.asarray(actions), np.asarray(logp), np.asarray(value))
+
+
+def pixel_forward(cfg: PixelModuleConfig, params,
+                  obs) -> Tuple[jax.Array, jax.Array]:
+    """[B, H, W, C] images -> (action_logits [B, A], value [B]) through
+    the shared ViT encoder (``models/vit.py:encode``)."""
+    from ray_tpu.models import vit as _vit
+
+    vcfg = cfg.vit
+    pooled = _vit.encode(params["vit"], obs, vcfg)
+    logits = (pooled @ params["vit"]["head"]["w"]
+              + params["vit"]["head"]["b"])
+    value = (pooled @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def make_forward(cfg, jit: bool = True):
+    """Config-dispatched forward: ``fn(params, obs) -> (logits, value)``.
+    MLP configs resolve to the module-level :func:`forward` (shared jit
+    cache); pixel configs close over the static config and route through
+    the ViT encoder. ``jit=False`` returns the traceable raw function
+    for callers that fold it into their own jitted step (the V-trace
+    mesh learner)."""
+    if isinstance(cfg, PixelModuleConfig):
+        import functools
+
+        fn = functools.partial(pixel_forward, cfg)
+        return jax.jit(fn) if jit else fn
+    return forward_jit if jit else forward
+
+
+def make_sample_fn(cfg):
+    """Exploration forward for any module config: sampled actions +
+    behaviour logp + value, numpy out (the env-runner hot loop)."""
+    fwd = make_forward(cfg)
+
+    def sample(params, obs, key):
+        logits, value = fwd(params, jnp.asarray(obs))
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions]
+        return (np.asarray(actions), np.asarray(logp), np.asarray(value))
+
+    return sample
 
 
 def epsilon_greedy_actions(params, obs, key, epsilon: float) -> np.ndarray:

@@ -2,8 +2,19 @@
 
 Reference: ``rllib/algorithms/impala/`` vtrace_torch/tf — importance-
 weighted multi-step value targets with clipped rho/c (Espeholt et al.
-2018). Computed as a reverse scan over [T, N] arrays; numpy here (it runs
-on the learner's host path right before the jitted update, like GAE).
+2018). Two implementations with identical semantics:
+
+* :func:`vtrace` — numpy reverse scan over [T, N] arrays; runs on the
+  learner's host path right before the jitted update (like GAE).
+* :func:`vtrace_scan` — ``lax.scan`` version that traces under ``jit``,
+  so the Podracer mesh learner folds the correction INTO the compiled
+  update (no host round trip per batch; under GSPMD the scan shards
+  along the env axis with everything else).
+
+``lam`` is the Espeholt λ: it scales the c ("trace cutting") weights
+only — λ=1 is full n-step V-trace, λ<1 decays the off-policy correction
+toward one-step TD exactly like TD(λ) (rho, the policy-gradient weight,
+is never scaled).
 """
 
 from __future__ import annotations
@@ -16,8 +27,8 @@ import numpy as np
 def vtrace(behaviour_logp: np.ndarray, target_logp: np.ndarray,
            rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
            bootstrap_value: np.ndarray, gamma: float = 0.99,
-           clip_rho: float = 1.0, clip_c: float = 1.0
-           ) -> Tuple[np.ndarray, np.ndarray]:
+           clip_rho: float = 1.0, clip_c: float = 1.0,
+           lam: float = 1.0) -> Tuple[np.ndarray, np.ndarray]:
     """Returns (vs, pg_advantages), both [T, N].
 
     vs are the v-trace value targets; pg_advantages are the clipped-rho
@@ -25,7 +36,7 @@ def vtrace(behaviour_logp: np.ndarray, target_logp: np.ndarray,
     """
     T, N = rewards.shape
     rho = np.minimum(np.exp(target_logp - behaviour_logp), clip_rho)
-    c = np.minimum(np.exp(target_logp - behaviour_logp), clip_c)
+    c = lam * np.minimum(np.exp(target_logp - behaviour_logp), clip_c)
     nonterminal = 1.0 - dones.astype(np.float32)
     values_tp1 = np.concatenate([values[1:], bootstrap_value[None]], axis=0)
     deltas = rho * (rewards + gamma * values_tp1 * nonterminal - values)
@@ -38,3 +49,34 @@ def vtrace(behaviour_logp: np.ndarray, target_logp: np.ndarray,
     vs_tp1 = np.concatenate([vs[1:], bootstrap_value[None]], axis=0)
     pg_adv = rho * (rewards + gamma * vs_tp1 * nonterminal - values)
     return vs.astype(np.float32), pg_adv.astype(np.float32)
+
+
+def vtrace_scan(behaviour_logp, target_logp, rewards, values, dones,
+                bootstrap_value, gamma: float = 0.99,
+                clip_rho: float = 1.0, clip_c: float = 1.0,
+                lam: float = 1.0):
+    """Jit-traceable V-trace: same math as :func:`vtrace` on jnp arrays
+    via a reversed ``lax.scan`` over the time axis. Inputs [T, N] (+
+    bootstrap [N]); returns (vs, pg_advantages) as jnp arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    rho = jnp.minimum(jnp.exp(target_logp - behaviour_logp), clip_rho)
+    c = lam * jnp.minimum(jnp.exp(target_logp - behaviour_logp), clip_c)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]],
+                                 axis=0)
+    deltas = rho * (rewards + gamma * values_tp1 * nonterminal - values)
+
+    def step(acc, xs):
+        delta_t, nt_t, c_t = xs
+        acc = delta_t + gamma * nt_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(bootstrap_value),
+        (deltas, nonterminal, c), reverse=True)
+    vs = vs_minus_v + values
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * vs_tp1 * nonterminal - values)
+    return vs, pg_adv

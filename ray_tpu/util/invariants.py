@@ -143,6 +143,13 @@ def _session_procs() -> List[dict]:
     return [p for p in out if p["ppid"] == 1]
 
 
+def orphaned_session_procs() -> List[dict]:
+    """Public face of the ppid==1 orphan scan — used by the conftest
+    pre-flight (stale zygotes from earlier hard-killed runs red out the
+    chaos tier host-wide) as well as the post-shutdown host check."""
+    return _session_procs()
+
+
 def check_host_invariants(session_name: Optional[str] = None,
                           timeout: float = 10.0) -> None:
     """Post-shutdown host state: no orphaned session processes, and the

@@ -1,0 +1,128 @@
+"""Tier-1 smoke of the Podracer (Sebulba) IMPALA tier — the bench path
+(benchmarks/rl_bench.py --mode impala) cannot silently rot (mirror of
+test_serve_bench_smoke.py): tiny shape, real three-tier dataflow.
+
+Asserts the r10 tentpole contracts:
+  * updates actually land through runner -> aggregator -> mesh learner,
+  * broadcast staleness is RECORDED per rollout (a distribution, not a
+    guess),
+  * weight broadcast is ONE driver-side put per published version
+    (transport counters — re-shipping per runner is the anti-pattern),
+  * the aggregator tier pushes batches worker-to-worker (driver-side
+    counters never see a batch payload).
+
+The slow half is the heavier-than-CartPole learning threshold: the
+procedural Catch pixel env through the ViT module path must hit a
+reward threshold under a step budget.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _run_pod(pod, min_updates, wall_s):
+    deadline = time.time() + wall_s
+    while pod._updates_done < min_updates and time.time() < deadline:
+        pod.step(max_wall_s=30)
+    return pod.metrics()
+
+
+def test_podracer_smoke():
+    from ray_tpu._private.serialization import reset_transport_stats
+    from ray_tpu.rl import PodracerConfig
+
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
+    reset_transport_stats()
+    puts_before = global_worker()._put_counter._value
+    pod = (PodracerConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                        rollout_fragment_length=8)
+           .aggregation(num_aggregators=1, agg_fanin=2, queue_depth=2)
+           .learners(mesh_devices=2)
+           .training(train_batch_size=64, broadcast_interval=1)
+           .debugging(seed=0)
+           ).build()
+    try:
+        m = _run_pod(pod, min_updates=3, wall_s=120)
+        assert m["updates"] >= 3, m
+        assert m["env_steps"] > 0
+        # staleness is measured per aggregated rollout (agg_fanin per
+        # update) and every update recorded its batch's versions
+        assert sum(m["staleness"].values()) >= 3 * 2, m["staleness"]
+        assert all(int(k) >= 0 for k in m["staleness"])
+        # ONE driver put per published weight version — the broadcast
+        # back-edge never re-ships copies per runner. Two surfaces:
+        # the subsystem's own counter, AND the driver worker's actual
+        # store-put counter (weight boxes are the ONLY puts this
+        # workload's driver makes, so a per-runner re-ship regression
+        # shows up here even if the hand counter still lines up).
+        assert m["published_versions"] >= 2
+        assert (m["transport"]["weight_bcast_puts"]
+                == m["published_versions"]), m["transport"]
+        actual_puts = global_worker()._put_counter._value - puts_before
+        assert actual_puts == m["published_versions"], (
+            f"driver made {actual_puts} store puts for "
+            f"{m['published_versions']} published versions")
+        # learner queue was actually exercised (occupancy observed)
+        assert m["queue_occupancy"]["max"] >= 1
+        # the batch payloads moved aggregator->learner, not through the
+        # driver: the aggregator tier's own data-plane counters saw the
+        # pushes (inline or direct lane depending on batch size)
+        agg = m["agg_transport"]
+        assert (agg.get("inline_args", 0) + agg.get("direct_lane_args", 0)
+                + agg.get("shm_args", 0)) >= m["updates"], agg
+        # fresh learner stats flowed back
+        assert "total_loss" in pod._last_stats
+    finally:
+        pod.stop()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_podracer_pixel_catch_learns():
+    """The r10 learning threshold: Catch (procedural pixels) through
+    the ViT module path (PixelModuleConfig -> models/vit.py encoder)
+    must reach mean return >= 0.5 (i.e. catch rate >= 75%) within a
+    600k env-step budget. The prototype run on this host crossed 0.75
+    by ~320k steps at ~12k env-steps/s."""
+    from ray_tpu.rl import PodracerConfig
+    from ray_tpu.rl.pixel_env import CatchEnv
+
+    ray_tpu.init(num_cpus=6, probe_tpu=False, ignore_reinit_error=True)
+    pod = (PodracerConfig()
+           .environment("catch", env_fn=lambda: CatchEnv(8))
+           .env_runners(num_env_runners=3, num_envs_per_env_runner=16,
+                        rollout_fragment_length=16)
+           .aggregation(num_aggregators=1, agg_fanin=2, queue_depth=3)
+           .learners(mesh_devices=4)
+           .training(lr=1e-3, entropy_coeff=0.01, gamma=0.99,
+                     broadcast_interval=1)
+           .debugging(seed=1)
+           ).build()
+    try:
+        assert type(pod.module_cfg).__name__ == "PixelModuleConfig"
+        best = -1.0
+        deadline = time.time() + 420
+        while (pod._total_env_steps < 600_000
+               and time.time() < deadline):
+            out = pod.train()
+            r = out.get("episode_return_mean")
+            if r is not None and np.isfinite(r):
+                best = max(best, r)
+            if best >= 0.5:
+                break
+        assert best >= 0.5, (
+            f"pixel Catch not learned: best={best:.3f} after "
+            f"{pod._total_env_steps} env steps")
+        m = pod.metrics()
+        assert sum(m["staleness"].values()) > 0
+    finally:
+        pod.stop()
+        ray_tpu.shutdown()

@@ -1,0 +1,130 @@
+"""V-trace reference tests (rl/vtrace.py — untested until r10).
+
+Every expected value below is hand-computed scalar-by-scalar from the
+Espeholt et al. 2018 definitions (eqs. 1-2):
+
+    delta_t = rho_t (r_t + gamma nt_t V(x_{t+1}) - V(x_t))
+    vs_t - V(x_t) = delta_t + gamma nt_t c_t (vs_{t+1} - V(x_{t+1}))
+    pg_adv_t = rho_t (r_t + gamma nt_t vs_{t+1} - V(x_t))
+
+with rho_t = min(clip_rho, ratio_t), c_t = lam * min(clip_c, ratio_t),
+nt_t = 1 - done_t — NOT by re-running the library's own scan.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.vtrace import vtrace, vtrace_scan
+
+
+def _col(*vals):
+    return np.asarray(vals, np.float32).reshape(len(vals), 1)
+
+
+def test_on_policy_reduces_to_nstep_td():
+    """ratio == 1 everywhere (behaviour == target), no dones: vs is the
+    n-step TD target; pg_adv the TD error against vs_{t+1}."""
+    gamma = 0.9
+    logp = _col(-0.5, -1.0)
+    rew = _col(1.0, 2.0)
+    val = _col(0.5, 1.5)
+    dones = np.zeros((2, 1), bool)
+    bv = np.asarray([2.0], np.float32)
+    vs, pg = vtrace(logp, logp, rew, val, dones, bv, gamma, 1.0, 1.0)
+    # delta_1 = 1*(2.0 + 0.9*2.0 - 1.5) = 2.3  -> vs_1 = 1.5 + 2.3 = 3.8
+    # delta_0 = 1*(1.0 + 0.9*1.5 - 0.5) = 1.85
+    # vs_0 = 0.5 + delta_0 + 0.9*1*2.3 = 0.5 + 1.85 + 2.07 = 4.42
+    np.testing.assert_allclose(vs[:, 0], [4.42, 3.8], rtol=1e-6)
+    # pg_0 = 1.0 + 0.9*vs_1 - 0.5 = 3.92 ; pg_1 = 2.0 + 0.9*2.0 - 1.5
+    np.testing.assert_allclose(pg[:, 0], [3.92, 2.3], rtol=1e-6)
+
+
+def test_rho_and_c_clipping():
+    """ratio = e (behaviour-target gap of 1 nat) clips at clip_rho for
+    the delta/pg weight and at clip_c for the trace coefficient."""
+    gamma = 1.0
+    beh = _col(0.0, 0.0)
+    tgt = _col(1.0, 1.0)   # ratio = e ~ 2.718 at both steps
+    rew = _col(0.0, 0.0)
+    val = _col(0.0, 0.0)
+    dones = np.zeros((2, 1), bool)
+    bv = np.asarray([1.0], np.float32)
+    # clip_rho=1, clip_c=1: rho=c=1. delta_1 = 1*(0 + 1 - 0) = 1
+    # delta_0 = 1*(0 + 0 - 0) = 0 ; vs_0 = 0 + 0 + 1*1*1 = 1
+    vs, pg = vtrace(beh, tgt, rew, val, dones, bv, gamma, 1.0, 1.0)
+    np.testing.assert_allclose(vs[:, 0], [1.0, 1.0], rtol=1e-6)
+    # pg_0 = rho*(0 + vs_1 - 0) = 1.0 ; pg_1 = rho*(0 + bv - 0) = 1.0
+    np.testing.assert_allclose(pg[:, 0], [1.0, 1.0], rtol=1e-6)
+    # raise clip_rho past e: rho = e, c still 1.
+    e = float(np.exp(1.0))
+    vs3, pg3 = vtrace(beh, tgt, rew, val, dones, bv, gamma, 3.0, 1.0)
+    # delta_1 = e ; vs_1 = e ; delta_0 = 0 ; vs_0 = 0 + 1*e
+    np.testing.assert_allclose(vs3[:, 0], [e, e], rtol=1e-6)
+    # pg_0 = e*(vs_1) = e*e ; pg_1 = e*bv = e
+    np.testing.assert_allclose(pg3[:, 0], [e * e, e], rtol=1e-6)
+    # raise clip_c too: trace coefficient becomes e as well.
+    vs33, _ = vtrace(beh, tgt, rew, val, dones, bv, gamma, 3.0, 3.0)
+    # vs_0 = delta_0 + gamma*c_0*(vs_1 - V_1) = 0 + e*e
+    np.testing.assert_allclose(vs33[:, 0], [e * e, e], rtol=1e-6)
+
+
+def test_bootstrap_and_done_cut():
+    """A done at t cuts both the bootstrap and the trace through t."""
+    gamma = 0.9
+    logp = _col(-0.3, -0.3)
+    rew = _col(1.0, 1.0)
+    val = _col(0.25, 0.5)
+    dones = np.asarray([[True], [False]])
+    bv = np.asarray([10.0], np.float32)
+    vs, pg = vtrace(logp, logp, rew, val, dones, bv, gamma, 1.0, 1.0)
+    # delta_1 = 1 + 0.9*10 - 0.5 = 9.5 -> vs_1 = 10.0
+    # t=0 is terminal: delta_0 = 1 + 0 - 0.25 = 0.75, trace cut:
+    # vs_0 = 0.25 + 0.75 + 0 = 1.0
+    np.testing.assert_allclose(vs[:, 0], [1.0, 10.0], rtol=1e-6)
+    # pg_0 = 1 + 0 - 0.25 (no bootstrap through the done)
+    np.testing.assert_allclose(pg[:, 0], [0.75, 9.5], rtol=1e-6)
+
+
+def test_lambda_decays_the_correction():
+    """lam scales ONLY the trace coefficient c: with lam=0.5 the t=0
+    target keeps half the downstream correction; rho (and so pg_adv's
+    weight) is untouched."""
+    gamma = 1.0
+    logp = _col(-0.5, -0.5)
+    rew = _col(0.0, 0.0)
+    val = _col(0.0, 0.0)
+    dones = np.zeros((2, 1), bool)
+    bv = np.asarray([4.0], np.float32)
+    # on-policy: delta_1 = 4.0, delta_0 = 0.
+    vs_full, _ = vtrace(logp, logp, rew, val, dones, bv, gamma,
+                        1.0, 1.0, lam=1.0)
+    vs_half, pg_half = vtrace(logp, logp, rew, val, dones, bv, gamma,
+                              1.0, 1.0, lam=0.5)
+    np.testing.assert_allclose(vs_full[:, 0], [4.0, 4.0], rtol=1e-6)
+    # vs_0 = 0 + gamma * nt * (lam*c) * delta_1 = 0.5 * 4.0
+    np.testing.assert_allclose(vs_half[:, 0], [2.0, 4.0], rtol=1e-6)
+    # pg_adv still uses unscaled rho: pg_0 = 1*(0 + vs_1 - 0) = 4.0
+    np.testing.assert_allclose(pg_half[:, 0], [4.0, 4.0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("lam", [1.0, 0.7])
+def test_scan_matches_numpy(lam):
+    """The jit-traceable lax.scan variant is bit-compatible (f32) with
+    the host scan on random off-policy batches."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    T, N = 9, 6
+    beh = rng.randn(T, N).astype(np.float32)
+    tgt = beh + 0.5 * rng.randn(T, N).astype(np.float32)
+    rew = rng.randn(T, N).astype(np.float32)
+    val = rng.randn(T, N).astype(np.float32)
+    dones = rng.rand(T, N) < 0.25
+    bv = rng.randn(N).astype(np.float32)
+    vs1, pg1 = vtrace(beh, tgt, rew, val, dones, bv, 0.95, 1.2, 0.9, lam)
+    vs2, pg2 = vtrace_scan(
+        jnp.asarray(beh), jnp.asarray(tgt), jnp.asarray(rew),
+        jnp.asarray(val), jnp.asarray(dones), jnp.asarray(bv),
+        0.95, 1.2, 0.9, lam)
+    np.testing.assert_allclose(vs1, np.asarray(vs2), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(pg1, np.asarray(pg2), rtol=2e-5, atol=2e-5)
