@@ -22,8 +22,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax
 import ray_tpu
 from jax import lax
+from ray_tpu.serve.deployment import deployment
 
 # RTL003: large module-level literal captured by a remote fn below.
 LOOKUP = [0] * 1_000_000
@@ -83,6 +85,74 @@ def _bad_collective(x):
     # RTL005: "dpp" is bound by no Mesh/shard_map — dies at trace time,
     # after the TPU slice was already reserved.
     return lax.psum(x, "dpp")
+
+
+# ----- RTL10x: event-loop blocking through call CHAINS (flow analysis)
+
+def _fetch_weights(ref):
+    # Innocent-looking sync helper...
+    return ray_tpu.get(ref)
+
+
+@ray_tpu.remote
+class _BadAsyncActor:
+    async def refresh(self, ref):
+        # RTL101: the blocking get hides one sync frame below the
+        # async def — the event loop stalls on work only IT can
+        # deliver (the PR 9 `_load_args_fast` IO-thread crash shape).
+        return _fetch_weights(ref)
+
+
+class _BadReplica:
+    async def __call__(self, request):
+        return request
+
+    def reconfigure(self, user_config):
+        # RTL102: a handle-routed reconfigure runs ON the replica's
+        # event loop, where this get can never resolve (the PR 9
+        # reconfigure deadlock, pre-fix form). The shipped fix returns
+        # a coroutine that offloads the fetch (serve/llm.py).
+        self.params = ray_tpu.get(user_config["weights_ref"])
+
+
+_bad_replica_app = deployment(_BadReplica)
+
+
+# ----- RTL11x: JAX host-sync / retrace hazards
+
+def _bad_spec_decode_loop(params, prompt, k, max_new):
+    _draft_k = jax.jit(lambda p, x: x)
+    _verify = jax.jit(lambda p, x: x)
+    pos = 0
+    while pos < max_new:
+        draft = _draft_k(params, prompt)
+        tgt = _verify(params, draft)
+        acc = 0
+        for i in range(k):
+            # RTL111: int() of a jitted output per compared position —
+            # the pre-PR-9 speculative accept loop paid ~142 blocking
+            # D2H syncs per generation exactly here (21.7x once the
+            # loop moved on device: models/speculative.py).
+            if int(draft[0, i]) != int(tgt[0, i]):
+                break
+            acc += 1
+        # RTL113: a FRESH jit (empty compile cache) per iteration.
+        step = jax.jit(lambda p: p)
+        # RTL114: host/device lock-step every iteration.
+        step(params).block_until_ready()
+        pos += max(1, acc)
+    return pos
+
+
+# ----- RTL12x: protocol frame contract (run with --protocol)
+#
+#   python -m ray_tpu check examples/10_anti_patterns.py --protocol
+#
+# The frame below is sent with a msg type NO dispatcher names
+# (RTL121) — the typo'd cousin of a real handler ("obj_progress").
+
+def _bad_orphan_frame(conn, oid):
+    conn.send({"t": "obj_progres", "oid": oid})  # note the typo
 
 
 def main():

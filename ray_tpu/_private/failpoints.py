@@ -246,7 +246,9 @@ def fire(site: str, key: Optional[str] = None) -> Optional[str]:
         raise FailpointError(
             f"failpoint {fp.site!r} injected failure (seed={_seed})")
     if action == "delay":
-        time.sleep(float(fp.param or 0.05))
+        # The injected stall IS the fault being simulated — exempt from
+        # flow analysis or every fire() caller chain flags.
+        time.sleep(float(fp.param or 0.05))  # raylint: disable=RTL101
         return "delay"
     if action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)

@@ -1044,7 +1044,9 @@ async def head_amain(args):
             # parent polls for existence and immediately reads the
             # (load-bearing) address.
             ready = os.path.join(args.session_dir, "gcs.ready")
-            with open(ready + ".tmp", "w") as f:
+            # Boot-time one-shot, <100 bytes, written before the GCS
+            # serves any traffic.  # raylint: disable=RTL006
+            with open(ready + ".tmp", "w") as f:  # raylint: disable=RTL006
                 f.write(address)
             os.rename(ready + ".tmp", ready)
             ready_written = True

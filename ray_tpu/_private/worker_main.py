@@ -222,8 +222,6 @@ class Executor:
             # in-progress pull (or a sealed local object) to peer
             # pullers. Synchronous — replies must stay FIFO per conn.
             self.worker.handle_obj_fetch(conn, msg)
-        elif t == "ping":
-            conn.reply(msg, {"ok": True})
 
     # ------------------------------------------------- compiled DAG stages
     # Reference: compiled actor pipelines bypassing the normal RPC path
@@ -442,7 +440,7 @@ class Executor:
         bab = bytes(ab) if ab is not None else None  # one copy, reused
         if bab is not None and bab == serialization.empty_args_bytes():
             return (), {}, False
-        if msg.get("argsref") is not None:
+        if msg.get("argsref") is not None:  # raylint: disable=RTL123 (direct-lane field)
             return None  # shm/GCS fetch: may block
         # Definition-export references (__main__ classes/functions pickle
         # as `_load_export(token)` calls) may need a BLOCKING GCS KV
@@ -455,10 +453,10 @@ class Executor:
         # executor retry would then double-debit it. Substring scan, so
         # a false positive (user bytes containing the marker) only costs
         # the pre-PR6 executor hop, never correctness.
-        if msg.get("ap") is not None:
+        if msg.get("ap") is not None:  # raylint: disable=RTL123 (direct-lane field)
             import pickle
 
-            bp = bytes(msg["ap"])
+            bp = bytes(msg["ap"])  # raylint: disable=RTL123 (direct-lane field)
             if b"_load_export" in bp:
                 return None
             args, kwargs = pickle.loads(bp,

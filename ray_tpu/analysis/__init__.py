@@ -1,17 +1,37 @@
 """Static analysis of distributed anti-patterns (``ray_tpu check``).
 
-A rule-based analyzer over Python ASTs with two delivery modes:
+A rule-based analyzer over Python ASTs. v2 added a project index + call
+graph under the per-file walk, growing it into cross-file flow
+analysis. Rule families:
+
+- **RTL00x** (``rules.py``) — per-file distributed anti-patterns
+  (get-in-loop, actor self-get, unbound collective axes, …).
+- **RTL10x** (``flow.py`` over ``project.py``/``callgraph.py``) —
+  event-loop blocking reached through sync call chains: the PR 9
+  ``reconfigure`` deadlock and ``_load_args_fast`` IO-thread shapes.
+- **RTL11x** (``rules_jax.py``) — JAX host-sync/retrace hazards: the
+  pre-PR-9 speculative accept loop's ~142 D2H syncs per generation.
+- **RTL12x** (``protocol_check.py``, ``--protocol``) — dict-frame
+  send-site ↔ handler-site contract drift across ``_private/``.
+- **RTL131** (``failpoint_check.py``, ``--failpoints``) — chaos
+  schedule sites that resolve to no registered failpoint.
+
+Delivery modes:
 
 - **Offline CLI**: ``python -m ray_tpu check <paths>`` (or ``python -m
   ray_tpu.analysis <paths>``) — human or ``--format json`` output, exit
-  code = max severity, JSON ``--baseline`` for adopted codebases.
+  code = max severity, JSON ``--baseline`` for adopted codebases;
+  ``--protocol`` / ``--failpoints`` run the project-contract passes.
 - **Decoration-time**: with ``RAY_TPU_STATIC_CHECKS=1`` each
-  ``@ray_tpu.remote`` function/actor is analyzed as it registers and
+  ``@ray_tpu.remote`` function/actor is analyzed as it registers
+  (RTL10x included — the snippet becomes a one-module project) and
   findings surface as warnings (never errors) before any TPU time is
   spent.
 
 Suppress any finding inline with ``# raylint: disable=RTL001`` (or a
-bare ``# raylint: disable`` for the whole line).
+bare ``# raylint: disable`` for the whole line). A suppression at a
+*blocking* line also removes that op from flow propagation — one
+justified comment at the op, not one per caller.
 """
 
 from .engine import (Finding, Rule, all_rules, analyze_file, analyze_paths,
@@ -19,11 +39,15 @@ from .engine import (Finding, Rule, all_rules, analyze_file, analyze_paths,
                      load_baseline, max_severity, register_rule, rule_table)
 from .decoration import (StaticCheckWarning, check_decorated,
                          static_checks_enabled, warn_on_decoration)
+from .project import ProjectIndex
+from .protocol_check import check_protocol, check_protocol_paths
+from .failpoint_check import check_failpoints, check_failpoint_paths
 
 __all__ = [
     "Finding", "Rule", "all_rules", "analyze_file", "analyze_paths",
     "analyze_source", "apply_baseline", "findings_to_json",
     "load_baseline", "max_severity", "register_rule", "rule_table",
     "StaticCheckWarning", "check_decorated", "static_checks_enabled",
-    "warn_on_decoration",
+    "warn_on_decoration", "ProjectIndex", "check_protocol",
+    "check_protocol_paths", "check_failpoints", "check_failpoint_paths",
 ]

@@ -1,0 +1,338 @@
+"""Call graph + blocking-op reachability for the RTL10x family.
+
+Both PR 9 deadlocks shared one shape: the blocking call was *not* in the
+``async def`` — it sat one or two sync frames below (``reconfigure`` →
+``_refresh_weights`` → ``ray_tpu.get``; ``_run_actor_call`` →
+``_load_args_fast`` → blocking KV fetch), exactly where the per-function
+RTL006 walk cannot see it. This module builds the statically-resolvable
+call graph over a :class:`~.project.ProjectIndex` and computes, per
+function, the set of blocking operations its sync transitive closure can
+reach, each with the shortest call chain as evidence.
+
+Resolution is conservative on dynamic dispatch: only edges the AST pins
+down are followed — ``self.m()`` / ``cls.m()`` within the class (plus
+project-visible bases), bare names through nested/module/import scope,
+and dotted names through the import map. An ``obj.method()`` on an
+unknown receiver produces NO edge (never a guess), with one deliberate
+exception: a short list of framework method names that block regardless
+of receiver (``kv_get``, ``run_async``) — the exact ops behind the
+``_load_args_fast`` IO-thread crash.
+
+Escapes that break the chain on purpose:
+
+- callables *referenced* (not called) — ``run_in_executor(None, fn)``,
+  ``Thread(target=fn)``, ``pool.submit(fn)`` — create no edge, so the
+  blessed offload idiom is clean by construction;
+- calls inside the loop-guard idiom (an ``except RuntimeError:`` handler
+  of a ``try`` that probes ``asyncio.get_running_loop()``) are exempt:
+  the guard proves no loop is running on this path (``serve/llm.py``'s
+  post-fix ``reconfigure``);
+- a blocking line carrying ``# raylint: disable=RTL10x`` drops out of
+  propagation entirely (one justified suppression at the op, not one per
+  caller).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .project import ClassDef, FuncDef, ModuleInfo, ProjectIndex
+
+# Deadlock-class ops: block on work the same event loop must deliver —
+# on the loop they can never resolve (the PR 9 bug class).
+DEADLOCK_OPS = {
+    "ray_tpu.get": "sync ray_tpu.get()",
+    "ray_tpu.wait": "sync ray_tpu.wait()",
+}
+# Stall-class ops: bounded blocking that freezes every peer coroutine,
+# heartbeat, and connection on the worker while it runs.
+STALL_OPS = {
+    "time.sleep": "time.sleep()",
+    "os.system": "os.system()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "urllib.request.urlopen": "urllib.request.urlopen()",
+    "requests.get": "requests.get()",
+    "requests.post": "requests.post()",
+    "requests.put": "requests.put()",
+    "requests.request": "requests.request()",
+    "socket.create_connection": "socket.create_connection()",
+}
+# Framework methods that block regardless of receiver type: the sync GCS
+# KV fetch and the run-a-coroutine-and-wait bridge ("run_async called
+# from the IO thread" is the runtime crash this catches at write time).
+ATTR_DEADLOCK = {
+    "kv_get": "sync GCS kv_get()",
+    "run_async": "run_async() (blocks on a future the loop must fill)",
+}
+
+_CHAIN_CAP = 8
+_OPS_PER_FN_CAP = 40
+
+# Event-loop callback registrars: their callable argument runs ON the
+# loop thread (arg index after self/receiver; call_later's is arg 1).
+_CALLBACK_REGISTRARS = {"call_soon": 0, "call_soon_threadsafe": 0,
+                        "call_later": 1, "call_at": 1}
+
+_FLOW_RULE_IDS = ("RTL101", "RTL102", "RTL103")
+
+
+class BlockOp:
+    """One blocking operation reachable from a function."""
+
+    __slots__ = ("label", "kind", "origin_path", "origin_line", "chain")
+
+    def __init__(self, label: str, kind: str, origin_path: str,
+                 origin_line: int, chain: Tuple[str, ...] = ()):
+        self.label = label
+        self.kind = kind  # "deadlock" | "stall"
+        self.origin_path = origin_path
+        self.origin_line = origin_line
+        self.chain = chain
+
+    def via(self, hop: str) -> "BlockOp":
+        return BlockOp(self.label, self.kind, self.origin_path,
+                       self.origin_line, (hop,) + self.chain)
+
+    def describe(self) -> str:
+        where = f"{self.origin_path}:{self.origin_line}"
+        if not self.chain:
+            return f"{self.label} ({where})"
+        return (f"{self.label} via {' -> '.join(self.chain)}()"
+                f" ({where})")
+
+
+def _own_scope_nodes(root):
+    """Iterate a function's OWN statements/expressions: nested function,
+    lambda, and class bodies are separate scopes (they run only when
+    invoked — if invoked by name, the call edge covers them)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _catches_runtime_error(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return "RuntimeError" in names
+
+
+def _loop_guarded_lines(funcnode) -> set:
+    """Line numbers inside ``except RuntimeError:`` handlers of a try
+    whose body probes ``asyncio.get_running_loop()`` — the no-loop-here
+    proof (the post-fix ``reconfigure`` idiom)."""
+    guarded = set()
+    for node in _own_scope_nodes(funcnode):
+        if not isinstance(node, ast.Try):
+            continue
+        probes = any(
+            isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Attribute)
+            and c.func.attr in ("get_running_loop", "get_event_loop")
+            for stmt in node.body for c in ast.walk(stmt))
+        if not probes:
+            continue
+        for h in node.handlers:
+            if _catches_runtime_error(h):
+                for stmt in h.body:
+                    for sub in ast.walk(stmt):
+                        ln = getattr(sub, "lineno", None)
+                        if ln is not None:
+                            guarded.add(ln)
+    return guarded
+
+
+class CallSite:
+    __slots__ = ("node", "line", "targets", "direct_ops")
+
+    def __init__(self, node: ast.Call):
+        self.node = node
+        self.line = node.lineno
+        self.targets: List[FuncDef] = []
+        self.direct_ops: List[BlockOp] = []
+
+
+class CallGraph:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self._sites: Dict[str, List[CallSite]] = {}
+        self._callbacks: Dict[str, List[Tuple[ast.Call, object]]] = {}
+        self._summaries: Dict[str, List[BlockOp]] = {}
+        self._in_progress: set = set()
+
+    # -------------------------------------------------------- collection
+
+    def _suppressed_op(self, mod: ModuleInfo, line: int) -> bool:
+        return any(mod.suppressed(rid, line) for rid in _FLOW_RULE_IDS)
+
+    def sites(self, fd: FuncDef) -> List[CallSite]:
+        cached = self._sites.get(fd.fid)
+        if cached is not None:
+            return cached
+        mod = fd.module
+        guarded = _loop_guarded_lines(fd.node)
+        out: List[CallSite] = []
+        callbacks: List[Tuple[ast.Call, object]] = []
+        for node in _own_scope_nodes(fd.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if node.lineno in guarded:
+                continue
+            site = CallSite(node)
+            dotted = mod.resolve(node.func)
+            label_kind = None
+            if dotted in DEADLOCK_OPS:
+                label_kind = (DEADLOCK_OPS[dotted], "deadlock")
+            elif dotted in STALL_OPS:
+                label_kind = (STALL_OPS[dotted], "stall")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ATTR_DEADLOCK):
+                label_kind = (ATTR_DEADLOCK[node.func.attr], "deadlock")
+            if label_kind is not None:
+                if not self._suppressed_op(mod, node.lineno):
+                    site.direct_ops.append(BlockOp(
+                        label_kind[0], label_kind[1], mod.path,
+                        node.lineno))
+                out.append(site)
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CALLBACK_REGISTRARS):
+                argi = _CALLBACK_REGISTRARS[node.func.attr]
+                if len(node.args) > argi:
+                    callbacks.append((node, node.args[argi]))
+            tgt = self._resolve_target(fd, node)
+            if tgt is not None:
+                site.targets.append(tgt)
+                out.append(site)
+        self._sites[fd.fid] = out
+        self._callbacks[fd.fid] = callbacks
+        return out
+
+    def callback_registrations(self, fd: FuncDef):
+        self.sites(fd)
+        return self._callbacks.get(fd.fid, [])
+
+    def _resolve_target(self, fd: FuncDef,
+                        call: ast.Call) -> Optional[FuncDef]:
+        mod = fd.module
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            # nested defs / siblings, innermost scope outward
+            parts = fd.qualname.split(".")
+            for i in range(len(parts), 0, -1):
+                cand = mod.functions.get(".".join(parts[:i] + [name]))
+                if cand is not None:
+                    return cand
+            cand = mod.functions.get(name)
+            if cand is not None:
+                return cand
+            dotted = mod.imports.get(name)
+            if dotted is not None:
+                return self.index.resolve_project_callable(
+                    mod.modname, dotted)
+            return None
+        if isinstance(func, ast.Attribute):
+            chain = []
+            expr = func
+            while isinstance(expr, ast.Attribute):
+                chain.append(expr.attr)
+                expr = expr.value
+            chain.reverse()
+            if (isinstance(expr, ast.Name) and expr.id in ("self", "cls")
+                    and len(chain) == 1 and fd.class_name):
+                cls = mod.classes.get(fd.class_name)
+                if cls is not None:
+                    return self.index.method_through_bases(
+                        mod, cls, chain[0])
+                return None
+            dotted = mod.resolve(func)
+            if dotted is not None:
+                return self.index.resolve_project_callable(
+                    mod.modname, dotted)
+        return None
+
+    # -------------------------------------------------------- summaries
+
+    def block_summary(self, fd: FuncDef) -> List[BlockOp]:
+        """Blocking ops reachable from ``fd`` through its SYNC transitive
+        closure (async callees are their own analysis entry points)."""
+        cached = self._summaries.get(fd.fid)
+        if cached is not None:
+            return cached
+        if fd.fid in self._in_progress:
+            return []  # recursion: the cycle adds nothing new
+        self._in_progress.add(fd.fid)
+        try:
+            seen: Dict[Tuple[str, str, int], BlockOp] = {}
+            for site in self.sites(fd):
+                for op in site.direct_ops:
+                    key = (op.label, op.origin_path, op.origin_line)
+                    if key not in seen:
+                        seen[key] = op
+                for tgt in site.targets:
+                    if tgt.is_async:
+                        continue
+                    for op in self.block_summary(tgt):
+                        if len(op.chain) + 1 > _CHAIN_CAP:
+                            continue
+                        key = (op.label, op.origin_path, op.origin_line)
+                        prev = seen.get(key)
+                        nxt = op.via(tgt.name)
+                        if prev is None or len(nxt.chain) < len(prev.chain):
+                            seen[key] = nxt
+                if len(seen) >= _OPS_PER_FN_CAP:
+                    break
+            out = list(seen.values())
+        finally:
+            self._in_progress.discard(fd.fid)
+        self._summaries[fd.fid] = out
+        return out
+
+    def lambda_ops(self, fd: FuncDef, lam) -> List[BlockOp]:
+        """Blocking ops of a callback expression: a Lambda body analyzed
+        in place, or a resolvable function reference's summary."""
+        mod = fd.module
+        out: List[BlockOp] = []
+        if isinstance(lam, ast.Lambda):
+            for node in ast.walk(lam.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = mod.resolve(node.func)
+                if dotted in DEADLOCK_OPS:
+                    out.append(BlockOp(DEADLOCK_OPS[dotted], "deadlock",
+                                       mod.path, node.lineno))
+                elif dotted in STALL_OPS:
+                    out.append(BlockOp(STALL_OPS[dotted], "stall",
+                                       mod.path, node.lineno))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ATTR_DEADLOCK):
+                    out.append(BlockOp(ATTR_DEADLOCK[node.func.attr],
+                                       "deadlock", mod.path, node.lineno))
+                else:
+                    tgt = self._resolve_target(fd, node)
+                    if tgt is not None and not tgt.is_async:
+                        out.extend(op.via(tgt.name)
+                                   for op in self.block_summary(tgt))
+        elif isinstance(lam, (ast.Name, ast.Attribute)):
+            fake = ast.Call(func=lam, args=[], keywords=[])
+            ast.copy_location(fake, lam)
+            tgt = self._resolve_target(fd, fake)
+            if tgt is not None and not tgt.is_async:
+                out.extend(op.via(tgt.name)
+                           for op in self.block_summary(tgt))
+        return [op for op in out
+                if not self._suppressed_op(mod, op.origin_line)
+                or op.chain]

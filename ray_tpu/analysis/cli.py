@@ -8,6 +8,16 @@ fully baselined), 1 warnings, 2 errors.
 ``--format json`` output IS the baseline file format — redirect it to a
 file (or use ``--write-baseline``) to adopt an existing codebase, then
 only *new* violations fail.
+
+Project-contract modes (run INSTEAD of the per-file+flow rules, over
+the same positional paths):
+
+- ``--protocol``: the RTL12x dict-frame send↔handler contract pass
+  (``protocol_check.py``) — ``python -m ray_tpu check ray_tpu
+  --protocol`` is the committed-tree gate.
+- ``--failpoints``: the RTL131 chaos-schedule site cross-check
+  (``failpoint_check.py``); schedule files default to
+  ``benchmarks,tests`` via ``--schedules``.
 """
 
 from __future__ import annotations
@@ -42,6 +52,25 @@ def add_arguments(parser: argparse.ArgumentParser):
                         help="comma-separated rule IDs to skip")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--protocol", action="store_true",
+                        help="run the RTL12x frame-contract pass "
+                        "instead of the per-file rules: send-site vs "
+                        "handler-site message-type graph over the "
+                        "given paths (orphan sends, dead handlers, "
+                        "unsourced field reads, release= discipline)")
+    parser.add_argument("--failpoints", action="store_true",
+                        help="run the RTL131 failpoint-site cross-"
+                        "check instead of the per-file rules: every "
+                        "site= in chaos schedules (--schedules) must "
+                        "resolve to a failpoints.fire()/_fp() site "
+                        "registered in the given paths")
+    parser.add_argument("--schedules", default="benchmarks,tests",
+                        metavar="PATHS", help="comma-separated paths "
+                        "holding chaos schedules for --failpoints "
+                        "(default: benchmarks,tests; "
+                        "tests/test_failpoints.py is always excluded — "
+                        "its synthetic site names test the registry "
+                        "itself)")
     return parser
 
 
@@ -63,9 +92,26 @@ def run_check(args) -> int:
         return 0
 
     skipped: List[str] = []
-    findings = analyze_paths(
-        args.paths, rules=_selected_rules(args),
-        on_error=lambda p, e: skipped.append(f"{p}: {e}"))
+    on_error = lambda p, e: skipped.append(f"{p}: {e}")  # noqa: E731
+    if args.protocol or args.failpoints:
+        # project-scope passes replace the per-file rules: they answer a
+        # different question (cross-file contracts) over the same paths.
+        findings = []
+        if args.protocol:
+            from .protocol_check import check_protocol_paths
+
+            findings.extend(check_protocol_paths(args.paths,
+                                                 on_error=on_error))
+        if args.failpoints:
+            from .failpoint_check import check_failpoint_paths
+
+            sched = [s for s in args.schedules.split(",") if s]
+            findings.extend(check_failpoint_paths(
+                args.paths, sched, on_error=on_error))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    else:
+        findings = analyze_paths(args.paths, rules=_selected_rules(args),
+                                 on_error=on_error)
 
     baseline_path = args.baseline
     if args.write_baseline:

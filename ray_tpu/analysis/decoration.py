@@ -12,6 +12,12 @@ just the decorated snippet — so it is seeded from the target's live
 ``__globals__``: the actual module objects and ray_tpu callables the
 function will call at runtime, which is *more* precise than re-parsing
 imports.
+
+v2: the RTL10x flow family runs here too — the snippet becomes a
+one-module project (same ``__globals__`` seed for its import map), so
+an ``async def`` actor method whose blocking call hides one sync frame
+below (the ``_load_args_fast`` shape) warns the moment the class
+registers.
 """
 
 from __future__ import annotations

@@ -73,7 +73,15 @@ def register_rule(cls):
 
 
 def all_rules() -> List["Rule"]:
-    from . import rules as _rules  # noqa: F401  (populates the registry)
+    # imports populate the registry: per-file rules (rules, rules_jax),
+    # plus metadata carriers for the flow (RTL10x) and project-scope
+    # protocol/failpoint (RTL12x/RTL13x) passes so --select/--disable
+    # and the rule table cover every family.
+    from . import rules as _rules  # noqa: F401
+    from . import rules_jax as _rules_jax  # noqa: F401
+    from . import flow as _flow  # noqa: F401
+    from . import protocol_check as _pc  # noqa: F401
+    from . import failpoint_check as _fc  # noqa: F401
 
     return [cls() for cls in _RULE_CLASSES]
 
@@ -141,7 +149,8 @@ def _norm(dotted: str) -> str:
 
 class _FuncInfo:
     __slots__ = ("node", "is_async", "is_remote_task", "in_actor",
-                 "local_names", "handle_locals", "aliases")
+                 "local_names", "handle_locals", "aliases", "lock_locals",
+                 "future_locals")
 
     def __init__(self, node, is_async, is_remote_task, in_actor,
                  local_names):
@@ -154,16 +163,24 @@ class _FuncInfo:
         self.handle_locals: Set[str] = set()
         # function-scoped rename aliases, overlaying the module map
         self.aliases: Dict[str, str] = {}
+        # locals bound to threading.Lock()/Semaphore()/… (RTL006 acquire)
+        self.lock_locals: Set[str] = set()
+        # locals bound to pool.submit()/run_coroutine_threadsafe()/…
+        # (RTL006's scoped Future.result() check)
+        self.future_locals: Set[str] = set()
 
 
 class _ClassInfo:
-    __slots__ = ("node", "is_remote_actor", "self_handle_attrs")
+    __slots__ = ("node", "is_remote_actor", "self_handle_attrs",
+                 "lock_attrs")
 
     def __init__(self, node, is_remote_actor):
         self.node = node
         self.is_remote_actor = is_remote_actor
         # ``self.<attr>`` assigned from the actor's own handle
         self.self_handle_attrs: Set[str] = set()
+        # ``self.<attr>`` assigned from threading.Lock()/… (RTL006)
+        self.lock_attrs: Set[str] = set()
 
 
 class Context:
@@ -190,6 +207,14 @@ class Context:
         self.bound_axes: Set[str] = set()
         self.large_globals: Dict[str, str] = {}  # name -> description
         self.map_fn_names: Set[str] = set()
+        # jit-compiled callables (RTL11x): names assigned from
+        # jax.jit/pmap(...), ``self.<attr>`` jit assignments, and
+        # functions traced by decorator or by-reference wrap — the
+        # latter mapped to (static_argnums, static_argnames).
+        self.jit_names: Set[str] = set()
+        self.jit_attr_names: Set[str] = set()
+        self.jit_traced: Dict[str, Tuple[Tuple[int, ...],
+                                         Tuple[str, ...]]] = {}
 
     # -- resolution --------------------------------------------------------
 
@@ -303,6 +328,83 @@ def _literal_size(node) -> Optional[int]:
     return None
 
 
+# jit/pmap wrappers whose results are device-committed callables: calls
+# to them produce values whose host coercion is a D2H sync (RTL111) and
+# whose traced bodies can't take Python control flow on args (RTL112).
+_JIT_WRAPPERS = {"jax.jit", "jax.pmap"}
+
+
+def _static_argspec(keywords) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for k in keywords:
+        if k.arg == "static_argnums":
+            v = k.value
+            elts = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                    else [v])
+            nums = tuple(e.value for e in elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, int))
+        elif k.arg == "static_argnames":
+            v = k.value
+            elts = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                    else [v])
+            names = tuple(e.value for e in elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str))
+    return nums, names
+
+
+def _jit_call_info(node, ctx: "Context"):
+    """``jax.jit(f, ...)`` / ``partial(jax.jit, ...)`` call detection:
+    returns (wrapped_fn_name_or_None, static_argnums, static_argnames),
+    or None when ``node`` is not a jit-wrapper call."""
+    if not isinstance(node, ast.Call):
+        return None
+    target = ctx.resolve(node.func)
+    if target in _JIT_WRAPPERS:
+        fn = (node.args[0].id if node.args
+              and isinstance(node.args[0], ast.Name) else None)
+        nums, names = _static_argspec(node.keywords)
+        return fn, nums, names
+    if target == "functools.partial" and node.args:
+        inner = ctx.resolve(node.args[0])
+        if inner in _JIT_WRAPPERS:
+            nums, names = _static_argspec(node.keywords)
+            return None, nums, names
+    return None
+
+
+def _prescan_jit(tree: ast.Module, ctx: Context):
+    """Second prescan pass (aliases are complete): collect the module's
+    jit-compiled callables for the RTL11x rules."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            info = _jit_call_info(node.value, ctx)
+            if info is None:
+                continue
+            wrapped, nums, names = info
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    ctx.jit_names.add(t.id)
+                elif (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    ctx.jit_attr_names.add(t.attr)
+            if wrapped is not None:
+                ctx.jit_traced[wrapped] = (nums, names)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if ctx.resolve(dec) in _JIT_WRAPPERS:
+                    ctx.jit_names.add(node.name)
+                    ctx.jit_traced[node.name] = ((), ())
+                elif isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec, ctx)
+                    if info is not None:
+                        ctx.jit_names.add(node.name)
+                        ctx.jit_traced[node.name] = info[1:]
+
+
 def _prescan_module(tree: ast.Module, ctx: Context):
     """One cheap pass for module-wide facts rules need ahead of time:
     import aliases, axis-name bindings, large module-level literals, and
@@ -409,6 +511,17 @@ def _is_current_actor_expr(node, ctx: Context) -> bool:
             and ctx.resolve(node.value.func) == "ray_tpu.get_runtime_context")
 
 
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Semaphore",
+               "threading.BoundedSemaphore", "threading.Condition"}
+
+
+def _is_lock_ctor(node, ctx: Context) -> bool:
+    """``threading.Lock()`` & friends — whose ``.acquire()`` blocks the
+    calling thread (asyncio locks are awaited, not matched here)."""
+    return (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) in _LOCK_CTORS)
+
+
 class _Walker(ast.NodeVisitor):
     def __init__(self, ctx: Context, rules: List[Rule]):
         self.ctx = ctx
@@ -463,17 +576,24 @@ class _Walker(ast.NodeVisitor):
             ctx.assume_remote_toplevel and not ctx.class_stack
             and not ctx.func_stack)
         info = _ClassInfo(node, is_actor)
-        if is_actor:
-            # pre-collect self.<attr> = <own handle> so a method defined
-            # before __init__ still resolves the attribute (RTL004).
-            for n in ast.walk(node):
-                if isinstance(n, ast.Assign) and _is_current_actor_expr(
-                        n.value, ctx):
-                    for t in n.targets:
-                        if (isinstance(t, ast.Attribute)
-                                and isinstance(t.value, ast.Name)
-                                and t.value.id == "self"):
-                            info.self_handle_attrs.add(t.attr)
+        # pre-collect self.<attr> = <own handle> / <lock ctor> so a
+        # method defined before __init__ still resolves the attribute
+        # (RTL004 handles; RTL006 lock acquires).
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Assign):
+                continue
+            if is_actor and _is_current_actor_expr(n.value, ctx):
+                for t in n.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        info.self_handle_attrs.add(t.attr)
+            if _is_lock_ctor(n.value, ctx):
+                for t in n.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        info.lock_attrs.add(t.attr)
         ctx.class_stack.append(info)
         # methods of an actor class must not see the enclosing module's
         # function stack tricks; plain traversal is fine here.
@@ -551,6 +671,16 @@ class _Walker(ast.NodeVisitor):
             # handle-local for RTL004: me = <runtime ctx>.current_actor
             if f is not None and _is_current_actor_expr(node.value, ctx):
                 f.handle_locals.add(single.id)
+            # lock-local for RTL006: l = threading.Lock()
+            if f is not None and _is_lock_ctor(node.value, ctx):
+                f.lock_locals.add(single.id)
+            # future-local for RTL006: fut = pool.submit(fn)
+            if (f is not None and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in (
+                        "submit", "run_coroutine_threadsafe",
+                        "run_async")):
+                f.future_locals.add(single.id)
             # loop-local ref names for RTL002
             if ctx.loop_remote_names and _is_remote_call(node.value):
                 ctx.loop_remote_names[-1].add(single.id)
@@ -592,11 +722,15 @@ def analyze_source(source: str, path: str = "<string>",
                    rules: Optional[List[Rule]] = None,
                    seed_aliases: Optional[Dict[str, str]] = None,
                    line_offset: int = 0,
-                   assume_remote_toplevel: bool = False) -> List[Finding]:
+                   assume_remote_toplevel: bool = False,
+                   flow: bool = True) -> List[Finding]:
     """Analyze one file's source; returns findings (suppressions applied).
 
     ``line_offset`` shifts reported line numbers (decoration mode analyzes
-    a function snippet but reports file line numbers).
+    a function snippet but reports file line numbers). ``flow`` runs the
+    cross-function RTL10x pass over this file as a one-module project
+    (``analyze_paths`` passes False and runs one project-wide pass
+    instead, so cross-FILE chains resolve).
     """
     tree = ast.parse(source)
     if line_offset:
@@ -604,11 +738,38 @@ def analyze_source(source: str, path: str = "<string>",
     ctx = Context(path, source.splitlines(), seed_aliases, line_offset,
                   assume_remote_toplevel)
     _prescan_module(tree, ctx)
+    _prescan_jit(tree, ctx)
     walker = _Walker(ctx, rules if rules is not None else all_rules())
     walker.visit(tree)
     out = [f for f in walker.findings if not _suppressed(f, ctx)]
+    if flow:
+        out.extend(_flow_pass({path: source}, rules,
+                              line_offset=line_offset,
+                              seed_imports=seed_aliases))
     out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return out
+
+
+def _flow_pass(sources: Dict[str, str], rules: Optional[List[Rule]],
+               line_offset: int = 0,
+               seed_imports: Optional[Dict[str, str]] = None
+               ) -> List[Finding]:
+    """Run the RTL10x call-graph pass over ``{path: source}``.
+
+    ``seed_imports``: decoration mode analyzes a bare snippet whose
+    imports live in the target's ``__globals__`` — seed them under the
+    module's own (empty) import map so ``ray_tpu.get`` still resolves.
+    """
+    from .flow import analyze_flow
+    from .project import ProjectIndex
+
+    idx = ProjectIndex()
+    for path, src in sources.items():
+        mod = idx.add_source(path, src, line_offset=line_offset)
+        if mod is not None and seed_imports:
+            mod.imports = {**seed_imports, **mod.imports}
+    rule_ids = None if rules is None else [r.id for r in rules]
+    return analyze_flow(idx, rule_ids)
 
 
 def analyze_file(path: str, rules: Optional[List[Rule]] = None,
@@ -647,12 +808,21 @@ def analyze_paths(paths: Sequence[str],
                   on_error=None) -> List[Finding]:
     rules = rules if rules is not None else all_rules()
     findings: List[Finding] = []
+    sources: Dict[str, str] = {}
     for path in iter_python_files(paths):
         try:
-            findings.extend(analyze_file(path, rules, display_path(path)))
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                source = f.read()
+            dp = display_path(path)
+            # per-file walker rules here; ONE project-wide flow pass
+            # below over every parsed file, so call chains crossing
+            # file boundaries resolve (the point of the RTL10x family).
+            findings.extend(analyze_source(source, dp, rules, flow=False))
+            sources[dp] = source
         except (SyntaxError, ValueError, OSError) as e:
             if on_error is not None:
                 on_error(path, e)
+    findings.extend(_flow_pass(sources, rules))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

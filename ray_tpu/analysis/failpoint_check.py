@@ -1,0 +1,179 @@
+"""RTL131: failpoint-site cross-check (``ray_tpu check --failpoints``).
+
+A chaos schedule references injection sites by name
+(``conn.send.actor_call=hit3:raise``); the registry is whatever
+``failpoints.fire("<site>", key)`` / GCS ``self._fp("<site>", key)``
+calls exist in the code. Nothing validates the two against each other at
+runtime — ``fire`` just misses the table — so a typo'd site **silently
+never fires** and the chaos test asserts recovery from a fault that was
+never injected (a green run proving nothing). This pass:
+
+1. builds the registered-site set from the scanned package: first
+   positional string literal of every ``failpoints.fire(...)`` /
+   ``*._fp(...)`` call, noting whether the call passes a key (a keyed
+   site accepts any ``site.<key>`` qualification, including dynamic
+   f-string keys like ``r{rank}``);
+2. parses every schedule string found in the given schedule paths —
+   string literals whose ``;``-separated segments all look like
+   ``site=trigger:action`` with a valid trigger (``once``/``hitK``/
+   ``everyK``/``pX``) — from specs, ``RAY_TPU_FAILPOINTS`` env dict
+   values, and ``set_failpoints(...)`` calls alike;
+3. reports (error severity, the run is lying otherwise):
+   - a site that resolves to no registered site (exact match, or
+     ``registered.<suffix>`` where ``registered`` is keyed),
+   - a segment with a valid trigger but an unknown action (the runtime
+     parser logs-and-drops the WHOLE spec on these).
+
+``tests/test_failpoints.py`` uses deliberately synthetic site names to
+unit-test the registry itself — exclude it (the CLI default does).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .engine import Finding, Rule, register_rule
+from .project import ModuleInfo, ProjectIndex
+
+_TRIGGER_RE = re.compile(r"^(once|hit\d+|every\d+|p\d+(?:\.\d+)?)$")
+_ACTIONS = {"raise", "delay", "kill", "drop", "short", "disconnect",
+            "crash"}
+_SITE_RE = re.compile(r"^[A-Za-z_][\w.\[\]{}-]*$")
+_SEG_RE = re.compile(r"^([^=;\s]+)=([^:;\s]+):([^:;]+)(?::[^;]*)?$")
+
+
+@register_rule
+class UnknownFailpointSite(Rule):
+    id = "RTL131"
+    severity = "error"
+    name = "unknown-failpoint-site"
+    hint = ("the schedule targets a site no failpoints.fire()/_fp() "
+            "call registers — the fault silently never fires and the "
+            "chaos run proves nothing; fix the name (see "
+            "`grep -rn 'failpoints.fire' ray_tpu/`)")
+
+
+def _registered_sites(index: ProjectIndex) -> Dict[str, bool]:
+    """{site: accepts_key} from fire()/_fp() call literals."""
+    sites: Dict[str, bool] = {}
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name not in ("fire", "_fp"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            site = node.args[0].value
+            keyed = (len(node.args) > 1
+                     or any(k.arg == "key" for k in node.keywords))
+            sites[site] = sites.get(site, False) or keyed
+    return sites
+
+
+def _spec_segments(value: str) -> List[Tuple[str, str, str]]:
+    """Parse ``site=trigger:action[...]`` segments; [] when the string
+    is not a failpoint spec (any segment with an invalid trigger
+    disqualifies the whole string — ordinary ``k=v`` text)."""
+    segs = [s.strip() for s in value.split(";") if s.strip()]
+    out = []
+    for seg in segs:
+        m = _SEG_RE.match(seg)
+        if m is None:
+            return []
+        site, trigger, action = m.group(1), m.group(2), m.group(3)
+        if not _TRIGGER_RE.match(trigger) or not _SITE_RE.match(site):
+            return []
+        out.append((site, trigger, action))
+    return out
+
+
+def _site_resolves(site: str, registered: Dict[str, bool]) -> bool:
+    if site in registered:
+        return True
+    # qualified form: registered keyed site + ".<key>"
+    head = site
+    while "." in head:
+        head = head.rsplit(".", 1)[0]
+        if head in registered:
+            return registered[head]
+    return False
+
+
+def check_failpoints(registry_index: ProjectIndex,
+                     schedule_index: ProjectIndex) -> List[Finding]:
+    registered = _registered_sites(registry_index)
+    findings: List[Finding] = []
+    # An EMPTY scope must fail loudly — exiting 0 because the paths
+    # resolved to nothing is precisely the "green run proving nothing"
+    # failure mode this rule exists to close.
+    if not schedule_index.modules:
+        return [Finding(
+            rule="RTL131", severity="error", path="<schedules>", line=0,
+            col=0,
+            message="no schedule files found — --schedules paths "
+                    "resolve to no Python files, so NO failpoint "
+                    "schedule was validated",
+            hint=UnknownFailpointSite.hint)]
+    if not registered:
+        return [Finding(
+            rule="RTL131", severity="error", path="<registry>", line=0,
+            col=0,
+            message="no failpoints.fire()/_fp() sites found in the "
+                    "scanned paths — point the positional paths at the "
+                    "package that registers the injection sites",
+            hint=UnknownFailpointSite.hint)]
+    for mod in schedule_index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and "=" in node.value and ":" in node.value):
+                continue
+            for site, trigger, action in _spec_segments(node.value):
+                if action not in _ACTIONS:
+                    findings.append(Finding(
+                        rule="RTL131", severity="error", path=mod.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"failpoint schedule segment "
+                                f"{site}={trigger}:{action} has unknown "
+                                f"action {action!r} — the runtime "
+                                f"parser drops the ENTIRE spec on it",
+                        hint=UnknownFailpointSite.hint))
+                elif not _site_resolves(site, registered):
+                    findings.append(Finding(
+                        rule="RTL131", severity="error", path=mod.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"failpoint schedule targets site "
+                                f"{site!r} which no failpoints.fire()/"
+                                f"_fp() call registers — it will "
+                                f"silently never fire",
+                        hint=UnknownFailpointSite.hint))
+    # inline allowlist via the standard suppression comment
+    out = []
+    for f in findings:
+        mod = schedule_index.by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def check_failpoint_paths(registry_paths: Sequence[str],
+                          schedule_paths: Sequence[str],
+                          exclude_basenames: Sequence[str] = (
+                              "test_failpoints.py",),
+                          on_error=None) -> List[Finding]:
+    reg = ProjectIndex.build(registry_paths, on_error=on_error)
+    sched = ProjectIndex.build(schedule_paths, on_error=on_error)
+    for path in [p for p in sched.by_path
+                 if p.rsplit("/", 1)[-1] in set(exclude_basenames)]:
+        mod = sched.by_path.pop(path)
+        sched.modules.pop(mod.modname, None)
+    return check_failpoints(reg, sched)

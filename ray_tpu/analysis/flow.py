@@ -1,0 +1,181 @@
+"""RTL10x: event-loop blocking found through the call graph.
+
+The cross-file/flow-aware rule family (engine walks one file; these walk
+the :class:`~.callgraph.CallGraph`). Three rules, all grounded in bugs
+this repo actually shipped and later fixed by hand:
+
+- **RTL101** — a blocking op reachable from an ``async def`` through a
+  statically-resolved sync call chain (the ``_load_args_fast`` IO-thread
+  crash: ``_run_actor_call`` → ``_load_args_fast`` → blocking KV fetch).
+  Depth ≥ 1, or depth 0 for the framework ops RTL006 cannot name
+  (``kv_get``/``run_async`` on any receiver).
+- **RTL102** — a *sync* entry method of an event-loop-hosted class (one
+  with ``async def`` methods: async actors, serve deployments) reaching
+  a deadlock-class op (``ray_tpu.get``/``wait``, ``kv_get``,
+  ``run_async``). Handle-routed calls execute such methods ON the
+  replica's loop, where a blocking get waits on the very loop that must
+  deliver the object (the PR 9 ``reconfigure`` deadlock). The loop-guard
+  idiom (``except RuntimeError`` around ``asyncio.get_running_loop()``)
+  exempts its handler block.
+- **RTL103** — a callable handed to ``call_soon`` /
+  ``call_soon_threadsafe`` / ``call_later`` that blocks: loop callbacks
+  run inline on the loop thread, there is no executor underneath them.
+
+Entry methods for RTL102 are the remotely-routable surface: public names
+plus ``__call__``; underscore helpers are only flagged through the chain
+from an entry (a private helper that is *only* invoked via
+``run_in_executor`` references is clean by construction — references
+create no call edge).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .callgraph import ATTR_DEADLOCK, CallGraph
+from .engine import Finding, Rule, register_rule
+from .project import ProjectIndex
+
+_ATTR_LABELS = frozenset(ATTR_DEADLOCK.values())
+_PER_RULE_FN_CAP = 6  # findings per (function, rule): evidence, not spam
+
+
+@register_rule
+class BlockingReachableFromAsync(Rule):
+    """Metadata carrier for RTL101 (fired by the flow pass, not the
+    per-file walker — hooks intentionally inert)."""
+
+    id = "RTL101"
+    severity = "error"
+    name = "event-loop-blocking-call-chain"
+    hint = ("offload the sync helper with await loop.run_in_executor "
+            "(or make the chain async and await the ref); suppress at "
+            "the blocking line to exempt it from all flow findings")
+
+
+@register_rule
+class BlockingInLoopHostedMethod(Rule):
+    """Metadata carrier for RTL102 (flow pass)."""
+
+    id = "RTL102"
+    severity = "warning"
+    name = "blocking-in-loop-hosted-method"
+    hint = ("handle-routed calls run sync methods of an async actor / "
+            "deployment ON its event loop: return a coroutine that "
+            "offloads the fetch (serve/llm.py reconfigure), or guard "
+            "with try: asyncio.get_running_loop() / except RuntimeError")
+
+
+@register_rule
+class BlockingInLoopCallback(Rule):
+    """Metadata carrier for RTL103 (flow pass)."""
+
+    id = "RTL103"
+    severity = "error"
+    name = "blocking-in-loop-callback"
+    hint = ("loop callbacks run inline on the loop thread — schedule a "
+            "task that awaits, or run_in_executor the blocking part")
+
+
+def _is_entry_method(name: str) -> bool:
+    return name == "__call__" or not name.startswith("_")
+
+
+def analyze_flow(index: ProjectIndex,
+                 rule_ids=None) -> List[Finding]:
+    """Run the RTL10x family over a project index. ``rule_ids`` filters
+    (None = all three)."""
+    want = set(rule_ids) if rule_ids is not None else {
+        "RTL101", "RTL102", "RTL103"}
+    if not want & {"RTL101", "RTL102", "RTL103"}:
+        return []
+    g = CallGraph(index)
+    findings: List[Finding] = []
+
+    for mod in index.modules.values():
+        for fd in mod.functions.values():
+            counts = {"RTL101": 0, "RTL102": 0, "RTL103": 0}
+
+            def emit(rule_id, severity, line, message, hint):
+                if rule_id not in want:
+                    return
+                if counts[rule_id] >= _PER_RULE_FN_CAP:
+                    return
+                if mod.suppressed(rule_id, line):
+                    return
+                counts[rule_id] += 1
+                findings.append(Finding(
+                    rule=rule_id, severity=severity, path=mod.path,
+                    line=line, col=0, message=message, hint=hint))
+
+            cls = (mod.classes.get(fd.class_name)
+                   if fd.class_name else None)
+            # Only serve-deployment classes route sync methods onto the
+            # replica loop (plain actors run them in the executor pool —
+            # worker_main._run_actor_call's sync branch).
+            loop_hosted = (cls is not None and cls.has_async
+                           and cls.is_deployment)
+
+            if fd.is_async:
+                for site in g.sites(fd):
+                    # depth 0: only the framework ops RTL006 can't name
+                    for op in site.direct_ops:
+                        if op.label in _ATTR_LABELS:
+                            emit("RTL101", "error", op.origin_line,
+                                 f"{op.label} inside `async def "
+                                 f"{fd.name}` blocks the event loop on "
+                                 f"work the loop itself must deliver",
+                                 BlockingReachableFromAsync.hint)
+                    for tgt in site.targets:
+                        if tgt.is_async:
+                            continue
+                        for op in g.block_summary(tgt):
+                            chained = op.via(tgt.name)
+                            emit("RTL101", "error", site.line,
+                                 f"blocking {chained.describe()} "
+                                 f"reachable from `async def {fd.name}` "
+                                 f"— the whole event loop stalls (and a "
+                                 f"get/wait can never resolve) while it "
+                                 f"runs",
+                                 BlockingReachableFromAsync.hint)
+                            break  # one op per call site is evidence
+            elif loop_hosted and _is_entry_method(fd.name):
+                for site in g.sites(fd):
+                    for op in site.direct_ops:
+                        if op.kind != "deadlock":
+                            continue
+                        emit("RTL102", "warning", op.origin_line,
+                             f"sync method {fd.name!r} of event-loop-"
+                             f"hosted class {cls.name!r} calls "
+                             f"{op.label} — a handle-routed call runs "
+                             f"it ON the replica's loop, where the get "
+                             f"waits on the loop that must deliver it "
+                             f"(the PR 9 reconfigure deadlock shape)",
+                             BlockingInLoopHostedMethod.hint)
+                    for tgt in site.targets:
+                        if tgt.is_async:
+                            continue
+                        for op in g.block_summary(tgt):
+                            if op.kind != "deadlock":
+                                continue
+                            chained = op.via(tgt.name)
+                            emit("RTL102", "warning", site.line,
+                                 f"sync method {fd.name!r} of event-"
+                                 f"loop-hosted class {cls.name!r} "
+                                 f"reaches {chained.describe()} — "
+                                 f"deadlock when routed onto the "
+                                 f"replica's event loop",
+                                 BlockingInLoopHostedMethod.hint)
+                            break
+
+            for call, target_expr in g.callback_registrations(fd):
+                for op in g.lambda_ops(fd, target_expr):
+                    emit("RTL103", "error", call.lineno,
+                         f"event-loop callback registered here blocks "
+                         f"in {op.describe()} — callbacks run inline "
+                         f"on the loop thread",
+                         BlockingInLoopCallback.hint)
+                    break
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,355 @@
+"""Project index: the cross-file substrate for flow-aware rules.
+
+``engine.py`` analyzes one file at a time — enough for the RTL00x
+pattern rules, but the recurring bug classes PRs 4/7/9 fixed by hand
+(blocking calls reaching an actor's event loop through a sync helper,
+protocol frame types drifting between sender and handler files) only
+exist *between* files. This module parses every file of a scan once and
+exposes what the cross-file passes need:
+
+- module table keyed by dotted module name (derived from the
+  repo-relative path, so ``ray_tpu/_private/worker.py`` resolves as
+  ``ray_tpu._private.worker`` for import-edge resolution),
+- per-module import maps with relative-import (``from .engine import``)
+  resolution,
+- every function/method (qualified, async flag, enclosing class) and
+  every class (base names, has-async-methods — the event-loop-hosted
+  marker the RTL10x family keys on),
+- shared dotted-name resolution (aliases + ``_norm``'s ray→ray_tpu
+  canonicalization), mirroring ``Context.resolve`` at module scope.
+
+The index is deliberately syntactic: no imports are executed, unparsable
+files are skipped (reported via ``errors``), and resolution is
+conservative — a name the index can't pin to a project definition simply
+produces no edge, never a guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .engine import _SUPPRESS_RE, _norm, display_path, iter_python_files
+
+
+class FuncDef:
+    """One function/method definition in the project."""
+
+    __slots__ = ("fid", "module", "qualname", "name", "node", "is_async",
+                 "class_name", "lineno")
+
+    def __init__(self, module: "ModuleInfo", qualname: str, node,
+                 class_name: Optional[str]):
+        self.module = module
+        self.qualname = qualname
+        self.name = node.name
+        self.node = node
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.class_name = class_name
+        self.lineno = node.lineno
+        self.fid = f"{module.modname}:{qualname}"
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<FuncDef {self.fid}>"
+
+
+class ClassDef:
+    __slots__ = ("name", "node", "module", "methods", "bases",
+                 "has_async", "is_deployment")
+
+    def __init__(self, module: "ModuleInfo", node: ast.ClassDef):
+        self.name = node.name
+        self.node = node
+        self.module = module
+        self.methods: Dict[str, FuncDef] = {}
+        # base-class NAMES (best effort: Name / dotted tail) for method
+        # resolution through simple inheritance inside the project.
+        self.bases: List[str] = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                self.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                self.bases.append(b.attr)
+        self.has_async = False
+        # serve-deployment marker (RTL102): plain actors run sync
+        # methods in the executor pool; only deployment-hosted classes
+        # have them routed onto the replica's event loop.
+        self.is_deployment = False
+
+
+class ModuleInfo:
+    """One parsed file."""
+
+    def __init__(self, path: str, modname: str, tree: ast.Module,
+                 lines: Sequence[str], is_package: bool,
+                 line_offset: int = 0):
+        self.path = path
+        self.modname = modname
+        self.tree = tree
+        self.lines = lines
+        self.is_package = is_package
+        self.line_offset = line_offset
+        # local name -> absolute dotted name ("rt" -> "ray_tpu",
+        # "Backoff" -> "ray_tpu._private.backoff.Backoff")
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, FuncDef] = {}
+        self.classes: Dict[str, ClassDef] = {}
+        self._collect()
+
+    # ------------------------------------------------------------ building
+
+    def _abs_from(self, node: ast.ImportFrom) -> Optional[str]:
+        """Absolute module a ``from X import ...`` refers to."""
+        if not node.level:
+            return node.module
+        parts = self.modname.split(".")
+        # level 1 from a plain module = its package; from a package
+        # (__init__) = the package itself.
+        chop = node.level if not self.is_package else node.level - 1
+        if chop:
+            parts = parts[:-chop]
+        if not parts:
+            return node.module
+        base = ".".join(parts)
+        return f"{base}.{node.module}" if node.module else base
+
+    def _collect(self):
+        # One walk covers module-level AND function-local imports (the
+        # lazy-import idiom all over _private/): function-local names
+        # matter for resolution inside that function, and a module-wide
+        # union is a fine conservative stand-in — the names are
+        # overwhelmingly unique per module.
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_imports(node)
+        self._collect_defs(self.tree, prefix="", class_name=None)
+        self._mark_deployments()
+
+    def _collect_imports(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    self.imports[a.asname] = _norm(a.name)
+                else:
+                    root = a.name.split(".")[0]
+                    self.imports.setdefault(root, _norm(root))
+        elif isinstance(node, ast.ImportFrom):
+            mod = self._abs_from(node)
+            if not mod:
+                return
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                self.imports[a.asname or a.name] = _norm(f"{mod}.{a.name}")
+
+    def _collect_defs(self, node, prefix: str, class_name: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                fd = FuncDef(self, qual, child, class_name)
+                self.functions[qual] = fd
+                cls = self.classes.get(class_name) if class_name else None
+                if cls is not None and prefix == f"{class_name}.":
+                    cls.methods[child.name] = fd
+                    if fd.is_async:
+                        cls.has_async = True
+                # nested defs: resolvable by bare name from the enclosing
+                # scope; qualified with the outer name for uniqueness.
+                self._collect_defs(child, prefix=f"{qual}.",
+                                   class_name=class_name)
+            elif isinstance(child, ast.ClassDef):
+                cd = ClassDef(self, child)
+                self.classes[child.name] = cd
+                self._collect_defs(child, prefix=f"{child.name}.",
+                                   class_name=child.name)
+
+    def _mark_deployments(self):
+        """Flag serve-deployment classes: decorated ``@serve.deployment``
+        (bare or called) or passed to a ``deployment(...)`` wrapper call
+        in this module (``_deployment(LLMServer, ...)`` in serve/llm.py).
+        Worker_main runs plain actors' sync methods in the executor
+        pool; only deployment-hosted classes get them routed onto the
+        replica's event loop — the RTL102 precondition."""
+
+        def is_deployment_fn(expr) -> bool:
+            tail = None
+            if isinstance(expr, ast.Attribute):
+                tail = expr.attr
+            elif isinstance(expr, ast.Name):
+                tail = expr.id
+            if tail is None:
+                return False
+            if tail in ("deployment", "_deployment"):
+                return True
+            dotted = self.resolve(expr)
+            return bool(dotted) and dotted.split(".")[-1] == "deployment"
+
+        for cls in self.classes.values():
+            for dec in cls.node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if is_deployment_fn(target):
+                    cls.is_deployment = True
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in self.classes
+                    and is_deployment_fn(node.func)):
+                self.classes[node.args[0].id].is_deployment = True
+
+    # ---------------------------------------------------------- resolution
+
+    def resolve(self, expr) -> Optional[str]:
+        """Dotted resolution of a Name/Attribute chain through the module
+        import map (the project-scope twin of ``Context.resolve``)."""
+        parts: List[str] = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        base = self.imports.get(expr.id)
+        if base is None:
+            return None
+        parts.append(base)
+        return _norm(".".join(reversed(parts)))
+
+    def source_line(self, lineno: int) -> str:
+        idx = lineno - 1 - self.line_offset
+        if 0 <= idx < len(self.lines):
+            return self.lines[idx]
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        m = _SUPPRESS_RE.search(self.source_line(lineno))
+        if not m:
+            return False
+        ids = m.group("ids")
+        if ids is None:
+            return True
+        return rule in {s.strip() for s in ids.split(",")}
+
+
+def _modname_for(path: str) -> Tuple[str, bool]:
+    """Dotted module name from a repo-relative path."""
+    p = path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    is_package = p.endswith("/__init__")
+    if is_package:
+        p = p[: -len("/__init__")]
+    return p.strip("/").replace("/", "."), is_package
+
+
+class ProjectIndex:
+    """All parsed modules of one scan + cross-module lookup."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self.errors: List[Tuple[str, Exception]] = []
+
+    @classmethod
+    def build(cls, paths: Sequence[str],
+              on_error=None) -> "ProjectIndex":
+        idx = cls()
+        for path in iter_python_files(paths):
+            try:
+                with open(path, "r", encoding="utf-8",
+                          errors="replace") as f:
+                    idx.add_source(display_path(path), f.read())
+            except (SyntaxError, ValueError, OSError) as e:
+                idx.errors.append((path, e))
+                if on_error is not None:
+                    on_error(path, e)
+        return idx
+
+    def add_source(self, path: str, source: str, line_offset: int = 0):
+        try:
+            tree = ast.parse(source)
+        except (SyntaxError, ValueError) as e:
+            self.errors.append((path, e))
+            return None
+        if line_offset:
+            ast.increment_lineno(tree, line_offset)
+        modname, is_package = _modname_for(path)
+        mod = ModuleInfo(path, modname, tree, source.splitlines(),
+                         is_package, line_offset)
+        self.modules[modname] = mod
+        self.by_path[path] = mod
+        return mod
+
+    # ---------------------------------------------------------- lookups
+
+    def func(self, fid: str) -> Optional[FuncDef]:
+        modname, _, qual = fid.partition(":")
+        mod = self.modules.get(modname)
+        return mod.functions.get(qual) if mod else None
+
+    def find_module(self, dotted_mod: str) -> Optional[ModuleInfo]:
+        """Exact modname lookup, falling back to a UNIQUE dotted-suffix
+        match (a scan rooted outside the cwd keys modules by absolute
+        dotted path while its imports use the short name — ambiguity
+        resolves to nothing, never a guess)."""
+        mod = self.modules.get(dotted_mod)
+        if mod is not None or not dotted_mod:
+            return mod
+        suffix = "." + dotted_mod
+        cands = [m for name, m in self.modules.items()
+                 if name.endswith(suffix)]
+        return cands[0] if len(cands) == 1 else None
+
+    def resolve_project_callable(self, modname: str,
+                                 dotted: str) -> Optional[FuncDef]:
+        """Map an absolute dotted name to a project function: tries
+        ``pkg.mod.fn``, ``pkg.mod.Class.__init__`` (constructor calls),
+        and package-``__init__`` re-export fallbacks."""
+        if dotted is None:
+            return None
+        head, _, tail = dotted.rpartition(".")
+        mod = self.find_module(head)
+        if mod is not None:
+            fn = mod.functions.get(tail)
+            if fn is not None:
+                return fn
+            cls = mod.classes.get(tail)
+            if cls is not None:
+                return cls.methods.get("__init__")
+        # two-level tail: pkg.mod.Class.method
+        head2, _, cls_name = head.rpartition(".")
+        mod2 = self.find_module(head2)
+        if mod2 is not None:
+            cls = mod2.classes.get(cls_name)
+            if cls is not None:
+                return cls.methods.get(tail)
+        return None
+
+    def class_of(self, module: ModuleInfo,
+                 name: str) -> Optional[ClassDef]:
+        cd = module.classes.get(name)
+        if cd is not None:
+            return cd
+        dotted = module.imports.get(name)
+        if dotted:
+            head, _, tail = dotted.rpartition(".")
+            mod = self.find_module(head)
+            if mod is not None:
+                return mod.classes.get(tail)
+        return None
+
+    def method_through_bases(self, module: ModuleInfo, cls: ClassDef,
+                             name: str, _depth: int = 0
+                             ) -> Optional[FuncDef]:
+        """Resolve a method on a class or (by name) its project-visible
+        bases — single inheritance chains only, depth-capped."""
+        fd = cls.methods.get(name)
+        if fd is not None or _depth >= 4:
+            return fd
+        for base in cls.bases:
+            bcd = self.class_of(cls.module, base)
+            if bcd is not None:
+                fd = self.method_through_bases(module, bcd, name,
+                                               _depth + 1)
+                if fd is not None:
+                    return fd
+        return None

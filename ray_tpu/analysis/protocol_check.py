@@ -1,0 +1,560 @@
+"""RTL12x: the protocol frame contract checker (``ray_tpu check --protocol``).
+
+The control plane speaks hand-rolled dict frames: ``{"t": <msg type>,
+...}`` packed by ``_private/protocol.py`` and dispatched by string
+comparison (worker/agent/proxy ``t == "..."`` chains) or by reflection
+(GCS ``_h_<type>`` methods). Nothing but convention keeps a send site
+and its handler in sync — which is how PR 4's early-unpin release-marker
+race and PR 7's dropped-frame strands crept in. This pass rebuilds the
+send-site ↔ handler-site graph from the string literals and reports the
+drift:
+
+- **RTL121** (error) — a message type is sent somewhere but no handler
+  anywhere names it: the frame is silently dropped by every dispatcher's
+  unknown-type guard.
+- **RTL122** (warning) — a handler names a type no send site produces:
+  dead code, or the sender was renamed/removed without it.
+- **RTL123** (warning) — a handler reads a field no send site of that
+  type writes: the read sees ``None``/KeyError at runtime, exactly the
+  dropped-strand class. Types with any non-literal construction
+  (forwarded frames, ``**`` splats, dynamic keys) are *field-opaque* and
+  exempt — conservative, never a guess.
+- **RTL124** (error) — a ``release=`` unpin marker passed to anything
+  other than ``Connection.send``/``reply`` (the two paths that flush
+  coalesced frame bytes BEFORE running the marker — PR 4's
+  flush-before-release discipline), or a marker both passed as
+  ``release=`` and invoked directly in the same module scope (double
+  release = serve-buffer recycle race).
+
+Send sites are any dict literal carrying ``"t": <str>`` (frames are
+built inline or staged in a local and mutated — both tracked) plus
+``var["t"] = "<lit>"`` retype assignments (forwarding shims), which mark
+the type field-opaque. Handler field reads follow the ``msg`` dict one
+call hop at a time through statically-resolvable helpers.
+
+Intentional asymmetries are allowlisted inline at the reported line:
+``# raylint: disable=RTL122  <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph
+from .engine import Finding, Rule, register_rule
+from .project import FuncDef, ModuleInfo, ProjectIndex
+
+# Frame fields owned by the transport/correlation layer, not the
+# per-type payload contract.
+_TRANSPORT_FIELDS = {"t", "i", "r", "sc", "_bufs"}
+
+# The flush-before-release-safe send paths (protocol.Connection).
+_RELEASE_SAFE_CALLEES = {"send", "reply"}
+
+_HELPER_DEPTH = 3
+
+
+@register_rule
+class OrphanSentMessage(Rule):
+    id = "RTL121"
+    severity = "error"
+    name = "orphan-sent-message"
+    hint = ("add the handler (GCS: an _h_<type> method; peers: a "
+            "t == \"<type>\" branch) or delete the dead send; allowlist "
+            "a deliberate one-way frame with # raylint: disable=RTL121")
+
+
+@register_rule
+class DeadHandler(Rule):
+    id = "RTL122"
+    severity = "warning"
+    name = "dead-handler"
+    hint = ("no send site produces this type — remove the handler or "
+            "restore the sender; allowlist intentional asymmetry with "
+            "# raylint: disable=RTL122")
+
+
+@register_rule
+class UnsourcedFieldRead(Rule):
+    id = "RTL123"
+    severity = "warning"
+    name = "unsourced-handler-field-read"
+    hint = ("no send site of this message type writes the field — fix "
+            "the key (sender or handler) or write it at the send site")
+
+
+@register_rule
+class ReleaseSkipsFlush(Rule):
+    id = "RTL124"
+    severity = "error"
+    name = "release-skips-flush"
+    hint = ("pass release= only to Connection.send/reply (they flush "
+            "coalesced bytes before running the marker); never invoke "
+            "a marker you also handed to the transport")
+
+
+class SendSite:
+    __slots__ = ("msg_type", "fields", "opaque", "path", "line")
+
+    def __init__(self, msg_type: str, fields: Set[str], opaque: bool,
+                 path: str, line: int):
+        self.msg_type = msg_type
+        self.fields = fields
+        self.opaque = opaque
+        self.path = path
+        self.line = line
+
+
+class HandlerSite:
+    __slots__ = ("msg_type", "path", "line",
+                 "reads")  # reads: (field, path, line)
+
+    def __init__(self, msg_type: str, path: str, line: int):
+        self.msg_type = msg_type
+        self.path = path
+        self.line = line
+        self.reads: List[Tuple[str, str, int]] = []
+
+
+def _dict_t_literal(node: ast.Dict) -> Optional[str]:
+    for k, v in zip(node.keys, node.values):
+        if (isinstance(k, ast.Constant) and k.value == "t"
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            return v.value
+    return None
+
+
+def _dict_fields(node: ast.Dict) -> Tuple[Set[str], bool]:
+    """Literal keys + opacity (``**`` splat / computed key present)."""
+    fields: Set[str] = set()
+    opaque = False
+    for k in node.keys:
+        if k is None:  # ** splat
+            opaque = True
+        elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+            fields.add(k.value)
+        else:
+            opaque = True
+    return fields, opaque
+
+
+def _own_scope_walk(root):
+    """Walk a scope in SOURCE ORDER (pre-order) without descending into
+    nested function/class bodies (they are separate scopes, yielded by
+    _function_scopes). Source order matters: staged-frame tracking must
+    see ``msg = {...}`` before the ``msg["k"] = v`` writes below it."""
+    for child in ast.iter_child_nodes(root):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        yield child
+        yield from _own_scope_walk(child)
+
+
+def _function_scopes(mod: ModuleInfo):
+    """Module top level + every function, each scope yielded once."""
+    yield mod.tree
+    for fd in mod.functions.values():
+        yield fd.node
+
+
+def _collect_sends(mod: ModuleInfo) -> List[SendSite]:
+    out: List[SendSite] = []
+    for fn_node in _function_scopes(mod):
+        staged: Dict[str, SendSite] = {}
+        consumed: Set[int] = set()  # dicts owned by a staged assign
+        for node in _own_scope_walk(fn_node):
+            if isinstance(node, ast.Dict):
+                if id(node) in consumed:
+                    continue
+                t = _dict_t_literal(node)
+                if t is None:
+                    continue
+                fields, opaque = _dict_fields(node)
+                out.append(SendSite(t, fields - _TRANSPORT_FIELDS,
+                                    opaque, mod.path, node.lineno))
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and isinstance(node.value, ast.Dict):
+                # spawn_msg: Dict[str, Any] = {"t": ...}: staged frame
+                t = _dict_t_literal(node.value)
+                if t is not None:
+                    consumed.add(id(node.value))
+                    fields, opaque = _dict_fields(node.value)
+                    staged[node.target.id] = SendSite(
+                        t, fields - _TRANSPORT_FIELDS, opaque,
+                        mod.path, node.lineno)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                # msg = {... "t": "x" ...}: staged frame, later
+                # ``msg["k"] = v`` writes extend its field set.
+                if (isinstance(tgt, ast.Name)
+                        and isinstance(node.value, ast.Dict)):
+                    t = _dict_t_literal(node.value)
+                    if t is not None:
+                        consumed.add(id(node.value))
+                        fields, opaque = _dict_fields(node.value)
+                        site = SendSite(t, fields - _TRANSPORT_FIELDS,
+                                        opaque, mod.path, node.lineno)
+                        prev = staged.get(tgt.id)
+                        if prev is not None:
+                            out.append(prev)  # re-staged name: flush
+                        staged[tgt.id] = site
+                elif (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)):
+                    key = tgt.slice.value
+                    name = tgt.value.id
+                    if key == "t":
+                        # retype of a forwarded frame: fields unknown
+                        if (isinstance(node.value, ast.Constant)
+                                and isinstance(node.value.value, str)):
+                            out.append(SendSite(
+                                node.value.value, set(), True,
+                                mod.path, node.lineno))
+                    elif name in staged:
+                        staged[name].fields.add(key)
+                elif (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in staged):
+                    # dynamic key on a staged frame: fields unknowable
+                    staged[tgt.value.id].opaque = True
+        out.extend(staged.values())
+    return out
+
+
+class _HandlerScan:
+    """Extract handler sites + their msg-field reads for one module."""
+
+    def __init__(self, index: ProjectIndex, graph: CallGraph):
+        self.index = index
+        self.graph = graph
+
+    def scan(self, mod: ModuleInfo) -> List[HandlerSite]:
+        out: List[HandlerSite] = []
+        for fd in mod.functions.values():
+            name = fd.name
+            if name.startswith("_h_") and len(name) > 3:
+                site = HandlerSite(name[3:], mod.path, fd.lineno)
+                param = self._msg_param(fd.node)
+                if param:
+                    self._collect_reads(fd, param, site.reads, 0, set())
+                out.append(site)
+            out.extend(self._dispatch_branches(fd))
+        return out
+
+    @staticmethod
+    def _msg_param(node) -> Optional[str]:
+        args = [a.arg for a in node.args.posonlyargs + node.args.args]
+        if "msg" in args:
+            return "msg"
+        return args[-1] if args else None
+
+    # ---------------------------------------------------- field reads
+
+    def _collect_reads(self, fd: FuncDef, param: str,
+                       reads: List[Tuple[str, str, int]], depth: int,
+                       seen: Set[str], scope=None):
+        if fd.fid in seen or depth > _HELPER_DEPTH:
+            return
+        seen = seen | {fd.fid}
+        body = scope if scope is not None else fd.node.body
+        for stmt in body:
+            for node in ast.walk(stmt):
+                field = self._read_of(node, param)
+                if field is not None:
+                    reads.append((field, fd.module.path, node.lineno))
+                if isinstance(node, ast.Call):
+                    self._follow_helper(fd, node, param, reads, depth,
+                                        seen)
+
+    @staticmethod
+    def _read_of(node, param: str) -> Optional[str]:
+        # param["f"] loads
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == param
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+                and node.slice.value not in _TRANSPORT_FIELDS):
+            return node.slice.value
+        # param.get("f"[, default])
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == param
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value not in _TRANSPORT_FIELDS):
+            return node.args[0].value
+        return None
+
+    def _follow_helper(self, fd: FuncDef, call: ast.Call, param: str,
+                       reads, depth: int, seen: Set[str]):
+        """One resolvable call hop: the msg dict passed onward."""
+        argpos = None
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id == param:
+                argpos = i
+                break
+        if argpos is None:
+            return
+        tgt = self.graph._resolve_target(fd, call)
+        if tgt is None:
+            return
+        params = [a.arg for a in (tgt.node.args.posonlyargs
+                                  + tgt.node.args.args)]
+        if params and params[0] in ("self", "cls") \
+                and tgt.class_name is not None:
+            params = params[1:]
+        if argpos >= len(params):
+            return
+        self._collect_reads(tgt, params[argpos], reads, depth + 1, seen)
+
+    # ----------------------------------------------- dispatch branches
+
+    def _dispatch_branches(self, fd: FuncDef) -> List[HandlerSite]:
+        """``t = msg.get("t")`` + ``t == "lit"`` / ``t in (...)``
+        comparison dispatchers (worker, worker_main, node agent, serve
+        proxy, broadcast's guard form)."""
+        out: List[HandlerSite] = []
+        tvars: Dict[str, str] = {}  # tvar -> msg receiver name
+        for node in _own_scope_walk(fd.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                recv = self._t_receiver(node.value)
+                if recv is not None:
+                    tvars[node.targets[0].id] = recv
+        self._walk_dispatch(fd, fd.node.body, tvars, out)
+        return out
+
+    @staticmethod
+    def _t_receiver(expr) -> Optional[str]:
+        """``msg.get("t")`` / ``msg["t"]`` -> "msg"."""
+        if (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "get"
+                and isinstance(expr.func.value, ast.Name)
+                and expr.args
+                and isinstance(expr.args[0], ast.Constant)
+                and expr.args[0].value == "t"):
+            return expr.func.value.id
+        if (isinstance(expr, ast.Subscript)
+                and isinstance(expr.value, ast.Name)
+                and isinstance(expr.slice, ast.Constant)
+                and expr.slice.value == "t"):
+            return expr.value.id
+        return None
+
+    def _compare_types(self, node, tvars):
+        """(types, msg receiver, negated) for a Compare on the type
+        var (or inline ``msg.get("t") == ...``); (None, None, False)
+        otherwise."""
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            return None, None, False
+        left, op, right = node.left, node.ops[0], node.comparators[0]
+        if isinstance(left, ast.Name) and tvars and left.id in tvars:
+            recv = tvars[left.id]
+        else:
+            recv = self._t_receiver(left)
+            if recv is None:
+                return None, None, False
+        types: List[str] = []
+        if isinstance(op, (ast.Eq, ast.NotEq)):
+            if isinstance(right, ast.Constant) \
+                    and isinstance(right.value, str):
+                types = [right.value]
+        elif isinstance(op, (ast.In, ast.NotIn)):
+            if isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+                types = [e.value for e in right.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+        if not types:
+            return None, None, False
+        return types, recv, isinstance(op, (ast.NotEq, ast.NotIn))
+
+    def _test_compares(self, test, tvars):
+        """Yield every type-compare inside a (possibly boolean) test."""
+        nodes = [test]
+        while nodes:
+            n = nodes.pop()
+            if isinstance(n, ast.BoolOp):
+                nodes.extend(n.values)
+                continue
+            types, recv, negated = self._compare_types(n, tvars)
+            if types:
+                yield types, recv, negated
+
+    def _walk_dispatch(self, fd: FuncDef, body, tvars,
+                       out: List[HandlerSite]):
+        for stmt in body:
+            if isinstance(stmt, ast.If):
+                for types, recv, negated in self._test_compares(
+                        stmt.test, tvars):
+                    if not negated:
+                        for t in types:
+                            site = HandlerSite(t, fd.module.path,
+                                               stmt.lineno)
+                            if recv:
+                                self._collect_reads(fd, recv,
+                                                    site.reads, 0,
+                                                    set(),
+                                                    scope=stmt.body)
+                            out.append(site)
+                    else:
+                        # guard form (``if msg.get("t") != "obj_fetch":
+                        # continue``): the rest of the function handles
+                        # the type — attribute its reads coarsely.
+                        for t in types:
+                            site = HandlerSite(t, fd.module.path,
+                                               stmt.lineno)
+                            if recv:
+                                self._collect_reads(fd, recv,
+                                                    site.reads, 0,
+                                                    set())
+                            out.append(site)
+                self._walk_dispatch(fd, stmt.body, tvars, out)
+                self._walk_dispatch(fd, stmt.orelse, tvars, out)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._walk_dispatch(fd, stmt.body + stmt.orelse, tvars,
+                                    out)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk_dispatch(fd, stmt.body, tvars, out)
+            elif isinstance(stmt, ast.Try):
+                self._walk_dispatch(fd, stmt.body, tvars, out)
+                for h in stmt.handlers:
+                    self._walk_dispatch(fd, h.body, tvars, out)
+                self._walk_dispatch(fd, stmt.orelse, tvars, out)
+                self._walk_dispatch(fd, stmt.finalbody, tvars, out)
+
+
+def _release_findings(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for fn_node in _function_scopes(mod):
+        released_names: Set[str] = set()
+        calls = [n for n in _own_scope_walk(fn_node)
+                 if isinstance(n, ast.Call)]
+        for call in calls:
+            for kw in call.keywords:
+                if kw.arg != "release":
+                    continue
+                callee = call.func
+                cname = (callee.attr if isinstance(callee, ast.Attribute)
+                         else callee.id if isinstance(callee, ast.Name)
+                         else "")
+                if cname not in _RELEASE_SAFE_CALLEES:
+                    out.append(Finding(
+                        rule="RTL124", severity="error", path=mod.path,
+                        line=call.lineno, col=call.col_offset,
+                        message=f"release= marker passed to "
+                                f"{cname or 'a call'}() which does not "
+                                f"guarantee the PR 4 flush-before-"
+                                f"release discipline — coalesced frame "
+                                f"bytes may still reference the buffer "
+                                f"when the unpin runs",
+                        hint=ReleaseSkipsFlush.hint))
+                if isinstance(kw.value, ast.Name):
+                    released_names.add(kw.value.id)
+        for call in calls:
+            if (released_names and isinstance(call.func, ast.Name)
+                    and call.func.id in released_names):
+                out.append(Finding(
+                    rule="RTL124", severity="error", path=mod.path,
+                    line=call.lineno, col=call.col_offset,
+                    message=f"release marker {call.func.id!r} invoked "
+                            f"directly AND passed as release= in the "
+                            f"same scope — double release recycles the "
+                            f"serve buffer while frames still alias it",
+                    hint=ReleaseSkipsFlush.hint))
+    return out
+
+
+def check_protocol(index: ProjectIndex) -> List[Finding]:
+    """The full RTL12x pass over a project index."""
+    graph = CallGraph(index)
+    hscan = _HandlerScan(index, graph)
+    sends: List[SendSite] = []
+    handlers: List[HandlerSite] = []
+    findings: List[Finding] = []
+    for mod in index.modules.values():
+        sends.extend(_collect_sends(mod))
+        handlers.extend(hscan.scan(mod))
+        findings.extend(_release_findings(mod))
+
+    sent_types: Dict[str, List[SendSite]] = {}
+    for s in sends:
+        sent_types.setdefault(s.msg_type, []).append(s)
+    handled_types: Dict[str, List[HandlerSite]] = {}
+    for h in handlers:
+        handled_types.setdefault(h.msg_type, []).append(h)
+
+    for t, sites in sorted(sent_types.items()):
+        if t in handled_types:
+            continue
+        first = min(sites, key=lambda s: (s.path, s.line))
+        findings.append(Finding(
+            rule="RTL121", severity="error", path=first.path,
+            line=first.line, col=0,
+            message=f"message type {t!r} is sent here but NO handler "
+                    f"anywhere names it — every dispatcher drops it as "
+                    f"unknown ({len(sites)} send site(s))",
+            hint=OrphanSentMessage.hint))
+
+    for t, sites in sorted(handled_types.items()):
+        if t in sent_types:
+            continue
+        first = min(sites, key=lambda s: (s.path, s.line))
+        findings.append(Finding(
+            rule="RTL122", severity="warning", path=first.path,
+            line=first.line, col=0,
+            message=f"handler for message type {t!r} but no send site "
+                    f"produces it",
+            hint=DeadHandler.hint))
+
+    for t, hsites in sorted(handled_types.items()):
+        ssites = sent_types.get(t)
+        if not ssites:
+            continue
+        if any(s.opaque for s in ssites):
+            continue  # field-opaque type: forwarding/dynamic senders
+        written: Set[str] = set()
+        for s in ssites:
+            written |= s.fields
+        reported: Set[Tuple[str, str, int]] = set()
+        for h in hsites:
+            for field, path, line in h.reads:
+                if field in written:
+                    continue
+                key = (field, path, line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    rule="RTL123", severity="warning", path=path,
+                    line=line, col=0,
+                    message=f"handler of {t!r} reads field {field!r} "
+                            f"which no send site of this type writes "
+                            f"(senders write: "
+                            f"{sorted(written) or 'nothing'})",
+                    hint=UnsourcedFieldRead.hint))
+
+    # inline allowlist: drop suppressed findings via each module's lines
+    out = []
+    for f in findings:
+        mod = index.by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def check_protocol_paths(paths: Sequence[str],
+                         on_error=None) -> List[Finding]:
+    return check_protocol(ProjectIndex.build(paths, on_error=on_error))

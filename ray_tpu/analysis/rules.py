@@ -15,6 +15,10 @@ import ast
 from .engine import (CANONICAL_AXES, Context, Rule, _is_remote_call,
                      _is_current_actor_expr, register_rule)
 
+# Calls that hand back a concurrent future whose .result() blocks the
+# calling thread (RTL006's scoped Future.result() check).
+_FUTURE_MAKERS = {"submit", "run_coroutine_threadsafe", "run_async"}
+
 
 def _contains_direct_remote_call(node) -> bool:
     """A ``.remote()`` call in this expression that is NOT nested under a
@@ -284,6 +288,7 @@ class BlockingInAsync(Rule):
     _BLOCKING = {
         "time.sleep": "time.sleep()",
         "ray_tpu.get": "sync ray_tpu.get()",
+        "ray_tpu.wait": "sync ray_tpu.wait()",
         "subprocess.run": "subprocess.run()",
         "subprocess.call": "subprocess.call()",
         "subprocess.check_call": "subprocess.check_call()",
@@ -295,11 +300,53 @@ class BlockingInAsync(Rule):
         "socket.create_connection": "socket.create_connection()",
     }
 
+    def _blocking_label(self, node, ctx: Context):
+        what = self._BLOCKING.get(ctx.resolve(node.func) or "")
+        if what is not None:
+            return what
+        f = ctx.current_function
+        fn = node.func
+        # file I/O: bare builtin open() (a shadowed local is exempt)
+        if (isinstance(fn, ast.Name) and fn.id == "open"
+                and ctx.resolve(fn) is None
+                and (f is None or "open" not in f.local_names)):
+            return "file I/O open()"
+        if isinstance(fn, ast.Attribute):
+            # concurrent future: .result() blocks the loop on a value
+            # only an executor thread will produce. Scoped to receivers
+            # the rule can PROVE are concurrent futures (chained off
+            # pool.submit()/run_coroutine_threadsafe()/run_async(), or a
+            # local assigned from one) — a bare `t.result()` on an
+            # already-done asyncio task is the standard non-blocking
+            # read and must stay clean.
+            if fn.attr == "result":
+                recv = fn.value
+                if (isinstance(recv, ast.Call)
+                        and isinstance(recv.func, ast.Attribute)
+                        and recv.func.attr in _FUTURE_MAKERS):
+                    return "Future.result()"
+                if (isinstance(recv, ast.Name) and f is not None
+                        and recv.id in f.future_locals):
+                    return "Future.result()"
+            # lock.acquire() on a threading lock bound in this scope
+            if fn.attr == "acquire":
+                recv = fn.value
+                if (isinstance(recv, ast.Name) and f is not None
+                        and recv.id in f.lock_locals):
+                    return "threading Lock.acquire()"
+                cls = ctx.current_class
+                if (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self" and cls is not None
+                        and recv.attr in cls.lock_attrs):
+                    return "threading Lock.acquire()"
+        return None
+
     def on_call(self, node, ctx: Context):
         f = ctx.current_function
         if f is None or not f.is_async:
             return ()
-        what = self._BLOCKING.get(ctx.resolve(node.func) or "")
+        what = self._blocking_label(node, ctx)
         if what is None:
             return ()
         return (self.finding(

@@ -1,0 +1,324 @@
+"""RTL11x: JAX host-sync and retrace hazards.
+
+The bug class behind PR 9's 21.7× speculative-decoding speedup: the
+pre-fix accept loop coerced device values with ``int()`` per compared
+position — ~142 blocking device-to-host syncs per generation — until the
+whole loop moved on device. These rules catch that shape (and its
+retrace cousins) at write time, the "find the sync before the profiler
+does" discipline of the pjit/concurrency TPU papers.
+
+Detection is dataflow-lite, per function: values assigned from calls to
+*jit-compiled callables* (module names bound via ``jax.jit``/``pmap``,
+``@jax.jit``-style decorated functions, ``self.<attr>`` jit bindings —
+collected by the engine prescan) are device values; anything derived
+from them (subscripts, arithmetic, tuple unpacking) stays device. Host
+coercion of a device value **inside a loop** is the hazard — a single
+coercion after the loop is the normal one-fetch-per-generation pattern
+and stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set, Tuple
+
+from .engine import Context, Rule, _JIT_WRAPPERS, register_rule
+
+# Host-coercion spellings: builtins, numpy materialization, explicit
+# device fetch, and the method forms.
+_COERCE_BUILTINS = {"int", "float", "bool"}
+_COERCE_DOTTED = {"numpy.asarray", "numpy.array", "jax.device_get"}
+_COERCE_METHODS = {"item", "tolist"}
+
+# Attribute accesses on a traced value that yield CONCRETE Python values
+# at trace time — control flow on these is fine (RTL112).
+_CONCRETE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_CONCRETE_FNS = {"len", "isinstance", "getattr", "hasattr", "type"}
+
+
+def _device_producing(call: ast.Call, ctx: Context,
+                      local_jit: Set[str]) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return (f.id in ctx.jit_names or f.id in ctx.jit_traced
+                or f.id in local_jit)
+    if isinstance(f, ast.Attribute):
+        if (isinstance(f.value, ast.Name) and f.value.id == "self"
+                and f.attr in ctx.jit_attr_names):
+            return True
+    return False
+
+
+def _names_in(expr) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _assign_targets(node) -> Tuple[ast.AST, list]:
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        t = node.targets[0]
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        t = node.target
+    else:
+        return None, []
+    if isinstance(t, ast.Name):
+        return node.value, [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        return node.value, [e.id for e in t.elts
+                            if isinstance(e, ast.Name)]
+    return node.value, []
+
+
+@register_rule
+class HostSyncInLoop(Rule):
+    """``int()``/``.item()``/``np.asarray()`` of a jit output in a loop.
+
+    Every coercion is a blocking D2H transfer that serializes host
+    against device per iteration (the pre-PR-9 compare-and-break loop
+    did it per *token*). Keep the loop on device (``lax.while_loop`` /
+    ``scan``) and fetch ONE packed buffer at the end.
+    """
+
+    id = "RTL111"
+    severity = "warning"
+    name = "jit-host-sync-in-loop"
+    hint = ("move the loop on device (lax.while_loop/scan) and fetch "
+            "one packed result per generation, or hoist the coercion "
+            "out of the loop (models/speculative.py is the worked "
+            "example)")
+
+    def on_function(self, node, ctx: Context):
+        # analyze this function's own scope; nested defs get their own
+        # on_function entry (guard: fire only for the entered node).
+        f = ctx.current_function
+        if f is None or f.node is not node:
+            return ()
+        out = []
+        device: Set[str] = set()
+        local_jit: Set[str] = set()
+
+        def is_device_expr(expr) -> bool:
+            if isinstance(expr, ast.Call):
+                return _device_producing(expr, ctx, local_jit)
+            return bool(_names_in(expr) & device)
+
+        def coercion(call: ast.Call):
+            """Return the coerced sub-expression when this call is a
+            host coercion, else None."""
+            fn = call.func
+            if (isinstance(fn, ast.Name) and fn.id in _COERCE_BUILTINS
+                    and call.args):
+                return call.args[0]
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in _COERCE_METHODS and not call.args:
+                    return fn.value
+                if ctx.resolve(fn) in _COERCE_DOTTED and call.args:
+                    return call.args[0]
+            elif (isinstance(fn, ast.Name)
+                    and ctx.resolve(fn) in _COERCE_DOTTED and call.args):
+                return call.args[0]
+            return None
+
+        def scan_expr(expr, depth):
+            """Coercion scan of one expression tree; comprehensions
+            bump the loop depth for their element/condition parts."""
+            stack = [(expr, depth)]
+            while stack:
+                n, d = stack.pop()
+                if isinstance(n, (ast.ListComp, ast.SetComp,
+                                  ast.DictComp, ast.GeneratorExp)):
+                    d += 1
+                elif isinstance(n, (ast.Lambda, ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(n, ast.Call) and d > 0:
+                    target = coercion(n)
+                    if target is not None and is_device_expr(target):
+                        out.append(self.finding(
+                            n, ctx,
+                            "host coercion of a jit-compiled call's "
+                            "output inside a loop — each one is a "
+                            "blocking device-to-host sync per "
+                            "iteration (the pre-PR-9 speculative "
+                            "accept loop paid ~142 of these per "
+                            "generation)"))
+                for c in ast.iter_child_nodes(n):
+                    stack.append((c, d))
+
+        def walk(stmts, depth):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                value, targets = _assign_targets(st)
+                if targets and value is not None:
+                    from .engine import _jit_call_info
+
+                    if _jit_call_info(value, ctx) is not None:
+                        local_jit.update(targets)
+                    elif (isinstance(value, ast.Call)
+                            and coercion(value) is not None):
+                        # ``toks = np.asarray(toks)`` materializes to
+                        # host ONCE — downstream int(toks[i]) reads are
+                        # free numpy indexing, not per-read D2H syncs.
+                        device.difference_update(targets)
+                    elif is_device_expr(value):
+                        device.update(targets)
+                if isinstance(st, (ast.For, ast.AsyncFor)):
+                    scan_expr(st.iter, depth)  # evaluates once
+                    walk(st.body + st.orelse, depth + 1)
+                elif isinstance(st, ast.While):
+                    scan_expr(st.test, depth + 1)  # re-evaluates per tick
+                    walk(st.body + st.orelse, depth + 1)
+                elif isinstance(st, (ast.If,)):
+                    scan_expr(st.test, depth)
+                    walk(st.body, depth)
+                    walk(st.orelse, depth)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        scan_expr(item.context_expr, depth)
+                    walk(st.body, depth)
+                elif isinstance(st, ast.Try):
+                    walk(st.body, depth)
+                    for h in st.handlers:
+                        walk(h.body, depth)
+                    walk(st.orelse, depth)
+                    walk(st.finalbody, depth)
+                else:
+                    scan_expr(st, depth)
+
+        walk(node.body, 0)
+        seen = set()
+        deduped = []
+        for fnd in out:
+            key = (fnd.line, fnd.col)
+            if key not in seen:
+                seen.add(key)
+                deduped.append(fnd)
+        return deduped
+
+
+@register_rule
+class TracedControlFlow(Rule):
+    """Python ``if``/``while`` on a traced argument inside a jitted fn.
+
+    Dies at trace time (``TracerBoolConversionError``) — after the mesh
+    is built and the TPU slice reserved, like RTL005. Shape/dtype/ndim
+    reads are concrete and exempt; ``static_argnums``/``argnames`` are
+    honored.
+    """
+
+    id = "RTL112"
+    severity = "error"
+    name = "traced-control-flow"
+    hint = ("branch with lax.cond / lax.while_loop / jnp.where, or mark "
+            "the argument static (static_argnums/static_argnames)")
+
+    def on_function(self, node, ctx: Context):
+        f = ctx.current_function
+        if f is None or f.node is not node:
+            return ()
+        statics = ctx.jit_traced.get(node.name)
+        has_dec = any(
+            ctx.resolve(d) in _JIT_WRAPPERS or (
+                isinstance(d, ast.Call) and ctx.resolve(d.func)
+                in _JIT_WRAPPERS)
+            for d in node.decorator_list)
+        if statics is None and not has_dec:
+            return ()
+        nums, names = statics if statics is not None else ((), ())
+        args = node.args
+        all_args = args.posonlyargs + args.args
+        traced = set()
+        offset = 1 if (all_args and all_args[0].arg in ("self", "cls")) \
+            else 0
+        for i, a in enumerate(all_args[offset:]):
+            if i in nums or a.arg in names:
+                continue
+            traced.add(a.arg)
+        for a in args.kwonlyargs:
+            if a.arg not in names:
+                traced.add(a.arg)
+        if not traced:
+            return ()
+
+        def uses_traced(n) -> bool:
+            if isinstance(n, ast.Attribute) and n.attr in _CONCRETE_ATTRS:
+                return False
+            if isinstance(n, ast.Call):
+                fn = n.func
+                if isinstance(fn, ast.Name) and fn.id in _CONCRETE_FNS:
+                    return False
+            if isinstance(n, ast.Name) and n.id in traced:
+                return True
+            return any(uses_traced(c) for c in ast.iter_child_nodes(n))
+
+        out = []
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node:
+                continue
+            if isinstance(sub, (ast.If, ast.While)) \
+                    and uses_traced(sub.test):
+                out.append(self.finding(
+                    sub, ctx,
+                    f"Python control flow on traced argument(s) of "
+                    f"jitted {node.name!r} — raises at trace time, "
+                    f"after the TPU slice is reserved"))
+        return out
+
+
+@register_rule
+class JitInLoop(Rule):
+    """``jax.jit(...)`` constructed inside a loop body.
+
+    Each call builds a fresh compiled-function object with an EMPTY
+    cache: every iteration retraces and recompiles (seconds per step on
+    real models) instead of hitting the cache of one hoisted wrapper.
+    """
+
+    id = "RTL113"
+    severity = "warning"
+    name = "jit-in-loop"
+    hint = ("hoist the jax.jit(...) wrapper out of the loop (module "
+            "scope or __init__) so every iteration reuses one "
+            "compilation cache")
+
+    def on_call(self, node, ctx: Context):
+        if ctx.loop_depth == 0:
+            return ()
+        if ctx.resolve(node.func) not in _JIT_WRAPPERS:
+            return ()
+        return (self.finding(
+            node, ctx,
+            "jax.jit constructed inside a loop — a fresh (empty) "
+            "compilation cache per iteration means retrace + recompile "
+            "every time"),)
+
+
+@register_rule
+class BlockUntilReadyInLoop(Rule):
+    """``.block_until_ready()`` inside a per-step loop.
+
+    It exists for benchmarking; in a training/decode loop it forfeits
+    JAX's async dispatch — host and device run lock-step, one
+    round-trip of latency per iteration.
+    """
+
+    id = "RTL114"
+    severity = "warning"
+    name = "block-until-ready-in-loop"
+    hint = ("drop it (dispatch is async; the next op queues behind the "
+            "result anyway) or sync once after the loop; keep it only "
+            "around timed benchmark sections  # raylint: disable=RTL114")
+
+    def on_call(self, node, ctx: Context):
+        if ctx.loop_depth == 0:
+            return ()
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"):
+            return ()
+        return (self.finding(
+            node, ctx,
+            ".block_until_ready() inside a loop serializes host "
+            "against device every iteration — async dispatch is "
+            "forfeited"),)

@@ -183,7 +183,9 @@ class GenerationEngine:
                 bk, bv = self.caches[li]
                 self.caches[li] = (bk.at[idx].set(kc), bv.at[idx].set(vc))
             slot = _Slot(rid, length=n, max_new=max_new, eos_id=eos_id)
-            tok = int(first)
+            # One scalar fetch per ADMITTED request (prefill emit);
+            # the decode loop fetches one np.asarray batch per step.
+            tok = int(first)  # raylint: disable=RTL111
             slot.emitted.append(tok)
             self.last_tok[idx] = tok
             self._admit_events.append((rid, tok))

@@ -168,7 +168,9 @@ class ContinuousEnvRunner:
             else:
                 a, _ = _sample_jit(actor, jnp.asarray(
                     self.obs, jnp.float32), sub, self.cfg)
-                actions = np.asarray(a)
+                # The env boundary is host-side numpy: ONE batched
+                # fetch per env step is the contract.
+                actions = np.asarray(a)  # raylint: disable=RTL111
             nxt, rew, term, trunc, _ = self.env.step(actions)
             obs_b.append(self.obs.copy())
             act_b.append(actions)

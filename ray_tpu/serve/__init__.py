@@ -259,8 +259,10 @@ def shutdown():
     _ConfigWatcher.stop()
     try:
         ctl = get_controller()
-        for app in list(ray_tpu.get(ctl.list_deployments.remote())):
-            ray_tpu.get(ctl.delete_app.remote(app))
+        apps = list(ray_tpu.get(ctl.list_deployments.remote()))
+        # Fan every delete_app out first, ONE barrier after — the
+        # serial per-app get was PR 2's last baselined RTL002.
+        ray_tpu.get([ctl.delete_app.remote(app) for app in apps])
         ray_tpu.kill(ctl)
     except Exception:
         pass

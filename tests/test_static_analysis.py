@@ -438,9 +438,13 @@ def test_self_scan_against_committed_baseline():
     assert data["findings"] == []
 
 
-def test_rule_table_covers_all_eight():
+def test_rule_table_covers_all_families():
     ids = [r["id"] for r in rule_table()]
-    assert ids == [f"RTL00{i}" for i in range(1, 9)]
+    assert ids == ([f"RTL00{i}" for i in range(1, 9)]          # per-file
+                   + ["RTL101", "RTL102", "RTL103"]            # flow
+                   + ["RTL111", "RTL112", "RTL113", "RTL114"]  # jax
+                   + ["RTL121", "RTL122", "RTL123", "RTL124"]  # protocol
+                   + ["RTL131"])                               # failpoints
 
 
 # ------------------------------------- decoration-time (RAY_TPU_STATIC_CHECKS)
@@ -512,3 +516,635 @@ def test_decoration_time_reports_real_file_and_line():
     want = start + next(i for i, line in enumerate(src)
                         if "ray_tpu.get" in line)
     assert findings[0].line == want
+
+
+# ============================================================ RTL10x (flow)
+
+def test_rtl101_chain_blocking_from_async_fires():
+    src = '''
+    import ray_tpu
+
+    class A:
+        def _helper(self, ref):
+            return ray_tpu.get(ref)
+
+        async def refresh(self, ref):
+            return self._helper(ref)
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL101"]
+    assert [f.line for f in hits] == [9]  # the call site in the async def
+    assert hits[0].severity == "error"
+    assert "_helper" in hits[0].message
+
+
+def test_rtl101_regression_load_args_fast_io_thread_shape():
+    """PR 9's `_load_args_fast` crash, pre-fix form: a coroutine
+    dispatcher loads args inline and the loader needs a blocking KV
+    fetch on cache miss — `run_async called from the IO thread`."""
+    src = '''
+    class Executor:
+        def _load_args_fast(self, msg):
+            blob = self.worker.kv_get(msg["fid"], ns="fn")
+            return blob
+
+        async def _run_actor_call(self, conn, msg):
+            args = self._load_args_fast(msg)
+            return args
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL101"]
+    assert hits and hits[0].severity == "error"
+    assert "kv_get" in hits[0].message
+
+
+def test_rtl101_executor_offload_reference_clean():
+    # run_in_executor(None, fn) REFERENCES fn — no call edge, no finding.
+    src = '''
+    import asyncio
+    import ray_tpu
+
+    class A:
+        def _fetch(self, ref):
+            return ray_tpu.get(ref)
+
+        async def refresh(self, ref):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, self._fetch, ref)
+    '''
+    assert "RTL101" not in rules_of(src)
+
+
+def test_rtl101_cross_file_chain_fires(tmp_path):
+    (tmp_path / "helpers.py").write_text(textwrap.dedent('''
+    import ray_tpu
+
+    def fetch_weights(ref):
+        return ray_tpu.get(ref)
+    '''))
+    (tmp_path / "server.py").write_text(textwrap.dedent('''
+    from helpers import fetch_weights
+
+    class Replica:
+        async def refresh(self, ref):
+            return fetch_weights(ref)
+    '''))
+    from ray_tpu.analysis import analyze_paths
+
+    found = analyze_paths([str(tmp_path)])
+    hits = [f for f in found if f.rule == "RTL101"]
+    assert len(hits) == 1
+    assert hits[0].path.endswith("server.py")
+    assert "fetch_weights" in hits[0].message
+
+
+def test_rtl101_suppression_at_blocking_line_stops_propagation():
+    src = '''
+    import ray_tpu
+
+    class A:
+        def _helper(self, ref):
+            return ray_tpu.get(ref)  # raylint: disable=RTL101
+
+        async def refresh(self, ref):
+            return self._helper(ref)
+    '''
+    assert "RTL101" not in rules_of(src)
+
+
+def test_rtl102_regression_reconfigure_deadlock_shape():
+    """PR 9's serve reconfigure deadlock, pre-fix form: a sync method
+    of a deployment class blocks in ray_tpu.get — a handle-routed call
+    runs it ON the replica's event loop."""
+    src = '''
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Replica:
+        async def __call__(self, request):
+            return request
+
+        def reconfigure(self, user_config):
+            self.params = ray_tpu.get(user_config["weights_ref"])
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL102"]
+    assert [f.line for f in hits] == [11]
+    assert "reconfigure" in hits[0].message
+
+
+def test_rtl102_loop_guard_idiom_clean():
+    # The shipped fix: probe for a running loop, block only in the
+    # except RuntimeError (no-loop) branch.
+    src = '''
+    import asyncio
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Replica:
+        async def __call__(self, request):
+            return request
+
+        def reconfigure(self, cfg):
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                return ray_tpu.get(cfg["weights_ref"])
+
+            async def _run():
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(
+                    None, ray_tpu.get, cfg["weights_ref"])
+
+            return _run()
+    '''
+    assert "RTL102" not in rules_of(src)
+
+
+def test_rtl102_plain_actor_sync_method_clean():
+    # Plain actors run sync methods in the executor pool — only
+    # deployment-hosted classes route them onto the replica loop.
+    src = '''
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        async def poll(self):
+            return 1
+
+        def fetch(self, ref):
+            return ray_tpu.get(ref)
+    '''
+    assert "RTL102" not in rules_of(src)
+
+
+def test_rtl103_blocking_loop_callback_fires():
+    src = '''
+    import ray_tpu
+
+    def schedule(loop, ref):
+        loop.call_soon_threadsafe(lambda: ray_tpu.get(ref))
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL103"]
+    assert [f.line for f in hits] == [5]
+    assert hits[0].severity == "error"
+
+
+def test_rtl103_nonblocking_callback_clean():
+    src = '''
+    def schedule(loop, q, item):
+        loop.call_soon_threadsafe(q.put_nowait, item)
+        loop.call_soon_threadsafe(lambda: q.put_nowait(item))
+    '''
+    assert "RTL103" not in rules_of(src)
+
+
+# -------------------------------------------- RTL006 op-set extensions
+
+def test_rtl006_wait_open_result_acquire_fire():
+    src = '''
+    import asyncio
+    import threading
+    import ray_tpu
+
+    class A:
+        def __init__(self):
+            self.lock = threading.Lock()
+
+        async def f(self, refs, pool, coro, loop):
+            ray_tpu.wait(refs)
+            open("/tmp/x").read()
+            fut = pool.submit(len, refs)
+            fut.result()
+            asyncio.run_coroutine_threadsafe(coro, loop).result()
+            self.lock.acquire()
+    '''
+    assert lines_of(src, "RTL006") == [11, 12, 14, 15, 16]
+
+
+def test_rtl006_shadowed_open_plain_acquire_done_task_result_clean():
+    src = '''
+    import asyncio
+
+    class A:
+        async def f(self, open, conn, tasks):
+            open("/tmp/x")      # shadowed local: not builtin open
+            conn.acquire()      # receiver is no known threading lock
+            # standard non-blocking read of COMPLETED asyncio tasks:
+            done, _ = await asyncio.wait(tasks)
+            return [t.result() for t in done]
+    '''
+    assert "RTL006" not in rules_of(src)
+
+
+# ============================================================ RTL11x (jax)
+
+def test_rtl111_regression_spec_decode_sync_loop_shape():
+    """The pre-PR-9 speculative compare-and-break loop: int() of jitted
+    outputs per compared position (~142 blocking D2H syncs per
+    generation before the loop moved on device)."""
+    src = '''
+    import jax
+
+    _draft_k = jax.jit(lambda p, x: x)
+    _verify = jax.jit(lambda p, x: x)
+
+    def generate(params, prompt, max_new, k):
+        pos = prompt.shape[1]
+        while pos < max_new:
+            draft_ids = _draft_k(params, pos)
+            tgt = _verify(params, draft_ids)
+            acc = 0
+            for i in range(k):
+                if int(draft_ids[0, i]) != int(tgt[0, i]):
+                    break
+                acc += 1
+            pos += acc
+        return pos
+    '''
+    assert lines_of(src, "RTL111") == [14, 14]
+
+
+def test_rtl111_single_fetch_after_loop_clean():
+    # The post-fix shape: one packed device_get per generation, plus
+    # np.asarray ONCE materializes to host (later int()s are free).
+    src = '''
+    import jax
+    import numpy as np
+
+    _step = jax.jit(lambda p: p)
+
+    def generate(params, steps):
+        out = []
+        for _ in range(steps):
+            toks = _step(params)
+            toks = np.asarray(toks)
+            out.append(int(toks[0]))
+        packed = _step(params)
+        return out, int(packed[0])
+    '''
+    assert "RTL111" not in rules_of(src)
+
+
+def test_rtl112_traced_control_flow_fires_as_error():
+    src = '''
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    '''
+    found = analyze_source(textwrap.dedent(src), "t.py")
+    hits = [f for f in found if f.rule == "RTL112"]
+    assert [f.line for f in hits] == [6]
+    assert hits[0].severity == "error"
+
+
+def test_rtl112_shape_reads_and_static_args_clean():
+    src = '''
+    import functools
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x.shape[0] > 1:
+            return x
+        return x * 2
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def g(x, n):
+        while n > 0:
+            n -= 1
+            x = x * 2
+        return x
+    '''
+    assert "RTL112" not in rules_of(src)
+
+
+def test_rtl112_by_reference_wrap_fires():
+    # jax.jit(f, ...) marks f as traced even without a decorator.
+    src = '''
+    import jax
+
+    def step(params, lr):
+        if lr > 0:
+            return params
+        return params
+
+    step_jit = jax.jit(step)
+    '''
+    assert lines_of(src, "RTL112") == [5]
+
+
+def test_rtl113_jit_in_loop_fires_and_hoisted_clean():
+    src = '''
+    import jax
+
+    def train(fns, x):
+        out = []
+        for fn in fns:
+            jf = jax.jit(fn)
+            out.append(jf(x))
+        return out
+
+    def train_ok(fns, x):
+        jfs = [jax.jit(f) for f in fns]
+        return jfs
+    '''
+    # the comprehension form is ALSO a loop — both flagged
+    assert lines_of(src, "RTL113") == [7, 12]
+
+
+def test_rtl114_block_until_ready_in_loop_fires():
+    src = '''
+    def train(step, params):
+        for _ in range(10):
+            params = step(params).block_until_ready()
+        params = step(params)
+        return params.block_until_ready()
+    '''
+    assert lines_of(src, "RTL114") == [4]
+
+
+# ========================================================= RTL12x (protocol)
+
+def proto_findings(tmp_path, files):
+    from ray_tpu.analysis.protocol_check import check_protocol_paths
+
+    for name, src in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return check_protocol_paths([str(tmp_path)])
+
+
+def test_rtl121_orphan_sent_message(tmp_path):
+    found = proto_findings(tmp_path, {"a.py": '''
+    def notify(conn, oid):
+        conn.send({"t": "obj_progres", "oid": oid})
+    '''})
+    assert [f.rule for f in found] == ["RTL121"]
+    assert found[0].severity == "error"
+    assert "obj_progres" in found[0].message
+
+
+def test_rtl122_dead_handler_and_matched_pair(tmp_path):
+    found = proto_findings(tmp_path, {
+        "send.py": '''
+    def notify(conn, oid):
+        conn.send({"t": "obj_done", "oid": oid})
+    ''',
+        "handle.py": '''
+    class S:
+        async def _h_obj_done(self, client, msg):
+            return msg["oid"]
+
+        async def _h_obj_gone(self, client, msg):
+            return msg["oid"]
+    '''})
+    assert [f.rule for f in found] == ["RTL122"]
+    assert "obj_gone" in found[0].message
+
+
+def test_rtl123_unsourced_field_read(tmp_path):
+    found = proto_findings(tmp_path, {
+        "send.py": '''
+    def notify(conn, oid):
+        conn.send({"t": "obj_done", "oid": oid, "nbytes": 1})
+    ''',
+        "handle.py": '''
+    class S:
+        async def _h_obj_done(self, client, msg):
+            return msg["oid"], msg.get("adr")
+    '''})
+    assert [f.rule for f in found] == ["RTL123"]
+    assert "'adr'" in found[0].message
+
+
+def test_rtl123_opaque_sender_exempts_and_staged_fields_count(tmp_path):
+    found = proto_findings(tmp_path, {
+        "send.py": '''
+    def notify(conn, oid, extra):
+        msg = {"t": "obj_done", "oid": oid}
+        msg["addr"] = extra
+        conn.send(msg)
+
+    def forward(conn, fwd):
+        fwd["t"] = "obj_gone"
+        conn.send(fwd)
+    ''',
+        "handle.py": '''
+    class S:
+        async def _h_obj_done(self, client, msg):
+            return msg["oid"], msg["addr"]
+
+        async def _h_obj_gone(self, client, msg):
+            return msg["anything"]
+    '''})
+    assert found == []  # staged write covers addr; retyped fwd is opaque
+
+
+def test_rtl123_dispatcher_branch_reads(tmp_path):
+    found = proto_findings(tmp_path, {"w.py": '''
+    def send(conn):
+        conn.send({"t": "task_done", "tid": 1})
+
+    async def on_push(msg):
+        t = msg.get("t")
+        if t == "task_done":
+            return msg["tid"], msg["results"]
+    '''})
+    assert [f.rule for f in found] == ["RTL123"]
+    assert "'results'" in found[0].message
+
+
+def test_rtl124_release_discipline(tmp_path):
+    found = proto_findings(tmp_path, {"a.py": '''
+    def serve_chunk(conn, msg, view, parts):
+        conn.send(msg, release=view.transfer())       # safe path
+        _write_parts(parts, release=view.transfer())  # bypasses flush
+
+    def double(conn, msg, unpin):
+        conn.reply(msg, {"ok": True}, release=unpin)
+        unpin()                                       # double release
+    '''})
+    rules = sorted(f.rule for f in found)
+    assert rules == ["RTL124", "RTL124"]
+    lines = sorted(f.line for f in found)
+    assert lines == [4, 8]
+
+
+def test_rtl12x_inline_allowlist(tmp_path):
+    found = proto_findings(tmp_path, {"a.py": '''
+    def notify(conn, oid):
+        # deliberate one-way frame
+        conn.send({"t": "fire_and_forget", "oid": oid})  # raylint: disable=RTL121
+    '''})
+    assert found == []
+
+
+def test_protocol_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text('def f(c):\n    c.send({"t": "nope_x"})\n')
+    ok = tmp_path / "ok.py"
+    ok.write_text('def f(c):\n    c.send({"t": "ping_y"})\n'
+                  'async def on(msg):\n'
+                  '    if msg.get("t") == "ping_y":\n        return 1\n')
+    assert check_main([str(bad), "--protocol"]) == 2
+    capsys.readouterr()
+    bad.unlink()
+    assert check_main([str(ok), "--protocol"]) == 0
+
+
+# ======================================================== RTL131 (failpoints)
+
+def fp_findings(tmp_path, registry_src, schedule_src):
+    from ray_tpu.analysis.failpoint_check import check_failpoint_paths
+
+    reg = tmp_path / "reg"
+    sched = tmp_path / "sched"
+    reg.mkdir()
+    sched.mkdir()
+    (reg / "sites.py").write_text(textwrap.dedent(registry_src))
+    (sched / "chaos.py").write_text(textwrap.dedent(schedule_src))
+    return check_failpoint_paths([str(reg)], [str(sched)])
+
+
+_REGISTRY = '''
+from x import failpoints
+
+def f(self, rank):
+    failpoints.fire("conn.send", msg_type)
+    failpoints.fire("store.seal")
+    failpoints.fire("train.collective", key=f"r{rank}")
+    self._fp("gcs.wal.before", op)
+'''
+
+
+def test_rtl131_known_sites_and_qualified_keys_clean(tmp_path):
+    found = fp_findings(tmp_path, _REGISTRY, '''
+    SPECS = [
+        "conn.send.actor_call=hit3:raise",
+        "store.seal=every3:raise;gcs.wal.before=once:crash",
+        "train.collective.r2=once:kill",
+    ]
+    ''')
+    assert found == []
+
+
+def test_rtl131_typo_site_fires(tmp_path):
+    found = fp_findings(tmp_path, _REGISTRY, '''
+    SPEC = "store.seel=every3:raise"
+    ''')
+    assert [f.rule for f in found] == ["RTL131"]
+    assert found[0].severity == "error"
+    assert "store.seel" in found[0].message
+
+
+def test_rtl131_unkeyed_site_rejects_qualification(tmp_path):
+    # store.seal is fired WITHOUT a key: store.seal.foo can never match.
+    found = fp_findings(tmp_path, _REGISTRY, '''
+    SPEC = "store.seal.foo=once:drop"
+    ''')
+    assert [f.rule for f in found] == ["RTL131"]
+
+
+def test_rtl131_unknown_action_fires(tmp_path):
+    found = fp_findings(tmp_path, _REGISTRY, '''
+    SPEC = "store.seal=once:explode"
+    ''')
+    assert [f.rule for f in found] == ["RTL131"]
+    assert "explode" in found[0].message
+
+
+def test_rtl131_env_dict_values_scanned(tmp_path):
+    found = fp_findings(tmp_path, _REGISTRY, '''
+    ENV = {"RAY_TPU_FAILPOINTS": "conn.sendd=once:drop"}
+    ''')
+    assert [f.rule for f in found] == ["RTL131"]
+
+
+def test_rtl131_empty_scopes_fail_loudly(tmp_path):
+    # A green run because the paths resolved to NOTHING is the exact
+    # failure mode the rule exists to close — both scopes must error.
+    from ray_tpu.analysis.failpoint_check import check_failpoint_paths
+
+    reg = tmp_path / "reg"
+    sched = tmp_path / "sched"
+    reg.mkdir()
+    sched.mkdir()
+    (reg / "sites.py").write_text(textwrap.dedent(_REGISTRY))
+    found = check_failpoint_paths([str(reg)], [str(sched / "missing")])
+    assert [f.rule for f in found] == ["RTL131"]
+    assert "no schedule files" in found[0].message
+    (sched / "chaos.py").write_text('SPEC = "store.seal=once:drop"\n')
+    (reg / "sites.py").write_text("def f():\n    pass\n")
+    found = check_failpoint_paths([str(reg)], [str(sched)])
+    assert [f.rule for f in found] == ["RTL131"]
+    assert "no failpoints.fire" in found[0].message
+
+
+def test_rtl131_ordinary_strings_ignored(tmp_path):
+    found = fp_findings(tmp_path, _REGISTRY, '''
+    X = "key=value:other"        # invalid trigger: not a spec
+    Y = "a=1:2;b=3:4"
+    Z = "x == y: z"
+    ''')
+    assert found == []
+
+
+# ============================================== committed-tree gates (tier-1)
+
+def test_protocol_gate_on_committed_tree():
+    """`ray_tpu check --protocol` must stay clean on ray_tpu/ — frame
+    contract drift (orphan sends, dead handlers, unsourced reads) fails
+    the suite. Intentional asymmetries are allowlisted inline."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--protocol", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "protocol contract drift:\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []
+
+
+def test_failpoint_gate_on_committed_tree():
+    """Every site= in the chaos schedules must resolve to a registered
+    failpoint site — a typo'd site silently never fires."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--failpoints", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "failpoint-site drift:\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []
+
+
+def test_decoration_time_runs_flow_family(monkeypatch):
+    """Satellite: RTL10x runs at @ray_tpu.remote registration on async
+    actor methods (warning-only, as the other decoration checks)."""
+    monkeypatch.setenv("RAY_TPU_STATIC_CHECKS", "1")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+
+        @ray_tpu.remote
+        class DecoChain:
+            def _helper(self, ref):
+                return ray_tpu.get(ref)
+
+            async def refresh(self, ref):
+                return self._helper(ref)
+
+    assert isinstance(DecoChain, ray_tpu.ActorClass)  # never hard-fails
+    msgs = [str(x.message) for x in w
+            if isinstance(x.message, StaticCheckWarning)]
+    assert any("RTL101" in m for m in msgs)
