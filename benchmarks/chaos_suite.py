@@ -621,8 +621,8 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
     if ray_tpu.is_initialized():
         raise RuntimeError("run_schedule needs a fresh (uninitialized) "
                            "process state")
-    failpoints.set_failpoints(sched["spec"], sched["seed"])
     failpoints.reset_journal()
+    failpoints.set_failpoints(sched["spec"], sched["seed"])  # raylint: disable=RTL161 (disarmed in the run's finally below)
     session = None
     session_dir = None
     t0 = time.time()

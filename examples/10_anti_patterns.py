@@ -155,6 +155,65 @@ def _bad_orphan_frame(conn, oid):
     conn.send({"t": "obj_progres", "oid": oid})  # note the typo
 
 
+# ----- RTL14x: await-point atomicity (also under --concurrency)
+
+class _BadAsyncPool:
+    """Check-then-act split across an await: the membership test ran
+    BEFORE the suspension, the dependent write lands after it — another
+    coroutine may have filled the slot in between (double connect,
+    RTL141). And resizing a live container while iterating it across an
+    await lets every other coroutine interleave its own mutations
+    (RTL142)."""
+
+    async def get_conn(self, addr, connect):
+        if addr not in self._conns:
+            conn = await connect(addr)
+            self._conns[addr] = conn     # RTL141: re-check after await
+        return self._conns[addr]
+
+    async def drain(self):
+        for k in self._conns:            # iterate list(self._conns)
+            await self._conns[k].close()
+            self._conns.pop(k)           # RTL142
+
+
+# ----- RTL15x: thread/loop affinity
+
+class _BadServeThread:
+    """`_partials` is loop-affine — the async `locate` reads it on the
+    event loop — but the blocking-socket serve thread mutates it with
+    neither `call_soon_threadsafe` nor a lock held on both sides
+    (RTL151: the broadcast serve-thread bug class). `call_soon` from
+    thread context is RTL152 — `thread_check.assert_on_loop` made
+    static."""
+
+    def __init__(self):
+        import threading
+
+        self._partials = {}
+        threading.Thread(target=self._serve_loop, daemon=True).start()
+
+    async def locate(self, oid):
+        return self._partials.get(oid)
+
+    def _serve_loop(self):
+        oid, engine = self._accept()
+        self._partials[oid] = engine     # RTL151
+        self.loop.call_soon(self._wake)  # RTL152: needs _threadsafe
+
+
+# ----- RTL16x: resource lifecycle on error paths
+
+def _bad_create_seal(store, oid, sobj):
+    # RTL161: write_into can raise between create and seal — the arena
+    # range strands for the process lifetime (the pre-PR 7
+    # stranded-arena shape). Fix: try/except BaseException around the
+    # write+seal with store.abort(oid) on the error path.
+    buf = store.create(oid, sobj.total_size)
+    sobj.write_into(buf)
+    store.seal(oid)
+
+
 def main():
     ray_tpu.init(num_cpus=4, probe_tpu=False)
 

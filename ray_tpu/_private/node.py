@@ -758,7 +758,12 @@ class NodeAgent:
                 stderr=open(os.path.join(self.session_dir,
                                          "zygote.out"), "ab"),
                 env=env, bufsize=0)
-            self._zygote = z
+            # The zygote handle is spawner-thread-owned: every loop-side
+            # reader (_is_zygote_child, shutdown) derefs `self._zygote`
+            # exactly once into a local and re-validates with poll(), so
+            # these atomic rebinds can at worst hand it a just-retired
+            # handle — which the poll() check rejects.
+            self._zygote = z  # raylint: disable=RTL151 (atomic rebind; loop readers snapshot + poll()-validate)
             self._zygote_rbuf = b""
             ready = self._pipe_read_line(30.0)
             if ready.strip() != "READY":
@@ -766,7 +771,7 @@ class NodeAgent:
         except Exception:
             if z is not None and z.poll() is None:
                 z.kill()
-            self._zygote = None
+            self._zygote = None  # raylint: disable=RTL151 (atomic rebind; loop readers snapshot + poll()-validate)
             return None
         return z
 
@@ -774,7 +779,7 @@ class NodeAgent:
         z = self._zygote
         if z is not None and z.poll() is None:
             z.kill()
-        self._zygote = None
+        self._zygote = None  # raylint: disable=RTL151 (atomic rebind; loop readers snapshot + poll()-validate)
         self._zygote_rbuf = b""
 
     def _spawn_batch_via_zygote(self, env_keys: List[str]) -> int:
@@ -814,7 +819,15 @@ class NodeAgent:
         try:
             for _ in env_keys:
                 pid = int(self._pipe_read_line(15.0).strip())
-                self.zygote_pids.add(pid)
+                # Copy-on-write rebind, NOT .add(): the memory-monitor
+                # path iterates this set from the IO loop
+                # (_is_zygote_child candidates), and a concurrent .add()
+                # from this spawner thread is a "set changed size during
+                # iteration" crash. Readers deref once and iterate the
+                # immutable snapshot. Single-writer (spawner thread
+                # only), so the read-modify-write below cannot lose
+                # updates.
+                self.zygote_pids = self.zygote_pids | {pid}  # raylint: disable=RTL151 (single-writer copy-on-write rebind; loop readers iterate the snapshot)
                 done += 1
         except (OSError, ValueError, TimeoutError):
             # Template wedged or died mid-burst: kill it so the pipe
@@ -960,7 +973,9 @@ class NodeAgent:
         if z is not None and z.poll() is None:
             z.kill()
         self._zygote = None
-        self.zygote_pids.clear()
+        # Rebind, not .clear(): the spawner thread iterates the bound
+        # snapshot (copy-on-write invariant at _spawn_batch_via_zygote).
+        self.zygote_pids = set()
 
 
 async def _orphan_watch(get_gcs):

@@ -1168,7 +1168,13 @@ class Worker:
         nbytes = None
         if not self.client_mode:
             try:
-                loc = self.request_gcs(
+                # Every downstream path retires the pull=1 registration:
+                # the striped path via _pull_from_peers' error handlers +
+                # _finish_pull, the no-holder case via the pidx branch
+                # below, and a registration-less reply (inline data /
+                # error) never creates one — split responsibility the
+                # per-function pass cannot see.
+                loc = self.request_gcs(  # raylint: disable=RTL161 (retired by _pull_from_peers error paths / pidx branch below)
                     {"t": "obj_locate", "oid": object_id.binary(),
                      "pull": 1},
                     timeout=_cfg().pull_timeout_base_s)
@@ -1250,6 +1256,7 @@ class Worker:
         cfg = _cfg()
         cs = int(loc.get("cs") or self._PULL_CHUNK)
         oid_b = object_id.binary()
+        exclude = {self.serve_addr} if self.serve_addr else set()
         try:
             buf = self.create_in_store(object_id, nbytes)
         except BaseException:
@@ -1266,38 +1273,65 @@ class Worker:
             except RuntimeError:
                 pass
             raise
-        exclude = {self.serve_addr} if self.serve_addr else set()
 
         async def locate():
             return await self.gcs.request(
                 {"t": "obj_locate", "oid": oid_b, "pull": 1}, timeout=5)
 
-        engine = broadcast.StripedPull(
-            oid_b, nbytes, buf, chunk_bytes=cs, window=self._PULL_WINDOW,
-            max_sources=cfg.pull_max_sources,
-            chunk_timeout_s=chunk_timeout_s(cs, self._PULL_WINDOW),
-            refresh_interval_s=cfg.pull_refresh_interval_s,
-            progress_every=cfg.pull_progress_chunks,
-            locate=locate, conn_factory=self._chunk_conn,
-            conn_release=self._release_chunk_conn, exclude_addrs=exclude,
-            pidx=loc.get("pidx"), npull=int(loc.get("npull") or 1))
+        engine = None
+        try:
+            engine = broadcast.StripedPull(
+                oid_b, nbytes, buf, chunk_bytes=cs,
+                window=self._PULL_WINDOW,
+                max_sources=cfg.pull_max_sources,
+                chunk_timeout_s=chunk_timeout_s(cs, self._PULL_WINDOW),
+                refresh_interval_s=cfg.pull_refresh_interval_s,
+                progress_every=cfg.pull_progress_chunks,
+                locate=locate, conn_factory=self._chunk_conn,
+                conn_release=self._release_chunk_conn,
+                exclude_addrs=exclude,
+                pidx=loc.get("pidx"), npull=int(loc.get("npull") or 1))
 
-        def report(idxs, _e=engine):
-            # Runs on the IO loop (engine context): publish our
-            # chunk-bitmap progress + current sources (the directory's
-            # per-holder load signal).
-            msg = {"t": "obj_progress", "oid": oid_b, "cs": _e.cs,
-                   "nbytes": nbytes, "add": idxs, "srcs": _e.live_addrs()}
-            if self.serve_addr:
-                msg["addr"] = self.serve_addr
-                if self.node_id is not None:
-                    msg["node"] = self.node_id
-            self._send_gcs(msg)
+            def report(idxs, _e=engine):
+                # Runs on the IO loop (engine context): publish our
+                # chunk-bitmap progress + current sources (the
+                # directory's per-holder load signal).
+                msg = {"t": "obj_progress", "oid": oid_b, "cs": _e.cs,
+                       "nbytes": nbytes, "add": idxs,
+                       "srcs": _e.live_addrs()}
+                if self.serve_addr:
+                    msg["addr"] = self.serve_addr
+                    if self.node_id is not None:
+                        msg["node"] = self.node_id
+                self._send_gcs(msg)
 
-        engine.report = report
-        if self.serve_addr and engine.nchunks > 1:
-            self._partials[object_id] = engine
-        cfut = asyncio.run_coroutine_threadsafe(engine.run(loc), self.loop)
+            engine.report = report
+            if self.serve_addr and engine.nchunks > 1:
+                self._partials[object_id] = engine
+            cfut = asyncio.run_coroutine_threadsafe(engine.run(loc),
+                                                    self.loop)
+        except BaseException:
+            # The engine never started (ctor raised, or the loop is
+            # closed so the dispatch itself failed): the range can't
+            # have in-flight serves — abort it and retire the puller
+            # registration, exactly like the create-failure path above
+            # (RTL161: the unprotected window stranded the range AND
+            # left a phantom npull).
+            if engine is not None:
+                self._finish_pull(object_id, engine, ok=False)
+            else:
+                try:
+                    self.store.abort(object_id)
+                except Exception:
+                    pass
+                try:
+                    self.loop.call_soon_threadsafe(
+                        self._send_gcs,
+                        {"t": "obj_progress", "oid": oid_b,
+                         "done": True, "ok": False})
+                except RuntimeError:
+                    pass
+            raise
         try:
             ok = cfut.result(pull_deadline_s(nbytes))
         except BaseException:
@@ -1524,22 +1558,23 @@ class Worker:
                 "nbytes": len(data), "data": data})
         else:
             buf = self.create_in_store(oid, sobj.total_size)
-            sobj.write_into(buf)
-            if failpoints.active():
-                # Create->seal window: an injected failure must abort the
-                # unsealed allocation (no stranded arena range) and back
-                # out the registration mark above, or the failed ref
-                # would poison later borrower serialization.
-                try:
+            # Create->seal window: ANY failure — not just an injected
+            # one, the pre-RTL161 form only aborted under the failpoint
+            # — must abort the unsealed allocation (no stranded arena
+            # range) and back out the registration mark above, or the
+            # failed ref would poison later borrower serialization.
+            try:
+                sobj.write_into(buf)
+                if failpoints.active():
                     failpoints.fire("store.seal")
-                except failpoints.FailpointError:
-                    self._registered_inline.discard(oid)
-                    try:
-                        self.store.abort(oid)
-                    except Exception:
-                        pass
-                    raise
-            self.store.seal(oid)
+                self.store.seal(oid)
+            except BaseException:
+                self._registered_inline.discard(oid)
+                try:
+                    self.store.abort(oid)
+                except Exception:
+                    pass
+                raise
             self.send_gcs_threadsafe({
                 "t": "obj_put", "oid": oid.binary(),
                 "nbytes": sobj.total_size, "shm": True})
@@ -1557,20 +1592,21 @@ class Worker:
         if oid is None:
             oid = ObjectID.for_put(self._put_counter.next())
         buf = self.create_in_store(oid, sobj.total_size)
-        sobj.write_into(buf)
-        if failpoints.active():
-            # Between create and seal: an injected failure here must not
-            # strand the unsealed allocation — abort reclaims the range
-            # (the crashed-writer case plasma handles via client death).
-            try:
+        # Between create and seal: any failure must not strand the
+        # unsealed allocation — abort reclaims the range (the
+        # crashed-writer case plasma handles via client death; the
+        # pre-RTL161 form covered only the injected failure).
+        try:
+            sobj.write_into(buf)
+            if failpoints.active():
                 failpoints.fire("store.seal")
-            except failpoints.FailpointError:
-                try:
-                    self.store.abort(oid)
-                except Exception:
-                    pass
-                raise
-        self.store.seal(oid)
+            self.store.seal(oid)
+        except BaseException:
+            try:
+                self.store.abort(oid)
+            except Exception:
+                pass
+            raise
         if register:
             self._registered_inline.add(oid)
             self.loop.call_soon_threadsafe(self._send_gcs, {

@@ -533,7 +533,7 @@ class Executor:
         # for other tasks — retire it after this task like the reference's
         # dedicated runtime-env workers.
         if ctx.taints_worker and self.actor_id is None:
-            self.die_after_task = True
+            self.die_after_task = True  # raylint: disable=RTL151 (loop reads it only after the executor future resolves — happens-before)
 
     def _pack_results(self, tid_bytes: bytes, values: List[Any],
                       register_shm: bool) -> List[dict]:
@@ -547,8 +547,18 @@ class Executor:
                             "data": sobj.to_bytes()})
             else:
                 buf = self.worker.create_in_store(oid, sobj.total_size)
-                sobj.write_into(buf)
-                self.worker.store.seal(oid)
+                # A write_into/seal failure mid-result-set must abort
+                # the unsealed allocation or the arena range strands for
+                # the worker's lifetime (RTL161).
+                try:
+                    sobj.write_into(buf)
+                    self.worker.store.seal(oid)
+                except BaseException:
+                    try:
+                        self.worker.store.abort(oid)
+                    except Exception:
+                        pass
+                    raise
                 out.append({"oid": oid.binary(), "nbytes": sobj.total_size,
                             "shm": True})
         return out
@@ -674,7 +684,7 @@ class Executor:
 
     def _execute_sync(self, msg: dict, tid: bytes, nret: int,
                       opts: dict) -> List[dict]:
-        self.running_tasks[tid] = threading.get_ident()
+        self.running_tasks[tid] = threading.get_ident()  # raylint: disable=RTL151 (GIL-atomic dict op; loop side only truthiness/get/setdefault, never iterates)
         fn_name = opts.get("name", "unknown")
         from .runtime_context import _clear_execution, _set_execution
 
@@ -744,7 +754,7 @@ class Executor:
                 tid, 1 if nret == "dyn" else nret, fn_name, e)
         finally:
             _clear_execution()
-            self.running_tasks.pop(tid, None)
+            self.running_tasks.pop(tid, None)  # raylint: disable=RTL151 (GIL-atomic dict op; loop side only truthiness/get/setdefault, never iterates)
 
     @staticmethod
     def _split_returns(value: Any, nret: int) -> List[Any]:
@@ -809,7 +819,7 @@ class Executor:
             kwargs = {}
         else:
             args, kwargs = self._load_args(msg)
-        self.actor_instance = cls(*args, **kwargs)
+        self.actor_instance = cls(*args, **kwargs)  # raylint: disable=RTL151 (loop awaits the init executor future before any call dispatch — happens-before)
 
     async def _run_actor_call(self, conn: protocol.Connection, msg: dict):
         loop = asyncio.get_running_loop()
@@ -983,7 +993,7 @@ class Executor:
                 except serialization.ActorExitSignal:
                     results = self._pack_results(
                         tid, self._split_returns(None, nret), True)
-                    self._exit_requested = True
+                    self._exit_requested = True  # raylint: disable=RTL151 (monotonic bool flag, atomic rebind; loop polls it after the pump batch delivers)
                 except BaseException as e:  # noqa: BLE001
                     ok = False
                     try:
@@ -1057,7 +1067,7 @@ class Executor:
 
     def _execute_method_sync(self, method, msg: dict, tid: bytes,
                              nret: int) -> List[dict]:
-        self.running_tasks[tid] = threading.get_ident()
+        self.running_tasks[tid] = threading.get_ident()  # raylint: disable=RTL151 (GIL-atomic dict op; loop side only truthiness/get/setdefault, never iterates)
         from .runtime_context import _clear_execution, _set_execution
 
         _set_execution(task_id=bytes(tid),
@@ -1098,7 +1108,7 @@ class Executor:
             return self._pack_results(tid, values, register_shm=True)
         finally:
             _clear_execution()
-            self.running_tasks.pop(tid, None)
+            self.running_tasks.pop(tid, None)  # raylint: disable=RTL151 (GIL-atomic dict op; loop side only truthiness/get/setdefault, never iterates)
 
     # ---------------------------------------------------------------- misc
 

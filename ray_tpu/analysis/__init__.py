@@ -42,6 +42,9 @@ from .decoration import (StaticCheckWarning, check_decorated,
 from .project import ProjectIndex
 from .protocol_check import check_protocol, check_protocol_paths
 from .failpoint_check import check_failpoints, check_failpoint_paths
+from .concurrency import analyze_concurrency, check_concurrency_paths
+from .cache import ScanCache, file_sig
+from .changed import closure_for_paths, reverse_closure
 
 __all__ = [
     "Finding", "Rule", "all_rules", "analyze_file", "analyze_paths",
@@ -50,4 +53,6 @@ __all__ = [
     "StaticCheckWarning", "check_decorated", "static_checks_enabled",
     "warn_on_decoration", "ProjectIndex", "check_protocol",
     "check_protocol_paths", "check_failpoints", "check_failpoint_paths",
+    "analyze_concurrency", "check_concurrency_paths", "ScanCache",
+    "file_sig", "closure_for_paths", "reverse_closure",
 ]

@@ -1,0 +1,154 @@
+"""Incremental scan cache: skip re-analysis of unchanged files.
+
+Three new project-scope families (PR 13) ride on the same walk the
+per-file rules pay for, and the full self-scan is the tier-1 gate — so
+scan cost is a budget, not a nicety. Two layers, both keyed by
+``(path, mtime_ns, size)``:
+
+- **In-process module memo** (:func:`memo_module` /
+  :func:`remember_module`): parsed :class:`~.project.ModuleInfo` objects
+  — the per-function tables (FuncDef/ClassDef/import maps) every
+  cross-file pass draws from. ``ProjectIndex.build`` consults it, so a
+  CLI invocation running ``--protocol`` + ``--failpoints`` +
+  ``--concurrency`` parses each file once, and repeated decoration-time
+  checks in one process never re-stat the world. Entries are shared
+  between indexes: passes must treat ModuleInfo as read-only (they do —
+  only decoration-mode snippets overlay imports, and those never enter
+  the memo).
+
+- **On-disk findings cache** (:class:`ScanCache`): per-file findings of
+  the PER-FILE rules only, JSON next to the baseline
+  (``--cache [FILE]``, default ``.raylint_cache.json``). Cross-file
+  findings (flow/concurrency/protocol) are NEVER cached — a callee edit
+  changes a caller's findings without touching the caller's stat — they
+  are recomputed every run over the (memo-cheap) project index. Entries
+  carry the rule-selection key; a scan with a different ``--select`` /
+  ``--disable`` set ignores them.
+
+Invalidation is the stat signature: any mtime or size change misses.
+``hits``/``misses`` counters make the behavior testable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .engine import Finding
+
+CACHE_VERSION = 1
+
+_MEMO_CAP = 1024
+
+
+def file_sig(path: str) -> Optional[Tuple[int, int]]:
+    """(mtime_ns, size) stat signature; None when unreadable."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+# ------------------------------------------------- in-process module memo
+
+_mod_memo: Dict[Tuple[str, Tuple[int, int]], object] = {}
+memo_hits = 0
+memo_misses = 0
+
+
+def memo_module(path: str, sig: Optional[Tuple[int, int]]):
+    """Cached ModuleInfo for (path, sig), else None."""
+    global memo_hits, memo_misses
+    if sig is None:
+        return None
+    mod = _mod_memo.get((path, sig))
+    if mod is not None:
+        memo_hits += 1
+    else:
+        memo_misses += 1
+    return mod
+
+
+def remember_module(path: str, sig: Optional[Tuple[int, int]], mod):
+    if sig is None or mod is None:
+        return
+    if len(_mod_memo) >= _MEMO_CAP:
+        # drop the oldest generation wholesale — the memo is a
+        # throughput device, not a correctness one.
+        _mod_memo.clear()
+    _mod_memo[(path, sig)] = mod
+
+
+def clear_memo():
+    global memo_hits, memo_misses
+    _mod_memo.clear()
+    memo_hits = 0
+    memo_misses = 0
+
+
+# ---------------------------------------------------- on-disk scan cache
+
+class ScanCache:
+    """Per-file findings of the per-file rules, stat-keyed.
+
+    ``rules_key`` pins the rule selection the entries were computed
+    under; a mismatching cache file is treated as empty (and rewritten
+    on save).
+    """
+
+    def __init__(self, path: Optional[str] = None, rules_key: str = ""):
+        self.path = path
+        self.rules_key = rules_key
+        self.hits = 0
+        self.misses = 0
+        self._files: Dict[str, dict] = {}
+        self._dirty = False
+        if path:
+            self._load()
+
+    def _load(self):
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        if (not isinstance(data, dict)
+                or data.get("version") != CACHE_VERSION
+                or data.get("rules_key") != self.rules_key):
+            return
+        files = data.get("files")
+        if isinstance(files, dict):
+            self._files = files
+
+    def get(self, display_path: str,
+            sig: Optional[Tuple[int, int]]) -> Optional[List[Finding]]:
+        entry = self._files.get(display_path)
+        if (sig is None or entry is None
+                or entry.get("sig") != list(sig)):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [Finding.from_dict(d) for d in entry.get("findings", [])]
+
+    def put(self, display_path: str, sig: Optional[Tuple[int, int]],
+            findings: List[Finding]):
+        if sig is None:
+            return
+        self._files[display_path] = {
+            "sig": list(sig),
+            "findings": [f.to_dict() for f in findings],
+        }
+        self._dirty = True
+
+    def save(self):
+        if not self.path or not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": CACHE_VERSION,
+                       "rules_key": self.rules_key,
+                       "files": self._files}, f, indent=1)
+        os.replace(tmp, self.path)
+        self._dirty = False

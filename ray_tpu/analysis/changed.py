@@ -1,0 +1,116 @@
+"""``ray_tpu check --changed [ref]``: scan what an edit can affect.
+
+The pre-commit/CI entry point. A full self-scan is the gate of record,
+but an edit's blast radius is bounded: the changed files plus everything
+that imports them (transitively) — a callee edit must rescan its
+CALLERS, because the flow/concurrency findings a caller carries depend
+on the callee's body (that is the whole point of cross-file analysis).
+
+Mechanics: ``git diff --name-only <ref>`` (plus untracked files) names
+the changed set; the project import map (built for the scan anyway)
+gives reverse dependencies; findings are filtered to the closure. The
+ANALYSIS still runs over the full index — cross-file chains must
+resolve through unchanged intermediates — only the *reporting* narrows,
+so ``--changed`` output is always a subset of the full scan on the same
+tree.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List, Sequence, Set
+
+from .engine import Finding, display_path
+from .project import ModuleInfo, ProjectIndex
+
+
+class ChangedScanError(RuntimeError):
+    """git not available / not a repository / bad ref."""
+
+
+def git_changed_files(ref: str, cwd: str = ".") -> Set[str]:
+    """Paths changed vs ``ref`` (committed, staged, or working-tree)
+    plus untracked files, normalized to the SCAN's cwd-relative display
+    form. git prints ``diff --name-only`` repo-root-relative and
+    ``ls-files`` cwd-relative — both are rebased off the repo toplevel
+    so a scan run from a subdirectory still matches its index paths."""
+    def run(argv, run_cwd):
+        try:
+            p = subprocess.run(argv, capture_output=True, text=True,
+                               cwd=run_cwd, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise ChangedScanError(f"{' '.join(argv)}: {e}")
+        if p.returncode != 0:
+            raise ChangedScanError(
+                f"{' '.join(argv)} failed: {p.stderr.strip()}")
+        return [line.strip() for line in p.stdout.splitlines()
+                if line.strip()]
+
+    top = run(["git", "rev-parse", "--show-toplevel"], cwd)[0]
+    out: Set[str] = set()
+    for argv in (["git", "diff", "--name-only", ref, "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        for line in run(argv, top):
+            out.add(display_path(os.path.join(top, line)))
+    return out
+
+
+def _module_deps(index: ProjectIndex, mod: ModuleInfo) -> Set[str]:
+    """Project modules ``mod`` imports (module names), via the import
+    map with progressive tail-stripping (``pkg.mod.Name`` -> pkg.mod)."""
+    deps: Set[str] = set()
+    for dotted in mod.imports.values():
+        head = dotted
+        while head:
+            dep = index.find_module(head)
+            if dep is not None:
+                if dep is not mod:
+                    deps.add(dep.modname)
+                break
+            if "." not in head:
+                break
+            head = head.rsplit(".", 1)[0]
+    return deps
+
+
+def reverse_closure(index: ProjectIndex,
+                    changed_paths: Set[str]) -> Set[str]:
+    """Display paths of the changed files plus their transitive
+    importers (the reverse-dependency closure over the import map)."""
+    importers: Dict[str, Set[str]] = {}
+    for mod in index.modules.values():
+        for dep in _module_deps(index, mod):
+            importers.setdefault(dep, set()).add(mod.modname)
+    work = [m.modname for m in index.modules.values()
+            if m.path in changed_paths]
+    seen: Set[str] = set(work)
+    while work:
+        name = work.pop()
+        for importer in importers.get(name, ()):
+            if importer not in seen:
+                seen.add(importer)
+                work.append(importer)
+    out = {index.modules[m].path for m in seen}
+    # changed non-module files (scripts outside the scan roots) still
+    # name themselves so a direct finding in them survives the filter.
+    out.update(changed_paths)
+    return out
+
+
+def closure_for_paths(paths: Sequence[str], ref: str,
+                      on_error=None) -> Set[str]:
+    """The --changed reporting set for a scan over ``paths``."""
+    # git must run against the repo CONTAINING the scanned tree, not the
+    # process cwd — an out-of-tree target would otherwise diff the wrong
+    # repo and pass vacuously.
+    p0 = os.path.abspath(paths[0]) if paths else "."
+    git_cwd = p0 if os.path.isdir(p0) else os.path.dirname(p0)
+    changed = git_changed_files(ref, cwd=git_cwd)
+    index = ProjectIndex.build(paths, on_error=on_error)
+    return reverse_closure(index, changed)
+
+
+def filter_findings(findings: List[Finding],
+                    closure: Set[str]) -> List[Finding]:
+    return [f for f in findings if f.path in closure]

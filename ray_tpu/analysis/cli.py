@@ -18,6 +18,18 @@ the same positional paths):
 - ``--failpoints``: the RTL131 chaos-schedule site cross-check
   (``failpoint_check.py``); schedule files default to
   ``benchmarks,tests`` via ``--schedules``.
+- ``--concurrency``: ONLY the RTL14x/15x/16x interleaving families
+  (``concurrency.py``) — they also run in the default scan; this mode
+  is the focused committed-tree gate.
+
+Scoping/caching:
+
+- ``--changed [REF]`` (composes with any mode): report only findings
+  in files changed vs the git ref (default HEAD) plus their reverse-
+  dependency closure from the import map — the pre-commit entry point.
+- ``--cache [FILE]`` (default scan only; the project-contract modes
+  above ignore it): stat-keyed per-file findings cache (default
+  ``.raylint_cache.json``); cross-file findings are always recomputed.
 """
 
 from __future__ import annotations
@@ -71,6 +83,26 @@ def add_arguments(parser: argparse.ArgumentParser):
                         "tests/test_failpoints.py is always excluded — "
                         "its synthetic site names test the registry "
                         "itself)")
+    parser.add_argument("--concurrency", action="store_true",
+                        help="run ONLY the RTL14x/15x/16x concurrency "
+                        "interleaving families (await-point atomicity, "
+                        "thread/loop affinity, resource lifecycle on "
+                        "error paths) over the given paths — the "
+                        "focused committed-tree gate (they also run in "
+                        "the default scan)")
+    parser.add_argument("--changed", nargs="?", const="HEAD",
+                        default=None, metavar="REF",
+                        help="report only findings in files changed vs "
+                        "the git REF (default HEAD) plus their reverse-"
+                        "dependency closure from the import map (a "
+                        "callee edit rescans its callers)")
+    parser.add_argument("--cache", nargs="?", const=".raylint_cache.json",
+                        default=None, metavar="FILE",
+                        help="stat-keyed ((path, mtime, size)) per-file "
+                        "findings cache for the DEFAULT scan "
+                        "(--protocol/--failpoints/--concurrency ignore "
+                        "it); cross-file findings are always recomputed "
+                        "(default file: .raylint_cache.json)")
     return parser
 
 
@@ -91,9 +123,16 @@ def run_check(args) -> int:
             print(f"{row['id']}  {row['severity']:7}  {row['name']}")
         return 0
 
+    if args.write_baseline and args.changed is not None:
+        # The baseline is the FULL-scan allowlist; writing the closure-
+        # filtered subset would silently drop every entry outside it.
+        print("--write-baseline requires a full scan; drop --changed",
+              file=sys.stderr)
+        return 2
+
     skipped: List[str] = []
     on_error = lambda p, e: skipped.append(f"{p}: {e}")  # noqa: E731
-    if args.protocol or args.failpoints:
+    if args.protocol or args.failpoints or args.concurrency:
         # project-scope passes replace the per-file rules: they answer a
         # different question (cross-file contracts) over the same paths.
         findings = []
@@ -108,10 +147,34 @@ def run_check(args) -> int:
             sched = [s for s in args.schedules.split(",") if s]
             findings.extend(check_failpoint_paths(
                 args.paths, sched, on_error=on_error))
+        if args.concurrency:
+            from .concurrency import check_concurrency_paths
+
+            findings.extend(check_concurrency_paths(args.paths,
+                                                    on_error=on_error))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     else:
-        findings = analyze_paths(args.paths, rules=_selected_rules(args),
-                                 on_error=on_error)
+        rules = _selected_rules(args)
+        cache = None
+        if args.cache:
+            from .cache import ScanCache
+
+            cache = ScanCache(args.cache, rules_key=",".join(
+                sorted(r.id for r in rules)))
+        findings = analyze_paths(args.paths, rules=rules,
+                                 on_error=on_error, cache=cache)
+
+    if args.changed is not None:
+        from .changed import (ChangedScanError, closure_for_paths,
+                              filter_findings)
+
+        try:
+            closure = closure_for_paths(args.paths, args.changed,
+                                        on_error=on_error)
+        except ChangedScanError as e:
+            print(f"--changed: {e}", file=sys.stderr)
+            return 2
+        findings = filter_findings(findings, closure)
 
     baseline_path = args.baseline
     if args.write_baseline:

@@ -1,0 +1,1276 @@
+"""RTL14x/15x/16x: concurrency interleaving analysis.
+
+The repo's fixed-bug history is one bug class repeating: shared state
+mutated across an ``await`` or thread boundary, or an acquire whose
+release is skipped on an error path — the early-unpin serve-buffer race
+(PR 4), the phantom ``npull`` puller registration (PR 4 review), the
+stranded arena range on seal failure (PR 7), fallocate under the close
+lock (PR 4 review). Every one was found by a chaos schedule or a code
+review *after* it shipped. These three families make the shapes
+checkable at write time, riding the PR 12 project index + call graph:
+
+- **RTL14x — await-point atomicity** (per ``async def``):
+  RTL141 check-then-act on shared ``self.`` state split across an
+  ``await`` — the test reads an attribute (or a key of it) before the
+  suspension point, the dependent write lands after it, and any other
+  coroutine may have changed the answer in between (the interleaving
+  TOCTOU shape). RTL142 mutation of a ``self.`` container while
+  iterating it — with an ``await`` in the loop body the iteration
+  invariant isn't even safe from *other* coroutines.
+
+- **RTL15x — thread/loop affinity** (per event-loop-hosted class):
+  the loop-affine attribute set is inferred as everything coroutine
+  code touches; RTL151 flags mutations of it from thread-entry
+  callables (``Thread(target=)``, executor-submitted functions, the
+  blocking-socket serve threads) that go through neither
+  ``call_soon_threadsafe`` nor a lock held on both sides (lock-set
+  inference over ``with self._lock:`` scopes). RTL152 is
+  ``thread_check.assert_on_loop`` made static: ``call_soon`` /
+  ``create_task`` / ``call_later`` from thread context where the
+  ``_threadsafe`` spelling (or ``run_coroutine_threadsafe``) is
+  required.
+
+- **RTL16x — resource lifecycle on error paths** (per function):
+  a paired-op registry — store ``create``→``seal``/``abort``,
+  ``pin``→``unpin``/``release``, ``acquire``→``release``, GCS puller /
+  gang ``register``→``deregister`` frames, failpoint
+  ``set_failpoints``→``clear_failpoints`` — checked along exception
+  paths: RTL161 fires when a fallible operation sits between the
+  acquire and its release with no ``finally``/handler (direct or one
+  call hop away) that releases, and the exception isn't contained by a
+  catch-all. RTL162 is the early-unpin shape: a release marker invoked
+  while a coalescing buffer may still hold data sliced from the pinned
+  source.
+
+Clean idioms recognized (negatives by construction):
+
+- executor offload: callables *referenced*, not called, create no edge;
+- lock on both sides: a thread-side mutation under ``with self._lock:``
+  where coroutine code also takes ``self._lock``;
+- thread-safe containers: attrs bound to ``queue.Queue`` /
+  ``collections.deque`` / ``threading.Event`` (and locks themselves)
+  are exempt from affinity findings;
+- try/finally (or except-with-release) around the fallible region;
+- re-check after the await (``if k not in d: v = await f();
+  if k not in d: d[k] = v``) and ``async with self._lock:`` around the
+  whole check-then-act;
+- snapshot iteration (``for x in list(self._conns):``).
+
+Suppress any finding inline with ``# raylint: disable=RTL1xx`` plus a
+reason — the committed-tree gate (``ray_tpu check ray_tpu
+--concurrency``) keeps the package at zero unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, _own_scope_nodes
+from .engine import Finding, Rule, register_rule
+from .project import ClassDef, FuncDef, ModuleInfo, ProjectIndex
+
+_PER_RULE_FN_CAP = 6  # findings per (function, rule): evidence, not spam
+
+CONCURRENCY_RULE_IDS = ("RTL141", "RTL142", "RTL151", "RTL152",
+                       "RTL161", "RTL162")
+
+
+@register_rule
+class CheckThenActAcrossAwait(Rule):
+    """Metadata carrier for RTL141 (fired by the concurrency pass)."""
+
+    id = "RTL141"
+    severity = "warning"
+    name = "await-split-check-then-act"
+    hint = ("another coroutine can change the tested state during the "
+            "await: re-check after the await before writing, or hold an "
+            "asyncio.Lock (async with self._lock) across the whole "
+            "check-then-act")
+
+
+@register_rule
+class MutateIteratedAcrossAwait(Rule):
+    """Metadata carrier for RTL142 (concurrency pass)."""
+
+    id = "RTL142"
+    severity = "error"
+    name = "container-mutated-while-iterated"
+    hint = ("iterate a snapshot instead: for x in list(self._conns): "
+            "... — the live container may be resized mid-iteration "
+            "(RuntimeError), and with an await in the body other "
+            "coroutines interleave too")
+
+
+@register_rule
+class LoopAffineMutationFromThread(Rule):
+    """Metadata carrier for RTL151 (concurrency pass)."""
+
+    id = "RTL151"
+    severity = "warning"
+    name = "loop-affine-mutation-from-thread"
+    hint = ("marshal the mutation onto the owning loop with "
+            "loop.call_soon_threadsafe(...), or protect BOTH sides with "
+            "the same lock (with self._lock: here and in the coroutine "
+            "code); thread-safe containers (queue.Queue, deque, "
+            "threading.Event) are exempt")
+
+
+@register_rule
+class LoopApiFromThread(Rule):
+    """Metadata carrier for RTL152 (concurrency pass)."""
+
+    id = "RTL152"
+    severity = "error"
+    name = "loop-api-from-thread"
+    hint = ("call_soon/create_task/call_later are not thread-safe: from "
+            "a thread use loop.call_soon_threadsafe(...) or "
+            "asyncio.run_coroutine_threadsafe(coro, loop) — the static "
+            "twin of thread_check.assert_on_loop")
+
+
+@register_rule
+class AcquireLeaksOnErrorPath(Rule):
+    """Metadata carrier for RTL161 (concurrency pass)."""
+
+    id = "RTL161"
+    severity = "warning"
+    name = "acquire-without-release-on-error-path"
+    hint = ("an exception between the acquire and its release strands "
+            "the resource (arena range, puller registration, gang "
+            "record): wrap the fallible region in try/except-or-finally "
+            "that releases/aborts, or suppress at the acquire with the "
+            "reason the leak is impossible")
+
+
+@register_rule
+class ReleaseMarkerBeforeFlush(Rule):
+    """Metadata carrier for RTL162 (concurrency pass)."""
+
+    id = "RTL162"
+    severity = "warning"
+    name = "release-marker-before-flush"
+    hint = ("the coalescing buffer still references the pinned source "
+            "when the marker runs — the arena can recycle the range "
+            "before the bytes hit the socket (the PR 4 early-unpin "
+            "serve-buffer race): flush the buffer BEFORE invoking the "
+            "release marker")
+
+
+# --------------------------------------------------------------- shared AST
+
+_MUTATOR_METHODS = {"append", "extend", "add", "remove", "discard", "pop",
+                    "popitem", "popleft", "appendleft", "clear", "update",
+                    "insert", "setdefault"}
+# size-changing subset: a subscript store on an existing key doesn't
+# resize a dict, these do.
+_RESIZE_METHODS = _MUTATOR_METHODS - {"setdefault", "update"}
+
+_SNAPSHOT_CALLS = {"list", "tuple", "sorted", "set", "frozenset", "dict"}
+
+
+def _self_attr(expr) -> Optional[str]:
+    """``self.X`` -> "X" (else None)."""
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+def _self_attr_root(expr) -> Optional[str]:
+    """Root ``self.X`` of an Attribute/Subscript chain (``self.X[k]``,
+    ``self.X.keys()`` -> "X")."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        a = _self_attr(expr)
+        if a is not None:
+            return a
+        expr = expr.value
+    return None
+
+
+def _test_attr_keys(test) -> Dict[str, Optional[str]]:
+    """Self attrs read by a condition expression, with the subscript /
+    membership KEY text when the test pins one (``k in self._c`` ->
+    {"_c": "k"}); None = whole-attr test (any write matches)."""
+    out: Dict[str, Optional[str]] = {}
+
+    def note(attr: str, key: Optional[str]):
+        if attr in out and out[attr] != key:
+            out[attr] = None  # tested under two keys: match any write
+        else:
+            out.setdefault(attr, key)
+
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    attr = _self_attr_root(comp)
+                    if attr is not None:
+                        try:
+                            note(attr, ast.unparse(node.left))
+                        except Exception:  # pragma: no cover
+                            note(attr, None)
+        elif isinstance(node, ast.Subscript):
+            attr = _self_attr(node.value)
+            if attr is not None:
+                try:
+                    note(attr, ast.unparse(node.slice))
+                except Exception:  # pragma: no cover
+                    note(attr, None)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "__contains__")
+                and node.args):
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                try:
+                    note(attr, ast.unparse(node.args[0]))
+                except Exception:  # pragma: no cover
+                    note(attr, None)
+    # plain attribute loads (truthiness / comparison / None tests)
+    for node in ast.walk(test):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(getattr(node, "ctx", None),
+                                           ast.Load):
+            out.setdefault(attr, None)
+    return out
+
+
+def _attr_writes(stmt) -> Iterable[Tuple[str, Optional[str], int, bool]]:
+    """(attr, key_text_or_None, line, resizes) for every ``self.X``
+    write inside one statement (own scope — nested defs excluded)."""
+    for node in _stmt_scope(stmt):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, None, t.lineno, True
+                elif isinstance(t, ast.Subscript):
+                    a = _self_attr(t.value)
+                    if a is not None:
+                        try:
+                            key = ast.unparse(t.slice)
+                        except Exception:  # pragma: no cover
+                            key = None
+                        yield a, key, t.lineno, False
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = _self_attr_root(t)
+                if a is not None:
+                    yield a, None, t.lineno, True
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS):
+            a = _self_attr(node.func.value)
+            if a is not None:
+                yield (a, None, node.lineno,
+                       node.func.attr in _RESIZE_METHODS)
+
+
+def _stmt_scope(stmt) -> Iterable[ast.AST]:
+    """All nodes of one statement, nested function/lambda/class bodies
+    excluded (they run only when invoked)."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(ch)
+
+
+def _contains_await(stmt) -> bool:
+    return any(isinstance(n, ast.Await) for n in _stmt_scope(stmt))
+
+
+def _parent_map(root) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(root):
+        for ch in ast.iter_child_nodes(node):
+            parents[ch] = node
+    return parents
+
+
+def _recv_text(expr) -> str:
+    """Dotted text of a call receiver (``self.store`` -> "self.store");
+    "" for exotic receivers."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _Emitter:
+    """Per-function finding sink: suppressions, caps, dedup."""
+
+    def __init__(self, mod: ModuleInfo, want: Set[str],
+                 findings: List[Finding]):
+        self.mod = mod
+        self.want = want
+        self.findings = findings
+        self.counts: Dict[str, int] = {}
+        self.seen: Set[Tuple[str, int]] = set()
+
+    def emit(self, rule: Rule, line: int, message: str):
+        rid = rule.id
+        if rid not in self.want or (rid, line) in self.seen:
+            return
+        if self.counts.get(rid, 0) >= _PER_RULE_FN_CAP:
+            return
+        if self.mod.suppressed(rid, line):
+            return
+        self.seen.add((rid, line))
+        self.counts[rid] = self.counts.get(rid, 0) + 1
+        self.findings.append(Finding(
+            rule=rid, severity=rule.severity, path=self.mod.path,
+            line=line, col=0, message=message, hint=rule.hint))
+
+
+# =========================================================== RTL14x pass
+
+def _async_with_lock_lines(fd: FuncDef) -> Set[int]:
+    """Lines inside ``async with self.<lock>:`` bodies — a coroutine
+    lock held across the check-then-act serializes same-lock holders."""
+    lines: Set[int] = set()
+    for node in _own_scope_nodes(fd.node):
+        if not isinstance(node, ast.AsyncWith):
+            continue
+        if any(_self_attr_root(item.context_expr) is not None
+               for item in node.items):
+            end = getattr(node, "end_lineno", node.lineno)
+            lines.update(range(node.lineno, end + 1))
+    return lines
+
+
+def _scan_check_then_act(stmts: Sequence[ast.stmt],
+                         active: Dict[str, list], fd: FuncDef,
+                         em: _Emitter, guarded_lines: Set[int]) -> bool:
+    """Abstract walk of a statement block for RTL141.
+
+    ``active`` maps attr -> [key_text ("" = whole attr), awaited] for
+    conditions currently guarding execution; ``awaited`` is tracked PER
+    GUARD — a nested re-test of the same attr resets it, which is
+    exactly why the re-check-after-await idiom is clean. Returns
+    whether the block contained a suspension point.
+    """
+    block_awaits = False
+
+    def suspend():
+        nonlocal block_awaits
+        block_awaits = True
+        for ent in active.values():
+            ent[1] = True
+
+    for st in stmts:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        st_awaits = _contains_await(st)
+        compound = isinstance(st, (ast.If, ast.For, ast.AsyncFor,
+                                   ast.While, ast.Try, ast.With,
+                                   ast.AsyncWith))
+        # writes in a SIMPLE statement: in an Assign the value (and any
+        # await in it) evaluates before the store lands. Compound
+        # statements recurse below with their own guard state.
+        if active and not compound:
+            for attr, key, line, _rs in _attr_writes(st):
+                ent = active.get(attr)
+                if ent is None:
+                    continue
+                want_key, guard_awaited = ent
+                if not (guard_awaited or st_awaits):
+                    continue
+                if want_key and key and want_key != key:
+                    continue  # different key than the one tested
+                if line in guarded_lines:
+                    continue
+                em.emit(CheckThenActAcrossAwait, line,
+                        f"`self.{attr}` is written here based on a test "
+                        f"that ran before an `await` in `async def "
+                        f"{fd.name}` — another coroutine may have "
+                        f"changed it during the suspension "
+                        f"(check-then-act split across an await)")
+        if isinstance(st, ast.If):
+            tested = _test_attr_keys(st.test)
+            branch = {a: list(v) for a, v in active.items()}
+            for attr, key in tested.items():
+                # fresh guard: a re-test AFTER an await re-reads the
+                # state, so its awaited flag starts clean again.
+                branch[attr] = [key or "", False]
+            aw1 = _scan_check_then_act(
+                st.body, {a: list(v) for a, v in branch.items()}, fd,
+                em, guarded_lines)
+            aw2 = _scan_check_then_act(
+                st.orelse, {a: list(v) for a, v in branch.items()}, fd,
+                em, guarded_lines)
+            if aw1 or aw2:
+                suspend()
+        elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(st, ast.AsyncFor):
+                suspend()
+            body = list(st.body) + list(st.orelse)
+            # two passes: an await late in iteration i precedes a write
+            # early in iteration i+1.
+            if _scan_check_then_act(body, active, fd, em, guarded_lines):
+                suspend()
+                _scan_check_then_act(body, active, fd, em, guarded_lines)
+        elif isinstance(st, ast.Try):
+            for block in (st.body, st.handlers, st.orelse, st.finalbody):
+                for sub in block:
+                    inner = (sub.body if isinstance(sub, ast.ExceptHandler)
+                             else [sub])
+                    if _scan_check_then_act(inner, active, fd, em,
+                                            guarded_lines):
+                        suspend()
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            if isinstance(st, ast.AsyncWith):
+                suspend()
+            if _scan_check_then_act(st.body, active, fd, em,
+                                    guarded_lines):
+                suspend()
+        elif st_awaits:
+            suspend()
+    return block_awaits
+
+
+def _iterated_self_container(iter_expr) -> Optional[str]:
+    """Attr name when a ``for`` iterates a live ``self.X`` (directly or
+    via ``.items()/.keys()/.values()``); None for snapshots."""
+    e = iter_expr
+    if (isinstance(e, ast.Call) and isinstance(e.func, ast.Name)
+            and e.func.id in _SNAPSHOT_CALLS):
+        return None
+    if (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+            and e.func.attr in ("items", "keys", "values")
+            and not e.args):
+        e = e.func.value
+    return _self_attr(e)
+
+
+def _check_iteration_mutation(fd: FuncDef, em: _Emitter):
+    for node in _own_scope_nodes(fd.node):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        attr = _iterated_self_container(node.iter)
+        if attr is None:
+            continue
+        body_awaits = any(
+            isinstance(sub, (ast.Await, ast.AsyncFor, ast.AsyncWith))
+            for st in node.body for sub in _stmt_scope(st))
+        for st in node.body:
+            for a, _key, line, resizes in _attr_writes(st):
+                if a != attr or not resizes:
+                    continue
+                extra = (" — and the `await` in the body lets other "
+                         "coroutines interleave their own mutations"
+                         if body_awaits else "")
+                em.emit(MutateIteratedAcrossAwait, line,
+                        f"`self.{attr}` is resized here while the "
+                        f"enclosing `for` iterates it live{extra}; "
+                        f"iterate a snapshot (`list(self.{attr})`)")
+
+
+def _run_atomicity(mod: ModuleInfo, fd: FuncDef, em: _Emitter):
+    if not fd.is_async:
+        return
+    guarded = _async_with_lock_lines(fd)
+    _scan_check_then_act(fd.node.body, {}, fd, em, guarded)
+    _check_iteration_mutation(fd, em)
+
+
+# =========================================================== RTL15x pass
+
+_THREADSAFE_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "collections.deque", "deque",
+    "threading.Event", "threading.Lock", "threading.RLock",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Condition", "threading.local",
+}
+
+_OWN_LOOP_MARKERS = {"run_until_complete", "run_forever"}
+_OWN_LOOP_CALLS = {"asyncio.run", "asyncio.new_event_loop",
+                   "asyncio.set_event_loop"}
+
+_NOT_THREADSAFE_LOOP_ATTRS = {"call_soon", "call_later", "call_at"}
+
+
+class _ClassAffinity:
+    """Inference products for one event-loop-hosted class."""
+
+    def __init__(self, index: ProjectIndex, cg: CallGraph,
+                 mod: ModuleInfo, cls: ClassDef):
+        self.index = index
+        self.cg = cg
+        self.mod = mod
+        self.cls = cls
+        self.threadsafe_attrs = self._threadsafe_attrs()
+        self.loop_funcs = self._coroutine_context_funcs()
+        # thread entries FIRST: a nested def handed to Thread(target=)
+        # from inside an async method is thread code — its attr touches
+        # must not make those attrs "loop-affine" (it would flag its own
+        # writes against itself).
+        self.thread_entries = self._thread_entry_funcs()
+        self.loop_attrs, self.loop_evidence = self._loop_affine_attrs()
+        self.loop_locks = self._loop_lock_attrs()
+
+    # ---- inference
+
+    def _threadsafe_attrs(self) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(self.cls.node):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            v = node.value
+            if not isinstance(v, ast.Call):
+                continue
+            dotted = self.mod.resolve(v.func)
+            name = (v.func.attr if isinstance(v.func, ast.Attribute)
+                    else v.func.id if isinstance(v.func, ast.Name)
+                    else "")
+            if dotted in _THREADSAFE_CTORS or name in (
+                    "Queue", "SimpleQueue", "deque", "Event", "Lock",
+                    "RLock", "Semaphore", "BoundedSemaphore", "Condition"):
+                for t in targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        out.add(a)
+        return out
+
+    def _same_class_targets(self, fd: FuncDef) -> List[FuncDef]:
+        return [t for site in self.cg.sites(fd) for t in site.targets
+                if t.class_name == self.cls.name
+                and t.module is self.mod]
+
+    def _coroutine_context_funcs(self) -> Set[str]:
+        """fids of async methods + sync methods reachable from them via
+        resolved self-calls (they run ON the loop when so called)."""
+        work = [fd for fd in self.cls.methods.values() if fd.is_async]
+        seen = {fd.fid for fd in work}
+        while work:
+            fd = work.pop()
+            for tgt in self._same_class_targets(fd):
+                if tgt.fid not in seen and not tgt.is_async:
+                    seen.add(tgt.fid)
+                    work.append(tgt)
+        return seen
+
+    def _walk_loop_side(self, fd: FuncDef):
+        """Walk a coroutine-context function INCLUDING nested defs
+        (loop callbacks), but excluding nested defs that are thread
+        entries — those bodies run on threads, not the loop."""
+        entry_nodes = {id(e.node) for e, _ in self.thread_entries.values()}
+        stack = [fd.node]
+        while stack:
+            node = stack.pop()
+            yield node
+            for ch in ast.iter_child_nodes(node):
+                if id(ch) in entry_nodes:
+                    continue
+                stack.append(ch)
+
+    def _loop_affine_attrs(self) -> Tuple[Set[str], Dict[str, str]]:
+        attrs: Set[str] = set()
+        evidence: Dict[str, str] = {}
+        for fd in self.cls.methods.values():
+            if fd.fid not in self.loop_funcs:
+                continue
+            for node in self._walk_loop_side(fd):
+                a = _self_attr(node)
+                if a is not None and a not in self.threadsafe_attrs:
+                    attrs.add(a)
+                    evidence.setdefault(
+                        a, f"{fd.name} (line {node.lineno})")
+        return attrs, evidence
+
+    def _loop_lock_attrs(self) -> Set[str]:
+        """Lock attrs coroutine-context code takes via with/async-with:
+        the loop side of the lock-on-both-sides exemption."""
+        out: Set[str] = set()
+        for fd in self.cls.methods.values():
+            if fd.fid not in self.loop_funcs:
+                continue
+            for node in self._walk_loop_side(fd):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        a = _self_attr_root(item.context_expr)
+                        if a is not None:
+                            out.add(a)
+        return out
+
+    def _entry_from_arg(self, fd: FuncDef, arg) -> Optional[FuncDef]:
+        """Resolve a thread-target expression to a class method or a
+        nested def of ``fd``."""
+        a = _self_attr(arg)
+        if a is not None:
+            tgt = self.cls.methods.get(a)
+            if tgt is not None and not tgt.is_async:
+                return tgt
+            return None
+        if isinstance(arg, ast.Name):
+            parts = fd.qualname.split(".")
+            for i in range(len(parts), 0, -1):
+                cand = self.mod.functions.get(
+                    ".".join(parts[:i] + [arg.id]))
+                if cand is not None and not cand.is_async:
+                    return cand
+        return None
+
+    def _thread_entry_funcs(self) -> Dict[str, Tuple[FuncDef, str]]:
+        """{fid: (funcdef, how)} for callables this class hands to
+        threads/executors."""
+        out: Dict[str, Tuple[FuncDef, str]] = {}
+        for fd in self.cls.methods.values():
+            for node in ast.walk(fd.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = self.mod.resolve(node.func)
+                name = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else "")
+                cand = None
+                how = ""
+                if dotted == "threading.Thread" or name == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            cand = self._entry_from_arg(fd, kw.value)
+                            how = "Thread(target=...)"
+                elif name == "submit" and node.args:
+                    cand = self._entry_from_arg(fd, node.args[0])
+                    how = "executor .submit()"
+                elif name == "run_in_executor" and len(node.args) >= 2:
+                    cand = self._entry_from_arg(fd, node.args[1])
+                    how = "run_in_executor()"
+                if cand is not None and cand.fid not in self.loop_funcs:
+                    out.setdefault(cand.fid, (cand, how))
+        return out
+
+    def thread_side_closure(self, entry: FuncDef
+                            ) -> List[Tuple[FuncDef, str]]:
+        """Thread-entry + same-class sync callees not reachable from
+        coroutine context (shared helpers are ambiguous — skipped)."""
+        out: List[Tuple[FuncDef, str]] = []
+        seen: Set[str] = set()
+        work: List[Tuple[FuncDef, int]] = [(entry, 0)]
+        while work:
+            fd, depth = work.pop()
+            if fd.fid in seen or depth > 3:
+                continue
+            seen.add(fd.fid)
+            out.append((fd, entry.name))
+            if fd.class_name != self.cls.name:
+                continue
+            for tgt in self._same_class_targets(fd):
+                if (tgt.fid not in self.loop_funcs
+                        and not tgt.is_async):
+                    work.append((tgt, depth + 1))
+        return out
+
+
+def _with_lock_attr_lines(fd: FuncDef) -> Dict[int, Set[str]]:
+    """line -> set of ``self.<lock>`` attrs held (with-statement scopes)."""
+    held: Dict[int, Set[str]] = {}
+    for node in ast.walk(fd.node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        attrs = {a for item in node.items
+                 for a in [_self_attr_root(item.context_expr)]
+                 if a is not None}
+        if not attrs:
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        for ln in range(node.lineno, end + 1):
+            held.setdefault(ln, set()).update(attrs)
+    return held
+
+
+def _runs_own_loop(fd: FuncDef, mod: ModuleInfo) -> bool:
+    """Thread bodies that create/drive their own loop use the loop API
+    legitimately (``asyncio.run``, ``run_forever`` …)."""
+    for node in ast.walk(fd.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = mod.resolve(node.func)
+        name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name)
+                else "")
+        if dotted in _OWN_LOOP_CALLS or name in _OWN_LOOP_MARKERS:
+            return True
+    return False
+
+
+def _run_affinity(index: ProjectIndex, cg: CallGraph, mod: ModuleInfo,
+                  cls: ClassDef, emitters: Dict[str, _Emitter],
+                  want: Set[str], findings: List[Finding]):
+    if not cls.has_async:
+        return
+    aff = _ClassAffinity(index, cg, mod, cls)
+    if not aff.thread_entries:
+        return
+    for fid, (entry, how) in sorted(aff.thread_entries.items()):
+        for fd, entry_name in aff.thread_side_closure(entry):
+            fmod = fd.module
+            em = emitters.setdefault(
+                fd.fid, _Emitter(fmod, want, findings))
+            held = _with_lock_attr_lines(fd)
+            own_loop = _runs_own_loop(fd, fmod)
+            for attr, _key, line, _rs in _attr_writes(fd.node):
+                if attr not in aff.loop_attrs:
+                    continue
+                if held.get(line, set()) & aff.loop_locks:
+                    continue  # lock held on both sides
+                em.emit(
+                    LoopAffineMutationFromThread, line,
+                    f"`self.{attr}` is loop-affine (touched by "
+                    f"coroutine code: "
+                    f"{aff.loop_evidence.get(attr, '?')}) but mutated "
+                    f"here in {fd.name!r}, which runs on a thread "
+                    f"({how} from {entry_name!r}) — no "
+                    f"call_soon_threadsafe, no lock held on both sides")
+            if own_loop:
+                continue
+            for node in _own_scope_nodes(fd.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = fmod.resolve(node.func)
+                name = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else "")
+                if name in _NOT_THREADSAFE_LOOP_ATTRS:
+                    em.emit(
+                        LoopApiFromThread, node.lineno,
+                        f"`{name}` called from thread context "
+                        f"({fd.name!r} is a thread-entry callable via "
+                        f"{how}) — only call_soon_threadsafe may touch "
+                        f"a foreign loop from a thread")
+                elif (name == "create_task"
+                        or dotted in ("asyncio.ensure_future",
+                                      "asyncio.create_task")):
+                    em.emit(
+                        LoopApiFromThread, node.lineno,
+                        f"`{name or dotted}` called from thread context "
+                        f"({fd.name!r} runs on a thread via {how}) — "
+                        f"use asyncio.run_coroutine_threadsafe(coro, "
+                        f"loop)")
+
+
+# =========================================================== RTL16x pass
+
+class _MethodPair:
+    __slots__ = ("acquires", "releases", "recv_hint", "what",
+                 "flag_missing")
+
+    def __init__(self, acquires, releases, recv_hint, what,
+                 flag_missing=False):
+        self.acquires = acquires
+        self.releases = releases
+        self.recv_hint = recv_hint  # substring the receiver must contain
+        self.what = what
+        # flag_missing: fire even when NO release exists in the
+        # function. True for locks (they rarely transfer ownership);
+        # False for buffer handles — a create whose seal appears nowhere
+        # in the function is assumed handed off to whoever seals it.
+        self.flag_missing = flag_missing
+
+
+_METHOD_PAIRS = [
+    _MethodPair(("create",), ("seal", "abort"), "store",
+                "store allocation (create without seal/abort strands "
+                "the arena range)"),
+    _MethodPair(("create_in_store",), ("seal", "abort"), None,
+                "store allocation (create without seal/abort strands "
+                "the arena range)"),
+    _MethodPair(("pin",), ("unpin", "release", "close"), None,
+                "pinned buffer"),
+    _MethodPair(("acquire",), ("release",), None, "lock/semaphore",
+                True),
+]
+
+# frame pairs: ({"t": <acq>} [+ required key]) -> ({"t": <rel>} [+ key])
+_FRAME_PAIRS = [
+    (("gang_register", None), ("gang_deregister", None),
+     "gang registration"),
+    (("obj_locate", "pull"), ("obj_progress", "done"),
+     "puller registration (a phantom npull narrows every later "
+     "puller's stripe until this process disconnects)"),
+]
+
+_FN_PAIRS = [
+    ("set_failpoints", ("clear_failpoints", "set_failpoints"),
+     "armed failpoints"),
+]
+
+_CATCH_ALL = {"Exception", "BaseException"}
+
+
+def _frame_type_in_call(node: ast.Call,
+                        required_key: Optional[str]) -> Optional[str]:
+    """Message type of a dict-literal frame passed to this call (the
+    ``{"t": ...}`` protocol idiom), honoring a required extra key."""
+    for arg in list(node.args) + [k.value for k in node.keywords]:
+        if not isinstance(arg, ast.Dict):
+            continue
+        t = None
+        keys = set()
+        for k, v in zip(arg.keys, arg.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+                if (k.value == "t" and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    t = v.value
+        if t is not None and (required_key is None
+                              or required_key in keys):
+            return t
+    return None
+
+
+class _AcquireSite:
+    __slots__ = ("node", "line", "kind", "pair", "recv", "bound")
+
+    def __init__(self, node, kind, pair, recv, bound):
+        self.node = node
+        self.line = node.lineno
+        self.kind = kind  # "method" | "frame" | "fn"
+        self.pair = pair
+        self.recv = recv
+        self.bound = bound  # name the result is bound to (method pairs)
+
+
+def _collect_acquires(fd: FuncDef, parents) -> List[_AcquireSite]:
+    out: List[_AcquireSite] = []
+    for node in _own_scope_nodes(fd.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = _recv_text(node.func.value)
+            for pair in _METHOD_PAIRS:
+                if attr not in pair.acquires:
+                    continue
+                if pair.recv_hint and pair.recv_hint not in recv.lower():
+                    continue
+                parent = parents.get(node)
+                bound = None
+                if isinstance(parent, ast.Assign) and parent.value is node:
+                    if len(parent.targets) == 1 and isinstance(
+                            parent.targets[0], ast.Name):
+                        bound = parent.targets[0].id
+                out.append(_AcquireSite(node, "method", pair, recv, bound))
+        name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name)
+                else "")
+        for (acq_t, acq_key), rel, what in _FRAME_PAIRS:
+            if _frame_type_in_call(node, acq_key) == acq_t:
+                out.append(_AcquireSite(
+                    node, "frame", ((acq_t, acq_key), rel, what), "", None))
+        for fn, rels, what in _FN_PAIRS:
+            if name == fn and node.args and not (
+                    isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == ""):
+                out.append(_AcquireSite(
+                    node, "fn", (fn, rels, what), "", None))
+    return out
+
+
+def _is_release_call(node: ast.Call, site: _AcquireSite) -> bool:
+    if site.kind == "method":
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in site.pair.releases)
+    if site.kind == "frame":
+        (_acq, (rel_t, rel_key), _what) = site.pair
+        return _frame_type_in_call(node, rel_key) == rel_t
+    fn, rels, _what = site.pair
+    name = (node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else "")
+    if name not in rels:
+        return False
+    if name == "set_failpoints":  # only the empty-spec disarm form
+        return bool(node.args) and isinstance(
+            node.args[0], ast.Constant) and node.args[0].value == ""
+    return True
+
+
+def _release_in_stmts(stmts, site: _AcquireSite, cg: CallGraph,
+                      fd: FuncDef, depth: int = 0) -> bool:
+    """A matching release inside ``stmts`` — directly, or ≤2 resolvable
+    call hops down (cleanup helpers)."""
+    calls: List[ast.Call] = []
+    for st in stmts:
+        for node in _stmt_scope(st):
+            if isinstance(node, ast.Call):
+                if _is_release_call(node, site):
+                    return True
+                calls.append(node)
+    if depth >= 2:
+        return False
+    for call in calls:
+        tgt = cg._resolve_target(fd, call)
+        if tgt is not None:
+            if _release_in_stmts(tgt.node.body, site, cg, tgt, depth + 1):
+                return True
+    return False
+
+
+def _escapes(fd: FuncDef, site: _AcquireSite, parents) -> bool:
+    """Ownership leaves this function: acquire returned / yielded /
+    stored on self — release responsibility is the holder's."""
+    if site.kind != "method":
+        return False
+    parent = parents.get(site.node)
+    if isinstance(parent, (ast.Return, ast.Yield, ast.Await)):
+        return True
+    if isinstance(parent, ast.Assign):
+        for t in parent.targets:
+            if _self_attr_root(t) is not None:
+                return True
+    if site.bound:
+        for node in _own_scope_nodes(fd.node):
+            if (isinstance(node, (ast.Return, ast.Yield))
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == site.bound):
+                return True
+            if isinstance(node, ast.Assign):
+                tgt_self = any(_self_attr_root(t) is not None
+                               for t in node.targets)
+                if tgt_self and isinstance(node.value, ast.Name) \
+                        and node.value.id == site.bound:
+                    return True
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("append", "add")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == site.bound):
+                return True
+    return False
+
+
+def _in_try_body(node, tr: ast.Try, parents) -> bool:
+    cur = node
+    while cur is not None and cur is not tr:
+        parent = parents.get(cur)
+        if parent is tr:
+            return any(cur is b for b in tr.body)
+        cur = parent
+    return False
+
+
+def _handler_contains(tr: ast.Try, site, cg, fd) -> bool:
+    if _release_in_stmts(tr.finalbody, site, cg, fd):
+        return True
+    for h in tr.handlers:
+        if _release_in_stmts(h.body, site, cg, fd):
+            return True
+    return False
+
+
+def _handler_contains_catchall(tr: ast.Try) -> bool:
+    for h in tr.handlers:
+        names: List[str] = []
+        if h.type is None:
+            names = ["BaseException"]
+        elif isinstance(h.type, ast.Name):
+            names = [h.type.id]
+        elif isinstance(h.type, ast.Tuple):
+            names = [e.id for e in h.type.elts
+                     if isinstance(e, ast.Name)]
+        if not set(names) & _CATCH_ALL:
+            continue
+        if not any(isinstance(n, ast.Raise)
+                   for st in h.body for n in _stmt_scope(st)):
+            return True
+    return False
+
+
+def _risky_covered(node, site, trys, parents, cg, fd) -> bool:
+    """A fallible node is safe when some enclosing try (node in its
+    BODY) releases in a handler/finally, or contains the exception with
+    a non-reraising catch-all (flow then reaches the later release)."""
+    for tr in trys:
+        if not _in_try_body(node, tr, parents):
+            continue
+        if _handler_contains(tr, site, cg, fd):
+            return True
+        if _handler_contains_catchall(tr):
+            return True
+    return False
+
+
+def _call_target_releases(node: ast.Call, site, cg, fd) -> bool:
+    """The risky call's own callee releases (the callee owns its error
+    path — `_pull_from_peers` retires the puller registration itself)."""
+    tgt = cg._resolve_target(fd, node)
+    if tgt is None:
+        return False
+    return _release_in_stmts(tgt.node.body, site, cg, tgt, depth=1)
+
+
+def _run_lifecycle(mod: ModuleInfo, fd: FuncDef, cg: CallGraph,
+                   em: _Emitter):
+    parents = _parent_map(fd.node)
+    acquires = _collect_acquires(fd, parents)
+    if not acquires:
+        return
+    trys = [n for n in _own_scope_nodes(fd.node)
+            if isinstance(n, ast.Try)]
+    # except-handler bodies run ONLY during unwinding — a release there
+    # is error-path protection, not the normal-path release. finally
+    # and orelse run on the normal path too.
+    unwind_nodes: Set[int] = set()
+    for tr in trys:
+        for h in tr.handlers:
+            for st in h.body:
+                for n in _stmt_scope(st):
+                    unwind_nodes.add(id(n))
+    for site in acquires:
+        if _escapes(fd, site, parents):
+            continue
+        # first matching release after the acquire, document order
+        rel_line = None
+        for node in _own_scope_nodes(fd.node):
+            if (isinstance(node, ast.Call) and node.lineno > site.line
+                    and id(node) not in unwind_nodes
+                    and _is_release_call(node, site)):
+                if rel_line is None or node.lineno < rel_line:
+                    rel_line = node.lineno
+        if rel_line is None and site.kind == "method" \
+                and not site.pair.flag_missing:
+            continue  # handle assumed transferred to whoever releases
+        end_line = rel_line if rel_line is not None else (
+            getattr(fd.node, "end_lineno", site.line + 10 ** 6))
+        risky = []
+        for node in _own_scope_nodes(fd.node):
+            if not isinstance(node, (ast.Call, ast.Await)):
+                continue
+            if not (site.line < node.lineno <= end_line):
+                continue
+            if id(node) in unwind_nodes:
+                continue
+            if isinstance(node, ast.Call) and (
+                    _is_release_call(node, site) or node is site.node):
+                continue
+            risky.append(node)
+        if not risky:
+            continue
+        what = (site.pair.what if site.kind == "method"
+                else site.pair[2] if site.kind == "frame"
+                else site.pair[2])
+        uncovered = None
+        for node in risky:
+            if _risky_covered(node, site, trys, parents, cg, fd):
+                continue
+            if isinstance(node, ast.Call) and _call_target_releases(
+                    node, site, cg, fd):
+                continue
+            uncovered = node
+            break
+        if uncovered is None:
+            continue
+        where = ("before the release at line %d" % rel_line
+                 if rel_line is not None
+                 else "and no matching release exists in this function")
+        em.emit(AcquireLeaksOnErrorPath, site.line,
+                f"{what} acquired here can leak: the fallible "
+                f"operation at line {uncovered.lineno} may raise "
+                f"{where}, with no finally/except that releases on "
+                f"the error path")
+
+
+# --------------------------------------------------- RTL162 (early unpin)
+
+_RELEASE_MARKER_NAMES = {"release", "rel", "on_release", "release_cb",
+                         "unpin"}
+
+
+def _release_marker_locals(fd: FuncDef) -> Dict[str, Set[str]]:
+    """{marker_name: sibling data names} from tuple unpacks and
+    parameters (``for data, release in parts:``)."""
+    out: Dict[str, Set[str]] = {}
+    args = fd.node.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        if a.arg in _RELEASE_MARKER_NAMES:
+            others = {x.arg for x in
+                      args.posonlyargs + args.args + args.kwonlyargs}
+            out[a.arg] = others - {a.arg, "self"}
+    for node in _own_scope_nodes(fd.node):
+        targets = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+        for t in targets:
+            if not isinstance(t, ast.Tuple):
+                continue
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+            for n in names:
+                if n in _RELEASE_MARKER_NAMES:
+                    out[n] = set(names) - {n}
+    return out
+
+
+def _fn_touches_attr(fd: FuncDef, attr: str) -> bool:
+    for node in ast.walk(fd.node):
+        if _self_attr(node) == attr:
+            return True
+    return False
+
+
+def _scan_unflushed(stmts, state: Set[str], markers, guarded,
+                    fd: FuncDef, cg: CallGraph, em: _Emitter) -> Set[str]:
+    """Abstract interpretation for RTL162: ``state`` = self-attrs of
+    coalescing buffers that may hold guarded data appended since the
+    last flush. Branch join = union (may-hold)."""
+    for st in stmts:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        if isinstance(st, ast.If):
+            s1 = _scan_unflushed(list(st.body), set(state), markers,
+                                 guarded, fd, cg, em)
+            s2 = _scan_unflushed(list(st.orelse), set(state), markers,
+                                 guarded, fd, cg, em)
+            state.clear()
+            state.update(s1 | s2)
+            continue
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            body = list(st.body) + list(st.orelse)
+            s = _scan_unflushed(body, set(state), markers, guarded, fd,
+                                cg, em)
+            s = _scan_unflushed(body, s, markers, guarded, fd, cg, em)
+            state.update(s)
+            continue
+        if isinstance(st, ast.Try):
+            for block in (st.body, st.orelse, st.finalbody):
+                state = _scan_unflushed(list(block), state, markers,
+                                        guarded, fd, cg, em)
+            for h in st.handlers:
+                state |= _scan_unflushed(list(h.body), set(state),
+                                         markers, guarded, fd, cg, em)
+            continue
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            state = _scan_unflushed(list(st.body), state, markers,
+                                    guarded, fd, cg, em)
+            continue
+        # simple statement: appends, flushes, marker invocations —
+        # processed in source order within the statement.
+        events = []
+        for node in _stmt_scope(st):
+            if isinstance(node, ast.Call):
+                events.append(node)
+        events.sort(key=lambda n: (n.lineno, n.col_offset))
+        for node in events:
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("append", "extend")
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in guarded):
+                a = _self_attr(f.value)
+                if a is not None:
+                    state.add(a)
+                continue
+            if isinstance(f, ast.Attribute) and f.attr == "clear":
+                a = _self_attr(f.value)
+                state.discard(a)
+                continue
+            if isinstance(f, ast.Name) and f.id in markers:
+                if state:
+                    buf = sorted(state)[0]
+                    em.emit(
+                        ReleaseMarkerBeforeFlush, node.lineno,
+                        f"release marker {f.id!r} invoked while "
+                        f"`self.{buf}` may still buffer data sliced "
+                        f"from the pinned source — flush `self.{buf}` "
+                        f"first or the arena can recycle the range "
+                        f"before the bytes are written (early-unpin "
+                        f"serve-buffer race)")
+                continue
+            # a call whose resolvable target touches a buffered attr =
+            # the flush helper (`self._flush_pending()`).
+            if state:
+                tgt = cg._resolve_target(fd, node)
+                if tgt is not None:
+                    for a in list(state):
+                        if _fn_touches_attr(tgt, a):
+                            state.discard(a)
+        # direct re-binds clear too: self._buf = []
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                a = _self_attr(t)
+                if a is not None:
+                    state.discard(a)
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                a = _self_attr_root(t)
+                if a is not None:
+                    state.discard(a)
+    return state
+
+
+def _run_early_release(mod: ModuleInfo, fd: FuncDef, cg: CallGraph,
+                       em: _Emitter):
+    markers = _release_marker_locals(fd)
+    if not markers:
+        return
+    guarded: Set[str] = set()
+    for siblings in markers.values():
+        guarded |= siblings
+    if not guarded:
+        return
+    _scan_unflushed(list(fd.node.body), set(), set(markers), guarded,
+                    fd, cg, em)
+
+
+# ------------------------------------------------------------- entry point
+
+def analyze_concurrency(index: ProjectIndex,
+                        rule_ids=None) -> List[Finding]:
+    """Run the RTL14x/15x/16x families over a project index.
+    ``rule_ids`` filters (None = all)."""
+    want = (set(rule_ids) if rule_ids is not None
+            else set(CONCURRENCY_RULE_IDS))
+    if not want & set(CONCURRENCY_RULE_IDS):
+        return []
+    cg = CallGraph(index)
+    findings: List[Finding] = []
+    emitters: Dict[str, _Emitter] = {}
+
+    for mod in index.modules.values():
+        for fd in mod.functions.values():
+            em = emitters.setdefault(fd.fid,
+                                     _Emitter(mod, want, findings))
+            if want & {"RTL141", "RTL142"}:
+                _run_atomicity(mod, fd, em)
+            if want & {"RTL161"}:
+                _run_lifecycle(mod, fd, cg, em)
+            if want & {"RTL162"}:
+                _run_early_release(mod, fd, cg, em)
+        if want & {"RTL151", "RTL152"}:
+            for cls in mod.classes.values():
+                _run_affinity(index, cg, mod, cls, emitters, want,
+                              findings)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def check_concurrency_paths(paths: Sequence[str],
+                            on_error=None) -> List[Finding]:
+    """CLI entry (``ray_tpu check --concurrency``): the three families
+    over a fresh project index of ``paths``."""
+    index = ProjectIndex.build(paths, on_error=on_error)
+    return analyze_concurrency(index)

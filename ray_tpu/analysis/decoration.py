@@ -67,20 +67,43 @@ def _aliases_from_globals(g: dict) -> Dict[str, str]:
     return out
 
 
+_DECO_MEMO: dict = {}
+_DECO_MEMO_CAP = 512
+
+
 def check_decorated(target) -> List[Finding]:
-    """Analyze one function/class about to become remote. Never raises."""
+    """Analyze one function/class about to become remote. Never raises.
+
+    Results are memoized per (file, mtime, size, start_line) — the
+    decoration-time half of the incremental scan cache: re-registering
+    remotes from an unchanged file (reloads, options() rebuilds, test
+    re-imports) costs a stat, not a re-analysis.
+    """
     try:
         source, start_line = inspect.getsourcelines(target)
-        tree_src = textwrap.dedent("".join(source))
         path = inspect.getsourcefile(target) or "<unknown>"
+        from .cache import file_sig
+
+        sig = file_sig(path) if path != "<unknown>" else None
+        key = (path, sig, start_line) if sig is not None else None
+        if key is not None:
+            hit = _DECO_MEMO.get(key)
+            if hit is not None:
+                return list(hit)
+        tree_src = textwrap.dedent("".join(source))
         g = getattr(target, "__globals__", None)
         if g is None:
             mod = sys.modules.get(getattr(target, "__module__", ""), None)
             g = getattr(mod, "__dict__", {})
-        return analyze_source(tree_src, path,
-                              seed_aliases=_aliases_from_globals(g),
-                              line_offset=start_line - 1,
-                              assume_remote_toplevel=True)
+        out = analyze_source(tree_src, path,
+                             seed_aliases=_aliases_from_globals(g),
+                             line_offset=start_line - 1,
+                             assume_remote_toplevel=True)
+        if key is not None:
+            if len(_DECO_MEMO) >= _DECO_MEMO_CAP:
+                _DECO_MEMO.clear()
+            _DECO_MEMO[key] = list(out)
+        return out
     except Exception:
         # (OSError: no source; SyntaxError: dedent edge cases; anything
         # else: a lint must never break @remote)

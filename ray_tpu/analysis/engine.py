@@ -80,6 +80,7 @@ def all_rules() -> List["Rule"]:
     from . import rules as _rules  # noqa: F401
     from . import rules_jax as _rules_jax  # noqa: F401
     from . import flow as _flow  # noqa: F401
+    from . import concurrency as _cc  # noqa: F401
     from . import protocol_check as _pc  # noqa: F401
     from . import failpoint_check as _fc  # noqa: F401
 
@@ -752,24 +753,50 @@ def analyze_source(source: str, path: str = "<string>",
 
 def _flow_pass(sources: Dict[str, str], rules: Optional[List[Rule]],
                line_offset: int = 0,
-               seed_imports: Optional[Dict[str, str]] = None
+               seed_imports: Optional[Dict[str, str]] = None,
+               sigs: Optional[Dict[str, Tuple[int, int]]] = None
                ) -> List[Finding]:
     """Run the RTL10x call-graph pass over ``{path: source}``.
 
     ``seed_imports``: decoration mode analyzes a bare snippet whose
     imports live in the target's ``__globals__`` — seed them under the
     module's own (empty) import map so ``ray_tpu.get`` still resolves.
+
+    ``sigs``: stat signatures captured by the caller at READ time. When
+    given, the module memo is keyed by them instead of a fresh stat —
+    statting here would key a module parsed from old content under a
+    signature an editor save produced after the read.
     """
+    from .cache import file_sig, memo_module, remember_module
+    from .concurrency import analyze_concurrency
     from .flow import analyze_flow
     from .project import ProjectIndex
 
     idx = ProjectIndex()
+    # snippet mode (decoration: offset/seeded imports) must not touch
+    # the stat-keyed module memo — the source is NOT the file content.
+    plain = not line_offset and not seed_imports
     for path, src in sources.items():
+        if not plain:
+            sig = None
+        elif sigs is not None:
+            sig = sigs.get(path)
+        else:
+            sig = file_sig(path)
+        mod = memo_module(path, sig) if plain else None
+        if mod is not None:
+            idx.modules[mod.modname] = mod
+            idx.by_path[path] = mod
+            continue
         mod = idx.add_source(path, src, line_offset=line_offset)
         if mod is not None and seed_imports:
             mod.imports = {**seed_imports, **mod.imports}
+        elif plain:
+            remember_module(path, sig, mod)
     rule_ids = None if rules is None else [r.id for r in rules]
-    return analyze_flow(idx, rule_ids)
+    out = analyze_flow(idx, rule_ids)
+    out.extend(analyze_concurrency(idx, rule_ids))
+    return out
 
 
 def analyze_file(path: str, rules: Optional[List[Rule]] = None,
@@ -805,24 +832,47 @@ def display_path(path: str) -> str:
 
 def analyze_paths(paths: Sequence[str],
                   rules: Optional[List[Rule]] = None,
-                  on_error=None) -> List[Finding]:
+                  on_error=None, cache=None) -> List[Finding]:
+    """``cache``: optional :class:`~.cache.ScanCache` — per-file walker
+    findings are served from it for stat-unchanged files. The project
+    passes (flow/concurrency) always recompute: their findings depend
+    on OTHER files' bodies, which the per-file stat can't witness."""
+    from .cache import file_sig
+
     rules = rules if rules is not None else all_rules()
     findings: List[Finding] = []
     sources: Dict[str, str] = {}
+    sigs: Dict[str, Tuple[int, int]] = {}
     for path in iter_python_files(paths):
         try:
+            # Stat BEFORE read (as ProjectIndex.build does): an edit
+            # landing in between re-scans next time instead of caching
+            # old-content findings under the new signature.
+            sig = file_sig(path)
             with open(path, "r", encoding="utf-8", errors="replace") as f:
                 source = f.read()
             dp = display_path(path)
+            sources[dp] = source
+            if sig is not None:
+                sigs[dp] = sig
+            if cache is not None:
+                hit = cache.get(dp, sig)
+                if hit is not None:
+                    findings.extend(hit)
+                    continue
             # per-file walker rules here; ONE project-wide flow pass
             # below over every parsed file, so call chains crossing
             # file boundaries resolve (the point of the RTL10x family).
-            findings.extend(analyze_source(source, dp, rules, flow=False))
-            sources[dp] = source
+            per_file = analyze_source(source, dp, rules, flow=False)
+            findings.extend(per_file)
+            if cache is not None:
+                cache.put(dp, sig, per_file)
         except (SyntaxError, ValueError, OSError) as e:
             if on_error is not None:
                 on_error(path, e)
-    findings.extend(_flow_pass(sources, rules))
+    findings.extend(_flow_pass(sources, rules, sigs=sigs))
+    if cache is not None:
+        cache.save()
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

@@ -144,7 +144,7 @@ class ModuleInfo:
                 self.imports[a.asname or a.name] = _norm(f"{mod}.{a.name}")
 
     def _collect_defs(self, node, prefix: str, class_name: Optional[str]):
-        for child in ast.iter_child_nodes(node):
+        for child in self._scope_children(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qual = f"{prefix}{child.name}"
                 fd = FuncDef(self, qual, child, class_name)
@@ -163,6 +163,22 @@ class ModuleInfo:
                 self.classes[child.name] = cd
                 self._collect_defs(child, prefix=f"{child.name}.",
                                    class_name=child.name)
+
+    @staticmethod
+    def _scope_children(node):
+        """Direct defs of a scope INCLUDING those nested under compound
+        statements (if/try/with/for) — a helper defined inside a try is
+        still this scope's function (the pre-v3 walk missed it, losing
+        its send sites and thread-entry bodies). Nested function/class
+        bodies stay their own scopes."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            child = stack.pop(0)
+            yield child
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(child))
 
     def _mark_deployments(self):
         """Flag serve-deployment classes: decorated ``@serve.deployment``
@@ -252,12 +268,26 @@ class ProjectIndex:
     @classmethod
     def build(cls, paths: Sequence[str],
               on_error=None) -> "ProjectIndex":
+        from .cache import file_sig, memo_module, remember_module
+
         idx = cls()
         for path in iter_python_files(paths):
+            dp = display_path(path)
+            sig = file_sig(path)
+            cached = memo_module(dp, sig)
+            if cached is not None:
+                # stat-keyed in-process memo: one parse + def-table
+                # build per (path, mtime, size) across every pass and
+                # index of this process. Shared object — passes treat
+                # ModuleInfo as read-only.
+                idx.modules[cached.modname] = cached
+                idx.by_path[dp] = cached
+                continue
             try:
                 with open(path, "r", encoding="utf-8",
                           errors="replace") as f:
-                    idx.add_source(display_path(path), f.read())
+                    mod = idx.add_source(dp, f.read())
+                remember_module(dp, sig, mod)
             except (SyntaxError, ValueError, OSError) as e:
                 idx.errors.append((path, e))
                 if on_error is not None:

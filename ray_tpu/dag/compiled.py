@@ -210,15 +210,25 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
         self._inflight.acquire()
-        with self._lock:
-            self._seq += 1
-            seq = self._seq
-        fut: SyncFuture = SyncFuture()
-        self._futures[seq] = fut
-        blob = serialization.serialize(value).to_bytes()
-        w = global_worker()
-        w.loop.call_soon_threadsafe(self._send_input, seq, blob)
-        return CompiledDAGRef(fut, self)
+        seq = None
+        # An unserializable input (or a closed loop) must hand the
+        # inflight slot back — leaking one per failed execute() would
+        # wedge the pipeline at max_inflight failures (RTL161).
+        try:
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            fut: SyncFuture = SyncFuture()
+            self._futures[seq] = fut
+            blob = serialization.serialize(value).to_bytes()
+            w = global_worker()
+            w.loop.call_soon_threadsafe(self._send_input, seq, blob)
+            return CompiledDAGRef(fut, self)
+        except BaseException:
+            if seq is not None:
+                self._futures.pop(seq, None)
+            self._inflight.release()
+            raise
 
     def _send_input(self, seq: int, blob: bytes):
         try:

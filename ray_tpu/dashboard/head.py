@@ -320,9 +320,12 @@ class DashboardActor:
         return f"http://127.0.0.1:{self._port}"
 
     async def stop(self):
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # Claim-then-await: two concurrent stop()s both passed the old
+        # `if self._runner is not None` check before either cleared it
+        # across the await — double cleanup() on one runner (RTL141).
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
 
 
 def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> str:

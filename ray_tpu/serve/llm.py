@@ -290,9 +290,13 @@ class LLMServer:
                 self.engine.invalidate_prefix_cache()
         if self._spec is not None:
             dparams, dcfg = self._draft_factory(params, self._cfg)
-            self._spec = (params, self._cfg, dparams, dcfg,
+            # Single-writer handoff: reconfigure calls are serialized by
+            # the serve controller, and the loop-side readers
+            # (_speculative, _admin) deref the tuple exactly once — they
+            # see the old or the new weights atomically, never a mix.
+            self._spec = (params, self._cfg, dparams, dcfg,  # raylint: disable=RTL151 (single-writer atomic tuple rebind; readers deref once)
                           self._spec[4])
-        self._weights_version += 1
+        self._weights_version += 1  # raylint: disable=RTL151 (single-writer counter — reconfigures are controller-serialized)
 
     async def _stream(self, body: dict):
         rid = self._submit(body)

@@ -296,7 +296,12 @@ class WorkerGroup:
         returned generation stamps every collective this group runs."""
         from ray_tpu._private.worker import global_worker
 
-        reply = global_worker().request_gcs(
+        # The formation wrap (__init__) runs _teardown_members ->
+        # _deregister_gang on ANY failure past this point, and
+        # driver-exit GC retires owned gangs as the backstop — the
+        # caller owns this error path, which the per-function pass
+        # cannot see.
+        reply = global_worker().request_gcs(  # raylint: disable=RTL161 (caller's formation wrap deregisters)
             {"t": "gang_register", "name": self.gang_name,
              "members": [w._id.binary() for w in self.workers]},
             timeout=30)
@@ -379,6 +384,12 @@ class WorkerGroup:
         self._collective_group = None
 
     def _teardown_members(self):
+        # Retire the gang record first: a formation failure AFTER
+        # registration succeeded used to strand it until driver-exit GC
+        # (RTL161). Harmless pre-registration — generation 0 never
+        # matches a live record.
+        if self.generation:
+            self._deregister_gang()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

@@ -1,0 +1,797 @@
+"""raylint v3 — RTL14x/15x/16x concurrency interleaving analysis.
+
+Positive + negative fixtures per rule, the four historical bug shapes
+re-detected on their pre-fix forms (early-unpin release race, phantom
+puller registration, stranded-arena seal failure, loop-affine mutation
+from a serve thread), the clean idioms (executor offload, lock on both
+sides, try/finally release, re-check after await, snapshot iteration),
+the incremental scan cache, `--changed` reverse-dependency scoping, and
+the committed-tree `--concurrency` gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import pytest
+
+import ray_tpu
+from ray_tpu.analysis import (ScanCache, StaticCheckWarning,
+                              analyze_concurrency, analyze_paths)
+from ray_tpu.analysis.changed import reverse_closure
+from ray_tpu.analysis.cli import main as check_main
+from ray_tpu.analysis.project import ProjectIndex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def conc(src: str, path: str = "t.py"):
+    """(rule, line) pairs from the concurrency families over one file."""
+    idx = ProjectIndex()
+    idx.add_source(path, textwrap.dedent(src))
+    return [(f.rule, f.line) for f in analyze_concurrency(idx)]
+
+
+def conc_rules(src: str):
+    return [r for r, _ in conc(src)]
+
+
+# ===================================================== RTL141 (atomicity)
+
+def test_rtl141_check_then_act_across_await_fires():
+    src = '''
+    class Pool:
+        async def get_conn(self, addr):
+            if addr not in self._conns:
+                conn = await connect(addr)
+                self._conns[addr] = conn
+            return self._conns[addr]
+    '''
+    assert ("RTL141", 6) in conc(src)
+
+
+def test_rtl141_write_in_awaiting_statement_fires():
+    # the await evaluates before the store lands: still split
+    src = '''
+    class Pool:
+        async def fill(self, k):
+            if k not in self._cache:
+                self._cache[k] = await fetch(k)
+    '''
+    assert conc_rules(src) == ["RTL141"]
+
+
+def test_rtl141_recheck_after_await_clean():
+    src = '''
+    class Pool:
+        async def get_conn(self, addr):
+            if addr not in self._conns:
+                conn = await connect(addr)
+                if addr not in self._conns:
+                    self._conns[addr] = conn
+            return self._conns[addr]
+    '''
+    assert "RTL141" not in conc_rules(src)
+
+
+def test_rtl141_async_lock_held_clean():
+    src = '''
+    class Pool:
+        async def get_conn(self, addr):
+            async with self._lock:
+                if addr not in self._conns:
+                    self._conns[addr] = await connect(addr)
+            return self._conns[addr]
+    '''
+    assert "RTL141" not in conc_rules(src)
+
+
+def test_rtl141_no_await_between_clean():
+    src = '''
+    class Pool:
+        async def track(self, k):
+            if k not in self._seen:
+                self._seen[k] = 1
+            await self.flush()
+    '''
+    assert "RTL141" not in conc_rules(src)
+
+
+def test_rtl141_different_key_clean():
+    src = '''
+    class Pool:
+        async def swap(self, a, b):
+            if a in self._slots:
+                v = await self.fetch(a)
+                self._slots[b] = v
+    '''
+    assert "RTL141" not in conc_rules(src)
+
+
+# ===================================================== RTL142 (iteration)
+
+def test_rtl142_mutation_while_iterating_across_await_fires():
+    src = '''
+    class Pool:
+        async def drain(self):
+            for k in self._conns:
+                await self._close(k)
+                self._conns.pop(k)
+    '''
+    assert ("RTL142", 6) in conc(src)
+
+
+def test_rtl142_snapshot_iteration_clean():
+    src = '''
+    class Pool:
+        async def drain(self):
+            for k in list(self._conns):
+                await self._close(k)
+                self._conns.pop(k)
+    '''
+    assert "RTL142" not in conc_rules(src)
+
+
+def test_rtl142_items_view_counts_as_live():
+    src = '''
+    class Pool:
+        async def drain(self):
+            for k, c in self._conns.items():
+                await c.close()
+                del self._conns[k]
+    '''
+    assert "RTL142" in conc_rules(src)
+
+
+def test_rtl142_read_only_loop_clean():
+    src = '''
+    class Pool:
+        async def ping_all(self):
+            for c in self._conns:
+                await c.ping()
+    '''
+    assert "RTL142" not in conc_rules(src)
+
+
+# ====================================================== RTL151 (affinity)
+
+def test_rtl151_regression_serve_thread_loop_affine_mutation_shape():
+    """Historical shape #4: the blocking-socket serve thread mutating
+    state the IO loop's coroutines read (the broadcast `_partials` /
+    fallocate-under-close-lock family) — pre-fix form."""
+    src = '''
+    import threading
+
+    class WorkerLike:
+        def __init__(self):
+            self._partials = {}
+            threading.Thread(target=self._serve_loop,
+                             daemon=True).start()
+
+        async def locate(self, oid):
+            return self._partials.get(oid)
+
+        def _serve_loop(self):
+            while True:
+                oid, engine = self._accept()
+                self._partials[oid] = engine
+    '''
+    assert any(r == "RTL151" for r, _ in conc(src))
+
+
+def test_rtl151_lock_on_both_sides_clean():
+    src = '''
+    import threading
+
+    class WorkerLike:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._partials = {}
+            threading.Thread(target=self._serve_loop).start()
+
+        async def locate(self, oid):
+            with self._lock:
+                return self._partials.get(oid)
+
+        def _serve_loop(self):
+            oid, engine = self._accept()
+            with self._lock:
+                self._partials[oid] = engine
+    '''
+    assert "RTL151" not in conc_rules(src)
+
+
+def test_rtl151_threadsafe_queue_clean():
+    src = '''
+    import queue
+    import threading
+
+    class WorkerLike:
+        def __init__(self):
+            self._q = queue.Queue()
+            threading.Thread(target=self._pump).start()
+
+        async def drain(self):
+            return self._q.get_nowait()
+
+        def _pump(self):
+            self._q.put(1)
+    '''
+    assert "RTL151" not in conc_rules(src)
+
+
+def test_rtl151_call_soon_threadsafe_marshal_clean():
+    src = '''
+    import threading
+
+    class WorkerLike:
+        def __init__(self):
+            self._partials = {}
+            threading.Thread(target=self._serve_loop).start()
+
+        async def locate(self, oid):
+            return self._partials.get(oid)
+
+        def _on_chunk(self, oid, engine):
+            self._partials[oid] = engine
+
+        def _serve_loop(self):
+            oid, engine = self._accept()
+            self.loop.call_soon_threadsafe(self._on_chunk, oid, engine)
+    '''
+    # _on_chunk is referenced (not called) from the thread — the
+    # marshalling idiom creates no thread-side mutation.
+    assert "RTL151" not in conc_rules(src)
+
+
+def test_rtl151_executor_submitted_helper_fires():
+    src = '''
+    class WorkerLike:
+        async def admin(self):
+            return self._stats
+
+        def handle(self):
+            self.pool.submit(self._work)
+
+        def _work(self):
+            self._stats["n"] = 1
+    '''
+    assert "RTL151" in conc_rules(src)
+
+
+# ====================================================== RTL152 (loop API)
+
+def test_rtl152_call_soon_and_create_task_from_thread_fire():
+    src = '''
+    import threading
+
+    class W:
+        def __init__(self):
+            threading.Thread(target=self._bg).start()
+
+        async def tick(self):
+            self._n = 1
+
+        def _bg(self):
+            self.loop.call_soon(self._wake)
+            self.loop.create_task(self._coro())
+    '''
+    rules = conc_rules(src)
+    assert rules.count("RTL152") == 2
+
+
+def test_rtl152_own_loop_in_thread_clean():
+    src = '''
+    import asyncio
+    import threading
+
+    class W:
+        def __init__(self):
+            threading.Thread(target=self._bg).start()
+
+        async def tick(self):
+            self._n = 1
+
+        def _bg(self):
+            loop = asyncio.new_event_loop()
+            loop.call_soon(self._wake)
+            loop.run_forever()
+    '''
+    assert "RTL152" not in conc_rules(src)
+
+
+def test_rtl152_threadsafe_spelling_clean():
+    src = '''
+    import threading
+
+    class W:
+        def __init__(self):
+            threading.Thread(target=self._bg).start()
+
+        async def tick(self):
+            self._n = 1
+
+        def _bg(self):
+            self.loop.call_soon_threadsafe(self._wake)
+    '''
+    assert "RTL152" not in conc_rules(src)
+
+
+# ==================================================== RTL161 (lifecycle)
+
+def test_rtl161_regression_stranded_arena_seal_failure_shape():
+    """Historical shape #3: create -> fallible write -> seal with no
+    abort on the error path (the pre-PR 7 put()/put_serialized form)."""
+    src = '''
+    class W:
+        def put(self, oid, sobj):
+            buf = self.store.create(oid, sobj.total_size)
+            sobj.write_into(buf)
+            self.store.seal(oid)
+    '''
+    assert ("RTL161", 4) in conc(src)
+
+
+def test_rtl161_abort_in_handler_clean():
+    src = '''
+    class W:
+        def put(self, oid, sobj):
+            buf = self.store.create(oid, sobj.total_size)
+            try:
+                sobj.write_into(buf)
+                self.store.seal(oid)
+            except BaseException:
+                self.store.abort(oid)
+                raise
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+def test_rtl161_regression_phantom_puller_registration_shape():
+    """Historical shape #2: obj_locate pull=1 registers this worker as
+    an active puller; create_in_store fails; nothing retires the
+    registration — the phantom npull (pre-fix `_pull_from_peers`)."""
+    src = '''
+    class W:
+        def _pull(self, oid, nbytes):
+            loc = self.request_gcs(
+                {"t": "obj_locate", "oid": oid, "pull": 1})
+            buf = self.create_in_store(oid, nbytes)
+            return self._stripe(loc, buf)
+    '''
+    assert ("RTL161", 4) in conc(src)
+
+
+def test_rtl161_puller_registration_retired_on_error_clean():
+    src = '''
+    class W:
+        def _stripe(self, loc, buf):
+            try:
+                return self._run(loc, buf)
+            finally:
+                self._send_gcs({"t": "obj_progress",
+                                "oid": loc["oid"], "done": True})
+
+        def _pull(self, oid, nbytes):
+            loc = self.request_gcs(
+                {"t": "obj_locate", "oid": oid, "pull": 1})
+            try:
+                buf = self.create_in_store(oid, nbytes)
+            except BaseException:
+                self._send_gcs({"t": "obj_progress", "oid": oid,
+                                "done": True, "ok": False})
+                raise
+            return self._stripe(loc, buf)
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+def test_rtl161_gang_register_without_deregister_fires():
+    src = '''
+    class WG:
+        def form(self):
+            self.gen = self.gcs({"t": "gang_register", "name": self.name})
+            self._spawn_workers()
+    '''
+    assert "RTL161" in conc_rules(src)
+
+
+def test_rtl161_gang_deregister_in_handler_clean():
+    src = '''
+    class WG:
+        def form(self):
+            self.gen = self.gcs({"t": "gang_register", "name": self.name})
+            try:
+                self._spawn_workers()
+            except Exception:
+                self.gcs({"t": "gang_deregister", "name": self.name})
+                raise
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+def test_rtl161_failpoints_armed_without_disarm_fires():
+    src = '''
+    from ray_tpu.util.chaos import clear_failpoints, set_failpoints
+
+    def bench():
+        set_failpoints("conn.send=once:drop", seed=7)
+        run_workload()
+    '''
+    assert "RTL161" in conc_rules(src)
+
+
+def test_rtl161_failpoints_try_finally_clean():
+    src = '''
+    from ray_tpu.util.chaos import clear_failpoints, set_failpoints
+
+    def bench():
+        set_failpoints("conn.send=once:drop", seed=7)
+        try:
+            run_workload()
+        finally:
+            clear_failpoints()
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+def test_rtl161_lock_try_finally_release_clean():
+    src = '''
+    class W:
+        def work(self):
+            self._lock.acquire()
+            try:
+                self.do_thing()
+            finally:
+                self._lock.release()
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+def test_rtl161_lock_release_not_exception_safe_fires():
+    src = '''
+    class W:
+        def work(self):
+            self._lock.acquire()
+            self.do_thing()
+            self._lock.release()
+    '''
+    assert "RTL161" in conc_rules(src)
+
+
+def test_rtl161_escape_via_return_clean():
+    src = '''
+    class W:
+        def create_in_store(self, oid, n):
+            return self.store.create(oid, n)
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+def test_rtl161_callee_owns_release_clean():
+    # the risky call's own body retires the registration: the callee
+    # owns its error path (post-fix `_pull_from_peers` split).
+    src = '''
+    class W:
+        def _stripe(self, oid):
+            try:
+                self._run(oid)
+            finally:
+                self._send_gcs({"t": "obj_progress", "oid": oid,
+                                "done": True})
+
+        def _pull(self, oid):
+            self.request_gcs({"t": "obj_locate", "oid": oid, "pull": 1})
+            self._stripe(oid)
+    '''
+    assert "RTL161" not in conc_rules(src)
+
+
+# ================================================== RTL162 (early unpin)
+
+_EARLY_UNPIN_PRE_FIX = '''
+class Conn:
+    async def _drain(self):
+        pass
+
+    def _flush_outbuf(self):
+        if self._outbuf:
+            self._sock.sendall(b"".join(self._outbuf))
+            self._outbuf.clear()
+
+    def _write_batch(self, parts):
+        for data, release in parts:
+            if len(data) < 4096:
+                self._outbuf.append(data)
+            else:
+                self._flush_outbuf()
+                self._sock.sendall(data)
+            if release is not None:
+                release()
+        self._flush_outbuf()
+'''
+
+
+def test_rtl162_regression_early_unpin_release_race_shape():
+    """Historical shape #1: `_transport_write_batch` ran the release
+    marker while the coalescing buffer still held a slice of the pinned
+    serve view — the arena recycled the range before the flush (PR 4
+    review fix). Pre-fix form."""
+    assert "RTL162" in conc_rules(_EARLY_UNPIN_PRE_FIX)
+
+
+def test_rtl162_flush_before_release_clean():
+    src = '''
+    class Conn:
+        def _flush_outbuf(self):
+            if self._outbuf:
+                self._sock.sendall(b"".join(self._outbuf))
+                self._outbuf.clear()
+
+        def _write_batch(self, parts):
+            for data, release in parts:
+                if len(data) < 4096:
+                    self._outbuf.append(data)
+                else:
+                    self._sock.sendall(data)
+                if release is not None:
+                    self._flush_outbuf()
+                    release()
+            self._flush_outbuf()
+    '''
+    assert "RTL162" not in conc_rules(src)
+
+
+def test_rtl162_no_release_marker_clean():
+    src = '''
+    class Conn:
+        def _write_batch(self, parts):
+            for data in parts:
+                self._outbuf.append(data)
+            self._flush()
+    '''
+    assert "RTL162" not in conc_rules(src)
+
+
+# ============================================== suppressions / delivery
+
+def test_concurrency_suppression_with_reason():
+    src = '''
+    class Pool:
+        async def get_conn(self, addr):
+            if addr not in self._conns:
+                conn = await connect(addr)
+                self._conns[addr] = conn  # raylint: disable=RTL141 (single-writer: only this coroutine fills the pool)
+            return self._conns[addr]
+    '''
+    assert "RTL141" not in conc_rules(src)
+
+
+def test_default_scan_includes_concurrency_families(tmp_path):
+    # the families ride the default analyze_paths flow pass, not only
+    # the --concurrency mode
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent('''
+        class Pool:
+            async def fill(self, k):
+                if k not in self._cache:
+                    self._cache[k] = await fetch(k)
+    '''))
+    findings = analyze_paths([str(tmp_path)])
+    assert any(f.rule == "RTL141" for f in findings)
+
+
+def test_concurrency_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent('''
+        class Pool:
+            async def drain(self):
+                for k in self._conns:
+                    await self._close(k)
+                    self._conns.pop(k)
+    '''))
+    ok = tmp_path / "ok.py"
+    ok.write_text("def fine():\n    return 1\n")
+    # RTL142 is an error -> exit 2
+    assert check_main([str(bad), "--concurrency"]) == 2
+    capsys.readouterr()
+    assert check_main([str(ok), "--concurrency"]) == 0
+
+
+def test_decoration_time_runs_concurrency_family(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_STATIC_CHECKS", "1")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+
+        @ray_tpu.remote
+        class DecoPool:
+            async def fill(self, k):
+                if k not in self._cache:
+                    self._cache[k] = await self.fetch(k)
+                return self._cache[k]
+
+            async def fetch(self, k):
+                return k
+
+    assert isinstance(DecoPool, ray_tpu.ActorClass)  # never hard-fails
+    msgs = [str(x.message) for x in w
+            if isinstance(x.message, StaticCheckWarning)]
+    assert any("RTL141" in m for m in msgs)
+
+
+# ===================================================== incremental cache
+
+def test_scan_cache_hit_and_invalidation(tmp_path):
+    target = tmp_path / "m.py"
+    target.write_text(textwrap.dedent('''
+        import ray_tpu
+
+        @ray_tpu.remote
+        def parent(refs):
+            return ray_tpu.get(refs)
+    '''))
+    cache_file = str(tmp_path / "cache.json")
+
+    cache = ScanCache(cache_file, rules_key="k1")
+    first = analyze_paths([str(target)], cache=cache)
+    assert any(f.rule == "RTL001" for f in first)
+    assert cache.misses == 1 and cache.hits == 0
+
+    # unchanged file: served from cache (findings identical)
+    cache2 = ScanCache(cache_file, rules_key="k1")
+    second = analyze_paths([str(target)], cache=cache2)
+    assert cache2.hits == 1 and cache2.misses == 0
+    assert [(f.rule, f.line) for f in first] == \
+        [(f.rule, f.line) for f in second]
+
+    # INVALIDATION: edit the file (content, size and mtime change) —
+    # the stale entry must not be served.
+    target.write_text(textwrap.dedent('''
+        import ray_tpu
+
+        @ray_tpu.remote
+        def parent(refs):
+            return refs
+    '''))
+    cache3 = ScanCache(cache_file, rules_key="k1")
+    third = analyze_paths([str(target)], cache=cache3)
+    assert cache3.misses == 1 and cache3.hits == 0
+    assert not any(f.rule == "RTL001" for f in third)
+
+
+def test_scan_cache_rules_key_mismatch_ignored(tmp_path):
+    target = tmp_path / "m.py"
+    target.write_text("x = 1\n")
+    cache_file = str(tmp_path / "cache.json")
+    cache = ScanCache(cache_file, rules_key="A")
+    analyze_paths([str(target)], cache=cache)
+    # a different rule selection must not reuse the entries
+    other = ScanCache(cache_file, rules_key="B")
+    analyze_paths([str(target)], cache=other)
+    assert other.hits == 0 and other.misses == 1
+
+
+def test_cross_file_findings_not_served_stale_from_cache(tmp_path):
+    """The cache covers per-file rules only: a CALLEE edit changes the
+    caller's flow finding on the very next cached scan."""
+    callee = tmp_path / "helper.py"
+    caller = tmp_path / "svc.py"
+    callee.write_text(textwrap.dedent('''
+        import ray_tpu
+
+        def fetch(ref):
+            return ray_tpu.get(ref)
+    '''))
+    caller.write_text(textwrap.dedent('''
+        import helper
+
+        class Svc:
+            async def run(self, ref):
+                return helper.fetch(ref)
+    '''))
+    cache_file = str(tmp_path / "cache.json")
+    first = analyze_paths([str(tmp_path)],
+                          cache=ScanCache(cache_file, rules_key="k"))
+    assert any(f.rule == "RTL101" and f.path.endswith("svc.py")
+               for f in first)
+    # fix the CALLEE only; the caller's file is stat-unchanged
+    callee.write_text(textwrap.dedent('''
+        import ray_tpu
+
+        def fetch(ref):
+            return ref
+    '''))
+    second = analyze_paths([str(tmp_path)],
+                           cache=ScanCache(cache_file, rules_key="k"))
+    assert not any(f.rule == "RTL101" for f in second)
+
+
+# ======================================================== --changed mode
+
+def test_reverse_closure_callee_edit_includes_callers(tmp_path):
+    idx = ProjectIndex()
+    idx.add_source("a.py", "def helper():\n    return 1\n")
+    idx.add_source("b.py", "import a\n\ndef use():\n    return a.helper()\n")
+    idx.add_source("c.py", "def unrelated():\n    return 2\n")
+    closure = reverse_closure(idx, {"a.py"})
+    assert "a.py" in closure and "b.py" in closure
+    assert "c.py" not in closure
+
+
+def _git(cwd, *argv):
+    subprocess.run(["git", *argv], cwd=cwd, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_mode_callee_edit_rescans_callers(tmp_path, monkeypatch,
+                                                  capsys):
+    """--changed with ONLY the callee edited still reports the caller's
+    cross-file finding (reverse-dependency closure), and an unrelated
+    edit does not."""
+    (tmp_path / "helper.py").write_text(textwrap.dedent('''
+        import ray_tpu
+
+        def fetch(ref):
+            return ray_tpu.get(ref)
+    '''))
+    (tmp_path / "svc.py").write_text(textwrap.dedent('''
+        import helper
+
+        class Svc:
+            async def run(self, ref):
+                return helper.fetch(ref)
+    '''))
+    (tmp_path / "other.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "base")
+    monkeypatch.chdir(tmp_path)
+
+    # edit ONLY the callee (keep the blocking op so the finding stays)
+    (tmp_path / "helper.py").write_text(textwrap.dedent('''
+        import ray_tpu
+
+        def fetch(ref):
+            # tweaked
+            return ray_tpu.get(ref)
+    '''))
+    rc = check_main([".", "--changed", "HEAD", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 2  # RTL101 is an error
+    assert any(f["rule"] == "RTL101" and f["path"] == "svc.py"
+               for f in data["findings"])
+
+    # commit, then edit only the unrelated file: the svc.py finding is
+    # outside the closure and must be filtered out
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "callee tweak")
+    (tmp_path / "other.py").write_text("x = 2\n")
+    rc = check_main([".", "--changed", "HEAD", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert data["findings"] == []
+
+
+# ============================================ committed-tree gate (tier-1)
+
+def test_concurrency_gate_on_committed_tree():
+    """`ray_tpu check --concurrency` must stay clean on ray_tpu/ —
+    every intentional interleaving pattern carries an inline suppression
+    with its reason; anything new is a finding to fix or justify."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--concurrency", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "concurrency interleaving drift:\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []

@@ -67,7 +67,7 @@ def test_bad_specs_rejected():
 
 
 def test_env_roundtrip_and_fire():
-    fp.set_failpoints("site.x=every2:drop", seed=9)
+    fp.set_failpoints("site.x=every2:drop", seed=9)  # raylint: disable=RTL161 (autouse _clean fixture disarms)
     assert os.environ[fp.ENV_SPEC] == "site.x=every2:drop"
     assert os.environ[fp.ENV_SEED] == "9"
     assert fp.active()
@@ -103,7 +103,7 @@ def test_clear_overrides_config_flag():
 
 
 def test_qualified_key_matches_before_bare_site():
-    fp.set_failpoints("conn.send.actor_call=once:drop;conn.send=once:drop",
+    fp.set_failpoints("conn.send.actor_call=once:drop;conn.send=once:drop",  # raylint: disable=RTL161 (autouse _clean fixture disarms)
                       seed=0)
     # actor_call traffic hits the qualified entry...
     assert fp.fire("conn.send", "actor_call") == "drop"
@@ -113,14 +113,14 @@ def test_qualified_key_matches_before_bare_site():
 
 
 def test_raise_action_is_connection_error():
-    fp.set_failpoints("s=once:raise", seed=0)
+    fp.set_failpoints("s=once:raise", seed=0)  # raylint: disable=RTL161 (autouse _clean fixture disarms)
     with pytest.raises(ConnectionError):
         fp.fire("s")
     assert issubclass(fp.FailpointError, ConnectionError)
 
 
 def test_journal_and_format():
-    fp.set_failpoints("a=every1:drop", seed=3)
+    fp.set_failpoints("a=every1:drop", seed=3)  # raylint: disable=RTL161 (autouse _clean fixture disarms)
     fp.reset_journal()
     fp.fire("a")
     fp.fire("a", "typed")
@@ -135,7 +135,7 @@ def test_journal_and_format():
 def test_delay_action_returns_and_sleeps_briefly():
     import time
 
-    fp.set_failpoints("d=once:delay:0.02", seed=0)
+    fp.set_failpoints("d=once:delay:0.02", seed=0)  # raylint: disable=RTL161 (autouse _clean fixture disarms)
     t0 = time.perf_counter()
     assert fp.fire("d") == "delay"
     assert time.perf_counter() - t0 >= 0.015
@@ -155,7 +155,7 @@ def test_connection_send_drop_and_short(ray_cluster):
 
     e = Echo.remote()
     assert ray_tpu.get(e.ping.remote(1), timeout=30) == 1
-    fp.set_failpoints("conn.send.actor_call=hit1:short", seed=1)
+    fp.set_failpoints("conn.send.actor_call=hit1:short", seed=1)  # raylint: disable=RTL161 (autouse _clean fixture disarms)
     try:
         out = ray_tpu.get([e.ping.remote(i) for i in range(6)], timeout=60)
         assert out == list(range(6))

@@ -554,7 +554,7 @@ def test_pull_registration_ordinals(bcast_cluster):
     w = global_worker()
     ref = ray_tpu.put(os.urandom(2 << 20))
     oid_b = ref.binary()
-    loc = w.request_gcs({"t": "obj_locate", "oid": oid_b, "pull": 1},
+    loc = w.request_gcs({"t": "obj_locate", "oid": oid_b, "pull": 1},  # raylint: disable=RTL161 (deliberate: the test IS the registration lifecycle, retired below)
                         timeout=10)
     assert loc.get("ok") and "pidx" in loc and loc["npull"] >= 1
     first = loc["pidx"]

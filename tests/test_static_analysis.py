@@ -444,7 +444,10 @@ def test_rule_table_covers_all_families():
                    + ["RTL101", "RTL102", "RTL103"]            # flow
                    + ["RTL111", "RTL112", "RTL113", "RTL114"]  # jax
                    + ["RTL121", "RTL122", "RTL123", "RTL124"]  # protocol
-                   + ["RTL131"])                               # failpoints
+                   + ["RTL131"]                                # failpoints
+                   + ["RTL141", "RTL142"]                      # atomicity
+                   + ["RTL151", "RTL152"]                      # affinity
+                   + ["RTL161", "RTL162"])                     # lifecycle
 
 
 # ------------------------------------- decoration-time (RAY_TPU_STATIC_CHECKS)
