@@ -640,10 +640,16 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         metrics = WORKLOADS[sched["workload"]](**sched.get("kwargs", {}))
         from ray_tpu._private.worker import global_worker
 
+        plane_events = None
         if ray_tpu.is_initialized():
             session = global_worker().session_name
             session_dir = global_worker().session_dir
-            invariants.check_cluster_invariants()
+            # check_cluster_invariants asserts the recorder end-state
+            # too (drop counters reported, table within retention);
+            # keep the final counters in the record so a run that
+            # SHED telemetry under fault load is visible in the JSON.
+            end_stats = invariants.check_cluster_invariants()
+            plane_events = end_stats.get("plane_events")
             if not keep_cluster:
                 ray_tpu.shutdown()
         if not keep_cluster:
@@ -654,7 +660,8 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         return {"name": sched["name"], "seed": sched["seed"],
                 "spec": sched["spec"], "fault": sched["fault"],
                 "ok": True, "wall_s": round(time.time() - t0, 2),
-                "metrics": metrics, "fired": fired}
+                "metrics": metrics, "fired": fired,
+                "plane_events": plane_events}
     except BaseException as e:
         # Repro ergonomics: a red run prints everything needed to rerun
         # it — the schedule name, seed, spec, and what actually fired.

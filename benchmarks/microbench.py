@@ -43,6 +43,12 @@ def main():
     parser.add_argument("--only", default="",
                         help="regex: time only matching rows (setup still "
                              "runs, so later rows keep their state)")
+    parser.add_argument("--recorder", choices=["on", "off"], default="on",
+                        help="plane-event flight recorder A/B arm: 'off' "
+                             "disables every emit site cluster-wide "
+                             "(plane_events=False via _system_config, "
+                             "inherited by workers) so two runs quantify "
+                             "the recorder's hot-path overhead")
     args = parser.parse_args()
     if args.only:
         import re
@@ -50,8 +56,9 @@ def main():
         _ONLY = re.compile(args.only)
     scale = 0.2 if args.quick else 1.0
 
-    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True)
-    results: dict = {}
+    ray_tpu.init(num_cpus=4, probe_tpu=False, ignore_reinit_error=True,
+                 _system_config={"plane_events": args.recorder == "on"})
+    results: dict = {"recorder": args.recorder}
 
     @ray_tpu.remote
     def tiny():

@@ -51,8 +51,17 @@ from typing import Callable, Dict, List, Optional
 
 import msgpack
 
+from ray_tpu.util import events as plane_events
+
 from . import failpoints
 from .protocol import _LEN, _SG_FLAG, MAX_FRAME, pack
+
+# Per-source in-flight queue-depth gauge (flight-recorder telemetry;
+# lazy + recorder-gated via events.gauge).
+_set_inflight = plane_events.gauge(
+    "bcast_inflight_chunks",
+    "in-flight chunk fetches per broadcast source", tag_keys=("src",))
+
 
 # ----------------------------------------------------------------- bitmaps
 
@@ -158,6 +167,8 @@ def serve_obj_fetch(conn, msg: dict, view, *, miss: bool = False,
         if stats is not None:
             stats["bcast_sg_chunks_served"] += 1
             stats["bcast_bytes_served"] += length
+        plane_events.emit("bcast.chunk.serve", plane="bcast",
+                          off=off, nbytes=length)
         try:
             conn.reply(msg, {"ok": True, "total": total, "off": off},
                        buffers=[part], release=view.close)
@@ -282,6 +293,8 @@ def _serve_conn_blocking(sock: socket.socket, resolve: Callable,
                     if stats is not None:
                         stats["bcast_sg_chunks_served"] += 1
                         stats["bcast_bytes_served"] += ln
+                    plane_events.emit("bcast.chunk.serve", plane="bcast",
+                                      off=off, nbytes=ln)
                 else:
                     chunk = bytes(view.data[off:off + ln]) if ln else b""
                     if stats is not None:
@@ -579,6 +592,7 @@ class StripedPull:
         # outstanding (relays not delivering: peers dead, no serve addrs)
         # so hold-back never wedges a pull.
         self.npull = max(1, int(npull))
+        self.pidx = pidx  # directory-assigned puller ordinal (events tag)
         self._relax = 0
         self._idle_nd = -1
         self._idle_t0 = _perf_counter()
@@ -712,11 +726,15 @@ class StripedPull:
                     continue
             src.cursor = (src.cursor + step + 1) % n
             self.claimed.add(i)
+            plane_events.emit("bcast.chunk.claim", plane="bcast",
+                              src=src.addr, idx=i, pidx=self.pidx)
             return i
         if fallback is not None:
             i, step = fallback
             src.cursor = (src.cursor + step + 1) % n
             self.claimed.add(i)
+            plane_events.emit("bcast.chunk.claim", plane="bcast",
+                              src=src.addr, idx=i, pidx=self.pidx)
             return i
         # Endgame steal: every remaining chunk is claimed by some OTHER
         # source — duplicate-fetch one of them rather than idle behind a
@@ -729,6 +747,8 @@ class StripedPull:
                     continue
                 if src.has is not None and not bitmap_test(src.has, i):
                     continue
+                plane_events.emit("bcast.chunk.steal", plane="bcast",
+                                  src=src.addr, idx=i, pidx=self.pidx)
                 return i
         return None
 
@@ -851,6 +871,7 @@ class StripedPull:
                     self.inflight += 1
                     inflight.append(idx)
                     src.pending = len(inflight)
+                    _set_inflight(src.pending, src=addr)
                     await client.send({
                         "t": "obj_fetch", "oid": self.oid_b, "off": off,
                         "len": ln, "nbytes": self.nbytes, "sg": 1,
@@ -867,6 +888,7 @@ class StripedPull:
                 idx = inflight.popleft()
                 self.inflight -= 1
                 src.pending = len(inflight)
+                _set_inflight(src.pending, src=addr)
                 off = idx * self.cs
                 want = min(self.cs, self.nbytes - off)
 
@@ -891,6 +913,10 @@ class StripedPull:
                              else 0.6 * src.avg_s + 0.4 * _dt)
                 if hdr.get("ok") and hdr.get("total") == self.nbytes:
                     if wrote == want:
+                        plane_events.emit(
+                            "bcast.chunk.done", plane="bcast", dur=_dt,
+                            src=addr, idx=idx, nbytes=want,
+                            pidx=self.pidx)
                         self._complete(idx, addr, want)
                         continue
                     data = hdr.get("data")  # legacy copy reply

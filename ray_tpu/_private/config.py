@@ -169,6 +169,25 @@ class RayTpuConfig:
     max_done_tasks: int = 10_000
     max_task_events: int = 50_000
     event_flush_interval_s: float = 0.5
+    # Plane-event flight recorder (util/events.py). ``plane_events``
+    # gates every emit site (the --recorder off A/B arm); the ring is
+    # per-process and bounded — overflow increments a ``dropped``
+    # counter, it never backpressures an emit site.
+    plane_events: bool = True
+    plane_event_ring: int = 65536
+    # GCS-side plane-event table bound (rows) + retention window: the
+    # maintenance sweep evicts rows older than the window, and the
+    # chaos end-state invariant asserts the table honors it.
+    max_plane_events: int = 100_000
+    plane_event_retention_s: float = 600.0
+    # Trace KV retention: spans flushed to ns="trace" used to accumulate
+    # forever; the same GCS maintenance sweep that owns the plane-event
+    # table bounds traces by age and count (oldest evicted first).
+    trace_retention_s: float = 600.0
+    trace_max_traces: int = 512
+    # Metrics flusher cadence (was a hard-coded 1.0s daemon sleep); the
+    # flusher also drains the driver-side plane-event ring each tick.
+    metrics_flush_interval_s: float = 1.0
     # ---- data
     data_memory_limit: int = 0      # 0 = auto (store capacity / 4)
 

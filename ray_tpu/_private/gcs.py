@@ -32,6 +32,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu.util import events as plane_events
+
 from . import failpoints, protocol
 from .broadcast import bitmap_make, bitmap_set, bitmap_test
 from .config import config as _cfg
@@ -633,6 +635,23 @@ class GcsServer:
         self._event_file = None
         self.max_done_tasks = _cfg().max_done_tasks
         self.task_events: deque = deque(maxlen=_cfg().max_task_events)
+        # Plane-event flight recorder table (util/events.py): bounded
+        # rows pushed from every process's ring (+ this process's own
+        # ring, ingested on the maintenance tick), per-plane drop
+        # accounting ACCUMULATED from pushed drain deltas, and a
+        # retention sweep (same tick as trace-KV retention below).
+        self.plane_events: deque = deque(maxlen=_cfg().max_plane_events)
+        self.plane_event_drops: Dict[str, int] = {}
+        self.plane_events_evicted = 0
+        # ns="trace" KV retention bookkeeping: trace_id -> last kv_put
+        # time, trace_id -> its KV keys (maintained incrementally at
+        # kv_put/kv_del so the sweep never scans the whole KV). Traces
+        # restored from a WAL/snapshot are adopted by a ONE-TIME scan on
+        # the first sweep and stamped "now" so they age out a full
+        # window later.
+        self._trace_touch: Dict[str, float] = {}
+        self._trace_keys: Dict[str, Set[tuple]] = {}
+        self._trace_adopted = False
         # (sender_key, name, tags_tuple) -> metric dict
         self.metrics: Dict[tuple, dict] = {}
         self.counters: Dict[str, float] = {
@@ -971,6 +990,10 @@ class GcsServer:
         if not client.bp_on:
             client.bp_on = True
             self.counters["backpressure_events"] += 1
+            plane_events.emit("gcs.admission.block", plane="gcs",
+                              tenant=client.namespace or "",
+                              role=client.role or "",
+                              queued=len(client.inq))
             try:
                 client.conn.send({"t": "backpressure", "on": 1,
                                   "queued": len(client.inq)})
@@ -1001,6 +1024,10 @@ class GcsServer:
                             self._disconnect_cleanup(client)
                     if client.bp_on and len(q) <= self._adm_low:
                         client.bp_on = False
+                        plane_events.emit("gcs.admission.unblock",
+                                          plane="gcs",
+                                          tenant=client.namespace or "",
+                                          queued=len(q))
                         if client.bp_event is not None:
                             client.bp_event.set()
                         if not client.conn.closed:
@@ -1015,6 +1042,10 @@ class GcsServer:
 
     async def _dispatch(self, client: ClientConn, msg: dict):
         t = msg.get("t")
+        if plane_events._enabled and t is not None:
+            # Per-frame plane: aggregate counter, never per-event rows
+            # (this path runs at the 160k frames/s ceiling).
+            plane_events.count("proto.dispatch.gcs", key=t)
         if t is None:
             # Empty/typeless frame (the undecodable-frame placeholder from
             # protocol's decode guard, or a buggy peer): skip explicitly
@@ -1422,6 +1453,12 @@ class GcsServer:
     async def _h_kv_put(self, client, msg):
         ns = msg.get("ns", "")
         self.kv[(ns, msg["k"])] = msg["v"]
+        if ns == "trace":
+            # Retention clock + key index for the trace sweep: a trace
+            # stays live as long as spans keep arriving for it.
+            tid = msg["k"].split(":", 1)[0]
+            self._trace_touch[tid] = time.time()
+            self._trace_keys.setdefault(tid, set()).add((ns, msg["k"]))
         self._log_append("kv", [ns, msg["k"], msg["v"]])
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
@@ -1456,6 +1493,11 @@ class GcsServer:
     async def _h_kv_del(self, client, msg):
         ns = msg.get("ns", "")
         self.kv.pop((ns, msg["k"]), None)
+        if ns == "trace":
+            tid = msg["k"].split(":", 1)[0]
+            keys = self._trace_keys.get(tid)
+            if keys is not None:
+                keys.discard((ns, msg["k"]))
         self._log_append("kvd", [ns, msg["k"]])
         if msg.get("i") is not None:
             client.conn.reply(msg, {"ok": True})
@@ -1562,10 +1604,14 @@ class GcsServer:
             if len(group.rows) >= group.need:
                 group.replied = True
                 rows, group.rows = group.rows, None
+                if group.need > 1:
+                    plane_events.emit("wait.group.threshold", plane="wait",
+                                      rows=len(rows), nr=group.need)
                 client.conn.reply(group.msg, {"ok": True, "rows": rows})
         else:
             buf = client.res_rows
             buf.append(row)
+            plane_events.count("wait.rows.stream", plane="wait")
             if len(buf) >= _cfg().obj_res_flush_rows:
                 self._flush_res_rows(client)
             elif len(buf) == 1:
@@ -1723,7 +1769,20 @@ class GcsServer:
         need = int(msg.get("nr") or len(seen))
         need = max(1, min(need, len(seen))) if seen else 0
         half = len(pending_entries) // 2
+        if len(seen) > 1:
+            plane_events.emit("wait.group.register", plane="wait",
+                              tenant=self._client_tenant(client) or "",
+                              oids=len(seen),
+                              pending=len(pending_entries), nr=need)
+        else:
+            # Single-oid groups are the worker per-arg lane (thousands/s
+            # under load): fold them into an aggregate counter instead
+            # of one ring row apiece.
+            plane_events.count("wait.group.single", plane="wait")
         if len(rows) >= need:
+            if need > 1:
+                plane_events.emit("wait.group.threshold", plane="wait",
+                                  rows=len(rows), nr=need)
             client.conn.reply(msg, {"ok": True, "rows": rows})
             if pending_entries:
                 group = WaitGroup(client, msg, need, rows)
@@ -2143,6 +2202,13 @@ class GcsServer:
         spawn_timeout = _cfg2().spawn_timeout_s
         while not self._shutdown_event.is_set():
             await asyncio.sleep(interval)
+            try:
+                # Maintenance rides the health tick: plane-event +
+                # trace-KV retention, and this process's own recorder
+                # ring folds into the table.
+                self._retention_sweep()
+            except Exception:
+                logger.exception("retention sweep failed")
             # Stale-spawn decay: a spawn_worker frame lost in flight (or
             # an agent that died mid-spawn without reporting) would pin
             # node.spawning and starve the lease plane of new workers
@@ -2455,6 +2521,10 @@ class GcsServer:
 
     def _release_lease(self, worker: WorkerInfo):
         ctx = worker.lease_ctx
+        plane_events.emit(
+            "lease.release.worker", plane="lease",
+            tenant=(getattr(ctx, "tenant", "") or "") if ctx else "",
+            wid=worker.worker_id.hex()[:16])
         if ctx is not None and self._tenant_quotas:
             # Covers normal grants AND post-restart re-claims: lease_claim
             # attaches a _ClaimedLeaseCtx so the usage it re-charged is
@@ -2688,6 +2758,11 @@ class GcsServer:
                     worker.leased_to = record.client
                     worker.lease_ctx = record
                     self._tenant_acquire(record.tenant, record.resources)
+                    plane_events.emit(
+                        "lease.grant.worker", plane="lease",
+                        tenant=record.tenant or "",
+                        wid=worker.worker_id.hex()[:16],
+                        node=node.node_id.hex()[:8])
                     record.client.conn.send({
                         "t": "lease_grant", "key": record.key,
                         "wid": worker.worker_id.binary(),
@@ -2801,6 +2876,9 @@ class GcsServer:
                 self._revoke_lease_for_rebalance(owners[serial], ws[0])
                 revoked = 1
         if revoked:
+            plane_events.emit("lease.rebalance.revoke", plane="lease",
+                              revoked=revoked, share=share,
+                              claimants=len(claimants))
             logger.debug("lease rebalance: revoked %d (share %d, "
                          "claimants %d)", revoked, share, len(claimants))
             self._wake_scheduler()
@@ -3995,6 +4073,92 @@ class GcsServer:
             "start": start, "end": end, "ok": bool(ok),
         }
 
+    async def _h_plane_events(self, client, msg):
+        """Plane-event rows pushed from a process's recorder ring
+        (util/events.py drain): stored raw + batch header, decoded only
+        when read (same stance as task_events). ``drops`` carries the
+        sender's per-plane drop DELTA since its last drain — accumulated
+        here so a ring overflow anywhere is visible cluster-wide."""
+        nid = bytes(msg.get("nid") or b"")
+        pid = msg.get("pid", 0)
+        for row in msg.get("ev") or []:
+            self.plane_events.append((nid, pid, row))
+        for plane, n in (msg.get("drops") or {}).items():
+            self.plane_event_drops[plane] = \
+                self.plane_event_drops.get(plane, 0) + int(n)
+
+    def _ingest_local_plane_events(self):
+        """Fold this process's OWN ring into the table (the GCS emits
+        lease/admission/wait events but has no worker to push through)."""
+        if not plane_events.enabled() or plane_events.pending() == 0:
+            return
+        rows, drops = plane_events.drain()
+        for row in rows:
+            self.plane_events.append((b"", os.getpid(), row))
+        for plane, n in drops.items():
+            self.plane_event_drops[plane] = \
+                self.plane_event_drops.get(plane, 0) + n
+
+    def _retention_sweep(self):
+        """Bounded-retention sweep, one owner for both stores: evict
+        plane-event rows older than ``plane_event_retention_s`` and
+        ns="trace" KV blobs older than ``trace_retention_s`` (or beyond
+        ``trace_max_traces``, oldest first). Runs on the health-check
+        tick; O(evicted + traces) per pass — the trace-key index is
+        maintained incrementally (kv_put/kv_del), never by scanning the
+        whole KV, except ONE adoption scan for WAL/snapshot-restored
+        entries on the first pass after startup."""
+        self._ingest_local_plane_events()
+        now = time.time()
+        horizon = now - _cfg().plane_event_retention_s
+        pe = self.plane_events
+        while pe and pe[0][2][0] < horizon:
+            pe.popleft()
+            self.plane_events_evicted += 1
+        # ---- trace KV (key = "<tid>:<pid>:..").
+        if not self._trace_adopted:
+            self._trace_adopted = True
+            for (ns, k) in self.kv:
+                if ns == "trace":
+                    self._trace_keys.setdefault(
+                        k.split(":", 1)[0], set()).add((ns, k))
+        if not self._trace_keys:
+            return
+        retention = _cfg().trace_retention_s
+        max_traces = _cfg().trace_max_traces
+        for tid in [t for t, ks in self._trace_keys.items() if not ks]:
+            del self._trace_keys[tid]  # every key individually deleted
+            self._trace_touch.pop(tid, None)
+        for tid in self._trace_keys:
+            self._trace_touch.setdefault(tid, now)
+        for tid in list(self._trace_touch):
+            if tid not in self._trace_keys:
+                del self._trace_touch[tid]
+        doomed = {tid for tid, ts in self._trace_touch.items()
+                  if now - ts > retention}
+        live = len(self._trace_keys) - len(doomed)
+        if live > max_traces:
+            survivors = sorted(
+                (tid for tid in self._trace_keys if tid not in doomed),
+                key=lambda t: self._trace_touch.get(t, now))
+            doomed.update(survivors[:live - max_traces])
+        for tid in doomed:
+            for key in self._trace_keys.pop(tid, ()):
+                if self.kv.pop(key, None) is not None:
+                    self._log_append("kvd", list(key))
+            self._trace_touch.pop(tid, None)
+
+    async def _h_clear_traces(self, client, msg):
+        """Driver API (``tracing.clear_traces()``): drop every span blob
+        in the trace namespace now, without waiting for retention."""
+        keys = [(ns, k) for (ns, k) in self.kv if ns == "trace"]
+        for key in keys:
+            del self.kv[key]
+            self._log_append("kvd", list(key))
+        self._trace_touch.clear()
+        self._trace_keys.clear()
+        client.conn.reply(msg, {"ok": True, "cleared": len(keys)})
+
     async def _h_metrics_push(self, client, msg):
         sender = (client.worker_id.hex() if client.worker_id
                   else str(id(client)))
@@ -4044,6 +4208,31 @@ class GcsServer:
         out.append({"name": "gcs_alive_actors", "tags": {}, "type": "gauge",
                     "value": float(sum(1 for a in self.actors.values()
                                        if a.state == A_ALIVE))})
+        # Queue-depth telemetry (the flight recorder's gauge face): GCS
+        # ingress-lane depth per role + total admission-blocked lanes,
+        # and the plane-event table's own health. Per-process series
+        # (broadcast in-flight, collective pending ops, per-tenant serve
+        # queues) arrive through metrics_push like any user metric.
+        lane_by_role: Dict[str, int] = {}
+        blocked = 0
+        for c in self.clients:
+            if c.conn is None or c.conn.closed:
+                continue
+            lane_by_role[c.role or "?"] = \
+                lane_by_role.get(c.role or "?", 0) + len(c.inq)
+            if c.bp_on:
+                blocked += 1
+        for role, depth in sorted(lane_by_role.items()):
+            out.append({"name": "gcs_lane_depth", "tags": {"role": role},
+                        "type": "gauge", "value": float(depth)})
+        out.append({"name": "gcs_admission_blocked_lanes", "tags": {},
+                    "type": "gauge", "value": float(blocked)})
+        out.append({"name": "plane_event_rows", "tags": {},
+                    "type": "gauge", "value": float(len(self.plane_events))})
+        for plane, n in sorted(self.plane_event_drops.items()):
+            out.append({"name": "plane_event_drops",
+                        "tags": {"plane": plane}, "type": "counter",
+                        "value": float(n)})
         client.conn.reply(msg, {"ok": True, "metrics": out})
 
     async def _h_autoscaler_state(self, client, msg):
@@ -4161,6 +4350,10 @@ class GcsServer:
                                           for nid in p.placement]})
         elif kind == "task_events":
             out = [self._event_to_dict(e) for e in self.task_events]
+        elif kind == "plane_events":
+            self._ingest_local_plane_events()
+            out = [plane_events.row_to_dict(row, nid.hex(), pid)
+                   for nid, pid, row in self.plane_events]
         else:
             client.conn.reply(msg, {"ok": False,
                                     "err": f"unknown kind {kind!r}"})
@@ -4213,6 +4406,18 @@ class GcsServer:
                                "world": len(g.members),
                                "lost": sorted(g.lost)}
                       for g in self.gangs.values()},
+            # Flight-recorder end-state surface (chaos invariants):
+            # drop counters are REPORTED (dict present even when all
+            # zero) and the oldest row's age proves the table honors
+            # its retention bound.
+            "plane_events": {
+                "rows": len(self.plane_events),
+                "drops": dict(self.plane_event_drops),
+                "evicted": self.plane_events_evicted,
+                "oldest_age_s": (time.time() - self.plane_events[0][2][0]
+                                 if self.plane_events else 0.0),
+                "retention_s": _cfg().plane_event_retention_s,
+            },
         })
 
     async def _h_cluster_info(self, client, msg):

@@ -931,9 +931,26 @@ class NodeAgent:
             self.stopped.set()
 
     async def _reap_loop(self):
+        from ray_tpu.util import events as plane_events
+
         while not self.stopped.is_set():
             for p in self.procs:
                 p.poll()
+            # Agent-side plane events (this process's chunk-serve
+            # threads emit bcast rows) flush on the reap tick — agents
+            # have no executor flush loop.
+            if plane_events.pending() and self.conn is not None \
+                    and not self.conn.closed:
+                rows, drops = plane_events.drain()
+                if rows or drops:
+                    try:
+                        self.conn.send({
+                            "t": "plane_events", "ev": rows,
+                            "drops": drops,
+                            "nid": self.node_id.binary(),
+                            "pid": os.getpid()})
+                    except ConnectionError:
+                        pass
             await asyncio.sleep(0.5)
 
     async def run_until_stopped(self):

@@ -42,6 +42,22 @@ import msgpack
 
 from . import failpoints
 
+# Plane-event recorder binding, resolved lazily: protocol is imported
+# while ray_tpu/__init__ is still executing (worker bootstrap), so a
+# module-level ``from ray_tpu.util import events`` would re-enter the
+# partially-initialized package. Bound on first use instead; until the
+# recorder module loads, the counter hook is a no-op.
+_plane_events = None
+
+
+def _events():
+    global _plane_events
+    if _plane_events is None:
+        import sys as _sys
+
+        _plane_events = _sys.modules.get("ray_tpu.util.events")
+    return _plane_events
+
 _LEN = struct.Struct("<I")
 _SG_FLAG = 0x8000_0000  # top bit of the length prefix: scatter-gather
 MAX_FRAME = 1 << 30
@@ -660,13 +676,22 @@ class Connection:
         if failpoints.active() and self._fp_outbound(msg, buffers,
                                                      release) is not None:
             return
+        ev = _events()
         if buffers:
             parts = pack_with_buffers(msg, buffers)
+            if ev is not None and ev._enabled:
+                ev.count("proto.send.frame", key=msg.get("t") or "",
+                         nbytes=len(parts[0]) + sum(len(b)
+                                                    for b in buffers))
             if release is not None:
                 parts.append(release)
             self._write_parts(parts)
         else:
-            self._write_frame(pack(msg))
+            data = pack(msg)
+            if ev is not None and ev._enabled:
+                ev.count("proto.send.frame", key=msg.get("t") or "",
+                         nbytes=len(data))
+            self._write_frame(data)
             if release is not None:
                 release()
 
@@ -692,10 +717,20 @@ class Connection:
             # (dropped frame) or fails via _mark_closed (disconnect/short)
             # — exactly what the caller's timeout/retry path must absorb.
             return fut
+        ev = _events()
         if buffers:
-            self._write_parts(pack_with_buffers(msg, buffers))
+            parts = pack_with_buffers(msg, buffers)
+            if ev is not None and ev._enabled:
+                ev.count("proto.send.frame", key=msg.get("t") or "",
+                         nbytes=len(parts[0]) + sum(len(b)
+                                                    for b in buffers))
+            self._write_parts(parts)
         else:
-            self._write_frame(pack(msg))
+            data = pack(msg)
+            if ev is not None and ev._enabled:
+                ev.count("proto.send.frame", key=msg.get("t") or "",
+                         nbytes=len(data))
+            self._write_frame(data)
         return fut
 
     async def request(self, msg: dict, timeout: Optional[float] = None) -> dict:

@@ -548,6 +548,19 @@ class Worker:
             # crash that loses this key's WAL append would otherwise
             # leave workers unable to unpickle driver-module functions.
             self.note_export("", "driver_sys_path", blob)
+            # Driver-side plane events (broadcast pulls, serve handles)
+            # flush on the metrics tick — start it with the session, not
+            # on first Metric creation (a driver may emit events without
+            # ever declaring a metric). Also restart it when metrics
+            # from a PREVIOUS session in this process exist: disconnect
+            # joins the flusher, and those Metric objects never re-call
+            # _ensure_flusher — without this, a reinit with the recorder
+            # disabled would silently stop flushing them.
+            from ray_tpu.util import events as _events
+            from ray_tpu.util import metrics as _metrics
+
+            if _events.enabled() or _metrics._registry:
+                _metrics._ensure_flusher()
         return hello
 
     def _run_loop(self):
@@ -735,6 +748,24 @@ class Worker:
     def disconnect(self):
         if self.closed:
             return
+        # Final metric/plane-event push + flusher stop BEFORE closing:
+        # flush_now() no-ops once ``closed`` is set, and the joined
+        # flusher thread is the no-leaked-thread shutdown posture.
+        import sys as _sys
+
+        _metrics = _sys.modules.get("ray_tpu.util.metrics")
+        _events = _sys.modules.get("ray_tpu.util.events")
+        for mod, fn in ((_metrics, "flush_now"), (_events, "flush_now")):
+            if mod is not None:
+                try:
+                    getattr(mod, fn)()
+                except Exception:
+                    pass
+        if _metrics is not None:
+            try:
+                _metrics.shutdown_flusher()
+            except Exception:
+                pass
         self.closed = True
         try:
             self.run_async(self._disconnect_async(), timeout=5)

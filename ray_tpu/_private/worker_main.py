@@ -30,6 +30,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu.util import events as plane_events
+
 from . import failpoints, protocol, serialization
 from .ids import ActorID, ObjectID, TaskID, WorkerID
 from .serialization import deserialize, pack_error, serialize
@@ -114,6 +116,19 @@ class Executor:
                 tracing.flush_to_kv(self.worker)
             except Exception:
                 pass
+        # Plane-event recorder rows ride the same coalesced cadence as
+        # task_events (ISSUE 14): one drain + one frame per tick.
+        if (plane_events.pending()
+                and self.worker.gcs and not self.worker.gcs.closed):
+            rows, drops = plane_events.drain()
+            if rows or drops:
+                try:
+                    self.worker.gcs.send({
+                        "t": "plane_events", "ev": rows, "drops": drops,
+                        "nid": self.worker.node_id or b"",
+                        "pid": os.getpid()})
+                except ConnectionError:
+                    pass
         if self.events and self.worker.gcs and not self.worker.gcs.closed:
             batch, self.events = self.events, []
             try:
@@ -136,6 +151,10 @@ class Executor:
 
     async def _on_direct_msg(self, conn: protocol.Connection, msg: dict):
         t = msg.get("t")
+        if t is not None and plane_events._enabled:
+            # Worker dispatch lane: aggregate counter (per-frame plane —
+            # this is the actor-call hot path).
+            plane_events.count("proto.dispatch.worker", key=t)
         if t is None:
             # Empty/typeless frame (undecodable-frame placeholder from
             # protocol.read_frame, or a malformed peer): skip explicitly —

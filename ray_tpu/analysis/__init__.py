@@ -15,6 +15,10 @@ analysis. Rule families:
   send-site ↔ handler-site contract drift across ``_private/``.
 - **RTL131** (``failpoint_check.py``, ``--failpoints``) — chaos
   schedule sites that resolve to no registered failpoint.
+- **RTL132** (``event_check.py``, ``--events``) — plane-event names
+  referenced by benchmarks/tests that resolve to no
+  ``events.emit()/count()`` literal (and malformed names at the emit
+  sites themselves).
 
 Delivery modes:
 
@@ -42,6 +46,7 @@ from .decoration import (StaticCheckWarning, check_decorated,
 from .project import ProjectIndex
 from .protocol_check import check_protocol, check_protocol_paths
 from .failpoint_check import check_failpoints, check_failpoint_paths
+from .event_check import check_events, check_event_paths
 from .concurrency import analyze_concurrency, check_concurrency_paths
 from .cache import ScanCache, file_sig
 from .changed import closure_for_paths, reverse_closure
@@ -53,6 +58,7 @@ __all__ = [
     "StaticCheckWarning", "check_decorated", "static_checks_enabled",
     "warn_on_decoration", "ProjectIndex", "check_protocol",
     "check_protocol_paths", "check_failpoints", "check_failpoint_paths",
+    "check_events", "check_event_paths",
     "analyze_concurrency", "check_concurrency_paths", "ScanCache",
     "file_sig", "closure_for_paths", "reverse_closure",
 ]

@@ -18,6 +18,9 @@ the same positional paths):
 - ``--failpoints``: the RTL131 chaos-schedule site cross-check
   (``failpoint_check.py``); schedule files default to
   ``benchmarks,tests`` via ``--schedules``.
+- ``--events``: the RTL132 plane-event name cross-check
+  (``event_check.py``); reference files default to
+  ``benchmarks,tests`` via ``--schedules``.
 - ``--concurrency``: ONLY the RTL14x/15x/16x interleaving families
   (``concurrency.py``) — they also run in the default scan; this mode
   is the focused committed-tree gate.
@@ -78,11 +81,19 @@ def add_arguments(parser: argparse.ArgumentParser):
                         "registered in the given paths")
     parser.add_argument("--schedules", default="benchmarks,tests",
                         metavar="PATHS", help="comma-separated paths "
-                        "holding chaos schedules for --failpoints "
+                        "holding chaos schedules for --failpoints and "
+                        "event-name references for --events "
                         "(default: benchmarks,tests; "
-                        "tests/test_failpoints.py is always excluded — "
-                        "its synthetic site names test the registry "
-                        "itself)")
+                        "tests/test_failpoints.py is always excluded "
+                        "from --failpoints — its synthetic site names "
+                        "test the registry itself)")
+    parser.add_argument("--events", action="store_true",
+                        help="run the RTL132 plane-event name cross-"
+                        "check instead of the per-file rules: every "
+                        "string in the reference paths (--schedules) "
+                        "matching the <plane>.<noun>.<verb> grammar "
+                        "must resolve to an events.emit()/count() "
+                        "literal registered in the given paths")
     parser.add_argument("--concurrency", action="store_true",
                         help="run ONLY the RTL14x/15x/16x concurrency "
                         "interleaving families (await-point atomicity, "
@@ -100,7 +111,8 @@ def add_arguments(parser: argparse.ArgumentParser):
                         default=None, metavar="FILE",
                         help="stat-keyed ((path, mtime, size)) per-file "
                         "findings cache for the DEFAULT scan "
-                        "(--protocol/--failpoints/--concurrency ignore "
+                        "(--protocol/--failpoints/--events/"
+                        "--concurrency ignore "
                         "it); cross-file findings are always recomputed "
                         "(default file: .raylint_cache.json)")
     return parser
@@ -132,7 +144,7 @@ def run_check(args) -> int:
 
     skipped: List[str] = []
     on_error = lambda p, e: skipped.append(f"{p}: {e}")  # noqa: E731
-    if args.protocol or args.failpoints or args.concurrency:
+    if args.protocol or args.failpoints or args.events or args.concurrency:
         # project-scope passes replace the per-file rules: they answer a
         # different question (cross-file contracts) over the same paths.
         findings = []
@@ -147,6 +159,12 @@ def run_check(args) -> int:
             sched = [s for s in args.schedules.split(",") if s]
             findings.extend(check_failpoint_paths(
                 args.paths, sched, on_error=on_error))
+        if args.events:
+            from .event_check import check_event_paths
+
+            refs = [s for s in args.schedules.split(",") if s]
+            findings.extend(check_event_paths(
+                args.paths, refs, on_error=on_error))
         if args.concurrency:
             from .concurrency import check_concurrency_paths
 

@@ -83,6 +83,7 @@ def all_rules() -> List["Rule"]:
     from . import concurrency as _cc  # noqa: F401
     from . import protocol_check as _pc  # noqa: F401
     from . import failpoint_check as _fc  # noqa: F401
+    from . import event_check as _ec  # noqa: F401
 
     return [cls() for cls in _RULE_CLASSES]
 

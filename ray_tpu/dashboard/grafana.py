@@ -23,9 +23,29 @@ _CORE_PANELS = [
     ("Pending tasks", "gcs_pending_tasks", "tasks"),
 ]
 
+# Plane-event flight-recorder panels (queue-depth telemetry, ISSUE 14):
+# each series flows through the ordinary metrics path — GCS-internal
+# gauges (lane depth, admission) are appended by metrics_get, per-process
+# gauges (broadcast in-flight, collective pending, per-tenant serve
+# queues) arrive via metrics_push. (title, expr, unit, legend).
+_PLANE_PANELS = [
+    ("GCS ingress lane depth", "gcs_lane_depth", "frames", "{{role}}"),
+    ("Admission-blocked lanes", "gcs_admission_blocked_lanes", "lanes",
+     "{{instance}}"),
+    ("Broadcast in-flight chunks", "bcast_inflight_chunks", "chunks",
+     "{{src}}"),
+    ("Collective pending ops", "collective_pending_ops", "ops",
+     "{{gang}}"),
+    ("Serve queue depth by tenant", "serve_tenant_queue_depth",
+     "requests", "{{tenant}}"),
+    ("Plane-event drops", "rate(plane_event_drops[1m])", "rows/s",
+     "{{plane}}"),
+]
+
 
 def _panel(panel_id: int, title: str, expr: str, unit: str,
-           x: int, y: int) -> Dict[str, Any]:
+           x: int, y: int,
+           legend: str = "{{instance}}") -> Dict[str, Any]:
     return {
         "id": panel_id,
         "title": title,
@@ -34,7 +54,7 @@ def _panel(panel_id: int, title: str, expr: str, unit: str,
         "datasource": {"type": "prometheus", "uid": "${datasource}"},
         "fieldConfig": {"defaults": {"unit": unit}},
         "targets": [{"expr": expr, "refId": "A",
-                     "legendFormat": "{{instance}}"}],
+                     "legendFormat": legend}],
     }
 
 
@@ -62,6 +82,19 @@ def generate_dashboard(extra_metrics: List[str] = None) -> Dict[str, Any]:
         pid += 1
         if i % 2 == 1:
             y += 8
+    for i, (title, expr, unit, legend) in enumerate(_PLANE_PANELS):
+        panels.append(_panel(pid, title, expr, unit,
+                             x=(i % 2) * 12, y=y, legend=legend))
+        pid += 1
+        if i % 2 == 1:
+            y += 8
+    # Plane-panel series also show up in the live registry once their
+    # planes run — don't duplicate them as auto-panels. Compare against
+    # the UNDERLYING metric name (an expr may wrap it in rate(...)).
+    plane_metrics = {expr[5:].split("[", 1)[0]
+                     if expr.startswith("rate(") else expr
+                     for _, expr, _, _ in _PLANE_PANELS}
+    names = [n for n in names if n not in plane_metrics]
     for i, name in enumerate(names):
         panels.append(_panel(pid, name, name, "short",
                              x=(i % 2) * 12, y=y))

@@ -74,16 +74,26 @@ class PodRunnerImpl(EnvRunnerImpl):
         the cooperative broadcast under test, and it only happens when
         the version actually changed."""
         from ray_tpu._private import failpoints
+        from ray_tpu.util import events as plane_events
 
         if failpoints.active():
             failpoints.fire("podracer.sample", f"r{self.rank}")
         version, ref = wbox
         if version != self._weights_version:
             # the pull IS the broadcast plane (chunk-striped, relayed)
+            t0 = time.time()
             self._params = ray_tpu.get(ref)  # raylint: disable=RTL001
+            plane_events.emit(
+                "rl.weights.pull", plane="rl", dur=time.time() - t0,
+                rank=self.rank, version=int(version),
+                staleness=int(version) - int(self._weights_version))
             self._weights_version = version
+        t0 = time.time()
         out = self._collect(self._params, num_steps)
         out["weights_version"] = int(version)
+        plane_events.emit("rl.rollout.push", plane="rl",
+                          dur=time.time() - t0, rank=self.rank,
+                          steps=int(num_steps), version=int(version))
         return out
 
 

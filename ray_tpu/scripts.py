@@ -172,8 +172,10 @@ def cmd_timeline(args):
     from ray_tpu.util import state
 
     _connect(_load_address(args.address))
-    events = state.timeline(args.output)
-    print(f"wrote {len(events)} events to {args.output}")
+    events = state.timeline(args.output, planes=args.planes)
+    lanes = {e["pid"] for e in events if "plane:" in str(e.get("pid"))}
+    extra = f" ({len(lanes)} plane lanes)" if args.planes else ""
+    print(f"wrote {len(events)} events to {args.output}{extra}")
 
 
 def cmd_metrics(args):
@@ -327,6 +329,11 @@ def main(argv=None):
     p = sub.add_parser("timeline", help="export Chrome trace of task events")
     p.add_argument("--address", default="")
     p.add_argument("-o", "--output", default="ray_tpu_timeline.json")
+    p.add_argument("--planes", action="store_true",
+                   help="merge the plane-event flight recorder into the "
+                        "trace: one lane per (node, plane) — broadcast/"
+                        "collective/serve/lease/wait/admission events on "
+                        "the same clock as the task plane")
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("metrics", help="dump Prometheus metrics")

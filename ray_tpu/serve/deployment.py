@@ -11,10 +11,81 @@ hop.
 
 from __future__ import annotations
 
+import asyncio
 import random
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util import events as plane_events
+
+# Per-tenant serve-queue depth (requests admitted to THIS replica and
+# not yet finished), keyed by the request body's "tenant" field — the
+# SLO telemetry the fleet item (ROADMAP #2) routes and sheds on.
+_tenant_gauge = plane_events.gauge(
+    "serve_tenant_queue_depth",
+    "in-flight serve requests per tenant on this replica",
+    tag_keys=("deployment", "tenant"))
+_tenant_depth: Dict[tuple, int] = {}
+
+
+def _note_tenant_queue(deployment: str, tenant: str, delta: int) -> None:
+    if not plane_events._enabled:
+        return
+    key = (deployment, tenant)
+    _tenant_depth[key] = max(0, _tenant_depth.get(key, 0) + delta)
+    _tenant_gauge(_tenant_depth[key],
+                  deployment=deployment, tenant=tenant)
+
+
+def _request_tenant(args: tuple) -> str:
+    """Tenant tag for a replica call: the "tenant" field of a dict
+    first arg — absent means the anonymous default tenant."""
+    if args and isinstance(args[0], dict):
+        return str(args[0].get("tenant") or "")
+    return ""
+
+
+def _stream_done(dep: str, tenant: str, method: str, ok: bool) -> None:
+    _note_tenant_queue(dep, tenant or "default", -1)
+    plane_events.emit("serve.req.done", plane="serve", tenant=tenant,
+                      deployment=dep, method=method, ok=ok, stream=1)
+
+
+async def _stream_lifetime_agen(gen, dep, tenant, method):
+    """Bracket an async generator's consumption: done fires (and the
+    tenant queue decrements) at exhaustion/close, not creation."""
+    ok = True
+    try:
+        async for item in gen:
+            yield item
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        _stream_done(dep, tenant, method, ok)
+
+
+def _stream_lifetime_gen(gen, dep, tenant, method):
+    ok = True
+    try:
+        for item in gen:
+            yield item
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        _stream_done(dep, tenant, method, ok)
+
+
+async def _stream_lifetime_coro(coro, dep, tenant, method):
+    ok = True
+    try:
+        return await coro
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        _stream_done(dep, tenant, method, ok)
 
 
 class DeploymentResponse:
@@ -149,8 +220,6 @@ class Replica:
 
     async def handle_request_async(self, method: str, args: tuple,
                                    kwargs: dict):
-        import asyncio
-
         model_id = kwargs.pop("_multiplexed_model_id", "")
         if model_id:
             from .multiplex import _set_multiplexed_model_id
@@ -161,22 +230,52 @@ class Replica:
             target = self.callable
         if target is None:
             raise AttributeError(f"deployment has no method {method!r}")
-        out = target(*args, **kwargs)
-        if asyncio.iscoroutine(out):
-            out = await out
+        # Serve-plane admit/done events + per-tenant queue depth.
+        tenant = _request_tenant(args)
+        ctx = _replica_context
+        dep = ctx.deployment if ctx is not None else ""
+        plane_events.emit("serve.req.admit", plane="serve",
+                          tenant=tenant, deployment=dep, method=method)
+        _note_tenant_queue(dep, tenant or "default", 1)
+        try:
+            out = target(*args, **kwargs)
+            if asyncio.iscoroutine(out):
+                out = await out
+        except BaseException:
+            _note_tenant_queue(dep, tenant or "default", -1)
+            plane_events.emit("serve.req.done", plane="serve",
+                              tenant=tenant, deployment=dep,
+                              method=method, ok=False)
+            raise
         import inspect
 
+        _note_tenant_queue(dep, tenant or "default", -1)
         if inspect.isgenerator(out) or inspect.isasyncgen(out):
             # Generators can't ride the unary reply; the ingress probes
             # with a unary call first (the fast batched actor-call path)
             # and falls back to the streaming channel on this marker.
+            # Only the PROBE is done here — the request's real lifetime
+            # is the streaming dispatch, which owns its own admit→done
+            # pair below (a probe-time "done" would zero the tenant
+            # queue gauge before a single token streamed).
+            plane_events.emit("serve.req.done", plane="serve",
+                              tenant=tenant, deployment=dep,
+                              method=method, ok=True, stream_handoff=1)
             return {"__serve_needs_stream__": True}
+        plane_events.emit("serve.req.done", plane="serve",
+                          tenant=tenant, deployment=dep,
+                          method=method, ok=True)
         return out
 
     def handle_request_stream(self, spec):
         """Streaming dispatch: returns whatever the user callable produces
         (generator / async generator / coroutine / value) — the worker's
-        stream_call executor drives it chunk by chunk."""
+        stream_call executor drives it chunk by chunk. The admit→done
+        pair here brackets the stream's REAL lifetime (wrapping the
+        generator to its exhaustion), so the per-tenant queue gauge
+        counts in-flight streams, not just unary calls."""
+        import inspect
+
         method, args, kwargs = spec
         model_id = kwargs.pop("_multiplexed_model_id", "")
         if model_id:
@@ -188,7 +287,25 @@ class Replica:
             target = self.callable
         if target is None:
             raise AttributeError(f"deployment has no method {method!r}")
-        return target(*args, **kwargs)
+        out = target(*args, **kwargs)
+        tenant = _request_tenant(args)
+        ctx = _replica_context
+        dep = ctx.deployment if ctx is not None else ""
+        plane_events.emit("serve.req.admit", plane="serve",
+                          tenant=tenant, deployment=dep, method=method,
+                          stream=1)
+        _note_tenant_queue(dep, tenant or "default", 1)
+        if inspect.isasyncgen(out):
+            return _stream_lifetime_agen(out, dep, tenant, method)
+        if inspect.isgenerator(out):
+            return _stream_lifetime_gen(out, dep, tenant, method)
+        if asyncio.iscoroutine(out):
+            return _stream_lifetime_coro(out, dep, tenant, method)
+        _note_tenant_queue(dep, tenant or "default", -1)
+        plane_events.emit("serve.req.done", plane="serve", tenant=tenant,
+                          deployment=dep, method=method, ok=True,
+                          stream=1)
+        return out
 
     def reconfigure(self, user_config):
         if hasattr(self.callable, "reconfigure"):

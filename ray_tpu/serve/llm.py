@@ -10,8 +10,11 @@ them, and concurrent requests share every decode step.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import Any, Dict, Optional
+
+from ray_tpu.util import events as plane_events
 
 # NB: `serve.deployment` the attribute shadows the submodule; import
 # the decorator from the module itself.
@@ -116,6 +119,11 @@ class LLMServer:
     def _submit(self, body: dict) -> str:
         rid = uuid.uuid4().hex
         self._queues[rid] = asyncio.Queue()
+        plane_events.emit("serve.req.queue", plane="serve",
+                          tenant=str(body.get("tenant") or ""),
+                          rid=rid[:8], prompt_len=len(body["prompt"]),
+                          weights_version=self._weights_version,
+                          queued=len(self._queues))
         try:
             self.engine.submit(rid, [int(t) for t in body["prompt"]],
                                max_new_tokens=int(
@@ -151,6 +159,8 @@ class LLMServer:
             return await self._speculative(body)
         if body.get("stream"):
             return self._stream(body)
+        t0 = time.time()
+        tenant = str(body.get("tenant") or "")
         rid = self._submit(body)
         q = self._queues[rid]
         toks = []
@@ -159,9 +169,19 @@ class LLMServer:
                 tok = await q.get()
                 if tok is None:
                     break
+                if not toks:
+                    plane_events.emit(
+                        "serve.req.first_token", plane="serve",
+                        tenant=tenant, rid=rid[:8],
+                        weights_version=self._weights_version,
+                        dur=time.time() - t0)
                 toks.append(tok)
         finally:
             self._queues.pop(rid, None)
+        plane_events.emit("serve.req.tokens_done", plane="serve",
+                          tenant=tenant, rid=rid[:8],
+                          weights_version=self._weights_version,
+                          tokens=len(toks), dur=time.time() - t0)
         return {"tokens": toks, "num_tokens": len(toks)}
 
     async def _speculative(self, body: dict):
@@ -299,13 +319,23 @@ class LLMServer:
         self._weights_version += 1  # raylint: disable=RTL151 (single-writer counter — reconfigures are controller-serialized)
 
     async def _stream(self, body: dict):
+        t0 = time.time()
         rid = self._submit(body)
         q = self._queues[rid]
+        first = True
         try:
             while True:
                 tok = await q.get()
                 if tok is None:
                     return
+                if first:
+                    first = False
+                    plane_events.emit(
+                        "serve.req.first_token", plane="serve",
+                        tenant=str(body.get("tenant") or ""),
+                        rid=rid[:8],
+                        weights_version=self._weights_version,
+                        dur=time.time() - t0)
                 yield tok
         finally:
             self._queues.pop(rid, None)

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.util import events as plane_events
 
 
 class ReduceOp:
@@ -120,6 +121,13 @@ _REDUCERS = {
 }
 
 
+# Pending-rendezvous-ops queue-depth gauge (flight-recorder telemetry;
+# lazy + recorder-gated via events.gauge).
+_set_pending_ops = plane_events.gauge(
+    "collective_pending_ops", "rendezvous ops awaiting contributions",
+    tag_keys=("gang",))
+
+
 class _Coordinator:
     """Per-group rendezvous actor (async). One instance per group name.
 
@@ -204,6 +212,10 @@ class _Coordinator:
         are all lost (a rank that died after contributing but before
         pickup would otherwise strand its (kind, seq) entry forever —
         the last-rank-out cleanup can no longer fire)."""
+        plane_events.emit("coll.op.member_lost", plane="coll",
+                          gang=self.gang or "", gen=self.generation,
+                          ranks=[int(r) for r in ranks], cause=cause,
+                          pending=len(self._ops))
         for r in ranks:
             self._lost.setdefault(int(r), cause)
         lost = set(self._lost)
@@ -214,6 +226,7 @@ class _Coordinator:
                 self._ops.pop(key, None)
             elif st["expect"] - st.setdefault("taken", set()) <= lost:
                 self._ops.pop(key, None)
+        self._pending_gauge()
 
     def _check(self, generation: Optional[int]):
         if generation is not None and generation != self.generation:
@@ -247,11 +260,21 @@ class _Coordinator:
         st = self._ops.get(key)
         if st is None:
             st = {"parts": {}, "event": asyncio.Event(), "result": None,
-                  "error": None,
+                  "error": None, "t0": time.time(),
                   "expect": (set(expect) if expect is not None
                              else set(range(self.world)))}
             self._ops[key] = st
+            plane_events.emit("coll.op.begin", plane="coll", kind=kind,
+                              seq=seq, gang=self.gang or "",
+                              gen=self.generation,
+                              pending=len(self._ops))
+            self._pending_gauge()
         return st
+
+    def _pending_gauge(self):
+        """Queue-depth telemetry: pending rendezvous ops on this
+        coordinator (flows through the ordinary metrics push)."""
+        _set_pending_ops(len(self._ops), gang=self.gang or "anon")
 
     async def collect(self, kind: str, seq: int, rank: int, data: Any,
                       op: str = "sum", src_rank: int = 0,
@@ -269,6 +292,10 @@ class _Coordinator:
         self._check(generation)
         st = self._get(kind, seq)
         st["parts"][rank] = data
+        plane_events.emit("coll.op.contribute", plane="coll", kind=kind,
+                          seq=seq, rank=rank, gang=self.gang or "",
+                          gen=self.generation,
+                          have=len(st["parts"]), world=self.world)
         if len(st["parts"]) == self.world:
             parts = [st["parts"][r] for r in range(self.world)]
             if kind == "allreduce":
@@ -291,6 +318,10 @@ class _Coordinator:
                     st["result"] = arr
             elif kind == "barrier":
                 st["result"] = True
+            plane_events.emit("coll.op.complete", plane="coll",
+                              kind=kind, seq=seq, gang=self.gang or "",
+                              gen=self.generation,
+                              dur=time.time() - st.get("t0", time.time()))
             st["event"].set()
         else:
             try:
@@ -311,6 +342,7 @@ class _Coordinator:
         st.setdefault("taken", set()).add(rank)
         if st["expect"] - st["taken"] <= set(self._lost):
             self._ops.pop((kind, seq), None)
+            self._pending_gauge()
         if kind == "reducescatter":
             return result[rank]
         return result

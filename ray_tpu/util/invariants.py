@@ -84,6 +84,23 @@ def check_cluster_invariants(*, baseline_refs: Optional[int] = None,
                 # exit GCs the rest): a surviving record is a leaked
                 # gang — its channel keeps publishing into the void.
                 problems.append(f"gang records not retired: {gangs}")
+            pe = stats.get("plane_events")
+            if pe is None or "drops" not in pe:
+                # The flight recorder's end-state surface is part of the
+                # contract: drop counters must be REPORTED (present even
+                # when all-zero) so a chaos run can't silently lose the
+                # overflow signal.
+                problems.append("plane_events stats missing from "
+                                "gcs_stats (drop counters unreported)")
+            elif pe["oldest_age_s"] > pe["retention_s"] + 30.0:
+                # Slack: the retention sweep rides the GCS health tick
+                # (health_check_interval_s, default 5s) — one missed
+                # tick is fine, a table aging far past its window means
+                # the sweep is dead.
+                problems.append(
+                    f"plane-event table beyond retention: oldest row "
+                    f"{pe['oldest_age_s']:.1f}s old vs "
+                    f"{pe['retention_s']:.0f}s window")
             stuck = [wk for wk in state.list_workers()
                      if wk.get("state") == "busy"]
             if stuck:

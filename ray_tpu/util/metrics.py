@@ -12,34 +12,59 @@ Prometheus text (``ray_tpu.util.state.prometheus_metrics``).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
-_flusher_started = False
+_flusher_thread: "threading.Thread | None" = None
+_flusher_stop = threading.Event()
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0]
 
 
 def _ensure_flusher():
-    global _flusher_started
-    if _flusher_started:
-        return
-    _flusher_started = True
-    t = threading.Thread(target=_flush_loop, name="ray_tpu-metrics",
-                         daemon=True)
-    t.start()
+    """Start (or restart after a shutdown) the daemon flusher. The
+    stop event makes the thread joinable at worker shutdown — the
+    invariants core's no-leaked-thread posture for metric-using tests;
+    a later ``init()`` in the same process restarts it here."""
+    global _flusher_thread
+    with _registry_lock:
+        if _flusher_thread is not None and _flusher_thread.is_alive():
+            return
+        _flusher_stop.clear()
+        _flusher_thread = threading.Thread(
+            target=_flush_loop, name="ray_tpu-metrics", daemon=True)
+        _flusher_thread.start()
 
 
 def _flush_loop():
-    while True:
-        time.sleep(1.0)
+    from ray_tpu._private.config import config as _cfg
+
+    from . import events as _events
+
+    while not _flusher_stop.wait(
+            max(0.05, _cfg().metrics_flush_interval_s)):
         try:
             flush_now()
+            # Driver-side plane events ride the same tick (workers have
+            # their own coalesced task_events loop; this covers driver
+            # and standalone processes).
+            _events.flush_now()
         except Exception:
             pass
+
+
+def shutdown_flusher(timeout: float = 2.0):
+    """Stop and join the flusher (worker shutdown hook). Idempotent;
+    safe when the flusher never started."""
+    global _flusher_thread
+    with _registry_lock:
+        t, _flusher_thread = _flusher_thread, None
+    if t is None or not t.is_alive():
+        return
+    _flusher_stop.set()
+    t.join(timeout=timeout)
 
 
 def flush_now():

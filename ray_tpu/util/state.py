@@ -50,6 +50,15 @@ def list_task_events(limit: int = 50000) -> List[dict]:
     return _list("task_events", limit)
 
 
+def list_plane_events(limit: int = 100000) -> List[dict]:
+    """Flight-recorder rows from the GCS plane-event table
+    (``ray_tpu.util.events``): tenant-/plane-tagged events from every
+    plane boundary, on one clock. Flush cadence: workers push on the
+    0.5s task_events tick, drivers on the metrics tick — recent emits
+    may need a moment to land."""
+    return _list("plane_events", limit)
+
+
 def list_cluster_events(limit: int = 1000) -> List[dict]:
     """Structured export events (node/actor lifecycle transitions) — the
     reference's RayEvent export stream (``util/event.h:246``); also
@@ -86,10 +95,18 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return out
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
+def timeline(filename: Optional[str] = None,
+             planes: bool = False) -> List[dict]:
     """Export task execution events as a Chrome trace (``chrome://tracing`` /
     Perfetto). Reference: ``ray timeline`` CLI → Chrome-trace from
     GcsTaskManager events (``python/ray/scripts/scripts.py:1934``).
+
+    ``planes=True`` merges the plane-event flight recorder into the same
+    trace — one lane per (node, plane), all planes on ONE clock, so
+    Perfetto shows e.g. broadcast chunk traffic interleaved with the
+    actor tasks it competes with. Rows carrying a trace id (emitted
+    under ``RAY_TPU_TRACE``) surface it in ``args`` for span
+    cross-linking.
     """
     events = list_task_events()
     trace = []
@@ -108,6 +125,31 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
             "args": {"task_id": ev.get("task_id", ""),
                      "ok": ev.get("ok", True)},
         })
+    if planes:
+        for ev in list_plane_events():
+            args = dict(ev.get("fields") or {})
+            if ev.get("tenant"):
+                args["tenant"] = ev["tenant"]
+            if ev.get("trace_id"):
+                args["trace_id"] = ev["trace_id"]
+            dur = ev.get("dur") or 0.0
+            row = {
+                "name": ev.get("name", ""),
+                "cat": ev.get("plane", "plane"),
+                # Durationed rows span their wall time (the emit stamps
+                # the END of the operation); zero-dur rows are instants.
+                "ph": "X" if dur else "i",
+                "ts": (ev["ts"] - dur) * 1e6,
+                "pid": f"node:{ev.get('node_id', '')[:8]} "
+                       f"plane:{ev.get('plane', '')}",
+                "tid": f"pid:{ev.get('pid', 0)}",
+                "args": args,
+            }
+            if dur:
+                row["dur"] = dur * 1e6
+            else:
+                row["s"] = "t"  # instant scope: thread
+            trace.append(row)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -253,6 +253,19 @@ def flush_to_kv(worker=None):
     return len(batch)
 
 
+def clear_traces() -> int:
+    """Drop every span blob in the GCS trace namespace now (driver API;
+    retention — ``trace_retention_s`` / ``trace_max_traces`` — bounds
+    them anyway, this is the explicit reset between experiments).
+    Returns how many KV blobs were cleared."""
+    from ray_tpu._private.worker import global_worker
+
+    with _buffer_lock:
+        _buffer.clear()  # don't resurrect local spans on the next flush
+    reply = global_worker().request_gcs({"t": "clear_traces"}, timeout=10)
+    return int(reply.get("cleared", 0))
+
+
 def get_trace(trace_id: str) -> List[dict]:
     """All spans of a trace, sorted by start time (driver-side query)."""
     from ray_tpu._private.worker import global_worker

@@ -1,0 +1,403 @@
+"""Plane-event flight recorder (ISSUE 14, ``ray_tpu/util/events.py``).
+
+Tier-1 coverage for the cross-plane telemetry substrate: the bounded
+ring (overflow drops + never blocks), the hot-path aggregate counters,
+the Chrome-trace export with per-(node, plane) lanes and span
+cross-links, the per-tenant serve-queue gauge series, the metrics
+flusher's stop/join lifecycle, and the GCS-side retention sweep that
+bounds both the plane-event table and the ``ns="trace"`` span KV.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import events, state
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    """Each test starts with an empty per-process ring/drop table."""
+    events.reset()
+    yield
+    events.reset()
+
+
+# ------------------------------------------------------- unit: the ring
+
+
+def test_ring_overflow_increments_dropped_and_never_blocks():
+    cap = events._cap
+    events._cap = 64
+    try:
+        for i in range(200):
+            events.emit("bcast.chunk.claim", plane="bcast", idx=i)
+        assert events.pending() == 64
+        assert events.dropped_counts() == {"bcast": 136}
+        # A full ring must stay non-blocking: emits are dropped in
+        # constant time, never queued or retried.
+        t0 = time.perf_counter()
+        for i in range(1000):
+            events.emit("bcast.chunk.claim", plane="bcast", idx=i)
+        assert time.perf_counter() - t0 < 0.5
+        assert events.dropped_counts() == {"bcast": 1136}
+        rows, drops = events.drain()
+        assert len(rows) == 64 and drops == {"bcast": 1136}
+        # drain resets the drop counters (the GCS accumulates deltas)
+        assert events.dropped_counts() == {}
+    finally:
+        events._cap = cap
+
+
+def test_count_folds_hot_path_into_one_row():
+    for _ in range(500):
+        events.count("proto.send.frame", key="actor_call", nbytes=100)
+    events.count("proto.send.frame", key="ping", nbytes=7)
+    assert events.pending() == 2  # two (name, key) aggregates, not 501
+    rows, _ = events.drain()
+    agg = {r[6]["key"]: r[6] for r in rows}
+    assert agg["actor_call"]["n"] == 500
+    assert agg["actor_call"]["bytes"] == 50_000
+    assert agg["ping"]["n"] == 1 and agg["ping"]["bytes"] == 7
+    assert all(r[6]["agg"] == 1 for r in rows)
+
+
+def test_disabled_recorder_is_a_noop():
+    events._enabled = False
+    try:
+        events.emit("bcast.chunk.claim", plane="bcast")
+        events.count("proto.send.frame", key="x")
+        assert events.pending() == 0
+        assert events.flush_now() == 0
+    finally:
+        events._enabled = True
+
+
+def test_emit_carries_ambient_trace_id():
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    try:
+        with tracing.span("pull") as (tid, _sid):
+            events.emit("bcast.chunk.claim", plane="bcast", idx=1)
+    finally:
+        tracing.disable_tracing()
+    events.emit("bcast.chunk.claim", plane="bcast", idx=2)  # no ctx
+    rows, _ = events.drain()
+    assert rows[0][4] == tid  # cross-link: row carries the span's trace
+    assert rows[1][4] == ""
+
+
+# ------------------------------ integration: 2-plane run, one timeline
+
+
+def _pull_with_recorder(nbytes=1 << 20, cs=128 * 1024):
+    """A real StripedPull against an in-process framed holder — the
+    same engine the runtime uses, emitting bcast.chunk.* rows from the
+    claim/serve/done sites."""
+    from ray_tpu._private import broadcast, protocol
+
+    blob = bytearray(os.urandom(nbytes))
+
+    async def main():
+        async def on_client(reader, writer):
+            conn = protocol.Connection(reader, writer)
+            protocol.widen_for_serving(conn)
+
+            async def handler(msg, conn=conn):
+                if msg.get("t") == "obj_fetch":
+                    broadcast.serve_obj_fetch(
+                        conn, msg, broadcast.ServeView(memoryview(blob)))
+
+            conn._handler = handler
+            conn.start()
+
+        server = await protocol.serve("127.0.0.1:0", on_client)
+        addr = "127.0.0.1:%d" % server.sockets[0].getsockname()[1]
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=cs,
+            window=4, chunk_timeout_s=20)
+        ok = await asyncio.wait_for(eng.run({"addrs": [addr]}), 60)
+        server.close()
+        return ok, dst
+
+    ok, dst = asyncio.run(main())
+    assert ok and dst == blob
+
+
+def test_timeline_merges_task_and_broadcast_lanes(tmp_path):
+    """The acceptance shape in miniature: broadcast chunk traffic
+    concurrent with actor calls exports as ONE Chrome trace with a lane
+    per (node, plane) — both planes on one clock, zero drops."""
+    ray_tpu.init(num_cpus=2, probe_tpu=False)
+    try:
+        @ray_tpu.remote
+        def work(i):
+            return i + 1
+
+        refs = [work.remote(i) for i in range(8)]
+        _pull_with_recorder()  # bcast plane, driver-side ring
+        assert ray_tpu.get(refs) == list(range(1, 9))
+        assert events.dropped_counts() == {}  # bench-rate ⇒ zero drops
+        events.flush_now()
+
+        out = str(tmp_path / "trace.json")
+        deadline = time.time() + 10
+        while True:
+            trace = state.timeline(out, planes=True)
+            cats = {e.get("cat") for e in trace}
+            if "bcast" in cats and any(e.get("name") == "work"
+                                       for e in trace):
+                break
+            assert time.time() < deadline, f"lanes never merged: {cats}"
+            time.sleep(0.2)
+
+        with open(out) as f:
+            exported = json.load(f)  # round-trips as valid JSON
+        assert exported == trace
+        bcast = [e for e in trace if e.get("cat") == "bcast"]
+        # one lane per (node, plane): every bcast row shares the
+        # driver-node lane, distinct from the task rows' lanes
+        assert len({e["pid"] for e in bcast}) == 1
+        assert "plane:bcast" in bcast[0]["pid"]
+        names = {e["name"] for e in bcast}
+        assert "bcast.chunk.claim" in names
+        assert "bcast.chunk.done" in names
+        # durationed rows are spans, instants carry a scope
+        done = next(e for e in bcast if e["name"] == "bcast.chunk.done")
+        assert done["ph"] == "X" and done["dur"] > 0
+        claim = next(e for e in bcast if e["name"] == "bcast.chunk.claim")
+        assert claim["ph"] == "i" and claim["s"] == "t"
+        # drop accounting made it to the GCS table's stats surface
+        from ray_tpu._private.worker import global_worker
+
+        stats = global_worker().request_gcs({"t": "gcs_stats"},
+                                            timeout=10)
+        pe = stats["plane_events"]
+        assert pe["rows"] > 0 and "drops" in pe
+        assert pe["oldest_age_s"] <= pe["retention_s"]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_timeline_exports_span_cross_link(tmp_path):
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=1, probe_tpu=False)
+    tracing.enable_tracing()
+    try:
+        with tracing.span("refresh") as (tid, _sid):
+            events.emit("bcast.chunk.claim", plane="bcast", idx=0)
+        events.flush_now()
+        deadline = time.time() + 10
+        while True:
+            rows = [e for e in state.list_plane_events()
+                    if e["name"] == "bcast.chunk.claim"]
+            if rows:
+                break
+            assert time.time() < deadline, "plane event never flushed"
+            time.sleep(0.2)
+        assert rows[0]["trace_id"] == tid
+        trace = state.timeline(str(tmp_path / "t.json"), planes=True)
+        ev = next(e for e in trace
+                  if e.get("name") == "bcast.chunk.claim")
+        assert ev["args"]["trace_id"] == tid
+    finally:
+        tracing.disable_tracing()
+        ray_tpu.shutdown()
+
+
+# -------------------------------- integration: tenant-tagged telemetry
+
+
+def test_per_tenant_serve_queue_series_in_prometheus(tmp_path):
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=2, probe_tpu=False)
+    try:
+        @serve.deployment
+        class Echo:
+            def __call__(self, body):
+                time.sleep(0.01)
+                return {"tenant": body.get("tenant")}
+
+        handle = serve.run(Echo.bind(), name="tenants",
+                           route_prefix=None)
+        futs = [handle.remote({"tenant": t, "i": i})
+                for i in range(10) for t in ("acme", "globex")]
+        for f in futs:
+            f.result(timeout=30)
+
+        # The replica's gauge flushes on the worker metrics tick.
+        deadline = time.time() + 15
+        while True:
+            text = state.prometheus_metrics()
+            if ('serve_tenant_queue_depth' in text
+                    and 'tenant="acme"' in text
+                    and 'tenant="globex"' in text):
+                break
+            assert time.time() < deadline, (
+                "per-tenant serve series never appeared:\n"
+                + "\n".join(l for l in text.splitlines()
+                            if "serve" in l))
+            time.sleep(0.3)
+        # serve-plane rows are tenant-tagged in the flight recorder too
+        deadline = time.time() + 10
+        while True:
+            tenants = {e["tenant"] for e in state.list_plane_events()
+                       if e["plane"] == "serve"}
+            if {"acme", "globex"} <= tenants:
+                break
+            assert time.time() < deadline, f"serve rows: {tenants}"
+            time.sleep(0.2)
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_streaming_request_brackets_real_lifetime(tmp_path):
+    """A streaming request's done event (and tenant-queue decrement)
+    fires at generator EXHAUSTION, not creation — mid-stream the
+    per-tenant gauge counts the in-flight stream."""
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=2, probe_tpu=False)
+    try:
+        @serve.deployment
+        class Tok:
+            def __call__(self, body):
+                for i in range(int(body.get("n", 3))):
+                    yield f"t{i}"
+
+        serve.run(Tok.bind(), name="tok", route_prefix=None)
+        handle = serve.get_deployment_handle("Tok", "tok")
+
+        async def collect():
+            return [c async for c in handle.stream(
+                {"tenant": "streamer", "n": 4})]
+
+        assert asyncio.run(collect()) == [f"t{i}" for i in range(4)]
+
+        deadline = time.time() + 10
+        while True:
+            rows = [e for e in state.list_plane_events()
+                    if e["plane"] == "serve"
+                    and e["tenant"] == "streamer"]
+            done = [e for e in rows if e["name"] == "serve.req.done"
+                    and e["fields"].get("stream")]
+            if done:
+                break
+            assert time.time() < deadline, f"no stream done row: {rows}"
+            time.sleep(0.2)
+        admits = [e for e in rows if e["name"] == "serve.req.admit"
+                  and e["fields"].get("stream")]
+        assert admits and done[0]["fields"]["ok"]
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------- satellite: metrics flusher lifecycle
+
+
+def _flusher_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "ray_tpu-metrics" and t.is_alive()]
+
+
+def test_metrics_flusher_stops_on_shutdown():
+    """The flusher is joinable and joined at worker shutdown (the
+    no-leaked-thread posture), and a later init restarts it."""
+    from ray_tpu.util import metrics
+
+    ray_tpu.init(num_cpus=1, probe_tpu=False)
+    try:
+        g = metrics.Gauge("flusher_probe", "probe")
+        g.set(1.0)
+        assert len(_flusher_threads()) == 1
+    finally:
+        ray_tpu.shutdown()
+    assert _flusher_threads() == []
+    # restartable: the next session's _ensure_flusher brings it back
+    ray_tpu.init(num_cpus=1, probe_tpu=False)
+    try:
+        assert len(_flusher_threads()) == 1
+    finally:
+        ray_tpu.shutdown()
+    assert _flusher_threads() == []
+
+
+def test_flush_interval_knob():
+    from ray_tpu._private.config import RayTpuConfig
+
+    assert RayTpuConfig().metrics_flush_interval_s == 1.0
+    assert RayTpuConfig(metrics_flush_interval_s=0.25) \
+        .metrics_flush_interval_s == 0.25
+
+
+# ----------------------- satellite: trace KV + plane-table retention
+
+
+def test_trace_kv_retention_and_plane_table_bounds():
+    """The GCS maintenance sweep evicts ns="trace" blobs past
+    ``trace_retention_s`` and keeps the plane-event table inside
+    ``plane_event_retention_s`` — one owner for both stores."""
+    from ray_tpu._private.config import set_system_config
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=1, probe_tpu=False, _system_config={
+        "trace_retention_s": 1.0,
+        "plane_event_retention_s": 1.0,
+        "health_check_interval_s": 0.4,
+    })
+    try:
+        w = global_worker()
+        w.request_gcs({"t": "kv_put", "ns": "trace",
+                       "k": "feedc0de:1:0", "v": b"span", "i": 1},
+                      timeout=10)
+        got = w.request_gcs({"t": "kv_get", "ns": "trace",
+                             "k": "feedc0de:1:0"}, timeout=10)
+        assert got["ok"]
+        events.emit("bcast.chunk.claim", plane="bcast", idx=0)
+        events.flush_now()
+        deadline = time.time() + 15
+        while True:
+            got = w.request_gcs({"t": "kv_get", "ns": "trace",
+                                 "k": "feedc0de:1:0"}, timeout=10)
+            if not got["ok"]:
+                break
+            assert time.time() < deadline, "trace blob never swept"
+            time.sleep(0.3)
+        stats = w.request_gcs({"t": "gcs_stats"}, timeout=10)
+        pe = stats["plane_events"]
+        assert pe["retention_s"] == 1.0
+        # the sweep keeps the oldest row inside the window (+ a tick)
+        assert pe["oldest_age_s"] <= 1.0 + 1.0
+    finally:
+        set_system_config({})  # exported via env — don't leak onwards
+        ray_tpu.shutdown()
+
+
+def test_clear_traces_driver_api():
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=1, probe_tpu=False)
+    try:
+        w = global_worker()
+        for i in range(3):
+            w.request_gcs({"t": "kv_put", "ns": "trace",
+                           "k": f"cafe{i:04x}:1:0", "v": b"s", "i": 1},
+                          timeout=10)
+        assert tracing.clear_traces() >= 3
+        keys = w.request_gcs({"t": "kv_keys", "ns": "trace",
+                              "prefix": ""}, timeout=10)["keys"]
+        assert keys == []
+    finally:
+        ray_tpu.shutdown()

@@ -445,6 +445,7 @@ def test_rule_table_covers_all_families():
                    + ["RTL111", "RTL112", "RTL113", "RTL114"]  # jax
                    + ["RTL121", "RTL122", "RTL123", "RTL124"]  # protocol
                    + ["RTL131"]                                # failpoints
+                   + ["RTL132"]                                # plane events
                    + ["RTL141", "RTL142"]                      # atomicity
                    + ["RTL151", "RTL152"]                      # affinity
                    + ["RTL161", "RTL162"])                     # lifecycle
@@ -1099,6 +1100,100 @@ def test_rtl131_ordinary_strings_ignored(tmp_path):
     assert found == []
 
 
+# ===================================================== RTL132 (plane events)
+
+def ev_findings(tmp_path, registry_src, reference_src):
+    from ray_tpu.analysis.event_check import check_event_paths
+
+    reg = tmp_path / "reg"
+    ref = tmp_path / "ref"
+    reg.mkdir()
+    ref.mkdir()
+    (reg / "sites.py").write_text(textwrap.dedent(registry_src))
+    (ref / "bench.py").write_text(textwrap.dedent(reference_src))
+    return check_event_paths([str(reg)], [str(ref)])
+
+
+_EVENT_REGISTRY = '''
+from ray_tpu.util import events as plane_events
+
+def f(ev):
+    plane_events.emit("bcast.chunk.claim", plane="bcast")
+    plane_events.count("wait.rows.stream", plane="wait")
+    ev.count("proto.send.frame", key=t)
+'''
+
+
+def test_rtl132_known_names_clean(tmp_path):
+    found = ev_findings(tmp_path, _EVENT_REGISTRY, '''
+    NAMES = ["bcast.chunk.claim", "proto.send.frame"]
+    assert_has = "wait.rows.stream"
+    ''')
+    assert found == []
+
+
+def test_rtl132_typo_name_fires(tmp_path):
+    found = ev_findings(tmp_path, _EVENT_REGISTRY, '''
+    NAME = "bcast.chunk.clame"
+    ''')
+    assert [f.rule for f in found] == ["RTL132"]
+    assert found[0].severity == "error"
+    assert "bcast.chunk.clame" in found[0].message  # raylint: disable=RTL132 (the deliberate typo under test)
+
+
+def test_rtl132_non_grammar_strings_ignored(tmp_path):
+    # Failpoint sites, dotted attrs, synthetic test names: first
+    # segment outside the PLANES alphabet never matches the grammar.
+    found = ev_findings(tmp_path, _EVENT_REGISTRY, '''
+    A = "conn.send.actor_call"
+    B = "test.ring.overflow"
+    C = "bcast.chunk"            # two segments: not an event name
+    D = "os.path.join"
+    ''')
+    assert found == []
+
+
+def test_rtl132_malformed_emit_site_fires(tmp_path):
+    # The registry side is gated too: a literal violating the grammar
+    # AT the emit site poisons lane grouping downstream.
+    found = ev_findings(tmp_path, '''
+    from ray_tpu.util import events
+
+    def f():
+        events.emit("bogusplane.thing.done", plane="bcast")
+        events.emit("bcast.chunk.claim", plane="bcast")
+    ''', '''
+    NAME = "bcast.chunk.claim"
+    ''')
+    assert [f.rule for f in found] == ["RTL132"]
+    assert "grammar" in found[0].message
+
+
+def test_rtl132_empty_scopes_fail_loudly(tmp_path):
+    from ray_tpu.analysis.event_check import check_event_paths
+
+    reg = tmp_path / "reg"
+    ref = tmp_path / "ref"
+    reg.mkdir()
+    ref.mkdir()
+    (reg / "sites.py").write_text(textwrap.dedent(_EVENT_REGISTRY))
+    found = check_event_paths([str(reg)], [str(ref / "missing")])
+    assert [f.rule for f in found] == ["RTL132"]
+    assert "no reference files" in found[0].message
+    (ref / "bench.py").write_text('N = "bcast.chunk.claim"\n')
+    (reg / "sites.py").write_text("def f():\n    pass\n")
+    found = check_event_paths([str(reg)], [str(ref)])
+    assert [f.rule for f in found] == ["RTL132"]
+    assert "no events.emit" in found[0].message
+
+
+def test_rtl132_suppression_on_flagged_line(tmp_path):
+    found = ev_findings(tmp_path, _EVENT_REGISTRY, '''
+    NAME = "bcast.chunk.clame"  # raylint: disable=RTL132 (testing the miss path itself)
+    ''')
+    assert found == []
+
+
 # ============================================== committed-tree gates (tier-1)
 
 def test_protocol_gate_on_committed_tree():
@@ -1127,6 +1222,22 @@ def test_failpoint_gate_on_committed_tree():
     data = json.loads(p.stdout)
     assert p.returncode == 0, (
         "failpoint-site drift:\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []
+
+
+def test_event_gate_on_committed_tree():
+    """Every plane-event name referenced by benchmarks/tests must
+    resolve to a registered emit site — a typo'd name silently never
+    matches a recorded row (`ray_tpu check ray_tpu --events`)."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--events", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "plane-event name drift:\n"
         + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
                     for f in data["findings"]))
     assert data["findings"] == []
