@@ -428,6 +428,152 @@ def workload_drain_pipeline() -> dict:
         c.shutdown()
 
 
+def workload_mpmd_kill_then_drain(n_microbatches: int = 4,
+                                  extra_nodes: int = 1,
+                                  pin_stages: bool = False) -> dict:
+    """THE composition certification (ROADMAP #3): one seeded run in
+    which a 4-stage MPMD pipeline takes BOTH fault classes the fault
+    plane was built for. Phase 1 — the armed
+    ``mpmd.boundary.send.s1`` kill SIGKILLs stage 1's process mid-1F1B;
+    the gang-registered pipeline must fail TYPED via membership PUSH
+    (``PipelineMemberLost``, generation-stamped — never the compiled
+    chain's 300 s result timeout), and re-form at N−1 stages from the
+    last MERGED checkpoint under the same gang name (generation+1).
+    Phase 2 — the re-formed pipeline gets a DRAIN notice mid-schedule
+    (with the armed ``mpmd.admit.g2`` admission stall widening the
+    window): boundary stop, partial-step gradient, merge-checkpoint
+    while the draining stage is reachable, ``from_checkpoint`` re-split
+    landing off the draining node. Returns the fault_sequence the
+    multi-fault runner asserts ordering on."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.models import LlamaConfig, init_params
+    from ray_tpu.parallel.mpmd_pipeline import (MPMDPipeline,
+                                                PipelineDrainSignal,
+                                                PipelineMemberLost)
+    from ray_tpu.util import state as state_api
+
+    m = n_microbatches
+    p = 4
+    cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=2 * p,
+                      n_heads=4, n_kv_heads=2, d_ff=64, max_seq_len=32,
+                      dtype=jnp.float32, tie_embeddings=False)
+    c = Cluster(connect=True)
+    # One resource-tagged node per extra host: the full-size shape pins
+    # one stage per node (N≫2 hosts); the fast shape keeps one tagged
+    # node as the drain target.
+    for i in range(extra_nodes):
+        c.add_node(num_cpus=2, resources={f"st{i}": 2})
+    pipes = []
+    seq: list = []  # [site-ish label, ts] — the runner's ordering record
+    try:
+        assert c.wait_for_nodes(extra_nodes + 1, timeout=120)
+        from ray_tpu._private.worker import global_worker
+
+        # A workload that manages its own cluster has torn it down by
+        # the time the runner looks for the session logs — export the
+        # session dir so the cross-process fire journal (the kill fires
+        # in a stage worker's process) survives into the record.
+        sdir = global_worker().session_dir
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (2 * m, 16), 0, cfg.vocab_size))
+
+        opts1 = ([{"resources": {f"st{i}": 1}} for i in range(p)]
+                 if pin_stages else None)
+        pipe = MPMDPipeline(cfg, params, n_stages=p, n_microbatches=m,
+                            simulate_compute_s=0.1,
+                            gang_name="mpmd-cert", stage_options=opts1)
+        pipes.append(pipe)
+        gen1 = pipe.generation
+        assert gen1 >= 1
+        assert np.isfinite(pipe.step(tokens))      # warm full schedule
+        ckpt = pipe.save_checkpoint()
+
+        # ---- Phase 1: SIGKILL mid-1F1B, detected by gang push.
+        t0 = time.time()
+        try:
+            pipe.step(tokens)
+            raise AssertionError(
+                "stage SIGKILL schedule armed but the step completed")
+        except PipelineMemberLost as e:
+            detect_s = time.time() - t0
+            assert 1 in e.lost_stages, e
+            assert e.generation == gen1, e
+            assert e.checkpoint_path == ckpt, e
+            # Push territory, not result-timeout territory (300 s).
+            assert detect_s < 30, (
+                f"stage loss surfaced in {detect_s:.1f}s — that is "
+                f"timeout territory, not a membership push")
+        seq.append(["mpmd.boundary.send.s1", time.time()])
+        pipe.teardown()
+        pipes.remove(pipe)
+
+        # ---- Elastic re-form at N−1 from the merged checkpoint, same
+        # gang name -> generation+1. The re-formed stages run DISARMED
+        # (the kill schedule is per-process and would fire again);
+        # the driver-side mpmd.admit.g2 stall stays armed.
+        drain_stage = 1
+        opts2 = [{} for _ in range(p - 1)]
+        opts2[drain_stage] = {"resources": {"st0": 1}}
+        pipe2 = MPMDPipeline.from_checkpoint(
+            ckpt, cfg, n_stages=p - 1, n_microbatches=m,
+            simulate_compute_s=0.1, gang_name="mpmd-cert",
+            stage_env={"RAY_TPU_FAILPOINTS": ""}, stage_options=opts2)
+        pipes.append(pipe2)
+        assert pipe2.generation == gen1 + 1, (gen1, pipe2.generation)
+        assert np.isfinite(pipe2.step(tokens))     # trains at N−1
+
+        # ---- Phase 2: drain notice mid-schedule on the survivor.
+        actors = {a["actor_id"]: a.get("node_id")
+                  for a in state_api.list_actors()}
+        doomed = actors[pipe2.stages[drain_stage]._id.hex()]
+        assert doomed is not None
+        threading.Timer(0.35, lambda: ray_tpu.drain_node(
+            doomed, reason="preemption notice", deadline_s=60.0)).start()
+        try:
+            pipe2.step(tokens)
+            raise AssertionError("drain notice never interrupted the step")
+        except PipelineDrainSignal as sig:
+            assert 0 < sig.completed_microbatches < m, sig
+            assert drain_stage in sig.draining_stages, sig
+            ckpt2 = sig.checkpoint_path
+            completed = sig.completed_microbatches
+        seq.append(["mpmd.admit.g2", time.time()])
+        pipe2.teardown()
+        pipes.remove(pipe2)
+
+        # ---- Re-split lands off the draining node and still trains.
+        pipe3 = MPMDPipeline.from_checkpoint(
+            ckpt2, cfg, n_stages=2, n_microbatches=2, drain_aware=False)
+        pipes.append(pipe3)
+        assert np.isfinite(pipe3.step(tokens[:4]))
+        actors = {a["actor_id"]: a.get("node_id")
+                  for a in state_api.list_actors()}
+        for s in pipe3.stages:
+            assert actors[s._id.hex()] != doomed, (
+                "re-split stage landed on the draining node")
+        return {"generations": [gen1, pipe2.generation],
+                "kill_detect_s": round(detect_s, 2),
+                "drain_completed_microbatches": completed,
+                "hosts": extra_nodes + 1,
+                "fault_sequence": seq,
+                "_session_dir": sdir}
+    finally:
+        for pp in list(pipes):
+            try:
+                pp.teardown()
+            except Exception:
+                pass
+        c.shutdown()
+
+
 def workload_podracer(updates: int = 6) -> dict:
     """The Podracer (Sebulba) IMPALA tier under an env-runner SIGKILL
     schedule (``podracer.sample.r1=hitK:kill`` — per-PROCESS hits, so
@@ -480,6 +626,7 @@ WORKLOADS = {
     "gang": workload_gang,
     "coord_death": workload_coord_death,
     "drain_pipeline": workload_drain_pipeline,
+    "mpmd_kill_then_drain": workload_mpmd_kill_then_drain,
     "podracer": workload_podracer,
 }
 
@@ -574,6 +721,36 @@ SCHEDULES = [
          spec="mpmd.admit=hit3:delay:0.2",
          workload="drain_pipeline",
          fault="drain notice mid-1F1B schedule"),
+    # --- COMPOUND multi-fault schedules (ISSUE 15): a stage SIGKILL
+    #     mid-1F1B AND a drain notice against one 4-stage pipeline in
+    #     the SAME run. Two armed sites, two fault classes; the runner
+    #     asserts both fired and that the workload observed them in the
+    #     declared order. Hit math (deterministic): a mid stage does
+    #     2 boundary sends per microbatch per step, so with m
+    #     microbatches stage 1's 3rd forward send of step 2 is hit
+    #     2m+3; the re-formed pipeline is generation 2, so its
+    #     admissions hit mpmd.admit.g2 — its full step burns m hits and
+    #     hit m+2 stalls the 2nd admission of the DRAINED step.
+    dict(name="mpmd_kill_then_drain_fast", tier="fast", seed=91,
+         spec=("mpmd.boundary.send.s1=hit11:kill;"
+               "mpmd.admit.g2=hit6:delay:0.25"),
+         workload="mpmd_kill_then_drain",
+         kwargs={"n_microbatches": 4, "extra_nodes": 1},
+         faults=["stage SIGKILL mid-1F1B (gang-push detection)",
+                 "drain notice mid-schedule (armed admission stall)"],
+         order=["mpmd.boundary.send.s1", "mpmd.admit.g2"],
+         fault="compound: stage SIGKILL + drain, one run"),
+    dict(name="mpmd_kill_then_drain", tier="slow", seed=92,
+         spec=("mpmd.boundary.send.s1=hit19:kill;"
+               "mpmd.admit.g2=hit10:delay:0.25"),
+         workload="mpmd_kill_then_drain",
+         kwargs={"n_microbatches": 8, "extra_nodes": 4,
+                 "pin_stages": True},
+         faults=["stage SIGKILL mid-1F1B (gang-push detection)",
+                 "drain notice mid-schedule (armed admission stall)"],
+         order=["mpmd.boundary.send.s1", "mpmd.admit.g2"],
+         fault="compound full-size: pp=4 one stage per host, SIGKILL "
+               "then drain"),
     # --- Podracer RL tier (r10): env-runner death inside the
     #     three-tier dataflow. hit2 is a per-process rate: every
     #     incarnation of rank 1 (replacements included) dies at its 2nd
@@ -610,6 +787,38 @@ def _cross_process_fires(session_dir) -> list:
     return out
 
 
+def validate_multi_fault(sched: dict, fired: list, metrics: dict) -> None:
+    """First-class multi-fault schedule support: a compound schedule
+    (``faults`` list) certifies nothing unless EVERY armed site fired —
+    a one-fault-fired green run would silently demote the composition
+    back to the single-fault coverage we already have — and unless the
+    workload observed the fault classes in the declared ``order``
+    (strictly increasing timestamps in its ``fault_sequence``). The
+    journal is cross-process (driver seqs + session-log greps), so the
+    ordering assertion rides the workload's observation points, which
+    are the semantically meaningful interleaving."""
+    if not sched.get("faults"):
+        return
+    armed = [seg.partition("=")[0].strip()
+             for seg in sched["spec"].split(";") if seg.strip()]
+    joined = "\n".join(fired)
+    for site in armed:
+        assert site in joined, (
+            f"multi-fault schedule {sched['name']}: armed site {site!r} "
+            f"never fired — the compound run degenerated to a "
+            f"single-fault run\nfired:\n{joined}")
+    seq = metrics.get("fault_sequence") or []
+    want = sched.get("order") or armed
+    got = [s for s, _ in seq]
+    assert got == want, (
+        f"multi-fault schedule {sched['name']}: fault order {got} != "
+        f"declared {want}")
+    ts = [t for _, t in seq]
+    assert all(b > a for a, b in zip(ts, ts[1:])), (
+        f"multi-fault schedule {sched['name']}: fault_sequence "
+        f"timestamps not strictly increasing: {ts}")
+
+
 def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
     """Run one seeded schedule end to end: arm failpoints -> init an own
     cluster -> workload -> invariants (cluster then host) -> disarm.
@@ -633,11 +842,17 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         overrides.setdefault("spawn_timeout_s", 3.0)
         overrides.setdefault("health_check_interval_s", 1.0)
         manages_cluster = sched["workload"] in ("broadcast",
-                                                "drain_pipeline")
+                                                "drain_pipeline",
+                                                "mpmd_kill_then_drain")
         if not manages_cluster:
             ray_tpu.init(num_cpus=4, probe_tpu=False,
                          _system_config=overrides)
         metrics = WORKLOADS[sched["workload"]](**sched.get("kwargs", {}))
+        if isinstance(metrics, dict):
+            # Cluster-managing workloads tear their cluster down before
+            # this point; they export the session dir themselves so the
+            # cross-process fire journal still lands in the record.
+            session_dir = metrics.pop("_session_dir", session_dir)
         from ray_tpu._private.worker import global_worker
 
         plane_events = None
@@ -657,6 +872,7 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         fired = ([f"driver: {seq} {site} -> {act}"
                   for seq, _pid, site, act in failpoints.fired_schedule()]
                  + _cross_process_fires(session_dir))
+        validate_multi_fault(sched, fired, metrics)
         return {"name": sched["name"], "seed": sched["seed"],
                 "spec": sched["spec"], "fault": sched["fault"],
                 "ok": True, "wall_s": round(time.time() - t0, 2),

@@ -24,6 +24,15 @@ from . import (ClassMethodNode, ClassNode, DAGNode, InputNode,
                MultiOutputNode, _HandleNode)
 
 
+class AdmissionTimeout(TimeoutError):
+    """``execute(timeout=...)`` could not admit within the window — the
+    pipe is full (``max_inflight`` in-flight executions, none completed).
+    Callers that must stay responsive to out-of-band fault signals while
+    the pipe is backed up (the MPMD pipeline's member-loss/drain checks)
+    admit with a short timeout in a loop instead of blocking forever on
+    a chain whose downstream stage may be dead."""
+
+
 class CompiledDAGRef:
     """Future-like handle for one compiled execution."""
 
@@ -206,10 +215,16 @@ class CompiledDAG:
 
     # ------------------------------------------------------------- execute
 
-    def execute(self, value: Any) -> CompiledDAGRef:
+    def execute(self, value: Any,
+                timeout: Optional[float] = None) -> CompiledDAGRef:
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
-        self._inflight.acquire()
+        if timeout is None:
+            self._inflight.acquire()  # raylint: disable=RTL161 (released by the except wrap below and _on_sink on completion)
+        elif not self._inflight.acquire(timeout=timeout):  # raylint: disable=RTL161 (the raise fires only when NOT acquired; successful acquires release via the except wrap below / _on_sink)
+            raise AdmissionTimeout(
+                f"pipe full: {self._max_inflight} executions in flight, "
+                f"none completed within {timeout}s")
         seq = None
         # An unserializable input (or a closed loop) must hand the
         # inflight slot back — leaking one per failed execute() would

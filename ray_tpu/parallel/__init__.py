@@ -11,10 +11,13 @@ from .mesh import (
 from .sharding import (
     LLAMA_RULES,
     VIT_RULES,
+    activation_sharding,
     apply_shardings,
     constrain,
+    optimizer_shardings,
     shardings_for_tree,
     spec_for,
+    stage_submesh,
 )
 from . import collectives
 from .moe import (
@@ -39,6 +42,7 @@ __all__ = [
     "AXES", "MeshSpec", "make_mesh", "mesh_spec_from_string",
     "batch_sharding", "replicated", "data_axes", "local_batch_size",
     "LLAMA_RULES", "VIT_RULES", "spec_for", "shardings_for_tree", "apply_shardings",
+    "stage_submesh", "activation_sharding", "optimizer_shardings",
     "constrain", "collectives", "ring_attention", "make_ring_attention",
     "ulysses_attention", "make_ulysses_attention",
     "spmd_pipeline", "make_stage_fn", "stack_layers", "unstack_layers",

@@ -30,10 +30,39 @@ losses and averaged accumulated gradients reproduce the single-program
 ``llama.loss_fn`` exactly (per-row next-token targets make the batch split
 exact) — tested against the single-mesh SPMD pipeline in
 ``tests/test_mpmd_pipeline.py`` for 2 AND 3+ stages.
+
+Fault plane (the pp×fsdp certification surface):
+
+  * ``gang_name=`` registers the stage actors as a GANG (the PR 8 GCS
+    gang registry): a stage process SIGKILLed mid-1F1B publishes a
+    ``gang:<name>`` ``member_lost`` push the driver's watcher consumes —
+    the step fails typed (:class:`PipelineMemberLost`, generation-
+    stamped) in push time, never by waiting out the compiled chain's
+    300 s result timeout. Re-forming ``from_checkpoint`` under the SAME
+    gang name lands at generation+1 (strictly monotonic per name).
+  * the inter-stage DCN hop carries failpoint sites
+    ``mpmd.boundary.send`` / ``mpmd.boundary.recv`` (keyed ``s<stage>``)
+    whose drop/short/disconnect actions surface as typed transport
+    failures of the hop, and whose ``kill`` action is the chaos suite's
+    mid-1F1B stage SIGKILL (`mpmd_kill_then_drain`).
+  * each hop emits ``pipe.stage.fwd`` / ``pipe.stage.bwd`` /
+    ``pipe.stage.boundary`` plane events (stage+microbatch+generation
+    tags) so ``python -m ray_tpu timeline --planes`` shows the bubble
+    on the shared cross-plane clock.
+
+pp×fsdp: each stage of a REAL multi-slice topology is itself an
+fsdp submesh (one SPMD program per slice). The module-level
+``stage_abstract_params`` / ``build_stage_step`` / ``lower_stage_step``
+/ ``stage_hbm_budget`` machinery full-shape-compiles every stage
+against its own ``parallel.sharding.stage_submesh`` and budgets its HBM
+including 1F1B-depth activation buffers — the certification path
+``benchmarks/certify_8b.py --stages N`` drives
+(``records/hbm_budget_8b_pp4_fsdp16.json``).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -42,6 +71,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+
+logger = logging.getLogger(__name__)
 
 
 class PipelineDrainSignal(RuntimeError):
@@ -77,6 +108,46 @@ class PipelineDrainSignal(RuntimeError):
                              self.draining_stages, self.reason))
 
 
+class PipelineMemberLost(RuntimeError):
+    """A pipeline stage's process died mid-schedule. Detection is
+    PUSHED: with ``gang_name=`` set, the stage actors are registered as
+    a gang and the GCS publishes ``member_lost`` the moment the stage's
+    worker dies — the admission/result loops observe the event within
+    one poll tick, never the 300 s result timeout. The killed stage's
+    params are gone with its process, so recovery re-splits the LAST
+    MERGED CHECKPOINT (``checkpoint_path`` when one was saved) at a
+    stage count that fits the survivors:
+    ``MPMDPipeline.from_checkpoint(..., n_stages=n-1, gang_name=same)``
+    — the re-formed gang gets generation+1."""
+
+    def __init__(self, lost_stages, n_stages: int, generation: int = 0,
+                 cause: str = "", checkpoint_path: Optional[str] = None):
+        self.lost_stages = sorted(
+            r for r in lost_stages if isinstance(r, int))
+        self.n_stages = n_stages
+        self.generation = generation
+        self.cause = cause
+        self.checkpoint_path = checkpoint_path
+        super().__init__(
+            f"pipeline lost stage(s) {self.lost_stages or lost_stages} of "
+            f"{n_stages} (generation {generation})"
+            + (f" — {cause}" if cause else "")
+            + (f"; last merged checkpoint: {checkpoint_path}"
+               if checkpoint_path else ""))
+
+    def __reduce__(self):
+        return (type(self), (self.lost_stages, self.n_stages,
+                             self.generation, self.cause,
+                             self.checkpoint_path))
+
+    @property
+    def lost_ranks(self):
+        """Alias for the train-layer escalation surface: in the stage
+        gang, the stage index IS the gang rank (TrainWorker.run exports
+        ``lost_ranks`` for every typed loss)."""
+        return self.lost_stages
+
+
 def merge_stage_params(stage_params: List[Dict[str, Any]]
                        ) -> Dict[str, Any]:
     """Inverse of :func:`split_llama_params`: stitch per-stage pytrees
@@ -95,6 +166,19 @@ def merge_stage_params(stage_params: List[Dict[str, Any]]
     }
 
 
+def stage_layer_counts(n_layers: int, n_stages: int) -> List[int]:
+    """Per-stage layer counts for an n-way split (earlier stages take
+    the remainder) — shared by the runtime split and the analytic HBM
+    budget so the two can never disagree about who owns which layers."""
+    if n_stages < 2:
+        raise ValueError("a pipeline needs at least 2 stages")
+    if n_layers < n_stages:
+        raise ValueError(
+            f"{n_layers} layers cannot fill {n_stages} pipeline stages")
+    return [n_layers // n_stages + (1 if i < n_layers % n_stages else 0)
+            for i in range(n_stages)]
+
+
 def split_llama_params(params: Dict[str, Any], n_stages: int
                        ) -> List[Dict[str, Any]]:
     """Split a Llama param pytree into per-stage pytrees.
@@ -110,13 +194,7 @@ def split_llama_params(params: Dict[str, Any], n_stages: int
             "embedding, the last stage owns lm_head)")
     layers = params["layers"]
     n = len(layers)
-    if n_stages < 2:
-        raise ValueError("a pipeline needs at least 2 stages")
-    if n < n_stages:
-        raise ValueError(
-            f"{n} layers cannot fill {n_stages} pipeline stages")
-    per = [n // n_stages + (1 if i < n % n_stages else 0)
-           for i in range(n_stages)]
+    per = stage_layer_counts(n, n_stages)
     out: List[Dict[str, Any]] = []
     pos = 0
     for i in range(n_stages):
@@ -168,14 +246,24 @@ def stage_forward(stage_params, tokens_or_act, cfg, *, first: bool,
 
 
 def stage_loss(stage_params, act, targets, cfg, *, first: bool = False,
-               remat: bool = True):
-    """Last stage: remaining layers + final norm + head + NLL loss."""
+               remat: bool = True, chunked_vocab: int = 0):
+    """Last stage: remaining layers + final norm + head + NLL loss.
+    ``chunked_vocab > 0`` streams the vocab softmax (the full
+    ``[B, L, V]`` fp32 logits never materialize — the same HBM lever
+    ``llama.loss_fn`` uses, which the per-stage budget assumes)."""
     import jax.numpy as jnp
 
     from ray_tpu.ops.layers import cross_entropy_loss, rms_norm
 
     x = _run_layers(stage_params, act, cfg, remat)
     x = rms_norm(x, stage_params["norm"], cfg.norm_eps)
+    if chunked_vocab > 0:
+        from ray_tpu.ops.chunked_xent import chunked_cross_entropy
+
+        B, L, D = x.shape
+        return chunked_cross_entropy(
+            x.reshape(B * L, D), stage_params["lm_head"],
+            targets.reshape(B * L), chunked_vocab)
     logits = jnp.dot(x, stage_params["lm_head"].astype(x.dtype))
     loss, _ = cross_entropy_loss(logits, targets)
     return loss
@@ -190,19 +278,37 @@ class PipelineStageActor:
     def __init__(self, stage_idx: int, n_stages: int, cfg_blob: bytes,
                  params_blob: bytes, lr: float, n_microbatches: int,
                  transport_dtype: Optional[str] = None,
-                 simulate_compute_s: Optional[float] = None):
+                 simulate_compute_s: Optional[float] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 chunked_vocab: int = 0):
         import cloudpickle
         import jax
         import optax
 
+        if env:
+            # Per-stage env override (mirror of WorkerGroup's
+            # env_per_worker): a re-formed pipeline running clear of the
+            # schedule that killed its predecessor re-arms/disarms HERE
+            # — the inherited spec was snapshotted at process import.
+            os.environ.update(env)
+            if ("RAY_TPU_FAILPOINTS" in env
+                    or "RAY_TPU_FAILPOINT_SEED" in env):
+                from ray_tpu._private import failpoints
+
+                failpoints.reload_failpoints()
         self.jax = jax
         self.stage_idx = stage_idx
+        self.generation = 0
         self.n_stages = n_stages
         self.cfg = cloudpickle.loads(cfg_blob)
         params = cloudpickle.loads(params_blob)
         self.params = jax.tree.map(jax.numpy.asarray, params)
         self.n_microbatches = n_microbatches
         self.transport_dtype = transport_dtype
+        # Chunked-vocab CE on the last stage (streams the vocab softmax
+        # so the full [B, L, V] fp32 logits never materialize) — the
+        # memory lever the per-stage HBM budget assumes; 0 = dense.
+        self.chunked_vocab = chunked_vocab
         # Schedule-measurement mode: each hop additionally sleeps this many
         # seconds per unit of simulated compute (fwd/bwd hops 1 unit,
         # loss_bwd 2 — so every stage owes the same 2 units per
@@ -222,6 +328,50 @@ class PipelineStageActor:
     def _sim(self, units: float) -> None:
         if self.simulate_compute_s:
             time.sleep(units * self.simulate_compute_s)
+
+    def set_generation(self, generation: int) -> int:
+        """Stamp this stage with the pipeline's gang generation (set by
+        the driver right after gang registration) — the tag every plane
+        event row carries, so a timeline of a reshaped run separates
+        the superseded pipeline's spans from its successor's."""
+        self.generation = generation
+        return generation
+
+    def _boundary(self, direction: str, mb: int, nbytes: int) -> None:
+        """The inter-stage DCN hop edge: one failpoint site per
+        direction (keyed by stage, so a schedule can target one stage's
+        sends) and one plane-event row. drop/short/disconnect surface
+        as a typed transport failure of the hop — the compiled chain
+        propagates it to the driver's result ref, the step fails typed,
+        and the caller retries the step (the activation rode the object
+        plane, so a lost/truncated frame means the hop must re-run);
+        ``kill`` is the chaos suite's mid-1F1B stage SIGKILL."""
+        from ray_tpu._private import failpoints
+        from ray_tpu.util import events
+
+        if direction == "send":
+            act = failpoints.fire("mpmd.boundary.send",
+                                  key=f"s{self.stage_idx}")
+        else:
+            act = failpoints.fire("mpmd.boundary.recv",
+                                  key=f"s{self.stage_idx}")
+        if act in ("drop", "short", "disconnect"):
+            raise failpoints.FailpointError(
+                f"mpmd boundary {direction} {act} injected at stage "
+                f"{self.stage_idx} (mb {mb}, seed {failpoints.seed()})")
+        events.emit("pipe.stage.boundary", "pipe", stage=self.stage_idx,
+                    mb=mb, gen=self.generation, dir=direction,
+                    nbytes=nbytes)
+
+    def _emit_hop(self, name: str, mb: int, dur: float) -> None:
+        from ray_tpu.util import events
+
+        if name == "fwd":
+            events.emit("pipe.stage.fwd", "pipe", dur=dur,
+                        stage=self.stage_idx, mb=mb, gen=self.generation)
+        else:
+            events.emit("pipe.stage.bwd", "pipe", dur=dur,
+                        stage=self.stage_idx, mb=mb, gen=self.generation)
 
     def _track_vjp(self, mb, value) -> None:
         self._vjps[mb] = value
@@ -265,7 +415,10 @@ class PipelineStageActor:
         self._track_vjp(mb, (vjp, out.dtype))
         out = self._cast_wire(out)
         self._sim(1)
-        self._busy += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self._busy += dur
+        self._emit_hop("fwd", mb, dur)
+        self._boundary("send", mb, out.nbytes)
         return (mb, out, targets)
 
     def mid_fwd(self, packet):
@@ -274,6 +427,7 @@ class PipelineStageActor:
         cotangent)."""
         t0 = time.perf_counter()
         mb, act, targets = packet
+        self._boundary("recv", mb, np.asarray(act).nbytes)
         act = self._cast_compute(act)
 
         out, vjp = self.jax.vjp(
@@ -282,7 +436,10 @@ class PipelineStageActor:
         self._track_vjp(mb, (vjp, out.dtype))
         out = self._cast_wire(out)
         self._sim(1)
-        self._busy += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self._busy += dur
+        self._emit_hop("fwd", mb, dur)
+        self._boundary("send", mb, out.nbytes)
         return (mb, out, targets)
 
     def loss_bwd(self, packet):
@@ -291,11 +448,13 @@ class PipelineStageActor:
         t0 = time.perf_counter()
         jnp = self.jax.numpy
         mb, act, targets = packet
+        self._boundary("recv", mb, np.asarray(act).nbytes)
         act = self._cast_compute(act)
         targets = jnp.asarray(targets)
 
         loss, vjp = self.jax.vjp(
-            lambda p, a: stage_loss(p, a, targets, self.cfg),
+            lambda p, a: stage_loss(p, a, targets, self.cfg,
+                                    chunked_vocab=self.chunked_vocab),
             self.params, act)
         gp, gact = vjp(jnp.ones_like(loss))
         self._accumulate(gp)
@@ -303,7 +462,10 @@ class PipelineStageActor:
         self._step_losses.append(loss)
         gact = self._cast_wire(gact)
         self._sim(2)
-        self._busy += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self._busy += dur
+        self._emit_hop("bwd", mb, dur)
+        self._boundary("send", mb, gact.nbytes)
         return (mb, gact, loss)
 
     def mid_bwd(self, packet):
@@ -312,12 +474,16 @@ class PipelineStageActor:
         cotangent."""
         t0 = time.perf_counter()
         mb, gact, loss = packet
+        self._boundary("recv", mb, np.asarray(gact).nbytes)
         vjp, out_dtype = self._vjps.pop(mb)
         gp, gact_up = vjp(self._cast_compute(gact, like=out_dtype))
         self._accumulate(gp)
         gact_up = self._cast_wire(gact_up)
         self._sim(1)
-        self._busy += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self._busy += dur
+        self._emit_hop("bwd", mb, dur)
+        self._boundary("send", mb, gact_up.nbytes)
         return (mb, gact_up, loss)
 
     def bwd(self, packet):
@@ -325,11 +491,14 @@ class PipelineStageActor:
         next slice; passes the microbatch loss through to the driver."""
         t0 = time.perf_counter()
         mb, gact, loss = packet
+        self._boundary("recv", mb, np.asarray(gact).nbytes)
         vjp, out_dtype = self._vjps.pop(mb)
         (gp,) = vjp(self._cast_compute(gact, like=out_dtype))
         self._accumulate(gp)
         self._sim(1)
-        self._busy += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self._busy += dur
+        self._emit_hop("bwd", mb, dur)
         return loss
 
     # -------------------------------------------------------- step control
@@ -351,6 +520,19 @@ class PipelineStageActor:
         self._accum = None
         losses, self._step_losses = self._step_losses, []
         return float(np.mean(losses)) if losses else None
+
+    def reset_step_state(self) -> bool:
+        """Discard partial-step backward state (accumulated grads, saved
+        VJPs, per-microbatch losses). The driver calls this on every
+        stage when a step fails mid-schedule on a hop transport failure:
+        the microbatches that completed before the fault must NOT be
+        averaged into the retry's update — without the reset, a retried
+        step applies (stale + fresh)/m and silently corrupts the
+        trajectory."""
+        self._vjps.clear()
+        self._accum = None
+        self._step_losses = []
+        return True
 
     def grad_norm(self):
         """Global-norm of the accumulated (unscaled) grads — parity
@@ -378,6 +560,9 @@ class PipelineStageActor:
 
     def get_params(self):
         return self.jax.tree.map(np.asarray, self.params)
+
+    def pid(self) -> int:
+        return os.getpid()
 
 
 class MPMDPipeline:
@@ -411,7 +596,10 @@ class MPMDPipeline:
                  simulate_compute_s: Optional[float] = None,
                  drain_aware: bool = True,
                  checkpoint_dir: Optional[str] = None,
-                 stage_options: Optional[List[dict]] = None):
+                 stage_options: Optional[List[dict]] = None,
+                 gang_name: Optional[str] = None,
+                 stage_env: Optional[Dict[str, str]] = None,
+                 chunked_vocab: int = 0):
         import cloudpickle
 
         if schedule not in ("1f1b", "gpipe"):
@@ -425,10 +613,20 @@ class MPMDPipeline:
         self.simulate_compute_s = simulate_compute_s
         self.drain_aware = drain_aware
         self.checkpoint_dir = checkpoint_dir
+        self.gang_name = gang_name
+        self.generation = 0
+        # The budget-assumed last-stage memory lever (stage_hbm_budget's
+        # xent_chunk row): streams the vocab softmax in the runtime
+        # loss_bwd exactly as the certified compile does.
+        self.chunked_vocab = chunked_vocab
         self.last_step_stats: Optional[dict] = None
+        self.last_checkpoint: Optional[str] = None
         self._drain_evt = threading.Event()
         self._drain_info: Optional[dict] = None
         self._drain_sub = None
+        self._member_lost_evt = threading.Event()
+        self._member_lost_info: Optional[dict] = None
+        self._gang_sub = None
         stage_params = split_llama_params(
             jax_tree_to_numpy(params), n_stages)
         cfg_blob = cloudpickle.dumps(cfg)
@@ -439,28 +637,121 @@ class MPMDPipeline:
         self.stages = [
             PipelineStageActor.options(**stage_options[i]).remote(
                 i, n_stages, cfg_blob, cloudpickle.dumps(stage_params[i]),
-                lr, n_microbatches, transport_dtype, simulate_compute_s)
+                lr, n_microbatches, transport_dtype, simulate_compute_s,
+                stage_env, chunked_vocab)
             for i in range(n_stages)
         ]
-        from ray_tpu.dag import InputNode
+        # Formation wrap (the WorkerGroup discipline): everything past
+        # the stage spawns must not leak on failure — a gang
+        # registration or chain-compile error used to strand the stage
+        # actors (and a registered gang record) until driver exit.
+        try:
+            if gang_name:
+                self._register_gang()
+                self._start_member_watcher()
+            from ray_tpu.dag import InputNode
 
-        with InputNode() as inp:
-            node = self.stages[0].fwd.bind(inp)
-            for s in self.stages[1:-1]:
-                node = s.mid_fwd.bind(node)
-            node = self.stages[-1].loss_bwd.bind(node)
-            for s in reversed(self.stages[1:-1]):
-                node = s.mid_bwd.bind(node)
-            dag = self.stages[0].bwd.bind(node)
-        if max_inflight is None:
-            # 1F1B: admit at most `depth` microbatches — a new forward
-            # enters only when a backward completes, so each stage holds
-            # ≤ n_stages live VJPs. GPipe: the whole schedule at once.
-            max_inflight = (n_stages if schedule == "1f1b"
-                            else n_microbatches + 2)
-        self._dag = dag.experimental_compile(max_inflight=max_inflight)
+            with InputNode() as inp:
+                node = self.stages[0].fwd.bind(inp)
+                for s in self.stages[1:-1]:
+                    node = s.mid_fwd.bind(node)
+                node = self.stages[-1].loss_bwd.bind(node)
+                for s in reversed(self.stages[1:-1]):
+                    node = s.mid_bwd.bind(node)
+                dag = self.stages[0].bwd.bind(node)
+            if max_inflight is None:
+                # 1F1B: admit at most `depth` microbatches — a new
+                # forward enters only when a backward completes, so each
+                # stage holds ≤ n_stages live VJPs. GPipe: the whole
+                # schedule at once.
+                max_inflight = (n_stages if schedule == "1f1b"
+                                else n_microbatches + 2)
+            self._dag = dag.experimental_compile(max_inflight=max_inflight)
+        except Exception:
+            self._deregister_gang()
+            for s in self.stages:
+                try:
+                    ray_tpu.kill(s)
+                except Exception:
+                    pass
+            raise
         if drain_aware:
             self._start_drain_watcher()
+
+    # ---------------------------------------------------- gang fault plane
+
+    def _register_gang(self):
+        """Register the stage actors as a gang (rank == stage index):
+        the GCS turns any stage-process death into a ``member_lost``
+        push, and the returned strictly-monotonic generation stamps this
+        pipeline incarnation — a re-form under the same name after a
+        SIGKILL lands at generation+1."""
+        from ray_tpu._private.worker import global_worker
+
+        reply = global_worker().request_gcs(  # raylint: disable=RTL161 (teardown deregisters; driver-exit GC is the backstop)
+            {"t": "gang_register", "name": self.gang_name,
+             "members": [s._id.binary() for s in self.stages]},
+            timeout=30)
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"pipeline gang registration failed: {reply.get('err')}")
+        self.generation = int(reply["generation"])
+        ray_tpu.get([s.set_generation.remote(self.generation)
+                     for s in self.stages], timeout=60)
+
+    def _start_member_watcher(self):
+        """One thread on the gang channel: a ``member_lost`` push for
+        THIS generation arms the event the admission/result loops poll —
+        a stage SIGKILL mid-1F1B surfaces as a typed
+        :class:`PipelineMemberLost` within one poll tick, not the
+        compiled chain's result timeout."""
+
+        def watch():
+            from ray_tpu.util.pubsub import Subscriber
+
+            try:
+                sub = Subscriber(f"gang:{self.gang_name}")
+            except Exception:
+                # A dead push channel silently demotes stage-loss
+                # detection to the 300 s result timeout — say so.
+                logger.warning(
+                    "pipeline gang watcher for %r could not subscribe: "
+                    "member-loss detection falls back to the result "
+                    "timeout", self.gang_name, exc_info=True)
+                return
+            self._gang_sub = sub
+            for item in sub:
+                m = item.get("message") or {}
+                if (m.get("event") != "member_lost"
+                        or m.get("generation") != self.generation):
+                    continue
+                self._member_lost_info = m
+                self._member_lost_evt.set()
+
+        threading.Thread(target=watch, daemon=True,
+                         name=f"mpmd-gang-watch-{self.gang_name}").start()
+
+    def _deregister_gang(self):
+        if not self.gang_name or not self.generation:
+            return
+        from ray_tpu._private.worker import global_worker
+
+        try:
+            global_worker().request_gcs(
+                {"t": "gang_deregister", "name": self.gang_name,
+                 "generation": self.generation}, timeout=10)
+        except Exception:
+            pass  # GCS down / already gone — driver-exit GC covers it
+
+    def _check_member_lost(self):
+        if not self._member_lost_evt.is_set():
+            return
+        info = self._member_lost_info or {}
+        raise PipelineMemberLost(
+            info.get("lost_ranks") or [], self.n_stages,
+            generation=self.generation,
+            cause=f"membership push: {info.get('cause', 'member lost')}",
+            checkpoint_path=self.last_checkpoint)
 
     # --------------------------------------------------- drain fault plane
 
@@ -537,7 +828,9 @@ class MPMDPipeline:
             json.dump({"n_stages": self.n_stages,
                        "n_microbatches": self.n_microbatches,
                        "n_layers": len(merged["layers"]),
+                       "generation": self.generation,
                        "ts": time.time()}, f)
+        self.last_checkpoint = path
         return path
 
     @classmethod
@@ -560,8 +853,16 @@ class MPMDPipeline:
         (1F1B), so between any two admissions a backward has completed —
         checking the drain flag here stops the schedule at a microbatch
         boundary with every in-flight microbatch finishing its full
-        forward+backward before control returns."""
+        forward+backward before control returns. Both the admission and
+        the result waits poll in short slices so a gang ``member_lost``
+        push (a stage SIGKILLed mid-1F1B) fails the step typed within
+        one tick — a dead stage must never be discovered by waiting out
+        the flat result timeout, and never wedge admission against a
+        ``max_inflight`` window that can no longer drain."""
+        import concurrent.futures
+
         from ray_tpu._private import failpoints
+        from ray_tpu.dag.compiled import AdmissionTimeout
 
         m = self.n_microbatches
         if tokens.shape[0] % m != 0:
@@ -572,12 +873,42 @@ class MPMDPipeline:
         tgt_mb = np.split(np.asarray(targets), m)
         t0 = time.perf_counter()
         refs = []
+        stopped = False
         for i in range(m):
             if self.drain_aware and self._drain_evt.is_set():
                 break
-            failpoints.fire("mpmd.admit")
-            refs.append(self._dag.execute((i, tok_mb[i], tgt_mb[i])))
-        losses = [r.get(timeout=300) for r in refs]
+            self._check_member_lost()
+            failpoints.fire("mpmd.admit", key=f"g{self.generation}")
+            while True:
+                try:
+                    refs.append(self._dag.execute(
+                        (i, tok_mb[i], tgt_mb[i]), timeout=0.5))
+                    break
+                except AdmissionTimeout:
+                    # Pipe full: between polls a backward normally
+                    # completes; if instead a stage died, the loss push
+                    # unwedges us here — and a drain notice that lands
+                    # while we WAIT for a slot stops the schedule at
+                    # this boundary (the microbatch was never admitted,
+                    # so in-flight ones still finish their full
+                    # forward+backward).
+                    self._check_member_lost()
+                    if self.drain_aware and self._drain_evt.is_set():
+                        stopped = True
+                        break
+            if stopped:
+                break
+        losses = []
+        for r in refs:
+            deadline = time.monotonic() + 300
+            while True:
+                try:
+                    losses.append(r.get(timeout=0.5))
+                    break
+                except concurrent.futures.TimeoutError:
+                    self._check_member_lost()
+                    if time.monotonic() >= deadline:
+                        raise
         wall = time.perf_counter() - t0
         busy = ray_tpu.get([s.take_busy.remote() for s in self.stages],
                            timeout=300)
@@ -589,6 +920,30 @@ class MPMDPipeline:
         }
         return losses
 
+    def _reset_step_state(self):
+        """Best-effort stage-state reset after a mid-schedule hop
+        failure: the typed error propagates to the caller, whose RETRY
+        must start from clean per-stage accumulators (the completed
+        microbatches of the failed step would otherwise be averaged
+        into the retry's update — silent gradient corruption)."""
+        try:
+            ray_tpu.get([s.reset_step_state.remote() for s in self.stages],
+                        timeout=60)
+        except Exception:
+            pass  # a dead/unreachable stage: the caller is re-forming
+
+    def _run_microbatches_clean(self, tokens, targets) -> List[float]:
+        """`_run_microbatches` with the retry contract: any failure
+        OTHER than a member loss (whose stages are dead or about to be
+        torn down) leaves the surviving stages' step state clean."""
+        try:
+            return self._run_microbatches(tokens, targets)
+        except PipelineMemberLost:
+            raise
+        except Exception:
+            self._reset_step_state()
+            raise
+
     def step(self, tokens: np.ndarray, targets: Optional[np.ndarray] = None
              ) -> float:
         from ray_tpu.models.llama import next_token_targets
@@ -597,7 +952,7 @@ class MPMDPipeline:
             import jax.numpy as jnp
 
             targets = np.asarray(next_token_targets(jnp.asarray(tokens)))
-        losses = self._run_microbatches(tokens, targets)
+        losses = self._run_microbatches_clean(tokens, targets)
         k = len(losses)
         if k:
             ray_tpu.get([s.apply_gradients.remote(
@@ -619,7 +974,7 @@ class MPMDPipeline:
         import jax.numpy as jnp
 
         targets = np.asarray(next_token_targets(jnp.asarray(tokens)))
-        return float(np.mean(self._run_microbatches(tokens, targets)))
+        return float(np.mean(self._run_microbatches_clean(tokens, targets)))
 
     def grad_norms(self) -> List[float]:
         return ray_tpu.get(
@@ -648,11 +1003,15 @@ class MPMDPipeline:
             [s.get_params.remote() for s in self.stages], timeout=300)
 
     def teardown(self):
-        if self._drain_sub is not None:
-            try:
-                self._drain_sub.close()
-            except Exception:
-                pass
+        # Deregister FIRST: the orchestrated stage kills below must not
+        # publish member_lost storms to survivors of the same gang name.
+        self._deregister_gang()
+        for sub in (self._drain_sub, self._gang_sub):
+            if sub is not None:
+                try:
+                    sub.close()
+                except Exception:
+                    pass
         try:
             self._dag.teardown()
         except Exception:
@@ -668,3 +1027,215 @@ def jax_tree_to_numpy(tree):
     import jax
 
     return jax.tree.map(np.asarray, tree)
+
+
+# ---------------------------------------------------------------------------
+# pp×fsdp certification machinery: each stage of a multi-slice pipeline is
+# itself an fsdp submesh (one SPMD program per slice). These module-level
+# helpers let `benchmarks/certify_8b.py --stages N` full-shape-compile every
+# stage against its own `parallel.sharding.stage_submesh` (abstract
+# ShapeDtypeStructs only — no weights materialize) and budget per-stage HBM
+# including the 1F1B-depth activation buffering the single-mesh budget has
+# no analog for.
+
+
+def stage_abstract_params(cfg, n_stages: int) -> List[Dict[str, Any]]:
+    """Abstract (ShapeDtypeStruct) per-stage param trees for the FULL
+    geometry — `split_llama_params` is shape-only, so it splits an
+    `eval_shape` tree exactly like a real one."""
+    import jax
+
+    from ray_tpu.models import init_params
+
+    full = jax.eval_shape(lambda k: init_params(cfg, k),
+                          jax.random.PRNGKey(0))
+    return split_llama_params(full, n_stages)
+
+
+def build_stage_step(cfg, stage_idx: int, n_stages: int, *,
+                     lr: float = 3e-4, chunked_vocab: int = 0):
+    """One pp-stage's per-microbatch compute envelope as a single
+    jittable program: the stage's forward, its full backward, and the
+    adamw update. (The runtime actor path splits fwd and bwd around the
+    1F1B schedule with a saved VJP; fusing them here compiles the same
+    math and the same resident state in one certifiable unit.)
+
+    Returns ``(opt, step_fn, kind)``; ``kind`` names the abstract
+    input signature:
+
+      * ``"first"``: ``(params, opt_state, tokens[B,L]i32, g_out[B,L,D])``
+      * ``"mid"``:   ``(params, opt_state, act[B,L,D], g_out[B,L,D])``
+      * ``"last"``:  ``(params, opt_state, act[B,L,D], targets[B,L]i32)``
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adamw(lr, weight_decay=0.1, mu_dtype=jnp.float32)
+
+    if stage_idx == n_stages - 1:
+        def step_fn(params, opt_state, act, targets):
+            loss, vjp = jax.vjp(
+                lambda p, a: stage_loss(p, a, targets, cfg,
+                                        chunked_vocab=chunked_vocab),
+                params, act)
+            gp, gact_up = vjp(jnp.ones_like(loss))
+            updates, opt_state = opt.update(gp, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, \
+                loss, gact_up
+        return opt, step_fn, "last"
+
+    if stage_idx == 0:
+        def step_fn(params, opt_state, tokens, g_out):
+            out, vjp = jax.vjp(
+                lambda p: stage_forward(p, tokens, cfg, first=True),
+                params)
+            (gp,) = vjp(g_out.astype(out.dtype))
+            updates, opt_state = opt.update(gp, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, out
+        return opt, step_fn, "first"
+
+    def step_fn(params, opt_state, act, g_out):
+        out, vjp = jax.vjp(
+            lambda p, a: stage_forward(p, a, cfg, first=False),
+            params, act)
+        gp, gact_up = vjp(g_out.astype(out.dtype))
+        updates, opt_state = opt.update(gp, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, \
+            out, gact_up
+    return opt, step_fn, "mid"
+
+
+def lower_stage_step(cfg, stage_idx: int, n_stages: int, mesh, *,
+                     batch: int, seq: int, lr: float = 3e-4,
+                     chunked_vocab: int = 0):
+    """AOT full-shape lower of one stage's step against its fsdp
+    submesh: params sharded by the production ``LLAMA_RULES``, adam
+    moments mirroring their parameter's sharding, activations/cotangents
+    batch-sharded at the DCN boundary. Returns the jax ``Lowered``
+    (call ``.compile()`` for the XLA compile + memory analysis)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .sharding import (activation_sharding, optimizer_shardings,
+                           shardings_for_tree)
+
+    opt, step_fn, kind = build_stage_step(
+        cfg, stage_idx, n_stages, lr=lr, chunked_vocab=chunked_vocab)
+    a_stage = stage_abstract_params(cfg, n_stages)[stage_idx]
+    sh = shardings_for_tree(a_stage, mesh)
+    a_params = jax.tree.map(
+        lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                             sharding=s),
+        a_stage, sh)
+    a_opt = optimizer_shardings(
+        a_stage, sh, jax.eval_shape(opt.init, a_stage), mesh)
+    act_sh = activation_sharding(mesh)
+    int_sh = NamedSharding(mesh, P(("dp", "fsdp", "ep"), None))
+    act = jax.ShapeDtypeStruct((batch, seq, cfg.d_model), cfg.dtype,
+                               sharding=act_sh)
+    gact = jax.ShapeDtypeStruct((batch, seq, cfg.d_model), cfg.dtype,
+                                sharding=act_sh)
+    ints = jax.ShapeDtypeStruct((batch, seq), jnp.int32, sharding=int_sh)
+    args = {"first": (a_params, a_opt, ints, gact),
+            "mid": (a_params, a_opt, act, gact),
+            "last": (a_params, a_opt, act, ints)}[kind]
+    with mesh:
+        return jax.jit(step_fn).lower(*args)
+
+
+def stage_param_count(cfg, n_stages: int, stage_idx: int) -> int:
+    """Exact per-stage parameter count for the split
+    `split_llama_params` produces (embedding on stage 0, norm+lm_head on
+    the last stage)."""
+    d, f = cfg.d_model, cfg.d_ff
+    kvdim = cfg.n_kv_heads * cfg.head_dim
+    per_layer = (d * cfg.n_heads * cfg.head_dim + 2 * d * kvdim
+                 + cfg.n_heads * cfg.head_dim * d + 3 * d * f + 2 * d)
+    n = stage_layer_counts(cfg.n_layers, n_stages)[stage_idx] * per_layer
+    if stage_idx == 0:
+        n += cfg.vocab_size * d
+    if stage_idx == n_stages - 1:
+        n += d * cfg.vocab_size + d
+    return n
+
+
+def stage_hbm_budget(cfg, n_stages: int, stage_idx: int, *,
+                     devices_per_stage: int, batch_per_chip: int,
+                     seq: int, n_microbatches: int, chunk_v: int = 16384,
+                     hbm_gib_per_chip: float = 95.74,
+                     schedule: str = "1f1b") -> dict:
+    """Analytic per-chip HBM bytes for ONE pp-stage on its fsdp submesh,
+    INCLUDING 1F1B-depth activation buffering: under non-interleaved
+    1F1B, stage i holds up to ``depth_i = min(p - i, m)`` microbatches'
+    live backward state (each pinning its remat boundary activations and
+    its inbound boundary activation until the cotangent returns). This
+    implementation's admission window additionally caps every stage at
+    ``min(p, m)`` live microbatches — reported as ``live_mb_bound`` and
+    used for the worst-case row so the certified figure holds even if a
+    stage momentarily buffers the full window."""
+    d, f = cfg.d_model, cfg.d_ff
+    kvdim = cfg.n_kv_heads * cfg.head_dim
+    D = devices_per_stage
+    bl = batch_per_chip * seq
+    n_layers_stage = stage_layer_counts(cfg.n_layers, n_stages)[stage_idx]
+    per_layer_params = (d * cfg.n_heads * cfg.head_dim + 2 * d * kvdim
+                       + cfg.n_heads * cfg.head_dim * d + 3 * d * f
+                       + 2 * d)
+    n_stage = stage_param_count(cfg, n_stages, stage_idx)
+    first = stage_idx == 0
+    last = stage_idx == n_stages - 1
+    m, p = n_microbatches, n_stages
+    depth = min(p - stage_idx, m) if schedule == "1f1b" else m
+    live_bound = min(p, m) if schedule == "1f1b" else m
+    # Live backward state pinned PER in-flight microbatch at this stage.
+    per_live_mb = (bl * d * 2 * n_layers_stage            # remat boundaries
+                   + (0 if first else bl * d * 2))        # inbound act
+    rows = {
+        # Resident state, fsdp-sharded over the stage's submesh.
+        "params_bf16": 2 * n_stage / D,
+        "grads_bf16": 2 * n_stage / D,
+        "adam_m_fp32": 4 * n_stage / D,
+        "adam_v_fp32": 4 * n_stage / D,
+        # 1F1B-depth activation buffers: depth_i live microbatches' remat
+        # boundaries + inbound boundary activations.
+        "live_mb_state_bf16_x_depth": depth * per_live_mb,
+        # Backward recompute working set inside one layer of ONE
+        # microbatch (bf16): boundary + q/k/v/attn-out + ffn tensors.
+        "recompute_working_set_bf16": bl * (4 * d + 3 * f + 2 * kvdim) * 2,
+        # One in-flight send + one in-flight recv on the DCN boundary.
+        "boundary_send_recv_bf16": 2 * bl * d * 2,
+        # FSDP all-gather transients: current + prefetched layer (full
+        # layer params on every chip while in use).
+        "allgather_layers_bf16_x2": 2 * per_layer_params * 2,
+    }
+    if first:
+        rows["embed_rows_bf16"] = bl * d * 2
+    if last:
+        # Chunked CE: one fp32 logits chunk resident at a time + fp32
+        # hidden staging + the gathered head (budgeted FULL,
+        # conservatively — chunked CE only needs one vocab chunk).
+        rows["xent_chunk_fp32"] = bl * chunk_v * 4
+        rows["xent_hidden_fp32"] = bl * d * 4
+        rows["allgather_vocab_head_bf16"] = cfg.vocab_size * d * 2
+    total = sum(rows.values())
+    worst = total + (live_bound - depth) * per_live_mb
+    return {
+        "stage": stage_idx,
+        "n_layers": n_layers_stage,
+        "devices": D,
+        "stage_param_count": n_stage,
+        "batch_per_chip": batch_per_chip,
+        "seq": seq,
+        "schedule": schedule,
+        "depth_1f1b": depth,
+        "live_mb_bound": live_bound,
+        "bytes_per_chip": {k: int(v) for k, v in rows.items()},
+        "gib_per_chip": {k: round(v / 2**30, 3) for k, v in rows.items()},
+        "total_gib_per_chip": round(total / 2**30, 2),
+        "worst_case_gib_per_chip": round(worst / 2**30, 2),
+        "hbm_gib_per_chip": hbm_gib_per_chip,
+        "fits": worst / 2**30 < hbm_gib_per_chip,
+        "headroom_gib": round(hbm_gib_per_chip - worst / 2**30, 2),
+    }

@@ -113,6 +113,52 @@ def shardings_for_tree(tree: PyTree, mesh: Mesh,
     return jax.tree.map(leaf_sharding, paths, tree)
 
 
+def stage_submesh(n_devices: int,
+                  devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """An fsdp-only mesh for ONE pipeline stage (pp×fsdp topology: the
+    pp axis lives BETWEEN programs — each stage is its own XLA program
+    on its own slice — so the per-stage mesh carries only the intra-
+    slice axis). The same LLAMA_RULES serve a stage param subtree
+    unchanged: stage trees keep the ``layers/<i>/wq`` path shapes the
+    rules match on."""
+    from .mesh import MeshSpec, make_mesh
+
+    if devices is None:
+        devices = jax.devices()[:n_devices]
+    return make_mesh(MeshSpec(fsdp=n_devices), devices)
+
+
+def activation_sharding(mesh: Mesh) -> NamedSharding:
+    """Inter-stage activation/cotangent sharding ``[B, L, D]``: batch
+    over the data-like axes (the DCN boundary ships per-chip rows — no
+    resharding at the hop), seq/d replicated within the stage."""
+    return NamedSharding(mesh, P(("dp", "fsdp", "ep"), None, None))
+
+
+def optimizer_shardings(abstract_params: PyTree, param_shardings: PyTree,
+                        abstract_opt: PyTree, mesh: Mesh) -> PyTree:
+    """ShapeDtypeStruct tree for an optimizer state whose moments mirror
+    their parameter's sharding. Relies on optax's structure-preserving
+    ``opt.init`` (mu/nu subtrees repeat the param tree, so a param's
+    keypath is a suffix of its moment's keypath); scalars like ``count``
+    are replicated. Shared by the fsdp=64 and per-stage (pp×fsdp) AOT
+    certification paths in ``benchmarks/certify_8b.py``."""
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+    pflat, _ = tree_flatten_with_path(abstract_params)
+    pmap = list(zip((keystr(kp) for kp, _ in pflat),
+                    jax.tree.leaves(param_shardings)))
+    oflat, otreedef = tree_flatten_with_path(abstract_opt)
+    oleaves = []
+    for kp, leaf in oflat:
+        ks = keystr(kp)
+        sh = next((s for ppath, s in pmap if ks.endswith(ppath)),
+                  NamedSharding(mesh, P()))
+        oleaves.append(jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=sh))
+    return tree_unflatten(otreedef, oleaves)
+
+
 def apply_shardings(tree: PyTree, shardings: PyTree) -> PyTree:
     """Device-put a host pytree onto its shardings (initial placement)."""
     return jax.tree.map(

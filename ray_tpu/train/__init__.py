@@ -8,13 +8,14 @@ from .session import (
     get_dataset_shard,
     report,
 )
-from .trainer import JaxTrainer, Result
+from .trainer import JaxTrainer, Result, classify_pipeline_loss
 from . import huggingface  # RayTrainReportCallback + prepare_trainer
 from . import torch  # ray_tpu.train.torch.prepare_model etc.
 from .torch_trainer import TorchTrainer
 
 __all__ = [
-    "JaxTrainer", "TorchTrainer", "torch", "huggingface", "Result", "Checkpoint", "ScalingConfig", "RunConfig",
+    "JaxTrainer", "TorchTrainer", "torch", "huggingface", "Result",
+    "classify_pipeline_loss", "Checkpoint", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "DataConfig", "SyncConfig",
     "BackendConfig", "TRAIN_DATASET_KEY", "report", "get_context",
     "get_checkpoint", "get_dataset_shard", "save_pytree", "load_pytree",

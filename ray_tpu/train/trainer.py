@@ -26,6 +26,42 @@ from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .worker_group import WorkerGroup
 
 
+def classify_pipeline_loss(err, *, n_stages: int, submesh_world: int,
+                           submesh_floor: int = 1):
+    """Escalation policy for the pp×fsdp topology (each pipeline stage
+    is itself an fsdp submesh of hosts): pick the MIN-COST recovery for
+    a typed loss.
+
+    * submesh-level loss — a ``WorkerGroupMemberLost`` tagged with a
+      ``stage_idx`` losing FEWER than the submesh's world: only that
+      stage's fsdp group re-forms at N−k (its params reshard from the
+      stage's own checkpoint shard); the other pp−1 stages are
+      untouched. Returns ``("reshape_submesh", stage_idx, new_world)``.
+    * stage-level loss — a ``PipelineMemberLost`` (the stage actor/
+      slice died) or a submesh loss that took the WHOLE submesh: the
+      pipeline re-splits the merged checkpoint at pp−k. Returns
+      ``("resplit_pipeline", new_stage_count)`` (floor 2 — below that
+      it is a single-mesh run).
+    * anything else returns ``None`` — not a pipeline-shaped loss.
+    """
+    from ray_tpu.parallel.mpmd_pipeline import PipelineMemberLost
+
+    from .worker_group import WorkerGroupMemberLost
+
+    if isinstance(err, PipelineMemberLost):
+        k = max(1, len(err.lost_stages))
+        return ("resplit_pipeline", max(2, n_stages - k))
+    if isinstance(err, WorkerGroupMemberLost):
+        k = max(1, len(err.lost_ranks))
+        if err.stage_idx is None:
+            return None  # an unscoped (single-mesh) group loss
+        if k >= submesh_world:
+            return ("resplit_pipeline", max(2, n_stages - 1))
+        return ("reshape_submesh", err.stage_idx,
+                max(max(submesh_floor, 1), submesh_world - k))
+    return None
+
+
 @dataclasses.dataclass
 class Result:
     metrics: Optional[Dict[str, Any]]
@@ -238,7 +274,10 @@ class JaxTrainer:
             if o.get("ok"):
                 continue
             et = o.get("err_type")
-            if et in ("CollectiveMemberLost", "WorkerGroupMemberLost"):
+            if et in ("CollectiveMemberLost", "WorkerGroupMemberLost",
+                      "PipelineMemberLost"):
+                # PipelineMemberLost aliases lost_stages as lost_ranks:
+                # in the stage gang, the stage index IS the rank.
                 lost.update(o.get("lost_ranks") or [])
             elif et == "CollectiveTimeout":
                 timed_out = True

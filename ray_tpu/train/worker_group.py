@@ -212,19 +212,28 @@ class WorkerGroupMemberLost(RuntimeError):
     checkpoint."""
 
     def __init__(self, lost_ranks, world_size: int, cause: str = "",
-                 generation: int = 0):
+                 generation: int = 0, stage_idx: Optional[int] = None):
         self.lost_ranks = sorted(lost_ranks)
         self.world_size = world_size
         self.generation = generation
         self.cause = cause
+        # pp×fsdp scope tag: when this group is ONE pipeline stage's
+        # fsdp submesh (WorkerGroup(stage_idx=...)), the loss names the
+        # stage so the trainer's escalation can pick the min-cost
+        # recovery — reshape THIS stage's submesh at N−k (params
+        # restorable from the stage's own checkpoint shard) vs re-split
+        # the whole pipeline at pp−1 (only when the stage is gone).
+        self.stage_idx = stage_idx
+        scope = (f", stage {stage_idx} submesh" if stage_idx is not None
+                 else "")
         super().__init__(
             f"worker group lost rank(s) {self.lost_ranks} of "
-            f"{world_size} (generation {generation}) "
+            f"{world_size}{scope} (generation {generation}) "
             f"{('— ' + cause) if cause else ''}".strip())
 
     def __reduce__(self):
         return (type(self), (self.lost_ranks, self.world_size,
-                             self.cause, self.generation))
+                             self.cause, self.generation, self.stage_idx))
 
 
 class WorkerGroup:
@@ -232,14 +241,26 @@ class WorkerGroup:
                  placement_strategy: str = "PACK",
                  env_per_worker: Optional[List[Dict[str, str]]] = None,
                  formation_timeout_s: float = 120.0,
-                 gang_name: Optional[str] = None):
+                 gang_name: Optional[str] = None,
+                 stage_idx: Optional[int] = None):
         import uuid as _uuid
 
         self.num_workers = num_workers
+        # pp×fsdp scope: this group is pipeline stage `stage_idx`'s fsdp
+        # submesh. Member losses carry the tag so the escalation ladder
+        # can separate submesh-level loss (reshape this stage at N−k)
+        # from stage-level loss (re-split the pipeline at pp−1).
+        self.stage_idx = stage_idx
         # Stable gang name => monotonic generation across re-formations
         # (the trainer passes its run name); an auto name still registers
-        # so membership-loss pushes work for ad-hoc groups.
-        self.gang_name = gang_name or f"wg-{_uuid.uuid4().hex[:8]}"
+        # so membership-loss pushes work for ad-hoc groups. A staged
+        # group defaults to a per-stage suffix so each stage's submesh
+        # has its own generation line.
+        if gang_name is None:
+            gang_name = f"wg-{_uuid.uuid4().hex[:8]}"
+        elif stage_idx is not None:
+            gang_name = f"{gang_name}-s{stage_idx}"
+        self.gang_name = gang_name
         self.generation = 0
         self._gang_lost = threading.Event()
         self._gang_lost_info: Optional[dict] = None
@@ -481,7 +502,8 @@ class WorkerGroup:
         if pending:
             self._abort_survivors(set(lost_ranks))
         raise WorkerGroupMemberLost(lost_ranks, self.num_workers, cause,
-                                    generation=self.generation)
+                                    generation=self.generation,
+                                    stage_idx=self.stage_idx)
 
     def run_collective(self, method: str, *args, timeout: float = 300.0,
                        poll_s: float = 0.5, **kwargs):
@@ -526,7 +548,8 @@ class WorkerGroup:
                     # typed failure, no survivor SIGKILL needed.
                     raise WorkerGroupMemberLost(
                         e.lost_ranks, self.num_workers, str(e),
-                        generation=self.generation) from e
+                        generation=self.generation,
+                        stage_idx=self.stage_idx) from e
                 except (ActorDiedError, ConnectionError) as e:
                     if self._gang_lost.is_set():
                         info = self._gang_lost_info or {}
@@ -539,7 +562,8 @@ class WorkerGroup:
                         self._abort_survivors(dead)
                         raise WorkerGroupMemberLost(
                             dead, self.num_workers, str(e),
-                            generation=self.generation) from e
+                            generation=self.generation,
+                            stage_idx=self.stage_idx) from e
                     # No MEMBER died: a collective dependency did (the
                     # group's coordinator actor, a dropped link). The
                     # ranks already unwedged with errors — surface the
@@ -551,7 +575,8 @@ class WorkerGroup:
                 self._abort_survivors(dead)
                 raise WorkerGroupMemberLost(
                     dead, self.num_workers, "actor-state poll",
-                    generation=self.generation)
+                    generation=self.generation,
+                    stage_idx=self.stage_idx)
             if _time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"collective {method!r} did not complete in "

@@ -45,7 +45,7 @@ from typing import Dict, List, Optional, Tuple
 # these; the --events checker treats the set as the name grammar's
 # first-segment alphabet).
 PLANES = ("task", "proto", "gcs", "lease", "wait", "bcast", "coll",
-          "serve", "rl")
+          "serve", "rl", "pipe")
 
 _lock = threading.Lock()
 _ring: List[list] = []

@@ -76,6 +76,61 @@ def test_slow_schedule(name):
 
 
 # --------------------------------------------------------------------------
+# Multi-fault (compound) schedule support: the runner contract, unit-level.
+
+
+def test_validate_multi_fault_contract():
+    """A compound schedule (``faults`` list) certifies nothing unless
+    EVERY armed site fired and the workload observed the fault classes
+    in the declared order with strictly increasing timestamps — a
+    one-fault green run must fail loudly, not silently degrade to the
+    single-fault coverage we already have."""
+    from benchmarks.chaos_suite import validate_multi_fault
+
+    sched = dict(
+        name="compound",
+        spec=("mpmd.boundary.send.s1=hit11:kill;"
+              "mpmd.admit.g2=hit6:delay:0.25"),
+        faults=["stage SIGKILL", "drain-phase stall"],
+        order=["mpmd.boundary.send.s1", "mpmd.admit.g2"])
+    fired = ["worker-z1.out: failpoint fired: "
+             "mpmd.boundary.send.s1[s1] -> kill (seed=91, #1)",
+             "driver: 1 mpmd.admit.g2[g2] -> delay"]
+    good = {"fault_sequence": [["mpmd.boundary.send.s1", 10.0],
+                               ["mpmd.admit.g2", 20.0]]}
+    validate_multi_fault(sched, fired, good)  # green
+
+    with pytest.raises(AssertionError, match="never fired"):
+        validate_multi_fault(sched, fired[1:], good)  # kill missing
+    with pytest.raises(AssertionError, match="order"):
+        validate_multi_fault(sched, fired, {"fault_sequence": [
+            ["mpmd.admit.g2", 10.0], ["mpmd.boundary.send.s1", 20.0]]})
+    with pytest.raises(AssertionError, match="increasing"):
+        validate_multi_fault(sched, fired, {"fault_sequence": [
+            ["mpmd.boundary.send.s1", 20.0], ["mpmd.admit.g2", 20.0]]})
+    # Single-fault schedules are untouched by the multi-fault contract.
+    validate_multi_fault(dict(name="plain", spec="mpmd.admit=hit3:delay"),
+                         [], {})
+
+
+def test_compound_schedules_declare_order_and_tiers():
+    """The compound entries stay well-formed: both fault classes
+    declared, order covers every armed site, the fast variant is tier-1
+    and the full-size (one stage per host, N≫2) run is slow-tier."""
+    by_name = {s["name"]: s for s in SCHEDULES}
+    fast = by_name["mpmd_kill_then_drain_fast"]
+    full = by_name["mpmd_kill_then_drain"]
+    assert fast["tier"] == "fast" and full["tier"] == "slow"
+    assert full["kwargs"]["extra_nodes"] >= 4  # N >> 2 hosts
+    assert full["kwargs"]["pin_stages"]
+    for s in (fast, full):
+        assert len(s["faults"]) == 2
+        armed = [seg.partition("=")[0]
+                 for seg in s["spec"].split(";") if seg]
+        assert s["order"] == armed
+
+
+# --------------------------------------------------------------------------
 # GCS kill-and-restart mid-workload, per new plane (satellite coverage).
 # These run in-process (no failpoints env needed — the restart is driven
 # through the gcs_restart chaos op) with the end-of-test invariants

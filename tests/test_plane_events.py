@@ -186,6 +186,77 @@ def test_timeline_merges_task_and_broadcast_lanes(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_pipeline_plane_spans_show_the_schedule(tmp_path):
+    """ISSUE 15 satellite: the MPMD pipeline emits ``pipe.stage.*``
+    spans (stage+microbatch+generation tags) from every hop, so
+    ``timeline --planes`` shows the 1F1B schedule — and its bubble —
+    on the shared cross-plane clock. Stage processes flush through the
+    coalesced worker task_events tick; the rows land in the GCS
+    plane-event table tagged ``plane=pipe``."""
+    import jax
+    import numpy as np
+
+    ray_tpu.init(num_cpus=4, probe_tpu=False)
+    try:
+        from ray_tpu.models import LlamaConfig, init_params
+        from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+        cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=2,
+                          n_heads=4, n_kv_heads=2, d_ff=64,
+                          max_seq_len=32, dtype=jax.numpy.float32,
+                          tie_embeddings=False)
+        m = 3
+        pipe = MPMDPipeline(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                            n_stages=2, n_microbatches=m,
+                            gang_name="pipeline-events")
+        try:
+            tokens = np.asarray(jax.random.randint(
+                jax.random.PRNGKey(1), (2 * m, 16), 0, cfg.vocab_size))
+            pipe.step(tokens)
+            gen = pipe.generation
+            # 2-stage schedule: stage 0 runs distinct fwd and bwd hops;
+            # the last stage's fused loss_bwd hop is one bwd span.
+            want_fwd = {(0, i) for i in range(m)}
+            want_bwd = {(s, i) for s in (0, 1) for i in range(m)}
+            deadline = time.time() + 20
+            while True:
+                rows = [e for e in state.list_plane_events()
+                        if e["plane"] == "pipe"]
+                names = {e["name"] for e in rows}
+                got_fwd = {(e["fields"]["stage"], e["fields"]["mb"])
+                           for e in rows
+                           if e["name"] == "pipe.stage.fwd"}
+                got_bwd = {(e["fields"]["stage"], e["fields"]["mb"])
+                           for e in rows
+                           if e["name"] == "pipe.stage.bwd"}
+                # Each stage process flushes on its own task_events
+                # tick — wait for the COMPLETE span set, not first rows.
+                if ("pipe.stage.boundary" in names
+                        and got_fwd == want_fwd and got_bwd == want_bwd):
+                    break
+                assert time.time() < deadline, (
+                    f"pipe rows never flushed: {names} fwd={got_fwd} "
+                    f"bwd={got_bwd}")
+                time.sleep(0.3)
+        finally:
+            pipe.teardown()
+        fwd = [e for e in rows if e["name"] == "pipe.stage.fwd"]
+        # every (stage, microbatch) hop is a distinct span with a real
+        # duration and the pipeline's gang generation tag
+        assert all(e["dur"] > 0 for e in fwd)
+        assert all(e["fields"]["gen"] == gen for e in fwd)
+        bnd = [e for e in rows if e["name"] == "pipe.stage.boundary"]
+        assert {e["fields"]["dir"] for e in bnd} == {"send", "recv"}
+        assert all(e["fields"]["nbytes"] > 0 for e in bnd)
+        # and the merged Chrome trace grows a pipe lane on one clock
+        trace = state.timeline(str(tmp_path / "t.json"), planes=True)
+        lanes = {e["pid"] for e in trace
+                 if e.get("cat") == "pipe"}
+        assert lanes and all("plane:pipe" in ln for ln in lanes)
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_timeline_exports_span_cross_link(tmp_path):
     from ray_tpu.util import tracing
 

@@ -148,3 +148,58 @@ def test_trainer_dataset_shards(ray_cluster, storage):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["n"] == 4  # plain lists are broadcast
+
+
+# ---------------------------------------------- pp×fsdp escalation policy
+
+
+def test_classify_pipeline_loss_submesh_vs_stage_level():
+    """ISSUE 15 train-layer satellite: the escalation ladder separates
+    submesh-level loss (one stage's fsdp group lost SOME hosts →
+    reshape only that submesh at N−k) from stage-level loss (the whole
+    stage / slice gone → re-split the pipeline at pp−k), picking the
+    min-cost recovery."""
+    from ray_tpu.parallel.mpmd_pipeline import PipelineMemberLost
+    from ray_tpu.train.trainer import classify_pipeline_loss
+    from ray_tpu.train.worker_group import WorkerGroupMemberLost
+
+    # One host of stage 2's 4-host submesh died: reshape THAT submesh.
+    e = WorkerGroupMemberLost([1], 4, "push", generation=3, stage_idx=2)
+    assert classify_pipeline_loss(e, n_stages=4, submesh_world=4) == \
+        ("reshape_submesh", 2, 3)
+    # Floor clamps the submesh reshape.
+    e = WorkerGroupMemberLost([0, 1, 2], 4, "push", generation=3,
+                              stage_idx=1)
+    assert classify_pipeline_loss(e, n_stages=4, submesh_world=4,
+                                  submesh_floor=2) == \
+        ("reshape_submesh", 1, 2)
+    # The WHOLE submesh died: that is a stage-level loss — re-split.
+    e = WorkerGroupMemberLost([0, 1, 2, 3], 4, "push", generation=3,
+                              stage_idx=1)
+    assert classify_pipeline_loss(e, n_stages=4, submesh_world=4) == \
+        ("resplit_pipeline", 3)
+    # A stage actor death (single-process stage) is stage-level too.
+    e = PipelineMemberLost([1], 4, generation=2, cause="push")
+    assert classify_pipeline_loss(e, n_stages=4, submesh_world=16) == \
+        ("resplit_pipeline", 3)
+    assert e.lost_ranks == [1]  # the train-layer alias
+    # Re-split floors at 2 stages; unscoped losses are not pipeline-shaped.
+    e = PipelineMemberLost([0, 1, 2], 4, generation=2)
+    assert classify_pipeline_loss(e, n_stages=4, submesh_world=16) == \
+        ("resplit_pipeline", 2)
+    e = WorkerGroupMemberLost([1], 4, "push", generation=3)
+    assert classify_pipeline_loss(e, n_stages=4, submesh_world=4) is None
+
+
+def test_stage_scoped_member_lost_pickles_with_scope():
+    """The stage tag must survive the actor boundary (TrainWorker.run
+    re-raises through __reduce__) and the gang name must carry the
+    per-stage suffix so each submesh has its own generation line."""
+    import pickle
+
+    from ray_tpu.train.worker_group import WorkerGroupMemberLost
+
+    e = WorkerGroupMemberLost([2], 8, "push", generation=5, stage_idx=3)
+    e2 = pickle.loads(pickle.dumps(e))
+    assert e2.stage_idx == 3 and e2.lost_ranks == [2]
+    assert e2.generation == 5 and "stage 3 submesh" in str(e2)
