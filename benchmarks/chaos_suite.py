@@ -847,6 +847,17 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         if not manages_cluster:
             ray_tpu.init(num_cpus=4, probe_tpu=False,
                          _system_config=overrides)
+        # Continuous invariants: the end-state check below only proves
+        # the run CONVERGED clean — the periodic sweeper proves the
+        # mid-run instants were clean too (quota never over cap, drops
+        # bounded, retention alive), each pass/violation timestamped in
+        # the plane-event journal. Own-cluster workloads start it
+        # themselves if they want it (their driver lives elsewhere).
+        sweeper = None
+        if not manages_cluster:
+            sweeper = invariants.PeriodicSweeper(
+                interval_s=float(sched.get("sweep_interval_s", 1.0)),
+                max_drops=int(sched.get("sweep_max_drops", 0))).start()
         metrics = WORKLOADS[sched["workload"]](**sched.get("kwargs", {}))
         if isinstance(metrics, dict):
             # Cluster-managing workloads tear their cluster down before
@@ -856,6 +867,13 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         from ray_tpu._private.worker import global_worker
 
         plane_events = None
+        sweep_summary = None
+        if sweeper is not None:
+            sweep_summary = sweeper.stop()
+            if sweep_summary["violations"]:
+                raise AssertionError(
+                    "continuous invariant sweep violated mid-run: "
+                    f"{sweep_summary['violations']}")
         if ray_tpu.is_initialized():
             session = global_worker().session_name
             session_dir = global_worker().session_dir
@@ -877,7 +895,8 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
                 "spec": sched["spec"], "fault": sched["fault"],
                 "ok": True, "wall_s": round(time.time() - t0, 2),
                 "metrics": metrics, "fired": fired,
-                "plane_events": plane_events}
+                "plane_events": plane_events,
+                "sweeps": sweep_summary}
     except BaseException as e:
         # Repro ergonomics: a red run prints everything needed to rerun
         # it — the schedule name, seed, spec, and what actually fired.

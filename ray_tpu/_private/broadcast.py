@@ -168,6 +168,7 @@ def serve_obj_fetch(conn, msg: dict, view, *, miss: bool = False,
             stats["bcast_sg_chunks_served"] += 1
             stats["bcast_bytes_served"] += length
         plane_events.emit("bcast.chunk.serve", plane="bcast",
+                          tenant=plane_events.process_tenant(),
                           off=off, nbytes=length)
         try:
             conn.reply(msg, {"ok": True, "total": total, "off": off},
@@ -294,6 +295,7 @@ def _serve_conn_blocking(sock: socket.socket, resolve: Callable,
                         stats["bcast_sg_chunks_served"] += 1
                         stats["bcast_bytes_served"] += ln
                     plane_events.emit("bcast.chunk.serve", plane="bcast",
+                                      tenant=plane_events.process_tenant(),
                                       off=off, nbytes=ln)
                 else:
                     chunk = bytes(view.data[off:off + ln]) if ln else b""
@@ -727,6 +729,7 @@ class StripedPull:
             src.cursor = (src.cursor + step + 1) % n
             self.claimed.add(i)
             plane_events.emit("bcast.chunk.claim", plane="bcast",
+                              tenant=plane_events.process_tenant(),
                               src=src.addr, idx=i, pidx=self.pidx)
             return i
         if fallback is not None:
@@ -734,6 +737,7 @@ class StripedPull:
             src.cursor = (src.cursor + step + 1) % n
             self.claimed.add(i)
             plane_events.emit("bcast.chunk.claim", plane="bcast",
+                              tenant=plane_events.process_tenant(),
                               src=src.addr, idx=i, pidx=self.pidx)
             return i
         # Endgame steal: every remaining chunk is claimed by some OTHER
@@ -748,6 +752,7 @@ class StripedPull:
                 if src.has is not None and not bitmap_test(src.has, i):
                     continue
                 plane_events.emit("bcast.chunk.steal", plane="bcast",
+                                  tenant=plane_events.process_tenant(),
                                   src=src.addr, idx=i, pidx=self.pidx)
                 return i
         return None

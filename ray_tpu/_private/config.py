@@ -111,6 +111,30 @@ class RayTpuConfig:
     # errors). Off by default — the reference allows explicit
     # cross-namespace lookup, and single-tenant clusters rely on it.
     tenant_isolation: bool = False
+    # ---- tenant SLO enforcement (interference detector + action ladder)
+    # Per-tenant SLO specs: JSON {namespace: {"event": "serve.req.done",
+    # "field": "dur", "stat": "p99", "threshold_s": 0.05, ...}} — also
+    # registrable at runtime via ray_tpu.util.slo.register(). The
+    # GCS-side sweep evaluates each spec over a sliding window of
+    # tenant-tagged plane-event rows; `breach_windows` consecutive
+    # breached sweeps escalate the enforcement ladder one rung
+    # (re-weight -> rebalance -> migrate), `recover_windows` clear
+    # sweeps de-escalate and restore the offender's weight. Empty =
+    # detector loop idle (zero overhead beyond the timer).
+    slo_specs: str = ""
+    slo_sweep_interval_s: float = 1.0   # detector cadence
+    slo_window_s: float = 5.0           # sliding stat window per sweep
+    # Minimum time between two enforcement actions against the same
+    # offender — the ladder never machine-guns rungs faster than the
+    # cluster can show the previous rung's effect.
+    slo_action_cooldown_s: float = 2.0
+    # Rung-1 de-weighting: offender's fair-ingress slice and admission
+    # budget scale by this factor (floor of 1 frame/cycle keeps the
+    # offender live — starvation is migration's job, not re-weighting's).
+    slo_reweight_factor: float = 0.05
+    # Rung-2 ceiling: at most this many of the offender's held leases
+    # are revoked per rebalance action (graceful, restartable work only).
+    slo_rebalance_max_leases: int = 4
     # ---- gang fault plane (train worker groups / host collectives)
     # Rendezvous cap for the shm-collective coordinator (was a hard-coded
     # 300s asyncio.wait_for): a rank blocked past this raises a typed

@@ -578,6 +578,13 @@ class GcsServer:
                            _cfg().tenant_quotas)
             self._tenant_quotas = {}
         self.tenant_usage: Dict[str, Dict[str, float]] = {}
+        # SLO enforcement rung 1: tenants whose fair-ingress slice and
+        # admission budget are scaled down (ns -> factor in (0, 1]).
+        # Empty dict == every hot-path check is one falsy test.
+        self._tenant_weights: Dict[str, float] = {}
+        from .slo import SloController
+
+        self.slo = SloController(self)
         # Gang fault plane: live gang records by name, the per-name
         # monotonic generation counters (durable — snapshot + WAL), and
         # the member-actor -> gang index the death/drain paths consult.
@@ -875,6 +882,7 @@ class GcsServer:
         self.loop_monitor = LoopMonitor(name="gcs").start()
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         asyncio.get_running_loop().create_task(self._health_check_loop())
+        asyncio.get_running_loop().create_task(self._slo_loop())
         self._ingress_task = asyncio.get_running_loop().create_task(
             self._ingress_drain())
         # WAL-restored placement groups re-place once agents re-register:
@@ -957,13 +965,21 @@ class GcsServer:
         flooding tenant while every other connection keeps draining
         (reference analog: per-call gRPC flow control the shared asyncio
         reader otherwise lacks)."""
-        if not self._ingress and not client.inq and not client.bp_on:
+        if not self._ingress and not client.inq and not client.bp_on \
+                and (not self._tenant_weights or client.role != "driver"
+                     or (client.namespace or "default")
+                     not in self._tenant_weights):
             # Uncontended fast path: no lane anywhere holds frames, so
             # dispatching inline IS the round-robin order — and the read
             # loop's mid-chunk yields (yield_every) keep concurrent
             # floods time-sliced at fair_slice granularity regardless.
             # The parked lane engages under contention (a lane already
             # draining, a handler blocking the loop, admission in force).
+            # A rung-1 de-weighted tenant NEVER gets the inline path: a
+            # flood the drain fully absorbs leaves every lane empty, so
+            # without this exclusion the weighted slice + scaled budget
+            # would simply never engage (the cost is one dict hit, and
+            # only while an enforcement weight is live).
             return self._dispatch(client, msg)
         client.inq.append(msg)
         if client not in self._ingress:
@@ -984,6 +1000,11 @@ class GcsServer:
             if client.role == "worker" \
                     and len(client.inq) >= self._adm_high * 4:
                 return self._admission_block(client)
+        elif self._tenant_weights and client.role == "driver" \
+                and len(client.inq) >= self._tenant_adm_high(client):
+            # SLO rung 1: a de-weighted tenant's budget shrinks with its
+            # weight, so backpressure engages before the full budget.
+            return self._admission_block(client)
         return None
 
     async def _admission_block(self, client: ClientConn):
@@ -1003,6 +1024,16 @@ class GcsServer:
             client.bp_event = asyncio.Event()
         client.bp_event.clear()
         await client.bp_event.wait()
+        hold = self._tenant_hold_s(client)
+        if hold > 0.0:
+            # Rung-1 pacing: the block/unblock round trip alone only
+            # halves an absorbed flood (measured 152k -> 80k frames/s —
+            # draining 41 parked frames costs microseconds), so a
+            # de-weighted lane's read loop stays closed for a beat after
+            # each unblock. Sleeps THIS socket's read loop only; kernel
+            # flow control pushes back on the offender while every other
+            # connection keeps draining.
+            await asyncio.sleep(hold)
 
     async def _ingress_drain(self):
         """Round-robin frame drain: every lane with parked frames gets at
@@ -1015,14 +1046,15 @@ class GcsServer:
             while self._ingress:
                 for client in list(self._ingress):
                     q = client.inq
-                    for _ in range(min(len(q), self._fair_slice)):
+                    for _ in range(min(len(q), self._tenant_slice(client))):
                         await self._dispatch(client, q.popleft())
                     if not q:
                         self._ingress.pop(client, None)
                         if client.gone:
                             client.gone = False
                             self._disconnect_cleanup(client)
-                    if client.bp_on and len(q) <= self._adm_low:
+                    if client.bp_on and len(q) <= self._tenant_adm_low(
+                            client):
                         client.bp_on = False
                         plane_events.emit("gcs.admission.unblock",
                                           plane="gcs",
@@ -2235,6 +2267,180 @@ class GcsServer:
                 # Concurrent fan-out: one unresponsive node's timeout must
                 # not delay (or compound into) the others' checks.
                 await asyncio.gather(*(ping(n) for n in targets))
+
+    # ------------------------------------------------- SLO enforcement
+
+    async def _slo_loop(self):
+        """Interference-detector cadence (_private/slo.py): fold this
+        process's recorder ring into the table (the sweep reads the
+        table, and the GCS's own admission/lease rows matter for
+        attribution), then run one sweep. Idle-cheap: with no specs
+        registered the sweep returns before touching the table."""
+        interval = self.slo.sweep_interval
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(interval)
+            try:
+                if self.slo.tenants:
+                    self._ingest_local_plane_events()
+                self.slo.sweep()
+            except Exception:
+                logger.exception("slo sweep failed")
+
+    def _tenant_slice(self, client) -> int:
+        """Rung-1 backend, ingress half: a de-weighted tenant's DRIVER
+        lanes drain at ``fair_slice * weight`` frames per round-robin
+        cycle (floor 1 — the offender stays live, just slow). Workers
+        and agents are never de-weighted: stalling the data plane or
+        health checks to punish a tenant would be self-harm (the same
+        exemption the admission budget makes)."""
+        if not self._tenant_weights or client.role != "driver":
+            return self._fair_slice
+        w = self._tenant_weights.get(client.namespace or "default")
+        if w is None:
+            return self._fair_slice
+        return max(1, int(self._fair_slice * w))
+
+    def _tenant_adm_high(self, client) -> int:
+        """Rung-1 backend, admission half: the de-weighted tenant's
+        in-flight budget scales with its weight, so kernel backpressure
+        engages proportionally earlier for the offender's sockets."""
+        if not self._tenant_weights:
+            return self._adm_high
+        w = self._tenant_weights.get(client.namespace or "default")
+        if w is None:
+            return self._adm_high
+        return max(2, int(self._adm_high * w))
+
+    def _tenant_adm_low(self, client) -> int:
+        """Unblock watermark paired with ``_tenant_adm_high``: without
+        scaling, a de-weighted tenant blocking at (high * weight) <
+        adm_low would unblock on the very next drain cycle — a
+        block/unblock oscillation that spams backpressure frames
+        instead of holding the socket closed."""
+        if not self._tenant_weights or client.role != "driver":
+            return self._adm_low
+        high = self._tenant_adm_high(client)
+        if high >= self._adm_high:
+            return self._adm_low
+        return min(self._adm_low, high // 2)
+
+    def _tenant_hold_s(self, client) -> float:
+        """Rung-1 pacing half: post-unblock read-loop hold for a
+        de-weighted DRIVER lane, ~1ms x (1/weight - 1) capped at 1s
+        (weight 0.05 -> 19ms -> a budget's worth of frames per ~20ms
+        instead of per drain cycle). Zero for everyone else — the
+        plain admission path is untouched."""
+        if not self._tenant_weights or client.role != "driver":
+            return 0.0
+        w = self._tenant_weights.get(client.namespace or "default")
+        if w is None or w >= 1.0:
+            return 0.0
+        return min(1.0, 0.001 * (1.0 / w - 1.0))
+
+    def _rebalance_against(self, offender: str, max_leases: int) -> int:
+        """Rung-2 backend: revoke up to ``max_leases`` worker leases
+        held by the offender tenant's drivers — the graceful
+        ``_revoke_lease_for_rebalance`` semantics (in-flight pushes
+        finish; re-requested leases compete under the offender's
+        de-weighted ingress), TARGETED at one tenant instead of the
+        passive over-share scan."""
+        revoked = 0
+        for w in list(self.workers.values()):
+            if revoked >= max_leases:
+                break
+            owner = w.leased_to
+            if owner is None or w.conn.closed:
+                continue
+            if (owner.namespace or "default") != offender:
+                continue
+            self._revoke_lease_for_rebalance(owner, w)
+            revoked += 1
+        if revoked:
+            self._wake_scheduler()
+        return revoked
+
+    def _migrate_tenant(self, offender: str, victim: str = "") -> str:
+        """Rung-3 backend: drain the node carrying the MOST offender
+        presence (its restartable actors + leased workers), via the
+        PR 1 drain path — restartable work migrates off, the deadline
+        forces the rest. Node choice prefers nodes that also host the
+        victim (separating the pair is the point); returns the drained
+        node's hex id, or "" when no node qualifies (single-node
+        clusters: draining the only node would take the victim with
+        it)."""
+        presence: Dict[bytes, int] = {}
+        victims: Dict[bytes, int] = {}
+        for rec in self.actors.values():
+            if rec.state != A_ALIVE or rec.node_id is None:
+                continue
+            if rec.namespace == offender:
+                nid = rec.node_id.binary()
+                presence[nid] = presence.get(nid, 0) + 1
+            elif victim and rec.namespace == victim:
+                victims[rec.node_id.binary()] = 1
+        for w in self.workers.values():
+            if w.leased_to is not None and not w.conn.closed \
+                    and (w.leased_to.namespace or "default") == offender \
+                    and w.node_id is not None:
+                nid = w.node_id.binary()
+                presence[nid] = presence.get(nid, 0) + 1
+        live = {n.node_id.binary() for n in self.nodes.values()
+                if n.alive and not n.draining}
+        candidates = {nid: c for nid, c in presence.items() if nid in live}
+        if not candidates or len(live) < 2:
+            return ""
+        nid = max(candidates,
+                  key=lambda k: (candidates[k], victims.get(k, 0)))
+        node = self.nodes.get(NodeID(nid))
+        if node is None:
+            return ""
+        # The drain handler's full semantics (migration, lease
+        # revocation, gang advisory, deadline) — invoked internally:
+        # with no "i" reply id the client arg is never touched.
+        asyncio.get_running_loop().create_task(
+            self._h_drain_node(None, {
+                "node_id": nid,
+                "reason": f"slo enforcement: tenant {offender!r} "
+                          f"interfering with {victim or 'cluster'}"}))
+        return nid.hex()
+
+    async def _h_slo_register(self, client, msg):
+        """Register/replace (or remove, spec=None) a tenant's SLO spec
+        at runtime — the quota plane's runtime face for the detector."""
+        tenant = str(msg.get("tenant") or self._client_tenant(client))
+        raw = msg.get("spec")
+        if raw is None:
+            removed = self.slo.unregister(tenant)
+            client.conn.reply(msg, {"ok": True, "removed": removed})
+            return
+        try:
+            spec = self.slo.register(tenant, dict(raw))
+        except (TypeError, ValueError) as e:
+            client.conn.reply(msg, {"ok": False, "err": str(e)})
+            return
+        client.conn.reply(msg, {"ok": True, "tenant": tenant,
+                                "spec": spec})
+
+    async def _h_slo_status(self, client, msg):
+        client.conn.reply(msg, {"ok": True, **self.slo.status()})
+
+    async def _h_slo_force(self, client, msg):
+        """Drill hook: execute one enforcement rung now (journaled with
+        forced=1), or restore=1 to undo a re-weight without waiting out
+        the recover hysteresis. The tier-1 soak smoke drives its
+        deterministic enforcement action through this."""
+        offender = str(msg.get("offender") or "")
+        if msg.get("restore"):
+            had = self.slo.restore(offender)
+            client.conn.reply(msg, {"ok": True, "restored": had})
+            return
+        try:
+            rec = self.slo.force(str(msg.get("rung") or "reweight"),
+                                 offender, str(msg.get("victim") or ""))
+        except Exception as e:
+            client.conn.reply(msg, {"ok": False, "err": str(e)})
+            return
+        client.conn.reply(msg, {"ok": True, "action": rec})
 
     async def _h_lease_claim(self, client, msg):
         """A resyncing driver re-claims leases it held across a GCS
@@ -4401,6 +4607,10 @@ class GcsServer:
             "tenant_usage": {ns: {k: round(v, 6) for k, v in u.items()}
                              for ns, u in self.tenant_usage.items()},
             "quota_rejections": self.counters["quota_rejections"],
+            # Interference-SLO surface: registered specs + detector
+            # state, the live enforcement weights, and the bounded
+            # action journal (the soak certificate reads this).
+            "slo": self.slo.status(),
             "gangs": {g.name: {"generation": g.generation,
                                "status": g.status,
                                "world": len(g.members),

@@ -43,7 +43,7 @@ try:
     from ray_tpu.util.events import PLANES as _PLANES
 except Exception:  # pragma: no cover - analysis must stay importable
     _PLANES = ("task", "proto", "gcs", "lease", "wait", "bcast", "coll",
-               "serve", "rl", "pipe")
+               "serve", "rl", "pipe", "slo", "enforce")
 
 _NAME_RE = re.compile(
     r"^(" + "|".join(_PLANES) + r")\.[a-z_][a-z0-9_]*\.[a-z_][a-z0-9_]*$")

@@ -5,6 +5,14 @@ ring keeps heads local and rotates KV, Ulysses all-to-alls activations so
 each device holds *all* tokens for a slice of heads, runs dense attention
 locally, then transposes back. Cheaper than ring when H >= sp and sequences
 are moderate; ring wins at extreme lengths. Both ride the same ``sp`` axis.
+
+GQA: K/V carry ``n_kv_heads < n_q_heads``. Repeating K/V up to the query
+head count BEFORE the all-to-all inflates the K/V transpose bytes by the
+group factor (8 q-heads over 2 kv-heads move 4x the wire bytes for zero
+information). When ``n_kv_heads % sp == 0`` the head blocks stay aligned
+through the transpose, so the repeat commutes with the all-to-all: move
+the TRUE kv heads, repeat locally after. The non-divisible case falls
+back to repeat-before (correctness over bandwidth).
 """
 
 from __future__ import annotations
@@ -16,36 +24,72 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+try:  # jax >= 0.5 exports it at top level (check_vma spelling)
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        # The experimental entry point spells the replication-check
+        # flag check_rep; semantics are the same.
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+# Indirection point: the byte-count assertion test (CPU interpreter
+# path) wraps this to account per-shard all-to-all bytes without
+# touching device internals.
+_all_to_all = lax.all_to_all
+
 
 def _seq_to_heads(x: jax.Array, axis: str) -> jax.Array:
     """[B, L/n, H, D] -> [B, L, H/n, D] over the sp ring."""
-    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+    return _all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
 
 
 def _heads_to_seq(x: jax.Array, axis: str) -> jax.Array:
     """[B, L, H/n, D] -> [B, L/n, H, D]."""
-    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+    return _all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis: str = "sp", causal: bool = False,
                       scale: Optional[float] = None,
-                      attn_fn: Optional[Callable] = None) -> jax.Array:
+                      attn_fn: Optional[Callable] = None,
+                      sp_size: Optional[int] = None) -> jax.Array:
     """Sequence-parallel attention via head/sequence all-to-all.
 
     Per-device shards inside shard_map: q/k/v [B, L_local, H, D] with H
     divisible by the sp degree. ``attn_fn(q, k, v, causal, scale)`` runs the
     local dense attention (defaults to a flash-style jax implementation).
+
+    ``sp_size`` (the sp axis degree — ``make_ulysses_attention`` passes
+    it from the mesh) enables the GQA bandwidth fix: with
+    ``n_kv_heads % sp_size == 0`` K/V transit the all-to-all at their
+    true head count and are repeated to the query head count AFTER the
+    transpose. Device i's post-transpose q heads
+    ``[i*Hq/n, (i+1)*Hq/n)`` group onto kv heads
+    ``[i*Hkv/n, (i+1)*Hkv/n)`` exactly when ``Hkv % n == 0``, so the
+    local repeat reproduces the repeat-before-transpose layout bit for
+    bit. Without ``sp_size`` (or indivisible kv heads) the safe
+    repeat-before path runs.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if k.shape[2] != q.shape[2]:  # GQA: repeat KV heads to match Q heads
+    rep = 1
+    if k.shape[2] != q.shape[2]:  # GQA: kv heads < q heads
         rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        if not (sp_size and k.shape[2] % sp_size == 0):
+            # Misaligned head blocks: repeat BEFORE the transpose (pays
+            # the group factor on the wire, but always correct).
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            rep = 1
     qh = _seq_to_heads(q, axis)
     kh = _seq_to_heads(k, axis)
     vh = _seq_to_heads(v, axis)
+    if rep > 1:
+        kh = jnp.repeat(kh, rep, axis=2)
+        vh = jnp.repeat(vh, rep, axis=2)
     if attn_fn is None:
         # flash_attention == the Mosaic kernel (differentiable) on TPU
         # when the full-seq shard tiles, dense otherwise — after the
@@ -64,7 +108,8 @@ def make_ulysses_attention(mesh, *, causal: bool = True, axis: str = "sp",
     from jax.sharding import PartitionSpec as P
 
     spec = P(batch_axes, axis, None, None)
-    fn = functools.partial(ulysses_attention, axis=axis, causal=causal)
-    return jax.shard_map(
+    fn = functools.partial(ulysses_attention, axis=axis, causal=causal,
+                           sp_size=int(mesh.shape[axis]))
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

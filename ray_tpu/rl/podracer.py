@@ -85,14 +85,18 @@ class PodRunnerImpl(EnvRunnerImpl):
             self._params = ray_tpu.get(ref)  # raylint: disable=RTL001
             plane_events.emit(
                 "rl.weights.pull", plane="rl", dur=time.time() - t0,
+                tenant=plane_events.process_tenant(),
                 rank=self.rank, version=int(version),
                 staleness=int(version) - int(self._weights_version))
             self._weights_version = version
         t0 = time.time()
         out = self._collect(self._params, num_steps)
         out["weights_version"] = int(version)
+        # Tenant tag: rollout egress is one of the traffic classes the
+        # SLO interference detector attributes breaches to.
         plane_events.emit("rl.rollout.push", plane="rl",
                           dur=time.time() - t0, rank=self.rank,
+                          tenant=plane_events.process_tenant(),
                           steps=int(num_steps), version=int(version))
         return out
 

@@ -11,8 +11,11 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+import time
 import uuid
 from typing import Any, Dict, Optional
+
+from ray_tpu.util import events as plane_events
 
 from .checkpoint import Checkpoint
 
@@ -76,9 +79,22 @@ class TrainSession:
         self.mesh = mesh
         self.dataset_shards = dataset_shards or {}
         self.iteration = 0
+        self._last_report_ts: Optional[float] = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
+        # Step-boundary telemetry: report() is the train loop's step
+        # clock, and the report-to-report wall time IS the step time a
+        # train tenant's SLO gates on (slo.register(..,
+        # event="pipe.step.report", field="dur")). Tenant tag rides
+        # process_tenant() — the worker's namespace.
+        now = time.time()
+        if self._last_report_ts is not None:
+            plane_events.emit("pipe.step.report", plane="pipe",
+                              tenant=plane_events.process_tenant(),
+                              dur=now - self._last_report_ts,
+                              iteration=self.iteration)
+        self._last_report_ts = now
         ckpt_path = None
         if checkpoint is not None and self.world_rank == 0:
             # Persist into run storage (reference:

@@ -43,9 +43,14 @@ from typing import Dict, List, Optional, Tuple
 
 # The planes a row may be tagged with (the timeline groups lanes by
 # these; the --events checker treats the set as the name grammar's
-# first-segment alphabet).
+# first-segment alphabet). "slo" rows are the interference detector's
+# breach/recovery/sweep journal; "enforce" rows are the reactive
+# control plane's action journal — cause (slo.*) and action (enforce.*)
+# share one clock with every other plane, which is what lets
+# ``timeline --planes`` prove breach -> attribution -> action ->
+# recovery on a single trace.
 PLANES = ("task", "proto", "gcs", "lease", "wait", "bcast", "coll",
-          "serve", "rl", "pipe")
+          "serve", "rl", "pipe", "slo", "enforce")
 
 _lock = threading.Lock()
 _ring: List[list] = []
@@ -73,6 +78,25 @@ def _snapshot_config():
 
 def enabled() -> bool:
     return _enabled
+
+
+def process_tenant() -> str:
+    """The tenant (namespace) this process acts for — the connected
+    driver/worker's namespace, or "" when no worker is live. Emit sites
+    on tenant-less planes (broadcast chunk accounting, podracer
+    rollout egress) tag their rows with this so the GCS-side
+    interference detector can attribute a plane's traffic to a tenant
+    without the emit site threading a namespace through every call."""
+    import sys
+
+    worker_mod = sys.modules.get("ray_tpu._private.worker")
+    if worker_mod is None:
+        return ""
+    w = worker_mod._global_worker
+    if w is None:
+        return ""
+    ns = getattr(w, "namespace", "")
+    return "" if ns in ("", "default", None) else str(ns)
 
 
 def _trace_id() -> str:

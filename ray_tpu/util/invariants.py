@@ -14,14 +14,24 @@ Two layers:
 * :func:`check_host_invariants` — after shutdown: no orphaned session
   processes (a worker/agent reparented to init is a leak — its session
   is gone), and the session's /dev/shm arena actually unlinked.
+* :func:`periodic_sweep` / :class:`PeriodicSweeper` — the MID-RUN
+  subset, run continuously while a long workload is still hot (the
+  chaos runner and the soak harness both ride this): lanes and usage
+  are legitimately non-zero mid-run, so the sweep checks what must hold
+  AT EVERY INSTANT — usage within quota caps, drop counters reported
+  and bounded, retention honored, no orphaned session processes — and
+  journals each pass (and each violation, with its timestamp) as
+  ``slo.invariant.*`` plane events so the certificate's timeline shows
+  when an invariant broke, not just that it did by exit.
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
+import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 
 class InvariantViolation(AssertionError):
@@ -165,6 +175,135 @@ def orphaned_session_procs() -> List[dict]:
     pre-flight (stale zygotes from earlier hard-killed runs red out the
     chaos tier host-wide) as well as the post-shutdown host check."""
     return _session_procs()
+
+
+def periodic_sweep(*, max_drops: int = 0,
+                   raise_on_violation: bool = False) -> dict:
+    """One mid-run invariant pass against the live cluster.
+
+    The end-state core (:func:`check_cluster_invariants`) asserts the
+    DRAINED state — lanes empty, usage zero — which is exactly wrong
+    while a workload is hot. This sweep checks what must hold at every
+    instant of a healthy run:
+
+    * per-tenant quota usage never exceeds its cap (an over-charge
+      mid-run is an accounting bug no amount of draining excuses);
+    * the flight recorder's drop counters are REPORTED, and within
+      ``max_drops`` (0 = any drop is a violation — the soak's bounded-
+      drop certificate);
+    * the plane-event table honors its retention window (sweep alive);
+    * no session process has been orphaned to init on this host.
+
+    Returns ``{"ts", "ok", "violations": [..], "stats": gcs_stats}``
+    and journals the pass as a ``slo.invariant.pass`` /
+    ``slo.invariant.violate`` plane event — per-sweep violation
+    timestamps land in the same journal the breach/enforcement rows
+    use, on the same clock. With ``raise_on_violation`` the first bad
+    sweep raises :class:`InvariantViolation` instead of recording."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import events as plane_events
+
+    now = time.time()
+    violations: List[str] = []
+    stats: dict = {}
+    try:
+        stats = _gcs_stats(global_worker())
+    except Exception as e:   # mid-chaos: GCS restarting is not a breach
+        return {"ts": now, "ok": True, "skipped": f"stats unavailable: {e}",
+                "violations": []}
+    caps = stats.get("tenant_quotas") or {}
+    for ns, used in (stats.get("tenant_usage") or {}).items():
+        cap = caps.get(ns)
+        if not cap:
+            continue
+        for k, v in used.items():
+            if k in cap and v > cap[k] + 1e-6:
+                violations.append(
+                    f"tenant {ns!r} over quota: {k}={v} > cap {cap[k]}")
+    pe = stats.get("plane_events")
+    if pe is None or "drops" not in pe:
+        violations.append("plane-event drop counters unreported")
+    else:
+        dropped = sum(pe["drops"].values())
+        if dropped > max_drops:
+            violations.append(
+                f"plane-event drops beyond bound: {dropped} > "
+                f"{max_drops} ({pe['drops']})")
+        if pe["oldest_age_s"] > pe["retention_s"] + 30.0:
+            violations.append(
+                f"plane-event retention dead: oldest row "
+                f"{pe['oldest_age_s']:.1f}s vs {pe['retention_s']:.0f}s")
+    orphans = _session_procs()
+    if orphans:
+        violations.append(f"orphaned session processes: {orphans}")
+    if violations:
+        for v in violations:
+            plane_events.emit("slo.invariant.violate", plane="slo",
+                              detail=v[:240])
+        if raise_on_violation:
+            _fail("periodic sweep violated: " + "; ".join(violations))
+    else:
+        plane_events.emit("slo.invariant.pass", plane="slo")
+    return {"ts": now, "ok": not violations, "violations": violations,
+            "stats": stats}
+
+
+class PeriodicSweeper:
+    """Background driver for :func:`periodic_sweep` — the continuous
+    arm of the invariant core. Start it next to a long workload, stop
+    it before the end-state check; ``result()`` summarizes every sweep
+    (count, violations with timestamps) for the run's certificate::
+
+        sw = PeriodicSweeper(interval_s=2.0).start()
+        ... hours of workload ...
+        summary = sw.stop()
+        assert summary["violations"] == []
+    """
+
+    def __init__(self, interval_s: float = 2.0, max_drops: int = 0,
+                 on_violation: Optional[Callable[[dict], None]] = None):
+        self.interval_s = max(0.1, float(interval_s))
+        self.max_drops = int(max_drops)
+        self.on_violation = on_violation
+        self.sweeps = 0
+        self.skipped = 0
+        self.violations: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PeriodicSweeper":
+        self._thread = threading.Thread(
+            target=self._run, name="invariant-sweeper", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                row = periodic_sweep(max_drops=self.max_drops)
+            except Exception as e:   # never kill the workload from here
+                row = {"ts": time.time(), "ok": True,
+                       "skipped": f"sweep error: {e}", "violations": []}
+            if row.get("skipped"):
+                self.skipped += 1
+                continue
+            self.sweeps += 1
+            for v in row["violations"]:
+                rec = {"ts": row["ts"], "violation": v}
+                self.violations.append(rec)
+                if self.on_violation is not None:
+                    self.on_violation(rec)
+
+    def stop(self, timeout: float = 10.0) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.result()
+
+    def result(self) -> dict:
+        return {"sweeps": self.sweeps, "skipped": self.skipped,
+                "interval_s": self.interval_s,
+                "violations": list(self.violations)}
 
 
 def check_host_invariants(session_name: Optional[str] = None,

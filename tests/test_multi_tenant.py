@@ -146,6 +146,106 @@ print("OK")
 """)
 
 
+def test_quota_accounting_across_slo_migration():
+    """Tenant quota usage follows an SLO-triggered migration atomically:
+    the offender's long-running retriable task is forced off its node
+    (rung 3 drains it) and the lease charge moves with the retry — never
+    doubled mid-flight, never leaked above the cap, back to exactly the
+    task's demand on the surviving node, and to zero at teardown."""
+    _run(r"""
+import os, subprocess, sys, time
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private.worker import global_worker
+from ray_tpu.util import slo, state
+
+# Exported through the environment so the head process (and every
+# session process) sees the quota table.
+from ray_tpu._private.config import set_system_config
+set_system_config({"tenant_quotas": '{"noisy": {"CPU": 2.0}}',
+                   # Short migration window: the held task must be
+                   # FORCED off the drained node (graceful drain would
+                   # just let it finish in place).
+                   "drain_deadline_s": 2.0})
+
+c = Cluster(initialize_head=True, connect=True,
+            head_node_args={"num_cpus": 2})
+c.add_node(num_cpus=2, resources={"slot": 1})
+c.add_node(num_cpus=2, resources={"slot": 1})
+assert c.wait_for_nodes(3, timeout=120)
+assert c.wait_for_workers(1, timeout=120)
+w = global_worker()
+
+NOISY = r'''
+import sys, time
+sys.path.insert(0, "@REPO@")
+import ray_tpu
+ray_tpu.init(address=sys.argv[1], namespace="noisy", probe_tpu=False)
+
+@ray_tpu.remote(num_cpus=1, resources={"slot": 1}, max_retries=3)
+def hold(seconds):
+    import time as _t
+    from ray_tpu import get_runtime_context
+    _t.sleep(seconds)
+    return get_runtime_context().get_node_id()
+
+ref = hold.remote(8.0)
+print("READY", flush=True)
+print("LANDED=" + ray_tpu.get(ref, timeout=180), flush=True)
+'''.replace("@REPO@", %r)
+noisy = subprocess.Popen([sys.executable, "-c", NOISY, c.address],
+                         stdout=subprocess.PIPE, text=True)
+assert noisy.stdout.readline().strip() == "READY"
+
+def usage():
+    st = w.request_gcs({"t": "gcs_stats"}, timeout=15)
+    return st["tenant_usage"].get("noisy", {}).get("CPU", 0.0)
+
+# The task's lease charges the tenant exactly its demand.
+deadline = time.time() + 60
+while time.time() < deadline and usage() != 1.0:
+    time.sleep(0.05)
+assert usage() == 1.0, usage()
+busy = [x for x in state.list_workers() if x["state"] == "busy"]
+assert busy, state.list_workers()
+node0 = busy[0]["node_id"]
+
+act = slo.force("migrate", offender="noisy", victim="")
+assert act["node"] == node0, (act, node0)
+
+# Poll THROUGH the migration: the charge may transiently drop (the
+# drained lease releases before the retry's grant) but must never
+# exceed the task's demand, and must settle back to exactly 1 CPU on
+# the surviving node.
+peak, deadline, settled = 0.0, time.time() + 90, False
+while time.time() < deadline:
+    peak = max(peak, usage())
+    nodes = {n["node_id"]: n for n in state.list_nodes()}
+    busy = [x for x in state.list_workers()
+            if x["state"] == "busy" and x["node_id"] != node0]
+    if busy and usage() == 1.0 and \
+            nodes.get(node0, {}).get("state") in ("DRAINING", "DEAD"):
+        settled = True
+        break
+    time.sleep(0.05)
+assert settled, (state.list_nodes(), usage())
+assert peak <= 1.0 + 1e-6, f"quota double-charged mid-migration: {peak}"
+
+# The retried task completes on a DIFFERENT node, and the release at
+# completion returns the tenant's usage to exactly zero.
+landed = noisy.stdout.readline().strip()
+assert landed.startswith("LANDED="), landed
+assert landed[len("LANDED="):] != node0, (landed, node0)
+deadline = time.time() + 30
+while time.time() < deadline and usage() != 0.0:
+    time.sleep(0.1)
+assert usage() == 0.0, usage()
+noisy.wait(timeout=30)
+c.shutdown()
+print("OK")
+""" % (_REPO,), timeout=420)
+
+
 @pytest.mark.slow
 def test_fair_share_under_flooding_driver():
     """One tenant floods the GCS with raw control frames; the other

@@ -212,3 +212,56 @@ def test_ring_flash_gradients_match_dense(cpu_mesh8, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=3e-5, rtol=3e-5,
                                    err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("kvh,causal", [(4, False), (4, True), (2, False)])
+def test_ulysses_gqa_matches_dense(cpu_mesh8, kvh, causal):
+    """GQA through ulysses: the aligned repeat-after-transpose path
+    (kvh=4, sp=4 divides it) and the repeat-before fallback (kvh=2,
+    indivisible by sp=4) both reproduce the dense GQA reference."""
+    mesh = make_mesh(MeshSpec(sp=4), devices=cpu_mesh8[:4])
+    B, L, H, D = 2, 64, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, kvh, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, kvh, D), jnp.float32)
+    uly = make_ulysses_attention(mesh, causal=causal, batch_axes=("dp",))
+    out = uly(q, k, v)
+    expected = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gqa_all_to_all_bytes(cpu_mesh8, monkeypatch):
+    """The GQA bandwidth contract, counted at the collective: K/V
+    transit the forward all-to-all at their TRUE head count — kv bytes
+    are q bytes * (Hkv/Hq), not equal to q bytes (the repeat-before bug
+    inflated them by the group factor). CPU interpreter path: the
+    ulysses module's _all_to_all indirection is wrapped to account
+    per-shard bytes during trace."""
+    from ray_tpu.parallel import ulysses as umod
+
+    calls = []
+    real = umod._all_to_all
+
+    def spy(x, axis, *, split_axis, concat_axis, tiled):
+        calls.append((split_axis, int(x.size) * x.dtype.itemsize))
+        return real(x, axis, split_axis=split_axis,
+                    concat_axis=concat_axis, tiled=tiled)
+
+    monkeypatch.setattr(umod, "_all_to_all", spy)
+    mesh = make_mesh(MeshSpec(sp=4), devices=cpu_mesh8[:4])
+    B, L, H, KVH, D = 2, 64, 8, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, KVH, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, KVH, D), jnp.float32)
+    uly = make_ulysses_attention(mesh, causal=False, batch_axes=("dp",))
+    uly(q, k, v)
+    fwd = [b for s, b in calls if s == 2]   # q, k, v seq->heads
+    back = [b for s, b in calls if s == 1]  # out heads->seq
+    assert len(fwd) == 3 and len(back) == 1, calls
+    q_bytes, k_bytes, v_bytes = fwd
+    assert k_bytes == q_bytes * KVH // H, (q_bytes, k_bytes)
+    assert v_bytes == q_bytes * KVH // H, (q_bytes, v_bytes)
+    assert back[0] == q_bytes  # output is full q-head width

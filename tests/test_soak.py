@@ -1,0 +1,54 @@
+"""Tier-1 shape of the consolidated soak (benchmarks/soak_suite.py):
+train + serve + Podracer RL as three REAL tenant drivers on one cluster
+for a few seconds, one injected fault (a dropped spawn request, decayed
+and recovered), one FORCED enforcement action (``slo.force``, journaled
+``forced=1``) against a real flooding driver, and the continuous
+invariant sweep green throughout. The full/medium shapes behind the same
+harness produce records/SOAK_r16.json."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_soak_smoke_three_tenants_one_fault_one_forced_action():
+    out = os.path.join(tempfile.mkdtemp(), "soak_smoke.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RAY_TPU_JAX_PLATFORM="cpu")
+    env.pop("RAY_TPU_FAILPOINTS", None)
+    env.pop("RAY_TPU_FAILPOINT_SEED", None)
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/soak_suite.py", "--mode", "smoke",
+         "--seconds", "4", "--json", out],
+        capture_output=True, text=True, timeout=420, cwd=_REPO, env=env)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout[-5000:]}\nstderr:\n{proc.stderr[-5000:]}")
+
+    with open(out) as f:
+        rec = json.load(f)
+    # The harness already asserts the run-time physics; the test pins
+    # the certificate's contract so a field rename or a silently-skipped
+    # phase cannot produce a green-but-empty record.
+    assert rec["ok"] and rec["mode"] == "smoke"
+    for tenant, key in (("serve", "requests"), ("train", "steps"),
+                        ("rl", "updates")):
+        assert rec["tenants"][tenant][key] > 0, rec["tenants"]
+    assert rec["sweeps"]["sweeps"] > 0
+    assert rec["sweeps"]["violations"] == []
+    assert rec["drops"] == {} or sum(rec["drops"].values()) == 0
+    assert any("node.spawn_worker" in f for f in rec["faults"]["fired"]), \
+        rec["faults"]
+    cyc = rec["interference"][0]
+    assert cyc["action"]["forced"] is True
+    assert cyc["action"]["rung"] == "reweight"
+    assert cyc["action"]["offender"] == "noisy"
+    assert cyc["restore_ts"] > cyc["action"]["ts"]
+    # The forced rung is physically real even in the smoke shape: the
+    # flooder's ingest rate must collapse under the de-weighted lane.
+    assert cyc["flood_rate_during"] < cyc["flood_rate_before"] * 0.5, cyc
+    assert rec["invariants"] == {"end_state": "clean",
+                                 "continuous_violations": 0}
