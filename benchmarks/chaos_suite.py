@@ -697,6 +697,10 @@ SCHEDULES = [
     dict(name="store_seal_fails", tier="fast", seed=51,
          spec="store.seal=every3:raise",
          workload="puts", fault="store seal failure"),
+    dict(name="store_create_fails", tier="fast", seed=52,
+         spec="store.create=every4:raise",
+         workload="puts",
+         fault="store create failure (backpressure entry)"),
     # --- broadcast chunk serving (multi-node: slow tier)
     dict(name="bcast_short_read", tier="slow", seed=61,
          spec="bcast.serve.chunk=p0.1:short",
@@ -709,10 +713,20 @@ SCHEDULES = [
          workload="broadcast", fault="holder death mid-stripe"),
     # --- gang fault plane (generation-stamped membership + fail-fast
     #     collectives + drain-aware pipeline reshape)
+    # The gang control-plane sites ride the same run: registration /
+    # member-lost / deregistration latency in the GCS handlers and a
+    # stalled coordinator membership push, each injected exactly once
+    # while the member kill is in flight — the widened windows are the
+    # interleavings the RTL175 coverage gate demands be exercised.
     dict(name="gang_rendezvous_gap_kill", tier="fast", seed=71,
-         spec="train.collective.r2=once:kill",
+         spec=("train.collective.r2=once:kill;"
+               "gcs.gang.register=hit1:delay:0.2;"
+               "gcs.gang.member_lost=hit1:delay:0.2;"
+               "gcs.gang.deregister=hit1:delay:0.2;"
+               "collective.coord.push=hit1:delay:0.2"),
          workload="gang", config={"collective_timeout_s": 240.0},
-         fault="member kill between rendezvous and first collective"),
+         fault="member kill between rendezvous and first collective, "
+               "with gang control-plane latency injection"),
     dict(name="gang_coordinator_death_mid_allreduce", tier="fast",
          seed=72, spec="collective.coord.collect=hit12:kill",
          workload="coord_death", config={"collective_timeout_s": 120.0},
@@ -731,13 +745,20 @@ SCHEDULES = [
     #     2m+3; the re-formed pipeline is generation 2, so its
     #     admissions hit mpmd.admit.g2 — its full step burns m hits and
     #     hit m+2 stalls the 2nd admission of the DRAINED step.
+    # mpmd.boundary.recv.s2 rides along: stage 2's first boundary recv
+    # of the warm step takes an armed stall (its own fault class — the
+    # receive side of the boundary, which no schedule exercised before
+    # the RTL175 coverage gate). hit1 is per-process and the re-formed
+    # stages run disarmed, so it fires exactly once, before the kill.
     dict(name="mpmd_kill_then_drain_fast", tier="fast", seed=91,
          spec=("mpmd.boundary.send.s1=hit11:kill;"
-               "mpmd.admit.g2=hit6:delay:0.25"),
+               "mpmd.admit.g2=hit6:delay:0.25;"
+               "mpmd.boundary.recv.s2=hit1:delay:0.1"),
          workload="mpmd_kill_then_drain",
          kwargs={"n_microbatches": 4, "extra_nodes": 1},
          faults=["stage SIGKILL mid-1F1B (gang-push detection)",
-                 "drain notice mid-schedule (armed admission stall)"],
+                 "drain notice mid-schedule (armed admission stall)",
+                 "boundary recv stall (armed latency, warm step)"],
          order=["mpmd.boundary.send.s1", "mpmd.admit.g2"],
          fault="compound: stage SIGKILL + drain, one run"),
     dict(name="mpmd_kill_then_drain", tier="slow", seed=92,

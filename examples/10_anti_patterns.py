@@ -214,6 +214,56 @@ def _bad_create_seal(store, oid, sobj):
     store.seal(oid)
 
 
+# ----- RTL17x: crash-consistency & durability (also under --consistency)
+
+class _BadDurableServer:
+    """A WAL-backed server in the gcs.py shape, with the historical
+    durability bugs baked in: the handler acknowledges the mutation
+    BEFORE the WAL append (RTL171 — a crash in the reply->append window
+    forgets acked state) and publishes it to subscribers just as early
+    (RTL173); the append stages a 3-field row whose replay consumes
+    only two (RTL172 — the export-blob partial-replay shape, the third
+    field is persisted and silently dropped at every restart)."""
+
+    def __init__(self):
+        self.kv = {}
+        self.log = None
+
+    def _log_append(self, op, payload):
+        self.log.append(op, payload)
+        self.log.maybe_compact(self._make_snapshot)
+
+    def _replay_persisted(self):
+        snapshot, wal = self.log.load()
+        self.kv = dict(snapshot.get("kv", {}))
+        for op, payload in wal:
+            if op == "kv":
+                self.kv[payload[0]] = payload[1]   # payload[2]? RTL172
+
+    def _make_snapshot(self):
+        return {"kv": dict(self.kv)}
+
+    def _h_kv_put(self, conn, rid, key, value, origin):
+        self.kv[key] = value
+        conn.reply(rid, ok=True)                   # RTL171: ack first
+        self._pub("kv", key)                       # RTL173: pub first
+        self._log_append("kv", (key, value, origin))
+
+
+class _BadTypedError(RuntimeError):
+    """RTL174: multi-field ctor, formatted super().__init__ message, no
+    __reduce__ — default pickling re-calls the ctor with self.args
+    (= the one formatted string) and the typed error dies with an arity
+    error crossing the actor boundary. Fix: __reduce__ returning
+    (type(self), (<ctor args>...))."""
+
+    def __init__(self, op, generation, lost):
+        super().__init__(f"{op} lost {lost} in gen {generation}")
+        self.op = op
+        self.generation = generation
+        self.lost = lost
+
+
 def main():
     ray_tpu.init(num_cpus=4, probe_tpu=False)
 

@@ -19,6 +19,12 @@ analysis. Rule families:
   referenced by benchmarks/tests that resolve to no
   ``events.emit()/count()`` literal (and malformed names at the emit
   sites themselves).
+- **RTL17x** (``consistency.py``, ``--consistency``/``--coverage``) —
+  crash-consistency & durability: WAL-mutation acknowledged or
+  published before its append (RTL171/RTL173), append↔replay payload
+  and snapshot drift (RTL172), unpicklable cross-actor exception
+  classes (RTL174), and registered failpoint sites no chaos schedule
+  arms (RTL175, the ``--coverage`` gate).
 
 Delivery modes:
 
@@ -48,6 +54,8 @@ from .protocol_check import check_protocol, check_protocol_paths
 from .failpoint_check import check_failpoints, check_failpoint_paths
 from .event_check import check_events, check_event_paths
 from .concurrency import analyze_concurrency, check_concurrency_paths
+from .consistency import (analyze_consistency, check_consistency_paths,
+                          check_coverage, check_coverage_paths)
 from .cache import ScanCache, file_sig
 from .changed import closure_for_paths, reverse_closure
 
@@ -59,6 +67,8 @@ __all__ = [
     "warn_on_decoration", "ProjectIndex", "check_protocol",
     "check_protocol_paths", "check_failpoints", "check_failpoint_paths",
     "check_events", "check_event_paths",
-    "analyze_concurrency", "check_concurrency_paths", "ScanCache",
+    "analyze_concurrency", "check_concurrency_paths",
+    "analyze_consistency", "check_consistency_paths", "check_coverage",
+    "check_coverage_paths", "ScanCache",
     "file_sig", "closure_for_paths", "reverse_closure",
 ]

@@ -24,6 +24,13 @@ the same positional paths):
 - ``--concurrency``: ONLY the RTL14x/15x/16x interleaving families
   (``concurrency.py``) — they also run in the default scan; this mode
   is the focused committed-tree gate.
+- ``--consistency``: ONLY the RTL171-174 crash-consistency family
+  (``consistency.py``) — WAL-before-reply ordering, append↔replay
+  drift, publish-before-commit, exception picklability; also in the
+  default scan, this mode is the focused committed-tree gate.
+- ``--coverage``: the RTL175 failpoint-coverage pass — every
+  registered fire()/_fp() site must be armed by a schedule/test in
+  ``--schedules`` or carry an inline allowlist with a reason.
 
 Scoping/caching:
 
@@ -101,6 +108,21 @@ def add_arguments(parser: argparse.ArgumentParser):
                         "error paths) over the given paths — the "
                         "focused committed-tree gate (they also run in "
                         "the default scan)")
+    parser.add_argument("--consistency", action="store_true",
+                        help="run ONLY the RTL171-174 crash-"
+                        "consistency family (WAL-before-reply "
+                        "ordering, append↔replay drift, publish-"
+                        "before-commit, exception picklability) over "
+                        "the given paths — the focused committed-tree "
+                        "gate (they also run in the default scan)")
+    parser.add_argument("--coverage", action="store_true",
+                        help="run the RTL175 failpoint-coverage pass "
+                        "instead of the per-file rules: every "
+                        "failpoints.fire()/_fp() site registered in "
+                        "the given paths must be armed by a chaos "
+                        "schedule or test in --schedules, or carry an "
+                        "inline allowlist "
+                        "(# raylint: disable=RTL175 (<reason>))")
     parser.add_argument("--changed", nargs="?", const="HEAD",
                         default=None, metavar="REF",
                         help="report only findings in files changed vs "
@@ -112,7 +134,7 @@ def add_arguments(parser: argparse.ArgumentParser):
                         help="stat-keyed ((path, mtime, size)) per-file "
                         "findings cache for the DEFAULT scan "
                         "(--protocol/--failpoints/--events/"
-                        "--concurrency ignore "
+                        "--concurrency/--consistency/--coverage ignore "
                         "it); cross-file findings are always recomputed "
                         "(default file: .raylint_cache.json)")
     return parser
@@ -144,7 +166,8 @@ def run_check(args) -> int:
 
     skipped: List[str] = []
     on_error = lambda p, e: skipped.append(f"{p}: {e}")  # noqa: E731
-    if args.protocol or args.failpoints or args.events or args.concurrency:
+    if (args.protocol or args.failpoints or args.events
+            or args.concurrency or args.consistency or args.coverage):
         # project-scope passes replace the per-file rules: they answer a
         # different question (cross-file contracts) over the same paths.
         findings = []
@@ -170,6 +193,17 @@ def run_check(args) -> int:
 
             findings.extend(check_concurrency_paths(args.paths,
                                                     on_error=on_error))
+        if args.consistency:
+            from .consistency import check_consistency_paths
+
+            findings.extend(check_consistency_paths(args.paths,
+                                                    on_error=on_error))
+        if args.coverage:
+            from .consistency import check_coverage_paths
+
+            sched = [s for s in args.schedules.split(",") if s]
+            findings.extend(check_coverage_paths(
+                args.paths, sched, on_error=on_error))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     else:
         rules = _selected_rules(args)

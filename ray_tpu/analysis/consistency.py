@@ -1,0 +1,1022 @@
+"""RTL17x: crash-consistency & durability analysis.
+
+Every durability bug the chaos suite has caught so far was one of four
+shapes, each found *dynamically*, one seeded schedule at a time: inline
+values acknowledged to the client but lost by a pre-WAL crash, export
+blobs "replayed" when only part of the staged payload was consumed,
+subscribers told about state a restart then forgot, and typed errors
+that died in pickling on their way across the actor boundary. This
+family makes those shapes checkable at write time, grounded in the
+``_private/gcs.py`` / ``gcs_persistence.py`` durability contract:
+
+- **RTL171 — reply-before-WAL-append** (error): a handler of the
+  durable class mutates a WAL-persisted table (the tables the
+  ``snapshot, wal = self.log.load()`` / ``for op, payload in wal:``
+  path restores) and sends its reply before the corresponding
+  ``_log_append``. A crash in the reply→append window — exactly what
+  the ``gcs.wal.before``/``gcs.wal.after`` failpoints probe —
+  acknowledges a mutation the restart forgets: the client holds an ok
+  for state that no longer exists.
+
+- **RTL172 — append↔replay drift** (error): the WAL is only as durable
+  as its replay. Three sub-contracts: every op literal passed to
+  ``_log_append("<op>", ...)`` must have a replay branch; every field
+  staged into a literal payload must be consumed at replay (the PR 7/8
+  export-blob shape: payload rows carried fields replay silently
+  dropped); and the snapshot serializer's key set must match what
+  replay deserializes — both directions.
+
+- **RTL173 — publish-before-WAL-append** (error): a pubsub publish /
+  plane-event emit advertising a durable state change ordered before
+  its WAL append. Subscribers can observe — and act on — state a
+  crash-restart forgets; the replay-side world then disagrees with
+  every listener.
+
+- **RTL174 — unpicklable cross-actor exception** (error): typed
+  exception classes cross the actor boundary by pickle; default
+  ``Exception`` pickling re-calls the ctor with ``self.args`` — which
+  ``super().__init__(formatted message)`` has reduced to one string.
+  Any project exception with a multi-field ctor must define
+  ``__reduce__`` (or inherit one from a project base) or the typed
+  plane (``CollectiveError``/``PipelineMemberLost``) degrades to
+  arity errors inside serialization.
+
+- **RTL175 — never-fired failpoint site** (error, ``--coverage``
+  only): the reverse direction RTL131 never checks — every registered
+  ``failpoints.fire()``/``_fp()`` site that no chaos schedule or test
+  arms is a coverage gap: the recovery path behind it has never once
+  been exercised. Allowlist a deliberately unarmed site inline:
+  ``failpoints.fire("x.y")  # raylint: disable=RTL175 (<reason>)``.
+
+Ordering (RTL171/173) is branch-aware but deliberately linear inside a
+path: events in *sibling arms of the same ``if``* are unordered (an
+error-reply in the else-branch of a mutation is clean); everything
+else orders by source position — ``try`` bodies and their handlers ARE
+ordered (an except runs after any prefix of the body). Mutation is
+counted only when a handler touches a WAL table *directly*; a helper
+that both mutates and appends (``_obj_put_one``) is sound by its own
+internal ordering, which this pass checks where the helper replies.
+
+Suppress any finding inline with ``# raylint: disable=RTL17x`` plus a
+reason — ``ray_tpu check ray_tpu --consistency`` is the committed-tree
+gate, ``ray_tpu check ray_tpu --coverage`` the failpoint-coverage one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, Rule, register_rule
+from .project import ClassDef, FuncDef, ModuleInfo, ProjectIndex
+
+CONSISTENCY_RULE_IDS = ("RTL171", "RTL172", "RTL173", "RTL174")
+
+_PER_FN_CAP = 6  # findings per (function, rule): evidence, not spam
+
+
+@register_rule
+class ReplyBeforeWalAppend(Rule):
+    """Metadata carrier for RTL171 (fired by the consistency pass)."""
+
+    id = "RTL171"
+    severity = "error"
+    name = "reply-before-wal-append"
+    hint = ("a crash between the reply and the append (the gcs.wal.before "
+            "window) acknowledges a mutation the restart forgets: order "
+            "mutate -> _log_append -> reply, so the client's ok implies "
+            "durability")
+
+
+@register_rule
+class AppendReplayDrift(Rule):
+    """Metadata carrier for RTL172 (consistency pass)."""
+
+    id = "RTL172"
+    severity = "error"
+    name = "append-replay-drift"
+    hint = ("the WAL is only as durable as its replay: every appended op "
+            "needs a replay branch, every staged payload field must be "
+            "consumed at replay, and snapshot serialize/deserialize key "
+            "sets must match (the export-blob partial-replay shape)")
+
+
+@register_rule
+class PublishBeforeWalAppend(Rule):
+    """Metadata carrier for RTL173 (consistency pass)."""
+
+    id = "RTL173"
+    severity = "error"
+    name = "publish-before-wal-append"
+    hint = ("subscribers observe state a crash-restart forgets: append to "
+            "the WAL before publishing the change (pubsub publish / "
+            "plane-event emit), so every observer's view is replayable")
+
+
+@register_rule
+class UnpicklableCrossActorException(Rule):
+    """Metadata carrier for RTL174 (consistency pass)."""
+
+    id = "RTL174"
+    severity = "error"
+    name = "unpicklable-cross-actor-exception"
+    hint = ("default Exception pickling re-calls the ctor with self.args "
+            "(= the formatted message): define __reduce__ returning "
+            "(type(self), (<ctor args>...)) so the typed error survives "
+            "the actor boundary")
+
+
+@register_rule
+class NeverFiredFailpointSite(Rule):
+    """Metadata carrier for RTL175 (``--coverage`` pass)."""
+
+    id = "RTL175"
+    severity = "error"
+    name = "never-fired-failpoint-site"
+    hint = ("no chaos schedule or test arms this registered site — the "
+            "recovery path behind it has never been exercised; add a "
+            "seeded schedule (benchmarks/chaos_suite.py) or allowlist "
+            "deliberately: # raylint: disable=RTL175 (<reason>)")
+
+
+# ---------------------------------------------------------- durable core
+
+def _self_attr(node) -> Optional[str]:
+    """``self.X`` -> "X" (one level only)."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+_MUTATOR_METHODS = {"pop", "popitem", "clear", "update", "setdefault"}
+
+
+def _direct_table_mutations(fn_node) -> Set[str]:
+    """Attrs ``self.X`` a function mutates as a *container*: subscript
+    assignment/deletion and dict-mutator method calls."""
+    out: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    a = _self_attr(t.value)
+                    if a is not None:
+                        out.add(a)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    a = _self_attr(t.value)
+                    if a is not None:
+                        out.add(a)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS):
+            a = _self_attr(node.func.value)
+            if a is not None:
+                out.add(a)
+    return out
+
+
+def _self_method_calls(fn_node) -> List[Tuple[str, ast.Call]]:
+    """``self.m(...)`` calls in a function body."""
+    out = []
+    for node in ast.walk(fn_node):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            out.append((node.func.attr, node))
+    return out
+
+
+def _is_append_call(node: ast.Call) -> bool:
+    """``self._log_append(...)`` or ``self.log.append(...)``."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if fn.attr == "_log_append" and _self_attr(fn) == "_log_append":
+        return True
+    if (fn.attr == "append" and isinstance(fn.value, ast.Attribute)
+            and _self_attr(fn.value) is not None
+            and "log" in fn.value.attr):
+        return True
+    return False
+
+
+class DurableCore:
+    """One class with a WAL: its replay function, restored tables,
+    replay branches, append sites, and snapshot contract."""
+
+    def __init__(self, mod: ModuleInfo, cls: ClassDef, replay: FuncDef):
+        self.mod = mod
+        self.cls = cls
+        self.replay = replay
+        self.snapshot_var: Optional[str] = None
+        self.wal_var: Optional[str] = None
+        self.op_var: Optional[str] = None
+        self.payload_var: Optional[str] = None
+        # op -> branch body (list of stmts) in the replay loop
+        self.replay_branches: Dict[str, Tuple[int, list]] = {}
+        # op -> [(payload_node, lineno)] over literal-op append calls
+        self.append_sites: Dict[str, List[Tuple[ast.Call, int]]] = {}
+        # WAL-persisted table attrs (restored by replay, directly or
+        # through one-hop same-class restore helpers)
+        self.tables: Set[str] = set()
+        self.snapshot_maker: Optional[FuncDef] = None
+
+
+def _find_replay(cls: ClassDef) -> Optional[Tuple[FuncDef, str, str]]:
+    """The method holding ``snap, wal = <x>.load()``; returns
+    (fn, snapshot_var, wal_var)."""
+    for fd in cls.methods.values():
+        for node in ast.walk(fd.node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr == "load"
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Tuple)
+                    and len(node.targets[0].elts) == 2
+                    and all(isinstance(e, ast.Name)
+                            for e in node.targets[0].elts)):
+                continue
+            snap_var = node.targets[0].elts[0].id
+            wal_var = node.targets[0].elts[1].id
+            return fd, snap_var, wal_var
+    return None
+
+
+def _replay_loop(fd: FuncDef, wal_var: str):
+    """The ``for op, payload in wal:`` loop; (loop, op_var, payload_var)."""
+    for node in ast.walk(fd.node):
+        if (isinstance(node, ast.For)
+                and isinstance(node.iter, ast.Name)
+                and node.iter.id == wal_var
+                and isinstance(node.target, ast.Tuple)
+                and len(node.target.elts) == 2
+                and all(isinstance(e, ast.Name)
+                        for e in node.target.elts)):
+            return (node, node.target.elts[0].id, node.target.elts[1].id)
+    return None
+
+
+def _op_branches(loop: ast.For, op_var: str) -> Dict[str, Tuple[int, list]]:
+    """``if op == "<lit>": <body>`` branches (elif chains included)."""
+    out: Dict[str, Tuple[int, list]] = {}
+
+    def visit_if(stmt):
+        if not isinstance(stmt, ast.If):
+            return
+        t = stmt.test
+        if (isinstance(t, ast.Compare) and isinstance(t.left, ast.Name)
+                and t.left.id == op_var and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Eq)
+                and len(t.comparators) == 1
+                and isinstance(t.comparators[0], ast.Constant)
+                and isinstance(t.comparators[0].value, str)):
+            out.setdefault(t.comparators[0].value,
+                           (stmt.lineno, stmt.body))
+        for s in stmt.orelse:
+            visit_if(s)
+
+    for s in loop.body:
+        visit_if(s)
+    return out
+
+
+def _collect_append_sites(cls: ClassDef) -> Dict[str, List[Tuple[ast.Call,
+                                                                 int]]]:
+    out: Dict[str, List[Tuple[ast.Call, int]]] = {}
+    for fd in cls.methods.values():
+        for node in ast.walk(fd.node):
+            if not (isinstance(node, ast.Call) and _is_append_call(node)):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue  # the forwarding wrapper itself (op is a Name)
+            out.setdefault(node.args[0].value, []).append(
+                (node, node.lineno))
+    return out
+
+
+def find_durable_cores(index: ProjectIndex) -> List[DurableCore]:
+    cores: List[DurableCore] = []
+    for mod in index.modules.values():
+        for cls in mod.classes.values():
+            hit = _find_replay(cls)
+            if hit is None:
+                continue
+            fd, snap_var, wal_var = hit
+            loop = _replay_loop(fd, wal_var)
+            core = DurableCore(mod, cls, fd)
+            core.snapshot_var = snap_var
+            core.wal_var = wal_var
+            core.append_sites = _collect_append_sites(cls)
+            if not core.append_sites:
+                continue  # a loader without a WAL writer is not a core
+            if loop is not None:
+                loop_node, core.op_var, core.payload_var = loop
+                core.replay_branches = _op_branches(loop_node, core.op_var)
+            # restored tables: direct mutations in the replay fn + one
+            # hop into same-class helpers it calls (_restore_actor ...)
+            core.tables = _direct_table_mutations(fd.node)
+            for mname, _ in _self_method_calls(fd.node):
+                helper = cls.methods.get(mname)
+                if helper is not None and helper is not fd:
+                    core.tables |= _direct_table_mutations(helper.node)
+            # the snapshot maker: the method handed to maybe_compact /
+            # compact, else a method named _make_snapshot
+            for fd2 in cls.methods.values():
+                for node in ast.walk(fd2.node):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("maybe_compact",
+                                                   "compact")):
+                        for arg in node.args:
+                            a = _self_attr(arg)
+                            if a is not None and a in cls.methods:
+                                core.snapshot_maker = cls.methods[a]
+                            elif (isinstance(arg, ast.Call)):
+                                a2 = _self_attr(arg.func)
+                                if a2 is not None and a2 in cls.methods:
+                                    core.snapshot_maker = cls.methods[a2]
+            if core.snapshot_maker is None:
+                core.snapshot_maker = cls.methods.get("_make_snapshot")
+            cores.append(core)
+    return cores
+
+
+# ------------------------------------------------ ordered event extraction
+
+class _Event:
+    __slots__ = ("kind", "pos", "line", "frames", "detail")
+
+    def __init__(self, kind, pos, line, frames, detail=""):
+        self.kind = kind
+        self.pos = pos
+        self.line = line
+        self.frames = frames  # tuple of (if-node-id, arm) for exclusivity
+        self.detail = detail
+
+
+# plane-event recorder bindings (mirrors event_check._EMITTER_BASES)
+_EMITTER_BASES = {"events", "plane_events", "_events", "ev"}
+
+
+def _is_reply_call(node: ast.Call) -> bool:
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "reply")
+
+
+def _is_publish_call(node: ast.Call) -> bool:
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if fn.attr in ("_pub", "_pub_actor") and _self_attr(fn) is not None:
+        return True
+    if fn.attr == "publish":
+        return True
+    if (fn.attr in ("emit", "count") and isinstance(fn.value, ast.Name)
+            and fn.value.id in _EMITTER_BASES):
+        return True
+    return False
+
+
+def _call_mutation_detail(node, tables: Set[str]) -> Optional[str]:
+    """WAL-table name a statement directly mutates, else None."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        tgts = (node.targets if isinstance(node, ast.Assign)
+                else [node.target])
+        for t in tgts:
+            if isinstance(t, ast.Subscript):
+                a = _self_attr(t.value)
+                if a in tables:
+                    return a
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                a = _self_attr(t.value)
+                if a in tables:
+                    return a
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATOR_METHODS):
+        a = _self_attr(node.func.value)
+        if a in tables:
+            return a
+    return None
+
+
+def _extract_events(fd: FuncDef, core: DurableCore,
+                    appending_methods: Set[str]) -> List[_Event]:
+    """Ordered MUTATE/APPEND/REPLY/PUB events with branch frames.
+
+    Only ``if``/``elif`` arms are exclusive; try-bodies and their
+    handlers are ordered (an except runs after any prefix of the body).
+    """
+    events: List[_Event] = []
+    counter = [0]
+
+    def emit(kind, node, frames, detail=""):
+        counter[0] += 1
+        events.append(_Event(kind, counter[0],
+                             getattr(node, "lineno", 0), frames, detail))
+
+    def scan_expr(node, frames):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_append_call(sub):
+                emit("APPEND", sub, frames)
+            elif (isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "self"
+                    and sub.func.attr in appending_methods):
+                # helper that appends internally (e.g. _obj_put_one)
+                emit("APPEND", sub, frames)
+            elif _is_reply_call(sub):
+                emit("REPLY", sub, frames)
+            elif _is_publish_call(sub):
+                emit("PUB", sub, frames)
+            d = _call_mutation_detail(sub, core.tables)
+            if d is not None:
+                emit("MUTATE", sub, frames, d)
+
+    def scan_stmt(st, frames):
+        d = _call_mutation_detail(st, core.tables)
+        if d is not None:
+            emit("MUTATE", st, frames, d)
+        if isinstance(st, ast.If):
+            scan_expr(st.test, frames)
+            fid = id(st)
+            for s in st.body:
+                scan_stmt(s, frames + ((fid, 0),))
+            for s in st.orelse:
+                scan_stmt(s, frames + ((fid, 1),))
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested scopes are their own functions
+        if isinstance(st, ast.Try):
+            for s in st.body:
+                scan_stmt(s, frames)
+            for h in st.handlers:
+                for s in h.body:
+                    scan_stmt(s, frames)
+            for s in st.orelse + st.finalbody:
+                scan_stmt(s, frames)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(st, ast.While):
+                scan_expr(st.test, frames)
+            else:
+                scan_expr(st.iter, frames)
+            for s in st.body + st.orelse:
+                scan_stmt(s, frames)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                scan_expr(item.context_expr, frames)
+            for s in st.body:
+                scan_stmt(s, frames)
+            return
+        # leaf statement: scan expressions for calls
+        scan_expr(st, frames)
+
+    for s in fd.node.body:
+        scan_stmt(s, ())
+    return events
+
+
+def _ordered(a: _Event, b: _Event) -> bool:
+    """True when ``a`` precedes ``b`` on some real execution path —
+    i.e. not in sibling arms of the same ``if``, and earlier in
+    traversal order."""
+    for fa, fb in zip(a.frames, b.frames):
+        if fa == fb:
+            continue
+        if fa[0] == fb[0] and fa[1] != fb[1]:
+            return False  # sibling arms of one if: exclusive
+        break
+    return a.pos < b.pos
+
+
+# --------------------------------------------------- RTL171/RTL173 checks
+
+def _appending_methods(cls: ClassDef) -> Set[str]:
+    """Method names that (directly) perform a WAL append — calls to
+    them count as an append at the call site (``_obj_put_one``)."""
+    out: Set[str] = set()
+    for name, fd in cls.methods.items():
+        for node in ast.walk(fd.node):
+            if (isinstance(node, ast.Call) and _is_append_call(node)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)):
+                out.add(name)
+                break
+    return out
+
+
+def _check_ordering(core: DurableCore, findings: List[Finding]):
+    appenders = _appending_methods(core.cls)
+    for fd in core.cls.methods.values():
+        if fd is core.replay:
+            continue
+        events = _extract_events(fd, core, appenders)
+        mutations = [e for e in events if e.kind == "MUTATE"]
+        if not mutations:
+            continue
+        appends = [e for e in events if e.kind == "APPEND"]
+        per_rule: Dict[str, int] = {}
+        for kind, rule_cls, what in (
+                ("REPLY", ReplyBeforeWalAppend, "sends its reply"),
+                ("PUB", PublishBeforeWalAppend,
+                 "publishes the change")):
+            for ev in (e for e in events if e.kind == kind):
+                mut = next((m for m in mutations if _ordered(m, ev)),
+                           None)
+                if mut is None:
+                    continue
+                covered = any(_ordered(ap, ev) for ap in appends)
+                if covered:
+                    continue
+                n = per_rule.get(rule_cls.id, 0)
+                if n >= _PER_FN_CAP:
+                    break
+                per_rule[rule_cls.id] = n + 1
+                findings.append(Finding(
+                    rule=rule_cls.id, severity=rule_cls.severity,
+                    path=core.mod.path, line=ev.line, col=0,
+                    message=(
+                        f"{fd.qualname} mutates WAL-persisted table "
+                        f"`self.{mut.detail}` (line {mut.line}) but "
+                        f"{what} before any WAL append — a crash in "
+                        f"between {'acknowledges' if kind == 'REPLY' else 'advertises'} "
+                        f"a mutation the restart forgets"),
+                    hint=rule_cls.hint))
+
+
+# ----------------------------------------------------------- RTL172 check
+
+def _names_consuming(body_nodes: Iterable, var: str,
+                     cls: ClassDef, depth: int = 0
+                     ) -> Tuple[Set[object], bool]:
+    """(consumed keys/indices, whole_value_used) for ``var`` across
+    ``body_nodes``; follows one hop into same-class helpers the value
+    is passed to (``self._restore_pg(payload)``)."""
+    consumed: Set[object] = set()
+    whole = False
+    for root in body_nodes:
+        # First pass: keyed/indexed consumption. The Name child of a
+        # matched Subscript/.get must NOT also count as a whole-value
+        # use in the second pass (ast.walk visits it separately).
+        keyed_names: Set[int] = set()
+        for node in ast.walk(root):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == var):
+                keyed_names.add(id(node.value))
+                if isinstance(node.slice, ast.Constant):
+                    consumed.add(node.slice.value)
+                else:
+                    whole = True  # dynamic access: assume all consumed
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == var
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)):
+                keyed_names.add(id(node.func.value))
+                consumed.add(node.args[0].value)
+        for node in ast.walk(root):
+            if (isinstance(node, ast.Name) and node.id == var
+                    and id(node) not in keyed_names):
+                # any other use: passed whole into a helper / ctor
+                parent_call = None
+                if depth < 1:
+                    parent_call = _enclosing_self_call(root, node, cls)
+                if parent_call is not None:
+                    helper, param = parent_call
+                    c2, w2 = _names_consuming([helper.node], param, cls,
+                                              depth + 1)
+                    consumed |= c2
+                    whole = whole or w2
+                else:
+                    whole = True
+    return consumed, whole
+
+
+def _enclosing_self_call(root, name_node, cls: ClassDef):
+    """If ``name_node`` is an argument of ``self.helper(<name>)`` where
+    helper is a same-class method, return (helper FuncDef, param name)."""
+    for node in ast.walk(root):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            continue
+        for i, arg in enumerate(node.args):
+            if arg is name_node:
+                helper = cls.methods.get(node.func.attr)
+                if helper is None:
+                    return None
+                params = [a.arg for a in helper.node.args.args
+                          if a.arg != "self"]
+                if i < len(params):
+                    return helper, params[i]
+    return None
+
+
+def _subscript_only_keys(body_nodes: Iterable, var: str,
+                         cls: ClassDef) -> Set[object]:
+    """Keys consumed via hard subscript (``p["k"]``, not ``.get``) —
+    these KeyError at replay if never staged. One helper hop."""
+    out: Set[object] = set()
+    for root in body_nodes:
+        for node in ast.walk(root):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == var
+                    and isinstance(node.slice, ast.Constant)):
+                out.add(node.slice.value)
+            elif isinstance(node, ast.Name) and node.id == var:
+                hop = _enclosing_self_call(root, node, cls)
+                if hop is not None:
+                    helper, param = hop
+                    for sub in ast.walk(helper.node):
+                        if (isinstance(sub, ast.Subscript)
+                                and isinstance(sub.value, ast.Name)
+                                and sub.value.id == param
+                                and isinstance(sub.slice, ast.Constant)):
+                            out.add(sub.slice.value)
+    return out
+
+
+def _check_drift(core: DurableCore, findings: List[Finding]):
+    mod = core.mod
+    # (a) appended op with no replay branch / (b) dead replay branch
+    for op, sites in sorted(core.append_sites.items()):
+        if op in core.replay_branches:
+            continue
+        node, line = sites[0]
+        findings.append(Finding(
+            rule="RTL172", severity="error", path=mod.path, line=line,
+            col=node.col_offset,
+            message=(f"op {op!r} is appended to the WAL but has no "
+                     f"replay branch in {core.replay.qualname} — the "
+                     f"mutation is written durably and then ignored at "
+                     f"restart"),
+            hint=AppendReplayDrift.hint))
+    for op, (line, _body) in sorted(core.replay_branches.items()):
+        if op in core.append_sites:
+            continue
+        findings.append(Finding(
+            rule="RTL172", severity="error", path=mod.path, line=line,
+            col=0,
+            message=(f"replay branch for op {op!r} has no append site — "
+                     f"dead replay code (or the appender was renamed "
+                     f"without the replay following)"),
+            hint=AppendReplayDrift.hint))
+    # (c) staged payload fields vs replay consumption
+    for op, sites in sorted(core.append_sites.items()):
+        branch = core.replay_branches.get(op)
+        if branch is None or core.payload_var is None:
+            continue
+        _bline, body = branch
+        consumed, whole = _names_consuming(body, core.payload_var,
+                                           core.cls)
+        for node, line in sites:
+            if len(node.args) < 2:
+                continue
+            payload = node.args[1]
+            if isinstance(payload, (ast.List, ast.Tuple)):
+                if whole:
+                    continue
+                n = len(payload.elts)
+                idx_used = {c for c in consumed if isinstance(c, int)}
+                for i in range(n):
+                    if i not in idx_used:
+                        findings.append(Finding(
+                            rule="RTL172", severity="error",
+                            path=mod.path, line=line,
+                            col=node.col_offset,
+                            message=(
+                                f"op {op!r} stages payload[{i}] but the "
+                                f"replay branch never consumes it — "
+                                f"the field is persisted and silently "
+                                f"dropped at restart (partial-replay "
+                                f"drift)"),
+                            hint=AppendReplayDrift.hint))
+                for i in sorted(idx_used):
+                    if i >= n:
+                        findings.append(Finding(
+                            rule="RTL172", severity="error",
+                            path=mod.path, line=line,
+                            col=node.col_offset,
+                            message=(
+                                f"replay of op {op!r} reads "
+                                f"payload[{i}] but only {n} field(s) "
+                                f"are staged — IndexError (or stale "
+                                f"data) at restart"),
+                            hint=AppendReplayDrift.hint))
+            elif (isinstance(payload, ast.Dict)
+                    and all(isinstance(k, ast.Constant)
+                            for k in payload.keys)):
+                if whole:
+                    continue
+                staged = {k.value for k in payload.keys}
+                key_used = {c for c in consumed if isinstance(c, str)}
+                for k in sorted(staged - key_used):
+                    findings.append(Finding(
+                        rule="RTL172", severity="error", path=mod.path,
+                        line=line, col=node.col_offset,
+                        message=(
+                            f"op {op!r} stages payload field {k!r} but "
+                            f"the replay branch never consumes it — "
+                            f"persisted and silently dropped at "
+                            f"restart (partial-replay drift)"),
+                        hint=AppendReplayDrift.hint))
+                hard = _subscript_only_keys(body, core.payload_var,
+                                            core.cls)
+                for k in sorted(k for k in hard
+                                if isinstance(k, str)
+                                and k not in staged):
+                    findings.append(Finding(
+                        rule="RTL172", severity="error", path=mod.path,
+                        line=line, col=node.col_offset,
+                        message=(
+                            f"replay of op {op!r} subscripts payload"
+                            f"[{k!r}] which this append site never "
+                            f"stages — KeyError at restart"),
+                        hint=AppendReplayDrift.hint))
+    # (d) snapshot serialize/deserialize key sets
+    maker = core.snapshot_maker
+    if maker is None or core.snapshot_var is None:
+        return
+    ret_dict = None
+    for node in ast.walk(maker.node):
+        if (isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Dict)
+                and all(isinstance(k, ast.Constant)
+                        for k in node.value.keys)):
+            ret_dict = node
+            break
+    if ret_dict is None:
+        return
+    staged = {k.value for k in ret_dict.value.keys}
+    consumed: Set[str] = set()
+    for node in ast.walk(core.replay.node):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == core.snapshot_var
+                and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            consumed.add(node.args[0].value)
+        elif (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == core.snapshot_var
+                and isinstance(node.slice, ast.Constant)):
+            consumed.add(node.slice.value)
+    for k in sorted(staged - consumed):
+        findings.append(Finding(
+            rule="RTL172", severity="error", path=core.mod.path,
+            line=ret_dict.lineno, col=ret_dict.col_offset,
+            message=(f"snapshot serializes key {k!r} which "
+                     f"{core.replay.qualname} never deserializes — the "
+                     f"table vanishes at every compaction+restart"),
+            hint=AppendReplayDrift.hint))
+    for k in sorted(consumed - staged):
+        findings.append(Finding(
+            rule="RTL172", severity="error", path=core.mod.path,
+            line=core.replay.lineno, col=0,
+            message=(f"{core.replay.qualname} deserializes snapshot key "
+                     f"{k!r} which {maker.qualname} never serializes — "
+                     f"restored as empty after every compaction"),
+            hint=AppendReplayDrift.hint))
+
+
+# ----------------------------------------------------------- RTL174 check
+
+_BUILTIN_EXC = {"Exception", "BaseException", "RuntimeError",
+                "ValueError", "TypeError", "KeyError", "OSError",
+                "IOError", "ConnectionError", "TimeoutError",
+                "InterruptedError", "ArithmeticError", "LookupError"}
+
+
+def _is_exception_class(index: ProjectIndex, mod: ModuleInfo,
+                        cls: ClassDef, _depth: int = 0) -> bool:
+    if _depth >= 5:
+        return False
+    for base in cls.bases:
+        if base in _BUILTIN_EXC or base.endswith("Error") \
+                or base.endswith("Exception"):
+            return True
+        bcd = index.class_of(mod, base)
+        if bcd is not None and _is_exception_class(
+                index, bcd.module, bcd, _depth + 1):
+            return True
+    return False
+
+
+def _has_reduce(index: ProjectIndex, mod: ModuleInfo, cls: ClassDef,
+                _depth: int = 0) -> bool:
+    if "__reduce__" in cls.methods or "__reduce_ex__" in cls.methods \
+            or "__getnewargs__" in cls.methods:
+        return True
+    if _depth >= 5:
+        return False
+    for base in cls.bases:
+        bcd = index.class_of(mod, base)
+        if bcd is not None and _has_reduce(index, bcd.module, bcd,
+                                           _depth + 1):
+            return True
+    return False
+
+
+def _check_exceptions(index: ProjectIndex, findings: List[Finding]):
+    for mod in index.modules.values():
+        for cls in mod.classes.values():
+            init = cls.methods.get("__init__")
+            if init is None:
+                continue
+            params = [a.arg for a in init.node.args.args
+                      if a.arg != "self"]
+            params += [a.arg for a in init.node.args.kwonlyargs]
+            if init.node.args.vararg is not None:
+                params.append(init.node.args.vararg.arg)
+            if len(params) < 2:
+                continue  # Cls(msg) round-trips through args fine
+            if not _is_exception_class(index, mod, cls):
+                continue
+            if _has_reduce(index, mod, cls):
+                continue
+            findings.append(Finding(
+                rule="RTL174", severity="error", path=mod.path,
+                line=cls.node.lineno, col=cls.node.col_offset,
+                message=(
+                    f"exception class {cls.name} has a "
+                    f"{len(params)}-field ctor but no __reduce__: "
+                    f"default pickling re-calls "
+                    f"{cls.name}(*self.args) with the formatted "
+                    f"message — the typed error dies (or degrades to "
+                    f"garbage fields) crossing the actor boundary"),
+                hint=UnpicklableCrossActorException.hint))
+
+
+# ------------------------------------------------------------ entry points
+
+def analyze_consistency(index: ProjectIndex,
+                        rule_ids=None) -> List[Finding]:
+    """Run RTL171-174 over a project index (RTL175 is the separate
+    ``--coverage`` pass: it needs schedule paths)."""
+    want = (set(rule_ids) if rule_ids is not None
+            else set(CONSISTENCY_RULE_IDS))
+    if not want & set(CONSISTENCY_RULE_IDS):
+        return []
+    findings: List[Finding] = []
+    if want & {"RTL171", "RTL172", "RTL173"}:
+        for core in find_durable_cores(index):
+            if want & {"RTL171", "RTL173"}:
+                _check_ordering(core, findings)
+            if "RTL172" in want:
+                _check_drift(core, findings)
+    if "RTL174" in want:
+        _check_exceptions(index, findings)
+    if rule_ids is not None:
+        findings = [f for f in findings if f.rule in want]
+    # inline suppressions via the standard comment
+    out = []
+    for f in findings:
+        mod = index.by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def check_consistency_paths(paths: Sequence[str],
+                            on_error=None) -> List[Finding]:
+    """CLI entry (``ray_tpu check --consistency``): the RTL171-174
+    family over a fresh project index of ``paths`` — the focused
+    committed-tree gate (the family also runs in the default scan)."""
+    index = ProjectIndex.build(paths, on_error=on_error)
+    return analyze_consistency(index)
+
+
+# ------------------------------------------------------ RTL175 (--coverage)
+
+# Lint-fixture test files embed deliberately synthetic or typo'd
+# schedule strings (testing the checkers themselves) — their "arms"
+# must not count as coverage, and their synthetic sites must not count
+# as gaps.
+COVERAGE_EXCLUDES = ("test_failpoints.py", "test_static_analysis.py",
+                     "test_concurrency_lint.py",
+                     "test_consistency_lint.py")
+
+
+def _registered_site_locs(index: ProjectIndex
+                          ) -> Dict[str, List[Tuple[str, int, int]]]:
+    """{site: [(path, line, col), ...]} over fire()/_fp() literals."""
+    out: Dict[str, List[Tuple[str, int, int]]] = {}
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name not in ("fire", "_fp"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            out.setdefault(node.args[0].value, []).append(
+                (mod.path, node.lineno, node.col_offset))
+    return out
+
+
+def _armed_sites(schedule_index: ProjectIndex) -> Set[str]:
+    from .failpoint_check import _spec_segments
+
+    armed: Set[str] = set()
+    for mod in schedule_index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and "=" in node.value and ":" in node.value):
+                continue
+            for site, _trigger, _action in _spec_segments(node.value):
+                armed.add(site)
+    return armed
+
+
+def check_coverage(registry_index: ProjectIndex,
+                   schedule_index: ProjectIndex) -> List[Finding]:
+    """RTL175: registered failpoint sites no schedule arms."""
+    registered = _registered_site_locs(registry_index)
+    if not schedule_index.modules:
+        return [Finding(
+            rule="RTL175", severity="error", path="<schedules>", line=0,
+            col=0,
+            message="no schedule files found — --schedules paths "
+                    "resolve to no Python files, so EVERY registered "
+                    "site would count as uncovered",
+            hint=NeverFiredFailpointSite.hint)]
+    if not registered:
+        return [Finding(
+            rule="RTL175", severity="error", path="<registry>", line=0,
+            col=0,
+            message="no failpoints.fire()/_fp() sites found in the "
+                    "scanned paths — point the positional paths at the "
+                    "package that registers the injection sites",
+            hint=NeverFiredFailpointSite.hint)]
+    armed = _armed_sites(schedule_index)
+    # a keyed site counts as armed when any qualified form arms it
+    armed_heads: Set[str] = set(armed)
+    for site in armed:
+        head = site
+        while "." in head:
+            head = head.rsplit(".", 1)[0]
+            armed_heads.add(head)
+    findings: List[Finding] = []
+    for site, locs in sorted(registered.items()):
+        if site in armed or site in armed_heads:
+            continue
+        path, line, col = locs[0]
+        findings.append(Finding(
+            rule="RTL175", severity="error", path=path, line=line,
+            col=col,
+            message=(f"failpoint site {site!r} is registered but no "
+                     f"chaos schedule or test arms it — the fault it "
+                     f"injects (and the recovery path behind it) has "
+                     f"never fired"),
+            hint=NeverFiredFailpointSite.hint))
+    out = []
+    for f in findings:
+        mod = registry_index.by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def check_coverage_paths(registry_paths: Sequence[str],
+                         schedule_paths: Sequence[str],
+                         exclude_basenames: Sequence[str]
+                         = COVERAGE_EXCLUDES,
+                         on_error=None) -> List[Finding]:
+    reg = ProjectIndex.build(registry_paths, on_error=on_error)
+    sched = ProjectIndex.build(schedule_paths, on_error=on_error)
+    for path in [p for p in sched.by_path
+                 if p.rsplit("/", 1)[-1] in set(exclude_basenames)]:
+        mod = sched.by_path.pop(path)
+        sched.modules.pop(mod.modname, None)
+    return check_coverage(reg, sched)

@@ -84,6 +84,7 @@ def all_rules() -> List["Rule"]:
     from . import protocol_check as _pc  # noqa: F401
     from . import failpoint_check as _fc  # noqa: F401
     from . import event_check as _ec  # noqa: F401
+    from . import consistency as _cons  # noqa: F401
 
     return [cls() for cls in _RULE_CLASSES]
 
@@ -770,6 +771,7 @@ def _flow_pass(sources: Dict[str, str], rules: Optional[List[Rule]],
     """
     from .cache import file_sig, memo_module, remember_module
     from .concurrency import analyze_concurrency
+    from .consistency import analyze_consistency
     from .flow import analyze_flow
     from .project import ProjectIndex
 
@@ -797,6 +799,7 @@ def _flow_pass(sources: Dict[str, str], rules: Optional[List[Rule]],
     rule_ids = None if rules is None else [r.id for r in rules]
     out = analyze_flow(idx, rule_ids)
     out.extend(analyze_concurrency(idx, rule_ids))
+    out.extend(analyze_consistency(idx, rule_ids))
     return out
 
 

@@ -123,11 +123,16 @@ def test_compound_schedules_declare_order_and_tiers():
     assert fast["tier"] == "fast" and full["tier"] == "slow"
     assert full["kwargs"]["extra_nodes"] >= 4  # N >> 2 hosts
     assert full["kwargs"]["pin_stages"]
+    # the fast variant also arms a passive warm-step recv stall (the
+    # RTL175 coverage gate drove it): journal-validated, but not a
+    # workload-timestamped fault, so it lives outside `order`
+    assert len(fast["faults"]) == 3
+    assert len(full["faults"]) == 2
     for s in (fast, full):
-        assert len(s["faults"]) == 2
         armed = [seg.partition("=")[0]
                  for seg in s["spec"].split(";") if seg]
-        assert s["order"] == armed
+        it = iter(armed)
+        assert all(site in it for site in s["order"])  # in-order subseq
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,648 @@
+"""raylint v4 — RTL17x crash-consistency & durability analysis.
+
+Positive + negative fixtures per rule, the four historical durability
+bug shapes re-detected on their pre-fix forms (inline-value ack before
+the WAL append, export-blob partial replay, publish-before-commit,
+unpicklable typed member-lost error), the clean orderings (append
+first, error-reply in the exclusive arm, whole-payload helper
+consumption), the RTL175 failpoint-coverage pass (armed / unarmed /
+keyed qualification / allowlist / loud empty scopes), default-scan and
+cache integration, `--changed` scoping, and the two committed-tree
+gates (`--consistency`, `--coverage`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from ray_tpu.analysis import (ScanCache, analyze_consistency,
+                              analyze_paths, check_coverage)
+from ray_tpu.analysis.cli import main as check_main
+from ray_tpu.analysis.project import ProjectIndex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cons(src: str, path: str = "t.py"):
+    """(rule, line) pairs from the consistency family over one file."""
+    idx = ProjectIndex()
+    idx.add_source(path, textwrap.dedent(src))
+    return [(f.rule, f.line) for f in analyze_consistency(idx)]
+
+
+def cons_rules(src: str):
+    return [r for r, _ in cons(src)]
+
+
+def cons_findings(src: str, path: str = "t.py"):
+    idx = ProjectIndex()
+    idx.add_source(path, textwrap.dedent(src))
+    return analyze_consistency(idx)
+
+
+# A minimal durable core in the gcs.py shape: replay unpacks
+# `snapshot, wal = self.log.load()`, loops `for op, payload in wal`,
+# compacts through `_make_snapshot`, appends through `_log_append`.
+def durable(handlers: str, replay_kv: str = 'self.kv[payload[0]] = payload[1]',
+            snapshot: str = 'return {"kv": dict(self.kv)}',
+            snap_load: str = 'self.kv = dict(snapshot.get("kv", {}))',
+            extra_ops: str = "") -> str:
+    return f'''
+    class Server:
+        def __init__(self):
+            self.kv = {{}}
+            self.log = None
+
+        def _log_append(self, op, payload):
+            self.log.append(op, payload)
+            self.log.maybe_compact(self._make_snapshot)
+
+        def _replay_persisted(self):
+            snapshot, wal = self.log.load()
+            {snap_load}
+            for op, payload in wal:
+                if op == "kv":
+                    {replay_kv}
+                {extra_ops}
+
+        def _make_snapshot(self):
+            {snapshot}
+
+        {handlers}
+    '''
+
+
+# ======================================= RTL171 (reply-before-WAL-append)
+
+def test_rtl171_historical_inline_value_ack_fires():
+    """The historical inline-value shape: the handler stores the value
+    in the durable table and replies ok BEFORE the WAL append — a crash
+    in the reply->append window acknowledges state the restart forgets
+    (the gcs.wal.before probe window)."""
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            conn.reply(rid, ok=True)
+            self._log_append("kv", (key, value))
+    ''')
+    assert cons_rules(src) == ["RTL171"]
+
+
+def test_rtl171_append_before_reply_clean():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+            conn.reply(rid, ok=True)
+    ''')
+    assert "RTL171" not in cons_rules(src)
+
+
+def test_rtl171_error_reply_in_exclusive_arm_clean():
+    """An early error-reply in the arm that does NOT mutate is fine:
+    sibling if-arms are exclusive, so no path replies after mutating."""
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            if key is None:
+                conn.reply(rid, error="bad key")
+            else:
+                self.kv[key] = value
+                self._log_append("kv", (key, value))
+                conn.reply(rid, ok=True)
+    ''')
+    assert "RTL171" not in cons_rules(src)
+
+
+def test_rtl171_reply_in_mutating_arm_fires():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            if key is not None:
+                self.kv[key] = value
+                conn.reply(rid, ok=True)
+                self._log_append("kv", (key, value))
+    ''')
+    assert "RTL171" in cons_rules(src)
+
+
+def test_rtl171_appending_helper_counts_as_append():
+    """A same-class helper that appends internally covers the reply at
+    its call site (the _obj_put_one shape)."""
+    src = durable('''
+        def _put_one(self, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._put_one(key, value)
+            conn.reply(rid, ok=True)
+    ''')
+    assert "RTL171" not in cons_rules(src)
+
+
+def test_rtl171_non_wal_table_mutation_clean():
+    """Mutating a table replay does NOT restore (ephemeral state) never
+    needs WAL ordering — resync hellos rebuild it."""
+    src = durable('''
+        def _h_hello(self, conn, rid, wid, addr):
+            self.worker_addrs[wid] = addr
+            conn.reply(rid, ok=True)
+    ''')
+    assert "RTL171" not in cons_rules(src)
+
+
+def test_rtl171_replay_fn_itself_exempt():
+    # replay mutates every table by definition; it must not self-flag
+    src = durable('''
+        def _h_noop(self, conn, rid):
+            conn.reply(rid, ok=True)
+    ''')
+    assert cons_rules(src) == []
+
+
+def test_rtl171_inline_suppression():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            conn.reply(rid, ok=True)  # raylint: disable=RTL171 (speculative ack: the follow-up commit frame retracts on crash)
+            self._log_append("kv", (key, value))
+    ''')
+    assert cons_rules(src) == []
+
+
+# ===================================== RTL173 (publish-before-WAL-append)
+
+def test_rtl173_historical_publish_before_commit_fires():
+    """The historical shape: subscribers told about the registration
+    before it was durable — a crash-restart then disagrees with every
+    listener."""
+    src = durable('''
+        def _h_actor_create(self, conn, rid, name, spec):
+            self.kv[name] = spec
+            self._pub("actors", name)
+            self._log_append("kv", (name, spec))
+            conn.reply(rid, ok=True)
+    ''')
+    assert cons_rules(src) == ["RTL173"]
+
+
+def test_rtl173_append_then_publish_clean():
+    src = durable('''
+        def _h_actor_create(self, conn, rid, name, spec):
+            self.kv[name] = spec
+            self._log_append("kv", (name, spec))
+            self._pub("actors", name)
+            conn.reply(rid, ok=True)
+    ''')
+    assert cons_rules(src) == []
+
+
+def test_rtl173_plane_event_emit_counts_as_publish():
+    src = durable('''
+        def _h_actor_create(self, conn, rid, name, spec):
+            self.kv[name] = spec
+            events.emit("gcs.actor.created", name=name)
+            self._log_append("kv", (name, spec))
+    ''')
+    assert cons_rules(src) == ["RTL173"]
+
+
+# ============================================ RTL172 (append-replay drift)
+
+def test_rtl172_op_without_replay_branch_fires():
+    src = durable('''
+        def _h_pin(self, conn, rid, oid):
+            self.kv[oid] = True
+            self._log_append("pin", (oid,))
+            conn.reply(rid, ok=True)
+    ''')
+    assert "RTL172" in cons_rules(src)
+
+
+def test_rtl172_dead_replay_branch_fires():
+    """A replay branch whose appender was renamed away: dead replay
+    code, the renamed op is silently not restored."""
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+            conn.reply(rid, ok=True)
+    ''', extra_ops='''
+                elif op == "kv_old":
+                    self.kv[payload[0]] = payload[1]
+    ''')
+    assert any(f.rule == "RTL172" and "'kv_old'" in f.message
+               and "dead replay" in f.message for f in cons_findings(src))
+
+
+def test_rtl172_historical_partial_replay_fires():
+    """The historical export-blob shape: the append stages a 3-field
+    row, replay consumes only two — the third field is persisted and
+    silently dropped at every restart."""
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value, origin):
+            self.kv[key] = value
+            self._log_append("kv", (key, value, origin))
+            conn.reply(rid, ok=True)
+    ''')
+    fs = cons_findings(src)
+    assert [f.rule for f in fs] == ["RTL172"]
+    assert "payload[2]" in fs[0].message
+
+
+def test_rtl172_replay_reads_past_staged_fields_fires():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key):
+            self.kv[key] = True
+            self._log_append("kv", (key,))
+            conn.reply(rid, ok=True)
+    ''')
+    fs = cons_findings(src)
+    assert any(f.rule == "RTL172" and "payload[1]" in f.message
+               for f in fs)
+
+
+def test_rtl172_dict_payload_field_drift_fires():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", {"k": key, "v": value, "ts": 0})
+            conn.reply(rid, ok=True)
+    ''', replay_kv='self.kv[payload["k"]] = payload["v"]')
+    fs = cons_findings(src)
+    assert [f.rule for f in fs] == ["RTL172"]
+    assert "'ts'" in fs[0].message
+
+
+def test_rtl172_replay_subscripts_unstaged_key_fires():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", {"k": key})
+            conn.reply(rid, ok=True)
+    ''', replay_kv='self.kv[payload["k"]] = payload["v"]')
+    assert any(f.rule == "RTL172" and "KeyError" in f.message
+               for f in cons_findings(src))
+
+
+def test_rtl172_whole_payload_helper_hop_clean():
+    """Replay hands the payload whole to a same-class restore helper
+    (the _restore_pg idiom): no per-field accounting is possible, so no
+    drift is claimed."""
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value, origin):
+            self.kv[key] = value
+            self._log_append("kv", (key, value, origin))
+            conn.reply(rid, ok=True)
+
+        def _restore_kv(self, row):
+            self.kv[row[0]] = row[1:]
+    ''', replay_kv='self._restore_kv(payload)')
+    assert cons_rules(src) == []
+
+
+def test_rtl172_non_literal_payload_skipped():
+    # a payload built elsewhere (a Name) can't be field-checked
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, row):
+            self.kv[key] = row
+            self._log_append("kv", row)
+            conn.reply(rid, ok=True)
+    ''')
+    assert cons_rules(src) == []
+
+
+def test_rtl172_snapshot_key_never_deserialized_fires():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+            conn.reply(rid, ok=True)
+    ''', snapshot='return {"kv": dict(self.kv), "pins": []}')
+    assert any(f.rule == "RTL172" and "'pins'" in f.message
+               and "never deserializes" in f.message
+               for f in cons_findings(src))
+
+
+def test_rtl172_snapshot_key_never_serialized_fires():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+            conn.reply(rid, ok=True)
+    ''', snap_load='self.kv = dict(snapshot.get("kv", {}));'
+                   ' self.pins = snapshot.get("pins", [])')
+    assert any(f.rule == "RTL172" and "'pins'" in f.message
+               and "never serializes" in f.message
+               for f in cons_findings(src))
+
+
+def test_rtl172_matched_snapshot_and_payload_clean():
+    src = durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+            conn.reply(rid, ok=True)
+    ''')
+    assert cons_rules(src) == []
+
+
+# ======================================== RTL174 (unpicklable exceptions)
+
+def test_rtl174_historical_member_lost_shape_fires():
+    """The pre-fix CollectiveMemberLost shape: multi-field ctor,
+    formatted super().__init__ message, no __reduce__ — pickling
+    re-calls the ctor with one string and the typed error dies at the
+    actor boundary."""
+    src = '''
+    class CollectiveMemberLost(RuntimeError):
+        def __init__(self, op, generation, lost):
+            super().__init__(
+                f"collective {op} lost members {lost} in gen {generation}")
+            self.op = op
+            self.generation = generation
+            self.lost = lost
+    '''
+    assert cons_rules(src) == ["RTL174"]
+
+
+def test_rtl174_reduce_present_clean():
+    src = '''
+    class CollectiveMemberLost(RuntimeError):
+        def __init__(self, op, generation, lost):
+            super().__init__(f"{op} lost {lost} in gen {generation}")
+            self.op = op
+            self.generation = generation
+            self.lost = lost
+
+        def __reduce__(self):
+            return (type(self), (self.op, self.generation, self.lost))
+    '''
+    assert cons_rules(src) == []
+
+
+def test_rtl174_single_field_ctor_clean():
+    # Cls(msg) round-trips through default Exception.args pickling
+    src = '''
+    class DrainTimeout(TimeoutError):
+        def __init__(self, msg):
+            super().__init__(msg)
+    '''
+    assert cons_rules(src) == []
+
+
+def test_rtl174_non_exception_class_clean():
+    src = '''
+    class MemberRecord:
+        def __init__(self, rank, addr, state):
+            self.rank = rank
+            self.addr = addr
+            self.state = state
+    '''
+    assert cons_rules(src) == []
+
+
+def test_rtl174_inherited_reduce_through_project_base_clean():
+    src = '''
+    class PlaneError(RuntimeError):
+        def __reduce__(self):
+            return (type(self), self._ctor_args)
+
+    class MemberLost(PlaneError):
+        def __init__(self, op, rank):
+            super().__init__(f"{op} lost rank {rank}")
+            self._ctor_args = (op, rank)
+    '''
+    assert cons_rules(src) == []
+
+
+def test_rtl174_kwonly_and_vararg_params_counted():
+    src = '''
+    class BoundaryError(ConnectionError):
+        def __init__(self, stage, *, attempt):
+            super().__init__(f"stage {stage} attempt {attempt}")
+    '''
+    assert cons_rules(src) == ["RTL174"]
+
+
+# ================================================ RTL175 (--coverage)
+
+def _indexes(registry_src: str, schedule_src: str):
+    reg = ProjectIndex()
+    reg.add_source("svc.py", textwrap.dedent(registry_src))
+    sched = ProjectIndex()
+    sched.add_source("suite.py", textwrap.dedent(schedule_src))
+    return reg, sched
+
+
+REGISTRY = '''
+from ray_tpu._private import failpoints
+
+def step(self):
+    failpoints.fire("gcs.wal.before")
+    failpoints.fire("mpmd.boundary.recv", key=self.stage)
+'''
+
+
+def test_rtl175_unarmed_site_fires():
+    reg, sched = _indexes(REGISTRY, '''
+    SCHEDULES = [dict(spec="gcs.wal.before=once:kill")]
+    ''')
+    fs = check_coverage(reg, sched)
+    assert [(f.rule, "mpmd.boundary.recv" in f.message) for f in fs] \
+        == [("RTL175", True)]
+
+
+def test_rtl175_armed_site_clean():
+    reg, sched = _indexes(REGISTRY, '''
+    SCHEDULES = [dict(
+        spec="gcs.wal.before=once:kill;mpmd.boundary.recv=hit1:delay:0.1")]
+    ''')
+    assert check_coverage(reg, sched) == []
+
+
+def test_rtl175_keyed_arm_covers_head_site():
+    """Arming the qualified form (site.s2) covers the registered head
+    site — fire(site, key=...) journals as site[key] and the armed
+    segment substring-matches."""
+    reg, sched = _indexes(REGISTRY, '''
+    SCHEDULES = [dict(
+        spec="gcs.wal.before=once:kill;mpmd.boundary.recv.s2=once:drop")]
+    ''')
+    assert check_coverage(reg, sched) == []
+
+
+def test_rtl175_allowlist_suppression_at_fire_line():
+    reg, sched = _indexes('''
+    from ray_tpu._private import failpoints
+
+    def step(self):
+        failpoints.fire("debug.only.site")  # raylint: disable=RTL175 (manual-repro hook, never in CI schedules)
+    ''', '''
+    SCHEDULES = [dict(spec="gcs.wal.before=once:kill")]
+    ''')
+    assert check_coverage(reg, sched) == []
+
+
+def test_rtl175_empty_schedule_scope_is_loud():
+    reg = ProjectIndex()
+    reg.add_source("svc.py", textwrap.dedent(REGISTRY))
+    fs = check_coverage(reg, ProjectIndex())
+    assert len(fs) == 1 and "no schedule files" in fs[0].message
+
+
+def test_rtl175_empty_registry_scope_is_loud():
+    sched = ProjectIndex()
+    sched.add_source("suite.py", 'S = "a.b=once:kill"\n')
+    fs = check_coverage(ProjectIndex(), sched)
+    assert len(fs) == 1 and "no failpoints.fire()" in fs[0].message
+
+
+# ==================================== default scan / cache / CLI plumbing
+
+def test_consistency_family_runs_in_default_scan(tmp_path):
+    (tmp_path / "svc.py").write_text(textwrap.dedent(durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            conn.reply(rid, ok=True)
+            self._log_append("kv", (key, value))
+    ''')))
+    fs = analyze_paths([str(tmp_path)])
+    assert any(f.rule == "RTL171" for f in fs)
+
+
+def test_consistency_findings_survive_cached_rescan(tmp_path):
+    """Cross-file passes are never cached: a warm per-file cache must
+    still recompute (and re-report) the RTL17x findings."""
+    (tmp_path / "svc.py").write_text(textwrap.dedent(durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            conn.reply(rid, ok=True)
+            self._log_append("kv", (key, value))
+    ''')))
+    cache_file = str(tmp_path / ".cache.json")
+    for _ in range(2):
+        cache = ScanCache(cache_file, rules_key="all")
+        fs = analyze_paths([str(tmp_path)], cache=cache)
+        assert any(f.rule == "RTL171" for f in fs)
+
+
+def test_cli_consistency_mode_exit_code(tmp_path, capsys):
+    (tmp_path / "svc.py").write_text(textwrap.dedent(durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            conn.reply(rid, ok=True)
+            self._log_append("kv", (key, value))
+    ''')))
+    rc = check_main([str(tmp_path), "--consistency", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert [f["rule"] for f in data["findings"]] == ["RTL171"]
+
+    (tmp_path / "svc.py").write_text(textwrap.dedent(durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            self._log_append("kv", (key, value))
+            conn.reply(rid, ok=True)
+    ''')))
+    rc = check_main([str(tmp_path), "--consistency", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["findings"] == []
+
+
+def test_cli_coverage_mode_exit_code(tmp_path, capsys):
+    (tmp_path / "svc.py").write_text(textwrap.dedent(REGISTRY))
+    sched_dir = tmp_path / "sched"
+    sched_dir.mkdir()
+    (sched_dir / "suite.py").write_text(
+        'S = "gcs.wal.before=once:kill"\n')
+    rc = check_main([str(tmp_path / "svc.py"), "--coverage",
+                     "--schedules", str(sched_dir), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert any("mpmd.boundary.recv" in f["message"]
+               for f in data["findings"])
+
+    (sched_dir / "suite.py").write_text(
+        'S = "gcs.wal.before=once:kill;mpmd.boundary.recv=once:drop"\n')
+    rc = check_main([str(tmp_path / "svc.py"), "--coverage",
+                     "--schedules", str(sched_dir), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["findings"] == []
+
+
+def _git(cwd, *argv):
+    subprocess.run(["git", *argv], cwd=cwd, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_scopes_consistency_mode(tmp_path, monkeypatch, capsys):
+    """--consistency composes with --changed: the finding reports only
+    while its file is in the change closure."""
+    bad = textwrap.dedent(durable('''
+        def _h_kv_put(self, conn, rid, key, value):
+            self.kv[key] = value
+            conn.reply(rid, ok=True)
+            self._log_append("kv", (key, value))
+    '''))
+    (tmp_path / "svc.py").write_text(bad)
+    (tmp_path / "other.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "base")
+    monkeypatch.chdir(tmp_path)
+
+    (tmp_path / "svc.py").write_text(bad + "\n# touched\n")
+    rc = check_main([".", "--consistency", "--changed", "HEAD",
+                     "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert any(f["rule"] == "RTL171" for f in data["findings"])
+
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "touch")
+    (tmp_path / "other.py").write_text("x = 2\n")
+    rc = check_main([".", "--consistency", "--changed", "HEAD",
+                     "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["findings"] == []
+
+
+# ============================================ committed-tree gates (tier-1)
+
+def test_consistency_gate_on_committed_tree():
+    """`ray_tpu check --consistency` must stay clean on ray_tpu/ —
+    every durable mutation orders mutate -> append -> reply/publish,
+    append and replay agree, and typed boundary errors pickle."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--consistency", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "crash-consistency drift:\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []
+
+
+def test_coverage_gate_on_committed_tree():
+    """`ray_tpu check --coverage` must stay clean: every registered
+    failpoint site is armed by some chaos schedule or test (or carries
+    an inline allowlist with its reason)."""
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--coverage", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+    data = json.loads(p.stdout)
+    assert p.returncode == 0, (
+        "failpoint coverage gap:\n"
+        + "\n".join(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+                    for f in data["findings"]))
+    assert data["findings"] == []

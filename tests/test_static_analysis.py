@@ -448,7 +448,9 @@ def test_rule_table_covers_all_families():
                    + ["RTL132"]                                # plane events
                    + ["RTL141", "RTL142"]                      # atomicity
                    + ["RTL151", "RTL152"]                      # affinity
-                   + ["RTL161", "RTL162"])                     # lifecycle
+                   + ["RTL161", "RTL162"]                      # lifecycle
+                   + ["RTL171", "RTL172", "RTL173", "RTL174"]  # consistency
+                   + ["RTL175"])                               # coverage
 
 
 # ------------------------------------- decoration-time (RAY_TPU_STATIC_CHECKS)
