@@ -373,5 +373,25 @@ def main():
     print(json.dumps(record))
 
 
+def _dispatch():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--mode", default="train", choices=("train", "stripe"),
+        help="train: Llama step throughput (default). stripe: object "
+             "plane v2 verification — striped-broadcast source share + "
+             "over-arena serve-from-spill ratio, from chunk events "
+             "(writes records/STRIPE_r18.json).")
+    args, _ = ap.parse_known_args()
+    if args.mode == "stripe":
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks import stripe_share
+
+        stripe_share.main()
+    else:
+        main()
+
+
 if __name__ == "__main__":
-    main()
+    _dispatch()

@@ -574,6 +574,88 @@ def workload_mpmd_kill_then_drain(n_microbatches: int = 4,
         c.shutdown()
 
 
+def workload_spill_broadcast(nodes: int = 3, mb: int = 4,
+                             count: int = 6) -> dict:
+    """Object plane v2 (ISSUE 18) under fault: a working set twice the
+    head arena is put (forcing spill writes mid-run), every node pulls
+    every object — the spilled ones are served chunk-granular off the
+    spill tier — and the GCS is crash-restarted WHILE the pulls are in
+    flight. The armed spill sites (``store.spill.write`` at the
+    eviction boundary, ``store.spill.read`` under every served pread)
+    fire inside this workload. Every pull must land the exact payload,
+    and the spill files must survive the restart (they live in the
+    session dir, not GCS memory; the fresh instance re-learns
+    servability from the WAL'd entries)."""
+    import glob
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.cluster_utils import Cluster
+
+    # Spilling requires the Python store (the native arena refuses to
+    # free sighted objects — the same gate tests/test_spilling.py uses).
+    os.environ["RAY_TPU_DISABLE_NATIVE_STORE"] = "1"
+    c = Cluster(connect=True, head_node_args={
+        "num_cpus": 2, "probe_tpu": False,
+        "resources": {
+            "object_store_memory": float((mb * count // 2) << 20)}})
+    try:
+        for i in range(nodes - 1):
+            c.add_node(num_cpus=1, resources={f"b{i}": 4})
+        assert c.wait_for_nodes(nodes, timeout=120)
+        assert c.wait_for_workers(timeout=120)
+
+        @ray_tpu.remote(max_retries=4)
+        def fetch(wrapped):
+            blob = ray_tpu.get(wrapped[0])  # raylint: disable=RTL001
+            return (blob[0], len(blob))
+
+        opts = [dict(resources={f"b{i}": 1}) for i in range(nodes - 1)]
+        small = ray_tpu.put(b"x")
+        ray_tpu.get([fetch.options(**o).remote([small]) for o in opts],
+                    timeout=60)
+
+        # Constant-byte payloads: blob[0] identifies the object, so a
+        # chunk served from the wrong offset/file cannot pass.
+        payloads = [bytes([i + 1]) * (mb << 20) for i in range(count)]
+        refs = [ray_tpu.put(p) for p in payloads]
+        w = global_worker()
+        sdir = w.session_dir
+        spill_glob = os.path.join(sdir, "spill", "*.bin")
+        deadline = time.time() + 20
+        while not glob.glob(spill_glob) and time.time() < deadline:
+            time.sleep(0.1)
+        spilled_before = len(glob.glob(spill_glob))
+        assert spilled_before > 0, (
+            "working set 2x the arena never spilled — capacity knob or "
+            "spill plane broken")
+
+        pulls = [fetch.options(**o).remote([r]) for r in refs
+                 for o in opts]
+        time.sleep(0.2)  # pulls (striped + spill-served) in flight
+        assert w.request_gcs({"t": "gcs_restart"}, timeout=10).get("ok")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                w.cluster_info()
+                break
+            except Exception:
+                time.sleep(0.2)
+
+        outs = ray_tpu.get(pulls, timeout=240)
+        expect = [(i + 1, mb << 20) for i in range(count) for _ in opts]
+        assert outs == expect, f"post-restart pulls wrong: {outs[:6]}..."
+        spilled_after = len(glob.glob(spill_glob))
+        assert spilled_after > 0, "spill files lost across GCS restart"
+        return {"nodes": nodes, "objects": count, "mb": mb,
+                "spilled_files_before": spilled_before,
+                "spilled_files_after": spilled_after,
+                "pulls_ok": len(outs), "_session_dir": sdir}
+    finally:
+        os.environ.pop("RAY_TPU_DISABLE_NATIVE_STORE", None)
+        c.shutdown()
+
+
 def workload_podracer(updates: int = 6) -> dict:
     """The Podracer (Sebulba) IMPALA tier under an env-runner SIGKILL
     schedule (``podracer.sample.r1=hitK:kill`` — per-PROCESS hits, so
@@ -627,6 +709,7 @@ WORKLOADS = {
     "coord_death": workload_coord_death,
     "drain_pipeline": workload_drain_pipeline,
     "mpmd_kill_then_drain": workload_mpmd_kill_then_drain,
+    "spill_broadcast": workload_spill_broadcast,
     "podracer": workload_podracer,
 }
 
@@ -711,6 +794,22 @@ SCHEDULES = [
     dict(name="bcast_holder_disconnect", tier="slow", seed=63,
          spec="bcast.serve.chunk=p0.08:raise",
          workload="broadcast", fault="holder death mid-stripe"),
+    # --- object plane v2 (ISSUE 18): serve-from-spill under fault. The
+    #     workload itself crash-restarts the GCS mid-broadcast (the
+    #     gcs_restart chaos op — deterministic timing relative to the
+    #     in-flight pulls); the armed sites add IO faults on the spill
+    #     tier on top.
+    dict(name="spill_serve_short_read", tier="slow", seed=64,
+         spec="store.spill.read=p0.2:short",
+         workload="spill_broadcast",
+         fault="spilled-chunk short read mid-serve (retryable miss, "
+               "puller fails over / retries)"),
+    dict(name="spill_write_drop_read_raise", tier="slow", seed=65,
+         spec="store.spill.write=every3:drop;store.spill.read=p0.08:raise",
+         workload="spill_broadcast",
+         fault="dropped spill writes (entry stays in arena) + spill "
+               "pread failures, across a GCS crash-restart "
+               "mid-broadcast"),
     # --- gang fault plane (generation-stamped membership + fail-fast
     #     collectives + drain-aware pipeline reshape)
     # The gang control-plane sites ride the same run: registration /
@@ -864,7 +963,8 @@ def run_schedule(sched: dict, *, keep_cluster: bool = False) -> dict:
         overrides.setdefault("health_check_interval_s", 1.0)
         manages_cluster = sched["workload"] in ("broadcast",
                                                 "drain_pipeline",
-                                                "mpmd_kill_then_drain")
+                                                "mpmd_kill_then_drain",
+                                                "spill_broadcast")
         if not manages_cluster:
             ray_tpu.init(num_cpus=4, probe_tpu=False,
                          _system_config=overrides)

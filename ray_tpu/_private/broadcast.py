@@ -162,14 +162,27 @@ def serve_obj_fetch(conn, msg: dict, view, *, miss: bool = False,
             view.close()
             conn._abort_transport()
             return
+    oid_hex = bytes(msg.get("oid") or b"").hex()[:12]
     if msg.get("sg") and length:
-        part = view.data[off:off + length]
+        try:
+            # Materialize BEFORE committing to the reply: a spill-backed
+            # view preads here and a short read (file evicted/truncated
+            # under us) must become a retryable miss, not a framed reply
+            # whose payload never arrives.
+            part = view.data[off:off + length]
+        except OSError:
+            view.close()
+            try:
+                conn.reply(msg, {"ok": False, "miss": True})
+            except ConnectionError:
+                pass
+            return
         if stats is not None:
             stats["bcast_sg_chunks_served"] += 1
             stats["bcast_bytes_served"] += length
         plane_events.emit("bcast.chunk.serve", plane="bcast",
                           tenant=plane_events.process_tenant(),
-                          off=off, nbytes=length)
+                          off=off, nbytes=length, oid=oid_hex)
         try:
             conn.reply(msg, {"ok": True, "total": total, "off": off},
                        buffers=[part], release=view.close)
@@ -178,7 +191,11 @@ def serve_obj_fetch(conn, msg: dict, view, *, miss: bool = False,
         return
     # Legacy copy path (peers that didn't ask for SG frames).
     try:
-        chunk = bytes(view.data[off:off + length]) if length else b""
+        try:
+            chunk = bytes(view.data[off:off + length]) if length else b""
+        except OSError:
+            conn.reply(msg, {"ok": False, "miss": True})
+            return
         if stats is not None:
             stats["bcast_copy_chunks_served"] += 1
             stats["bcast_bytes_served"] += length
@@ -282,6 +299,17 @@ def _serve_conn_blocking(sock: socket.socket, resolve: Callable,
                     return  # outer finally closes the socket mid-frame
             try:
                 if msg.get("sg") and ln:
+                    # Materialize the chunk BEFORE the header goes out: an
+                    # arena view slices for free, a spill-backed view
+                    # preads here — and a short pread (eviction racing the
+                    # serve) must resolve as a retryable miss, not a
+                    # header whose promised payload never follows.
+                    try:
+                        part = view.data[off:off + ln]
+                    except OSError:
+                        sock.sendall(pack({"i": rid, "r": 1, "ok": False,
+                                           "miss": True}))
+                        continue
                     header = msgpack.packb(
                         {"i": rid, "r": 1, "ok": True, "total": total,
                          "off": off, "bl": [ln]}, use_bin_type=True)
@@ -290,15 +318,22 @@ def _serve_conn_blocking(sock: socket.socket, resolve: Callable,
                     sock.sendall(head)
                     # Straight from the pinned arena/pull buffer: the only
                     # user-space touch of the payload on the serve side.
-                    sock.sendall(view.data[off:off + ln])
+                    sock.sendall(part)
                     if stats is not None:
                         stats["bcast_sg_chunks_served"] += 1
                         stats["bcast_bytes_served"] += ln
-                    plane_events.emit("bcast.chunk.serve", plane="bcast",
-                                      tenant=plane_events.process_tenant(),
-                                      off=off, nbytes=ln)
+                    plane_events.emit(
+                        "bcast.chunk.serve", plane="bcast",
+                        tenant=plane_events.process_tenant(),
+                        off=off, nbytes=ln,
+                        oid=bytes(msg.get("oid") or b"").hex()[:12])
                 else:
-                    chunk = bytes(view.data[off:off + ln]) if ln else b""
+                    try:
+                        chunk = bytes(view.data[off:off + ln]) if ln else b""
+                    except OSError:
+                        sock.sendall(pack({"i": rid, "r": 1, "ok": False,
+                                           "miss": True}))
+                        continue
                     if stats is not None:
                         stats["bcast_copy_chunks_served"] += 1
                         stats["bcast_bytes_served"] += ln
@@ -548,6 +583,9 @@ class StripedPull:
                  exclude_addrs=(), rotate: Optional[int] = None,
                  pidx: Optional[int] = None, npull: int = 1):
         self.oid_b = oid_b
+        # Short object tag on every chunk event: the stripe-share report
+        # groups claim/serve/steal/done rows per (object, source).
+        self.oid_hex = bytes(oid_b).hex()[:12]
         self.nbytes = nbytes
         self.buf = buf if isinstance(buf, memoryview) else memoryview(buf)
         self.cs = max(int(chunk_bytes), 1)
@@ -595,6 +633,16 @@ class StripedPull:
         # so hold-back never wedges a pull.
         self.npull = max(1, int(npull))
         self.pidx = pidx  # directory-assigned puller ordinal (events tag)
+        # Broadcast ramp: a directory-registered puller (pidx assigned)
+        # that locates FIRST sees npull=1 — the directory can't know the
+        # fan-out that is still arriving — and an unrestricted width lets
+        # it commit the whole ring against the source before the first
+        # refresh lands. Until a refresh confirms the real puller count,
+        # width is computed against a minimum fan-out prior; a genuinely
+        # solo pull loses only one refresh interval of full width, a
+        # broadcast keeps its early stripes disjoint (the relay fodder).
+        self._npull_prior = 4 if pidx is not None else 1
+        self._npull_seen = False
         self._relax = 0
         self._idle_nd = -1
         self._idle_t0 = _perf_counter()
@@ -706,8 +754,10 @@ class StripedPull:
         # the source endpoints win every claim race long before peer
         # coverage reaches the directory.
         width = n
-        if relays is not None and self.npull > 1:
-            width = min(n, (n + self.npull - 1) // self.npull
+        npull = self.npull if self._npull_seen \
+            else max(self.npull, self._npull_prior)
+        if relays is not None and npull > 1:
+            width = min(n, (n + npull - 1) // npull
                         + max(2, self.window // 2) + self._relax)
         fallback = None
         for step in range(n):
@@ -730,7 +780,8 @@ class StripedPull:
             self.claimed.add(i)
             plane_events.emit("bcast.chunk.claim", plane="bcast",
                               tenant=plane_events.process_tenant(),
-                              src=src.addr, idx=i, pidx=self.pidx)
+                              src=src.addr, idx=i, pidx=self.pidx,
+                              oid=self.oid_hex)
             return i
         if fallback is not None:
             i, step = fallback
@@ -738,7 +789,8 @@ class StripedPull:
             self.claimed.add(i)
             plane_events.emit("bcast.chunk.claim", plane="bcast",
                               tenant=plane_events.process_tenant(),
-                              src=src.addr, idx=i, pidx=self.pidx)
+                              src=src.addr, idx=i, pidx=self.pidx,
+                              oid=self.oid_hex)
             return i
         # Endgame steal: every remaining chunk is claimed by some OTHER
         # source — duplicate-fetch one of them rather than idle behind a
@@ -753,7 +805,8 @@ class StripedPull:
                     continue
                 plane_events.emit("bcast.chunk.steal", plane="bcast",
                                   tenant=plane_events.process_tenant(),
-                                  src=src.addr, idx=i, pidx=self.pidx)
+                                  src=src.addr, idx=i, pidx=self.pidx,
+                                  oid=self.oid_hex)
                 return i
         return None
 
@@ -921,7 +974,7 @@ class StripedPull:
                         plane_events.emit(
                             "bcast.chunk.done", plane="bcast", dur=_dt,
                             src=addr, idx=idx, nbytes=want,
-                            pidx=self.pidx)
+                            pidx=self.pidx, oid=self.oid_hex)
                         self._complete(idx, addr, want)
                         continue
                     data = hdr.get("data")  # legacy copy reply
@@ -982,6 +1035,11 @@ class StripedPull:
                 loc = await self.locate()
             except Exception:
                 loc = None
+            if loc:
+                # The directory has now seen every concurrent
+                # registration that beat this refresh: its npull is
+                # authoritative, the broadcast ramp prior retires.
+                self._npull_seen = True
             added = self._admit_sources(loc) if loc else 0
             if not self.live_addrs() and self.ndone < self.nchunks:
                 stall = 0 if added else stall + 1

@@ -53,6 +53,30 @@ class RayTpuConfig:
     pull_progress_chunks: int = 4          # chunk-bitmap report cadence
     pull_refresh_interval_s: float = 0.05  # mid-pull directory re-locate
     pull_max_sources: int = 8              # stripe fan-in cap per pull
+    # ---- object plane v2: sub-chunk striping + serve-from-spill
+    # Directory-assigned canonical chunk size: on the FIRST pull-locate of
+    # an object the GCS picks a chunk size targeting at least
+    # ``stripe_min_chunks`` chunks (never below ``stripe_chunk_floor``,
+    # never above pull_chunk_bytes) and publishes it in the locate reply.
+    # Sub-chunking is what turns a 16-64MB weight leaf — one or a few
+    # pull_chunk_bytes chunks, i.e. unstripeable — into a relay: a puller
+    # holding ANY chunk registers as a partial holder and serves it to
+    # its peers while its own pull is still in flight. 0 disables (legacy
+    # whole-chunk behavior: first puller's client chunk size wins).
+    stripe_min_chunks: int = 64
+    stripe_chunk_floor: int = 256 << 10    # don't sub-chunk below 256KB
+    # Serve chunks straight off the spill file (os.pread per chunk)
+    # instead of restoring the whole file into the arena first. Kills the
+    # broadcast cliff where a spilled hot object forces a full-file read
+    # + arena re-admission (possibly re-evicting what displaced it)
+    # before the first byte moves. False restores the legacy
+    # restore-then-serve path.
+    spill_serve: bool = True
+    # Shared byte budget for spill-tier reads (striped chunk serves AND
+    # full restores draw from one bucket): max bytes of spill IO in
+    # flight per process before further reads queue. Bounds disk
+    # thrash when many pullers stripe one spilled object.
+    spill_read_budget: int = 64 << 20
     max_peer_conns: int = 32               # cached idle pull connections
     inline_threshold: int = 100 * 1024
     # Direct-lane ceiling: actor-call args above inline_threshold and at

@@ -39,7 +39,7 @@ from .broadcast import bitmap_make, bitmap_set, bitmap_test
 from .config import config as _cfg
 from .gcs_shards import ShardedDict
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
-from .object_store import make_store
+from .object_store import make_store, spill_budget
 
 logger = logging.getLogger(__name__)
 
@@ -69,9 +69,17 @@ N_DEAD = "DEAD"
 
 def _read_spilled(path: str) -> bytes:
     """Blocking spilled-object read — always called via run_in_executor
-    (the payload spilled because it was big; see _do_pull)."""
-    with open(path, "rb") as f:
-        return f.read()
+    (the payload spilled because it was big; see _do_pull). Draws from
+    the shared spill IO budget as a RESTORE lane so full-file relays and
+    striped chunk serves are paced by one byte bucket."""
+    n = max(1, os.path.getsize(path))
+    budget = spill_budget()
+    budget.acquire(n, "restore")
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        budget.release(n)
 
 
 def _res_fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
@@ -1751,6 +1759,13 @@ class GcsServer:
         ``[oid, 2, nbytes]`` shm, ``[oid, 0, err]`` lost."""
         oid = ObjectID(oid_b)
         entry = self._obj(oid)
+        if (entry.spilled is not None and _cfg().spill_serve
+                and self._spill_servable(entry)):
+            # Serve-from-spill: don't restore the whole file into the
+            # arena before the waiter moves a byte — reply the shm row
+            # and let the puller stripe chunks straight off the spill
+            # tier (obj_locate advertises the spill-serving endpoints).
+            return [oid_b, 2, entry.nbytes]
         if entry.spilled is not None and not self._restore_spilled(entry):
             # Can't re-admit to the store: serve the disk bytes inline.
             try:
@@ -1886,6 +1901,18 @@ class GcsServer:
                         and node.obj_addr not in addrs):
                     addrs.append(node.obj_addr)
                     holder_nodes.append(node)
+        elif entry.spilled is not None and _cfg().spill_serve:
+            # Spilled head-host object: the spill path is deterministic
+            # (session_dir/spill/<oid>.bin), so every head-arena process
+            # can pread chunks straight off the file — advertise them as
+            # sources instead of forcing a full RAM restore before the
+            # first byte moves (serve-from-spill).
+            for node in self.nodes.values():
+                if (node.alive and node.obj_addr
+                        and node.store_suffix == ""
+                        and node.obj_addr not in addrs):
+                    addrs.append(node.obj_addr)
+                    holder_nodes.append(node)
         # A holder NODE can serve from several processes: its agent plus
         # idle workers attached to the same arena (each with its own TCP
         # serve socket). One serving process tops out well below a
@@ -1917,6 +1944,15 @@ class GcsServer:
         # Cooperative-broadcast surface: mid-pull partial holders with
         # their chunk bitmaps, the canonical chunk size, and per-source
         # in-flight pull counts (load-aware striping).
+        if msg.get("pull") and not entry.cs:
+            # Sub-chunk striping: the directory assigns the canonical
+            # chunk size on the FIRST pull-locate, targeting at least
+            # stripe_min_chunks chunks per object. A 16-64MB weight leaf
+            # is one-or-few default chunks — unstripeable; sub-chunking
+            # gives every puller chunks to relay while its own pull is
+            # still in flight, which is what drives the origin's share
+            # of a cooperative broadcast below 50%.
+            entry.cs = self._stripe_chunk_size(entry.nbytes)
         if entry.cs:
             reply["cs"] = entry.cs
         if msg.get("pull"):
@@ -2534,6 +2570,40 @@ class GcsServer:
         os.makedirs(path, exist_ok=True)
         return path
 
+    def _stripe_chunk_size(self, nbytes: int) -> int:
+        """Directory-assigned canonical chunk size for a pulled object:
+        halve the transfer chunk until the object splits into at least
+        ``stripe_min_chunks`` chunks, never below ``stripe_chunk_floor``
+        (per-chunk framing overhead dominates beneath it). 0 = striping
+        disabled; the first puller's client chunk size wins as before."""
+        cfg = _cfg()
+        want = int(cfg.stripe_min_chunks)
+        if want <= 0 or nbytes <= 0:
+            return 0
+        cs = max(1, int(cfg.pull_chunk_bytes))
+        floor = max(1, int(cfg.stripe_chunk_floor))
+        while cs > floor and (nbytes + cs - 1) // cs < want:
+            cs //= 2
+        return max(cs, floor)
+
+    def _spill_servable(self, entry) -> bool:
+        """Can a puller stripe this spilled object off the spill tier /
+        surviving holders right now, without a full restore? True when a
+        live endpoint exists: a registered holder node, or any head-arena
+        process that can pread the deterministic spill path."""
+        for nid in entry.holders:
+            node = self.nodes.get(NodeID(nid))
+            if node is not None and node.alive and node.obj_addr:
+                return True
+        if entry.spilled is None or not os.path.exists(entry.spilled):
+            # No holder and no file: unrecoverable — let the wait path's
+            # restore attempt produce the honest lost row.
+            return False
+        for node in self.nodes.values():
+            if node.alive and node.obj_addr and node.store_suffix == "":
+                return True
+        return False
+
     def _spill_until_under(self, target_bytes: int):
         # Oldest-first over referenced, ready, head-host shm objects.
         for entry in list(self.objects.values()):
@@ -2547,6 +2617,12 @@ class GcsServer:
             path = os.path.join(self._spill_dir(),
                                 entry.object_id.hex() + ".bin")
             try:
+                if failpoints.active():
+                    # Spill-write boundary: ``raise`` lands in the OSError
+                    # handler below (write failed, object stays in the
+                    # arena); ``drop`` skips spilling this entry.
+                    if failpoints.fire("store.spill.write") == "drop":
+                        continue
                 with open(path, "wb") as f:
                     f.write(view.data)
             except OSError:
@@ -2567,8 +2643,7 @@ class GcsServer:
         if entry.spilled is None:
             return True
         try:
-            with open(entry.spilled, "rb") as f:
-                data = f.read()
+            data = _read_spilled(entry.spilled)
         except OSError:
             logger.exception("spill restore failed for %s",
                              entry.object_id.hex())

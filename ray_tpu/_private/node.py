@@ -585,13 +585,26 @@ class NodeAgent:
         return self._store
 
     def _resolve_obj_fetch(self, msg: dict):
+        from .config import config
         from .ids import ObjectID
+        from .object_store import open_spilled
 
         oid = ObjectID(bytes(msg["oid"]))
         try:
             view = self._host_store().get(oid, msg.get("nbytes", 0))
         except Exception:
             view = None
+        if view is None and config().spill_serve:
+            # Serve-from-spill: the arena copy was evicted but the GCS's
+            # spill file sits at a deterministic session-dir path — pread
+            # the requested chunk straight off it, no restore. A vanished
+            # file resolves as a retryable miss, not a dead object.
+            try:
+                view = open_spilled(self.session_dir, oid,
+                                    int(msg.get("nbytes", 0)))
+            except Exception:
+                view = None
+            return view, view is None
         return view, False
 
     def _on_gcs_close(self):

@@ -29,9 +29,209 @@ import threading
 from multiprocessing import shared_memory, resource_tracker
 from typing import Dict, Optional
 
+from . import failpoints
 from .ids import ObjectID
 
 _PREFIX = "rtpu"
+
+
+def spill_path(session_dir: str, object_id: ObjectID) -> str:
+    """Deterministic spill-file location for an object.
+
+    The GCS writes spill files here and every process on the head host
+    (agents, workers answering chunk fetches) derives the same path from
+    (session_dir, oid) alone — serve-from-spill needs no path exchange.
+    """
+    return os.path.join(session_dir, "spill", object_id.hex() + ".bin")
+
+
+class SpillIOBudget:
+    """One byte budget for every spill-tier read in this process.
+
+    Striped chunk serves (many pullers preading one spilled object) and
+    full restores draw from the same bucket: at most ``limit`` bytes of
+    spill IO admitted at once, extra readers queue. Admission is
+    at-least-one — a single read larger than the whole budget still runs
+    (alone) instead of deadlocking. Counters double as the spill
+    accounting surface (``stats()``): serves and restores are separate
+    lanes of one budget, which is the invariant the object-plane-v2
+    tests pin down.
+    """
+
+    def __init__(self, limit: int):
+        self.limit = max(1, int(limit))
+        self._inflight = 0
+        self._cond = threading.Condition()
+        self._stats = {"serve_reads": 0, "serve_bytes": 0,
+                       "restore_reads": 0, "restore_bytes": 0,
+                       "queued": 0}
+
+    def acquire(self, nbytes: int, kind: str = "serve"):
+        with self._cond:
+            if self._inflight + nbytes > self.limit and self._inflight > 0:
+                self._stats["queued"] += 1
+                while self._inflight > 0 and \
+                        self._inflight + nbytes > self.limit:
+                    self._cond.wait(timeout=1.0)
+            self._inflight += nbytes
+            self._stats[f"{kind}_reads"] += 1
+            self._stats[f"{kind}_bytes"] += nbytes
+
+    def release(self, nbytes: int):
+        with self._cond:
+            self._inflight -= nbytes
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._cond:
+            out = dict(self._stats)
+            out["inflight"] = self._inflight
+            out["limit"] = self.limit
+            return out
+
+
+_spill_budget: Optional[SpillIOBudget] = None
+_spill_budget_lock = threading.Lock()
+
+
+def spill_budget(limit: int = 0) -> SpillIOBudget:
+    """Process-global spill IO budget (created on first use)."""
+    global _spill_budget
+    with _spill_budget_lock:
+        if _spill_budget is None:
+            if limit <= 0:
+                from .config import config
+                limit = config().spill_read_budget
+            _spill_budget = SpillIOBudget(limit)
+        return _spill_budget
+
+
+def spill_io_stats() -> dict:
+    """Spill accounting snapshot; zeros before any spill IO happened."""
+    with _spill_budget_lock:
+        b = _spill_budget
+    if b is None:
+        return {"serve_reads": 0, "serve_bytes": 0, "restore_reads": 0,
+                "restore_bytes": 0, "queued": 0, "inflight": 0, "limit": 0}
+    return b.stats()
+
+
+class _SpillData:
+    """Lazy pread window over a spill file, shaped like the whole-object
+    memoryview the serve paths slice.
+
+    Supports exactly the contract ``serve_obj_fetch`` /
+    ``_serve_conn_blocking`` rely on: ``len(data)`` is the object size
+    and ``data[off:off+ln]`` yields that chunk's bytes — here via
+    ``os.pread`` against a shared fd (pread is positionless, so
+    concurrent serve threads share one descriptor safely). A short read
+    (file truncated or unlinked under us — eviction vs. serve race)
+    raises ``OSError``; the serve paths translate that into a retryable
+    chunk miss instead of shipping garbage.
+    """
+
+    __slots__ = ("_path", "_nbytes", "_budget", "_fd", "_lock")
+
+    def __init__(self, path: str, nbytes: int,
+                 budget: Optional[SpillIOBudget] = None):
+        self._path = path
+        self._nbytes = int(nbytes)
+        self._budget = budget
+        self._fd: Optional[int] = None
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._nbytes
+
+    def _ensure_fd(self) -> int:
+        with self._lock:
+            if self._fd is None:
+                self._fd = os.open(self._path, os.O_RDONLY)
+            return self._fd
+
+    def __getitem__(self, key):
+        if not isinstance(key, slice):
+            raise TypeError("spill view supports slice reads only")
+        start, stop, step = key.indices(self._nbytes)
+        if step != 1:
+            raise ValueError("spill view reads must be contiguous")
+        ln = max(0, stop - start)
+        if ln == 0:
+            return b""
+        act = None
+        if failpoints.active():
+            # Spill-read boundary: ``raise`` is an injected IO error
+            # (FailpointError is a ConnectionError, hence an OSError —
+            # the same class a vanished file raises); ``short`` truncates
+            # the pread result so the short-read validation below trips.
+            act = failpoints.fire("store.spill.read")
+        if self._budget is not None:
+            self._budget.acquire(ln, "serve")
+        try:
+            buf = os.pread(self._ensure_fd(), ln, start)
+        finally:
+            if self._budget is not None:
+                self._budget.release(ln)
+        if act in ("short", "drop"):
+            buf = buf[:len(buf) // 2]
+        if len(buf) != ln:
+            raise OSError(
+                f"short spill read: wanted {ln} at {start}, got {len(buf)}")
+        return buf
+
+    def release(self):
+        self.close()
+
+    def close(self):
+        with self._lock:
+            fd, self._fd = self._fd, None
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+class SpillView:
+    """Serve-from-spill view: chunk-granular reads straight off the
+    spill tier, no arena restore.
+
+    Duck-types :class:`PlasmaObjectView` for the chunk-serve paths —
+    ``.data`` (sliceable, sized) and ``.close()`` — so a resolver can
+    hand it to ``serve_obj_fetch`` / the blocking serve loop unchanged.
+    Restoring a multi-GB spilled object into RAM before the first chunk
+    moves is the broadcast cliff object plane v2 removes: the serve side
+    now preads exactly the requested chunk.
+    """
+
+    __slots__ = ("data",)
+
+    def __init__(self, path: str, nbytes: int,
+                 budget: Optional[SpillIOBudget] = None):
+        self.data = _SpillData(path, nbytes,
+                               budget if budget is not None
+                               else spill_budget())
+
+    def transfer(self):
+        return None
+
+    def close(self):
+        self.data.close()
+
+
+def open_spilled(session_dir: str, object_id: ObjectID,
+                 nbytes: int) -> Optional[SpillView]:
+    """A :class:`SpillView` over the object's spill file, or None when
+    the file is absent (not spilled here / already restored+unlinked)."""
+    path = spill_path(session_dir, object_id)
+    try:
+        if nbytes <= 0:
+            nbytes = os.path.getsize(path)
+        elif not os.path.exists(path):
+            return None
+    except OSError:
+        return None
+    return SpillView(path, nbytes)
 
 
 class _Segment(shared_memory.SharedMemory):

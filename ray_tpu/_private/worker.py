@@ -1437,6 +1437,18 @@ class Worker:
             return view, view is None
         view = (self.store.get(oid, msg.get("nbytes", 0))
                 if self.store is not None else None)
+        if view is None and self.session_dir and _cfg().spill_serve:
+            # Serve-from-spill fallback (idle workers are advertised as
+            # extra serve endpoints): pread chunks off the GCS's
+            # deterministic spill file; absent file = retryable miss.
+            from .object_store import open_spilled
+
+            try:
+                sview = open_spilled(self.session_dir, oid,
+                                     int(msg.get("nbytes", 0)))
+            except Exception:
+                sview = None
+            return sview, sview is None
         return view, False
 
     def handle_obj_fetch(self, conn, msg: dict):

@@ -1,0 +1,31 @@
+"""jax version shims shared by the parallel wrappers.
+
+One seam for the ``shard_map`` entry-point drift: jax >= 0.5 exports
+``jax.shard_map`` with the replication-check flag spelled ``check_vma``;
+0.4.x only has ``jax.experimental.shard_map.shard_map`` with the same
+flag spelled ``check_rep``. Every shard_map-wrapping module in this
+package imports from here so the version fork lives in exactly one
+place (ulysses grew its own copy first; ring/moe/pipeline silently
+required jax >= 0.5 until this was hoisted).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:  # pragma: no cover - version-dependent
+    def axis_size(axis):
+        # psum of a Python literal folds to a static int at trace time,
+        # so callers can keep using the result in shapes / range().
+        return jax.lax.psum(1, axis)

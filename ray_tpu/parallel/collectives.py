@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.parallel._compat import axis_size as _axis_size
+
 AxisName = Union[str, Sequence[str]]
 
 
@@ -62,7 +64,7 @@ def alltoall(x, axis: AxisName = "sp", *, split_axis: int,
 
 def permute(x, axis: AxisName, shift: int = 1):
     """Ring shift by ``shift`` along a mesh axis (ppermute)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -77,7 +79,7 @@ def axis_index(axis: AxisName):
 
 
 def axis_size(axis: AxisName):
-    return lax.axis_size(axis)
+    return _axis_size(axis)
 
 
 class HostCollectiveGroup:

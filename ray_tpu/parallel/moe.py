@@ -29,6 +29,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ray_tpu.parallel._compat import axis_size as _axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -107,7 +109,7 @@ def ep_moe_ffn(x: jax.Array, w_router: jax.Array,
     experts_local: this device's expert shard, leading dim E/ep.
     Returns (out [B_local, L, D], aux_loss scalar, psum-averaged over ep).
     """
-    ep = lax.axis_size(axis)
+    ep = _axis_size(axis)
     E = w_router.shape[1]
     E_local = E // ep
     B, L, D = x.shape
@@ -186,7 +188,7 @@ def make_ep_moe_ffn(mesh, k: int, capacity_factor: float = 2.0,
                     aux = lax.pmean(aux, a)
             return out, aux
 
-        out, aux = jax.shard_map(
+        out, aux = shard_map(
             local, mesh=mesh,
             in_specs=(P(batch_axes, None, None), P(), expert_specs),
             out_specs=(P(batch_axes, None, None), P()),

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, List, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ray_tpu.parallel._compat import axis_size as _axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -80,7 +82,7 @@ def spmd_pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
         (replicated across ``axis``; only stage 0 consumes it).
     Returns: [M, mb, ...] outputs, identical on every ``axis`` member.
     """
-    pp = lax.axis_size(axis)
+    pp = _axis_size(axis)
     idx = lax.axis_index(axis)
     M = microbatches.shape[0]
     fwd = [(j, (j + 1) % pp) for j in range(pp)]
@@ -236,7 +238,7 @@ def make_pipelined_loss(mesh, cfg, n_microbatches: int,
             out = spmd_pipeline(stage_fn, stacked_local, mb)
             return out.reshape(x.shape)
 
-        x = jax.shard_map(
+        x = shard_map(
             run_pipe, mesh=mesh,
             in_specs=(_stacked_in_specs(params["stacked"], mesh),
                       P(("dp", "fsdp"), None, None), P(), P()),

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.parallel._compat import axis_size as _axis_size
+
 NEG_INF = -1e30
 
 # Per-core VMEM the ``auto`` gate lets the flash kernel's resident K/V
@@ -35,6 +37,17 @@ NEG_INF = -1e30
 # runtime. Override: RAY_TPU_FLASH_KV_VMEM_BUDGET (bytes).
 _FLASH_KV_VMEM_BUDGET = int(
     os.environ.get("RAY_TPU_FLASH_KV_VMEM_BUDGET", 8 << 20))
+
+
+def _ppermute(x, axis, perm):
+    """Every KV ring rotation goes through this seam (the mirror of
+    ``ulysses._all_to_all``): tests interpose a byte-accounting spy here
+    to pin the GQA bandwidth contract — K/V blocks (and their ring'd
+    gradient shards in the flash backward) transit the ring at their
+    TRUE kv-head count, never repeated to the query-head width first.
+    Repeat-before-rotate would silently inflate ICI bytes by the group
+    factor while still producing correct numbers."""
+    return lax.ppermute(x, axis, perm)
 
 
 def _block_attn(q, k, v, bias, scale):
@@ -90,7 +103,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # GQA KV stays in grouped form while rotating around the ring (1/group
     # the ICI bytes); heads are repeated per-block inside _block_attn.
     kv_rep = H // k.shape[2]
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     my_idx = lax.axis_index(axis)
     if scale is None:
         scale = D ** -0.5
@@ -142,8 +155,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                  + o_blk * beta.transpose(0, 2, 1)[..., None])
         # Rotate KV to the next ring position (overlaps with next compute).
         perm = [(j, (j + 1) % n) for j in range(n)]
-        k_nxt = lax.ppermute(k_blk, axis, perm)
-        v_nxt = lax.ppermute(v_blk, axis, perm)
+        k_nxt = _ppermute(k_blk, axis, perm)
+        v_nxt = _ppermute(v_blk, axis, perm)
         return (o_new, m_new, l_new, (k_nxt, v_nxt)), None
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
@@ -177,7 +190,7 @@ def _ring_flash_forward(q, k, v, axis, causal, scale):
     from ray_tpu.ops.attention import flash_attention_stats
 
     B, Lq, H, D = q.shape
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     my_idx = lax.axis_index(axis)
 
     def step(carry, i):
@@ -196,8 +209,8 @@ def _ring_flash_forward(q, k, v, axis, causal, scale):
                  + o_blk * beta.transpose(0, 2, 1)[..., None])
         perm = [(j, (j + 1) % n) for j in range(n)]
         return (o_new, m_new, l_new,
-                (lax.ppermute(k_blk, axis, perm),
-                 lax.ppermute(v_blk, axis, perm))), None
+                (_ppermute(k_blk, axis, perm),
+                 _ppermute(v_blk, axis, perm))), None
 
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
@@ -227,7 +240,7 @@ def _ring_flash_bwd(axis, causal, scale, res, dout):
     B, Lq, H, D = q.shape
     Hk = k.shape[2]
     rep = H // Hk
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     my_idx = lax.axis_index(axis)
     q32 = q.astype(jnp.float32)
     do = dout.astype(jnp.float32)
@@ -294,10 +307,10 @@ def _ring_flash_bwd(axis, causal, scale, res, dout):
         # steps every (dk, dv) lands back on its owner.
         perm = [(j, (j + 1) % n) for j in range(n)]
         return (dq_acc,
-                lax.ppermute(k_blk, axis, perm),
-                lax.ppermute(v_blk, axis, perm),
-                lax.ppermute(dk_blk, axis, perm),
-                lax.ppermute(dv_blk, axis, perm)), None
+                _ppermute(k_blk, axis, perm),
+                _ppermute(v_blk, axis, perm),
+                _ppermute(dk_blk, axis, perm),
+                _ppermute(dv_blk, axis, perm)), None
 
     dq0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     dk0 = jnp.zeros((B, k.shape[1], Hk, D), jnp.float32)
@@ -321,9 +334,11 @@ def make_ring_attention(mesh, *, causal: bool = True, axis: str = "sp",
     """
     from jax.sharding import PartitionSpec as P
 
+    from ray_tpu.parallel._compat import shard_map
+
     spec = P(batch_axes, axis, head_axis, None)
     fn = functools.partial(ring_attention, axis=axis, causal=causal,
                            block_impl=block_impl)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

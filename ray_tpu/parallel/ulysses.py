@@ -24,16 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-try:  # jax >= 0.5 exports it at top level (check_vma spelling)
-    _shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - version-dependent
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
-        # The experimental entry point spells the replication-check
-        # flag check_rep; semantics are the same.
-        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=check_vma)
+from ray_tpu.parallel._compat import shard_map as _shard_map
 
 # Indirection point: the byte-count assertion test (CPU interpreter
 # path) wraps this to account per-shard all-to-all bytes without

@@ -245,6 +245,49 @@ def row_to_dict(row, nid_hex: str = "", pid: int = 0) -> dict:
             "node_id": nid_hex, "pid": pid}
 
 
+def stripe_share(rows) -> Dict[str, dict]:
+    """Per-object source-share accounting over broadcast chunk events.
+
+    Input: decoded plane-event rows (``list_plane_events()`` dicts).
+    Every completed chunk transfer emits ``bcast.chunk.done`` on the
+    PULLER with ``{oid, src, nbytes}`` — summing those per (object,
+    source) yields exactly how many delivered bytes each endpoint
+    served. The object-plane-v2 target is stated on this output: on a
+    cooperative relay no single source (the origin included) serves
+    >=50% of an object's delivered bytes. Endgame ``bcast.chunk.steal``
+    duplicates are counted so a report can bound the waste.
+    """
+    out: Dict[str, dict] = {}
+    for r in rows:
+        name = r.get("name")
+        if name not in ("bcast.chunk.done", "bcast.chunk.steal"):
+            continue
+        f = r.get("fields") or {}
+        oid = str(f.get("oid") or "")
+        o = out.setdefault(oid, {"bytes": 0, "chunks": 0, "steals": 0,
+                                 "sources": {}})
+        if name == "bcast.chunk.steal":
+            o["steals"] += 1
+            continue
+        src = str(f.get("src") or "?")
+        nb = int(f.get("nbytes") or 0)
+        o["bytes"] += nb
+        o["chunks"] += 1
+        s = o["sources"].setdefault(src, {"chunks": 0, "bytes": 0})
+        s["chunks"] += 1
+        s["bytes"] += nb
+    for o in out.values():
+        total = o["bytes"]
+        max_src, max_bytes = "", 0
+        for src, s in o["sources"].items():
+            s["share"] = (s["bytes"] / total) if total else 0.0
+            if s["bytes"] > max_bytes:
+                max_src, max_bytes = src, s["bytes"]
+        o["max_share"] = (max_bytes / total) if total else 0.0
+        o["max_src"] = max_src
+    return out
+
+
 _snapshot_config()
 try:
     from ray_tpu._private.config import on_config_change

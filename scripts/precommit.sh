@@ -46,6 +46,15 @@ gate ray_tpu --concurrency
 gate ray_tpu --consistency
 gate ray_tpu --coverage
 
+# Opt-in (PRECOMMIT_STRIPE=1): the object-plane-v2 bench — striped
+# broadcast source share <50% + over-arena serve-from-spill ratio
+# <=1.5x, both asserted inside the bench from the chunk-event ledger.
+# Minutes, not seconds, so it is not in the default path.
+if [ "${PRECOMMIT_STRIPE:-0}" = "1" ]; then
+    echo "==> stripe bench (bench.py --mode stripe)"
+    JAX_PLATFORMS=cpu "$PY" bench.py --mode stripe || fail=1
+fi
+
 if [ "$fail" -ne 0 ]; then
     echo "precommit: FAILED (fix the findings above, or suppress inline"
     echo "with a reason: # raylint: disable=RTL1xx (<why>))"

@@ -191,6 +191,9 @@ def test_stripe_ownership_restricts_full_holder_claims():
     eng = broadcast.StripedPull(
         b"o" * 20, len(dst), memoryview(dst), chunk_bytes=cs,
         window=4, pidx=0, npull=3)
+    # Directory npull confirmed by a refresh: the broadcast ramp prior
+    # has retired and the advertised count is authoritative.
+    eng._npull_seen = True
     src = broadcast._Source("a", None)
     eng.sources["a"] = src
     claimed = []
@@ -209,6 +212,47 @@ def test_stripe_ownership_restricts_full_holder_claims():
     assert eng._relax == 4
     more = eng._claim(src)
     assert more is not None and more == eng.order[width]
+
+
+def test_broadcast_ramp_floors_early_npull():
+    """A directory-registered puller that locates FIRST sees npull=1 —
+    before the first refresh the stripe width is computed against the
+    minimum fan-out prior, so an early locate can't commit the whole
+    ring against the source. A refresh retires the prior."""
+    cs = 64 * 1024
+    nchunks = 32
+    dst = bytearray(nchunks * cs)
+    eng = broadcast.StripedPull(
+        b"o" * 20, len(dst), memoryview(dst), chunk_bytes=cs,
+        window=4, pidx=0, npull=1)
+    src = broadcast._Source("a", None)
+    eng.sources["a"] = src
+    claimed = []
+    while True:
+        i = eng._claim(src)
+        if i is None:
+            break
+        claimed.append(i)
+    # Prior of 4 pullers: ceil(32/4) + max(2, window//2) = 8 + 2.
+    assert len(claimed) == 10
+    # A refresh confirming npull=1 (genuinely solo) unlocks the ring.
+    eng._npull_seen = True
+    while True:
+        i = eng._claim(src)
+        if i is None:
+            break
+        claimed.append(i)
+    assert len(claimed) == nchunks
+    # An engine WITHOUT a directory ordinal (raw P2P pull) never ramps.
+    eng2 = broadcast.StripedPull(
+        b"p" * 20, len(dst), memoryview(bytearray(nchunks * cs)),
+        chunk_bytes=cs, window=4)
+    src2 = broadcast._Source("a", None)
+    eng2.sources["a"] = src2
+    n2 = 0
+    while eng2._claim(src2) is not None:
+        n2 += 1
+    assert n2 == nchunks
 
 
 def test_stripe_stagger_distinct_offsets():

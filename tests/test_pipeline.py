@@ -7,6 +7,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.models import LlamaConfig, init_params, loss_fn
+from ray_tpu.parallel._compat import shard_map
 from ray_tpu.parallel import (
     MeshSpec,
     make_mesh,
@@ -47,7 +48,7 @@ def test_spmd_pipeline_linear_stages(cpu_mesh8):
         out = spmd_pipeline(stage_fn, ws_local, mb)
         return out.reshape(4, 16)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         run, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False))(ws, x)
 

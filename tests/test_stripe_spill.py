@@ -1,0 +1,412 @@
+"""Object plane v2 edge cases: striped pulls racing holder death and
+eviction, and the serve-from-spill tier (pread views, IO budget,
+short-read handling).
+
+These pin the failure-mode contracts the broadcast bench relies on:
+
+- a holder that dies after a chunk CLAIM but before the serve never
+  wedges or restarts the pull — the claim rolls back and another holder
+  carries the chunk;
+- a stale directory bitmap (chunks evicted after the locate reply) turns
+  into retryable per-chunk misses, and the engine stops asking that
+  holder for the evicted chunks;
+- a spill file truncated under a serve (eviction vs. serve race) raises
+  a short-read OSError which the serve paths translate into a miss reply
+  — never a frame whose payload is garbage.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import broadcast, object_store, protocol
+from ray_tpu._private.config import reset_config, set_system_config
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    SpillIOBudget,
+    SpillView,
+    _SpillData,
+    open_spilled,
+    spill_path,
+)
+
+
+# ------------------------------------------------- directory chunk size
+
+
+def test_stripe_chunk_size_targets_min_chunks():
+    """Defaults: 4MB transfer chunks halve until >= 64 chunks/object."""
+    cs = GcsServer._stripe_chunk_size(None, 64 << 20)
+    assert cs == 1 << 20  # 64MB / 1MB = 64 chunks exactly
+    cs = GcsServer._stripe_chunk_size(None, 256 << 20)
+    assert cs == 4 << 20  # already 64 chunks at the transfer size
+    # Never halves past the framing floor: a 4MB object stops at 256KB
+    # (16 chunks), not 64KB (64 chunks).
+    cs = GcsServer._stripe_chunk_size(None, 4 << 20)
+    assert cs == 256 << 10
+    assert (4 << 20) // cs == 16
+
+
+def test_stripe_chunk_size_disabled_and_degenerate():
+    assert GcsServer._stripe_chunk_size(None, 0) == 0
+    set_system_config({"stripe_min_chunks": 0})
+    try:
+        assert GcsServer._stripe_chunk_size(None, 64 << 20) == 0
+    finally:
+        reset_config()
+
+
+# ----------------------------------------------------- spill-tier views
+
+
+def _oid(tag: bytes) -> ObjectID:
+    return ObjectID((tag * 20)[:20])
+
+
+def test_spill_path_deterministic(tmp_path):
+    oid = _oid(b"a")
+    p1 = spill_path(str(tmp_path), oid)
+    p2 = spill_path(str(tmp_path), oid)
+    assert p1 == p2
+    assert os.path.dirname(p1) == str(tmp_path / "spill")
+    assert os.path.basename(p1) == oid.hex() + ".bin"
+
+
+def test_spill_data_pread_window(tmp_path):
+    blob = os.urandom(96 * 1024)
+    path = tmp_path / "obj.bin"
+    path.write_bytes(blob)
+    sd = _SpillData(str(path), len(blob))
+    try:
+        assert len(sd) == len(blob)
+        assert sd[0:0] == b""
+        assert sd[10:4096] == blob[10:4096]
+        assert sd[len(blob) - 7:len(blob)] == blob[-7:]
+        with pytest.raises(TypeError):
+            sd[5]
+        with pytest.raises(ValueError):
+            sd[0:100:2]
+    finally:
+        sd.close()
+    sd.close()  # idempotent
+
+
+def test_spill_data_short_read_raises(tmp_path):
+    """File truncated under the view (eviction vs. serve race): reads
+    past the new EOF raise OSError; reads inside it still succeed."""
+    blob = os.urandom(64 * 1024)
+    path = tmp_path / "obj.bin"
+    path.write_bytes(blob)
+    sd = _SpillData(str(path), len(blob))
+    try:
+        assert sd[0:1024] == blob[:1024]  # fd now open
+        os.truncate(path, 16 * 1024)
+        assert sd[0:8192] == blob[:8192]  # inside the surviving prefix
+        with pytest.raises(OSError):
+            sd[8 * 1024:40 * 1024]  # crosses the truncation point
+    finally:
+        sd.close()
+    # Unlinked before first read: the lazy open itself raises OSError.
+    os.unlink(path)
+    sd2 = _SpillData(str(path), len(blob))
+    with pytest.raises(OSError):
+        sd2[0:16]
+
+
+def test_spill_data_draws_serve_budget(tmp_path):
+    blob = os.urandom(8 * 1024)
+    path = tmp_path / "obj.bin"
+    path.write_bytes(blob)
+    budget = SpillIOBudget(1 << 20)
+    sd = _SpillData(str(path), len(blob), budget)
+    try:
+        assert sd[0:4096] == blob[:4096]
+        assert sd[4096:8192] == blob[4096:]
+    finally:
+        sd.close()
+    st = budget.stats()
+    assert st["serve_reads"] == 2
+    assert st["serve_bytes"] == 8192
+    assert st["restore_reads"] == 0
+    assert st["inflight"] == 0  # released even on the happy path
+
+
+def test_open_spilled(tmp_path):
+    oid = _oid(b"b")
+    assert open_spilled(str(tmp_path), oid, 123) is None  # absent
+    path = spill_path(str(tmp_path), oid)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    blob = os.urandom(32 * 1024)
+    with open(path, "wb") as f:
+        f.write(blob)
+    view = open_spilled(str(tmp_path), oid, len(blob))
+    assert view is not None
+    try:
+        assert bytes(view.data[100:200]) == blob[100:200]
+        assert view.transfer() is None  # no zero-copy handle to donate
+    finally:
+        view.close()
+    # nbytes <= 0: size comes from stat (restore path knows no nbytes).
+    view = open_spilled(str(tmp_path), oid, 0)
+    assert view is not None and len(view.data) == len(blob)
+    view.close()
+
+
+# -------------------------------------------------------- spill budget
+
+
+def test_spill_budget_at_least_one_admission():
+    b = SpillIOBudget(10)
+    b.acquire(100)  # larger than the whole budget: runs alone, no wedge
+    assert b.stats()["inflight"] == 100
+    b.release(100)
+    assert b.stats()["inflight"] == 0
+    assert b.stats()["queued"] == 0
+
+
+def test_spill_budget_queues_excess_readers():
+    b = SpillIOBudget(100)
+    b.acquire(60, "serve")
+    landed = []
+
+    def reader():
+        b.acquire(60, "restore")  # 60+60 > 100: must wait for release
+        landed.append(time.monotonic())
+        b.release(60)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.15)
+    assert not landed  # still queued behind the serve read
+    assert b.stats()["queued"] == 1
+    t0 = time.monotonic()
+    b.release(60)
+    t.join(timeout=5)
+    assert landed and landed[0] >= t0
+    st = b.stats()
+    assert st["serve_reads"] == 1 and st["serve_bytes"] == 60
+    assert st["restore_reads"] == 1 and st["restore_bytes"] == 60
+    assert st["inflight"] == 0
+
+
+# ------------------------------------- serve-from-spill x chunk serving
+
+
+class _StubConn:
+    def __init__(self):
+        self.sent = []
+
+    def reply(self, req, msg, buffers=None, release=None):
+        self.sent.append((dict(msg), buffers))
+        if release is not None:
+            release()
+
+
+def test_serve_obj_fetch_from_spill_view(tmp_path):
+    blob = os.urandom(256 * 1024)
+    path = tmp_path / "obj.bin"
+    path.write_bytes(blob)
+    view = SpillView(str(path), len(blob), SpillIOBudget(1 << 20))
+    conn = _StubConn()
+    msg = {"t": "obj_fetch", "i": 1, "off": 64 << 10, "len": 32 << 10,
+           "sg": 1, "oid": b"s" * 20}
+    broadcast.serve_obj_fetch(conn, msg, view)
+    (reply, buffers), = conn.sent
+    assert reply["ok"] and reply["total"] == len(blob)
+    assert b"".join(bytes(x) for x in buffers) == \
+        blob[64 << 10:(64 << 10) + (32 << 10)]
+
+
+@pytest.mark.parametrize("sg", [1, 0])
+def test_serve_obj_fetch_spill_short_read_is_miss(tmp_path, sg):
+    """Serve over a truncated spill file: BOTH reply paths (SG and
+    legacy copy) answer a retryable miss, never a short/garbage frame."""
+    blob = os.urandom(256 * 1024)
+    path = tmp_path / "obj.bin"
+    path.write_bytes(blob)
+    os.truncate(path, 100 * 1024)  # evicted-under-us
+    view = SpillView(str(path), len(blob), SpillIOBudget(1 << 20))
+    conn = _StubConn()
+    msg = {"t": "obj_fetch", "i": 1, "off": 96 << 10, "len": 32 << 10,
+           "oid": b"s" * 20}
+    if sg:
+        msg["sg"] = 1
+    broadcast.serve_obj_fetch(conn, msg, view)
+    (reply, buffers), = conn.sent
+    assert reply == {"ok": False, "miss": True}
+    assert not buffers
+
+
+# -------------------------------------- striped pull: death and races
+
+
+async def _chunk_server(blob, *, die_on_request=None, has=None, cs=None):
+    """Framed-protocol holder with injectable edge behavior.
+
+    ``die_on_request=k``: close the connection when the k-th obj_fetch
+    REQUEST arrives, without serving it — a holder death after the
+    puller's claim but before any bytes move. ``has``: set of chunk
+    indices actually present (others answer a retryable miss — the
+    evicted-after-locate bitmap race); requires ``cs``.
+    """
+    seen = {"req": 0, "served": 0, "missed": 0}
+
+    async def on_client(reader, writer):
+        conn = protocol.Connection(reader, writer)
+        protocol.widen_for_serving(conn)
+
+        async def handler(msg, conn=conn):
+            if msg.get("t") != "obj_fetch":
+                return
+            seen["req"] += 1
+            if die_on_request is not None and seen["req"] >= die_on_request:
+                await conn.close()
+                return
+            if has is not None and int(msg.get("off", 0)) // cs not in has:
+                seen["missed"] += 1
+                broadcast.serve_obj_fetch(conn, msg, None, miss=True)
+                return
+            seen["served"] += 1
+            broadcast.serve_obj_fetch(
+                conn, msg, broadcast.ServeView(memoryview(blob)))
+
+        conn._handler = handler
+        conn.start()
+
+    server = await protocol.serve("127.0.0.1:0", on_client)
+    port = server.sockets[0].getsockname()[1]
+    return server, f"127.0.0.1:{port}", seen
+
+
+def test_holder_dies_after_claim_before_serve():
+    """The claimed-but-never-served chunks roll back into the pool and
+    the surviving holder carries the WHOLE object — zero chunks land
+    from the dead holder, no object restart."""
+    blob = bytearray(os.urandom(2 << 20))
+    cs = 128 * 1024
+    nchunks = len(blob) // cs
+
+    async def main():
+        s_dead, a_dead, n_dead = await _chunk_server(blob, die_on_request=1)
+        s_ok, a_ok, n_ok = await _chunk_server(blob)
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=cs,
+            window=4, chunk_timeout_s=20)
+        ok = await asyncio.wait_for(eng.run({"addrs": [a_dead, a_ok]}), 60)
+        s_dead.close()
+        s_ok.close()
+        return ok, dst, eng, n_dead, n_ok
+
+    ok, dst, eng, n_dead, n_ok = asyncio.run(main())
+    assert ok and dst == blob
+    assert n_dead["served"] == 0  # died with the first claim outstanding
+    assert n_ok["served"] == nchunks
+    assert eng.fetches <= 2 * nchunks  # chunk re-claims, not a restart
+    # Every landed byte is accounted to the one surviving source.
+    assert len(eng.src_bytes) == 1
+    assert sum(eng.src_bytes.values()) == len(blob)
+
+
+def test_stale_bitmap_eviction_races_serve():
+    """A partial holder's directory bitmap says 'all chunks' but half
+    were evicted after the locate reply. Each stale claim answers a
+    retryable miss; the engine clears those bits (stops asking) and the
+    full holder covers the evicted half. The served halves add up."""
+    blob = bytearray(os.urandom(2 << 20))
+    cs = 128 * 1024
+    nchunks = len(blob) // cs
+    kept = set(range(nchunks // 2))  # evicted: the upper half
+
+    async def main():
+        s_part, a_part, n_part = await _chunk_server(blob, has=kept, cs=cs)
+        s_full, a_full, n_full = await _chunk_server(blob)
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=cs,
+            window=4, chunk_timeout_s=20)
+        bm = broadcast.bitmap_make(nchunks)
+        for i in range(nchunks):
+            broadcast.bitmap_set(bm, i)  # stale: claims evicted chunks too
+        ok = await asyncio.wait_for(
+            eng.run({"addrs": [a_full],
+                     "partial": [[a_part, bytes(bm), cs, 0]]}), 60)
+        src = eng.sources[a_part]
+        s_part.close()
+        s_full.close()
+        return ok, dst, eng, n_part, n_full, src
+
+    ok, dst, eng, n_part, n_full, src = asyncio.run(main())
+    assert ok and dst == blob
+    assert n_part["missed"] >= 1  # the race actually happened
+    # Misses cleared the stale bits: the engine no longer believes the
+    # partial holder has what it advertised and lost.
+    assert src.has is not None
+    missed_idx = [i for i in range(nchunks)
+                  if not broadcast.bitmap_test(src.has, i) and i not in kept]
+    assert len(missed_idx) == n_part["missed"]
+    # Nothing evicted was served by the partial holder; the full holder
+    # covered at least the evicted half.
+    assert n_part["served"] + n_full["served"] == nchunks
+    assert n_full["served"] >= nchunks - len(kept)
+    assert sum(eng.src_bytes.values()) == len(blob)
+
+
+def test_striped_pull_serves_from_truncated_spill(tmp_path):
+    """End-to-end spill-serve failover: one holder serves off a spill
+    file that lost its tail (truncated mid-broadcast), the other from
+    memory. Short reads become misses; the pull still lands every byte
+    exactly."""
+    blob = bytes(os.urandom(2 << 20))
+    cs = 128 * 1024
+    path = tmp_path / "obj.bin"
+    path.write_bytes(blob)
+    os.truncate(path, len(blob) // 2)  # spill tier lost the upper half
+    nchunks = len(blob) // cs
+
+    async def main():
+        budget = SpillIOBudget(64 << 20)
+        served = {"n": 0}
+
+        async def on_client(reader, writer):
+            conn = protocol.Connection(reader, writer)
+            protocol.widen_for_serving(conn)
+
+            async def handler(msg, conn=conn):
+                if msg.get("t") != "obj_fetch":
+                    return
+                served["n"] += 1
+                broadcast.serve_obj_fetch(
+                    conn, msg, SpillView(str(path), len(blob), budget))
+
+            conn._handler = handler
+            conn.start()
+
+        s_spill = await protocol.serve("127.0.0.1:0", on_client)
+        a_spill = "127.0.0.1:%d" % s_spill.sockets[0].getsockname()[1]
+        s_mem, a_mem, n_mem = await _chunk_server(bytearray(blob))
+        dst = bytearray(len(blob))
+        eng = broadcast.StripedPull(
+            b"o" * 20, len(blob), memoryview(dst), chunk_bytes=cs,
+            window=4, chunk_timeout_s=20)
+        bm = broadcast.bitmap_make(nchunks)
+        for i in range(nchunks):
+            broadcast.bitmap_set(bm, i)
+        ok = await asyncio.wait_for(
+            eng.run({"addrs": [a_mem],
+                     "partial": [[a_spill, bytes(bm), cs, 0]]}), 60)
+        s_spill.close()
+        s_mem.close()
+        return ok, dst, budget.stats(), served["n"], n_mem
+
+    ok, dst, bstats, spill_reqs, n_mem = asyncio.run(main())
+    assert ok and bytes(dst) == blob
+    assert spill_reqs >= 1  # the spill tier really served chunks
+    assert bstats["serve_reads"] >= 1
+    assert bstats["inflight"] == 0  # budget released across miss paths
+    # The in-memory holder covered at least the truncated upper half.
+    assert n_mem["served"] >= nchunks // 2
